@@ -1,0 +1,49 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities of
+Apache MXNet v0.10.1 (the NNVM-era hybrid imperative/symbolic framework).
+
+Not a port: the reference's async dependency engine + per-op CUDA kernels become
+jax/XLA whole-graph compilation; its KVStore GPU-P2P/ps-lite communication becomes
+ICI/DCN collectives over a jax device mesh; cuDNN kernels become XLA HLOs (+
+Pallas where XLA lags). The user contract preserved: ``mx.nd``, ``mx.sym``,
+``mx.mod.Module.fit``, ``mx.io``, ``mx.kv``, optimizer/metric/initializer/rnn
+namespaces, and checkpoint formats. See SURVEY.md at the repo root for the full
+layer map of the reference this framework re-implements.
+"""
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+from . import random
+from .attribute import AttrScope
+from .name import NameManager, Prefix
+from .executor import Executor
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import optimizer as opt
+from .optimizer import Optimizer
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import monitor
+from . import io
+from . import recordio
+from . import kvstore as kv
+from .kvstore import KVStore, create as _kv_create
+from . import module
+from . import module as mod
+from . import model
+from .model import FeedForward
+from . import rnn
+from . import visualization
+from . import visualization as viz
+from . import profiler
+from . import parallel
+from . import contrib
+from . import test_utils
+
+__version__ = "0.1.0"

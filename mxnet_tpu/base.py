@@ -1,0 +1,109 @@
+"""Core shared utilities for the TPU-native MXNet-capability framework.
+
+This plays the role of the reference's python/mxnet/base.py (ctypes lib loading,
+MXNetError, handle types) — but there is no C handle layer here: the "C API" seam
+of the reference (include/mxnet/c_api.h) is replaced by direct Python calls into a
+jax/XLA-backed runtime, so this module only carries the error type, dtype tables
+and small parsing helpers shared across the package.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MXNetError", "string_types", "numeric_types"]
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (reference: python/mxnet/base.py MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+
+# dtype <-> string tables. Mirrors the reference's TypeFlag set
+# (mshadow type flags consumed at python/mxnet/ndarray.py _DTYPE_NP_TO_MX)
+# plus bfloat16, which is the TPU-native half type (the reference's fp16 story,
+# src/operator/convolution.cu:30-45, maps to bf16 on the MXU).
+_DTYPE_NP_TO_MX = {}
+_DTYPE_MX_TO_NP = {}
+
+
+def _init_dtype_tables():
+    import jax.numpy as jnp
+
+    pairs = [
+        (np.dtype(np.float32), 0),
+        (np.dtype(np.float64), 1),
+        (np.dtype(np.float16), 2),
+        (np.dtype(np.uint8), 3),
+        (np.dtype(np.int32), 4),
+        (np.dtype(np.int8), 5),
+        (np.dtype(np.int64), 6),
+        (np.dtype(jnp.bfloat16), 7),
+        (np.dtype(np.bool_), 8),
+        (np.dtype(np.uint32), 9),
+        (np.dtype(np.uint64), 10),
+    ]
+    for dt, flag in pairs:
+        _DTYPE_NP_TO_MX[dt] = flag
+        _DTYPE_MX_TO_NP[flag] = dt
+
+
+_init_dtype_tables()
+
+
+def py_str(x):
+    if isinstance(x, bytes):
+        return x.decode("utf-8")
+    return str(x)
+
+
+def shape_str(shape):
+    """Render a shape tuple the way MXNet attrs do: ``(1,2,3)``."""
+    return "(" + ",".join(str(int(s)) for s in shape) + ")"
+
+
+def parse_shape(s):
+    """Parse a shape attr string like ``(1, 2, 3)``/``[1,2]``/``3`` into a tuple."""
+    if s is None:
+        return None
+    if isinstance(s, (tuple, list)):
+        return tuple(int(x) for x in s)
+    if isinstance(s, (int, np.integer)):
+        return (int(s),)
+    s = s.strip()
+    if s in ("None", ""):
+        return None
+    s = s.strip("()[]")
+    if not s.strip():
+        return ()
+    return tuple(int(float(tok)) for tok in s.split(",") if tok.strip())
+
+
+def parse_bool(s):
+    if isinstance(s, bool):
+        return s
+    if isinstance(s, (int, np.integer)):
+        return bool(s)
+    return str(s).strip().lower() in ("true", "1", "yes")
+
+
+def parse_int_or_none(s):
+    if s is None or (isinstance(s, str) and s.strip() in ("None", "")):
+        return None
+    return int(float(s))
+
+
+def attr_str(v):
+    """Serialize an attr value to the canonical string form used in graph JSON.
+
+    The reference stores every op attr as a string (dmlc::Parameter text form);
+    we keep that convention so ``tojson`` output is interchangeable.
+    """
+    if isinstance(v, bool):
+        return "True" if v else "False"
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(attr_str(x) for x in v) + ")"
+    if v is None:
+        return "None"
+    return str(v)

@@ -1,0 +1,3 @@
+"""Contrib namespace (reference: python/mxnet/contrib/__init__.py — autograd,
+contrib ops)."""
+from . import autograd  # noqa: F401

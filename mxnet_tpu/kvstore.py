@@ -1,0 +1,260 @@
+"""KVStore — the parallelism/communication backbone.
+
+Reference: include/mxnet/kvstore.h:26 (Init/Push/Pull/set_updater/Barrier/rank),
+src/kvstore/kvstore_local.h, comm.h (CommCPU host-staged tree reduce :62;
+CommDevice GPU P2P gather-reduce-broadcast :211 — no NCCL in this era), and the
+ps-lite distributed tiers (kvstore_dist.h).
+
+TPU design (SURVEY §7 step 5-6):
+* ``local``  — host-staged reduce (the CommCPU analog).
+* ``device`` — reduce on an owner accelerator then broadcast (the CommDevice
+  algorithm); on a multi-chip host the transfers ride ICI. NOTE: the *fast*
+  data-parallel path on TPU is not push/pull at all — Module with
+  kvstore='device' compiles the whole train step SPMD over a jax Mesh with an
+  in-graph psum (parallel/spmd.py), which is how ICI allreduce actually gets
+  used. This explicit KVStore object keeps the reference API contract
+  (kv.init/push/pull/rank) for user code and tests.
+* ``dist_*`` — multi-host over jax.distributed collectives (DCN): rank/size map
+  to process_index/process_count. Single-process fallback keeps launch-less
+  scripts working exactly like the reference's dist modes under 1 worker.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    if isinstance(key, (int, str)):
+        return [key], True
+    return list(key), False
+
+
+def _value_list(value, n):
+    if isinstance(value, NDArray):
+        return [[value]] if n == 1 else [[value]]
+    assert isinstance(value, (list, tuple))
+    if n == 1:
+        if isinstance(value[0], NDArray):
+            return [list(value)]
+        return [list(v) for v in value]
+    out = []
+    for v in value:
+        if isinstance(v, NDArray):
+            out.append([v])
+        else:
+            out.append(list(v))
+    return out
+
+
+class Comm:
+    """Intra-node reduce/broadcast (reference: comm.h:18 Comm ABC)."""
+
+    def reduce(self, arrays):
+        raise NotImplementedError
+
+    def broadcast(self, src, dsts):
+        for d in dsts:
+            src.copyto(d)
+
+
+class CommHost(Comm):
+    """Host-staged sum (reference: CommCPU comm.h:62 — GPU→pinned CPU buffers,
+    OpenMP tree sum; here: device→host gather + numpy sum, then scatter)."""
+
+    def reduce(self, arrays):
+        if len(arrays) == 1:
+            return arrays[0]
+        acc = arrays[0].asnumpy()
+        for a in arrays[1:]:
+            acc = acc + a.asnumpy()
+        return nd.array(acc, ctx=arrays[0].context)
+
+
+class CommDevice(Comm):
+    """On-device gather-reduce (reference: CommDevice comm.h:211 — copy grads to
+    an owner device, ElementwiseSum there, broadcast back; transfers ride ICI on
+    a TPU host). Owner chosen round-robin by key for load balance
+    (InitMergeBuffer :333-361)."""
+
+    def __init__(self):
+        self._owner = {}
+        self._next = 0
+
+    def reduce_key(self, key, arrays):
+        import jax
+
+        if len(arrays) == 1:
+            return arrays[0]
+        if key not in self._owner:
+            self._owner[key] = self._next % len(arrays)
+            self._next += 1
+        owner = arrays[self._owner[key]]
+        dev = owner.data.device if hasattr(owner.data, "device") else None
+        total = owner.data
+        for i, a in enumerate(arrays):
+            if a is owner:
+                continue
+            total = total + jax.device_put(a.data, total.device)
+        return NDArray(total, ctx=owner.context)
+
+    def reduce(self, arrays):
+        return self.reduce_key(0, arrays)
+
+
+class KVStore:
+    """Single-process key-value store (reference: kvstore_local.h:22 +
+    python/mxnet/kvstore.py:49)."""
+
+    def __init__(self, name="local"):
+        self.name = name
+        self._store = {}
+        self._updater = None
+        self._str_keys = {}
+        self._comm = CommDevice() if "device" in name else CommHost()
+        self._optimizer = None
+
+    @property
+    def type(self):
+        return self.name
+
+    # ---- core API -------------------------------------------------------
+    def init(self, key, value):
+        keys, single = _key_list(key)
+        values = _value_list(value, len(keys)) if not single else [value if isinstance(value, list) else [value]]
+        if single:
+            values = [[value]] if isinstance(value, NDArray) else [list(value)]
+        for k, vs in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("key %s already initialized" % str(k))
+            self._store[k] = vs[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Reduce values across devices; apply updater or stash merged grad
+        (reference: kvstore_local push → Comm.Reduce → updater_)."""
+        keys, single = _key_list(key)
+        if single:
+            grouped = [[value]] if isinstance(value, NDArray) else [list(value)]
+        else:
+            grouped = _value_list(value, len(keys))
+        for k, vs in zip(keys, grouped):
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % str(k))
+            if isinstance(self._comm, CommDevice):
+                merged = self._comm.reduce_key(k, vs)
+            else:
+                merged = self._comm.reduce(vs)
+            if self._updater is not None:
+                idx = k if isinstance(k, int) else _str_key_int(k)
+                self._updater(idx, merged, self._store[k])
+            else:
+                self._store[k] = merged.copy()
+
+    def pull(self, key, out=None, priority=0):
+        """Broadcast stored value to out arrays (reference: Comm.Broadcast)."""
+        assert out is not None
+        keys, single = _key_list(key)
+        if single:
+            outs = [[out]] if isinstance(out, NDArray) else [list(out)]
+        else:
+            outs = _value_list(out, len(keys))
+        for k, os_ in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % str(k))
+            self._comm.broadcast(self._store[k], os_)
+
+    # ---- updater / optimizer -------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        """(reference: kvstore.py:226-267 — pickles optimizer to the dist
+        server; locally installs get_updater(optimizer))."""
+        if "dist" in self.name and self.rank == 0:
+            # serialize like the reference so multi-host servers share it
+            optim_str = pickle.dumps(optimizer, 0)
+            self._send_command_to_servers(0, optim_str)
+        self._optimizer = optimizer
+        self.set_updater(opt.get_updater(optimizer))
+
+    def _send_command_to_servers(self, head, body):
+        pass  # single-process: server == worker
+
+    # ---- cluster info ---------------------------------------------------
+    @property
+    def rank(self):
+        """(reference: kvstore.h get_rank)"""
+        return _process_index()
+
+    @property
+    def num_workers(self):
+        """(reference: kvstore.h get_group_size)"""
+        return _process_count() if "dist" in self.name else 1
+
+    def barrier(self):
+        """(reference: kvstore.h Barrier via ps-lite Postoffice)"""
+        if "dist" in self.name and _process_count() > 1:
+            import jax
+
+            # a tiny collective is the barrier on TPU pods
+            jax.block_until_ready(
+                jax.experimental.multihost_utils.sync_global_devices("kvstore_barrier")
+            )
+
+    def save_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def _process_index():
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def _process_count():
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+def _str_key_int(k):
+    return abs(hash(k)) % (1 << 31)
+
+
+def create(name="local"):
+    """Create a KVStore by type string with the reference's substring matching
+    (src/kvstore/kvstore.cc:22-41: local / local_allreduce_cpu /
+    device / local_allreduce_device / dist_sync / dist_async / dist_sync_device)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = (
+        "local", "local_allreduce_cpu", "local_update_cpu",
+        "device", "local_allreduce_device",
+        "dist_sync", "dist_async", "dist_sync_device", "dist_async_device", "dist",
+    )
+    if name not in valid:
+        raise MXNetError("Unknown KVStore type %s" % name)
+    return KVStore(name)

@@ -1,0 +1,279 @@
+"""Legacy FeedForward estimator + checkpoint helpers (reference:
+python/mxnet/model.py — FeedForward :387, _create_kvstore :40, updater helpers
+:79-116, save_checkpoint :319 / load_checkpoint :349).
+
+Checkpoint format preserved: ``prefix-symbol.json`` (Symbol.tojson) +
+``prefix-%04d.params`` (NDArray dict save with arg:/aux: prefixes).
+"""
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+
+import numpy as np
+
+from . import io
+from . import metric as metric_mod
+from . import ndarray as nd
+from . import optimizer as opt
+from . import symbol as sym
+from .base import MXNetError
+from .context import cpu, current_context
+
+__all__ = ["FeedForward", "save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+BatchEndParam = namedtuple("BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Decide kvstore + update_on_kvstore (reference: model.py:40-77)."""
+    from . import kvstore as kvs
+
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(np.prod(param.shape) for param in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_on_kvstore):
+    """(reference: model.py:79)"""
+    for idx, param_on_devs in enumerate(param_arrays):
+        kvstore.init(idx, arg_params[param_names[idx]])
+        if update_on_kvstore:
+            kvstore.pull(idx, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
+    """(reference: model.py:88)"""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        kvstore.push(index, grad_list, priority=-index)
+        kvstore.pull(index, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None):
+    """(reference: model.py:99)"""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            kvstore.push(index, grad_list, priority=-index)
+            kvstore.pull(index, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            updater(index * num_device + k, g, w)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Save symbol + params (reference: model.py:319)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load symbol + params (reference: model.py:349)."""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+class FeedForward:
+    """Legacy estimator API (reference: model.py:387). Thin adapter over
+    Module — the reference keeps it for pre-Module scripts; so do we."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+
+        self.symbol = symbol
+        if ctx is None:
+            ctx = [current_context()]
+        elif not isinstance(ctx, list):
+            ctx = [ctx]
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.kwargs = kwargs.copy()
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None else init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self._pred_exec = None
+
+    def _init_params(self, inputs, overwrite=False):
+        shapes = {item.name: item.shape for item in inputs}
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
+        arg_names = self.symbol.list_arguments()
+        input_names = list(shapes.keys())
+        param_names = [key for key in arg_names if key not in input_names]
+        aux_names = self.symbol.list_auxiliary_states()
+        param_name_attrs = [
+            x for x in zip(arg_names, arg_shapes) if x[0] in param_names
+        ]
+        arg_params = {k: nd.zeros(s) for k, s in param_name_attrs}
+        aux_params = {k: nd.zeros(s) for k, s in zip(aux_names, aux_shapes)}
+        for k, v in arg_params.items():
+            if self.arg_params and k in self.arg_params and (not overwrite):
+                arg_params[k][:] = self.arg_params[k]
+            else:
+                self.initializer(k, v)
+        for k, v in aux_params.items():
+            if self.aux_params and k in self.aux_params and (not overwrite):
+                aux_params[k][:] = self.aux_params[k]
+            else:
+                self.initializer(k, v)
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        return (arg_names, list(param_names), aux_names)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """(reference: model.py FeedForward.fit — delegates the loop to Module)"""
+        from .module import Module
+
+        data = self._prepare_iter(X, y, is_train=True)
+        mod = Module(
+            self.symbol,
+            data_names=[d.name if hasattr(d, "name") else d[0] for d in data.provide_data],
+            label_names=[l.name if hasattr(l, "name") else l[0] for l in data.provide_label],
+            context=self.ctx, logger=logger or logging,
+        )
+        mod.fit(
+            data, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback, batch_end_callback=batch_end_callback,
+            kvstore=kvstore, optimizer=self.optimizer,
+            optimizer_params=dict({"learning_rate": 0.01}, **self.kwargs),
+            initializer=self.initializer,
+            arg_params=self.arg_params, aux_params=self.aux_params,
+            allow_missing=True, begin_epoch=self.begin_epoch,
+            num_epoch=self.num_epoch, monitor=monitor,
+        )
+        self.arg_params, self.aux_params = mod.get_params()
+        self._module = mod
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """(reference: model.py FeedForward.predict)"""
+        data = self._prepare_iter(X, None, is_train=False)
+        if reset:
+            data.reset()
+        from .module import Module
+
+        mod = Module(
+            self.symbol,
+            data_names=[d[0] if isinstance(d, tuple) else d.name for d in data.provide_data],
+            label_names=None, context=self.ctx,
+        )
+        mod.bind(data.provide_data, for_training=False)
+        mod.set_params(self.arg_params, self.aux_params or {}, allow_missing=True)
+        outputs = mod.predict(data, num_batch=num_batch)
+        if isinstance(outputs, list):
+            return [o.asnumpy() for o in outputs]
+        return outputs.asnumpy()
+
+    def score(self, X, eval_metric="acc", num_batch=None, batch_end_callback=None, reset=True):
+        """(reference: model.py FeedForward.score)"""
+        data = self._prepare_iter(X, None, is_train=False)
+        if reset:
+            data.reset()
+        from .module import Module
+
+        mod = Module(
+            self.symbol,
+            data_names=[d[0] if isinstance(d, tuple) else d.name for d in data.provide_data],
+            label_names=[l[0] if isinstance(l, tuple) else l.name for l in data.provide_label],
+            context=self.ctx,
+        )
+        mod.bind(data.provide_data, data.provide_label, for_training=False)
+        mod.set_params(self.arg_params, self.aux_params or {}, allow_missing=True)
+        res = mod.score(data, eval_metric, num_batch=num_batch)
+        return res[0][1]
+
+    def _prepare_iter(self, X, y, is_train):
+        if isinstance(X, io.DataIter):
+            return X
+        if isinstance(X, (np.ndarray, nd.NDArray)):
+            if y is None and is_train:
+                raise ValueError("y must be specified when X is numpy.ndarray")
+            y = y if y is not None else np.zeros(X.shape[0])
+            return io.NDArrayIter(X, y, batch_size=min(self.numpy_batch_size, X.shape[0]),
+                                  shuffle=is_train, last_batch_handle="roll_over" if is_train else "pad")
+        raise TypeError("X must be DataIter or numpy/NDArray")
+
+    def save(self, prefix, epoch=None):
+        """(reference: model.py FeedForward.save)"""
+        if epoch is None:
+            epoch = self.num_epoch
+        assert epoch is not None
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params, self.aux_params)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """(reference: model.py FeedForward.load)"""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(
+            symbol, ctx=ctx, arg_params=arg_params, aux_params=aux_params,
+            begin_epoch=epoch, **kwargs
+        )
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None, eval_metric="acc",
+               epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+               logger=None, work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """(reference: model.py FeedForward.create)"""
+        model = FeedForward(
+            symbol, ctx=ctx, num_epoch=num_epoch, epoch_size=epoch_size,
+            optimizer=optimizer, initializer=initializer or _default_init(), **kwargs
+        )
+        model.fit(
+            X, y, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback, batch_end_callback=batch_end_callback,
+            kvstore=kvstore, logger=logger,
+            eval_end_callback=eval_end_callback, eval_batch_end_callback=eval_batch_end_callback,
+        )
+        return model
+
+
+def _default_init():
+    from . import initializer as init_mod
+
+    return init_mod.Uniform(0.01)

@@ -1,0 +1,332 @@
+"""DataParallelExecutorGroup (reference: python/mxnet/module/executor_group.py:84 —
+decide_slices :227-242, bind_exec :244-319, scatter-forward :369,
+backward-with-out-grads :501, metric gather :530).
+
+Data parallelism on TPU: the group binds one executor per context and slices
+each batch across them, exactly like the reference binds one GraphExecutor per
+GPU. Each per-context executor is its own whole-graph XLA program; gradient
+reduction happens above (KVStore, module.update) or — on the SPMD fast path
+(parallel/spmd.py) — inside one compiled program with psum over the mesh.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..io import DataDesc
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice batch by workload (reference: executor_manager.py:14)."""
+    total_work_load = sum(work_load_list)
+    batch_num_list = [
+        round(work_load * batch_size / total_work_load) for work_load in work_load_list
+    ]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise ValueError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _load_general(data, targets, major_axis):
+    """Scatter batch slices into per-device arrays (reference:
+    executor_group.py _load_general)."""
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, nd.NDArray):
+            d_src.copyto(d_targets)
+        else:
+            src_np = d_src.asnumpy() if isinstance(d_src, nd.NDArray) else np.asarray(d_src)
+            for sl, d_dst in d_targets:
+                d_dst[:] = src_np[sl]
+
+
+def _merge_multi_context(outputs, major_axis):
+    """Concat per-device outputs along the batch axis (reference:
+    executor_group.py _merge_multi_context)."""
+    rets = []
+    for tensors, axis in zip(outputs, major_axis):
+        if axis >= 0 and len(tensors) > 1:
+            rets.append(
+                nd.array(np.concatenate([t.asnumpy() for t in tensors], axis=axis))
+            )
+        else:
+            rets.append(tensors[0])
+    return rets
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=logging, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload if workload else [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.logger = logger
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+        self.shared_group = shared_group
+
+        if not for_training:
+            grad_req = "null"
+        data_names = [x.name if isinstance(x, DataDesc) else x[0] for x in data_shapes]
+        if isinstance(grad_req, str):
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = "null" if k in self.fixed_param_names else grad_req
+                elif k in data_names:
+                    self.grad_req[k] = grad_req if inputs_need_grad else "null"
+                else:
+                    self.grad_req[k] = "null"
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        elif isinstance(grad_req, dict):
+            self.grad_req = {k: "null" for k in self.arg_names}
+            self.grad_req.update(grad_req)
+        else:
+            raise ValueError("invalid grad_req")
+
+        self.execs = []
+        self.data_arrays = None
+        self.label_arrays = None
+        self.param_arrays = None
+        self.grad_arrays = None
+        self.aux_arrays = None
+        self.slices = None
+        self.batch_size = None
+        self.data_shapes = None
+        self.label_shapes = None
+        self.output_layouts = [0] * len(symbol.list_outputs())
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def decide_slices(self, data_shapes):
+        """(reference: executor_group.py:227-242)"""
+        assert len(data_shapes) > 0
+        major_axis = [DataDesc.get_batch_axis(getattr(x, "layout", "NCHW")) for x in data_shapes]
+        for (name, shape), axis in zip(
+            [(x.name, x.shape) if isinstance(x, DataDesc) else x for x in data_shapes], major_axis
+        ):
+            if axis == -1:
+                continue
+            batch_size = shape[axis]
+            if self.batch_size is not None:
+                assert batch_size == self.batch_size, (
+                    "all data must have the same batch size: "
+                    + ("batch_size = %d, but " % self.batch_size)
+                    + ("%s has shape %s" % (name, shape))
+                )
+            else:
+                self.batch_size = batch_size
+                self.slices = _split_input_slice(self.batch_size, self.workload)
+        return major_axis
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None, reshape=False):
+        """Bind one executor per context (reference: executor_group.py:244-319)."""
+        data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x) for x in data_shapes]
+        if label_shapes is not None:
+            label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x) for x in label_shapes]
+        self.data_layouts = self.decide_slices(data_shapes)
+        if label_shapes is not None:
+            self.label_layouts = self.decide_slices(label_shapes)
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self.execs = []
+        for i in range(len(self.contexts)):
+            self.execs.append(self._bind_ith_exec(i, data_shapes, label_shapes, shared_group))
+        self._collect_arrays()
+
+    def _sliced_shape(self, shapes, i, major_axis):
+        sliced = []
+        for (k, shape), axis in zip(
+            [(x.name, x.shape) if isinstance(x, DataDesc) else x for x in shapes], major_axis
+        ):
+            shape = list(shape)
+            if axis >= 0:
+                shape[axis] = self.slices[i].stop - self.slices[i].start
+            sliced.append(DataDesc(k, tuple(shape)))
+        return sliced
+
+    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
+        ctx = self.contexts[i]
+        shared_exec = None if shared_group is None else shared_group.execs[i]
+        sliced_data = self._sliced_shape(data_shapes, i, self.data_layouts)
+        input_shapes = {d.name: d.shape for d in sliced_data}
+        if label_shapes is not None:
+            sliced_label = self._sliced_shape(label_shapes, i, self.label_layouts)
+            input_shapes.update({l.name: l.shape for l in sliced_label})
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
+        if arg_shapes is None:
+            raise MXNetError("shape inference failed")
+        arg_types = [np.float32] * len(arg_shapes)
+        arg_arrays = []
+        grad_arrays = []
+        for j, name in enumerate(self.arg_names):
+            if shared_exec is not None and name in self.param_names:
+                # share parameter arrays with the shared executor (bucketing
+                # memory sharing, graph_executor.cc:352-356)
+                arg_arrays.append(shared_exec.arg_dict[name])
+                grad_arrays.append(shared_exec.grad_dict[name])
+                continue
+            arg_arrays.append(nd.zeros(arg_shapes[j], ctx=ctx, dtype=arg_types[j]))
+            if self.grad_req.get(name, "null") != "null":
+                grad_arrays.append(nd.zeros(arg_shapes[j], ctx=ctx, dtype=arg_types[j]))
+            else:
+                grad_arrays.append(None)
+        if shared_exec is not None:
+            aux_arrays = shared_exec.aux_arrays
+        else:
+            aux_arrays = [nd.zeros(s, ctx=ctx) for s in aux_shapes]
+        return self.symbol.bind(
+            ctx, arg_arrays, args_grad=grad_arrays,
+            grad_req=self.grad_req, aux_states=aux_arrays, shared_exec=shared_exec,
+        )
+
+    def _collect_arrays(self):
+        """(reference: executor_group.py _collect_arrays)"""
+        self.data_arrays = [
+            [(self.slices[i], e.arg_dict[name]) for i, e in enumerate(self.execs)]
+            for name in [d.name for d in self.data_shapes]
+        ]
+        if self.label_shapes is not None:
+            self.label_arrays = [
+                [(self.slices[i], e.arg_dict[name]) for i, e in enumerate(self.execs)]
+                for name in [l.name for l in self.label_shapes]
+            ]
+        else:
+            self.label_arrays = None
+        self.param_arrays = [
+            [exec_.arg_arrays[i] for exec_ in self.execs]
+            for i, name in enumerate(self.arg_names) if name in self.param_names
+        ]
+        if self.for_training:
+            self.grad_arrays = [
+                [exec_.grad_arrays[i] for exec_ in self.execs]
+                for i, name in enumerate(self.arg_names) if name in self.param_names
+            ]
+        else:
+            self.grad_arrays = None
+        data_names = [x.name for x in self.data_shapes]
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [
+                [exec_.grad_arrays[self.arg_names.index(name)] for exec_ in self.execs]
+                for name in data_names
+            ]
+        else:
+            self.input_grad_arrays = None
+        self.aux_arrays = [
+            [exec_.aux_arrays[i] for exec_ in self.execs] for i in range(len(self.aux_names))
+        ]
+
+    def set_params(self, arg_params, aux_params):
+        """(reference: executor_group.py set_params)"""
+        for exec_ in self.execs:
+            exec_.copy_params_from(arg_params, aux_params)
+
+    def get_params(self, arg_params, aux_params):
+        """Average params over devices into the given dicts
+        (reference: executor_group.py get_params — 'weight averaged over devices')."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = sum(w.copyto(ctx_mod.cpu()).asnumpy() for w in block) / len(block)
+            arg_params[name][:] = weight.astype(arg_params[name].dtype)
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = sum(w.copyto(ctx_mod.cpu()).asnumpy() for w in block) / len(block)
+            aux_params[name][:] = weight.astype(aux_params[name].dtype)
+
+    def forward(self, data_batch, is_train=None):
+        """Scatter + per-exec forward (reference: executor_group.py:369)."""
+        _load_general(data_batch.data, self.data_arrays, self.data_layouts)
+        if is_train is None:
+            is_train = self.for_training
+        if self.label_arrays is not None and data_batch.label is not None and len(data_batch.label):
+            _load_general(data_batch.label, self.label_arrays, self.label_layouts)
+        for exec_ in self.execs:
+            exec_.forward(is_train=is_train)
+
+    def get_output_shapes(self):
+        outputs = self.execs[0]._eval_out_shapes(
+            self.execs[0]._arg_data, self.execs[0]._aux_data
+        )
+        shapes = []
+        for name, out in zip(self.symbol.list_outputs(), outputs):
+            shape = list(out.shape)
+            shape[0] = self.batch_size
+            shapes.append((name, tuple(shape)))
+        return shapes
+
+    def get_outputs(self, merge_multi_context=True):
+        """(reference: executor_group.py get_outputs)"""
+        outputs = [
+            [exec_.outputs[i] for exec_ in self.execs]
+            for i in range(len(self.execs[0].outputs))
+        ]
+        if merge_multi_context:
+            outputs = _merge_multi_context(outputs, self.output_layouts)
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        """(reference: executor_group.py get_input_grads)"""
+        assert self.inputs_need_grad
+        if merge_multi_context:
+            return _merge_multi_context(self.input_grad_arrays, self.data_layouts)
+        return self.input_grad_arrays
+
+    def backward(self, out_grads=None):
+        """(reference: executor_group.py:501)"""
+        assert self.for_training, "re-bind with for_training=True first"
+        if out_grads is None:
+            for exec_ in self.execs:
+                exec_.backward()
+        else:
+            if isinstance(out_grads, nd.NDArray):
+                out_grads = [out_grads]
+            for i, (exec_, islice) in enumerate(zip(self.execs, self.slices)):
+                out_grads_slice = []
+                for grad, axis in zip(out_grads, self.output_layouts):
+                    if axis >= 0:
+                        og = nd.array(grad.asnumpy()[islice], ctx=self.contexts[i])
+                    else:
+                        og = grad.copyto(self.contexts[i])
+                    out_grads_slice.append(og)
+                exec_.backward(out_grads=out_grads_slice)
+
+    def update_metric(self, eval_metric, labels):
+        """(reference: executor_group.py:530)"""
+        for texec, islice in zip(self.execs, self.slices):
+            labels_slice = []
+            for label, axis in zip(labels, self.label_layouts if labels else []):
+                if axis == 0:
+                    label_np = label.asnumpy() if isinstance(label, nd.NDArray) else label
+                    labels_slice.append(nd.array(label_np[islice]))
+                else:
+                    labels_slice.append(label)
+            eval_metric.update(labels_slice, texec.outputs)
+
+    def reshape(self, data_shapes, label_shapes):
+        if data_shapes == self.data_shapes and label_shapes == self.label_shapes:
+            return
+        self.batch_size = None
+        self.bind_exec(data_shapes, label_shapes, self.shared_group, reshape=True)
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            mon.install(exe)

@@ -1,0 +1,582 @@
+"""NDArray — the imperative tensor.
+
+Reference: include/mxnet/ndarray.h:58 + src/ndarray/ndarray.cc (engine-scheduled
+mutable chunks) and python/mxnet/ndarray.py (the user API, with op functions
+generated from the registry at import, ndarray.py:2385-2413).
+
+TPU design:
+* The buffer is an immutable ``jax.Array``; "mutation" swaps the reference.
+  The reference's engine exists to serialize reads/writes on mutable buffers
+  (ThreadedVar dependency queues, src/engine/threaded_engine.h:93); with
+  immutable buffers those hazards are impossible by construction, and what
+  survives of the engine is jax's own async dispatch: every op returns
+  immediately with a future-backed array, ``wait_to_read`` = block_until_ready
+  (the reference's WaitToRead → Engine::WaitForVar path, engine.h:172).
+* Every ``nd.*`` call goes through a per-(op, attrs, shapes, dtypes, device)
+  jit cache — the analog of MXImperativeInvoke (src/c_api/c_api_ndarray.cc:324)
+  where SetShapeType+SetDependency overhead is replaced by one dict lookup
+  after the first call.
+* Basic ``a[i]`` indexing returns a *view* (base + index) so writes through the
+  view hit the parent, matching NDArray::Slice/At chunk sharing
+  (include/mxnet/ndarray.h:104 data()/Slice).
+"""
+from __future__ import annotations
+
+import builtins
+import collections
+import struct
+import sys
+
+import numpy as np
+
+from . import random as _random
+from .base import MXNetError, _DTYPE_MX_TO_NP, _DTYPE_NP_TO_MX
+from .context import Context, cpu, current_context
+from .ops.registry import OpContext, get_op, list_ops
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange", "concatenate",
+           "load", "save", "waitall", "imperative_invoke"]
+
+# ring of recently produced arrays so waitall() can block on outstanding work
+# (reference: Engine::WaitForAll, include/mxnet/engine.h:176)
+_RECENT = collections.deque(maxlen=4096)
+
+_JIT_CACHE = {}
+
+
+def _freeze_attrs(attrs):
+    def _f(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(_f(x) for x in v)
+        if isinstance(v, np.dtype):
+            return str(v)
+        return v
+
+    return tuple(sorted((k, _f(v)) for k, v in attrs.items()))
+
+
+def _get_jitted(op, attrs, n_args, n_aux, is_train):
+    import jax
+
+    key = (op.name, _freeze_attrs(attrs), n_args, n_aux, is_train, op.stochastic)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+
+        def run(args, auxs, rng):
+            octx = OpContext(is_train=is_train, rng=rng)
+            outs, new_auxs = op.forward(octx, attrs, list(args), list(auxs))
+            return list(outs), list(new_auxs)
+
+        fn = jax.jit(run)
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+_TRAIN_MODE = [False]  # flipped by contrib.autograd train_section
+
+
+def imperative_invoke(op_name, ndargs, attrs, out=None):
+    """Invoke a registered op imperatively on NDArrays.
+
+    The whole MXImperativeInvoke pipeline (c_api_ndarray.cc:324: SetShapeType →
+    SetDependency → PushFCompute) collapses to: canonicalize attrs, look up the
+    jitted kernel, run.  Returns NDArray or list of NDArrays (visible outputs).
+    """
+    import jax
+
+    op = get_op(op_name)
+    attrs, _extra = op.canonicalize_attrs(attrs)
+    n_expected = len(op.arg_names(attrs))
+    aux_names = op.aux_names(attrs)
+    args = [a.data if isinstance(a, NDArray) else a for a in ndargs[:n_expected]]
+    auxs = [a.data if isinstance(a, NDArray) else a for a in ndargs[n_expected:]]
+    if len(args) != n_expected or len(auxs) not in (0, len(aux_names)):
+        raise MXNetError(
+            "op %s expects %d args (+%d aux), got %d"
+            % (op_name, n_expected, len(aux_names), len(ndargs))
+        )
+    ctx = None
+    for a in ndargs:
+        if isinstance(a, NDArray):
+            ctx = a.context
+            break
+    if ctx is None:
+        ctx = attrs.pop("ctx", None) or current_context()
+        dev = ctx.jax_device
+        args = [jax.device_put(a, dev) for a in args]
+    is_train = _TRAIN_MODE[0]
+    rng = None
+    if op.stochastic:
+        rng = jax.device_put(_random.next_key(), ctx.jax_device)
+    fn = _get_jitted(op, attrs, len(args), len(auxs), is_train)
+    outs, new_auxs = fn(args, auxs, rng)
+    # write updated aux back into the caller's arrays (FMutateInputs semantics)
+    for nda, new in zip(ndargs[n_expected:], new_auxs):
+        if isinstance(nda, NDArray):
+            nda._set_data(new)
+    n_vis = op.num_visible_outputs(attrs)
+    outs = outs[: builtins.max(n_vis, 1)]
+    results = [NDArray(o, ctx=ctx) for o in outs]
+    for r in results:
+        _RECENT.append(r.data)
+    if is_train:
+        # record onto the autograd tape (reference: MXImperativeInvoke records
+        # to AutogradRuntime when training, c_api_ndarray.cc:324+)
+        from .contrib import autograd as _ag
+
+        if _ag.is_recording():
+            in_pairs = [
+                (id(a), a.data) if isinstance(a, NDArray) else (None, a) for a in ndargs
+            ]
+            _ag.record_op(op_name, attrs, in_pairs, results)
+    if out is not None:
+        outs_nd = [out] if isinstance(out, NDArray) else list(out)
+        for dst, src in zip(outs_nd, results):
+            dst._set_data(src.data)
+        return out
+    if len(results) == 1:
+        return results[0]
+    return results
+
+
+class NDArray:
+    """An n-dimensional array on a device context."""
+
+    __slots__ = ("_data", "_ctx", "_base", "_index", "writable")
+
+    def __init__(self, data, ctx=None, base=None, index=None):
+        self._data = data
+        self._ctx = ctx if ctx is not None else current_context()
+        self._base = base
+        self._index = index
+        self.writable = True
+
+    # ---- buffer access --------------------------------------------------
+    @property
+    def data(self):
+        if self._base is not None:
+            return self._base.data[self._index]
+        return self._data
+
+    def _set_data(self, value):
+        if self._base is not None:
+            b = self._base
+            b._set_data(b.data.at[self._index].set(value))
+        else:
+            self._data = value
+
+    # ---- basic properties ----------------------------------------------
+    @property
+    def shape(self):
+        if self._base is not None:
+            return tuple(self.data.shape)
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self.data.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def T(self):
+        return transpose(self)
+
+    def __repr__(self):
+        return "<NDArray %s @%s>" % ("x".join(map(str, self.shape)), self._ctx)
+
+    def __len__(self):
+        return self.shape[0]
+
+    # ---- sync (reference: MXNDArrayWaitToRead → Engine::WaitForVar) ------
+    def wait_to_read(self):
+        import jax
+
+        jax.block_until_ready(self.data)
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        return np.asarray(self.data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(-1)[0]
+
+    # ---- conversion / copy ----------------------------------------------
+    def astype(self, dtype):
+        return imperative_invoke("Cast", [self], {"dtype": np.dtype(dtype)})
+
+    def copyto(self, other):
+        """Copy to another NDArray or Context (reference: CopyFromTo,
+        src/ndarray/ndarray.cc:295 — device-pair dispatch is jax.device_put)."""
+        import jax
+
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(self.data, other.context.jax_device))
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self.data, other.jax_device), ctx=other)
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def copy(self):
+        return self.copyto(self._ctx)
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    def reshape(self, shape, **kwargs):
+        if isinstance(shape, int):
+            shape = (shape,)
+        return imperative_invoke("Reshape", [self], {"shape": tuple(shape)})
+
+    def broadcast_to(self, shape):
+        return imperative_invoke("broadcast_to", [self], {"shape": tuple(shape)})
+
+    # ---- indexing --------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return NDArray(None, ctx=self._ctx, base=self, index=key)
+        if isinstance(key, builtins.slice):
+            if key.step is not None and key.step != 1:
+                return NDArray(self.data[key], ctx=self._ctx)
+            return NDArray(None, ctx=self._ctx, base=self, index=key)
+        return NDArray(self.data[key], ctx=self._ctx)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value.data
+        elif isinstance(value, (np.ndarray, list, tuple, int, float, np.generic)):
+            value = np.asarray(value, dtype=self.dtype)
+        if isinstance(key, builtins.slice) and key.start is None and key.stop is None and key.step is None:
+            if np.ndim(value) == 0 or tuple(np.shape(value)) != self.shape:
+                self._set_data((self.data * 0 + value).astype(self.dtype))
+            else:
+                import jax
+
+                self._set_data(jax.device_put(value, self._ctx.jax_device).astype(self.dtype))
+            return
+        self._set_data(self.data.at[key].set(value))
+
+    # ---- arithmetic ------------------------------------------------------
+    def _binary(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return imperative_invoke(op, [a, b], {})
+        if isinstance(other, (int, float, np.generic)):
+            return imperative_invoke(scalar_op, [self], {"scalar": float(other)})
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if isinstance(o, (int, float, np.generic)):
+            return imperative_invoke("_rminus_scalar", [self], {"scalar": float(o)})
+        return self._binary(o, "broadcast_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __div__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar")
+
+    __truediv__ = __div__
+
+    def __rdiv__(self, o):
+        if isinstance(o, (int, float, np.generic)):
+            return imperative_invoke("_rdiv_scalar", [self], {"scalar": float(o)})
+        return self._binary(o, "broadcast_div", "_div_scalar", reverse=True)
+
+    __rtruediv__ = __rdiv__
+
+    def __mod__(self, o):
+        return self._binary(o, "broadcast_mod", "_mod_scalar")
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return imperative_invoke("negative", [self], {})
+
+    def __abs__(self):
+        return imperative_invoke("abs", [self], {})
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __iadd__(self, o):
+        r = self.__add__(o)
+        self._set_data(r.data)
+        return self
+
+    def __isub__(self, o):
+        r = self.__sub__(o)
+        self._set_data(r.data)
+        return self
+
+    def __imul__(self, o):
+        r = self.__mul__(o)
+        self._set_data(r.data)
+        return self
+
+    def __idiv__(self, o):
+        r = self.__truediv__(o)
+        self._set_data(r.data)
+        return self
+
+    __itruediv__ = __idiv__
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple elements is ambiguous")
+
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "ctx_type": self._ctx.device_type, "ctx_id": self._ctx.device_id}
+
+    def __setstate__(self, state):
+        import jax
+
+        ctx = Context(state["ctx_type"], state["ctx_id"])
+        self._ctx = ctx
+        self._base = None
+        self._index = None
+        self.writable = True
+        self._data = jax.device_put(state["data"], ctx.jax_device)
+
+
+# ---- creation -----------------------------------------------------------
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (reference: python/mxnet/ndarray.py array)."""
+    import jax
+
+    ctx = ctx or current_context()
+    if isinstance(source_array, NDArray):
+        src = source_array.asnumpy()
+    else:
+        src = np.asarray(source_array)
+    if dtype is None:
+        dtype = src.dtype if src.dtype != np.float64 else np.float32
+    src = src.astype(dtype)
+    return NDArray(jax.device_put(src, ctx.jax_device), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    ctx = ctx or current_context()
+    return imperative_invoke(
+        "_zeros", [], {"shape": shape, "dtype": np.dtype(dtype) if dtype else None, "ctx": ctx}
+    )
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    ctx = ctx or current_context()
+    return imperative_invoke(
+        "_ones", [], {"shape": shape, "dtype": np.dtype(dtype) if dtype else None, "ctx": ctx}
+    )
+
+
+def full(shape, val, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    return imperative_invoke(
+        "_full",
+        [],
+        {"shape": shape, "value": float(val), "dtype": np.dtype(dtype) if dtype else None, "ctx": ctx},
+    )
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    return imperative_invoke(
+        "_arange",
+        [],
+        {
+            "start": float(start),
+            "stop": None if stop is None else float(stop),
+            "step": float(step),
+            "repeat": int(repeat),
+            "dtype": np.dtype(dtype) if dtype else None,
+            "ctx": ctx,
+        },
+    )
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return imperative_invoke("Concat", list(arrays), {"num_args": len(arrays), "dim": axis})
+
+
+def waitall():
+    """Block until all outstanding async work completes
+    (reference: MXNDArrayWaitAll → Engine::WaitForAll)."""
+    import jax
+
+    while _RECENT:
+        a = _RECENT.popleft()
+        jax.block_until_ready(a)
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = imperative_invoke("one_hot", [indices], {"depth": depth})
+    out._set_data(res.data.astype(out.dtype))
+    return out
+
+
+# ---- serialization (reference: src/ndarray/ndarray.cc:618-717) -----------
+_NDARRAY_MAGIC = 0xF993FAC8  # NDArray V1 magic, ndarray.cc:618
+_LIST_MAGIC = 0x112  # dict-of-arrays magic, ndarray.cc:695
+
+_DTYPE_TO_FLAG = {np.dtype(k): v for k, v in _DTYPE_NP_TO_MX.items()}
+
+
+def _write_ndarray(f, arr):
+    f.write(struct.pack("<I", _NDARRAY_MAGIC))
+    f.write(struct.pack("<ii", arr.context.device_typeid, arr.context.device_id))
+    shape = arr.shape
+    f.write(struct.pack("<I", len(shape)))
+    for s in shape:
+        f.write(struct.pack("<q", s))
+    np_arr = arr.asnumpy()
+    flag = _DTYPE_NP_TO_MX.get(np.dtype(np_arr.dtype), 0)
+    f.write(struct.pack("<i", flag))
+    b = np.ascontiguousarray(np_arr).tobytes()
+    f.write(struct.pack("<Q", len(b)))
+    f.write(b)
+
+
+def _read_ndarray(f):
+    (magic,) = struct.unpack("<I", f.read(4))
+    if magic != _NDARRAY_MAGIC:
+        raise MXNetError("Invalid NDArray file format")
+    dev_type, dev_id = struct.unpack("<ii", f.read(8))
+    (ndim,) = struct.unpack("<I", f.read(4))
+    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+    (flag,) = struct.unpack("<i", f.read(4))
+    (nbytes,) = struct.unpack("<Q", f.read(8))
+    dt = _DTYPE_MX_TO_NP[flag]
+    data = np.frombuffer(f.read(nbytes), dtype=dt).reshape(shape)
+    return array(data, dtype=dt)
+
+
+def save(fname, data):
+    """Save a list or str->NDArray dict (reference format: magic 0x112 header +
+    named NDArray blobs, src/ndarray/ndarray.cc:695-717; file layout is this
+    framework's own since mshadow's TShape wire format is not public)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    names = []
+    arrays = []
+    if isinstance(data, dict):
+        for k, v in data.items():
+            names.append(k)
+            arrays.append(v)
+    else:
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<Q", _LIST_MAGIC))
+        f.write(struct.pack("<Q", 0))  # reserved
+        f.write(struct.pack("<Q", len(arrays)))
+        f.write(struct.pack("<Q", len(names)))
+        for arr in arrays:
+            _write_ndarray(f, arr)
+        for n in names:
+            nb = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(nb)))
+            f.write(nb)
+
+
+def load(fname):
+    """Load arrays saved by :func:`save`. Returns list or dict."""
+    with open(fname, "rb") as f:
+        (magic,) = struct.unpack("<Q", f.read(8))
+        if magic != _LIST_MAGIC:
+            raise MXNetError("Invalid NDArray list file")
+        f.read(8)
+        (n_arr,) = struct.unpack("<Q", f.read(8))
+        (n_names,) = struct.unpack("<Q", f.read(8))
+        arrays = [_read_ndarray(f) for _ in range(n_arr)]
+        names = []
+        for _ in range(n_names):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    if n_names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+# ---- op function generation (reference: _init_ndarray_module,
+# python/mxnet/ndarray.py:2385-2413) ---------------------------------------
+def _make_ndarray_function(op_name):
+    op = get_op(op_name)
+
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        ndargs = [a for a in args if isinstance(a, NDArray)]
+        if args and not ndargs and len(args) and not isinstance(args[0], NDArray):
+            # allow e.g. nd.exp(np_array)
+            ndargs = [array(a) if isinstance(a, (np.ndarray, list, tuple)) else a for a in args]
+            ndargs = [a for a in ndargs if isinstance(a, NDArray)]
+        if op.key_var_num_args and op.key_var_num_args not in kwargs:
+            kwargs[op.key_var_num_args] = len(ndargs)
+        return imperative_invoke(op_name, ndargs, kwargs, out=out)
+
+    fn.__name__ = op_name
+    fn.__doc__ = "Imperative form of operator ``%s``." % op_name
+    return fn
+
+
+_cur_module = sys.modules[__name__]
+for _name in list_ops():
+    _fn = _make_ndarray_function(_name)
+    setattr(_cur_module, _name, _fn)
+    # public names: strip no leading underscore ops only
+transpose = getattr(_cur_module, "transpose")

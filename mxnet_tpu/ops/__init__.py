@@ -1,0 +1,19 @@
+"""Operator library: importing this package registers every op.
+
+The registry (registry.py) is the single registration seam — the analog of the
+reference's NNVM op registry consumed by both the imperative path
+(src/c_api/c_api_ndarray.cc MXImperativeInvoke) and the symbolic path
+(src/executor/graph_executor.cc). ~300 names registered across the modules below.
+"""
+from . import elemwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import init_ops  # noqa: F401
+from . import sample  # noqa: F401
+from . import indexing  # noqa: F401
+from . import ordering  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
+from .registry import OpContext, Operator, get_op, list_ops, register, register_simple  # noqa: F401

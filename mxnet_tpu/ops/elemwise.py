@@ -1,0 +1,225 @@
+"""Elementwise binary/unary/scalar/logic op families.
+
+Reference: src/operator/tensor/elemwise_binary_op_basic.cc,
+elemwise_binary_broadcast_op_*.cc, elemwise_unary_op.cc, elemwise_binary_scalar_op_*.cc,
+and the scalar-functor math table src/operator/mshadow_op.h (892 LoC).
+
+TPU design: every functor is a one-line jnp expression; XLA fuses chains of these
+into single HBM-bandwidth-bound kernels, which is exactly the fusion the reference
+had to approximate with its `Kernel<OP,xpu>::Launch` per-op launches
+(src/operator/mxnet_op.h:219). Backward comes from autodiff — the reference's
+paired `_backward_*` registrations are unnecessary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Param, register, register_simple
+
+_f = Param.float
+
+
+def _same_dtype(a, b):
+    # mxnet semantics: binary elemwise keeps lhs dtype; jnp promotion is fine for
+    # matching dtypes which is what the reference requires anyway.
+    return a, b
+
+
+# ---- binary elementwise (reference: elemwise_binary_op_basic.cc:11-31) -----
+_BINARY = {
+    "elemwise_add": (lambda x, y: x + y, ("_plus", "_Plus")),
+    "elemwise_sub": (lambda x, y: x - y, ("_minus", "_Minus", "_sub")),
+    "elemwise_mul": (lambda x, y: x * y, ("_mul", "_Mul")),
+    "elemwise_div": (lambda x, y: x / y, ("_div", "_Div")),
+    "_power": (lambda x, y: jnp.power(x, y), ("_Power",)),
+    "_maximum": (jnp.maximum, ("_Maximum",)),
+    "_minimum": (jnp.minimum, ("_Minimum",)),
+    "_hypot": (jnp.hypot, ()),
+    "_mod": (jnp.mod, ()),
+}
+for _name, (_fn, _aliases) in _BINARY.items():
+    register_simple(
+        _name,
+        (lambda fn: lambda attrs, x, y: fn(x, y))(_fn),
+        arg_names=("lhs", "rhs"),
+        alias=_aliases,
+    )
+
+# comparison ops return same-dtype 0/1 arrays like the reference
+# (elemwise_binary_op_logic.cc)
+_LOGIC = {
+    "_equal": lambda x, y: (x == y),
+    "_not_equal": lambda x, y: (x != y),
+    "_greater": lambda x, y: (x > y),
+    "_greater_equal": lambda x, y: (x >= y),
+    "_lesser": lambda x, y: (x < y),
+    "_lesser_equal": lambda x, y: (x <= y),
+}
+for _name, _fn in _LOGIC.items():
+    register_simple(
+        _name,
+        (lambda fn: lambda attrs, x, y: jax.lax.stop_gradient(fn(x, y).astype(x.dtype)))(_fn),
+        arg_names=("lhs", "rhs"),
+    )
+
+# ---- broadcast binary (reference: elemwise_binary_broadcast_op_*.cc) -------
+for _name, _fn in {
+    "broadcast_add": lambda x, y: x + y,
+    "broadcast_sub": lambda x, y: x - y,
+    "broadcast_minus": lambda x, y: x - y,
+    "broadcast_plus": lambda x, y: x + y,
+    "broadcast_mul": lambda x, y: x * y,
+    "broadcast_div": lambda x, y: x / y,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+}.items():
+    register_simple(_name, (lambda fn: lambda attrs, x, y: fn(x, y))(_fn), arg_names=("lhs", "rhs"))
+
+for _name, _fn in {
+    "broadcast_equal": lambda x, y: x == y,
+    "broadcast_not_equal": lambda x, y: x != y,
+    "broadcast_greater": lambda x, y: x > y,
+    "broadcast_greater_equal": lambda x, y: x >= y,
+    "broadcast_lesser": lambda x, y: x < y,
+    "broadcast_lesser_equal": lambda x, y: x <= y,
+}.items():
+    register_simple(
+        _name,
+        (lambda fn: lambda attrs, x, y: jax.lax.stop_gradient(fn(x, y).astype(x.dtype)))(_fn),
+        arg_names=("lhs", "rhs"),
+    )
+
+# ---- scalar ops (reference: elemwise_binary_scalar_op_basic.cc) ------------
+_SCALAR = {
+    "_plus_scalar": (lambda x, s: x + s, ("_PlusScalar",)),
+    "_minus_scalar": (lambda x, s: x - s, ("_MinusScalar",)),
+    "_rminus_scalar": (lambda x, s: s - x, ("_RMinusScalar",)),
+    "_mul_scalar": (lambda x, s: x * s, ("_MulScalar",)),
+    "_div_scalar": (lambda x, s: x / s, ("_DivScalar",)),
+    "_rdiv_scalar": (lambda x, s: s / x, ("_RDivScalar",)),
+    "_power_scalar": (lambda x, s: jnp.power(x, s), ("_PowerScalar",)),
+    "_rpower_scalar": (lambda x, s: jnp.power(s, x), ("_RPowerScalar",)),
+    "_maximum_scalar": (lambda x, s: jnp.maximum(x, s), ("_MaximumScalar",)),
+    "_minimum_scalar": (lambda x, s: jnp.minimum(x, s), ("_MinimumScalar",)),
+    "_mod_scalar": (lambda x, s: jnp.mod(x, s), ()),
+    "_rmod_scalar": (lambda x, s: jnp.mod(s, x), ()),
+    "_hypot_scalar": (lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)), ()),
+}
+for _name, (_fn, _aliases) in _SCALAR.items():
+    register_simple(
+        _name,
+        (lambda fn: lambda attrs, x: fn(x, np.asarray(attrs["scalar"], dtype=x.dtype)))(_fn),
+        arg_names=("data",),
+        params={"scalar": _f()},
+        alias=_aliases,
+    )
+
+for _name, _fn in {
+    "_equal_scalar": lambda x, s: x == s,
+    "_not_equal_scalar": lambda x, s: x != s,
+    "_greater_scalar": lambda x, s: x > s,
+    "_greater_equal_scalar": lambda x, s: x >= s,
+    "_lesser_scalar": lambda x, s: x < s,
+    "_lesser_equal_scalar": lambda x, s: x <= s,
+}.items():
+    register_simple(
+        _name,
+        (lambda fn: lambda attrs, x: jax.lax.stop_gradient(fn(x, attrs["scalar"]).astype(x.dtype)))(_fn),
+        arg_names=("data",),
+        params={"scalar": _f()},
+    )
+
+# ---- unary math table (reference: mshadow_op.h + elemwise_unary_op.cc) -----
+_UNARY = {
+    "negative": lambda x: -x,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.fix,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": lambda x: jax.scipy.special.gammaln(x),
+    "sigmoid": jax.nn.sigmoid,
+    "relu": jax.nn.relu,
+    "softsign": jax.nn.soft_sign,
+    "reciprocal": lambda x: 1.0 / x,
+    "erf": jax.scipy.special.erf,
+    "logical_not": lambda x: jax.lax.stop_gradient((x == 0).astype(x.dtype)),
+}
+for _name, _fn in _UNARY.items():
+    register_simple(_name, (lambda fn: lambda attrs, x: fn(x))(_fn), arg_names=("data",))
+
+# identity / gradient-control ops (reference: elemwise_unary_op.cc _copy/BlockGrad)
+register_simple("_copy", lambda attrs, x: x + jnp.zeros((), x.dtype), arg_names=("data",), alias=("identity",))
+register_simple("BlockGrad", lambda attrs, x: jax.lax.stop_gradient(x), arg_names=("data",), alias=("stop_gradient",))
+register_simple(
+    "make_loss",
+    lambda attrs, x: x,
+    arg_names=("data",),
+)
+register_simple(
+    "Cast",
+    lambda attrs, x: x.astype(attrs["dtype"]),
+    arg_names=("data",),
+    params={"dtype": Param.dtype()},
+    alias=("cast",),
+)
+register_simple(
+    "clip",
+    lambda attrs, x: jnp.clip(x, attrs["a_min"], attrs["a_max"]),
+    arg_names=("data",),
+    params={"a_min": _f(), "a_max": _f()},
+)
+
+
+# variadic sum (reference: elemwise_sum.cc ElementWiseSum / add_n; used by
+# gradient aggregation, src/executor/graph_executor.cc:90-163)
+@register(
+    "add_n",
+    arg_names=lambda attrs: ["arg%d" % i for i in range(int(attrs.get("num_args", 1)))],
+    params={"num_args": Param.int(1)},
+    key_var_num_args="num_args",
+    alias=("ElementWiseSum", "_sum"),
+)
+def _add_n(octx, attrs, args, auxs):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return [out], []
+
+
+# scatter-style grad accumulation helper (reference: _grad_add chained adds)
+register_simple("_grad_add", lambda attrs, x, y: x + y, arg_names=("lhs", "rhs"))

@@ -1,0 +1,142 @@
+"""Indexing ops: Embedding / take / batch_take / one_hot / pick / gather-scatter.
+
+Reference: src/operator/tensor/indexing_op.{cc,cu,h} (Embedding forward =
+row gather, backward = scatter-add — here the scatter-add backward falls out of
+jax autodiff on ``take``, which XLA lowers to an efficient sorted-segment-sum on
+TPU rather than the reference's atomic-add CUDA kernel).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Param, register, register_simple
+
+
+@register(
+    "Embedding",
+    arg_names=("data", "weight"),
+    params={
+        "input_dim": Param.int(),
+        "output_dim": Param.int(),
+        "dtype": Param.dtype(None),
+    },
+)
+def _embedding(octx, attrs, args, auxs):
+    idx, weight = args
+    out = jnp.take(weight, jax.lax.stop_gradient(idx).astype(np.int32), axis=0)
+    return [out], []
+
+
+def _infer_embedding_shape(attrs, in_shapes, aux_shapes):
+    data, weight = in_shapes
+    w = (int(attrs["input_dim"]), int(attrs["output_dim"]))
+    if weight is None:
+        weight = w
+    if data is None:
+        raise ValueError("Embedding: data shape required")
+    return [data, weight], [tuple(data) + (w[1],)], []
+
+
+from .registry import get_op  # noqa: E402
+
+get_op("Embedding")._infer_shape = _infer_embedding_shape
+
+
+def _take(attrs, a, indices):
+    mode = attrs.get("mode", "clip")
+    idx = jax.lax.stop_gradient(indices).astype(np.int32)
+    return jnp.take(a, idx, axis=attrs.get("axis", 0), mode="clip" if mode == "clip" else "wrap")
+
+
+register_simple(
+    "take",
+    _take,
+    arg_names=("a", "indices"),
+    params={"axis": Param.int(0), "mode": Param.str("clip")},
+)
+
+
+def _batch_take(attrs, a, indices):
+    idx = jax.lax.stop_gradient(indices).astype(np.int32)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+register_simple("batch_take", _batch_take, arg_names=("a", "indices"))
+
+
+def _one_hot(attrs, indices):
+    idx = jax.lax.stop_gradient(indices).astype(np.int32)
+    dt = attrs.get("dtype") or np.float32
+    on, off = attrs["on_value"], attrs["off_value"]
+    oh = jax.nn.one_hot(idx, attrs["depth"], dtype=np.float32)
+    return jax.lax.stop_gradient((oh * (on - off) + off).astype(dt))
+
+
+register_simple(
+    "one_hot",
+    _one_hot,
+    arg_names=("indices",),
+    params={
+        "depth": Param.int(),
+        "on_value": Param.float(1.0),
+        "off_value": Param.float(0.0),
+        "dtype": Param.dtype(None),
+    },
+)
+
+
+def _pick(attrs, data, index):
+    ax = attrs["axis"]
+    ax = data.ndim - 1 if ax is None else ax % data.ndim
+    idx = jax.lax.stop_gradient(index).astype(np.int32)
+    idxe = jnp.expand_dims(idx, ax) if idx.ndim < data.ndim else idx
+    out = jnp.take_along_axis(data, idxe.astype(np.int32), axis=ax)
+    if not attrs["keepdims"]:
+        out = jnp.squeeze(out, axis=ax)
+    return out
+
+
+register_simple(
+    "pick",
+    _pick,
+    arg_names=("data", "index"),
+    params={
+        "axis": Param(lambda v: None if v in (None, "None", "") else int(float(v)), -1),
+        "keepdims": Param.bool(False),
+    },
+    alias=("choose_element_0index",),
+)
+
+
+def _fill_element_0index(attrs, lhs, mhs, rhs):
+    idx = jax.lax.stop_gradient(rhs).astype(np.int32)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+
+
+register_simple(
+    "fill_element_0index", _fill_element_0index, arg_names=("lhs", "mhs", "rhs")
+)
+
+
+def _gather_nd(attrs, data, indices):
+    idx = jax.lax.stop_gradient(indices).astype(np.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+register_simple("gather_nd", _gather_nd, arg_names=("data", "indices"))
+
+
+def _scatter_nd(attrs, data, indices):
+    idx = jax.lax.stop_gradient(indices).astype(np.int32)
+    shape = attrs["shape"]
+    out = jnp.zeros(shape, data.dtype)
+    m = idx.shape[0]
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+register_simple(
+    "scatter_nd", _scatter_nd, arg_names=("data", "indices"), params={"shape": Param.shape()}
+)

@@ -1,0 +1,60 @@
+"""Array-creation ops (reference: src/operator/tensor/init_op.cc —
+_zeros/_ones/_full/_arange) and shape-like creation (zeros_like/ones_like)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Param, register_simple
+
+
+def _dtype_or(attrs, default=np.float32):
+    dt = attrs.get("dtype")
+    return default if dt is None else dt
+
+
+register_simple(
+    "_zeros",
+    lambda attrs: jnp.zeros(attrs["shape"], _dtype_or(attrs)),
+    arg_names=(),
+    params={"shape": Param.shape(()), "dtype": Param.dtype(None)},
+)
+register_simple(
+    "_ones",
+    lambda attrs: jnp.ones(attrs["shape"], _dtype_or(attrs)),
+    arg_names=(),
+    params={"shape": Param.shape(()), "dtype": Param.dtype(None)},
+)
+register_simple(
+    "_full",
+    lambda attrs: jnp.full(attrs["shape"], attrs["value"], _dtype_or(attrs)),
+    arg_names=(),
+    params={"shape": Param.shape(()), "value": Param.float(0.0), "dtype": Param.dtype(None)},
+)
+
+
+def _arange(attrs):
+    start, stop, step = attrs["start"], attrs["stop"], attrs["step"]
+    if stop is None:
+        start, stop = 0.0, start
+    out = jnp.arange(start, stop, step, dtype=_dtype_or(attrs))
+    if attrs["repeat"] > 1:
+        out = jnp.repeat(out, attrs["repeat"])
+    return out
+
+
+register_simple(
+    "_arange",
+    _arange,
+    arg_names=(),
+    params={
+        "start": Param.float(0.0),
+        "stop": Param(lambda v: None if v in (None, "None", "") else float(v), None),
+        "step": Param.float(1.0),
+        "repeat": Param.int(1),
+        "dtype": Param.dtype(None),
+    },
+)
+
+register_simple("zeros_like", lambda attrs, x: jnp.zeros_like(x), arg_names=("data",))
+register_simple("ones_like", lambda attrs, x: jnp.ones_like(x), arg_names=("data",))

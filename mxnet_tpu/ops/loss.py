@@ -1,0 +1,270 @@
+"""Output/loss ops — the heads that drive training.
+
+Reference: SoftmaxOutput (src/operator/softmax_output-inl.h), regression outputs
+(src/operator/regression_output-inl.h), SVMOutput (svm_output-inl.h), MakeLoss
+(make_loss-inl.h), softmax_cross_entropy (loss_binary_op.cc).
+
+These ops have *declared* gradients rather than mathematical ones: SoftmaxOutput's
+backward writes ``(p - onehot(label)) * grad_scale`` directly, ignoring any head
+gradient. We express that with ``jax.custom_vjp`` so the semantics survive inside
+a whole-graph jit — the executor seeds ones into loss outputs (the reference
+seeds no head grad at all and lets the op's Backward fire; same effect).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Param, get_op, register
+
+
+def _mark_loss(name):
+    get_op(name).is_loss = True
+
+
+# ---------------------------------------------------------------- SoftmaxOutput
+def _softmax_fwd(data, attrs):
+    if attrs["multi_output"]:
+        return jax.nn.softmax(data, axis=1)
+    if attrs["preserve_shape"]:
+        return jax.nn.softmax(data, axis=-1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_grad(p, label, attrs):
+    scale = attrs["grad_scale"]
+    norm = attrs["normalization"]
+    use_ignore = attrs["use_ignore"]
+    ignore = attrs["ignore_label"]
+    smooth = attrs.get("smooth_alpha", 0.0) or 0.0
+    if attrs["multi_output"]:
+        nclass = p.shape[1]
+        lab = label.astype(np.int32)
+        oh = jnp.moveaxis(jax.nn.one_hot(lab, nclass, dtype=p.dtype), -1, 1)
+        grad = p - oh
+        valid_mask = (lab != int(ignore)).astype(p.dtype) if use_ignore else jnp.ones(lab.shape, p.dtype)
+        grad = grad * valid_mask[:, None]
+        nvalid = jnp.maximum(jnp.sum(valid_mask), 1.0)
+        denom = {"batch": float(p.shape[0]), "null": 1.0}.get(norm, None)
+        grad = grad / (nvalid if denom is None else denom)
+        if norm == "null":
+            pass
+    else:
+        flat = p.reshape(p.shape[0], -1)
+        nclass = flat.shape[1]
+        lab = label.reshape(-1).astype(np.int32)
+        oh = jax.nn.one_hot(lab, nclass, dtype=p.dtype)
+        if smooth:
+            oh = oh * (1 - smooth) + smooth / nclass
+        grad = flat - oh
+        valid_mask = (lab != int(ignore)).astype(p.dtype) if use_ignore else jnp.ones(lab.shape, p.dtype)
+        grad = grad * valid_mask[:, None]
+        if norm == "batch":
+            grad = grad / float(p.shape[0])
+        elif norm == "valid":
+            grad = grad / jnp.maximum(jnp.sum(valid_mask), 1.0)
+        grad = grad.reshape(p.shape)
+    return grad * scale
+
+
+_SOFTMAX_PARAMS = {
+    "grad_scale": Param.float(1.0),
+    "ignore_label": Param.float(-1.0),
+    "multi_output": Param.bool(False),
+    "use_ignore": Param.bool(False),
+    "preserve_shape": Param.bool(False),
+    "normalization": Param.str("null"),
+    "out_grad": Param.bool(False),
+    "smooth_alpha": Param.float(0.0),
+}
+
+
+@register(
+    "SoftmaxOutput",
+    arg_names=("data", "label"),
+    params=dict(_SOFTMAX_PARAMS),
+    alias=("Softmax",),
+)
+def _softmax_output(octx, attrs, args, auxs):
+    frozen = tuple(sorted(attrs.items()))
+
+    @jax.custom_vjp
+    def f(data, label):
+        return _softmax_fwd(data, dict(frozen))
+
+    def f_fwd(data, label):
+        p = _softmax_fwd(data, dict(frozen))
+        return p, (p, label)
+
+    def f_bwd(res, g):
+        p, label = res
+        a = dict(frozen)
+        grad = _softmax_grad(p, label, a)
+        if a["out_grad"]:
+            grad = grad * g
+        return grad, jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return [f(args[0], args[1])], []
+
+
+def _softmax_output_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    if attrs.get("multi_output"):
+        label = (data[0],) + tuple(data[2:])
+    else:
+        label = (data[0],)
+    if in_shapes[1] is not None:
+        label = tuple(in_shapes[1])
+    return [tuple(data), label], [tuple(data)], []
+
+
+get_op("SoftmaxOutput")._infer_shape = _softmax_output_infer_shape
+_mark_loss("SoftmaxOutput")
+
+
+# ---------------------------------------------------------------- regression heads
+def _reg_output(name, link, grad_fn):
+    @register(
+        name,
+        arg_names=("data", "label"),
+        params={"grad_scale": Param.float(1.0)},
+    )
+    def _fwd(octx, attrs, args, auxs):
+        scale = attrs["grad_scale"]
+
+        @jax.custom_vjp
+        def f(data, label):
+            return link(data)
+
+        def f_fwd(data, label):
+            out = link(data)
+            return out, (out, label)
+
+        def f_bwd(res, g):
+            out, label = res
+            grad = grad_fn(out, label.reshape(out.shape)) * scale
+            return grad, jnp.zeros_like(label)
+
+        f.defvjp(f_fwd, f_bwd)
+        return [f(args[0], args[1])], []
+
+    def _infer(attrs, in_shapes, aux_shapes):
+        data = in_shapes[0]
+        label = tuple(in_shapes[1]) if in_shapes[1] is not None else tuple(data)
+        return [tuple(data), label], [tuple(data)], []
+
+    get_op(name)._infer_shape = _infer
+    _mark_loss(name)
+
+
+_reg_output("LinearRegressionOutput", lambda x: x, lambda o, l: o - l)
+_reg_output("MAERegressionOutput", lambda x: x, lambda o, l: jnp.sign(o - l))
+_reg_output("LogisticRegressionOutput", jax.nn.sigmoid, lambda o, l: o - l)
+
+
+# ---------------------------------------------------------------- SVMOutput
+@register(
+    "SVMOutput",
+    arg_names=("data", "label"),
+    params={
+        "margin": Param.float(1.0),
+        "regularization_coefficient": Param.float(1.0),
+        "use_linear": Param.bool(False),
+    },
+)
+def _svm_output(octx, attrs, args, auxs):
+    margin = attrs["margin"]
+    reg = attrs["regularization_coefficient"]
+    linear = attrs["use_linear"]
+
+    @jax.custom_vjp
+    def f(data, label):
+        return data
+
+    def f_fwd(data, label):
+        return data, (data, label)
+
+    def f_bwd(res, g):
+        x, label = res
+        lab = label.astype(np.int32)
+        oh = jax.nn.one_hot(lab, x.shape[1], dtype=x.dtype)
+        sgn = 2 * oh - 1  # +1 at true class, -1 elsewhere
+        viol = (margin - sgn * x) > 0
+        if linear:
+            grad = jnp.where(viol, -sgn * reg, 0.0)
+        else:
+            grad = jnp.where(viol, -2 * (margin - sgn * x) * sgn * reg, 0.0)
+        return grad.astype(x.dtype), jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return [f(args[0], args[1])], []
+
+
+def _svm_infer(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    return [tuple(data), (data[0],)], [tuple(data)], []
+
+
+get_op("SVMOutput")._infer_shape = _svm_infer
+_mark_loss("SVMOutput")
+
+
+# ---------------------------------------------------------------- MakeLoss
+@register(
+    "MakeLoss",
+    arg_names=("data",),
+    params={
+        "grad_scale": Param.float(1.0),
+        "valid_thresh": Param.float(0.0),
+        "normalization": Param.str("null"),
+    },
+)
+def _make_loss(octx, attrs, args, auxs):
+    scale = attrs["grad_scale"]
+    norm = attrs["normalization"]
+    thresh = attrs["valid_thresh"]
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def f_fwd(x):
+        return x, x
+
+    def f_bwd(x, g):
+        grad = jnp.full(x.shape, scale, x.dtype)
+        if norm == "batch":
+            grad = grad / x.shape[0]
+        elif norm == "valid":
+            nvalid = jnp.maximum(jnp.sum((x > thresh).astype(x.dtype)), 1.0)
+            grad = grad / nvalid
+        return (grad,)
+
+    f.defvjp(f_fwd, f_bwd)
+    return [f(args[0])], []
+
+
+_mark_loss("MakeLoss")
+
+
+# ---------------------------------------------------------------- cross entropy
+@register(
+    "softmax_cross_entropy",
+    arg_names=("data", "label"),
+)
+def _softmax_cross_entropy(octx, attrs, args, auxs):
+    data, label = args
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = jax.lax.stop_gradient(label).astype(np.int32)
+    nll = -jnp.take_along_axis(logp, lab[:, None], axis=1)[:, 0]
+    return [jnp.sum(nll)], []
+
+
+def _sce_infer(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    return [tuple(data), (data[0],)], [()], []
+
+
+get_op("softmax_cross_entropy")._infer_shape = _sce_infer

@@ -1,0 +1,376 @@
+"""Matrix / shape-manipulation ops.
+
+Reference: src/operator/tensor/matrix_op.cc + matrix_op-inl.h (the 11k-LoC family:
+Reshape/Flatten/transpose/dot/batch_dot/slice/clip/repeat/tile/reverse, SURVEY §2.3)
+plus the layer-style shape ops Concat (src/operator/concat.cc), SliceChannel
+(slice_channel.cc), SwapAxis (swapaxis.cc), Crop (crop.cc), Pad (pad.cc).
+
+dot/batch_dot are the MXU entry points: they lower to a single XLA dot_general
+with a configurable accumulation type (fp32 accumulation for bf16 inputs —
+the TPU-native version of the reference's pseudo-fp16, convolution.cu:30-45).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, parse_shape
+from .registry import Param, register, register_simple
+
+
+# ---- reshape with MXNet's special codes (matrix_op-inl.h ReshapeParam) ------
+def mx_reshape(shape, target, reverse=False):
+    """Implement MXNet Reshape's 0/-1/-2/-3/-4 codes on a concrete shape."""
+    src = list(shape)
+    if reverse:
+        src = src[::-1]
+        target = tuple(reversed(target))
+    out = []
+    src_i = 0
+    i = 0
+    target = list(target)
+    while i < len(target):
+        t = target[i]
+        if t == 0:
+            out.append(src[src_i])
+            src_i += 1
+        elif t == -1:
+            out.append(-1)
+            src_i += 1
+        elif t == -2:
+            out.extend(src[src_i:])
+            src_i = len(src)
+        elif t == -3:
+            out.append(src[src_i] * src[src_i + 1])
+            src_i += 2
+        elif t == -4:
+            d1, d2 = target[i + 1], target[i + 2]
+            cur = src[src_i]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2])
+            src_i += 1
+            i += 2
+        else:
+            out.append(t)
+            src_i += 1
+        i += 1
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1])) if len(out) > 1 else 1
+        total = int(np.prod(shape)) if shape else 1
+        out[out.index(-1)] = total // max(known, 1)
+    if reverse:
+        out = out[::-1]
+    return tuple(int(d) for d in out)
+
+
+def _reshape(attrs, x):
+    target = attrs["shape"]
+    if target is None or target == ():
+        # legacy target_shape attr
+        ts = attrs.get("target_shape")
+        if ts:
+            return jnp.reshape(x, ts)
+        raise MXNetError("Reshape: shape required")
+    return jnp.reshape(x, mx_reshape(x.shape, target, attrs["reverse"]))
+
+
+register_simple(
+    "Reshape",
+    _reshape,
+    arg_names=("data",),
+    params={
+        "shape": Param.shape(()),
+        "reverse": Param.bool(False),
+        "target_shape": Param.shape(()),
+        "keep_highest": Param.bool(False),
+    },
+    alias=("reshape",),
+)
+
+register_simple(
+    "Flatten",
+    lambda attrs, x: jnp.reshape(x, (x.shape[0], -1)),
+    arg_names=("data",),
+    alias=("flatten",),
+)
+
+
+def _transpose(attrs, x):
+    axes = attrs["axes"]
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(x.ndim)))
+    return jnp.transpose(x, axes)
+
+
+register_simple(
+    "transpose", _transpose, arg_names=("data",), params={"axes": Param.shape(())}
+)
+
+register_simple(
+    "expand_dims",
+    lambda attrs, x: jnp.expand_dims(x, attrs["axis"]),
+    arg_names=("data",),
+    params={"axis": Param.int()},
+)
+
+
+def _swapaxis(attrs, x):
+    return jnp.swapaxes(x, attrs["dim1"], attrs["dim2"])
+
+
+register_simple(
+    "SwapAxis",
+    _swapaxis,
+    arg_names=("data",),
+    params={"dim1": Param.int(0), "dim2": Param.int(0)},
+    alias=("swapaxes",),
+)
+
+
+# ---- dot family (matrix_op-inl.h DotForward / BatchDotForward) -------------
+def _dot(attrs, lhs, rhs):
+    ta, tb = attrs["transpose_a"], attrs["transpose_b"]
+    a = lhs.T if ta and lhs.ndim == 2 else (jnp.transpose(lhs) if ta else lhs)
+    b = rhs.T if tb and rhs.ndim == 2 else (jnp.transpose(rhs) if tb else rhs)
+    # fp32 accumulation on the MXU for low-precision inputs
+    prec = jax.lax.Precision.DEFAULT
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b, precision=prec)
+    return jnp.dot(a, b, precision=prec, preferred_element_type=_acc_type(a.dtype))
+
+
+def _acc_type(dt):
+    dt = np.dtype(dt)
+    if dt in (np.dtype(np.float16), np.dtype(jnp.bfloat16)):
+        return np.float32
+    return None
+
+
+def _batch_dot(attrs, lhs, rhs):
+    ta, tb = attrs["transpose_a"], attrs["transpose_b"]
+    a = jnp.swapaxes(lhs, -1, -2) if ta else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if tb else rhs
+    return jnp.matmul(a, b, preferred_element_type=_acc_type(a.dtype))
+
+
+register_simple(
+    "dot",
+    _dot,
+    arg_names=("lhs", "rhs"),
+    params={"transpose_a": Param.bool(False), "transpose_b": Param.bool(False)},
+)
+register_simple(
+    "batch_dot",
+    _batch_dot,
+    arg_names=("lhs", "rhs"),
+    params={"transpose_a": Param.bool(False), "transpose_b": Param.bool(False)},
+    alias=("linalg_gemm2",),
+)
+
+
+# ---- slicing (matrix_op-inl.h SliceParam / SliceAxis) ----------------------
+def _slice(attrs, x):
+    begin, end = attrs["begin"], attrs["end"]
+    idx = []
+    for i in range(x.ndim):
+        b = begin[i] if i < len(begin) and begin[i] is not None else 0
+        e = end[i] if i < len(end) and end[i] is not None else x.shape[i]
+        idx.append(slice(b, e))
+    return x[tuple(idx)]
+
+
+def _parse_shape_opt(v):
+    """Parse shapes that may contain None entries: (None, 2)."""
+    if v is None:
+        return ()
+    if isinstance(v, (tuple, list)):
+        return tuple(None if e is None else int(e) for e in v)
+    s = str(v).strip().strip("()[]")
+    if not s:
+        return ()
+    return tuple(None if tok.strip() == "None" else int(float(tok)) for tok in s.split(","))
+
+
+register_simple(
+    "slice",
+    _slice,
+    arg_names=("data",),
+    params={"begin": Param(_parse_shape_opt), "end": Param(_parse_shape_opt)},
+    alias=("crop_like_slice",),
+)
+
+
+def _slice_axis(attrs, x):
+    ax = attrs["axis"] % x.ndim
+    b = attrs["begin"]
+    e = attrs["end"]
+    if e is None:
+        e = x.shape[ax]
+    if b < 0:
+        b += x.shape[ax]
+    if e < 0:
+        e += x.shape[ax]
+    sl = [slice(None)] * x.ndim
+    sl[ax] = slice(b, e)
+    return x[tuple(sl)]
+
+
+register_simple(
+    "slice_axis",
+    _slice_axis,
+    arg_names=("data",),
+    params={
+        "axis": Param.int(),
+        "begin": Param.int(0),
+        "end": Param(lambda v: None if v in (None, "None", "") else int(float(v)), None),
+    },
+)
+
+
+def _reverse(attrs, x):
+    axes = attrs["axis"] if isinstance(attrs["axis"], tuple) else (attrs["axis"],)
+    return jnp.flip(x, axes)
+
+
+register_simple(
+    "reverse", _reverse, arg_names=("data",), params={"axis": Param.shape(())}, alias=("flip",)
+)
+
+
+def _tile(attrs, x):
+    return jnp.tile(x, attrs["reps"])
+
+
+register_simple("tile", _tile, arg_names=("data",), params={"reps": Param.shape()})
+
+
+def _repeat(attrs, x):
+    ax = attrs["axis"]
+    return jnp.repeat(x, attrs["repeats"], axis=ax)
+
+
+register_simple(
+    "repeat",
+    _repeat,
+    arg_names=("data",),
+    params={
+        "repeats": Param.int(),
+        "axis": Param(lambda v: None if v in (None, "None", "") else int(float(v)), None),
+    },
+)
+
+
+# ---- concat / split (concat.cc:81 MXNET_REGISTER_OP_PROPERTY(Concat);
+# slice_channel.cc SliceChannel) --------------------------------------------
+@register(
+    "Concat",
+    arg_names=lambda attrs: ["arg%d" % i for i in range(int(attrs.get("num_args", 1)))],
+    params={"num_args": Param.int(1), "dim": Param.int(1)},
+    key_var_num_args="num_args",
+    alias=("concat",),
+)
+def _concat(octx, attrs, args, auxs):
+    return [jnp.concatenate(args, axis=attrs["dim"])], []
+
+
+@register(
+    "SliceChannel",
+    arg_names=("data",),
+    params={"num_outputs": Param.int(), "axis": Param.int(1), "squeeze_axis": Param.bool(False)},
+    num_outputs=lambda attrs: int(attrs["num_outputs"]),
+    output_names=lambda attrs: ["output%d" % i for i in range(int(attrs["num_outputs"]))],
+    alias=("split",),
+)
+def _slice_channel(octx, attrs, args, auxs):
+    x = args[0]
+    parts = jnp.split(x, attrs["num_outputs"], axis=attrs["axis"])
+    if attrs["squeeze_axis"]:
+        parts = [jnp.squeeze(p, axis=attrs["axis"]) for p in parts]
+    return list(parts), []
+
+
+def _stack(attrs, *args):
+    return jnp.stack(args, axis=attrs["axis"])
+
+
+@register(
+    "stack",
+    arg_names=lambda attrs: ["arg%d" % i for i in range(int(attrs.get("num_args", 1)))],
+    params={"num_args": Param.int(1), "axis": Param.int(0)},
+    key_var_num_args="num_args",
+)
+def _stack_op(octx, attrs, args, auxs):
+    return [jnp.stack(args, axis=attrs["axis"])], []
+
+
+# ---- Pad (pad.cc — edge/constant/reflect on 4d/5d) -------------------------
+def _pad(attrs, x):
+    pw = attrs["pad_width"]
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(x.ndim)]
+    mode = attrs["mode"]
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=attrs["constant_value"])
+    if mode == "edge":
+        return jnp.pad(x, pairs, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pairs, mode="reflect")
+    raise MXNetError("Pad: unknown mode %s" % mode)
+
+
+register_simple(
+    "Pad",
+    _pad,
+    arg_names=("data",),
+    params={
+        "pad_width": Param.shape(),
+        "mode": Param.str("constant"),
+        "constant_value": Param.float(0.0),
+    },
+    alias=("pad",),
+)
+
+
+# ---- Crop (crop.cc: crop h/w of src to match shape or ref symbol) ----------
+@register(
+    "Crop",
+    arg_names=lambda attrs: ["arg%d" % i for i in range(int(attrs.get("num_args", 1)))],
+    params={
+        "num_args": Param.int(1),
+        "offset": Param.shape((0, 0)),
+        "h_w": Param.shape((0, 0)),
+        "center_crop": Param.bool(False),
+    },
+    key_var_num_args="num_args",
+)
+def _crop(octx, attrs, args, auxs):
+    x = args[0]
+    if len(args) == 2:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = attrs["h_w"]
+    if attrs["center_crop"]:
+        oh = (x.shape[2] - th) // 2
+        ow = (x.shape[3] - tw) // 2
+    else:
+        oh, ow = attrs["offset"]
+    return [x[:, :, oh : oh + th, ow : ow + tw]], []
+
+
+# ---- where (control_flow.cc) ----------------------------------------------
+register_simple(
+    "where",
+    lambda attrs, cond, x, y: jnp.where(cond.astype(bool), x, y),
+    arg_names=("condition", "x", "y"),
+)
+
+# ---- diag/eye-ish helpers used by tests ------------------------------------
+register_simple(
+    "squeeze",
+    lambda attrs, x: jnp.squeeze(x, axis=attrs["axis"] if attrs["axis"] != () else None),
+    arg_names=("data",),
+    params={"axis": Param.shape(())},
+)

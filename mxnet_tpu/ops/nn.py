@@ -1,0 +1,688 @@
+"""Neural-network layer ops.
+
+Reference: the legacy-property layer family in src/operator/*.cc —
+FullyConnected (fully_connected-inl.h:60-120), Convolution (convolution-inl.h,
+im2col), Deconvolution, Pooling (nn/pool.h), Activation, LeakyReLU, BatchNorm
+(batch_norm.cc/.cu), Dropout, LRN, InstanceNorm, L2Normalization, UpSampling,
+SequenceLast/Mask/Reverse — each a hand-written Forward/Backward pair, with cuDNN
+fast paths (src/operator/cudnn_*.h).
+
+TPU design: every layer is one traced jax expression lowered to XLA conv/dot/
+reduce-window HLOs that map directly onto the MXU (conv/dot) and VPU
+(elementwise). Backward is autodiff — the hand Backward kernels and the
+cuDNN-vs-mshadow dual path disappear; XLA's conv transpose IS the gradient.
+Aux-state mutation (BatchNorm moving stats, FMutateInputs in the reference)
+is explicit: auxs in, updated auxs out.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import OpContext, Param, register, register_simple
+
+
+def _conv_dims(kernel):
+    return len(kernel)
+
+
+# ---------------------------------------------------------------- FullyConnected
+@register(
+    "FullyConnected",
+    arg_names=lambda attrs: ["data", "weight"] + ([] if attrs.get("no_bias") else ["bias"]),
+    params={
+        "num_hidden": Param.int(),
+        "no_bias": Param.bool(False),
+        "flatten": Param.bool(True),
+    },
+)
+def _fully_connected(octx, attrs, args, auxs):
+    data, weight = args[0], args[1]
+    if attrs["flatten"]:
+        x = data.reshape(data.shape[0], -1)
+    else:
+        x = data
+    out = jnp.dot(x, weight.T, preferred_element_type=_acc(x.dtype))
+    if not attrs["no_bias"]:
+        out = out + args[2]
+    return [out.astype(data.dtype)], []
+
+
+def _acc(dt):
+    dt = np.dtype(dt)
+    return np.float32 if dt in (np.dtype(np.float16), np.dtype(jnp.bfloat16)) else None
+
+
+def _fc_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("FullyConnected: data shape required")
+    nh = attrs["num_hidden"]
+    if attrs["flatten"]:
+        in_dim = int(np.prod(data[1:]))
+        out = (data[0], nh)
+    else:
+        in_dim = data[-1]
+        out = tuple(data[:-1]) + (nh,)
+    shapes = [tuple(data), (nh, in_dim)]
+    if not attrs["no_bias"]:
+        shapes.append((nh,))
+    return shapes, [out], []
+
+
+from .registry import get_op  # noqa: E402
+
+get_op("FullyConnected")._infer_shape = _fc_infer_shape
+
+
+# ---------------------------------------------------------------- Convolution
+_CONV_PARAMS = {
+    "kernel": Param.shape(),
+    "stride": Param.shape(()),
+    "dilate": Param.shape(()),
+    "pad": Param.shape(()),
+    "num_filter": Param.int(),
+    "num_group": Param.int(1),
+    "no_bias": Param.bool(False),
+    "workspace": Param.int(1024),  # accepted+ignored: XLA owns scratch memory
+    "cudnn_tune": Param.str(""),
+    "cudnn_off": Param.bool(False),
+    "layout": Param.str("None"),
+}
+
+
+def _conv_tuples(attrs, nd):
+    stride = attrs["stride"] or (1,) * nd
+    dilate = attrs["dilate"] or (1,) * nd
+    pad = attrs["pad"] or (0,) * nd
+    return stride, dilate, pad
+
+
+def _conv_dn(nd):
+    # NC + spatial for data, OI + spatial for kernel: the reference's fixed NCHW/
+    # NCDHW layout (convolution-inl.h). XLA relayouts internally for the MXU.
+    sp = "DHW"[3 - nd :]
+    return jax.lax.conv_dimension_numbers(
+        (1, 1) + (1,) * nd, (1, 1) + (1,) * nd, ("NC" + sp, "OI" + sp, "NC" + sp)
+    )
+
+
+@register(
+    "Convolution",
+    arg_names=lambda attrs: ["data", "weight"] + ([] if attrs.get("no_bias") else ["bias"]),
+    params=dict(_CONV_PARAMS),
+    alias=("Convolution_v1",),
+)
+def _convolution(octx, attrs, args, auxs):
+    data, weight = args[0], args[1]
+    nd = _conv_dims(attrs["kernel"])
+    stride, dilate, pad = _conv_tuples(attrs, nd)
+    out = jax.lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dn(nd),
+        feature_group_count=attrs["num_group"],
+        preferred_element_type=_acc(data.dtype),
+    ).astype(data.dtype)
+    if not attrs["no_bias"]:
+        bias = args[2]
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return [out], []
+
+
+def _conv_out_dim(x, k, s, p, d):
+    return (x + 2 * p - (d * (k - 1) + 1)) // s + 1
+
+
+def _conv_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("Convolution: data shape required")
+    nd = _conv_dims(attrs["kernel"])
+    stride, dilate, pad = _conv_tuples(attrs, nd)
+    nf, ng = attrs["num_filter"], attrs["num_group"]
+    wshape = (nf, data[1] // ng) + tuple(attrs["kernel"])
+    spatial = tuple(
+        _conv_out_dim(data[2 + i], attrs["kernel"][i], stride[i], pad[i], dilate[i])
+        for i in range(nd)
+    )
+    out = (data[0], nf) + spatial
+    shapes = [tuple(data), wshape] + ([] if attrs["no_bias"] else [(nf,)])
+    return shapes, [out], []
+
+
+get_op("Convolution")._infer_shape = _conv_infer_shape
+
+
+# ---------------------------------------------------------------- Deconvolution
+_DECONV_PARAMS = dict(_CONV_PARAMS)
+_DECONV_PARAMS.update({"adj": Param.shape(()), "target_shape": Param.shape(())})
+
+
+@register(
+    "Deconvolution",
+    arg_names=lambda attrs: ["data", "weight"] + ([] if attrs.get("no_bias") else ["bias"]),
+    params=_DECONV_PARAMS,
+)
+def _deconvolution(octx, attrs, args, auxs):
+    data, weight = args[0], args[1]
+    nd = _conv_dims(attrs["kernel"])
+    stride, dilate, pad = _conv_tuples(attrs, nd)
+    # Gradient-of-conv semantics (the reference implements deconv as conv
+    # backward-data, deconvolution-inl.h): lhs dilation by stride, flipped
+    # effective padding.
+    pads = [
+        (dilate[i] * (attrs["kernel"][i] - 1) - pad[i], dilate[i] * (attrs["kernel"][i] - 1) - pad[i] + (attrs["adj"][i] if attrs["adj"] else 0))
+        for i in range(nd)
+    ]
+    sp = "DHW"[3 - nd :]
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape, ("NC" + sp, "IO" + sp, "NC" + sp)
+    )
+    out = jax.lax.conv_general_dilated(
+        data,
+        jnp.flip(weight, axis=tuple(range(2, 2 + nd))),
+        window_strides=(1,) * nd,
+        padding=pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=attrs["num_group"],
+        preferred_element_type=_acc(data.dtype),
+    ).astype(data.dtype)
+    if not attrs["no_bias"]:
+        out = out + args[2].reshape((1, -1) + (1,) * nd)
+    return [out], []
+
+
+def _deconv_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    nd = _conv_dims(attrs["kernel"])
+    stride, dilate, pad = _conv_tuples(attrs, nd)
+    nf, ng = attrs["num_filter"], attrs["num_group"]
+    adj = attrs["adj"] or (0,) * nd
+    wshape = (data[1], nf // ng) + tuple(attrs["kernel"])
+    spatial = tuple(
+        (data[2 + i] - 1) * stride[i] - 2 * pad[i] + (dilate[i] * (attrs["kernel"][i] - 1) + 1) + adj[i]
+        for i in range(nd)
+    )
+    out = (data[0], nf) + spatial
+    shapes = [tuple(data), wshape] + ([] if attrs["no_bias"] else [(nf,)])
+    return shapes, [out], []
+
+
+get_op("Deconvolution")._infer_shape = _deconv_infer_shape
+
+
+# ---------------------------------------------------------------- Pooling
+@register(
+    "Pooling",
+    arg_names=("data",),
+    params={
+        "kernel": Param.shape(()),
+        "pool_type": Param.str("max"),
+        "global_pool": Param.bool(False),
+        "stride": Param.shape(()),
+        "pad": Param.shape(()),
+        "pooling_convention": Param.str("valid"),
+        "cudnn_off": Param.bool(False),
+    },
+    alias=("Pooling_v1",),
+)
+def _pooling(octx, attrs, args, auxs):
+    x = args[0]
+    nd = x.ndim - 2
+    if attrs["global_pool"]:
+        kernel = x.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = attrs["kernel"]
+        stride = attrs["stride"] or (1,) * nd
+        pad = attrs["pad"] or (0,) * nd
+    pads = []
+    for i in range(nd):
+        extra = 0
+        if attrs["pooling_convention"] == "full" and not attrs["global_pool"]:
+            h = x.shape[2 + i]
+            out_full = -(-(h + 2 * pad[i] - kernel[i]) // stride[i]) + 1  # ceil
+            extra = max(0, (out_full - 1) * stride[i] + kernel[i] - h - 2 * pad[i])
+        pads.append((pad[i], pad[i] + extra))
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = [(0, 0), (0, 0)] + pads
+    pt = attrs["pool_type"]
+    if pt == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, jnp.asarray(init, x.dtype), jax.lax.max, window, strides, padding)
+    elif pt in ("avg", "sum"):
+        s = jax.lax.reduce_window(x, jnp.asarray(0, x.dtype), jax.lax.add, window, strides, padding)
+        if pt == "avg":
+            ones = jnp.ones(x.shape[2:], x.dtype)
+            cnt = jax.lax.reduce_window(
+                ones, jnp.asarray(0, x.dtype), jax.lax.add, tuple(kernel), tuple(stride), pads
+            )
+            s = s / cnt
+        out = s
+    else:
+        raise MXNetError("Pooling: unknown pool_type %s" % pt)
+    return [out], []
+
+
+def _pool_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    nd = len(data) - 2
+    if attrs["global_pool"]:
+        return [tuple(data)], [tuple(data[:2]) + (1,) * nd], []
+    kernel = attrs["kernel"]
+    stride = attrs["stride"] or (1,) * nd
+    pad = attrs["pad"] or (0,) * nd
+    sp = []
+    for i in range(nd):
+        if attrs["pooling_convention"] == "full":
+            o = -(-(data[2 + i] + 2 * pad[i] - kernel[i]) // stride[i]) + 1
+        else:
+            o = (data[2 + i] + 2 * pad[i] - kernel[i]) // stride[i] + 1
+        sp.append(o)
+    return [tuple(data)], [tuple(data[:2]) + tuple(sp)], []
+
+
+get_op("Pooling")._infer_shape = _pool_infer_shape
+
+
+# ---------------------------------------------------------------- Activation
+@register(
+    "Activation",
+    arg_names=("data",),
+    params={"act_type": Param.str()},
+)
+def _activation(octx, attrs, args, auxs):
+    x = args[0]
+    t = attrs["act_type"]
+    if t == "relu":
+        out = jax.nn.relu(x)
+    elif t == "sigmoid":
+        out = jax.nn.sigmoid(x)
+    elif t == "tanh":
+        out = jnp.tanh(x)
+    elif t == "softrelu":
+        out = jax.nn.softplus(x)
+    elif t == "softsign":
+        out = jax.nn.soft_sign(x)
+    else:
+        raise MXNetError("Activation: unknown act_type %s" % t)
+    return [out], []
+
+
+# ---------------------------------------------------------------- LeakyReLU
+@register(
+    "LeakyReLU",
+    arg_names=lambda attrs: ["data", "gamma"] if attrs.get("act_type") == "prelu" else ["data"],
+    params={
+        "act_type": Param.str("leaky"),
+        "slope": Param.float(0.25),
+        "lower_bound": Param.float(0.125),
+        "upper_bound": Param.float(0.334),
+    },
+    stochastic=True,  # rrelu needs a key in training
+)
+def _leaky_relu(octx, attrs, args, auxs):
+    x = args[0]
+    t = attrs["act_type"]
+    if t == "leaky":
+        out = jnp.where(x > 0, x, attrs["slope"] * x)
+    elif t == "elu":
+        out = jnp.where(x > 0, x, attrs["slope"] * (jnp.exp(x) - 1))
+    elif t == "prelu":
+        gamma = args[1].reshape((1, -1) + (1,) * (x.ndim - 2)) if x.ndim > 1 else args[1]
+        out = jnp.where(x > 0, x, gamma * x)
+    elif t == "rrelu":
+        if octx.is_train and octx.rng is not None:
+            slope = jax.random.uniform(
+                octx.rng, (x.shape[0],) + (1,) * (x.ndim - 1),
+                minval=attrs["lower_bound"], maxval=attrs["upper_bound"], dtype=x.dtype,
+            )
+        else:
+            slope = (attrs["lower_bound"] + attrs["upper_bound"]) / 2.0
+        out = jnp.where(x > 0, x, slope * x)
+    else:
+        raise MXNetError("LeakyReLU: unknown act_type %s" % t)
+    return [out], []
+
+
+def _lrelu_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    shapes = [tuple(data)]
+    if attrs.get("act_type") == "prelu":
+        shapes.append((data[1],))
+    return shapes, [tuple(data)], []
+
+
+get_op("LeakyReLU")._infer_shape = _lrelu_infer_shape
+
+
+# ---------------------------------------------------------------- BatchNorm
+@register(
+    "BatchNorm",
+    arg_names=("data", "gamma", "beta"),
+    aux_names=("moving_mean", "moving_var"),
+    params={
+        "eps": Param.float(1e-3),
+        "momentum": Param.float(0.9),
+        "fix_gamma": Param.bool(True),
+        "use_global_stats": Param.bool(False),
+        "output_mean_var": Param.bool(False),
+        "axis": Param.int(1),
+        "cudnn_off": Param.bool(False),
+    },
+    num_outputs=3,
+    num_visible_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
+    output_names=("output", "mean", "var"),
+    alias=("BatchNorm_v1",),
+)
+def _batch_norm(octx, attrs, args, auxs):
+    x, gamma, beta = args
+    mmean, mvar = auxs
+    ax = attrs["axis"] % x.ndim
+    red = tuple(i for i in range(x.ndim) if i != ax)
+    bshape = tuple(x.shape[ax] if i == ax else 1 for i in range(x.ndim))
+    if attrs["fix_gamma"]:
+        gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
+    if octx.is_train and not attrs["use_global_stats"]:
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
+        m = attrs["momentum"]
+        new_mean = mmean * m + jax.lax.stop_gradient(mean) * (1 - m)
+        new_var = mvar * m + jax.lax.stop_gradient(var) * (1 - m)
+    else:
+        mean, var = mmean, mvar
+        new_mean, new_var = mmean, mvar
+    inv = jax.lax.rsqrt(var.reshape(bshape) + attrs["eps"])
+    out = (x - mean.reshape(bshape)) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+    return [out, mean, var], [new_mean, new_var]
+
+
+def _bn_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    c = (data[attrs.get("axis", 1) % len(data)],)
+    return [tuple(data), c, c], [tuple(data), c, c], [c, c]
+
+
+get_op("BatchNorm")._infer_shape = _bn_infer_shape
+
+
+# ---------------------------------------------------------------- InstanceNorm
+@register(
+    "InstanceNorm",
+    arg_names=("data", "gamma", "beta"),
+    params={"eps": Param.float(1e-3)},
+)
+def _instance_norm(octx, attrs, args, auxs):
+    x, gamma, beta = args
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    out = (x - mean) * jax.lax.rsqrt(var + attrs["eps"])
+    return [out * gamma.reshape(bshape) + beta.reshape(bshape)], []
+
+
+def _in_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    c = (data[1],)
+    return [tuple(data), c, c], [tuple(data)], []
+
+
+get_op("InstanceNorm")._infer_shape = _in_infer_shape
+
+
+# ---------------------------------------------------------------- L2Normalization
+@register(
+    "L2Normalization",
+    arg_names=("data",),
+    params={"eps": Param.float(1e-10), "mode": Param.str("instance")},
+)
+def _l2_normalization(octx, attrs, args, auxs):
+    x = args[0]
+    mode = attrs["mode"]
+    if mode == "instance":
+        red = tuple(range(1, x.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + attrs["eps"])
+    elif mode == "channel":
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + attrs["eps"])
+    elif mode == "spatial":
+        red = tuple(range(2, x.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + attrs["eps"])
+    else:
+        raise MXNetError("L2Normalization: unknown mode %s" % mode)
+    return [x / norm], []
+
+
+# ---------------------------------------------------------------- LRN
+@register(
+    "LRN",
+    arg_names=("data",),
+    params={
+        "alpha": Param.float(1e-4),
+        "beta": Param.float(0.75),
+        "knorm": Param.float(2.0),
+        "nsize": Param.int(),
+    },
+    num_outputs=2,
+    num_visible_outputs=1,
+    output_names=("output", "tmp_norm"),
+)
+def _lrn(octx, attrs, args, auxs):
+    x = args[0]
+    n = attrs["nsize"]
+    half = n // 2
+    sq = jnp.square(x)
+    ssum = jax.lax.reduce_window(
+        sq, jnp.asarray(0, x.dtype), jax.lax.add,
+        (1, n, 1, 1), (1, 1, 1, 1), [(0, 0), (half, half), (0, 0), (0, 0)],
+    )
+    norm = jnp.power(attrs["knorm"] + (attrs["alpha"] / n) * ssum, -attrs["beta"])
+    return [x * norm, norm], []
+
+
+# ---------------------------------------------------------------- Dropout
+@register(
+    "Dropout",
+    arg_names=("data",),
+    params={"p": Param.float(0.5), "mode": Param.str("training")},
+    stochastic=True,
+    num_outputs=2,
+    num_visible_outputs=1,
+    output_names=("output", "mask"),
+)
+def _dropout(octx, attrs, args, auxs):
+    x = args[0]
+    p = attrs["p"]
+    apply = octx.is_train or attrs["mode"] == "always"
+    if not apply or p <= 0.0 or octx.rng is None:
+        return [x, jnp.ones_like(x)], []
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(octx.rng, keep, x.shape).astype(x.dtype) / keep
+    mask = jax.lax.stop_gradient(mask)
+    return [x * mask, mask], []
+
+
+# ---------------------------------------------------------------- softmax family
+def _softmax_axis(attrs, x):
+    return jax.nn.softmax(x, axis=attrs["axis"])
+
+
+register_simple(
+    "softmax", _softmax_axis, arg_names=("data",), params={"axis": Param.int(-1), "temperature": Param.float(1.0)}
+)
+register_simple(
+    "log_softmax",
+    lambda attrs, x: jax.nn.log_softmax(x, axis=attrs["axis"]),
+    arg_names=("data",),
+    params={"axis": Param.int(-1), "temperature": Param.float(1.0)},
+)
+
+
+@register(
+    "SoftmaxActivation",
+    arg_names=("data",),
+    params={"mode": Param.str("instance")},
+)
+def _softmax_activation(octx, attrs, args, auxs):
+    x = args[0]
+    if attrs["mode"] == "channel":
+        return [jax.nn.softmax(x, axis=1)], []
+    return [jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)], []
+
+
+# ---------------------------------------------------------------- UpSampling
+@register(
+    "UpSampling",
+    arg_names=lambda attrs: (
+        ["arg%d" % i for i in range(int(attrs.get("num_args", 1)))]
+        if attrs.get("sample_type") == "nearest"
+        else ["data", "weight"]
+    ),
+    params={
+        "scale": Param.int(),
+        "num_filter": Param.int(0),
+        "sample_type": Param.str("nearest"),
+        "multi_input_mode": Param.str("concat"),
+        "num_args": Param.int(1),
+        "workspace": Param.int(512),
+    },
+    key_var_num_args="num_args",
+)
+def _upsampling(octx, attrs, args, auxs):
+    s = attrs["scale"]
+    if attrs["sample_type"] == "nearest":
+        ups = []
+        target = None
+        for x in args:
+            u = jnp.repeat(jnp.repeat(x, s, axis=2), s, axis=3) if target is None else x
+            if target is None:
+                target = u.shape[2:]
+            else:
+                scale_i = target[0] // x.shape[2]
+                u = jnp.repeat(jnp.repeat(x, scale_i, axis=2), scale_i, axis=3)
+            ups.append(u)
+        if len(ups) == 1:
+            return [ups[0]], []
+        if attrs["multi_input_mode"] == "sum":
+            out = ups[0]
+            for u in ups[1:]:
+                out = out + u
+            return [out], []
+        return [jnp.concatenate(ups, axis=1)], []
+    # bilinear: deconvolution with provided weight (reference wires UpSampling
+    # bilinear through DeconvolutionOp, upsampling-inl.h)
+    x, w = args
+    k = 2 * s - s % 2
+    p = (k - s) // 2  # integer pad chosen so out = in*scale
+    deconv_attrs = {
+        "kernel": (k, k), "stride": (s, s), "pad": (p, p), "adj": (s % 2, s % 2),
+        "num_filter": attrs["num_filter"], "num_group": attrs["num_filter"],
+        "no_bias": True, "dilate": (1, 1),
+    }
+    out, _ = _deconvolution(octx, deconv_attrs, [x, w], [])
+    return out, []
+
+
+def _upsampling_infer_shape(attrs, in_shapes, aux_shapes):
+    s = attrs["scale"]
+    data = in_shapes[0]
+    if attrs["sample_type"] == "nearest":
+        oh, ow = data[2] * s, data[3] * s
+        if len(in_shapes) == 1:
+            c = data[1]
+        else:
+            c = sum(sh[1] for sh in in_shapes) if attrs["multi_input_mode"] == "concat" else data[1]
+        return [tuple(d) for d in in_shapes], [(data[0], c, oh, ow)], []
+    k = 2 * s - s % 2
+    nf = attrs["num_filter"]
+    wshape = (data[1], 1, k, k)
+    return [tuple(data), wshape], [(data[0], nf, data[2] * s, data[3] * s)], []
+
+
+get_op("UpSampling")._infer_shape = _upsampling_infer_shape
+
+
+# ---------------------------------------------------------------- Sequence ops
+def _seq_mask_from_len(length, maxlen, batch, dtype):
+    # (seq, batch) mask from per-batch lengths
+    ar = jnp.arange(maxlen, dtype=jnp.float32)[:, None]
+    return (ar < length.astype(jnp.float32)[None, :]).astype(dtype)
+
+
+@register(
+    "SequenceMask",
+    arg_names=lambda attrs: ["data", "sequence_length"] if attrs.get("use_sequence_length") else ["data"],
+    params={"use_sequence_length": Param.bool(False), "value": Param.float(0.0), "axis": Param.int(0)},
+)
+def _sequence_mask(octx, attrs, args, auxs):
+    x = args[0]
+    if not attrs["use_sequence_length"]:
+        return [x], []
+    length = args[1]
+    ax = attrs["axis"]
+    xs = jnp.swapaxes(x, 0, ax) if ax != 0 else x
+    mask = _seq_mask_from_len(length, xs.shape[0], xs.shape[1], xs.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (xs.ndim - 2))
+    out = xs * mask + attrs["value"] * (1 - mask)
+    if ax != 0:
+        out = jnp.swapaxes(out, 0, ax)
+    return [out], []
+
+
+@register(
+    "SequenceLast",
+    arg_names=lambda attrs: ["data", "sequence_length"] if attrs.get("use_sequence_length") else ["data"],
+    params={"use_sequence_length": Param.bool(False), "axis": Param.int(0)},
+)
+def _sequence_last(octx, attrs, args, auxs):
+    x = args[0]
+    ax = attrs["axis"]
+    xs = jnp.swapaxes(x, 0, ax) if ax != 0 else x
+    if attrs["use_sequence_length"]:
+        idx = jax.lax.stop_gradient(args[1]).astype(np.int32) - 1
+        out = jnp.take_along_axis(
+            xs, idx.reshape((1, -1) + (1,) * (xs.ndim - 2)).astype(np.int32), axis=0
+        )[0]
+    else:
+        out = xs[-1]
+    return [out], []
+
+
+def _seqlast_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    ax = attrs.get("axis", 0)
+    rest = tuple(d for i, d in enumerate(data) if i != ax)
+    shapes = [tuple(data)]
+    if attrs.get("use_sequence_length"):
+        shapes.append((data[1 - ax],))
+    return shapes, [rest], []
+
+
+get_op("SequenceLast")._infer_shape = _seqlast_infer_shape
+
+
+@register(
+    "SequenceReverse",
+    arg_names=lambda attrs: ["data", "sequence_length"] if attrs.get("use_sequence_length") else ["data"],
+    params={"use_sequence_length": Param.bool(False), "axis": Param.int(0)},
+)
+def _sequence_reverse(octx, attrs, args, auxs):
+    x = args[0]
+    if not attrs["use_sequence_length"]:
+        return [jnp.flip(x, axis=0)], []
+    length = jax.lax.stop_gradient(args[1]).astype(np.int32)
+    T = x.shape[0]
+    ar = jnp.arange(T)[:, None]
+    rev_idx = jnp.where(ar < length[None, :], length[None, :] - 1 - ar, ar)
+    out = jnp.take_along_axis(x, rev_idx.reshape((T, -1) + (1,) * (x.ndim - 2)), axis=0)
+    return [out], []

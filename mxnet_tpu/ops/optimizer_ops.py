@@ -1,0 +1,111 @@
+"""Fused optimizer update ops.
+
+Reference: src/operator/optimizer_op.cc:18+ / optimizer_op-inl.h (SGDUpdate,
+SGDMomUpdate :136, AdamParam :156, rmsprop/rmspropalex) — single fused kernels
+called from python/mxnet/optimizer.py so the update never materializes
+intermediates. Here each is one jitted jax expression; XLA fuses the whole
+update into a single HBM pass, and inside a compiled training step the update
+fuses with the gradient computation itself (something the reference cannot do).
+
+Semantics note: these ops *mutate* their weight/state inputs in the reference
+(FMutateInputs). Imperatively we return the new values and the NDArray layer
+writes them back into the same buffers; inside compiled train steps the executor
+threads them functionally.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import Param, register
+
+_COMMON = {
+    "lr": Param.float(),
+    "wd": Param.float(0.0),
+    "rescale_grad": Param.float(1.0),
+    "clip_gradient": Param.float(-1.0),
+}
+
+
+def _prep_grad(grad, weight, attrs):
+    g = grad * attrs["rescale_grad"]
+    if attrs["clip_gradient"] > 0:
+        g = jnp.clip(g, -attrs["clip_gradient"], attrs["clip_gradient"])
+    return g + attrs["wd"] * weight
+
+
+@register("sgd_update", arg_names=("weight", "grad"), params=dict(_COMMON))
+def _sgd_update(octx, attrs, args, auxs):
+    weight, grad = args
+    g = _prep_grad(grad, weight, attrs)
+    return [weight - attrs["lr"] * g], []
+
+
+@register(
+    "sgd_mom_update",
+    arg_names=("weight", "grad", "mom"),
+    params=dict(_COMMON, momentum=Param.float(0.0)),
+    num_outputs=2,
+    num_visible_outputs=1,
+)
+def _sgd_mom_update(octx, attrs, args, auxs):
+    weight, grad, mom = args
+    g = _prep_grad(grad, weight, attrs)
+    new_mom = attrs["momentum"] * mom - attrs["lr"] * g
+    return [weight + new_mom, new_mom], []
+
+
+@register(
+    "adam_update",
+    arg_names=("weight", "grad", "mean", "var"),
+    params=dict(
+        _COMMON,
+        beta1=Param.float(0.9),
+        beta2=Param.float(0.999),
+        epsilon=Param.float(1e-8),
+    ),
+    num_outputs=3,
+    num_visible_outputs=1,
+)
+def _adam_update(octx, attrs, args, auxs):
+    weight, grad, mean, var = args
+    g = _prep_grad(grad, weight, attrs)
+    b1, b2 = attrs["beta1"], attrs["beta2"]
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    new_w = weight - attrs["lr"] * new_mean / (jnp.sqrt(new_var) + attrs["epsilon"])
+    return [new_w, new_mean, new_var], []
+
+
+@register(
+    "rmsprop_update",
+    arg_names=("weight", "grad", "n"),
+    params=dict(_COMMON, gamma1=Param.float(0.95), epsilon=Param.float(1e-8)),
+    num_outputs=2,
+    num_visible_outputs=1,
+)
+def _rmsprop_update(octx, attrs, args, auxs):
+    weight, grad, n = args
+    g = _prep_grad(grad, weight, attrs)
+    g1 = attrs["gamma1"]
+    new_n = (1 - g1) * jnp.square(g) + g1 * n
+    new_w = weight - attrs["lr"] * g / jnp.sqrt(new_n + attrs["epsilon"])
+    return [new_w, new_n], []
+
+
+@register(
+    "rmspropalex_update",
+    arg_names=("weight", "grad", "n", "g", "delta"),
+    params=dict(
+        _COMMON, gamma1=Param.float(0.95), gamma2=Param.float(0.9), epsilon=Param.float(1e-8)
+    ),
+    num_outputs=4,
+    num_visible_outputs=1,
+)
+def _rmspropalex_update(octx, attrs, args, auxs):
+    weight, grad, n, gbar, delta = args
+    g = _prep_grad(grad, weight, attrs)
+    g1, g2 = attrs["gamma1"], attrs["gamma2"]
+    new_n = (1 - g1) * jnp.square(g) + g1 * n
+    new_g = (1 - g1) * g + g1 * gbar
+    new_delta = g2 * delta - attrs["lr"] * g / jnp.sqrt(new_n - jnp.square(new_g) + attrs["epsilon"])
+    return [weight + new_delta, new_n, new_g, new_delta], []

@@ -1,0 +1,95 @@
+"""Ordering ops: topk / sort / argsort.
+
+Reference: src/operator/tensor/ordering_op.cc (+sort_op-inl.cuh, cub/thrust
+device sorts). XLA provides sort/top_k HLOs natively on TPU — no hand kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Param, register, register_simple
+
+
+def _axis_or_none(v):
+    if v in (None, "None", ""):
+        return None
+    return int(float(v))
+
+
+@register(
+    "topk",
+    arg_names=("data",),
+    params={
+        "axis": Param(_axis_or_none, -1),
+        "k": Param.int(1),
+        "ret_typ": Param.str("indices"),
+        "is_ascend": Param.bool(False),
+        "dtype": Param.dtype(None),
+    },
+    num_outputs=lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1,
+)
+def _topk(octx, attrs, args, auxs):
+    x = args[0]
+    ax = attrs["axis"]
+    k = attrs["k"] if attrs["k"] > 0 else (x.size if ax is None else x.shape[ax])
+    if ax is None:
+        flat = x.reshape(-1)
+        vals, idx = _topk1d(flat, k, attrs["is_ascend"])
+    else:
+        ax = ax % x.ndim
+        moved = jnp.moveaxis(x, ax, -1)
+        vals, idx = _topk1d(moved, k, attrs["is_ascend"])
+        vals = jnp.moveaxis(vals, -1, ax)
+        idx = jnp.moveaxis(idx, -1, ax)
+    idx = jax.lax.stop_gradient(idx)
+    rt = attrs["ret_typ"]
+    if rt == "value":
+        return [vals], []
+    if rt == "both":
+        return [vals, idx.astype(x.dtype)], []
+    if rt == "mask":
+        oh = jnp.sum(jax.nn.one_hot(idx, x.shape[ax if ax is not None else -1], dtype=x.dtype), axis=-2)
+        return [jax.lax.stop_gradient(oh)], []
+    return [jax.lax.stop_gradient(idx.astype(x.dtype))], []
+
+
+def _topk1d(x, k, is_ascend):
+    if is_ascend:
+        vals, idx = jax.lax.top_k(-x, k)
+        return -vals, idx
+    return jax.lax.top_k(x, k)
+
+
+def _sort(attrs, x):
+    ax = attrs["axis"]
+    ax = None if ax is None else ax
+    out = jnp.sort(x, axis=ax)
+    if not attrs["is_ascend"]:
+        out = jnp.flip(out, axis=-1 if ax is None else ax)
+    return out
+
+
+register_simple(
+    "sort",
+    _sort,
+    arg_names=("data",),
+    params={"axis": Param(_axis_or_none, -1), "is_ascend": Param.bool(True)},
+)
+
+
+def _argsort(attrs, x):
+    ax = attrs["axis"]
+    idx = jnp.argsort(x, axis=ax)
+    if not attrs["is_ascend"]:
+        idx = jnp.flip(idx, axis=-1 if ax is None else ax)
+    return jax.lax.stop_gradient(idx.astype(x.dtype))
+
+
+register_simple(
+    "argsort",
+    _argsort,
+    arg_names=("data",),
+    params={"axis": Param(_axis_or_none, -1), "is_ascend": Param.bool(True), "dtype": Param.dtype(None)},
+)

@@ -1,0 +1,145 @@
+"""Reduction and broadcasting-shape ops.
+
+Reference: src/operator/tensor/broadcast_reduce_op_value.cc / _index.cc and the
+hand-tiled kernels in broadcast_reduce-inl.{h,cuh}. On TPU these are single XLA
+reduce HLOs — the MXU/VPU tiling the reference hand-writes is the compiler's job.
+
+MXNet axis semantics preserved: ``axis=()`` or unset means reduce-all;
+``keepdims`` keeps singleton axes; ``exclude`` reduces over the complement.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import parse_bool, parse_shape
+from .registry import Param, register_simple
+
+
+def _axis_param(default=None):
+    def _parse(v):
+        if v is None or (isinstance(v, str) and v.strip() in ("None", "")):
+            return None
+        if isinstance(v, (int, np.integer)):
+            return (int(v),)
+        return parse_shape(v)
+
+    return Param(_parse, default)
+
+
+def _norm_axes(axis, ndim, exclude=False):
+    if axis is None or axis == ():
+        axes = tuple(range(ndim))
+        return tuple(range(ndim)) if not exclude else ()
+    axes = tuple(sorted(a % ndim for a in axis))
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _make_reduce(fn):
+    def _impl(attrs, x):
+        axes = _norm_axes(attrs["axis"], x.ndim, attrs["exclude"])
+        return fn(x, axis=axes if axes else None, keepdims=attrs["keepdims"])
+
+    return _impl
+
+
+_REDUCE_PARAMS = {
+    "axis": _axis_param(None),
+    "keepdims": Param.bool(False),
+    "exclude": Param.bool(False),
+}
+
+for _name, _fn, _aliases in [
+    ("sum", jnp.sum, ("sum_axis",)),
+    ("mean", jnp.mean, ()),
+    ("prod", jnp.prod, ()),
+    ("max", jnp.max, ("max_axis",)),
+    ("min", jnp.min, ("min_axis",)),
+    ("nansum", jnp.nansum, ()),
+    ("nanprod", jnp.nanprod, ()),
+]:
+    register_simple(
+        _name,
+        (lambda fn: _make_reduce(fn))(_fn),
+        arg_names=("data",),
+        params=dict(_REDUCE_PARAMS),
+        alias=_aliases,
+    )
+
+
+# argmax/argmin (reference: broadcast_reduce_op_index.cc) — axis is a single int
+# or None (flatten); output dtype matches input (mxnet returns float indices)
+def _make_argreduce(fn):
+    def _impl(attrs, x):
+        ax = attrs["axis"]
+        ax = None if ax is None else int(ax[0]) if isinstance(ax, tuple) else int(ax)
+        out = fn(x, axis=ax)
+        if attrs["keepdims"] and ax is not None:
+            out = jnp.expand_dims(out, ax)
+        return jax.lax.stop_gradient(out.astype(x.dtype))
+
+    return _impl
+
+
+for _name, _fn in [("argmax", jnp.argmax), ("argmin", jnp.argmin)]:
+    register_simple(
+        _name,
+        (lambda fn: _make_argreduce(fn))(_fn),
+        arg_names=("data",),
+        params={"axis": _axis_param(None), "keepdims": Param.bool(False)},
+    )
+
+register_simple(
+    "argmax_channel",
+    lambda attrs, x: jax.lax.stop_gradient(jnp.argmax(x, axis=1).astype(x.dtype)),
+    arg_names=("data",),
+)
+
+
+def _norm(attrs, x):
+    ord_ = attrs.get("ord", 2)
+    axes = _norm_axes(attrs.get("axis"), x.ndim, False) if attrs.get("axis") is not None else None
+    if ord_ == 1:
+        r = jnp.sum(jnp.abs(x), axis=axes, keepdims=attrs.get("keepdims", False))
+    else:
+        r = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=attrs.get("keepdims", False)))
+    return r
+
+
+register_simple(
+    "norm",
+    _norm,
+    arg_names=("data",),
+    params={"ord": Param.int(2), "axis": _axis_param(None), "keepdims": Param.bool(False)},
+)
+
+# ---- broadcasting shape ops (reference: broadcast_reduce_op_value.cc) ------
+register_simple(
+    "broadcast_to",
+    lambda attrs, x: jnp.broadcast_to(
+        x, tuple(t if t != 0 else s for t, s in zip(attrs["shape"], x.shape))
+    ),
+    arg_names=("data",),
+    params={"shape": Param.shape(())},
+)
+
+
+def _broadcast_axis(attrs, x):
+    axes = attrs["axis"] if isinstance(attrs["axis"], tuple) else (attrs["axis"],)
+    sizes = attrs["size"] if isinstance(attrs["size"], tuple) else (attrs["size"],)
+    target = list(x.shape)
+    for a, s in zip(axes, sizes):
+        target[a % x.ndim] = int(s)
+    return jnp.broadcast_to(x, tuple(target))
+
+
+register_simple(
+    "broadcast_axis",
+    _broadcast_axis,
+    arg_names=("data",),
+    params={"axis": _axis_param(()), "size": Param.shape(())},
+    alias=("broadcast_axes",),
+)

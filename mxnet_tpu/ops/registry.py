@@ -1,0 +1,299 @@
+"""Operator registry — the TPU-native analog of the reference's dual registration
+systems (legacy ``OperatorProperty``/``MXNET_REGISTER_OP_PROPERTY``,
+include/mxnet/operator.h:166, and NNVM ``FCompute``/``NNVM_REGISTER_OP``,
+include/mxnet/op_attr_types.h:59-63 — 298 registrations total, SURVEY §2.3).
+
+Design differences, deliberate and TPU-first:
+
+* One registration system, not two. Every op is a **pure jax function**
+  ``forward(opctx, attrs, args, auxs) -> (outputs, new_auxs)``. There are no
+  hand-written Backward kernels: gradients come from jax autodiff over the same
+  forward (the reference's per-op ``Backward``/``FGradient`` pairs collapse into
+  ``jax.vjp``). Ops that need a non-mathematical gradient (SoftmaxOutput writes
+  ``p - onehot(label)`` directly, src/operator/softmax_output-inl.h) express it
+  with ``jax.custom_vjp`` inside their forward.
+* Aux state (BatchNorm moving stats — ``FMutateInputs`` in the reference) is
+  functional: auxs go in, updated auxs come out, and the executor writes them
+  back. This is the jit-compatible form of the engine's mutable write-vars.
+* Shape/type inference (``FInferShape``/``FInferType``) defaults to
+  ``jax.eval_shape`` over the forward — the compiler is the shape oracle — with
+  per-op overrides only where inference must fill in *unknown parameter shapes*
+  from data shapes (FullyConnected weight, Convolution kernel, ...), which
+  abstract evaluation cannot do backwards.
+* Randomness (Dropout, samplers) is explicit: ops declaring ``stochastic=True``
+  receive a threefry key via ``opctx.rng`` instead of the reference's hidden
+  per-device RNG resource (src/resource.cc:158).
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError, parse_bool, parse_shape
+
+__all__ = ["OpContext", "Operator", "register", "register_simple", "get_op", "list_ops", "Param"]
+
+_OP_REGISTRY = {}
+
+
+class OpContext:
+    """Per-invocation execution context handed to op forwards.
+
+    Replaces the reference's ``OpContext`` (include/mxnet/op_attr_types.h:35-50:
+    is_train, RunContext, requested resources): here it carries the training flag
+    and an explicit PRNG key (None for deterministic ops).
+    """
+
+    __slots__ = ("is_train", "rng")
+
+    def __init__(self, is_train=False, rng=None):
+        self.is_train = is_train
+        self.rng = rng
+
+
+class Param:
+    """Attr schema entry — the analog of a dmlc::Parameter field (DMLC_DECLARE_FIELD):
+    a parser (from the JSON string form or a python value), a default, and a
+    required flag. Gives every op keyword validation + canonicalization so attrs
+    round-trip through Symbol JSON identically to the reference."""
+
+    __slots__ = ("parse", "default", "required")
+
+    _REQUIRED = object()
+
+    def __init__(self, parse, default=_REQUIRED):
+        self.parse = parse
+        self.default = default
+        self.required = default is Param._REQUIRED
+
+    @staticmethod
+    def shape(default=_REQUIRED):
+        return Param(parse_shape, default)
+
+    @staticmethod
+    def int(default=_REQUIRED):
+        return Param(lambda v: int(float(v)), default)
+
+    @staticmethod
+    def float(default=_REQUIRED):
+        return Param(float, default)
+
+    @staticmethod
+    def bool(default=_REQUIRED):
+        return Param(parse_bool, default)
+
+    @staticmethod
+    def str(default=_REQUIRED):
+        return Param(lambda v: str(v), default)
+
+    @staticmethod
+    def dtype(default=_REQUIRED):
+        import numpy as np
+
+        def _parse(v):
+            if v is None or (isinstance(v, str) and v in ("None", "")):
+                return None
+            if v == "bfloat16":
+                import jax.numpy as jnp
+
+                return np.dtype(jnp.bfloat16)
+            return np.dtype(v)
+
+        return Param(_parse, default)
+
+
+class Operator:
+    """A registered operator definition."""
+
+    def __init__(
+        self,
+        name,
+        forward,
+        arg_names=("data",),
+        aux_names=(),
+        num_outputs=1,
+        output_names=None,
+        params=None,
+        infer_shape=None,
+        infer_type=None,
+        stochastic=False,
+        key_var_num_args=None,
+        num_visible_outputs=None,
+        alias=(),
+    ):
+        self.name = name
+        self.forward = forward
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self._num_outputs = num_outputs
+        self._output_names = output_names
+        self.params = params or {}
+        self._infer_shape = infer_shape
+        self._infer_type = infer_type
+        self.stochastic = stochastic
+        # name of the attr carrying the variadic input count (nnvm key_var_num_args,
+        # e.g. Concat's num_args / add_n's num_args)
+        self.key_var_num_args = key_var_num_args
+        self._num_visible_outputs = num_visible_outputs
+        self.alias = alias
+
+    # ---- introspection ---------------------------------------------------
+    def arg_names(self, attrs):
+        a = self._arg_names
+        return list(a(attrs)) if callable(a) else list(a)
+
+    def aux_names(self, attrs):
+        a = self._aux_names
+        return list(a(attrs)) if callable(a) else list(a)
+
+    def num_outputs(self, attrs):
+        n = self._num_outputs
+        return n(attrs) if callable(n) else n
+
+    def num_visible_outputs(self, attrs):
+        n = self._num_visible_outputs
+        if n is None:
+            return self.num_outputs(attrs)
+        return n(attrs) if callable(n) else n
+
+    def output_names(self, attrs):
+        o = self._output_names
+        if o is None:
+            n = self.num_outputs(attrs)
+            return ["output"] if n == 1 else ["output%d" % i for i in range(n)]
+        return list(o(attrs)) if callable(o) else list(o)
+
+    # ---- attrs -----------------------------------------------------------
+    def canonicalize_attrs(self, raw):
+        """Parse raw attrs (strings from JSON or python values) against the schema.
+
+        Unknown keys that look like user attrs (``__key__``/``ctx_group``-style
+        graph attributes) are passed through untouched — the reference stores
+        those on the node, not the op param struct.
+        """
+        out = {}
+        extra = {}
+        for k, v in (raw or {}).items():
+            if k in self.params:
+                try:
+                    out[k] = self.params[k].parse(v)
+                except Exception as e:  # noqa: BLE001
+                    raise MXNetError(
+                        "op %s: cannot parse attr %s=%r: %s" % (self.name, k, v, e)
+                    ) from e
+            else:
+                extra[k] = v
+        for k, p in self.params.items():
+            if k not in out:
+                if p.required:
+                    raise MXNetError("op %s: required attr '%s' missing" % (self.name, k))
+                out[k] = p.default
+        return out, extra
+
+    # ---- inference -------------------------------------------------------
+    def infer_shape(self, attrs, in_shapes, aux_shapes=None):
+        """Return (in_shapes, out_shapes, aux_shapes); fills unknown (None) entries.
+
+        Reference semantics: nnvm InferShape pass (consumed at
+        src/executor/graph_executor.cc:428). Default: require all inputs known,
+        abstract-eval the forward.
+        """
+        if self._infer_shape is not None:
+            return self._infer_shape(attrs, list(in_shapes), list(aux_shapes or []))
+        if any(s is None for s in in_shapes):
+            raise MXNetError(
+                "op %s: cannot infer shapes with unknown inputs %s" % (self.name, in_shapes)
+            )
+        import numpy as np
+
+        out_shapes, out_dtypes, aux_s, _ = self.abstract_eval(
+            attrs, list(in_shapes), [np.float32] * len(in_shapes), list(aux_shapes or []), None
+        )
+        return list(in_shapes), out_shapes, aux_s
+
+    def infer_type(self, attrs, in_dtypes):
+        """Return (in_dtypes, out_dtypes, aux_dtypes) with Nones filled by
+        propagating the first known dtype (the reference's elemwise type rule,
+        src/operator/elemwise_op_common.h)."""
+        import numpy as np
+
+        if self._infer_type is not None:
+            return self._infer_type(attrs, list(in_dtypes))
+        known = [d for d in in_dtypes if d is not None]
+        fill = known[0] if known else np.float32
+        in_dtypes = [d if d is not None else fill for d in in_dtypes]
+        n_out = self.num_outputs(attrs)
+        out_dt = in_dtypes[0] if in_dtypes else np.float32
+        return in_dtypes, [out_dt] * n_out, []
+
+    def abstract_eval(self, attrs, in_shapes, in_dtypes, aux_shapes, aux_dtypes):
+        """jax.eval_shape over the forward: returns (out_shapes, out_dtypes,
+        new_aux_shapes, new_aux_dtypes)."""
+        import jax
+        import numpy as np
+
+        if aux_dtypes is None:
+            aux_dtypes = [np.float32] * len(aux_shapes)
+        args = [jax.ShapeDtypeStruct(tuple(s), d) for s, d in zip(in_shapes, in_dtypes)]
+        auxs = [jax.ShapeDtypeStruct(tuple(s), d) for s, d in zip(aux_shapes, aux_dtypes)]
+        octx = OpContext(is_train=True, rng=jax.ShapeDtypeStruct((2,), np.uint32) if self.stochastic else None)
+
+        def f(args, auxs, rng):
+            octx2 = OpContext(is_train=True, rng=rng)
+            return self.forward(octx2, attrs, args, auxs)
+
+        rng_arg = jax.ShapeDtypeStruct((2,), np.uint32) if self.stochastic else None
+        outs, new_auxs = jax.eval_shape(f, args, auxs, rng_arg)
+        return (
+            [tuple(o.shape) for o in outs],
+            [np.dtype(o.dtype) for o in outs],
+            [tuple(a.shape) for a in new_auxs],
+            [np.dtype(a.dtype) for a in new_auxs],
+        )
+
+
+def register(name, **kwargs):
+    """Register operator ``name`` with forward function decorated.
+
+    ::
+
+        @register("exp", arg_names=("data",))
+        def _exp(octx, attrs, args, auxs):
+            return [jnp.exp(args[0])], []
+    """
+
+    def _reg(fn):
+        op = Operator(name, fn, **kwargs)
+        _OP_REGISTRY[name] = op
+        for a in op.alias:
+            _OP_REGISTRY[a] = op
+        return fn
+
+    return _reg
+
+
+def register_simple(name, fn, arg_names=("data",), params=None, **kwargs):
+    """Register a stateless op from ``fn(attrs, *arrays) -> array-or-list``."""
+
+    @functools.wraps(fn)
+    def _fwd(octx, attrs, args, auxs):
+        out = fn(attrs, *args)
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        return list(out), []
+
+    op = Operator(name, _fwd, arg_names=arg_names, params=params, **kwargs)
+    _OP_REGISTRY[name] = op
+    for a in op.alias:
+        _OP_REGISTRY[a] = op
+    return op
+
+
+def get_op(name):
+    try:
+        return _OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError("Operator '%s' is not registered" % name) from None
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY.keys())

@@ -1,0 +1,206 @@
+"""Fused multi-layer RNN op — the TPU replacement for the cuDNN-only RNN.
+
+Reference: the `RNN` op is GPU-only there (src/operator/cudnn_rnn-inl.h:22,
+cudnnRNNForwardTraining :127; the CPU path is an empty TODO, src/operator/rnn.cc:14
+`LOG(FATAL) "RNN is only available for gpu"`). Here the fused RNN is a
+``jax.lax.scan`` over time — XLA compiles the whole unrolled recurrence into one
+executable with the gate matmuls batched onto the MXU, which is exactly what
+cudnnRNN does on GPU. Works on every backend.
+
+Parameter packing (documented contract, used by rnn.FusedRNNCell.unfuse too):
+for layer l in 0..L-1, for direction d (fwd, bwd):
+    i2h_weight (G*H, I_l), h2h_weight (G*H, H), i2h_bias (G*H,), h2h_bias (G*H,)
+flattened in that order and concatenated. Gate order: LSTM [i, f, c, o]
+(python/mxnet/rnn/rnn_cell.py LSTMCell order), GRU [r, z, n].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import Param, get_op, register
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    g = _gates(mode)
+    d = 2 if bidirectional else 1
+    total = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * d
+        total += d * (g * state_size * (isz + state_size) + 2 * g * state_size)
+    return total
+
+
+def _unpack_params(params, num_layers, input_size, state_size, bidirectional, mode):
+    g = _gates(mode)
+    d = 2 if bidirectional else 1
+    off = 0
+    layers = []
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * d
+        dirs = []
+        for _ in range(d):
+            n_i2h = g * state_size * isz
+            w_i2h = params[off : off + n_i2h].reshape(g * state_size, isz)
+            off += n_i2h
+            n_h2h = g * state_size * state_size
+            w_h2h = params[off : off + n_h2h].reshape(g * state_size, state_size)
+            off += n_h2h
+            b_i2h = params[off : off + g * state_size]
+            off += g * state_size
+            b_h2h = params[off : off + g * state_size]
+            off += g * state_size
+            dirs.append((w_i2h, w_h2h, b_i2h, b_h2h))
+        layers.append(dirs)
+    return layers
+
+
+def _cell_step(mode, state_size):
+    H = state_size
+
+    if mode == "lstm":
+
+        def step(carry, xw, w_h2h, b_h2h):
+            h, c = carry
+            gates = xw + jnp.dot(h, w_h2h.T) + b_h2h
+            i, f, g_, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g_ = jnp.tanh(g_)
+            c2 = f * c + i * g_
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+
+    elif mode == "gru":
+
+        def step(carry, xw, w_h2h, b_h2h):
+            (h,) = carry
+            hw = jnp.dot(h, w_h2h.T) + b_h2h
+            xr, xz, xn = jnp.split(xw, 3, axis=-1)
+            hr, hz, hn = jnp.split(hw, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h2 = (1 - z) * n + z * h
+            return (h2,), h2
+
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+        def step(carry, xw, w_h2h, b_h2h):
+            (h,) = carry
+            h2 = act(xw + jnp.dot(h, w_h2h.T) + b_h2h)
+            return (h2,), h2
+
+    return step
+
+
+def _run_layer(x, wp, init, mode, state_size, reverse=False):
+    """x: (T, N, I); returns (out (T,N,H), final_carry)."""
+    w_i2h, w_h2h, b_i2h, b_h2h = wp
+    # hoist the input projection out of the scan: one big MXU matmul over T*N
+    xw = jnp.einsum("tni,hi->tnh", x, w_i2h) + b_i2h
+    step = _cell_step(mode, state_size)
+
+    def body(carry, xw_t):
+        return step(carry, xw_t, w_h2h, b_h2h)
+
+    carry, out = jax.lax.scan(body, init, xw, reverse=reverse)
+    return out, carry
+
+
+@register(
+    "RNN",
+    arg_names=lambda attrs: ["data", "parameters", "state"]
+    + (["state_cell"] if attrs.get("mode") == "lstm" else []),
+    params={
+        "state_size": Param.int(),
+        "num_layers": Param.int(),
+        "bidirectional": Param.bool(False),
+        "mode": Param.str(),
+        "p": Param.float(0.0),
+        "state_outputs": Param.bool(False),
+        "pkeep_": Param.float(1.0),
+        "lstm_q_": Param.bool(False),
+    },
+    stochastic=True,
+    num_outputs=lambda attrs: 1
+    + (
+        (2 if attrs.get("mode") == "lstm" else 1)
+        if attrs.get("state_outputs")
+        else 0
+    ),
+    output_names=lambda attrs: ["output"]
+    + (
+        (["state_output", "statecell_output"] if attrs.get("mode") == "lstm" else ["state_output"])
+        if attrs.get("state_outputs")
+        else []
+    ),
+)
+def _rnn(octx, attrs, args, auxs):
+    mode = attrs["mode"]
+    H = attrs["state_size"]
+    L = attrs["num_layers"]
+    bidir = attrs["bidirectional"]
+    d = 2 if bidir else 1
+    x = args[0]
+    params = args[1]
+    h0 = args[2]  # (L*d, N, H)
+    c0 = args[3] if mode == "lstm" else None
+    T, N, I = x.shape
+    layers = _unpack_params(params, L, I, H, bidir, mode)
+    inp = x
+    h_finals, c_finals = [], []
+    key = octx.rng
+    for li, dirs in enumerate(layers):
+        outs = []
+        for di, wp in enumerate(dirs):
+            sidx = li * d + di
+            if mode == "lstm":
+                init = (h0[sidx], c0[sidx])
+            else:
+                init = (h0[sidx],)
+            out, carry = _run_layer(inp, wp, init, mode, H, reverse=(di == 1))
+            outs.append(out)
+            h_finals.append(carry[0])
+            if mode == "lstm":
+                c_finals.append(carry[1])
+        inp = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if attrs["p"] > 0 and octx.is_train and key is not None and li < L - 1:
+            key, sub = jax.random.split(key)
+            keep = 1.0 - attrs["p"]
+            mask = jax.random.bernoulli(sub, keep, inp.shape).astype(inp.dtype) / keep
+            inp = inp * jax.lax.stop_gradient(mask)
+    outputs = [inp]
+    if attrs["state_outputs"]:
+        outputs.append(jnp.stack(h_finals, axis=0))
+        if mode == "lstm":
+            outputs.append(jnp.stack(c_finals, axis=0))
+    return outputs, []
+
+
+def _rnn_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    if data is None:
+        raise MXNetError("RNN: data shape required")
+    T, N, I = data
+    H, L = attrs["state_size"], attrs["num_layers"]
+    d = 2 if attrs["bidirectional"] else 1
+    psize = rnn_param_size(L, I, H, attrs["bidirectional"], attrs["mode"])
+    shapes = [tuple(data), (psize,), (L * d, N, H)]
+    if attrs["mode"] == "lstm":
+        shapes.append((L * d, N, H))
+    outs = [(T, N, H * d)]
+    if attrs["state_outputs"]:
+        outs.append((L * d, N, H))
+        if attrs["mode"] == "lstm":
+            outs.append((L * d, N, H))
+    return shapes, outs, []
+
+
+get_op("RNN")._infer_shape = _rnn_infer_shape

@@ -1,0 +1,130 @@
+"""Sampling ops (reference: src/operator/tensor/sample_op.cc —
+uniform/normal/gamma/exponential/poisson/negative_binomial/generalized_nb,
+plus multinomial in sample_multinomial_op).
+
+TPU-native randomness: each stochastic op consumes an explicit threefry key from
+``OpContext.rng`` (split by the caller per invocation) instead of the reference's
+per-device stateful RNG resource (src/resource.cc:158). Inside compiled graphs
+the key is a real operand, so compiled training steps stay pure and replayable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Param, register
+
+
+def _shape_dtype(attrs):
+    shape = attrs["shape"] or ()
+    dt = attrs.get("dtype") or np.float32
+    return shape, dt
+
+
+def _reg_sampler(name, draw, params, aliases=()):
+    @register(
+        name,
+        arg_names=(),
+        params=dict(params, shape=Param.shape(()), dtype=Param.dtype(None)),
+        stochastic=True,
+        alias=aliases,
+    )
+    def _fwd(octx, attrs, args, auxs, _draw=draw):
+        shape, dt = _shape_dtype(attrs)
+        return [jax.lax.stop_gradient(_draw(octx.rng, attrs, shape, dt))], []
+
+    return _fwd
+
+
+_reg_sampler(
+    "_random_uniform",
+    lambda key, attrs, shape, dt: jax.random.uniform(
+        key, shape, dtype=dt, minval=attrs["low"], maxval=attrs["high"]
+    ),
+    {"low": Param.float(0.0), "high": Param.float(1.0)},
+    aliases=("random_uniform", "uniform", "_sample_uniform"),
+)
+
+_reg_sampler(
+    "_random_normal",
+    lambda key, attrs, shape, dt: attrs["loc"]
+    + attrs["scale"] * jax.random.normal(key, shape, dtype=dt),
+    {"loc": Param.float(0.0), "scale": Param.float(1.0)},
+    aliases=("random_normal", "normal", "_sample_normal"),
+)
+
+_reg_sampler(
+    "_random_gamma",
+    lambda key, attrs, shape, dt: attrs["beta"]
+    * jax.random.gamma(key, attrs["alpha"], shape, dtype=dt),
+    {"alpha": Param.float(1.0), "beta": Param.float(1.0)},
+    aliases=("random_gamma",),
+)
+
+_reg_sampler(
+    "_random_exponential",
+    lambda key, attrs, shape, dt: jax.random.exponential(key, shape, dtype=dt) / attrs["lam"],
+    {"lam": Param.float(1.0)},
+    aliases=("random_exponential",),
+)
+
+_reg_sampler(
+    "_random_poisson",
+    lambda key, attrs, shape, dt: jax.random.poisson(key, attrs["lam"], shape).astype(dt),
+    {"lam": Param.float(1.0)},
+    aliases=("random_poisson",),
+)
+
+_reg_sampler(
+    "_random_negative_binomial",
+    lambda key, attrs, shape, dt: _neg_binomial(key, attrs["k"], attrs["p"], shape).astype(dt),
+    {"k": Param.int(1), "p": Param.float(1.0)},
+    aliases=("random_negative_binomial",),
+)
+
+_reg_sampler(
+    "_random_randint",
+    lambda key, attrs, shape, dt: jax.random.randint(
+        key, shape, int(attrs["low"]), int(attrs["high"])
+    ).astype(dt if dt is not None else np.int32),
+    {"low": Param.float(0.0), "high": Param.float(1.0)},
+    aliases=("random_randint",),
+)
+
+
+def _neg_binomial(key, k, p, shape):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape)
+
+
+@register(
+    "_sample_multinomial",
+    arg_names=("data",),
+    params={"shape": Param.shape(()), "get_prob": Param.bool(False), "dtype": Param.dtype(None)},
+    stochastic=True,
+    num_outputs=lambda attrs: 2 if attrs.get("get_prob") else 1,
+    alias=("sample_multinomial",),
+)
+def _multinomial(octx, attrs, args, auxs):
+    probs = args[0]
+    shape = attrs["shape"] or ()
+    n = int(np.prod(shape)) if shape else 1
+    logits = jnp.log(jnp.maximum(probs, 1e-37))
+    if probs.ndim == 1:
+        draw = jax.random.categorical(octx.rng, logits, shape=(n,)).reshape(shape or ())
+    else:
+        draw = jax.random.categorical(octx.rng, logits[:, None, :], axis=-1, shape=(probs.shape[0], n))
+        draw = draw.reshape((probs.shape[0],) + (shape or ()))
+    dt = attrs.get("dtype") or np.int32
+    outs = [jax.lax.stop_gradient(draw.astype(dt))]
+    if attrs["get_prob"]:
+        if probs.ndim == 1:
+            lp = jnp.log(jnp.maximum(probs, 1e-37))[draw]
+        else:
+            lp = jnp.take_along_axis(
+                jnp.log(jnp.maximum(probs, 1e-37)), draw.reshape(probs.shape[0], -1), axis=1
+            ).reshape(outs[0].shape)
+        outs.append(lp)
+    return outs, []

@@ -1,0 +1,510 @@
+"""Optimizers (reference: python/mxnet/optimizer.py — registry :10/create :99,
+SGD :307 calling fused sgd_update/sgd_mom_update ops :351-355, NAG, SGLD, DCASGD,
+Adam :485, AdaGrad :538, RMSProp :575, AdaDelta :651, Ftrl :700, Test :753, and
+the Updater :769 with state checkpointing).
+
+The fused-update-op pattern survives: SGD/Adam/RMSProp call the registered
+optimizer ops (ops/optimizer_ops.py), each one jitted XLA program per
+shape — and when driven through a compiled train step the update fuses with the
+backward pass entirely.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+
+import numpy as np
+
+from . import ndarray as nd
+from .ndarray import NDArray, zeros
+from .base import MXNetError
+
+__all__ = [
+    "Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "ccSGD", "Adam", "AdaGrad",
+    "RMSProp", "AdaDelta", "Ftrl", "Test", "Updater", "get_updater", "create", "register",
+]
+
+
+class Optimizer:
+    """Base optimizer with lr/wd multiplier resolution and the op registry
+    (reference: optimizer.py:10-300)."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("WARNING: New optimizer %s is overriding existing optimizer", name)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None, sym=None,
+                 begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise MXNetError("param_idx2name should be a dict of param indexes to names.")
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_scale(self, args_lrscale):
+        raise DeprecationWarning
+
+    def set_lr_mult(self, args_lr_mult):
+        """(reference: optimizer.py set_lr_mult — pulls __lr_mult__ attrs from sym)"""
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """Defaults: no wd on bias/gamma/beta (reference: optimizer.py set_wd_mult)."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _clipped(grad_np, rescale, clip):
+    g = grad_np * rescale
+    if clip is not None:
+        g = np.clip(g, -clip, clip)
+    return g
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum via the fused ops (reference: optimizer.py:307-355)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = {
+            "lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+            "clip_gradient": self.clip_gradient if self.clip_gradient is not None else -1.0,
+        }
+        if state is not None:
+            res_w, res_m = _invoke_all(
+                "sgd_mom_update", [weight, grad, state], dict(kwargs, momentum=self.momentum)
+            )
+            weight._set_data(res_w)
+            state._set_data(res_m)
+        else:
+            res_w, = _invoke_all("sgd_update", [weight, grad], kwargs)
+            weight._set_data(res_w)
+
+
+def _invoke_all(op_name, ndargs, attrs):
+    """Run a registered op returning ALL outputs (including hidden state
+    outputs) as raw jax arrays — used by optimizers to write back mutated
+    weights/states (FMutateInputs semantics)."""
+    from .ops.registry import get_op
+    from .ndarray import _get_jitted
+
+    op = get_op(op_name)
+    cattrs, _ = op.canonicalize_attrs(attrs)
+    args = [a.data for a in ndargs]
+    fn = _get_jitted(op, cattrs, len(args), 0, False)
+    outs, _ = fn(args, [], None)
+    return outs
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference: optimizer.py NAG)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            g += wd * weight
+            mom += g
+            g += self.momentum * mom
+            weight += -lr * g
+        else:
+            weight += -lr * (g + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: optimizer.py SGLD)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        noise = nd.random_normal(loc=0.0, scale=math.sqrt(lr), shape=weight.shape, ctx=weight.context)
+        weight += -lr / 2 * (g + wd * weight) + noise
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        mon, previous_weight = state
+        if mon:
+            mon *= self.momentum
+            mon += -lr * (g + wd * weight + self.lamda * g * g * (weight - previous_weight))
+        else:
+            assert self.momentum == 0.0
+            mon = -lr * (g + wd * weight + self.lamda * g * g * (weight - previous_weight))
+        previous_weight[:] = weight
+        weight += mon
+
+
+@register
+class ccSGD(SGD):
+    """Alias of SGD in this build (reference keeps it for compat)."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam via fused op (reference: optimizer.py:485)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, weight.context, dtype=weight.dtype),  # mean
+            zeros(weight.shape, weight.context, dtype=weight.dtype),  # variance
+        )
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        res_w, res_m, res_v = _invoke_all(
+            "adam_update",
+            [weight, grad, mean, var],
+            {
+                "lr": lr_t, "wd": wd, "rescale_grad": self.rescale_grad,
+                "clip_gradient": self.clip_gradient if self.clip_gradient is not None else -1.0,
+                "beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon,
+            },
+        )
+        weight._set_data(res_w)
+        mean._set_data(res_m)
+        var._set_data(res_v)
+
+
+@register
+class AdaGrad(Optimizer):
+    """(reference: optimizer.py:538)"""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        history = state
+        history += g * g
+        weight += -lr * (g / nd.sqrt(history + self.float_stable_eps) + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp(+Alex Graves variant) via fused ops (reference: optimizer.py:575)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (
+                zeros(weight.shape, weight.context),  # n
+                zeros(weight.shape, weight.context),  # g
+                zeros(weight.shape, weight.context),  # delta
+            )
+        return (zeros(weight.shape, weight.context),)  # n
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = {
+            "lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+            "clip_gradient": self.clip_gradient if self.clip_gradient is not None else -1.0,
+            "gamma1": self.gamma1, "epsilon": self.epsilon,
+        }
+        if not self.centered:
+            (n,) = state
+            res_w, res_n = _invoke_all("rmsprop_update", [weight, grad, n], kwargs)
+            weight._set_data(res_w)
+            n._set_data(res_n)
+        else:
+            n, g, delta = state
+            kwargs["gamma2"] = self.gamma2
+            res_w, res_n, res_g, res_d = _invoke_all(
+                "rmspropalex_update", [weight, grad, n, g, delta], kwargs
+            )
+            weight._set_data(res_w)
+            n._set_data(res_n)
+            g._set_data(res_g)
+            delta._set_data(res_d)
+        if self.clip_weights:
+            weight._set_data(nd.clip(weight, -self.clip_weights, self.clip_weights).data)
+
+
+@register
+class AdaDelta(Optimizer):
+    """(reference: optimizer.py:651)"""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, weight.context),  # accumulated g
+            zeros(weight.shape, weight.context),  # accumulated delta
+        )
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g *= self.rho
+        acc_g += (1.0 - self.rho) * g * g
+        current_delta = nd.sqrt(acc_delta + self.epsilon) / nd.sqrt(acc_g + self.epsilon) * g
+        acc_delta *= self.rho
+        acc_delta += (1.0 - self.rho) * current_delta * current_delta
+        weight[:] = (weight - current_delta - wd * weight).data
+
+
+@register
+class Ftrl(Optimizer):
+    """(reference: optimizer.py:700)"""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, weight.context),  # z
+            zeros(weight.shape, weight.context),  # n
+        )
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        z, n = state
+        z += g - (nd.sqrt(n + g * g) - nd.sqrt(n)) / lr * weight
+        n += g * g
+        w_np = (
+            (nd.sign(z) * self.lamda1 - z)
+            / ((self.beta + nd.sqrt(n)) / lr + wd)
+            * (nd.abs(z) > self.lamda1)
+        )
+        weight[:] = w_np.data
+
+
+@register
+class Test(Optimizer):
+    """Trivial updater used by kvstore tests (reference: optimizer.py:753)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight[:] = (weight + grad * self.rescale_grad).data
+        state[:] = weight
+
+
+class Updater:
+    """Weight updater with per-index state (reference: optimizer.py:769;
+    get_states/set_states power optimizer-state checkpointing, module.py:134)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+            self.states_synced[index] = True
+        elif not self.states_synced[index]:
+            self.states[index] = self.sync_state_context(self.states[index], weight.context)
+            self.states_synced[index] = True
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, (tuple, list)):
+            return type(state)(self.sync_state_context(i, context) for i in state)
+        return state
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        self.states = {
+            k: self._from_np(v) for k, v in states.items()
+        }
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self):
+        return pickle.dumps({k: self._to_np(v) for k, v in self.states.items()})
+
+    @staticmethod
+    def _to_np(state):
+        if isinstance(state, NDArray):
+            return state.asnumpy()
+        if isinstance(state, (tuple, list)):
+            return type(state)(Updater._to_np(i) for i in state)
+        return state
+
+    @staticmethod
+    def _from_np(state):
+        if isinstance(state, np.ndarray):
+            return nd.array(state)
+        if isinstance(state, (tuple, list)):
+            return type(state)(Updater._from_np(i) for i in state)
+        return state
+
+
+def get_updater(optimizer):
+    """(reference: optimizer.py get_updater)"""
+    return Updater(optimizer)

@@ -1,0 +1,11 @@
+"""Parallelism utilities — the TPU-native replacement for the reference's
+multi-device Comm (src/kvstore/comm.h) and ps-lite distributed tier.
+
+* mesh.py — jax.sharding.Mesh construction helpers (dp/tp/pp/sp axes).
+* spmd.py — SPMD fused train step: whole fwd+bwd+allreduce+update as ONE
+  compiled program over the mesh (psum rides ICI). This is the performance
+  path that replaces per-device executors + kvstore push/pull.
+* ring.py — ring attention (sequence parallelism) over ppermute.
+"""
+from .mesh import build_mesh, local_mesh  # noqa: F401
+from .spmd import SPMDTrainer  # noqa: F401

@@ -1,0 +1,187 @@
+"""SPMD fused training — the TPU-native data-parallel fast path.
+
+The reference's data parallelism is: per-GPU executors + gradient gather to an
+owner device + updater + broadcast (module/executor_group.py + kvstore comm.h).
+On TPU the idiomatic equivalent is ONE program: jit the whole
+forward+backward+update over a ``Mesh`` with the batch sharded on the ``dp``
+axis and params replicated; XLA's SPMD partitioner inserts the gradient
+all-reduce (psum over ICI) automatically and fuses it with the optimizer
+update. Per-step host work drops to a single dispatch — no push/pull, no
+per-device python loop.
+
+Used by Module's fused path, the benchmark driver, and dryrun_multichip.
+Tensor-parallel sharding: pass ``param_rules`` mapping parameter-name regex →
+PartitionSpec to shard weights over a 'tp' axis (e.g. the FC head of ResNet or
+attention/FFN blocks); everything unmatched stays replicated.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..executor import build_graph_fn
+from ..ops.registry import get_op
+
+__all__ = ["SPMDTrainer"]
+
+
+class SPMDTrainer:
+    def __init__(self, symbol, mesh, data_shapes, optimizer="sgd", optimizer_params=None,
+                 label_shapes=None, dtype=np.float32, param_rules=None, batch_axis="dp",
+                 donate=True):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.symbol = symbol
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self._graph_fn, self.arg_names, self.aux_names = build_graph_fn(symbol)
+        self.data_names = [n for n, _ in data_shapes]
+        self.label_names = [n for n, _ in (label_shapes or [])]
+        self.param_names = [
+            n for n in self.arg_names if n not in self.data_names + self.label_names
+        ]
+        shapes = dict(data_shapes)
+        shapes.update(dict(label_shapes or []))
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
+        self.arg_shapes = dict(zip(self.arg_names, arg_shapes))
+        self.aux_shapes = dict(zip(self.aux_names, aux_shapes))
+        self.out_shapes = out_shapes
+        opt_params = dict(optimizer_params or {})
+        self.lr = opt_params.get("learning_rate", 0.01)
+        self.momentum = opt_params.get("momentum", 0.0)
+        self.wd = opt_params.get("wd", 0.0)
+        self.rescale_grad = opt_params.get("rescale_grad", 1.0)
+        self.dtype = dtype
+        self._param_rules = [(re.compile(k), v) for k, v in (param_rules or {}).items()]
+        self._loss_flags = self._detect_loss_outputs()
+
+        # shardings
+        self._P = P
+        self.repl = NamedSharding(mesh, P())
+        self.batch_sharding = NamedSharding(mesh, P(batch_axis))
+        self.param_shardings = {
+            n: NamedSharding(mesh, self._spec_for(n)) for n in self.param_names
+        }
+        self._step_fn = None
+        self._donate = donate
+
+    def _spec_for(self, name):
+        for prog, spec in self._param_rules:
+            if prog.match(name):
+                return self._P(*spec) if isinstance(spec, (tuple, list)) else spec
+        return self._P()
+
+    def _detect_loss_outputs(self):
+        flags = []
+        for node, _ in self.symbol._entries:
+            flags.append(
+                False if node.is_variable else getattr(get_op(node.op), "is_loss", False)
+            )
+        return flags
+
+    # ------------------------------------------------------------------
+    def init_params(self, initializer):
+        """Initialize replicated/sharded param dict + aux dict."""
+        import jax
+
+        from .. import ndarray as nd
+
+        params = {}
+        for n in self.param_names:
+            host = nd.zeros(self.arg_shapes[n])
+            initializer(n, host)
+            params[n] = jax.device_put(
+                host.asnumpy().astype(self.dtype), self.param_shardings[n]
+            )
+        auxs = {}
+        for n in self.aux_names:
+            host = nd.zeros(self.aux_shapes[n])
+            initializer(n, host)
+            auxs[n] = jax.device_put(host.asnumpy().astype(np.float32), self.repl)
+        moms = {
+            n: jax.device_put(
+                np.zeros(self.arg_shapes[n], self.dtype), self.param_shardings[n]
+            )
+            for n in self.param_names
+        } if self.momentum else {}
+        return params, auxs, moms
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        if self._step_fn is not None:
+            return self._step_fn
+        arg_order = self.arg_names
+        aux_order = self.aux_names
+        data_set = set(self.data_names + self.label_names)
+
+        def assemble(params, inputs):
+            return [params[n] if n not in data_set else inputs[n] for n in arg_order]
+
+        loss_flags = self._loss_flags
+        lr, momentum, wd, rescale = self.lr, self.momentum, self.wd, self.rescale_grad
+        graph_fn = self._graph_fn
+
+        def step(params, auxs, moms, inputs, rng):
+            aux_list = [auxs[n] for n in aux_order]
+
+            def f(p):
+                outs, new_aux = graph_fn(assemble(p, inputs), aux_list, rng, True)
+                return outs, new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
+            seeds = [
+                jnp.full(o.shape, 1.0 if fl else 0.0, o.dtype)
+                for o, fl in zip(outs, loss_flags)
+            ]
+            grads = vjp_fn(list(seeds))[0]
+            new_params = {}
+            new_moms = {}
+            for n in params:
+                g = grads[n].astype(params[n].dtype) * rescale + wd * params[n]
+                if momentum:
+                    m = momentum * moms[n] - lr * g
+                    new_moms[n] = m
+                    new_params[n] = params[n] + m
+                else:
+                    new_params[n] = params[n] - lr * g
+            new_auxs = dict(zip(aux_order, new_aux))
+            return new_params, new_auxs, new_moms, outs
+
+        donate = (0, 2) if self._donate else ()
+        self._step_fn = jax.jit(step, donate_argnums=donate)
+        return self._step_fn
+
+    def step(self, params, auxs, moms, inputs_np, rng=None):
+        """One fused train step. inputs_np: dict name->np array (global batch).
+        Returns (params, auxs, moms, outputs)."""
+        import jax
+
+        from .. import random as _random
+
+        if rng is None:
+            rng = _random.next_key()
+        inputs = {
+            n: jax.device_put(v, self.batch_sharding) for n, v in inputs_np.items()
+        }
+        return self._build_step()(params, auxs, moms, inputs, rng)
+
+    def eval_step_fn(self):
+        """Jitted inference fn(params, auxs, inputs) -> outputs."""
+        import jax
+
+        arg_order = self.arg_names
+        aux_order = self.aux_names
+        data_set = set(self.data_names + self.label_names)
+        graph_fn = self._graph_fn
+
+        def fwd(params, auxs, inputs):
+            args = [params[n] if n not in data_set else inputs.get(n) for n in arg_order]
+            aux_list = [auxs[n] for n in aux_order]
+            outs, _ = graph_fn(args, aux_list, None, False)
+            return outs
+
+        return jax.jit(fwd)

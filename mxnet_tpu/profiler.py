@@ -1,0 +1,90 @@
+"""Profiler (reference: python/mxnet/profiler.py:10-38 + src/engine/profiler.h —
+per-op records dumped as chrome://tracing JSON).
+
+TPU design: per-op wall timing is meaningless under whole-graph XLA fusion, so
+this profiler has two tiers:
+* device tier — delegates to jax.profiler (XLA's own tracing: HLO-level timeline
+  viewable in TensorBoard/Perfetto), started/stopped by the same
+  profiler_set_state API the reference exposes;
+* python tier — records imperative-op dispatch + executor step spans into a
+  chrome-tracing JSON file, matching the reference's dump format
+  (profiler.h EmitEvent :107).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile"]
+
+_state = {
+    "mode": "symbolic",
+    "filename": "profile.json",
+    "running": False,
+    "events": [],
+    "jax_trace_dir": None,
+    "lock": threading.Lock(),
+}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """(reference: profiler.py profiler_set_config; modes 'symbolic'|'all')"""
+    _state["mode"] = mode
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """'run' | 'stop' (reference: profiler.py profiler_set_state)."""
+    if state == "run" and not _state["running"]:
+        _state["running"] = True
+        _state["events"] = []
+        trace_dir = os.environ.get("MXNET_PROFILER_TRACE_DIR")
+        if trace_dir:
+            import jax
+
+            jax.profiler.start_trace(trace_dir)
+            _state["jax_trace_dir"] = trace_dir
+    elif state == "stop" and _state["running"]:
+        _state["running"] = False
+        if _state["jax_trace_dir"]:
+            import jax
+
+            jax.profiler.stop_trace()
+            _state["jax_trace_dir"] = None
+    else:
+        return
+
+
+def record_span(name, category="operator"):
+    """Context manager recording one span while the profiler runs."""
+
+    class _Span:
+        def __enter__(self):
+            self.t0 = time.time()
+            return self
+
+        def __exit__(self, *a):
+            if _state["running"]:
+                with _state["lock"]:
+                    _state["events"].append(
+                        {
+                            "name": name,
+                            "cat": category,
+                            "ph": "X",
+                            "ts": self.t0 * 1e6,
+                            "dur": (time.time() - self.t0) * 1e6,
+                            "pid": os.getpid(),
+                            "tid": threading.get_ident() % (1 << 16),
+                        }
+                    )
+
+    return _Span()
+
+
+def dump_profile():
+    """Write accumulated spans as chrome://tracing JSON
+    (reference: MXDumpProfile → Profiler::DumpProfile, profiler.h:88)."""
+    with open(_state["filename"], "w") as f:
+        json.dump({"traceEvents": _state["events"], "displayTimeUnit": "ms"}, f)

@@ -1,0 +1,64 @@
+"""Random state (reference: python/mxnet/random.py, src/resource.cc ResourceRandom).
+
+The reference seeds a per-device RNG resource (`ResourceManagerImpl::ResourceRandom`,
+src/resource.cc:158) consumed by sampling ops. On TPU randomness is functional:
+jax threefry keys. This module owns the process-global key chain — ``mx.random.seed``
+resets it; every imperative sampling call and every stochastic executor forward
+splits a fresh subkey from it, which preserves the reference's "seed once,
+reproducible stream" contract while staying jit-friendly (keys are explicit
+operands, never hidden state inside a compiled graph).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key", "uniform", "normal", "randint"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _key():
+    import jax
+
+    if getattr(_state, "key", None) is None:
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed the global random number generators
+    (reference: python/mxnet/random.py:45 mx.random.seed)."""
+    import jax
+
+    if not isinstance(seed_state, int):
+        raise ValueError("sd must be int")
+    _state.key = jax.random.PRNGKey(seed_state)
+
+
+def next_key():
+    """Split and return a fresh subkey from the global chain."""
+    import jax
+
+    k = _key()
+    _state.key, sub = jax.random.split(k)
+    return sub
+
+
+# Imperative samplers (mx.random.uniform / normal); also exposed as nd.random_*.
+def uniform(low=0, high=1, shape=None, dtype=None, ctx=None, out=None):
+    from . import ndarray as nd
+
+    return nd.random_uniform(low=low, high=high, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def normal(loc=0, scale=1, shape=None, dtype=None, ctx=None, out=None):
+    from . import ndarray as nd
+
+    return nd.random_normal(loc=loc, scale=scale, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    from . import ndarray as nd
+
+    return nd.random_randint(low=low, high=high, shape=shape, dtype=dtype, ctx=ctx, out=out)

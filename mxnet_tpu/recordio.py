@@ -1,0 +1,244 @@
+"""RecordIO file format (reference: python/mxnet/recordio.py — MXRecordIO :19,
+MXIndexedRecordIO :153, IRHeader, pack/unpack/pack_img :400; binary layout from
+dmlc-core recordio: [kMagic uint32][lrecord uint32][data][pad to 4B]).
+
+Wire-compatible with the reference's .rec files (same magic 0xced7230a, same
+continuation encoding), so datasets packed by the reference's im2rec tooling
+load here unchanged.
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack", "unpack_img", "pack_img"]
+
+_kMagic = 0xCED7230A
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return (lrec >> 29) & 7, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (reference: recordio.py:19)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.fid = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fid = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fid = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+
+    def close(self):
+        if self.fid is not None:
+            self.fid.close()
+            self.fid = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["fid"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fid.tell()
+
+    def write(self, buf):
+        assert self.writable
+        # split into ≤2^29-1 chunks with continuation flags like dmlc recordio
+        max_len = (1 << 29) - 1
+        n = len(buf)
+        if n <= max_len:
+            self.fid.write(struct.pack("<II", _kMagic, _encode_lrec(0, n)))
+            self.fid.write(buf)
+            pad = (4 - n % 4) % 4
+            self.fid.write(b"\x00" * pad)
+            return
+        off = 0
+        nchunk = (n + max_len - 1) // max_len
+        for i in range(nchunk):
+            chunk = buf[off : off + max_len]
+            cflag = 1 if i == 0 else (2 if i == nchunk - 1 else 3)
+            self.fid.write(struct.pack("<II", _kMagic, _encode_lrec(cflag, len(chunk))))
+            self.fid.write(chunk)
+            pad = (4 - len(chunk) % 4) % 4
+            self.fid.write(b"\x00" * pad)
+            off += len(chunk)
+
+    def read(self):
+        assert not self.writable
+        parts = []
+        while True:
+            header = self.fid.read(8)
+            if len(header) < 8:
+                return None if not parts else b"".join(parts)
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _kMagic:
+                raise MXNetError("Invalid RecordIO magic in %s" % self.uri)
+            cflag, length = _decode_lrec(lrec)
+            data = self.fid.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.fid.read(pad)
+            parts.append(data)
+            if cflag in (0, 2):
+                return b"".join(parts)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access .rec via .idx file (reference: recordio.py:153)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.fid is None:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        self.fid.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack header+payload into a record string (reference: recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label, header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label, header.id, header.id2)
+        packed += label.tobytes()
+    return packed + s
+
+
+def unpack(s):
+    """(reference: recordio.py unpack)"""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[: header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4 :]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """(reference: recordio.py unpack_img). Uses cv2 if available, else PIL/raw."""
+    header, s = unpack(s)
+    img = _imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """(reference: recordio.py:400 pack_img)"""
+    encoded = _imencode(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def _imdecode(buf, iscolor=-1):
+    try:
+        import cv2
+
+        return cv2.imdecode(buf, iscolor)
+    except ImportError:
+        pass
+    from io import BytesIO
+
+    from PIL import Image
+
+    img = np.array(Image.open(BytesIO(buf.tobytes())))
+    if img.ndim == 3:
+        img = img[:, :, ::-1]  # RGB->BGR to match cv2 convention
+    return img
+
+
+def _imencode(img, quality=95, img_fmt=".jpg"):
+    try:
+        import cv2
+
+        ret, buf = cv2.imencode(img_fmt, img, [cv2.IMWRITE_JPEG_QUALITY, quality])
+        assert ret, "failed to encode image"
+        return buf.tobytes()
+    except ImportError:
+        pass
+    from io import BytesIO
+
+    from PIL import Image
+
+    arr = img[:, :, ::-1] if img.ndim == 3 else img
+    bio = BytesIO()
+    fmt = "JPEG" if "jpg" in img_fmt or "jpeg" in img_fmt else "PNG"
+    Image.fromarray(arr).save(bio, format=fmt, quality=quality)
+    return bio.getvalue()

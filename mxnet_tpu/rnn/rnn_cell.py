@@ -1,0 +1,704 @@
+"""RNN cells (reference: python/mxnet/rnn/rnn_cell.py — BaseRNNCell :90 with
+unroll :274 explicit graph unrolling, RNNCell :341, LSTMCell :389, GRUCell :452,
+FusedRNNCell :521 wrapping the fused RNN op, SequentialRNNCell :709,
+modifier cells :787-935, BidirectionalCell :937).
+
+TPU note: ``FusedRNNCell`` wraps the lax.scan fused RNN op (ops/rnn_ops.py) —
+whereas the reference's fused path was cuDNN-only. ``unfuse()`` produces the
+equivalent stacked cells using the documented parameter packing.
+"""
+from __future__ import annotations
+
+from .. import ndarray
+from .. import symbol
+from ..base import MXNetError, string_types
+from ..ops.rnn_ops import rnn_param_size
+
+__all__ = [
+    "RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
+    "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell", "BidirectionalCell",
+    "ModifierCell",
+]
+
+
+class RNNParams:
+    """Container for holding variables (reference: rnn_cell.py:55)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """Abstract base class for RNN cells (reference: rnn_cell.py:90)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError()
+
+    @property
+    def state_shape(self):
+        return [ele["shape"] for ele in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        """Initial states (reference: rnn_cell.py begin_state)."""
+        assert not self._modified, (
+            "After applying modifier cells the base cell cannot be called directly. "
+            "Call the modifier cell instead."
+        )
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            if info is None:
+                state = func(name="%sbegin_state_%d" % (self._prefix, self._init_counter), **kwargs)
+            else:
+                kwargs.update(info)
+                state = func(name="%sbegin_state_%d" % (self._prefix, self._init_counter), **kwargs)
+            states.append(state)
+        return states
+
+    def unpack_weights(self, args):
+        """Unpack fused weight matrices into separate gate arrays
+        (reference: rnn_cell.py unpack_weights)."""
+        args = args.copy()
+        if not self._gate_names:
+            return args
+        h = self._num_hidden
+        for group_name in ["i2h", "h2h"]:
+            weight = args.pop("%s%s_weight" % (self._prefix, group_name))
+            bias = args.pop("%s%s_bias" % (self._prefix, group_name))
+            for j, gate in enumerate(self._gate_names):
+                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
+                args[wname] = ndarray.array(weight.asnumpy()[j * h : (j + 1) * h])
+                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
+                args[bname] = ndarray.array(bias.asnumpy()[j * h : (j + 1) * h])
+        return args
+
+    def pack_weights(self, args):
+        """(reference: rnn_cell.py pack_weights)"""
+        import numpy as np
+
+        args = args.copy()
+        if not self._gate_names:
+            return args
+        for group_name in ["i2h", "h2h"]:
+            weight = []
+            bias = []
+            for gate in self._gate_names:
+                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
+                weight.append(args.pop(wname).asnumpy())
+                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
+                bias.append(args.pop(bname).asnumpy())
+            args["%s%s_weight" % (self._prefix, group_name)] = ndarray.array(np.concatenate(weight))
+            args["%s%s_bias" % (self._prefix, group_name)] = ndarray.array(np.concatenate(bias))
+        return args
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        """Explicitly unroll the recurrence into a graph
+        (reference: rnn_cell.py:274)."""
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, False, input_prefix)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        outputs, _ = _normalize_sequence(length, outputs, layout, merge_outputs)
+        return outputs, states
+
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, string_types):
+            return symbol.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+def _normalize_sequence(length, inputs, layout, merge, input_prefix=""):
+    """(reference: rnn_cell.py _normalize_sequence)"""
+    assert inputs is not None or not merge
+    if inputs is None:
+        inputs = [
+            symbol.Variable("%st%d_data" % (input_prefix, i)) for i in range(length)
+        ]
+    axis = layout.find("T")
+    if isinstance(inputs, symbol.Symbol):
+        if merge is False:
+            assert len(inputs.list_outputs()) == 1, (
+                "unroll doesn't allow grouped symbol as input. Please "
+                "convert to list first or let unroll handle slicing"
+            )
+            inputs = list(
+                symbol.SliceChannel(inputs, axis=axis, num_outputs=length, squeeze_axis=1)
+            )
+    else:
+        assert length is None or len(inputs) == length
+        if merge is True:
+            inputs = [symbol.expand_dims(i, axis=axis) for i in inputs]
+            inputs = symbol.Concat(*inputs, dim=axis)
+    return inputs, axis
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN cell (reference: rnn_cell.py:341)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(
+            inputs, self._iW, self._iB, num_hidden=self._num_hidden, name="%si2h" % name
+        )
+        h2h = symbol.FullyConnected(
+            states[0], self._hW, self._hB, num_hidden=self._num_hidden, name="%sh2h" % name
+        )
+        output = self._get_activation(i2h + h2h, self._activation, name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell, gate order i,f,c,o (reference: rnn_cell.py:389)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None, forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        from .. import initializer as init_mod
+
+        self._iB = self.params.get(
+            "i2h_bias", init=init_mod.LSTMBias(forget_bias=forget_bias)
+        )
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [
+            {"shape": (0, self._num_hidden), "__layout__": "NC"},
+            {"shape": (0, self._num_hidden), "__layout__": "NC"},
+        ]
+
+    @property
+    def _gate_names(self):
+        return ["_i", "_f", "_c", "_o"]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(
+            inputs, self._iW, self._iB, num_hidden=self._num_hidden * 4, name="%si2h" % name
+        )
+        h2h = symbol.FullyConnected(
+            states[0], self._hW, self._hB, num_hidden=self._num_hidden * 4, name="%sh2h" % name
+        )
+        gates = i2h + h2h
+        slice_gates = symbol.SliceChannel(gates, num_outputs=4, name="%sslice" % name)
+        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid", name="%si" % name)
+        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid", name="%sf" % name)
+        in_transform = symbol.Activation(slice_gates[2], act_type="tanh", name="%sc" % name)
+        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid", name="%so" % name)
+        next_c = symbol._plus(
+            forget_gate * states[1], in_gate * in_transform, name="%sstate" % name
+        )
+        next_h = symbol._mul(
+            out_gate, symbol.Activation(next_c, act_type="tanh"), name="%sout" % name
+        )
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell, gate order r,z,n (reference: rnn_cell.py:452)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ["_r", "_z", "_o"]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        seq_idx = self._counter
+        name = "%st%d_" % (self._prefix, seq_idx)
+        prev_state_h = states[0]
+        i2h = symbol.FullyConnected(
+            inputs, self._iW, self._iB, num_hidden=self._num_hidden * 3, name="%s_i2h" % name
+        )
+        h2h = symbol.FullyConnected(
+            prev_state_h, self._hW, self._hB, num_hidden=self._num_hidden * 3, name="%s_h2h" % name
+        )
+        i2h_r, i2h_z, i2h = symbol.SliceChannel(i2h, num_outputs=3, name="%s_i2h_slice" % name)
+        h2h_r, h2h_z, h2h = symbol.SliceChannel(h2h, num_outputs=3, name="%s_h2h_slice" % name)
+        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid", name="%s_r_act" % name)
+        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid", name="%s_z_act" % name)
+        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h, act_type="tanh", name="%s_h_act" % name)
+        next_h = symbol._plus(
+            (1.0 - update_gate) * next_h_tmp, update_gate * prev_state_h, name="%sout" % name
+        )
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer RNN over the scan-based RNN op
+    (reference: rnn_cell.py:521, which wraps cuDNN RNN)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm", bidirectional=False,
+                 dropout=0.0, get_next_state=False, forget_bias=1.0,
+                 prefix=None, params=None):
+        if prefix is None:
+            prefix = "%s_" % mode
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._directions = ["l", "r"] if bidirectional else ["l"]
+        from .. import initializer as init_mod
+
+        initializer = init_mod.FusedRNN(
+            None, num_hidden, num_layers, mode, bidirectional, forget_bias
+        )
+        self._parameter = self.params.get("parameters", init=initializer)
+
+    @property
+    def state_info(self):
+        b = self._bidirectional + 1
+        n = (self._mode == "lstm") + 1
+        return [
+            {"shape": (b * self._num_layers, 0, self._num_hidden), "__layout__": "LNC"}
+            for _ in range(n)
+        ]
+
+    @property
+    def _gate_names(self):
+        return {
+            "rnn_relu": [""], "rnn_tanh": [""],
+            "lstm": ["_i", "_f", "_c", "_o"], "gru": ["_r", "_z", "_o"],
+        }[self._mode]
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("FusedRNNCell cannot be stepped. Please use unroll")
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        """(reference: rnn_cell.py FusedRNNCell.unroll — feeds the RNN op)"""
+        self.reset()
+        axis = layout.find("T")
+        inputs, _ = _normalize_sequence(length, inputs, layout, True, input_prefix)
+        if axis == 1:
+            warn_msg = "NTC layout detected. Consider using TNC for FusedRNNCell for faster speed"
+            inputs = symbol.SwapAxis(inputs, dim1=0, dim2=1)
+        else:
+            assert axis == 0, "Unsupported layout %s" % layout
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        if self._mode == "lstm":
+            states = {"state": states[0], "state_cell": states[1]}
+        else:
+            states = {"state": states[0]}
+        rnn = symbol.RNN(
+            data=inputs, parameters=self._parameter,
+            state_size=self._num_hidden, num_layers=self._num_layers,
+            bidirectional=self._bidirectional, p=self._dropout,
+            state_outputs=self._get_next_state, mode=self._mode,
+            name=self._prefix + "rnn", **states
+        )
+        attr_states = []
+        if not self._get_next_state:
+            outputs, attr_states = rnn, []
+        elif self._mode == "lstm":
+            outputs, attr_states = rnn[0], [rnn[1], rnn[2]]
+        else:
+            outputs, attr_states = rnn[0], [rnn[1]]
+        if axis == 1:
+            outputs = symbol.SwapAxis(outputs, dim1=0, dim2=1)
+        if merge_outputs is False:
+            outputs = list(
+                symbol.SliceChannel(
+                    outputs, axis=axis, num_outputs=length, squeeze_axis=1
+                )
+            )
+        return outputs, attr_states
+
+    def unfuse(self):
+        """Expand to a SequentialRNNCell of unfused cells
+        (reference: rnn_cell.py unfuse)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda cell_prefix: RNNCell(self._num_hidden, activation="relu", prefix=cell_prefix),
+            "rnn_tanh": lambda cell_prefix: RNNCell(self._num_hidden, activation="tanh", prefix=cell_prefix),
+            "lstm": lambda cell_prefix: LSTMCell(self._num_hidden, prefix=cell_prefix),
+            "gru": lambda cell_prefix: GRUCell(self._num_hidden, prefix=cell_prefix),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(
+                    BidirectionalCell(
+                        get_cell("%sl%d_" % (self._prefix, i)),
+                        get_cell("%sr%d_" % (self._prefix, i)),
+                        output_prefix="%sbi_%s_%d" % (self._prefix, self._mode, i),
+                    )
+                )
+            else:
+                stack.add(get_cell("%sl%d_" % (self._prefix, i)))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout, prefix="%s_dropout%d_" % (self._prefix, i)))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack cells sequentially (reference: rnn_cell.py:709)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            assert cell._own_params, (
+                "Either specify params for SequentialRNNCell "
+                "or child cells, not both."
+            )
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return _cells_state_info(self._cells)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._cells, **kwargs)
+
+    def unpack_weights(self, args):
+        return _cells_unpack_weights(self._cells, args)
+
+    def pack_weights(self, args):
+        return _cells_pack_weights(self._cells, args)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info)
+            state = states[p : p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        """(reference: rnn_cell.py SequentialRNNCell.unroll)"""
+        self.reset()
+        num_cells = len(self._cells)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            states = begin_state[p : p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, input_prefix=input_prefix,
+                begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs,
+            )
+            next_states.extend(states)
+        return inputs, next_states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base class for modifier cells (reference: rnn_cell.py:787)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, init_sym=symbol.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(init_sym, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+
+class DropoutCell(BaseRNNCell):
+    """Apply dropout on output (reference: rnn_cell.py DropoutCell)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(data=inputs, p=self.dropout)
+        return inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout state regularizer (reference: rnn_cell.py ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, FusedRNNCell), (
+            "FusedRNNCell doesn't support zoneout. Please unfuse first."
+        )
+        assert not isinstance(base_cell, BidirectionalCell), (
+            "BidirectionalCell doesn't support zoneout since it doesn't support step. "
+            "Please add ZoneoutCell to the cells underneath instead."
+        )
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        cell, p_outputs, p_states = self.base_cell, self.zoneout_outputs, self.zoneout_states
+        next_output, next_states = cell(inputs, states)
+        mask = lambda p, like: symbol.Dropout(  # noqa: E731
+            symbol.ones_like(like), p=p
+        )
+        prev_output = self.prev_output if self.prev_output is not None else symbol.zeros((0, 0))
+        output = (
+            symbol.where(mask(p_outputs, next_output), next_output, prev_output)
+            if p_outputs != 0.0
+            else next_output
+        )
+        states = (
+            [
+                symbol.where(mask(p_states, new_s), new_s, old_s)
+                for new_s, old_s in zip(next_states, states)
+            ]
+            if p_states != 0.0
+            else next_states
+        )
+        self.prev_output = output
+        return output, states
+
+
+class ResidualCell(ModifierCell):
+    """Output = base(input) + input (reference: rnn_cell.py ResidualCell)."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = symbol._plus(output, inputs, name="%s_plus_residual" % (output.name or "res"))
+        return output, states
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs,
+        )
+        self.base_cell._modified = True
+        merge_outputs = (
+            isinstance(outputs, symbol.Symbol) if merge_outputs is None else merge_outputs
+        )
+        inputs, _ = _normalize_sequence(length, inputs, layout, merge_outputs)
+        if merge_outputs:
+            outputs = symbol._plus(outputs, inputs)
+        else:
+            outputs = [symbol._plus(i, j) for i, j in zip(outputs, inputs)]
+        return outputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Bidirectional wrapper (reference: rnn_cell.py:937)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params=params)
+        self._output_prefix = output_prefix
+        self._override_cell_params = params is not None
+        if self._override_cell_params:
+            assert l_cell._own_params and r_cell._own_params, (
+                "Either specify params for BidirectionalCell or child cells, not both."
+            )
+            l_cell.params._params.update(self.params._params)
+            r_cell.params._params.update(self.params._params)
+        self.params._params.update(l_cell.params._params)
+        self.params._params.update(r_cell.params._params)
+        self._cells = [l_cell, r_cell]
+
+    def unpack_weights(self, args):
+        return _cells_unpack_weights(self._cells, args)
+
+    def pack_weights(self, args):
+        return _cells_pack_weights(self._cells, args)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("Bidirectional cannot be stepped. Please use unroll")
+
+    @property
+    def state_info(self):
+        return _cells_state_info(self._cells)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._cells, **kwargs)
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        """(reference: rnn_cell.py BidirectionalCell.unroll)"""
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, False, input_prefix)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        l_cell, r_cell = self._cells
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs, begin_state=states[: len(l_cell.state_info)],
+            layout=layout, merge_outputs=merge_outputs,
+        )
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=states[len(l_cell.state_info):],
+            layout=layout, merge_outputs=merge_outputs,
+        )
+        if merge_outputs is None:
+            merge_outputs = (
+                isinstance(l_outputs, symbol.Symbol) and isinstance(r_outputs, symbol.Symbol)
+            )
+            if not merge_outputs:
+                if isinstance(l_outputs, symbol.Symbol):
+                    l_outputs = list(
+                        symbol.SliceChannel(l_outputs, axis=axis, num_outputs=length, squeeze_axis=1)
+                    )
+                if isinstance(r_outputs, symbol.Symbol):
+                    r_outputs = list(
+                        symbol.SliceChannel(r_outputs, axis=axis, num_outputs=length, squeeze_axis=1)
+                    )
+        if merge_outputs:
+            l_outputs = [l_outputs]
+            r_outputs = [symbol.reverse(r_outputs, axis=axis)]
+        else:
+            r_outputs = list(reversed(r_outputs))
+        outputs = [
+            symbol.Concat(l_o, r_o, dim=1 + merge_outputs,
+                          name="%sout%d" % (self._output_prefix, i) if not merge_outputs
+                          else "%sout" % self._output_prefix)
+            for i, (l_o, r_o) in enumerate(zip(l_outputs, r_outputs))
+        ]
+        if merge_outputs:
+            outputs = outputs[0]
+        states = l_states + r_states
+        return outputs, states
+
+
+def _cells_state_info(cells):
+    return sum([c.state_info for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _cells_unpack_weights(cells, args):
+    for cell in cells:
+        args = cell.unpack_weights(args)
+    return args
+
+
+def _cells_pack_weights(cells, args):
+    for cell in cells:
+        args = cell.pack_weights(args)
+    return args

@@ -1,0 +1,623 @@
+"""Symbol — the declarative graph API.
+
+Reference: python/mxnet/symbol.py (Symbol :41, compose, infer_shape :815,
+infer_type :718, simple_bind :1157, bind :1256, tojson :1064) over the nnvm
+Symbol/Graph C++ core. Here the graph is a lightweight Python DAG; its only
+consumer is the Executor, which traces it straight into one jax function and
+jit-compiles the whole thing — the TPU analog of GraphExecutor::Init running
+nnvm passes then caching engine ops (src/executor/graph_executor.cc:336-449).
+Shape/type inference runs the registry's per-op inference in topological order
+(the InferShape/InferType passes, graph_executor.cc:428-429).
+
+JSON layout matches the nnvm serialization the reference emits (nodes /
+arg_nodes / heads with string attrs) so graphs round-trip between frameworks.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+from .attribute import AttrScope
+from .base import MXNetError, attr_str
+from .context import current_context
+from .name import NameManager
+from .ops.registry import get_op, list_ops
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "zeros", "ones", "arange"]
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "_extra_attrs")
+
+    def __init__(self, op, name, attrs, inputs, extra_attrs=None):
+        self.op = op  # op name string, or None for a variable
+        self.name = name
+        self.attrs = attrs or {}  # canonicalized op params
+        self.inputs = inputs or []  # list of (_Node, int output index)
+        self._extra_attrs = extra_attrs or {}  # user attrs (ctx_group, lr_mult, ...)
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def list_attr(self):
+        d = {k: attr_str(v) for k, v in self.attrs.items()}
+        d.update({k: attr_str(v) for k, v in self._extra_attrs.items()})
+        return d
+
+
+def _topo_order(root_entries):
+    """Post-order DFS over the DAG; returns list of unique nodes."""
+    seen = {}
+    order = []
+    stack = [(n, False) for n, _ in reversed(root_entries)]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen[id(node)] = node
+        stack.append((node, True))
+        for inp, _ in reversed(node.inputs):
+            if id(inp) not in seen:
+                stack.append((inp, False))
+    return order
+
+
+class Symbol:
+    """Symbol is a multi-output handle onto graph nodes: a list of
+    (node, output_index) entries (nnvm's NodeEntry)."""
+
+    __slots__ = ("_entries",)
+
+    def __init__(self, entries):
+        self._entries = list(entries)
+
+    # ---- composition ----------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        """Compose: replace this symbol's free variables with other symbols
+        (reference: symbol.py Symbol.__call__/_compose)."""
+        s = self.__copy__()
+        s._compose(*args, **kwargs)
+        return s
+
+    def __copy__(self):
+        # deep-copy the reachable subgraph so composition doesn't mutate shared nodes
+        mapping = {}
+        order = _topo_order(self._entries)
+        for node in order:
+            mapping[id(node)] = _Node(
+                node.op,
+                node.name,
+                dict(node.attrs),
+                [(mapping[id(i)], k) for i, k in node.inputs],
+                dict(node._extra_attrs),
+            )
+        return Symbol([(mapping[id(n)], k) for n, k in self._entries])
+
+    def _compose(self, *args, **kwargs):
+        kwargs = {k: v for k, v in kwargs.items()}
+        if args and kwargs:
+            raise MXNetError("compose only accept input Symbols either as positional or keyword arguments")
+        arg_names = self.list_arguments()
+        if args:
+            kwargs = dict(zip(arg_names, args))
+        order = _topo_order(self._entries)
+        var_map = {}
+        for node in order:
+            if node.is_variable and node.name in kwargs:
+                var_map[id(node)] = kwargs[node.name]._entries[0]
+        for node in order:
+            node.inputs = [
+                (var_map[id(i)][0], var_map[id(i)][1]) if id(i) in var_map else (i, k)
+                for i, k in node.inputs
+            ]
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("Cannot find output %s" % index)
+            index = names.index(index)
+        return Symbol([self._entries[index]])
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    # ---- arithmetic builds graph nodes ----------------------------------
+    def _binary(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _create(op, [a, b], {})
+        if isinstance(other, (int, float, np.generic)):
+            return _create(scalar_op, [self], {"scalar": float(other)})
+        raise TypeError("type %s not supported" % str(type(other)))
+
+    def __add__(self, o):
+        return self._binary(o, "elemwise_add" if isinstance(o, Symbol) else None, "_plus_scalar") \
+            if not isinstance(o, Symbol) else _create("elemwise_add", [self, o], {})
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        if isinstance(o, Symbol):
+            return _create("elemwise_sub", [self, o], {})
+        return _create("_minus_scalar", [self], {"scalar": float(o)})
+
+    def __rsub__(self, o):
+        return _create("_rminus_scalar", [self], {"scalar": float(o)})
+
+    def __mul__(self, o):
+        if isinstance(o, Symbol):
+            return _create("elemwise_mul", [self, o], {})
+        return _create("_mul_scalar", [self], {"scalar": float(o)})
+
+    __rmul__ = __mul__
+
+    def __div__(self, o):
+        if isinstance(o, Symbol):
+            return _create("elemwise_div", [self, o], {})
+        return _create("_div_scalar", [self], {"scalar": float(o)})
+
+    __truediv__ = __div__
+
+    def __rdiv__(self, o):
+        return _create("_rdiv_scalar", [self], {"scalar": float(o)})
+
+    __rtruediv__ = __rdiv__
+
+    def __pow__(self, o):
+        if isinstance(o, Symbol):
+            return _create("_power", [self, o], {})
+        return _create("_power_scalar", [self], {"scalar": float(o)})
+
+    def __neg__(self):
+        return _create("negative", [self], {})
+
+    # ---- introspection --------------------------------------------------
+    @property
+    def name(self):
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return None
+
+    def attr(self, key):
+        node = self._entries[0][0]
+        v = node._extra_attrs.get(key)
+        if v is None and key in node.attrs:
+            v = attr_str(node.attrs[key])
+        return v
+
+    def list_attr(self, recursive=False):
+        if recursive:
+            ret = {}
+            for node in _topo_order(self._entries):
+                for k, v in node.list_attr().items():
+                    ret["%s_%s" % (node.name, k)] = v
+            return ret
+        return self._entries[0][0].list_attr()
+
+    def attr_dict(self):
+        ret = {}
+        for node in _topo_order(self._entries):
+            d = node.list_attr()
+            if d:
+                ret[node.name] = d
+        return ret
+
+    def _set_attr(self, **kwargs):
+        self._entries[0][0]._extra_attrs.update(kwargs)
+
+    def _arg_aux_split(self):
+        """Walk the graph; classify variable nodes into args vs aux states.
+
+        A variable is auxiliary if it feeds only aux-slots of ops (the
+        reference tracks this via each op's ListAuxiliaryStates, operator.h).
+        """
+        aux_vars = set()
+        arg_vars = set()
+        for node in _topo_order(self._entries):
+            if node.is_variable:
+                continue
+            op = get_op(node.op)
+            n_args = len(op.arg_names(node.attrs))
+            for i, (inp, _) in enumerate(node.inputs):
+                if inp.is_variable:
+                    if i >= n_args:
+                        aux_vars.add(id(inp))
+                    else:
+                        arg_vars.add(id(inp))
+        return arg_vars, aux_vars
+
+    def list_arguments(self):
+        arg_vars, aux_vars = self._arg_aux_split()
+        out = []
+        for node in _topo_order(self._entries):
+            if node.is_variable and id(node) not in aux_vars:
+                out.append(node.name)
+        return out
+
+    def list_auxiliary_states(self):
+        arg_vars, aux_vars = self._arg_aux_split()
+        out = []
+        for node in _topo_order(self._entries):
+            if node.is_variable and id(node) in aux_vars:
+                out.append(node.name)
+        return out
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._entries:
+            if node.is_variable:
+                names.append(node.name)
+            else:
+                op = get_op(node.op)
+                onames = op.output_names(node.attrs)
+                if op.num_outputs(node.attrs) == 1:
+                    names.append(node.name + "_" + onames[0])
+                else:
+                    names.append(node.name + "_" + onames[idx])
+        return names
+
+    def list_inputs(self):
+        return self.list_arguments() + self.list_auxiliary_states()
+
+    def get_internals(self):
+        """All internal outputs, one entry per node output
+        (reference: symbol.py get_internals)."""
+        entries = []
+        for node in _topo_order(self._entries):
+            if node.is_variable:
+                entries.append((node, 0))
+            else:
+                op = get_op(node.op)
+                for i in range(op.num_visible_outputs(node.attrs)):
+                    entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        node = self._entries[0][0]
+        if not node.inputs:
+            return None
+        return Symbol([(n, i) for n, i in node.inputs])
+
+    # ---- inference ------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        provided = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    provided[name] = tuple(shape)
+        provided.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        shapes, out_shapes, aux_shapes = _infer(self, provided, "shape", partial)
+        return shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        provided = {}
+        if args:
+            for name, dt in zip(arg_names, args):
+                if dt is not None:
+                    provided[name] = np.dtype(dt)
+        provided.update({k: np.dtype(v) for k, v in kwargs.items() if v is not None})
+        return _infer(self, provided, "type", False)
+
+    # ---- serialization --------------------------------------------------
+    def tojson(self):
+        order = _topo_order(self._entries)
+        node_ids = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        arg_nodes = []
+        for i, node in enumerate(order):
+            if node.is_variable:
+                arg_nodes.append(i)
+                nodes.append({"op": "null", "name": node.name, "inputs": []})
+                attrs = node.list_attr()
+                if attrs:
+                    nodes[-1]["attrs"] = attrs
+            else:
+                entry = {
+                    "op": node.op,
+                    "name": node.name,
+                    "inputs": [[node_ids[id(n)], k, 0] for n, k in node.inputs],
+                }
+                attrs = node.list_attr()
+                if attrs:
+                    entry["attrs"] = attrs
+                nodes.append(entry)
+        heads = [[node_ids[id(n)], k, 0] for n, k in self._entries]
+        return json.dumps(
+            {
+                "nodes": nodes,
+                "arg_nodes": arg_nodes,
+                "node_row_ptr": list(range(len(order) + 1)),
+                "heads": heads,
+                "attrs": {"mxnet_version": ["int", 1000]},
+            },
+            indent=2,
+        )
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ---- binding --------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None, group2ctx=None,
+                    shared_arg_names=None, shared_exec=None, shared_buffer=None, **kwargs):
+        """Shape-inferred allocation + bind (reference: symbol.py:1157).
+
+        kwargs are input shapes. Allocates arg/grad/aux NDArrays and returns a
+        bound Executor.
+        """
+        from . import ndarray as nd
+        from .executor import Executor
+
+        ctx = ctx or current_context()
+        arg_shapes, out_shapes, aux_shapes = self.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("cannot infer shapes from %s" % kwargs)
+        type_dict = type_dict or {}
+        arg_names = self.list_arguments()
+        arg_types, _, aux_types = self.infer_type(**{k: v for k, v in type_dict.items() if k in arg_names})
+        args = [nd.zeros(s, ctx=ctx, dtype=t) for s, t in zip(arg_shapes, arg_types)]
+        aux_states = [nd.zeros(s, ctx=ctx, dtype=t) for s, t in zip(aux_shapes, aux_types)]
+        if grad_req == "null":
+            args_grad = None
+        else:
+            args_grad = [nd.zeros(s, ctx=ctx, dtype=t) for s, t in zip(arg_shapes, arg_types)]
+        return self.bind(ctx, args, args_grad=args_grad, grad_req=grad_req,
+                         aux_states=aux_states, group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        """Bind symbol to arrays, return Executor (reference: symbol.py:1256 →
+        Executor::Bind, src/executor/graph_executor.cc:915)."""
+        from .executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    # ---- eval convenience ----------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        ex.forward()
+        return ex.outputs
+
+    def grad(self, wrt):
+        raise MXNetError("Symbol.grad is deprecated; use bind with args_grad")
+
+    def debug_str(self):
+        lines = []
+        for node in _topo_order(self._entries):
+            if node.is_variable:
+                lines.append("Variable:%s" % node.name)
+            else:
+                lines.append(
+                    "Op:%s, Name=%s\nInputs:\n\t%s"
+                    % (node.op, node.name, "\n\t".join(n.name for n, _ in node.inputs))
+                )
+        return "\n".join(lines)
+
+    def __repr__(self):
+        name = self.name
+        return "<Symbol %s>" % (name if name else "Grouped")
+
+
+def _infer(sym, provided, kind, partial):
+    """Run shape or type inference over the graph in topo order."""
+    order = _topo_order(sym._entries)
+    known = {}  # id(node) -> list of per-output values
+    for node in order:
+        if node.is_variable:
+            known[id(node)] = [provided.get(node.name)]
+    changed = True
+    for node in order:
+        if node.is_variable:
+            continue
+        op = get_op(node.op)
+        in_vals = []
+        for inp, k in node.inputs:
+            vals = known.get(id(inp))
+            in_vals.append(None if vals is None else vals[k])
+        n_args = len(op.arg_names(node.attrs))
+        arg_vals, aux_vals = in_vals[:n_args], in_vals[n_args:]
+        try:
+            if kind == "shape":
+                new_args, outs, new_aux = op.infer_shape(node.attrs, arg_vals, aux_vals)
+            else:
+                new_args, outs, new_aux = op.infer_type(node.attrs, arg_vals)
+                new_aux = aux_vals
+                if not new_aux:
+                    new_aux = []
+                # aux types default to arg dtype
+                aux_names = op.aux_names(node.attrs)
+                if aux_names and not new_aux:
+                    new_aux = [new_args[0]] * len(aux_names)
+                elif aux_names:
+                    new_aux = [v if v is not None else new_args[0] for v in aux_vals]
+        except Exception as e:  # noqa: BLE001
+            if partial:
+                known[id(node)] = [None] * op.num_outputs(node.attrs)
+                continue
+            raise MXNetError(
+                "%s inference failed at node %s(%s): %s" % (kind, node.op, node.name, e)
+            ) from e
+        # write back filled input values onto variables
+        filled = list(new_args) + list(new_aux)
+        for (inp, k), v in zip(node.inputs, filled):
+            if inp.is_variable and v is not None:
+                prev = known[id(inp)][0]
+                if prev is not None and tuple(prev) != tuple(v) and kind == "shape":
+                    raise MXNetError(
+                        "shape mismatch for %s: %s vs %s" % (inp.name, prev, v)
+                    )
+                known[id(inp)] = [v]
+        known[id(node)] = list(outs)
+    # collect
+    arg_vars, aux_vars = sym._arg_aux_split()
+    args, auxs = [], []
+    for node in order:
+        if node.is_variable:
+            v = known[id(node)][0]
+            if id(node) in aux_vars:
+                auxs.append(v)
+            else:
+                args.append(v)
+    outs = []
+    for node, k in sym._entries:
+        vals = known.get(id(node))
+        outs.append(None if vals is None else vals[k])
+    if not partial and any(v is None for v in args + outs + auxs):
+        if kind == "shape":
+            return None, None, None
+    return args, outs, auxs
+
+
+# ---- symbol creation ----------------------------------------------------
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None, init=None, **kwargs):
+    """Create a variable symbol (reference: symbol.py Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    extra = AttrScope.current().get(attr or {})
+    if shape is not None:
+        extra["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        extra["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        extra["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        extra["__dtype__"] = str(np.dtype(dtype))
+    extra.update({k: str(v) for k, v in kwargs.items()})
+    node = _Node(None, name, {}, [], extra)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol (reference: symbol.py Group)."""
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from nnvm-format JSON."""
+    data = json.loads(json_str)
+    nodes_meta = data["nodes"]
+    built = []
+    for meta in nodes_meta:
+        attrs = meta.get("attrs", meta.get("param", {})) or {}
+        if meta["op"] == "null":
+            node = _Node(None, meta["name"], {}, [], dict(attrs))
+        else:
+            op = get_op(meta["op"])
+            cattrs, extra = op.canonicalize_attrs(attrs)
+            inputs = [(built[i], k) for i, k, *_ in meta["inputs"]]
+            node = _Node(meta["op"], meta["name"], cattrs, inputs, extra)
+        built.append(node)
+    heads = data.get("heads", [[len(built) - 1, 0, 0]])
+    return Symbol([(built[i], k) for i, k, *_ in heads])
+
+
+# ---- generated op constructors (reference: _init_symbol_module,
+# python/mxnet/symbol.py:1655) ---------------------------------------------
+def _create(op_name, sym_args, attrs, name=None, extra_attrs=None):
+    op = get_op(op_name)
+    cattrs, extra = op.canonicalize_attrs(attrs)
+    extra.update(extra_attrs or {})
+    extra = AttrScope.current().get(extra)
+    hint = op_name.lower().lstrip("_")
+    name = NameManager.current().get(name, hint)
+    arg_names = op.arg_names(cattrs)
+    aux_names = op.aux_names(cattrs)
+    inputs = []
+    for i, aname in enumerate(list(arg_names) + list(aux_names)):
+        if i < len(sym_args) and sym_args[i] is not None:
+            s = sym_args[i]
+            if not isinstance(s, Symbol):
+                raise TypeError("op %s input %d must be Symbol, got %s" % (op_name, i, type(s)))
+            inputs.append(s._entries[0])
+        else:
+            vnode = _Node(None, "%s_%s" % (name, aname), {}, [])
+            inputs.append((vnode, 0))
+    node = _Node(op_name, name, cattrs, inputs, extra)
+    return Symbol([(node, i) for i in range(op.num_visible_outputs(cattrs))][: max(1, op.num_visible_outputs(cattrs))]) \
+        if op.num_visible_outputs(cattrs) > 1 else Symbol([(node, 0)])
+
+
+def _make_symbol_function(op_name):
+    op = get_op(op_name)
+
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        sym_args = list(args)
+        attrs = {}
+        arg_names_static = None
+        # split kwargs into symbol inputs vs attrs
+        sym_kwargs = {}
+        for k, v in list(kwargs.items()):
+            if isinstance(v, Symbol):
+                sym_kwargs[k] = v
+            else:
+                attrs[k] = v
+        if op.key_var_num_args and op.key_var_num_args not in attrs:
+            attrs[op.key_var_num_args] = max(len(sym_args) + len(sym_kwargs), 1)
+        cattrs, _ = op.canonicalize_attrs(attrs)
+        names = list(op.arg_names(cattrs)) + list(op.aux_names(cattrs))
+        ordered = list(sym_args) + [None] * (len(names) - len(sym_args))
+        for k, v in sym_kwargs.items():
+            if k in names:
+                ordered[names.index(k)] = v
+            else:
+                raise MXNetError("op %s: unknown input '%s' (expects %s)" % (op_name, k, names))
+        return _create(op_name, ordered, attrs, name=name, extra_attrs=attr)
+
+    fn.__name__ = op_name
+    fn.__doc__ = "Symbolic form of operator ``%s``." % op_name
+    return fn
+
+
+_cur_module = sys.modules[__name__]
+for _name in list_ops():
+    setattr(_cur_module, _name, _make_symbol_function(_name))
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return getattr(_cur_module, "_zeros")(shape=shape, dtype=dtype, **kwargs)
+
+
+def ones(shape, dtype=None, **kwargs):
+    return getattr(_cur_module, "_ones")(shape=shape, dtype=dtype, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype=None):
+    return getattr(_cur_module, "_arange")(
+        start=start, stop=stop, step=step, repeat=repeat, name=name, dtype=dtype
+    )

@@ -1,0 +1,351 @@
+"""Test utilities (reference: python/mxnet/test_utils.py —
+assert_almost_equal :129, find_max_violation :101, check_numeric_gradient :420
+central finite differences vs symbolic backward, check_symbolic_forward :533,
+check_symbolic_backward :598, check_consistency :765 cross-backend comparison).
+
+The check_consistency pattern — run the same symbol on multiple ctx/dtype
+combos and cross-compare — is the reference's key portability harness
+(tests/python/gpu/test_operator_gpu.py); here it compares TPU vs host CPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym
+from .context import Context, cpu, current_context
+
+__all__ = [
+    "default_context", "set_default_context", "rand_shape_2d", "rand_shape_3d",
+    "rand_ndarray", "assert_almost_equal", "almost_equal", "same", "reldiff",
+    "find_max_violation", "numeric_grad", "check_numeric_gradient",
+    "check_symbolic_forward", "check_symbolic_backward", "check_consistency",
+    "simple_forward",
+]
+
+_rng = np.random.RandomState(1234)
+
+
+def default_context():
+    return current_context()
+
+
+def set_default_context(ctx):
+    Context._default_ctx.value = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(_rng.randint(1, (dim0, dim1)[i] + 1) for i in range(2))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(_rng.randint(1, (dim0, dim1, dim2)[i] + 1) for i in range(3))
+
+
+def rand_ndarray(shape, ctx=None, dtype=np.float32):
+    return nd.array(_rng.standard_normal(shape).astype(dtype), ctx=ctx)
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def reldiff(a, b):
+    """(reference: test_utils.py reldiff)"""
+    diff = np.sum(np.abs(a - b))
+    norm = np.sum(np.abs(a)) + np.sum(np.abs(b))
+    if diff == 0:
+        return 0
+    return diff / norm
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    rtol = rtol or 1e-5
+    atol = atol or 1e-20
+    return np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    """(reference: test_utils.py:101)"""
+    rtol = rtol or 1e-5
+    atol = atol or 1e-20
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = np.argmax(violation)
+    idx = np.unravel_index(loc, violation.shape)
+    return idx, np.max(violation)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    """(reference: test_utils.py:129)"""
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
+    rtol = rtol or 1e-5
+    atol = atol or 1e-20
+    if almost_equal(a, b, rtol, atol):
+        return
+    index, rel = find_max_violation(a, b, rtol, atol)
+    raise AssertionError(
+        "Items are not equal:\nError %f exceeds tolerance rtol=%f, atol=%f. "
+        " Location of maximum error:%s, %s=%f, %s=%f"
+        % (rel, rtol, atol, str(index), names[0], a[index], names[1], b[index])
+    )
+
+
+def simple_forward(symbol, ctx=None, is_train=False, **inputs):
+    """Run forward on a symbol with given inputs, return numpy outputs
+    (reference: test_utils.py simple_forward)."""
+    ctx = ctx or default_context()
+    inputs = {k: nd.array(v) if isinstance(v, np.ndarray) else v for k, v in inputs.items()}
+    exe = symbol.bind(ctx, args=inputs)
+    exe.forward(is_train=is_train)
+    outputs = [x.asnumpy() for x in exe.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def _parse_location(symbol, location, ctx):
+    assert isinstance(location, (dict, list, tuple))
+    if isinstance(location, dict):
+        if set(location.keys()) != set(symbol.list_arguments()):
+            raise ValueError(
+                "Symbol arguments and keys of the given location do not match."
+                "symbol args:%s, location.keys():%s"
+                % (str(set(symbol.list_arguments())), str(set(location.keys())))
+            )
+    else:
+        location = {k: v for k, v in zip(symbol.list_arguments(), location)}
+    return {
+        k: nd.array(v, ctx=ctx) if isinstance(v, np.ndarray) else v
+        for k, v in location.items()
+    }
+
+
+def _parse_aux_states(symbol, aux_states, ctx):
+    if aux_states is not None:
+        if isinstance(aux_states, dict):
+            if set(aux_states.keys()) != set(symbol.list_auxiliary_states()):
+                raise ValueError("Symbol aux_states names and given aux_states do not match.")
+        elif isinstance(aux_states, (list, tuple)):
+            aux_names = symbol.list_auxiliary_states()
+            aux_states = {k: v for k, v in zip(aux_names, aux_states)}
+        aux_states = {k: nd.array(v, ctx=ctx) for k, v in aux_states.items()}
+    return aux_states
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4, use_forward_train=True):
+    """Central finite-difference gradients (reference: test_utils.py numeric_grad)."""
+    approx_grads = {k: np.zeros(v.shape, dtype=np.float32) for k, v in location.items()}
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    for k in location:
+        old_value = location[k].copy()
+        for i in range(int(np.prod(old_value.shape))):
+            # inplace update
+            loc = np.unravel_index(i, old_value.shape) if old_value.shape else ()
+            executor.arg_dict[k][:] = old_value
+            tmp = old_value.copy()
+            tmp[loc] += eps / 2.0
+            executor.arg_dict[k][:] = tmp
+            executor.forward(is_train=use_forward_train)
+            f_peps = sum(np.sum(o.asnumpy()) for o in executor.outputs)
+            tmp = old_value.copy()
+            tmp[loc] -= eps / 2.0
+            executor.arg_dict[k][:] = tmp
+            executor.forward(is_train=use_forward_train)
+            f_neps = sum(np.sum(o.asnumpy()) for o in executor.outputs)
+            approx_grads[k][loc] = (f_peps - f_neps) / eps
+        executor.arg_dict[k][:] = old_value
+    return approx_grads
+
+
+def check_numeric_gradient(sym_, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None):
+    """Verify symbolic backward against finite differences
+    (reference: test_utils.py:420)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx)
+    location_npy = {k: v.asnumpy() for k, v in location.items()}
+    aux_states = _parse_aux_states(sym_, aux_states, ctx)
+    if grad_nodes is None:
+        grad_nodes = sym_.list_arguments()
+        grad_req = {k: "write" for k in grad_nodes}
+    elif isinstance(grad_nodes, (list, tuple)):
+        grad_nodes = list(grad_nodes)
+        grad_req = {k: "write" for k in grad_nodes}
+    elif isinstance(grad_nodes, dict):
+        grad_req = grad_nodes.copy()
+        grad_nodes = grad_nodes.keys()
+    else:
+        raise ValueError
+
+    # attach a random-projection head so the scalar objective exercises all
+    # output elements (reference: test_utils.py random_projection)
+    out = sym_
+    if len(sym_.list_outputs()) > 1:
+        out = sym.Group([sym_[i] for i in range(len(sym_.list_outputs()))])
+    proj = sym.Variable("__random_proj")
+    out2 = sym.sum(sym_ * proj) if len(sym_.list_outputs()) == 1 else None
+    if out2 is None:
+        raise NotImplementedError("multi-output check_numeric_gradient")
+    out2 = sym.MakeLoss(out2)
+    location = dict(location)
+    _, out_shapes, _ = sym_.infer_shape(**{k: v.shape for k, v in location.items()})
+    proj_arr = _rng.standard_normal(out_shapes[0]).astype(np.float32)
+    location["__random_proj"] = nd.array(proj_arr, ctx=ctx)
+    args_grad = {
+        k: nd.zeros(location[k].shape, ctx=ctx)
+        for k in list(grad_nodes) + ["__random_proj"]
+    }
+    grad_req = dict(grad_req)
+    grad_req["__random_proj"] = "write"
+    executor = out2.bind(
+        ctx, args=location, args_grad=args_grad, grad_req=grad_req,
+        aux_states=aux_states,
+    )
+    executor.forward(is_train=True)
+    executor.backward()
+    symbolic_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
+    numeric_gradients = numeric_grad(
+        executor, dict(location_npy, __random_proj=proj_arr),
+        eps=numeric_eps, use_forward_train=use_forward_train,
+    )
+    for name in grad_nodes:
+        fd_grad = numeric_gradients[name]
+        sym_grad = symbolic_grads[name]
+        if grad_req[name] == "write":
+            assert_almost_equal(
+                fd_grad, sym_grad, rtol, atol,
+                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name),
+            )
+        elif grad_req[name] == "null":
+            assert_almost_equal(
+                np.zeros_like(sym_grad), sym_grad, rtol, atol,
+                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name),
+            )
+
+
+def check_symbolic_forward(sym_, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None):
+    """Compare forward against expected numpy outputs
+    (reference: test_utils.py:533)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx)
+    aux_states = _parse_aux_states(sym_, aux_states, ctx)
+    executor = sym_.bind(ctx, args=location, aux_states=aux_states)
+    executor.forward(is_train=False)
+    outputs = [x.asnumpy() for x in executor.outputs]
+    for output_name, expect, output in zip(sym_.list_outputs(), expected, outputs):
+        assert_almost_equal(expect, output, rtol, atol, ("EXPECTED_%s" % output_name, output_name))
+    return executor.outputs
+
+
+def check_symbolic_backward(sym_, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write", ctx=None):
+    """Compare backward against expected numpy gradients
+    (reference: test_utils.py:598)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx)
+    aux_states = _parse_aux_states(sym_, aux_states, ctx)
+    if isinstance(expected, (list, tuple)):
+        expected = {k: v for k, v in zip(sym_.list_arguments(), expected)}
+    args_grad_npy = {k: _rng.normal(size=v.shape) for k, v in expected.items()}
+    args_grad_data = {k: nd.array(v, ctx=ctx) for k, v in args_grad_npy.items()}
+    if isinstance(grad_req, str):
+        grad_req = {k: grad_req for k in sym_.list_arguments()}
+    elif isinstance(grad_req, (list, tuple)):
+        grad_req = {k: v for k, v in zip(sym_.list_arguments(), grad_req)}
+    executor = sym_.bind(
+        ctx, args=location, args_grad=args_grad_data, aux_states=aux_states,
+        grad_req=grad_req,
+    )
+    executor.forward(is_train=True)
+    if isinstance(out_grads, (tuple, list)):
+        out_grads = [nd.array(v, ctx=ctx) if isinstance(v, np.ndarray) else v for v in out_grads]
+    elif isinstance(out_grads, np.ndarray):
+        out_grads = [nd.array(out_grads, ctx=ctx)]
+    executor.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in executor.grad_dict.items() if v is not None}
+    for name in expected:
+        if grad_req[name] == "write":
+            assert_almost_equal(
+                expected[name], grads[name], rtol, atol,
+                ("EXPECTED_%s" % name, "BACKWARD_%s" % name),
+            )
+        elif grad_req[name] == "add":
+            assert_almost_equal(
+                expected[name], grads[name] - args_grad_npy[name], rtol, atol,
+                ("EXPECTED_%s" % name, "BACKWARD_%s" % name),
+            )
+        elif grad_req[name] == "null":
+            assert_almost_equal(
+                args_grad_npy[name], grads[name], rtol, atol,
+                ("EXPECTED_%s" % name, "BACKWARD_%s" % name),
+            )
+    return executor.grad_arrays
+
+
+def check_consistency(sym_, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True, ground_truth=None):
+    """Run one symbol on several ctx/shape/dtype configs and cross-compare
+    (reference: test_utils.py:765 — the GPU-vs-CPU harness; here TPU-vs-CPU)."""
+    if tol is None:
+        tol = {
+            np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+            np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
+            np.dtype(np.int32): 0,
+        }
+    elif isinstance(tol, float):
+        tol = {
+            np.dtype(np.float16): tol, np.dtype(np.float32): tol,
+            np.dtype(np.float64): tol, np.dtype(np.uint8): 0,
+            np.dtype(np.int32): 0,
+        }
+    assert len(ctx_list) > 1
+    if isinstance(sym_, sym.Symbol):
+        sym_ = [sym_] * len(ctx_list)
+    else:
+        assert len(sym_) == len(ctx_list)
+    output_names = sym_[0].list_outputs()
+    arg_names = sym_[0].list_arguments()
+    exe_list = []
+    for s, ctx in zip(sym_, ctx_list):
+        assert s.list_arguments() == arg_names
+        assert s.list_outputs() == output_names
+        arg_shapes, _, aux_shapes = s.infer_shape(**{k: v for k, v in ctx["shapes"].items()})
+        type_dict = ctx.get("type_dict", {})
+        exe_list.append(
+            s.simple_bind(ctx=ctx["ctx"], grad_req=grad_req, type_dict=type_dict, **ctx["shapes"])
+        )
+    arg_params = {} if arg_params is None else arg_params
+    aux_params = {} if aux_params is None else aux_params
+    for n, arr in exe_list[0].arg_dict.items():
+        if n not in arg_params:
+            arg_params[n] = np.random.normal(size=arr.shape, scale=scale)
+    for n, arr in exe_list[0].aux_dict.items():
+        if n not in aux_params:
+            aux_params[n] = 0
+    for exe in exe_list:
+        for name, arr in exe.arg_dict.items():
+            arr[:] = arg_params[name].astype(arr.dtype) if hasattr(arg_params[name], "astype") else arg_params[name]
+        for name, arr in exe.aux_dict.items():
+            arr[:] = aux_params[name]
+    dtypes = [np.dtype(exe.outputs[0].dtype) if False else np.float32 for exe in exe_list]
+    # forward
+    for exe in exe_list:
+        exe.forward(is_train=False)
+    outputs = [[o.asnumpy() for o in exe.outputs] for exe in exe_list]
+    gt = ground_truth or outputs[0]
+    for i, out in enumerate(outputs[1:], 1):
+        for name, g, o in zip(output_names, gt, out):
+            rt = tol[np.dtype(np.float32)]
+            assert_almost_equal(g, o, rtol=rt, atol=rt, names=("gt_" + name, "ctx%d_" % i + name))
+    return exe_list

@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax loads.
+
+This is the TPU analog of the reference's CPU-fake-device trick
+(tests/python/unittest/test_multi_device_exec.py:20-33 binds graphs across
+mx.cpu(1)/mx.cpu(2)): multi-device/mesh tests run against 8 virtual host
+devices so sharding logic is exercised without a pod.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
