@@ -322,11 +322,13 @@ class LSTMBias(Initializer):
         self.forget_bias = forget_bias
 
     def _init_weight(self, name, arr):
-        arr[:] = 0.0
         num_hidden = int(arr.shape[0] / 4)
-        a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+        a = np.zeros(arr.shape, dtype="float32")
         a[num_hidden : 2 * num_hidden] = self.forget_bias
         arr[:] = a
+
+    # the bias suffix routes here in __call__'s dispatch; same fill
+    _init_bias = _init_weight
 
 
 @register

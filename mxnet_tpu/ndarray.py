@@ -398,11 +398,19 @@ def array(source_array, ctx=None, dtype=None):
     ctx = ctx or current_context()
     if isinstance(source_array, NDArray):
         src = source_array.asnumpy()
+        if dtype is None:
+            dtype = src.dtype
+    elif isinstance(source_array, np.ndarray):
+        src = source_array
+        if dtype is None:
+            # mxnet keeps numpy dtype (reference: ndarray.py array); float64
+            # narrows to the framework default fp32 (TPU has no f64 units)
+            dtype = src.dtype if src.dtype != np.float64 else np.float32
     else:
         src = np.asarray(source_array)
-    if dtype is None:
-        dtype = src.dtype if src.dtype != np.float64 else np.float32
-    src = src.astype(dtype)
+        if dtype is None:
+            dtype = np.float32
+    src = np.asarray(src).astype(dtype)
     return NDArray(jax.device_put(src, ctx.jax_device), ctx=ctx)
 
 

@@ -13,21 +13,30 @@ def _dtype_or(attrs, default=np.float32):
     return default if dt is None else dt
 
 
+def _shape_0to1(shape):
+    """MXNet uses 0 as the 'unknown batch' wildcard in creation shapes (e.g.
+    RNN begin_state uses sym.zeros((0, H)), rnn_cell.py state_info). The
+    reference's nnvm inference resolves 0 bidirectionally; the XLA-friendly
+    equivalent is dim 1 + broadcasting — downstream elemwise ops expand it to
+    the real batch, with identical numerics and gradients."""
+    return tuple(1 if s == 0 else s for s in shape)
+
+
 register_simple(
     "_zeros",
-    lambda attrs: jnp.zeros(attrs["shape"], _dtype_or(attrs)),
+    lambda attrs: jnp.zeros(_shape_0to1(attrs["shape"]), _dtype_or(attrs)),
     arg_names=(),
     params={"shape": Param.shape(()), "dtype": Param.dtype(None)},
 )
 register_simple(
     "_ones",
-    lambda attrs: jnp.ones(attrs["shape"], _dtype_or(attrs)),
+    lambda attrs: jnp.ones(_shape_0to1(attrs["shape"]), _dtype_or(attrs)),
     arg_names=(),
     params={"shape": Param.shape(()), "dtype": Param.dtype(None)},
 )
 register_simple(
     "_full",
-    lambda attrs: jnp.full(attrs["shape"], attrs["value"], _dtype_or(attrs)),
+    lambda attrs: jnp.full(_shape_0to1(attrs["shape"]), attrs["value"], _dtype_or(attrs)),
     arg_names=(),
     params={"shape": Param.shape(()), "value": Param.float(0.0), "dtype": Param.dtype(None)},
 )

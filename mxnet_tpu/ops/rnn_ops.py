@@ -161,10 +161,13 @@ def _rnn(octx, attrs, args, auxs):
         outs = []
         for di, wp in enumerate(dirs):
             sidx = li * d + di
+            # broadcast initial state up to the real batch (begin_state may be
+            # batch-1 from the 0-dim wildcard convention, init_ops._shape_0to1)
+            h_init = jnp.broadcast_to(h0[sidx], (N, H)).astype(x.dtype)
             if mode == "lstm":
-                init = (h0[sidx], c0[sidx])
+                init = (h_init, jnp.broadcast_to(c0[sidx], (N, H)).astype(x.dtype))
             else:
-                init = (h0[sidx],)
+                init = (h_init,)
             out, carry = _run_layer(inp, wp, init, mode, H, reverse=(di == 1))
             outs.append(out)
             h_finals.append(carry[0])

@@ -14,6 +14,7 @@ arg_nodes / heads with string attrs) so graphs round-trip between frameworks.
 """
 from __future__ import annotations
 
+import builtins
 import json
 import sys
 
@@ -465,7 +466,7 @@ def _infer(sym, provided, kind, partial):
         for (inp, k), v in zip(node.inputs, filled):
             if inp.is_variable and v is not None:
                 prev = known[id(inp)][0]
-                if prev is not None and tuple(prev) != tuple(v) and kind == "shape":
+                if kind == "shape" and prev is not None and tuple(prev) != tuple(v):
                     raise MXNetError(
                         "shape mismatch for %s: %s vs %s" % (inp.name, prev, v)
                     )
@@ -567,7 +568,7 @@ def _create(op_name, sym_args, attrs, name=None, extra_attrs=None):
             vnode = _Node(None, "%s_%s" % (name, aname), {}, [])
             inputs.append((vnode, 0))
     node = _Node(op_name, name, cattrs, inputs, extra)
-    return Symbol([(node, i) for i in range(op.num_visible_outputs(cattrs))][: max(1, op.num_visible_outputs(cattrs))]) \
+    return Symbol([(node, i) for i in range(op.num_visible_outputs(cattrs))][: builtins.max(1, op.num_visible_outputs(cattrs))]) \
         if op.num_visible_outputs(cattrs) > 1 else Symbol([(node, 0)])
 
 
@@ -588,7 +589,7 @@ def _make_symbol_function(op_name):
             else:
                 attrs[k] = v
         if op.key_var_num_args and op.key_var_num_args not in attrs:
-            attrs[op.key_var_num_args] = max(len(sym_args) + len(sym_kwargs), 1)
+            attrs[op.key_var_num_args] = builtins.max(len(sym_args) + len(sym_kwargs), 1)
         cattrs, _ = op.canonicalize_attrs(attrs)
         names = list(op.arg_names(cattrs)) + list(op.aux_names(cattrs))
         ordered = list(sym_args) + [None] * (len(names) - len(sym_args))

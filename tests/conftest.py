@@ -1,15 +1,24 @@
-"""Test configuration: force an 8-device virtual CPU platform BEFORE jax loads.
+"""Test configuration: force an 8-device virtual CPU platform.
 
 This is the TPU analog of the reference's CPU-fake-device trick
 (tests/python/unittest/test_multi_device_exec.py:20-33 binds graphs across
 mx.cpu(1)/mx.cpu(2)): multi-device/mesh tests run against 8 virtual host
 devices so sharding logic is exercised without a pod.
+
+The environment may pre-register a real-TPU PJRT plugin at interpreter start
+(sitecustomize) and pin JAX_PLATFORMS to it; jax captures that env at import,
+so we must both set XLA_FLAGS before the first backend init AND override the
+platform selection via jax.config after import.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,105 @@
+"""Executor tests (reference: tests/python/unittest/test_executor.py —
+bind/reshape/shared memory)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+
+rng = np.random.RandomState(7)
+
+
+def test_bind_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b * 2
+    x = rng.rand(3, 4).astype(np.float32)
+    y = rng.rand(3, 4).astype(np.float32)
+    ga = nd.zeros((3, 4))
+    gb = nd.zeros((3, 4))
+    ex = c.bind(mx.cpu(), {"a": nd.array(x), "b": nd.array(y)},
+                args_grad={"a": ga, "b": gb})
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x + 2 * y, rtol=1e-5)
+    og = rng.rand(3, 4).astype(np.float32)
+    ex.backward(nd.array(og))
+    np.testing.assert_allclose(ga.asnumpy(), og, rtol=1e-5)
+    np.testing.assert_allclose(gb.asnumpy(), og * 2, rtol=1e-5)
+
+
+def test_forward_kwargs_update_inputs():
+    a = sym.Variable("a")
+    out = a * 3
+    ex = out.bind(mx.cpu(), {"a": nd.zeros((2, 2))})
+    ex.forward(a=nd.array(np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), 3 * np.ones((2, 2)))
+
+
+def test_simple_bind_allocates():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(5, 3))
+    assert ex.arg_dict["fc_weight"].shape == (4, 3)
+    assert ex.arg_dict["fc_bias"].shape == (4,)
+    assert ex.grad_dict["fc_weight"].shape == (4, 3)
+
+
+def test_executor_reshape():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(5, 3))
+    ex.arg_dict["fc_weight"][:] = 1.0
+    ex2 = ex.reshape(data=(7, 3))
+    # params shared, data re-allocated
+    assert ex2.arg_dict["data"].shape == (7, 3)
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    ex2.forward(data=np.ones((7, 3), np.float32))
+    assert ex2.outputs[0].shape == (7, 4)
+
+
+def test_outputs_before_backward():
+    # reading outputs mid-train-step must materialize the deferred forward
+    a = sym.Variable("a")
+    out = sym.square(a)
+    ex = out.bind(mx.cpu(), {"a": nd.array(np.array([2.0], np.float32))},
+                  args_grad={"a": nd.zeros((1,))})
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), [4.0])
+    ex.backward(nd.array(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), [4.0])
+
+
+def test_grad_req_list_and_dict():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a * b
+    x = nd.array(np.array([3.0], np.float32))
+    y = nd.array(np.array([5.0], np.float32))
+    ex = out.bind(mx.cpu(), [x, y], args_grad=[nd.zeros((1,)), nd.zeros((1,))],
+                  grad_req=["write", "null"])
+    ex.forward(is_train=True)
+    ex.backward(nd.ones((1,)))
+    np.testing.assert_allclose(ex.grad_arrays[0].asnumpy(), [5.0])
+
+
+def test_copy_params_from():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 2))
+    w = nd.array(rng.rand(2, 2).astype(np.float32))
+    ex.copy_params_from({"fc_weight": w}, allow_extra_params=True)
+    np.testing.assert_allclose(ex.arg_dict["fc_weight"].asnumpy(), w.asnumpy())
+
+
+def test_dot_executor():
+    # reference test_executor.py check_bind_with_uniform pattern
+    for shape in [(10,), (4, 5)]:
+        lhs = sym.Variable("lhs")
+        rhs = sym.Variable("rhs")
+        ret = sym.dot(lhs, rhs) if len(shape) == 1 else sym.elemwise_mul(lhs, rhs)
+        x = rng.rand(*shape).astype(np.float32)
+        y = rng.rand(*shape).astype(np.float32)
+        ex = ret.bind(mx.cpu(), {"lhs": nd.array(x), "rhs": nd.array(y)})
+        ex.forward()
+        expected = np.dot(x, y) if len(shape) == 1 else x * y
+        np.testing.assert_allclose(ex.outputs[0].asnumpy(), expected, rtol=1e-4)

@@ -1,0 +1,142 @@
+"""IO tests (reference: tests/python/unittest/test_io.py, test_recordio.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import recordio
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100).reshape(25, 4).astype(np.float32)
+    label = np.arange(25).astype(np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (5, 4)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), label[:5])
+    # reset and iterate again
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarray_iter_pad():
+    data = np.arange(28).reshape(7, 4).astype(np.float32)
+    it = mx.io.NDArrayIter(data, np.zeros(7), batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 3
+    it2 = mx.io.NDArrayIter(data, np.zeros(7), batch_size=5, last_batch_handle="discard")
+    assert len(list(it2)) == 1
+
+
+def test_ndarray_iter_shuffle_provide():
+    data = np.random.rand(20, 3).astype(np.float32)
+    it = mx.io.NDArrayIter(data, np.arange(20), batch_size=4, shuffle=True)
+    assert it.provide_data[0].name == "data"
+    assert it.provide_data[0].shape == (4, 3)
+    assert it.provide_label[0].name == "softmax_label"
+
+
+def test_ndarray_iter_dict_input():
+    it = mx.io.NDArrayIter(
+        {"a": np.zeros((10, 2)), "b": np.ones((10, 3))}, np.zeros(10), batch_size=5
+    )
+    names = sorted(d.name for d in it.provide_data)
+    assert names == ["a", "b"]
+
+
+def test_resize_iter():
+    data = np.random.rand(20, 2).astype(np.float32)
+    base = mx.io.NDArrayIter(data, np.zeros(20), batch_size=5)
+    r = mx.io.ResizeIter(base, 7)
+    assert len(list(r)) == 7
+
+
+def test_prefetching_iter():
+    data = np.random.rand(20, 2).astype(np.float32)
+    base = mx.io.NDArrayIter(data, np.zeros(20), batch_size=5)
+    p = mx.io.PrefetchingIter(base)
+    batches = list(p)
+    assert len(batches) == 4
+    p.reset()
+    assert len(list(p)) == 4
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(10, 3).astype(np.float32)
+    labels = np.arange(10).astype(np.float32)
+    dcsv = str(tmp_path / "d.csv")
+    lcsv = str(tmp_path / "l.csv")
+    np.savetxt(dcsv, data, delimiter=",")
+    np.savetxt(lcsv, labels, delimiter=",")
+    it = mx.io.CSVIter(data_csv=dcsv, data_shape=(3,), label_csv=lcsv, batch_size=5)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.data[0].asnumpy(), data[:5], rtol=1e-5)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(b"record_%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == b"record_%d" % i
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(5):
+        w.write_idx(i, b"record_%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert r.read_idx(3) == b"record_3"
+    assert r.read_idx(0) == b"record_0"
+    assert r.keys == [0, 1, 2, 3, 4]
+    r.close()
+
+
+def test_recordio_pack_unpack():
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(header, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert h2.label == 3.0
+    assert h2.id == 7
+    assert payload == b"payload"
+    # vector label
+    header = recordio.IRHeader(0, np.array([1.0, 2.0], np.float32), 9, 0)
+    s = recordio.pack(header, b"x")
+    h3, p3 = recordio.unpack(s)
+    np.testing.assert_allclose(h3.label, [1.0, 2.0])
+
+
+def test_mnist_iter(tmp_path):
+    # write tiny synthetic MNIST-format files
+    import gzip
+    import struct
+
+    img_path = str(tmp_path / "imgs")
+    lbl_path = str(tmp_path / "lbls")
+    n = 20
+    imgs = (np.random.rand(n, 28, 28) * 255).astype(np.uint8)
+    lbls = (np.arange(n) % 10).astype(np.uint8)
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(lbls.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=5, shuffle=False, flat=False)
+    b = next(iter(it))
+    assert b.data[0].shape == (5, 1, 28, 28)
+    assert b.data[0].asnumpy().max() <= 1.0
+    np.testing.assert_allclose(b.label[0].asnumpy(), lbls[:5].astype(np.float32))

@@ -1,0 +1,92 @@
+"""KVStore tests (reference: tests/python/unittest/test_kvstore.py — push/pull/
+updater invariants on local stores with multiple device contexts)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+shape = (4, 4)
+keys = [5, 7, 11]
+
+
+def init_kv(name="local"):
+    kv = mx.kv.create(name)
+    kv.init(3, nd.zeros(shape))
+    for k in keys:
+        kv.init(k, nd.zeros(shape))
+    return kv
+
+
+def check_diff_to_scalar(A, x):
+    assert (A.asnumpy() == x).all(), A.asnumpy()
+
+
+def test_single_kv_pair():
+    for name in ["local", "device"]:
+        kv = init_kv(name)
+        kv.push(3, nd.ones(shape))
+        val = nd.empty(shape)
+        kv.pull(3, out=val)
+        check_diff_to_scalar(val, 1)
+
+
+def test_list_kv_pair():
+    kv = init_kv()
+    kv.push(keys, [nd.ones(shape) * 4] * len(keys))
+    val = [nd.empty(shape)] * len(keys)
+    kv.pull(keys, out=val)
+    for v in val:
+        check_diff_to_scalar(v, 4)
+
+
+def test_aggregator():
+    """Aggregation over 'devices' (reference: test_kvstore.py test_aggregator)."""
+    for name in ["local", "device"]:
+        kv = init_kv(name)
+        num_devs = 4
+        devs = [mx.cpu(i) for i in range(num_devs)]
+        vals = [nd.ones(shape, ctx=d) for d in devs]
+        kv.push(3, vals)
+        outs = [nd.empty(shape, ctx=d) for d in devs]
+        kv.pull(3, out=outs)
+        for out in outs:
+            check_diff_to_scalar(out, num_devs)
+
+
+def test_updater():
+    """(reference: test_kvstore.py test_updater)"""
+    kv = init_kv()
+    kv.set_updater(lambda key, recv, local: local.__iadd__(recv))
+    num_devs = 4
+    devs = [mx.cpu(i) for i in range(num_devs)]
+    vals = [nd.ones(shape, ctx=d) for d in devs]
+    kv.push(3, vals)
+    kv.push(3, vals)
+    outs = [nd.empty(shape, ctx=d) for d in devs]
+    kv.pull(3, out=outs)
+    for out in outs:
+        check_diff_to_scalar(out, num_devs * 2)
+
+
+def test_set_optimizer_test_updater():
+    kv = init_kv()
+    kv.set_optimizer(mx.opt.Test(rescale_grad=1.0))
+    kv.push(3, nd.ones(shape))
+    out = nd.empty(shape)
+    kv.pull(3, out=out)
+    check_diff_to_scalar(out, 1)
+
+
+def test_rank_and_size():
+    kv = mx.kv.create("local")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_optimizer_states_roundtrip(tmp_path):
+    kv = init_kv()
+    kv.set_optimizer(mx.opt.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push(3, nd.ones(shape))
+    f = str(tmp_path / "kv.states")
+    kv.save_optimizer_states(f)
+    kv.load_optimizer_states(f)

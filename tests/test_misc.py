@@ -1,0 +1,233 @@
+"""Metric / initializer / attr / random / infer_shape tests (reference:
+tests/python/unittest/test_{metric,init,attr,random,infer_shape}.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+
+
+# ---- metrics (test_metric.py) --------------------------------------------
+def test_accuracy():
+    m = mx.metric.create("acc")
+    pred = nd.array(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+    label = nd.array(np.array([0, 1], np.float32))
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+
+
+def test_topk():
+    m = mx.metric.create("top_k_accuracy", top_k=2)
+    pred = nd.array(np.array([[0.1, 0.5, 0.4], [0.5, 0.4, 0.1]], np.float32))
+    label = nd.array(np.array([2, 1], np.float32))
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+
+
+def test_mse_mae_rmse():
+    pred = nd.array(np.array([[1.0], [2.0]], np.float32))
+    label = nd.array(np.array([1.5, 1.5], np.float32))
+    for name, expected in [("mse", 0.25), ("mae", 0.5), ("rmse", 0.5)]:
+        m = mx.metric.create(name)
+        m.update([label], [pred])
+        assert abs(m.get()[1] - expected) < 1e-6
+
+
+def test_perplexity():
+    m = mx.metric.create("perplexity", ignore_label=None)
+    pred = nd.array(np.array([[0.5, 0.5], [0.5, 0.5]], np.float32))
+    label = nd.array(np.array([0, 1], np.float32))
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 2.0) < 1e-4
+
+
+def test_composite_and_custom_metric():
+    m = mx.metric.CompositeEvalMetric(metrics=["acc", "mse"])
+    names, vals = m.get()
+    assert len(names) == 2
+    cm = mx.metric.np(lambda label, pred: float((label == pred.argmax(1)).mean()))
+    pred = nd.array(np.eye(2, dtype=np.float32))
+    label = nd.array(np.array([0, 1], np.float32))
+    cm.update([label], [pred])
+    assert cm.get()[1] == 1.0
+
+
+# ---- initializers (test_init.py) -----------------------------------------
+def test_default_init_patterns():
+    init = mx.init.Uniform(0.1)
+    w = nd.zeros((10, 10))
+    init("fc1_weight", w)
+    assert 0 < np.abs(w.asnumpy()).max() <= 0.1
+    b = nd.ones((5,))
+    init("fc1_bias", b)
+    assert (b.asnumpy() == 0).all()
+    g = nd.zeros((5,))
+    init("bn_gamma", g)
+    assert (g.asnumpy() == 1).all()
+    mv = nd.ones((5,))
+    init("bn_moving_mean", mv)
+    assert (mv.asnumpy() == 0).all()
+
+
+def test_xavier_scale():
+    init = mx.init.Xavier(rnd_type="uniform", factor_type="avg", magnitude=3)
+    w = nd.zeros((100, 50))
+    init("w_weight", w)
+    scale = np.sqrt(3.0 / ((100 + 50) / 2))
+    assert np.abs(w.asnumpy()).max() <= scale + 1e-6
+    assert np.abs(w.asnumpy()).std() > 0
+
+
+def test_orthogonal_init():
+    init = mx.init.Orthogonal(scale=1.0)
+    w = nd.zeros((16, 16))
+    init("q_weight", w)
+    q = w.asnumpy()
+    np.testing.assert_allclose(q @ q.T, np.eye(16), atol=1e-4)
+
+
+def test_constant_one_zero():
+    for init, v in [(mx.init.Zero(), 0), (mx.init.One(), 1), (mx.init.Constant(3.5), 3.5)]:
+        w = nd.zeros((4,))
+        init("x_weight", w)
+        assert (w.asnumpy() == v).all()
+
+
+def test_mixed_and_load_init():
+    mixed = mx.init.Mixed([".*bias", ".*"], [mx.init.Zero(), mx.init.One()])
+    b = nd.ones((3,))
+    mixed("fc_bias", b)
+    assert (b.asnumpy() == 0).all()
+    w = nd.zeros((3,))
+    mixed("fc_weight", w)
+    assert (w.asnumpy() == 1).all()
+    loaded = mx.init.Load({"p_weight": nd.full((2,), 5)}, default_init=mx.init.Zero())
+    p = nd.zeros((2,))
+    loaded("p_weight", p)
+    assert (p.asnumpy() == 5).all()
+
+
+def test_lstm_bias_init():
+    init = mx.init.LSTMBias(forget_bias=1.0)
+    b = nd.zeros((20,))  # 4 gates x 5 hidden
+    init("lstm_i2h_bias", b)
+    arr = b.asnumpy()
+    assert (arr[5:10] == 1.0).all() and arr.sum() == 5.0
+
+
+# ---- attr scope (test_attr.py) -------------------------------------------
+def test_attr_basic():
+    data = sym.Variable("data", attr={"mood": "angry"})
+    op = sym.Convolution(
+        data=data, name="conv", kernel=(1, 1), num_filter=1, attr={"__mood__": "so so"}
+    )
+    assert data.attr("mood") == "angry"
+    assert op.attr("__mood__") == "so so"
+
+
+def test_attr_scope():
+    with mx.AttrScope(__group__="4", __data__="great"):
+        data = sym.Variable("data", attr={"dtype": "data", "__init_bias__": "0.0"})
+        gdata = sym.Variable("data2")
+    assert gdata.attr("__group__") == "4"
+    assert data.attr("__group__") == "4"
+    assert data.attr("__init_bias__") == "0.0"
+
+
+def test_name_manager():
+    from mxnet_tpu.name import NameManager, Prefix
+
+    with NameManager():
+        s1 = sym.FullyConnected(sym.Variable("d"), num_hidden=2)
+        s2 = sym.FullyConnected(sym.Variable("d"), num_hidden=2)
+        assert s1.name != s2.name
+    with Prefix("my_"):
+        s3 = sym.FullyConnected(sym.Variable("d"), num_hidden=2)
+        assert s3.name.startswith("my_")
+
+
+# ---- random (test_random.py) ---------------------------------------------
+def test_random_seed_reproducible():
+    mx.random.seed(42)
+    a = nd.random_uniform(shape=(100,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random_uniform(shape=(100,)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    c = nd.random_uniform(shape=(100,)).asnumpy()
+    assert not np.allclose(b, c)
+
+
+def test_random_moments():
+    mx.random.seed(0)
+    u = nd.random_uniform(low=2, high=4, shape=(20000,)).asnumpy()
+    assert abs(u.mean() - 3.0) < 0.05
+    assert u.min() >= 2 and u.max() <= 4
+    n = nd.random_normal(loc=1.0, scale=2.0, shape=(20000,)).asnumpy()
+    assert abs(n.mean() - 1.0) < 0.1
+    assert abs(n.std() - 2.0) < 0.1
+    g = nd.random_gamma(alpha=3.0, beta=2.0, shape=(20000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.3
+
+
+def test_random_symbol_dropout_reproducible():
+    # same executor rng stream drives dropout deterministically given a seed
+    mx.random.seed(7)
+    d = sym.Dropout(sym.Variable("x"), p=0.5)
+    ex = d.simple_bind(ctx=mx.cpu(), x=(50, 50))
+    ex.arg_dict["x"][:] = 1.0
+    ex.forward(is_train=True)
+    o1 = ex.outputs[0].asnumpy()
+    ex.forward(is_train=True)
+    o2 = ex.outputs[0].asnumpy()
+    assert not np.allclose(o1, o2)  # new mask per forward
+
+
+# ---- infer shape (test_infer_shape.py) -----------------------------------
+def test_mlp_infer_shape():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data=data, name="fc1", num_hidden=1000)
+    out = sym.Activation(data=out, act_type="relu")
+    out = sym.FullyConnected(data=out, name="fc2", num_hidden=10)
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(100, 100))
+    names = out.list_arguments()
+    d = dict(zip(names, arg_shapes))
+    assert d["fc1_weight"] == (1000, 100)
+    assert d["fc1_bias"] == (1000,)
+    assert d["fc2_weight"] == (10, 1000)
+    assert out_shapes[0] == (100, 10)
+
+
+def test_conv_chain_infer_shape():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="c1")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, kernel=(3, 3), num_filter=16, name="c2")
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 3, 28, 28))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["c1_weight"] == (8, 3, 3, 3)
+    assert d["c2_weight"] == (16, 8, 3, 3)
+    assert out_shapes[0] == (2, 16, 12, 12)
+
+
+def test_incomplete_infer_partial():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_shapes, out_shapes, _ = net.infer_shape_partial()
+    assert out_shapes[0] is None
+
+
+def test_batchnorm_aux_shape():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, name="bn")
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(4, 5, 2, 2))
+    assert aux_shapes == [(5,), (5,)]
+    assert net.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_infer_type():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_types, out_types, _ = net.infer_type(data=np.float32)
+    assert all(t == np.float32 for t in arg_types)
+    assert out_types[0] == np.float32

@@ -1,0 +1,187 @@
+"""Module tests (reference: tests/python/unittest/test_module.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+
+rng = np.random.RandomState(11)
+
+
+def _toy_data(n=256, d=8, k=3, seed=0):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, d).astype(np.float32)
+    w = r.randn(d, k).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.float32)
+    return x, y
+
+
+def _mlp(k=3):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=k, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_dtype_shapes():
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp())
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params()
+    assert mod.data_shapes[0].shape == (32, 8)
+    assert mod.output_shapes[0][1] == (32, 3)
+    arg, aux = mod.get_params()
+    assert arg["fc1_weight"].shape == (16, 8)
+
+
+def test_module_fit_converges():
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp())
+    mod.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    acc = mod.score(train, "acc")[0][1]
+    assert acc > 0.88, acc
+
+
+def test_module_predict_and_score():
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp())
+    mod.fit(train, num_epoch=3, optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    pred = mod.predict(train)
+    assert pred.shape == (256, 3)
+    probs = pred.asnumpy()
+    np.testing.assert_allclose(probs.sum(1), np.ones(256), rtol=1e-4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp())
+    mod.fit(train, num_epoch=2, optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+    # reload into a new module
+    mod2 = mx.mod.Module.load(prefix, 2, load_optimizer_states=True)
+    mod2.bind(train.provide_data, train.provide_label)
+    mod2.init_params(arg_params=mod2._arg_params, aux_params=mod2._aux_params)
+    a1 = mod.score(train, "acc")[0][1]
+    a2 = mod2.score(train, "acc")[0][1]
+    assert abs(a1 - a2) < 1e-6
+    # params equal
+    p1, _ = mod.get_params()
+    p2, _ = mod2.get_params()
+    for k in p1:
+        np.testing.assert_allclose(p1[k].asnumpy(), p2[k].asnumpy(), rtol=1e-6)
+
+
+def test_module_multi_device_data_parallel():
+    # the reference's fake-multi-device trick: several cpu contexts
+    x, y = _toy_data(n=128)
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9}, kvstore="local")
+    acc = mod.score(train, "acc")[0][1]
+    assert acc > 0.8, acc
+
+
+def test_module_kvstore_device():
+    x, y = _toy_data(n=128)
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9}, kvstore="device")
+    acc = mod.score(train, "acc")[0][1]
+    assert acc > 0.8, acc
+
+
+def test_module_input_grads():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=2, name="fc")
+    out = sym.SoftmaxOutput(out, name="softmax")
+    mod = mx.mod.Module(out)
+    mod.bind([("data", (4, 3))], [("softmax_label", (4,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(
+        [nd.array(rng.rand(4, 3).astype(np.float32))],
+        [nd.array(np.array([0, 1, 0, 1], np.float32))],
+    )
+    mod.forward_backward(batch)
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 3)
+
+
+def test_module_states_save_restore(tmp_path):
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp())
+    mod.fit(train, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    f = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(f)
+    mod.load_optimizer_states(f)
+
+
+def test_sequential_module():
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    net1 = sym.FullyConnected(sym.Variable("data"), num_hidden=16, name="fc1")
+    net1 = sym.Activation(net1, act_type="relu")
+    net2 = sym.FullyConnected(sym.Variable("data"), num_hidden=3, name="fc2")
+    net2 = sym.SoftmaxOutput(net2, name="softmax")
+    smod = mx.mod.SequentialModule()
+    smod.add(mx.mod.Module(net1, label_names=None))
+    smod.add(mx.mod.Module(net2), take_labels=True, auto_wiring=True)
+    smod.fit(train, num_epoch=4, optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    acc = smod.score(train, "acc")[0][1]
+    assert acc > 0.8, acc
+
+
+def test_bucketing_module():
+    # tiny bucketed "language model": predict constant next token
+    buckets = [4, 8]
+    V, H = 10, 8
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        emb = sym.Embedding(data, input_dim=V, output_dim=H, name="emb")
+        net = sym.mean(emb, axis=1)  # shape-invariant across buckets
+        net = sym.FullyConnected(net, num_hidden=V, name="fc")
+        net = sym.SoftmaxOutput(net, label, name="softmax")
+        return net, ["data"], ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    r = np.random.RandomState(3)
+
+    def make_batch(blen):
+        tok = r.randint(0, V, (16, 1))
+        d = np.repeat(tok, blen, axis=1).astype(np.float32)
+        l = d[:, 0].copy()
+        return mx.io.DataBatch(
+            [nd.array(d)], [nd.array(l)], bucket_key=blen,
+            provide_data=[mx.io.DataDesc("data", (16, blen))],
+            provide_label=[mx.io.DataDesc("softmax_label", (16,))],
+        )
+
+    mod.bind([("data", (16, 8))], [("softmax_label", (16,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 2.0, "momentum": 0.9})
+    metric = mx.metric.create("acc")
+    for i in range(120):
+        batch = make_batch(buckets[i % 2])
+        mod.forward_backward(batch)
+        mod.update()
+        if i == 90:
+            metric.reset()
+        mod.update_metric(metric, batch.label)
+    # after training, should fit the identity mapping reasonably
+    assert metric.get()[1] > 0.5
+    # shared params across buckets
+    assert mod._buckets[4]._exec_group.execs[0].arg_dict["fc_weight"] is \
+        mod._buckets[8]._exec_group.execs[0].arg_dict["fc_weight"]

@@ -1,0 +1,176 @@
+"""NDArray tests (reference: tests/python/unittest/test_ndarray.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_ndarray_creation():
+    a = nd.array([1, 2, 3])
+    assert a.shape == (3,)
+    assert a.dtype == np.float32
+    b = nd.zeros((2, 3))
+    assert (b.asnumpy() == 0).all()
+    c = nd.ones((2, 3), dtype=np.int32)
+    assert c.dtype == np.int32
+    d = nd.full((2, 2), 7)
+    assert (d.asnumpy() == 7).all()
+    e = nd.arange(1, 7, 2)
+    assert e.asnumpy().tolist() == [1.0, 3.0, 5.0]
+
+
+def test_ndarray_elementwise():
+    rng = np.random.RandomState(0)
+    for shape in [(3,), (4, 5), (2, 3, 4)]:
+        x = rng.randn(*shape).astype(np.float32)
+        y = rng.rand(*shape).astype(np.float32) + 0.5
+        a, b = nd.array(x), nd.array(y)
+        np.testing.assert_allclose((a + b).asnumpy(), x + y, rtol=1e-5)
+        np.testing.assert_allclose((a - b).asnumpy(), x - y, rtol=1e-5)
+        np.testing.assert_allclose((a * b).asnumpy(), x * y, rtol=1e-5)
+        np.testing.assert_allclose((a / b).asnumpy(), x / y, rtol=1e-5)
+        np.testing.assert_allclose((a + 3).asnumpy(), x + 3, rtol=1e-5)
+        np.testing.assert_allclose((3 - a).asnumpy(), 3 - x, rtol=1e-5)
+        np.testing.assert_allclose((a ** 2).asnumpy(), x ** 2, rtol=1e-4)
+        np.testing.assert_allclose((-a).asnumpy(), -x)
+
+
+def test_ndarray_inplace():
+    a = nd.ones((2, 2))
+    a += 2
+    np.testing.assert_allclose(a.asnumpy(), 3 * np.ones((2, 2)))
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+    a /= 3
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+
+
+def test_ndarray_setitem():
+    a = nd.zeros((3, 4))
+    a[:] = 5
+    assert (a.asnumpy() == 5).all()
+    a[1] = 2
+    expected = np.full((3, 4), 5.0)
+    expected[1] = 2
+    np.testing.assert_allclose(a.asnumpy(), expected)
+    a[0:2] = 0
+    expected[0:2] = 0
+    np.testing.assert_allclose(a.asnumpy(), expected)
+
+
+def test_ndarray_view_writes_parent():
+    # reference semantics: Slice/At share the underlying chunk
+    a = nd.zeros((4, 3))
+    v = a[1]
+    v[:] = 7
+    assert (a.asnumpy()[1] == 7).all()
+    s = a[2:4]
+    s[:] = 1
+    assert (a.asnumpy()[2:] == 1).all()
+
+
+def test_ndarray_copy():
+    a = nd.array(np.random.randn(3, 3))
+    b = a.copy()
+    b[:] = 0
+    assert not (a.asnumpy() == 0).all()
+    c = nd.zeros((3, 3))
+    a.copyto(c)
+    np.testing.assert_allclose(a.asnumpy(), c.asnumpy())
+
+
+def test_ndarray_reshape_transpose():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(a.reshape((6, 4)).asnumpy(), x.reshape(6, 4))
+    np.testing.assert_allclose(a.T.asnumpy(), x.T)
+    np.testing.assert_allclose(nd.transpose(a, axes=(1, 0, 2)).asnumpy(), x.transpose(1, 0, 2))
+
+
+def test_ndarray_comparisons():
+    x = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    y = np.array([[1, 3], [2, 4]], dtype=np.float32)
+    a, b = nd.array(x), nd.array(y)
+    np.testing.assert_allclose((a == b).asnumpy(), (x == y).astype(np.float32))
+    np.testing.assert_allclose((a > b).asnumpy(), (x > y).astype(np.float32))
+    np.testing.assert_allclose((a <= 2).asnumpy(), (x <= 2).astype(np.float32))
+
+
+def test_ndarray_reduce():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.sum(a).asnumpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(nd.sum(a, axis=1).asnumpy(), x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(nd.max(a, axis=(0, 2)).asnumpy(), x.max((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(nd.mean(a, axis=1, keepdims=True).asnumpy(), x.mean(1, keepdims=True), rtol=1e-5)
+
+
+def test_ndarray_dot():
+    x = np.random.rand(4, 5).astype(np.float32)
+    y = np.random.rand(5, 3).astype(np.float32)
+    np.testing.assert_allclose(nd.dot(nd.array(x), nd.array(y)).asnumpy(), x @ y, rtol=1e-4)
+    # batch_dot
+    bx = np.random.rand(2, 4, 5).astype(np.float32)
+    by = np.random.rand(2, 5, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.batch_dot(nd.array(bx), nd.array(by)).asnumpy(), bx @ by, rtol=1e-4
+    )
+
+
+def test_ndarray_concat_split():
+    x = np.random.rand(2, 3).astype(np.float32)
+    y = np.random.rand(2, 3).astype(np.float32)
+    c = nd.concatenate([nd.array(x), nd.array(y)], axis=0)
+    np.testing.assert_allclose(c.asnumpy(), np.concatenate([x, y], 0))
+    parts = nd.SliceChannel(nd.array(x), num_outputs=3, axis=1)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[0].asnumpy(), x[:, 0:1])
+
+
+def test_ndarray_saveload():
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "nd.bin")
+        arrays = [nd.array(np.random.rand(3, 4)), nd.array(np.arange(5, dtype=np.int32))]
+        nd.save(fname, arrays)
+        loaded = nd.load(fname)
+        assert len(loaded) == 2
+        np.testing.assert_allclose(loaded[0].asnumpy(), arrays[0].asnumpy())
+        assert loaded[1].dtype == np.int32
+        d2 = {"w": nd.array(np.random.rand(2, 2)), "b": nd.array(np.random.rand(2))}
+        nd.save(fname, d2)
+        loaded2 = nd.load(fname)
+        assert set(loaded2.keys()) == {"w", "b"}
+        np.testing.assert_allclose(loaded2["w"].asnumpy(), d2["w"].asnumpy())
+
+
+def test_ndarray_wait_sync():
+    a = nd.ones((100, 100))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asnumpy()[0, 0] == 100
+
+
+def test_ndarray_astype_asscalar():
+    a = nd.array([1.7])
+    assert a.astype(np.int32).dtype == np.int32
+    assert abs(a.asscalar() - 1.7) < 1e-6
+
+
+def test_onehot_encode():
+    idx = nd.array([0, 2, 1])
+    out = nd.zeros((3, 3))
+    nd.onehot_encode(idx, out)
+    np.testing.assert_allclose(out.asnumpy(), np.eye(3)[[0, 2, 1]])
+
+
+def test_ndarray_pickle():
+    import pickle
+
+    a = nd.array(np.random.rand(3, 3))
+    b = pickle.loads(pickle.dumps(a))
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy())

@@ -1,0 +1,516 @@
+"""Per-op numerics (reference: tests/python/unittest/test_operator.py, 3,180 LoC
+— pattern: small symbol + check_numeric_gradient / check_symbolic_forward
+against numpy references)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import (
+    assert_almost_equal, check_numeric_gradient, check_symbolic_backward,
+    check_symbolic_forward,
+)
+
+rng = np.random.RandomState(1234)
+
+
+def test_elemwise_binary_forward_backward():
+    shape = (3, 4)
+    x = rng.rand(*shape).astype(np.float32) + 0.5
+    y = rng.rand(*shape).astype(np.float32) + 0.5
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    for op, npf, ga, gb in [
+        (a + b, lambda x, y: x + y, lambda x, y: np.ones_like(x), lambda x, y: np.ones_like(y)),
+        (a * b, lambda x, y: x * y, lambda x, y: y, lambda x, y: x),
+        (a - b, lambda x, y: x - y, lambda x, y: np.ones_like(x), lambda x, y: -np.ones_like(y)),
+        (a / b, lambda x, y: x / y, lambda x, y: 1 / y, lambda x, y: -x / y ** 2),
+    ]:
+        check_symbolic_forward(op, {"a": x, "b": y}, [npf(x, y)], rtol=1e-4)
+        og = np.ones(shape, np.float32)
+        check_symbolic_backward(
+            op, {"a": x, "b": y}, og, {"a": ga(x, y), "b": gb(x, y)}, rtol=1e-4
+        )
+
+
+def test_unary_ops_forward():
+    x = rng.rand(3, 4).astype(np.float32) * 0.8 + 0.1
+    v = sym.Variable("x")
+    cases = {
+        "exp": np.exp, "log": np.log, "sqrt": np.sqrt, "square": np.square,
+        "abs": np.abs, "sign": np.sign, "floor": np.floor, "ceil": np.ceil,
+        "sin": np.sin, "cos": np.cos, "tanh": np.tanh,
+        "sigmoid": lambda z: 1 / (1 + np.exp(-z)),
+        "relu": lambda z: np.maximum(z, 0),
+        "log1p": np.log1p, "expm1": np.expm1, "rsqrt": lambda z: 1 / np.sqrt(z),
+    }
+    for name, npf in cases.items():
+        s = getattr(sym, name)(v)
+        check_symbolic_forward(s, {"x": x}, [npf(x)], rtol=1e-4, atol=1e-6)
+
+
+def test_unary_grad_numeric():
+    x = rng.rand(3, 3).astype(np.float32) * 0.8 + 0.1
+    for name in ["exp", "log", "sqrt", "tanh", "sigmoid", "square"]:
+        s = getattr(sym, name)(sym.Variable("x"))
+        check_numeric_gradient(s, {"x": x}, rtol=5e-2, atol=1e-3)
+
+
+def test_scalar_ops():
+    x = rng.rand(3, 4).astype(np.float32) + 0.5
+    v = sym.Variable("x")
+    check_symbolic_forward(v + 2.0, {"x": x}, [x + 2], rtol=1e-5)
+    check_symbolic_forward(2.0 - v, {"x": x}, [2 - x], rtol=1e-5)
+    check_symbolic_forward(v * 3.0, {"x": x}, [x * 3], rtol=1e-5)
+    check_symbolic_forward(v / 2.0, {"x": x}, [x / 2], rtol=1e-5)
+    check_symbolic_forward(v ** 2.0, {"x": x}, [x ** 2], rtol=1e-4)
+
+
+def test_broadcast_ops():
+    x = rng.rand(2, 3, 4).astype(np.float32)
+    y = rng.rand(1, 3, 1).astype(np.float32) + 0.5
+    a, b = sym.Variable("a"), sym.Variable("b")
+    check_symbolic_forward(sym.broadcast_add(a, b), {"a": x, "b": y}, [x + y], rtol=1e-5)
+    check_symbolic_forward(sym.broadcast_mul(a, b), {"a": x, "b": y}, [x * y], rtol=1e-5)
+    check_symbolic_forward(sym.broadcast_div(a, b), {"a": x, "b": y}, [x / y], rtol=1e-5)
+    # broadcast grad reduces over broadcast axes
+    og = np.ones_like(x)
+    check_symbolic_backward(
+        sym.broadcast_add(a, b), {"a": x, "b": y}, og,
+        {"a": np.ones_like(x), "b": np.ones_like(x).sum(axis=(0, 2), keepdims=True)},
+        rtol=1e-4,
+    )
+
+
+def test_reduce_ops():
+    x = rng.rand(2, 3, 4).astype(np.float32)
+    v = sym.Variable("x")
+    check_symbolic_forward(sym.sum(v), {"x": x}, [x.sum()], rtol=1e-5)
+    check_symbolic_forward(sym.sum(v, axis=1), {"x": x}, [x.sum(1)], rtol=1e-5)
+    check_symbolic_forward(sym.mean(v, axis=(0, 2)), {"x": x}, [x.mean((0, 2))], rtol=1e-5)
+    check_symbolic_forward(sym.max(v, axis=2), {"x": x}, [x.max(2)], rtol=1e-5)
+    check_symbolic_forward(sym.prod(v, axis=0), {"x": x}, [x.prod(0)], rtol=1e-5)
+    check_symbolic_forward(
+        sym.sum(v, axis=1, exclude=True), {"x": x}, [x.sum(axis=(0, 2))], rtol=1e-5
+    )
+    check_symbolic_forward(sym.norm(v), {"x": x}, [np.sqrt((x ** 2).sum())], rtol=1e-5)
+
+
+def test_argmax_argmin():
+    x = rng.rand(3, 5).astype(np.float32)
+    v = sym.Variable("x")
+    check_symbolic_forward(sym.argmax(v, axis=1), {"x": x}, [x.argmax(1).astype(np.float32)])
+    check_symbolic_forward(sym.argmin(v, axis=0), {"x": x}, [x.argmin(0).astype(np.float32)])
+
+
+def test_transpose_reshape_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    v = sym.Variable("x")
+    check_symbolic_forward(sym.transpose(v, axes=(2, 0, 1)), {"x": x}, [x.transpose(2, 0, 1)])
+    check_symbolic_forward(sym.Reshape(v, shape=(4, 6)), {"x": x}, [x.reshape(4, 6)])
+    check_symbolic_forward(sym.Reshape(v, shape=(0, -1)), {"x": x}, [x.reshape(2, 12)])
+    check_symbolic_forward(sym.Reshape(v, shape=(-1,)), {"x": x}, [x.reshape(-1)])
+    check_symbolic_forward(sym.Flatten(v), {"x": x}, [x.reshape(2, 12)])
+    check_symbolic_forward(sym.expand_dims(v, axis=1), {"x": x}, [x[:, None]])
+    check_symbolic_forward(sym.SwapAxis(v, dim1=0, dim2=2), {"x": x}, [x.swapaxes(0, 2)])
+
+
+def test_mx_reshape_special_codes():
+    from mxnet_tpu.ops.matrix import mx_reshape
+
+    assert mx_reshape((2, 3, 4), (0, -1)) == (2, 12)
+    assert mx_reshape((2, 3, 4), (-2,)) == (2, 3, 4)
+    assert mx_reshape((2, 3, 4), (0, -3)) == (2, 12)
+    assert mx_reshape((2, 3, 4), (-4, 1, 2, -2)) == (1, 2, 3, 4)
+    assert mx_reshape((2, 12), (0, -4, 3, -1)) == (2, 3, 4)
+
+
+def test_slice_ops():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    v = sym.Variable("x")
+    check_symbolic_forward(sym.slice(v, begin=(1, 2), end=(3, 5)), {"x": x}, [x[1:3, 2:5]])
+    check_symbolic_forward(sym.slice_axis(v, axis=1, begin=1, end=4), {"x": x}, [x[:, 1:4]])
+    check_symbolic_forward(sym.slice_axis(v, axis=0, begin=-2, end=None), {"x": x}, [x[-2:]])
+    check_symbolic_forward(sym.reverse(v, axis=1), {"x": x}, [x[:, ::-1]])
+
+
+def test_concat_op():
+    x = rng.rand(2, 3).astype(np.float32)
+    y = rng.rand(2, 4).astype(np.float32)
+    a, b = sym.Variable("a"), sym.Variable("b")
+    c = sym.Concat(a, b, dim=1)
+    check_symbolic_forward(c, {"a": x, "b": y}, [np.concatenate([x, y], 1)])
+    og = np.ones((2, 7), np.float32)
+    check_symbolic_backward(c, {"a": x, "b": y}, og, {"a": np.ones_like(x), "b": np.ones_like(y)})
+
+
+def test_where_clip_tile_repeat():
+    x = rng.rand(3, 4).astype(np.float32)
+    v = sym.Variable("x")
+    check_symbolic_forward(sym.clip(v, a_min=0.2, a_max=0.8), {"x": x}, [np.clip(x, 0.2, 0.8)])
+    check_symbolic_forward(sym.tile(v, reps=(2, 1)), {"x": x}, [np.tile(x, (2, 1))])
+    check_symbolic_forward(sym.repeat(v, repeats=2, axis=1), {"x": x}, [np.repeat(x, 2, 1)])
+    cond = (rng.rand(3, 4) > 0.5).astype(np.float32)
+    y = rng.rand(3, 4).astype(np.float32)
+    out = sym.where(sym.Variable("c"), sym.Variable("a"), sym.Variable("b"))
+    check_symbolic_forward(
+        out, {"c": cond, "a": x, "b": y}, [np.where(cond.astype(bool), x, y)]
+    )
+
+
+def test_fully_connected():
+    x = rng.rand(4, 5).astype(np.float32)
+    w = rng.rand(3, 5).astype(np.float32)
+    b = rng.rand(3).astype(np.float32)
+    fc = sym.FullyConnected(sym.Variable("x"), sym.Variable("w"), sym.Variable("b"), num_hidden=3)
+    check_symbolic_forward(fc, {"x": x, "w": w, "b": b}, [x @ w.T + b], rtol=1e-4)
+    check_numeric_gradient(fc, {"x": x, "w": w, "b": b}, rtol=5e-2, atol=1e-2)
+    # no_bias + flatten of >2d input
+    x4 = rng.rand(2, 3, 2, 2).astype(np.float32)
+    w2 = rng.rand(4, 12).astype(np.float32)
+    fc2 = sym.FullyConnected(sym.Variable("x"), sym.Variable("w"), num_hidden=4, no_bias=True)
+    check_symbolic_forward(fc2, {"x": x4, "w": w2}, [x4.reshape(2, -1) @ w2.T], rtol=1e-4)
+
+
+def np_conv2d(x, w, b=None, stride=(1, 1), pad=(0, 0)):
+    n, c, h, ww = x.shape
+    f, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])))
+    oh = (h + 2 * pad[0] - kh) // stride[0] + 1
+    ow = (ww + 2 * pad[1] - kw) // stride[1] + 1
+    out = np.zeros((n, f, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride[0] : i * stride[0] + kh, j * stride[1] : j * stride[1] + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, axes=([1, 2, 3], [1, 2, 3]))
+    if b is not None:
+        out += b[None, :, None, None]
+    return out
+
+
+def test_convolution():
+    x = rng.rand(2, 3, 7, 7).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)
+    b = rng.rand(4).astype(np.float32)
+    conv = sym.Convolution(
+        sym.Variable("x"), sym.Variable("w"), sym.Variable("b"),
+        kernel=(3, 3), num_filter=4, stride=(2, 2), pad=(1, 1),
+    )
+    expected = np_conv2d(x, w, b, stride=(2, 2), pad=(1, 1))
+    check_symbolic_forward(conv, {"x": x, "w": w, "b": b}, [expected], rtol=1e-3, atol=1e-4)
+    check_numeric_gradient(conv, {"x": x, "w": w, "b": b}, rtol=5e-2, atol=5e-2)
+
+
+def test_convolution_grouped():
+    x = rng.rand(1, 4, 5, 5).astype(np.float32)
+    w = rng.rand(4, 2, 3, 3).astype(np.float32)
+    conv = sym.Convolution(
+        sym.Variable("x"), sym.Variable("w"), kernel=(3, 3), num_filter=4,
+        num_group=2, no_bias=True,
+    )
+    e1 = np_conv2d(x[:, :2], w[:2])
+    e2 = np_conv2d(x[:, 2:], w[2:])
+    check_symbolic_forward(conv, {"x": x, "w": w}, [np.concatenate([e1, e2], 1)], rtol=1e-3, atol=1e-4)
+
+
+def test_deconvolution_shape_inverse():
+    # deconv(conv(x)) shape round-trips (reference test_operator.py check_deconvolution)
+    data = sym.Variable("x")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=2, stride=(2, 2), pad=(1, 1), name="conv")
+    deconv = sym.Deconvolution(conv, kernel=(3, 3), num_filter=3, stride=(2, 2), pad=(1, 1), name="deconv")
+    _, out_shapes, _ = deconv.infer_shape(x=(1, 3, 8, 8))
+    # conv out: (8+2-3)//2+1 = 4 ; deconv out: (4-1)*2-2+3 = 7 (+adj to recover 8)
+    assert out_shapes[0][2] in (7, 8)
+    arg_shapes, _, _ = deconv.infer_shape(x=(1, 3, 8, 8))
+
+
+def test_pooling():
+    x = rng.rand(1, 2, 6, 6).astype(np.float32)
+    v = sym.Variable("x")
+    pool = sym.Pooling(v, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    expected = x.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+    check_symbolic_forward(pool, {"x": x}, [expected], rtol=1e-5)
+    avg = sym.Pooling(v, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expected_avg = x.reshape(1, 2, 3, 2, 3, 2).mean(axis=(3, 5))
+    check_symbolic_forward(avg, {"x": x}, [expected_avg], rtol=1e-5)
+    gp = sym.Pooling(v, global_pool=True, pool_type="max", kernel=(1, 1))
+    check_symbolic_forward(gp, {"x": x}, [x.max(axis=(2, 3), keepdims=True)], rtol=1e-5)
+
+
+def test_activation_ops():
+    x = (rng.rand(3, 4).astype(np.float32) - 0.5) * 4
+    v = sym.Variable("x")
+    check_symbolic_forward(sym.Activation(v, act_type="relu"), {"x": x}, [np.maximum(x, 0)])
+    check_symbolic_forward(sym.Activation(v, act_type="tanh"), {"x": x}, [np.tanh(x)], rtol=1e-5)
+    check_symbolic_forward(
+        sym.Activation(v, act_type="sigmoid"), {"x": x}, [1 / (1 + np.exp(-x))], rtol=1e-5
+    )
+    check_symbolic_forward(
+        sym.Activation(v, act_type="softrelu"), {"x": x}, [np.log1p(np.exp(x))], rtol=1e-5
+    )
+    check_symbolic_forward(
+        sym.LeakyReLU(v, act_type="leaky", slope=0.1), {"x": x}, [np.where(x > 0, x, 0.1 * x)], rtol=1e-5
+    )
+    check_symbolic_forward(
+        sym.LeakyReLU(v, act_type="elu", slope=0.5), {"x": x},
+        [np.where(x > 0, x, 0.5 * (np.exp(x) - 1))], rtol=1e-5,
+    )
+
+
+def test_batchnorm_training_stats():
+    x = rng.rand(4, 3, 2, 2).astype(np.float32) * 5
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    bn = sym.BatchNorm(sym.Variable("x"), name="bn", fix_gamma=False, momentum=0.9)
+    ex = bn.simple_bind(ctx=mx.cpu(), data=None, x=(4, 3, 2, 2))
+    ex.arg_dict["x"][:] = x
+    ex.arg_dict["bn_gamma"][:] = gamma
+    ex.arg_dict["bn_beta"][:] = beta
+    ex.aux_dict["bn_moving_mean"][:] = 0
+    ex.aux_dict["bn_moving_var"][:] = 1
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expected = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-3)
+    np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-4)
+    # moving stats updated: m*0.9 + batch*0.1
+    np.testing.assert_allclose(
+        ex.aux_dict["bn_moving_mean"].asnumpy(), 0.1 * mean, rtol=1e-4, atol=1e-5
+    )
+    # inference uses moving stats
+    ex.forward(is_train=False)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    mv = ex.aux_dict["bn_moving_var"].asnumpy()
+    expected_inf = (x - mm[None, :, None, None]) / np.sqrt(mv[None, :, None, None] + 1e-3)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), expected_inf, rtol=1e-3, atol=1e-4)
+
+
+def test_dropout():
+    x = np.ones((200, 200), np.float32)
+    d = sym.Dropout(sym.Variable("x"), p=0.5)
+    ex = d.simple_bind(ctx=mx.cpu(), x=x.shape)
+    ex.arg_dict["x"][:] = x
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    frac = (out == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = out[out != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-5)
+    # inference: identity
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x)
+
+
+def test_softmax_output_grad():
+    x = rng.rand(4, 5).astype(np.float32)
+    label = np.array([0, 1, 2, 3], np.float32)
+    s = sym.SoftmaxOutput(sym.Variable("x"), sym.Variable("label"), name="sm")
+    ex = s.bind(
+        mx.cpu(), {"x": nd.array(x), "label": nd.array(label)},
+        args_grad={"x": nd.zeros((4, 5))}, grad_req={"x": "write", "label": "null"},
+    )
+    ex.forward(is_train=True)
+    p = ex.outputs[0].asnumpy()
+    exp = np.exp(x - x.max(1, keepdims=True))
+    expected_p = exp / exp.sum(1, keepdims=True)
+    np.testing.assert_allclose(p, expected_p, rtol=1e-4)
+    ex.backward()
+    grad = ex.grad_dict["x"].asnumpy()
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    np.testing.assert_allclose(grad, expected_p - onehot, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_output_ignore_label():
+    x = rng.rand(4, 5).astype(np.float32)
+    label = np.array([0, 1, -1, 3], np.float32)
+    s = sym.SoftmaxOutput(
+        sym.Variable("x"), sym.Variable("label"), use_ignore=True, ignore_label=-1
+    )
+    ex = s.bind(
+        mx.cpu(), {"x": nd.array(x), "label": nd.array(label)},
+        args_grad={"x": nd.zeros((4, 5))}, grad_req={"x": "write", "label": "null"},
+    )
+    ex.forward(is_train=True)
+    ex.backward()
+    grad = ex.grad_dict["x"].asnumpy()
+    assert np.abs(grad[2]).sum() == 0  # ignored row has zero grad
+
+
+def test_regression_outputs():
+    x = rng.rand(4, 3).astype(np.float32)
+    y = rng.rand(4, 3).astype(np.float32)
+    lr = sym.LinearRegressionOutput(sym.Variable("x"), sym.Variable("y"))
+    ex = lr.bind(
+        mx.cpu(), {"x": nd.array(x), "y": nd.array(y)},
+        args_grad={"x": nd.zeros((4, 3))}, grad_req={"x": "write", "y": "null"},
+    )
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), x - y, rtol=1e-5)
+    # logistic
+    lo = sym.LogisticRegressionOutput(sym.Variable("x"), sym.Variable("y"))
+    ex2 = lo.bind(
+        mx.cpu(), {"x": nd.array(x), "y": nd.array(y)},
+        args_grad={"x": nd.zeros((4, 3))}, grad_req={"x": "write", "y": "null"},
+    )
+    ex2.forward(is_train=True)
+    sig = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(ex2.outputs[0].asnumpy(), sig, rtol=1e-5)
+    ex2.backward()
+    np.testing.assert_allclose(ex2.grad_dict["x"].asnumpy(), sig - y, rtol=1e-4)
+
+
+def test_make_loss_blockgrad():
+    x = rng.rand(3, 3).astype(np.float32)
+    v = sym.Variable("x")
+    ml = sym.MakeLoss(sym.square(v))
+    ex = ml.bind(mx.cpu(), {"x": nd.array(x)}, args_grad={"x": nd.zeros((3, 3))})
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), 2 * x, rtol=1e-5)
+    bg = sym.BlockGrad(sym.square(v))
+    ex2 = bg.bind(mx.cpu(), {"x": nd.array(x)}, args_grad={"x": nd.zeros((3, 3))})
+    ex2.forward(is_train=True)
+    ex2.backward(nd.ones((3, 3)))
+    np.testing.assert_allclose(ex2.grad_dict["x"].asnumpy(), 0)
+
+
+def test_embedding_and_take():
+    w = rng.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], np.float32)
+    emb = sym.Embedding(sym.Variable("idx"), sym.Variable("w"), input_dim=10, output_dim=4)
+    check_symbolic_forward(emb, {"idx": idx, "w": w}, [w[[1, 3, 5]]])
+    # backward is scatter-add into weight
+    og = np.ones((3, 4), np.float32)
+    ex = emb.bind(
+        mx.cpu(), {"idx": nd.array(idx), "w": nd.array(w)},
+        args_grad={"w": nd.zeros((10, 4)), "idx": nd.zeros(3)},
+        grad_req={"w": "write", "idx": "null"},
+    )
+    ex.forward(is_train=True)
+    ex.backward(nd.array(og))
+    grad = ex.grad_dict["w"].asnumpy()
+    expected = np.zeros((10, 4), np.float32)
+    for i in [1, 3, 5]:
+        expected[i] = 1
+    np.testing.assert_allclose(grad, expected)
+
+
+def test_one_hot_pick():
+    idx = np.array([0, 2, 1], np.float32)
+    oh = sym.one_hot(sym.Variable("i"), depth=4)
+    check_symbolic_forward(oh, {"i": idx}, [np.eye(4, dtype=np.float32)[[0, 2, 1]]])
+    x = rng.rand(3, 4).astype(np.float32)
+    pk = sym.pick(sym.Variable("x"), sym.Variable("i"), axis=1)
+    check_symbolic_forward(pk, {"x": x, "i": idx}, [x[np.arange(3), idx.astype(int)]])
+
+
+def test_topk_sort_argsort():
+    x = rng.rand(3, 6).astype(np.float32)
+    v = sym.Variable("x")
+    vals = sym.topk(v, k=2, ret_typ="value")
+    expected = np.sort(x, axis=1)[:, ::-1][:, :2]
+    check_symbolic_forward(vals, {"x": x}, [expected], rtol=1e-5)
+    srt = sym.sort(v, axis=1)
+    check_symbolic_forward(srt, {"x": x}, [np.sort(x, 1)], rtol=1e-5)
+    ags = sym.argsort(v, axis=1)
+    check_symbolic_forward(ags, {"x": x}, [np.argsort(x, 1).astype(np.float32)])
+
+
+def test_swapaxis_pad_upsampling():
+    x = rng.rand(1, 1, 3, 3).astype(np.float32)
+    v = sym.Variable("x")
+    p = sym.Pad(v, pad_width=(0, 0, 0, 0, 1, 1, 1, 1), mode="constant", constant_value=0)
+    check_symbolic_forward(p, {"x": x}, [np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))])
+    up = sym.UpSampling(v, scale=2, sample_type="nearest")
+    check_symbolic_forward(up, {"x": x}, [x.repeat(2, 2).repeat(2, 3)])
+
+
+def test_sequence_ops():
+    x = rng.rand(4, 3, 2).astype(np.float32)  # (T, N, C)
+    length = np.array([2, 3, 4], np.float32)
+    v, l = sym.Variable("x"), sym.Variable("len")
+    sm = sym.SequenceMask(v, l, use_sequence_length=True, value=0.0)
+    expected = x.copy()
+    for b, ln in enumerate(length.astype(int)):
+        expected[ln:, b] = 0
+    check_symbolic_forward(sm, {"x": x, "len": length}, [expected])
+    sl = sym.SequenceLast(v, l, use_sequence_length=True)
+    exp_last = np.stack([x[int(ln) - 1, b] for b, ln in enumerate(length)], 0)
+    check_symbolic_forward(sl, {"x": x, "len": length}, [exp_last])
+    sr = sym.SequenceReverse(v, l, use_sequence_length=True)
+    exp_rev = x.copy()
+    for b, ln in enumerate(length.astype(int)):
+        exp_rev[:ln, b] = x[:ln, b][::-1]
+    check_symbolic_forward(sr, {"x": x, "len": length}, [exp_rev])
+
+
+def test_instance_norm_l2_norm():
+    x = rng.rand(2, 3, 4, 4).astype(np.float32)
+    g = rng.rand(3).astype(np.float32)
+    b = rng.rand(3).astype(np.float32)
+    instnorm = sym.InstanceNorm(sym.Variable("x"), sym.Variable("g"), sym.Variable("b"))
+    mean = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    expected = (x - mean) / np.sqrt(var + 1e-3) * g[None, :, None, None] + b[None, :, None, None]
+    check_symbolic_forward(instnorm, {"x": x, "g": g, "b": b}, [expected], rtol=1e-3, atol=1e-4)
+    l2 = sym.L2Normalization(sym.Variable("x"), mode="instance")
+    norm = np.sqrt((x ** 2).sum(axis=(1, 2, 3), keepdims=True) + 1e-10)
+    check_symbolic_forward(l2, {"x": x}, [x / norm], rtol=1e-4)
+
+
+def test_cast():
+    x = rng.rand(3, 3).astype(np.float32)
+    c = sym.Cast(sym.Variable("x"), dtype="int32")
+    out = c.eval(ctx=mx.cpu(), x=nd.array(x))[0]
+    assert out.dtype == np.int32
+
+
+def test_optimizer_update_ops():
+    w = rng.rand(5).astype(np.float32)
+    g = rng.rand(5).astype(np.float32)
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.0)
+    np.testing.assert_allclose(out.asnumpy(), w - 0.1 * g, rtol=1e-5)
+    out2 = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01)
+    np.testing.assert_allclose(out2.asnumpy(), w - 0.1 * (g + 0.01 * w), rtol=1e-5)
+
+
+def test_grad_req_add():
+    x = rng.rand(3, 3).astype(np.float32)
+    v = sym.Variable("x")
+    s = sym.sum(sym.square(v))
+    grad = nd.array(np.ones((3, 3), np.float32))
+    ex = s.bind(mx.cpu(), {"x": nd.array(x)}, args_grad={"x": grad}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward(nd.ones(()))
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), 1 + 2 * x, rtol=1e-5)
+
+
+def test_rnn_op_shapes_and_run():
+    T, N, I, H, L = 5, 2, 3, 4, 2
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    for mode, nstate in [("lstm", 2), ("gru", 1), ("rnn_tanh", 1)]:
+        psize = rnn_param_size(L, I, H, False, mode)
+        data = nd.array(rng.rand(T, N, I).astype(np.float32))
+        params = nd.array(rng.rand(psize).astype(np.float32) * 0.1)
+        state = nd.array(np.zeros((L, N, H), np.float32))
+        args = [data, params, state]
+        if mode == "lstm":
+            args.append(nd.array(np.zeros((L, N, H), np.float32)))
+        out = nd.RNN(
+            *args, state_size=H, num_layers=L, mode=mode, state_outputs=False
+        )
+        assert out.shape == (T, N, H)
+    # bidirectional doubles feature dim
+    psize = rnn_param_size(1, I, H, True, "gru")
+    out = nd.RNN(
+        nd.array(rng.rand(T, N, I).astype(np.float32)),
+        nd.array(rng.rand(psize).astype(np.float32) * 0.1),
+        nd.array(np.zeros((2, N, H), np.float32)),
+        state_size=H, num_layers=1, mode="gru", bidirectional=True,
+    )
+    assert out.shape == (T, N, 2 * H)

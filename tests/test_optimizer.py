@@ -1,0 +1,140 @@
+"""Optimizer tests (reference: tests/python/unittest/test_optimizer.py —
+python reference updater vs fused update ops)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import optimizer as opt
+
+rng = np.random.RandomState(5)
+
+
+def _np_sgd(w, g, lr, wd=0.0, rescale=1.0, mom=None, momentum=0.0, clip=None):
+    g = g * rescale
+    if clip is not None:
+        g = np.clip(g, -clip, clip)
+    g = g + wd * w
+    if mom is not None:
+        mom[:] = momentum * mom - lr * g
+        return w + mom
+    return w - lr * g
+
+
+def test_sgd_matches_numpy():
+    shape = (4, 5)
+    w = rng.rand(*shape).astype(np.float32)
+    g = rng.rand(*shape).astype(np.float32)
+    sgd = opt.SGD(learning_rate=0.1, wd=0.01, rescale_grad=0.5)
+    weight = nd.array(w)
+    grad = nd.array(g)
+    state = sgd.create_state(0, weight)
+    sgd.update(0, weight, grad, state)
+    np.testing.assert_allclose(
+        weight.asnumpy(), _np_sgd(w, g, 0.1, wd=0.01, rescale=0.5), rtol=1e-5
+    )
+
+
+def test_sgd_momentum_matches_numpy():
+    shape = (10,)
+    w = rng.rand(*shape).astype(np.float32)
+    g = rng.rand(*shape).astype(np.float32)
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9)
+    weight = nd.array(w)
+    state = sgd.create_state(0, weight)
+    mom_np = np.zeros(shape, np.float32)
+    w_np = w.copy()
+    for _ in range(3):
+        grad = nd.array(g)
+        sgd.update(0, weight, grad, state)
+        w_np = _np_sgd(w_np, g, 0.1, mom=mom_np, momentum=0.9)
+    np.testing.assert_allclose(weight.asnumpy(), w_np, rtol=1e-5)
+    np.testing.assert_allclose(state.asnumpy(), mom_np, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    shape = (6,)
+    w = rng.rand(*shape).astype(np.float32)
+    g = rng.rand(*shape).astype(np.float32)
+    adam = opt.Adam(learning_rate=0.01)
+    weight = nd.array(w)
+    state = adam.create_state(0, weight)
+    m = np.zeros(shape, np.float32)
+    v = np.zeros(shape, np.float32)
+    w_np = w.copy()
+    for t in range(1, 4):
+        adam.update(0, weight, nd.array(g), state)
+        lr_t = 0.01 * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        w_np = w_np - lr_t * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(weight.asnumpy(), w_np, rtol=1e-4)
+
+
+def test_rmsprop_runs():
+    w = nd.array(rng.rand(5).astype(np.float32))
+    g = nd.array(rng.rand(5).astype(np.float32))
+    o = opt.RMSProp(learning_rate=0.01)
+    s = o.create_state(0, w)
+    before = w.asnumpy().copy()
+    o.update(0, w, g, s)
+    assert not np.allclose(before, w.asnumpy())
+    # centered variant
+    oc = opt.RMSProp(learning_rate=0.01, centered=True)
+    sc = oc.create_state(0, w)
+    oc.update(0, w, g, sc)
+
+
+def test_adagrad_adadelta_ftrl_run():
+    for cls in [opt.AdaGrad, opt.AdaDelta, opt.Ftrl, opt.SGLD, opt.NAG]:
+        w = nd.array(rng.rand(5).astype(np.float32))
+        g = nd.array(rng.rand(5).astype(np.float32))
+        o = cls()
+        s = o.create_state(0, w)
+        before = w.asnumpy().copy()
+        o.update(0, w, g, s)
+        assert not np.allclose(before, w.asnumpy()), cls.__name__
+
+
+def test_lr_wd_mult():
+    o = opt.SGD(learning_rate=1.0, param_idx2name={0: "a_weight", 1: "b_bias"})
+    o.set_lr_mult({"a_weight": 0.1})
+    assert o._get_lr(0) == 0.1
+    assert o._get_lr(1) == 1.0
+    # bias gets no wd by default
+    o2 = opt.SGD(wd=0.1, param_idx2name={0: "a_weight", 1: "b_bias"})
+    assert o2._get_wd(1) == 0.0
+    assert o2._get_wd(0) == 0.1
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+
+    s = FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(5) == 1.0
+    assert s(15) == 0.5
+    m = MultiFactorScheduler(step=[10, 20], factor=0.1)
+    m.base_lr = 1.0
+    assert m(5) == 1.0
+    assert abs(m(15) - 0.1) < 1e-9
+    assert abs(m(25) - 0.01) < 1e-9
+
+
+def test_updater_states_roundtrip():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    u = opt.get_updater(o)
+    w = nd.array(rng.rand(4).astype(np.float32))
+    g = nd.array(rng.rand(4).astype(np.float32))
+    u(0, g, w)
+    states = u.get_states()
+    u2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    u2.set_states(states)
+    np.testing.assert_allclose(
+        u2.states[0].asnumpy(), u.states[0].asnumpy(), rtol=1e-6
+    )
+
+
+def test_create_by_name():
+    assert isinstance(opt.create("sgd"), opt.SGD)
+    assert isinstance(opt.create("adam"), opt.Adam)
+    assert isinstance(opt.create("rmsprop"), opt.RMSProp)

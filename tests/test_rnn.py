@@ -1,0 +1,162 @@
+"""RNN cell tests (reference: tests/python/unittest/test_rnn.py — cell unroll
+shapes, param names, fused-vs-stacked consistency)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import rnn
+from mxnet_tpu import symbol as sym
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = rnn.RNNCell(100, prefix="rnn_")
+    inputs = [sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight",
+    ]
+    args, outs, auxs = outputs.infer_shape(
+        rnn_t0_data=(10, 50), rnn_t1_data=(10, 50), rnn_t2_data=(10, 50)
+    )
+    assert outs == [(10, 100)] * 3
+
+
+def test_lstm_cell_unroll():
+    cell = rnn.LSTMCell(100, prefix="rnn_", forget_bias=1.0)
+    inputs = [sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = sym.Group(outputs)
+    args, outs, auxs = outputs.infer_shape(
+        rnn_t0_data=(10, 50), rnn_t1_data=(10, 50), rnn_t2_data=(10, 50)
+    )
+    assert outs == [(10, 100)] * 3
+
+
+def test_gru_cell_unroll():
+    cell = rnn.GRUCell(100, prefix="gru_")
+    inputs = [sym.Variable("gru_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = sym.Group(outputs)
+    args, outs, auxs = outputs.infer_shape(
+        gru_t0_data=(10, 50), gru_t1_data=(10, 50), gru_t2_data=(10, 50)
+    )
+    assert outs == [(10, 100)] * 3
+
+
+def test_stacked_and_bidirectional():
+    cell = rnn.SequentialRNNCell()
+    for i in range(2):
+        cell.add(rnn.LSTMCell(100, prefix="rnn_stack%d_" % i))
+    outputs, _ = cell.unroll(3, [sym.Variable("t%d_data" % i) for i in range(3)])
+    outputs = sym.Group(outputs)
+    args, outs, _ = outputs.infer_shape(
+        t0_data=(10, 50), t1_data=(10, 50), t2_data=(10, 50)
+    )
+    assert outs == [(10, 100)] * 3
+
+    bi = rnn.BidirectionalCell(
+        rnn.LSTMCell(40, prefix="l_"), rnn.LSTMCell(40, prefix="r_")
+    )
+    outputs, _ = bi.unroll(3, [sym.Variable("t%d_data" % i) for i in range(3)])
+    outputs = sym.Group(outputs)
+    args, outs, _ = outputs.infer_shape(
+        t0_data=(10, 50), t1_data=(10, 50), t2_data=(10, 50)
+    )
+    assert outs == [(10, 80)] * 3
+
+
+def test_fused_rnn_unroll_and_run():
+    T, N, I, H = 4, 2, 3, 5
+    cell = rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="f_")
+    data = sym.Variable("data")
+    outputs2, _ = cell.unroll(T, inputs=data, layout="NTC")
+    args, outs, _ = outputs2.infer_shape(data=(N, T, I))
+    assert outs[0] == (N, T, H)
+    ex = outputs2.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    ex.arg_dict["data"][:] = np.random.rand(N, T, I).astype(np.float32)
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    psize = rnn_param_size(1, I, H, False, "lstm")
+    assert ex.arg_dict["f_parameters"].shape == (psize,)
+    ex.arg_dict["f_parameters"][:] = np.random.rand(psize).astype(np.float32) * 0.1
+    ex.forward()
+    assert ex.outputs[0].shape == (N, T, H)
+
+
+def test_fused_matches_unfused_lstm():
+    """Fused scan RNN == explicitly unrolled LSTM cells with the same weights
+    (the reference can only test this on GPU; here it's backend-independent)."""
+    T, N, I, H = 3, 2, 4, 5
+    rngs = np.random.RandomState(0)
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="lstm_", get_next_state=True)
+    data = sym.Variable("data")
+    fout, fstates = fused.unroll(T, inputs=data, layout="NTC")
+    fex = fout.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    # parameter vector packed [i2h_w, h2h_w, i2h_b, h2h_b]
+    i2h_w = rngs.randn(4 * H, I).astype(np.float32) * 0.3
+    h2h_w = rngs.randn(4 * H, H).astype(np.float32) * 0.3
+    i2h_b = rngs.randn(4 * H).astype(np.float32) * 0.1
+    h2h_b = rngs.randn(4 * H).astype(np.float32) * 0.1
+    flat = np.concatenate([i2h_w.ravel(), h2h_w.ravel(), i2h_b, h2h_b])
+    x = rngs.randn(N, T, I).astype(np.float32)
+    fex.arg_dict["data"][:] = x
+    fex.arg_dict["lstm_parameters"][:] = flat
+    fex.forward()
+    fused_out = fex.outputs[0].asnumpy()
+
+    # numpy LSTM reference, gate order i,f,c,o
+    def np_lstm(x):
+        h = np.zeros((N, H), np.float32)
+        c = np.zeros((N, H), np.float32)
+        outs = []
+        for t in range(T):
+            gates = x[:, t] @ i2h_w.T + i2h_b + h @ h2h_w.T + h2h_b
+            i, f, g, o = np.split(gates, 4, axis=1)
+            sig = lambda z: 1 / (1 + np.exp(-z))  # noqa: E731
+            i, f, o = sig(i), sig(f), sig(o)
+            g = np.tanh(g)
+            c = f * c + i * g
+            h = o * np.tanh(c)
+            outs.append(h.copy())
+        return np.stack(outs, axis=1)
+
+    np.testing.assert_allclose(fused_out, np_lstm(x), rtol=1e-4, atol=1e-5)
+
+
+def test_unfuse():
+    cell = rnn.FusedRNNCell(50, num_layers=2, mode="lstm", prefix="pre_", bidirectional=True)
+    stack = cell.unfuse()
+    outputs, _ = stack.unroll(3, [sym.Variable("t%d_data" % i) for i in range(3)])
+    outputs = sym.Group(outputs)
+    args, outs, _ = outputs.infer_shape(
+        t0_data=(10, 50), t1_data=(10, 50), t2_data=(10, 50)
+    )
+    assert outs == [(10, 100)] * 3
+
+
+def test_residual_dropout_cells():
+    base = rnn.RNNCell(10, prefix="res_")
+    cell = rnn.ResidualCell(base)
+    outputs, _ = cell.unroll(2, [sym.Variable("t%d_data" % i) for i in range(2)])
+    outputs = sym.Group(outputs)
+    args, outs, _ = outputs.infer_shape(t0_data=(4, 10), t1_data=(4, 10))
+    assert outs == [(4, 10)] * 2
+    seq = rnn.SequentialRNNCell()
+    seq.add(rnn.RNNCell(10, prefix="a_"))
+    seq.add(rnn.DropoutCell(0.3, prefix="d_"))
+    outputs, _ = seq.unroll(2, [sym.Variable("t%d_data" % i) for i in range(2)])
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [2, 3], [1, 2, 3, 4], [3, 4, 5], [1, 2]] * 10
+    it = rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[3, 5], invalid_label=0)
+    batch = next(iter(it))
+    assert batch.data[0].shape[0] == 4
+    assert batch.bucket_key in (3, 5)
+
+
+def test_encode_sentences():
+    res, vocab = rnn.encode_sentences([["a", "b"], ["b", "c"]], start_label=1)
+    assert len(vocab) >= 3
+    assert res[0][1] == res[1][0]  # "b" same id

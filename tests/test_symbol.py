@@ -1,0 +1,115 @@
+"""Symbol tests (reference: tests/python/unittest/test_symbol.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=4)
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_symbol_basic():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias", "softmax_label",
+    ]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.name == "softmax"
+
+
+def test_symbol_compose():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = sym.FullyConnected(data=net1, name="fc2", num_hidden=100)
+    assert net1.list_arguments() == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    net2 = sym.FullyConnected(name="fc3", num_hidden=10)
+    net2 = sym.Activation(data=net2, act_type="relu")
+    net2 = sym.FullyConnected(data=net2, name="fc4", num_hidden=20)
+    composed = net2(fc3_data=net1, name="composed")
+    multi_out = sym.Group([composed, net1])
+    assert len(multi_out.list_outputs()) == 2
+
+
+def test_symbol_internals():
+    data = sym.Variable("data")
+    oldfc = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = sym.FullyConnected(data=oldfc, name="fc2", num_hidden=100)
+    internals = net1.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == oldfc.list_arguments()
+
+
+def test_symbol_children():
+    data = sym.Variable("data")
+    oldfc = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = sym.FullyConnected(data=oldfc, name="fc2", num_hidden=100)
+    assert net1.get_children().list_outputs() == ["fc1_output", "fc2_weight", "fc2_bias"]
+
+
+def test_symbol_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    assert net2.tojson() == js
+    # executes the same
+    x = np.random.rand(2, 6).astype(np.float32)
+    args = {n: mx.nd.array(np.random.rand(*s).astype(np.float32))
+            for n, s in zip(net.list_arguments(), net.infer_shape(data=(2, 6))[0])}
+    e1 = net.bind(mx.cpu(), dict(args))
+    e2 = net2.bind(mx.cpu(), dict(args))
+    e1.forward()
+    e2.forward()
+    np.testing.assert_allclose(e1.outputs[0].asnumpy(), e2.outputs[0].asnumpy(), rtol=1e-5)
+
+
+def test_symbol_saveload(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "net.json")
+    net.save(fname)
+    net2 = sym.load(fname)
+    assert net2.tojson() == net.tojson()
+
+
+def test_symbol_multi_output_indexing():
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=3, name="split")
+    assert len(parts.list_outputs()) == 3
+    p0 = parts[0]
+    assert len(p0.list_outputs()) == 1
+    outs = list(parts)
+    assert len(outs) == 3
+
+
+def test_symbol_pickle_via_json():
+    net = _mlp()
+    import pickle
+
+    # symbols aren't directly picklable in the reference either; json is the contract
+    js = net.tojson()
+    assert sym.load_json(js).list_arguments() == net.list_arguments()
+
+
+def test_variable_shape_attr():
+    v = sym.Variable("x", shape=(3, 4), lr_mult=2.0)
+    assert v.attr("__shape__") == "(3, 4)"
+    assert v.attr("__lr_mult__") == "2.0"
+
+
+def test_symbol_arithmetic_graph():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2 - a / b + (1 - a) + a ** 2
+    x = np.array([2.0], np.float32)
+    y = np.array([4.0], np.float32)
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array(x), "b": mx.nd.array(y)})
+    ex.forward()
+    expected = (x + y) * 2 - x / y + (1 - x) + x ** 2
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), expected, rtol=1e-5)
