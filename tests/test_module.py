@@ -37,6 +37,7 @@ def test_module_dtype_shapes():
 
 
 def test_module_fit_converges():
+    mx.random.seed(42)
     x, y = _toy_data()
     train = mx.io.NDArrayIter(x, y, batch_size=32)
     mod = mx.mod.Module(_mlp())
@@ -80,6 +81,7 @@ def test_module_checkpoint_roundtrip(tmp_path):
 
 def test_module_multi_device_data_parallel():
     # the reference's fake-multi-device trick: several cpu contexts
+    mx.random.seed(21)
     x, y = _toy_data(n=128)
     train = mx.io.NDArrayIter(x, y, batch_size=32)
     mod = mx.mod.Module(_mlp(), context=[mx.cpu(0), mx.cpu(1)])
@@ -90,6 +92,7 @@ def test_module_multi_device_data_parallel():
 
 
 def test_module_kvstore_device():
+    mx.random.seed(33)
     x, y = _toy_data(n=128)
     train = mx.io.NDArrayIter(x, y, batch_size=32)
     mod = mx.mod.Module(_mlp(), context=[mx.cpu(0), mx.cpu(1)])
@@ -128,6 +131,7 @@ def test_module_states_save_restore(tmp_path):
 
 
 def test_sequential_module():
+    mx.random.seed(7)
     x, y = _toy_data()
     train = mx.io.NDArrayIter(x, y, batch_size=32)
     net1 = sym.FullyConnected(sym.Variable("data"), num_hidden=16, name="fc1")
@@ -137,7 +141,8 @@ def test_sequential_module():
     smod = mx.mod.SequentialModule()
     smod.add(mx.mod.Module(net1, label_names=None))
     smod.add(mx.mod.Module(net2), take_labels=True, auto_wiring=True)
-    smod.fit(train, num_epoch=4, optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    smod.fit(train, num_epoch=8, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
     acc = smod.score(train, "acc")[0][1]
     assert acc > 0.8, acc
 
@@ -156,6 +161,7 @@ def test_bucketing_module():
         net = sym.SoftmaxOutput(net, label, name="softmax")
         return net, ["data"], ["softmax_label"]
 
+    mx.random.seed(5)
     mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
     r = np.random.RandomState(3)
 
