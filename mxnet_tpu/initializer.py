@@ -61,7 +61,9 @@ class Initializer:
             raise TypeError("desc must be a string or InitDesc")
         if isinstance(desc, InitDesc) and desc.attrs.get("__init__"):
             klass, kwargs = json.loads(desc.attrs["__init__"])
-            create(klass, **kwargs)._init_weight(desc, arr)
+            sub = create(klass, **kwargs)
+            desc.global_init = self  # nested inits fall back to the global one
+            sub._init_weight(desc, arr)
             return
         name = desc.lower()
         if name.endswith("upsampling"):
@@ -356,6 +358,10 @@ class FusedRNN(Initializer):
         from .ops.rnn_ops import _gates, _unpack_params
         from . import ndarray as nd
 
+        if self._init is None:
+            # fall back to the enclosing global initializer (reference:
+            # initializer.py FusedRNN uses desc.global_init when init is None)
+            self._init = getattr(desc, "global_init", None) or Uniform(0.07)
         H, L = self._num_hidden, self._num_layers
         g = _gates(self._mode)
         d = 2 if self._bidirectional else 1

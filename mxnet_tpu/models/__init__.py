@@ -1,0 +1,16 @@
+"""Model zoo — symbol builders for the reference's benchmark model families
+(reference: example/image-classification/symbols/{lenet,mlp,alexnet,vgg,
+inception-bn,resnet,googlenet}.py and example/rnn, example/gan).
+
+These are graph constructors over mx.sym — the flagship configs the baselines
+measure (BASELINE.md): ResNet-50/152 ImageNet, Inception-BN/v3, AlexNet, VGG,
+LeNet MNIST, LSTM LM, DCGAN.
+"""
+from .lenet import get_symbol as lenet
+from .mlp import get_symbol as mlp
+from .alexnet import get_symbol as alexnet
+from .vgg import get_symbol as vgg
+from .resnet import get_symbol as resnet
+from .inception_bn import get_symbol as inception_bn
+from .lstm_lm import get_symbol as lstm_lm
+from .dcgan import make_generator, make_discriminator

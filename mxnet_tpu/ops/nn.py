@@ -257,15 +257,19 @@ def _pooling(octx, attrs, args, auxs):
     strides = (1, 1) + tuple(stride)
     padding = [(0, 0), (0, 0)] + pads
     pt = attrs["pool_type"]
+    # NOTE: init must be a concrete scalar (python/np), not a jnp array — the
+    # monoid pattern-match that routes to the differentiable reduce_window_max/
+    # sum primitives fails on tracer inits under jit.
     if pt == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        out = jax.lax.reduce_window(x, jnp.asarray(init, x.dtype), jax.lax.max, window, strides, padding)
+        init = -np.inf if jnp.issubdtype(x.dtype, jnp.floating) else np.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, padding)
     elif pt in ("avg", "sum"):
-        s = jax.lax.reduce_window(x, jnp.asarray(0, x.dtype), jax.lax.add, window, strides, padding)
+        zero = np.array(0, x.dtype).item() if not jnp.issubdtype(x.dtype, jnp.floating) else 0.0
+        s = jax.lax.reduce_window(x, zero, jax.lax.add, window, strides, padding)
         if pt == "avg":
             ones = jnp.ones(x.shape[2:], x.dtype)
             cnt = jax.lax.reduce_window(
-                ones, jnp.asarray(0, x.dtype), jax.lax.add, tuple(kernel), tuple(stride), pads
+                ones, zero, jax.lax.add, tuple(kernel), tuple(stride), pads
             )
             s = s / cnt
         out = s
@@ -483,7 +487,7 @@ def _lrn(octx, attrs, args, auxs):
     half = n // 2
     sq = jnp.square(x)
     ssum = jax.lax.reduce_window(
-        sq, jnp.asarray(0, x.dtype), jax.lax.add,
+        sq, 0.0, jax.lax.add,
         (1, n, 1, 1), (1, 1, 1, 1), [(0, 0), (half, half), (0, 0), (0, 0)],
     )
     norm = jnp.power(attrs["knorm"] + (attrs["alpha"] / n) * ssum, -attrs["beta"])

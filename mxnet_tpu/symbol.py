@@ -506,6 +506,10 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None
         extra["__wd_mult__"] = str(wd_mult)
     if dtype is not None:
         extra["__dtype__"] = str(np.dtype(dtype))
+    if init is not None:
+        # store the initializer spec so Module.init_params dispatches to it
+        # (reference: symbol.py Variable stores init.dumps() as __init__)
+        extra["__init__"] = init.dumps() if hasattr(init, "dumps") else str(init)
     extra.update({k: str(v) for k, v in kwargs.items()})
     node = _Node(None, name, {}, [], extra)
     return Symbol([(node, 0)])

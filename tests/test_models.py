@@ -1,0 +1,103 @@
+"""Model zoo smoke tests: shape inference + one fwd/bwd step per family
+(reference: small end-to-end fits in tests/python/train/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu import ndarray as nd
+
+
+def _one_step(net, data_shape, label_shape=None, label_name="softmax_label"):
+    mod = mx.mod.Module(
+        net, label_names=[label_name] if label_shape else None
+    )
+    mod.bind(
+        [("data", data_shape)],
+        [(label_name, label_shape)] if label_shape else None,
+    )
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.01})
+    data = [nd.array(np.random.rand(*data_shape).astype(np.float32))]
+    label = [nd.array(np.zeros(label_shape, np.float32))] if label_shape else None
+    batch = mx.io.DataBatch(data, label)
+    mod.forward_backward(batch)
+    mod.update()
+    return mod.get_outputs()[0]
+
+
+def test_mlp_model():
+    out = _one_step(models.mlp(num_classes=10), (4, 28 * 28), (4,))
+    assert out.shape == (4, 10)
+
+
+def test_lenet_model():
+    out = _one_step(models.lenet(num_classes=10), (2, 1, 28, 28), (2,))
+    assert out.shape == (2, 10)
+
+
+def test_resnet18_cifar():
+    net = models.resnet(num_classes=10, num_layers=20, image_shape="3,28,28")
+    out = _one_step(net, (2, 3, 28, 28), (2,))
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_shapes():
+    net = models.resnet(num_classes=1000, num_layers=50, image_shape="3,224,224")
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 1000)
+    # bottleneck structure: conv0 7x7/64 stem
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["conv0_weight"] == (64, 3, 7, 7)
+    assert d["stage4_unit1_conv3_weight"] == (2048, 1, 1, 1)[0:1] + (512, 1, 1)
+    assert d["fc1_weight"] == (1000, 2048)
+    n_params = sum(int(np.prod(s)) for n, s in d.items() if n != "data" and n != "softmax_label")
+    assert 24e6 < n_params < 27e6  # ~25.5M params in ResNet-50
+
+
+def test_inception_bn_shapes():
+    net = models.inception_bn(num_classes=1000)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 1000)
+
+
+def test_vgg16_shapes():
+    net = models.vgg(num_classes=1000, num_layers=16)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 1000)
+
+
+def test_alexnet_shapes():
+    net = models.alexnet(num_classes=1000)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 1000)
+
+
+def test_lstm_lm_bucketing_one_step():
+    sym_gen = models.lstm_lm(num_embed=16, num_hidden=16, num_layers=1, vocab_size=50)
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind([("data", (4, 8))], [("softmax_label", (4, 8))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    d = nd.array(np.random.randint(0, 50, (4, 8)).astype(np.float32))
+    l = nd.array(np.random.randint(0, 50, (4, 8)).astype(np.float32))
+    batch = mx.io.DataBatch(
+        [d], [l], bucket_key=8,
+        provide_data=[mx.io.DataDesc("data", (4, 8))],
+        provide_label=[mx.io.DataDesc("softmax_label", (4, 8))],
+    )
+    mod.forward_backward(batch)
+    mod.update()
+    assert mod.get_outputs()[0].shape == (32, 50)
+
+
+def test_dcgan_generator_discriminator():
+    gen = models.make_generator(ngf=8, nc=3)
+    _, gout, _ = gen.infer_shape(rand=(2, 100, 1, 1))
+    assert gout[0] == (2, 3, 64, 64)
+    disc = models.make_discriminator(ndf=8)
+    _, dout, _ = disc.infer_shape(data=(2, 3, 64, 64), label=(2, 1))
+    assert dout[0] == (2, 1)
+    # one G step + one D step
+    out = _one_step(disc, (2, 3, 64, 64), (2, 1), label_name="label")
+    assert out.shape == (2, 1)
