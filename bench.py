@@ -1,0 +1,82 @@
+"""Benchmark driver: ResNet-50 ImageNet training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's best published single-chip ResNet-50 training number,
+181.53 img/s fp32 batch 32 on P100 (docs/how_to/perf.md:188, BASELINE.md).
+
+Runs the SPMD fused train step (forward+backward+SGD update as one XLA
+program, parallel/spmd.py) in mixed precision: bf16 conv/matmul compute with
+fp32 accumulation and fp32 master params — the TPU-native equivalent of the
+reference's fp32 training (its pseudo-fp16 path, convolution.cu:30-45, is the
+GPU analog).  Set MXNET_TPU_BENCH_DTYPE=float32 for pure fp32.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    batch = int(os.environ.get("MXNET_TPU_BENCH_BATCH", "32"))
+    dtype_name = os.environ.get("MXNET_TPU_BENCH_DTYPE", "bfloat16")
+    steps = int(os.environ.get("MXNET_TPU_BENCH_STEPS", "30"))
+    warmup = int(os.environ.get("MXNET_TPU_BENCH_WARMUP", "5"))
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import build_mesh
+    from mxnet_tpu.parallel.spmd import SPMDTrainer
+
+    if dtype_name == "bfloat16":
+        import jax.numpy as jnp
+
+        dtype = np.dtype(jnp.bfloat16)
+    else:
+        dtype = np.dtype(np.float32)
+
+    net = models.resnet(num_classes=1000, num_layers=50, image_shape="3,224,224")
+    devices = jax.devices()
+    mesh = build_mesh({"dp": 1}, devices[:1])
+    trainer = SPMDTrainer(
+        net, mesh,
+        data_shapes=[("data", (batch, 3, 224, 224))],
+        label_shapes=[("softmax_label", (batch,))],
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                          "rescale_grad": 1.0 / batch},
+        dtype=np.float32,  # master params fp32
+        input_dtype=dtype,
+    )
+    params, auxs, moms = trainer.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2))
+    rng = np.random.RandomState(0)
+    data = rng.rand(batch, 3, 224, 224).astype(np.float32)
+    label = rng.randint(0, 1000, (batch,)).astype(np.float32)
+    inputs = {"data": data.astype(dtype), "softmax_label": label}
+
+    # warmup (includes compile)
+    for _ in range(warmup):
+        params, auxs, moms, outs = trainer.step(params, auxs, moms, inputs)
+    jax.block_until_ready(outs)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, auxs, moms, outs = trainer.step(params, auxs, moms, inputs)
+    jax.block_until_ready(outs)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = steps * batch / dt
+    baseline = 181.53  # P100 fp32 train img/s (BASELINE.md)
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,7 @@ __all__ = ["SPMDTrainer"]
 class SPMDTrainer:
     def __init__(self, symbol, mesh, data_shapes, optimizer="sgd", optimizer_params=None,
                  label_shapes=None, dtype=np.float32, param_rules=None, batch_axis="dp",
-                 donate=True):
+                 donate=True, compute_dtype=None, input_dtype=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -54,6 +54,13 @@ class SPMDTrainer:
         self.wd = opt_params.get("wd", 0.0)
         self.rescale_grad = opt_params.get("rescale_grad", 1.0)
         self.dtype = dtype
+        # mixed precision: master params stay `dtype` (fp32); the graph runs in
+        # `compute_dtype` (bf16 on TPU — MXU-native) with fp32 accumulation via
+        # each op's preferred_element_type; grads flow back through the cast so
+        # updates are fp32. The TPU-native form of the reference's fp16 story.
+        self.compute_dtype = np.dtype(compute_dtype) if compute_dtype is not None else None
+        if input_dtype is not None and self.compute_dtype is None and np.dtype(input_dtype) != np.dtype(dtype):
+            self.compute_dtype = np.dtype(input_dtype)
         self._param_rules = [(re.compile(k), v) for k, v in (param_rules or {}).items()]
         self._loss_flags = self._detect_loss_outputs()
 
@@ -125,11 +132,16 @@ class SPMDTrainer:
         lr, momentum, wd, rescale = self.lr, self.momentum, self.wd, self.rescale_grad
         graph_fn = self._graph_fn
 
+        compute_dtype = self.compute_dtype
+
         def step(params, auxs, moms, inputs, rng):
             aux_list = [auxs[n] for n in aux_order]
 
             def f(p):
+                if compute_dtype is not None:
+                    p = {n: v.astype(compute_dtype) for n, v in p.items()}
                 outs, new_aux = graph_fn(assemble(p, inputs), aux_list, rng, True)
+                new_aux = [a.astype(np.float32) for a in new_aux]
                 return outs, new_aux
 
             outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
