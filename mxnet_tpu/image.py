@@ -1,0 +1,443 @@
+"""Image utilities + python ImageIter (reference: python/mxnet/image.py —
+imdecode, scale_down, resize_short, fixed_crop, random_crop, center_crop,
+color_normalize, augmenter list CreateAugmenter :404, ImageIter :502).
+
+Decode backend: PIL (the reference uses OpenCV). Array convention matches the
+reference: HWC uint8/float, BGR channel order from imdecode (cv2-compatible)
+unless ``to_rgb`` is set, then RGB.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import random as pyrandom
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+from . import recordio
+
+__all__ = [
+    "imdecode", "imresize", "scale_down", "resize_short", "fixed_crop", "random_crop",
+    "center_crop", "color_normalize", "random_size_crop", "HorizontalFlipAug",
+    "CreateAugmenter", "ImageIter",
+]
+
+
+def imdecode(buf, to_rgb=True, flag=1, **kwargs):
+    """Decode an image byte buffer to an NDArray (HWC).
+
+    (reference: image.py imdecode → cv2.imdecode op src/io/image_io.cc)
+    """
+    from PIL import Image
+
+    if isinstance(buf, nd.NDArray):
+        buf = buf.asnumpy().tobytes()
+    elif isinstance(buf, np.ndarray):
+        buf = buf.tobytes()
+    img = Image.open(_io.BytesIO(buf))
+    if flag == 0:
+        img = img.convert("L")
+        arr = np.asarray(img)[:, :, None]
+    else:
+        img = img.convert("RGB")
+        arr = np.asarray(img)
+        if not to_rgb:
+            arr = arr[:, :, ::-1]
+    return nd.array(arr.astype(np.uint8), dtype=np.uint8)
+
+
+def imresize(src, w, h, interp=2):
+    """Resize to exactly (w, h) (reference: cv2.resize wrapper)."""
+    from PIL import Image
+
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+    squeeze = arr.shape[2] == 1
+    im = Image.fromarray(arr.squeeze(-1) if squeeze else arr.astype(np.uint8))
+    im = im.resize((w, h), Image.BILINEAR if interp else Image.NEAREST)
+    out = np.asarray(im)
+    if squeeze:
+        out = out[:, :, None]
+    return nd.array(out.astype(np.uint8), dtype=np.uint8)
+
+
+def scale_down(src_size, size):
+    """Scale target size down to fit in src (reference: image.py scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge == size (reference: image.py resize_short)."""
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """(reference: image.py fixed_crop)"""
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+    out = arr[y0 : y0 + h, x0 : x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(nd.array(out, dtype=np.uint8), size[0], size[1], interp)
+    return nd.array(out, dtype=np.uint8)
+
+
+def random_crop(src, size, interp=2):
+    """(reference: image.py random_crop)"""
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """(reference: image.py center_crop)"""
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0), interp=2):
+    """Random area+aspect crop (reference: image.py random_size_crop)."""
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+    h, w = arr.shape[:2]
+    area = w * h
+    for _ in range(10):
+        new_area = pyrandom.uniform(min_area, 1.0) * area
+        new_ratio = pyrandom.uniform(*ratio)
+        new_w = int(round(np.sqrt(new_area * new_ratio)))
+        new_h = int(round(np.sqrt(new_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            return fixed_crop(src, x0, y0, new_w, new_h, size, interp), (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    """(reference: image.py color_normalize)"""
+    arr = src.asnumpy().astype(np.float32) if isinstance(src, nd.NDArray) else np.asarray(src, np.float32)
+    mean = np.asarray(mean, np.float32)
+    arr = arr - mean
+    if std is not None:
+        arr = arr / np.asarray(std, np.float32)
+    return nd.array(arr)
+
+
+# ---- augmenters (reference: image.py CreateAugmenter :404) ----------------
+class Augmenter:
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0), interp=2):
+        self.size, self.min_area, self.ratio, self.interp = size, min_area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.min_area, self.ratio, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+            return nd.array(arr[:, ::-1].copy(), dtype=np.uint8)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        arr = src.asnumpy().astype(np.float32) * alpha
+        return nd.array(np.clip(arr, 0, 255).astype(np.uint8), dtype=np.uint8)
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        arr = src.asnumpy().astype(np.float32)
+        gray = arr.mean()
+        arr = arr * alpha + gray * (1 - alpha)
+        return nd.array(np.clip(arr, 0, 255).astype(np.uint8), dtype=np.uint8)
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        arr = src.asnumpy().astype(np.float32)
+        coef = np.array([0.299, 0.587, 0.114], np.float32)
+        gray = (arr * coef).sum(axis=2, keepdims=True)
+        arr = arr * alpha + gray * (1 - alpha)
+        return nd.array(np.clip(arr, 0, 255).astype(np.uint8), dtype=np.uint8)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (reference: image.py pca_noise part of HSL aug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        arr = src.asnumpy().astype(np.float32) + rgb
+        return nd.array(np.clip(arr, 0, 255).astype(np.uint8), dtype=np.uint8)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+
+    def __call__(self, src):
+        arr = src.asnumpy().astype(np.float32)
+        if self.mean is not None:
+            arr = arr - self.mean
+        if self.std is not None:
+            arr = arr / self.std
+        return nd.array(arr)
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        arr = src.asnumpy().astype(np.float32)
+        return nd.array(arr)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Build the standard augmenter list (reference: image.py:404)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([
+            [-0.5675, 0.7192, 0.4009],
+            [-0.5808, -0.0045, -0.8140],
+            [-0.5836, -0.6948, 0.4203],
+        ])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Pure-python image iterator over .rec files or image lists
+    (reference: image.py ImageIter :502)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__()
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        if path_imgrec:
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        else:
+            self.imgrec = None
+        self.imglist = None
+        if path_imglist:
+            imglist_d = {}
+            imgkeys = []
+            with open(path_imglist) as fin:
+                for line in iter(fin.readline, ""):
+                    line = line.strip().split("\t")
+                    label = np.array([float(i) for i in line[1:-1]], np.float32)
+                    key = int(line[0])
+                    imglist_d[key] = (label, line[-1])
+                    imgkeys.append(key)
+            self.imglist = imglist_d
+            self.seq = imgkeys
+        elif isinstance(imglist, list):
+            imglist_d = {}
+            imgkeys = []
+            index = 1
+            for img in imglist:
+                key = str(index)
+                index += 1
+                if isinstance(img[0], (list, np.ndarray)):
+                    label = np.array(img[0], np.float32)
+                else:
+                    label = np.array([img[0]], np.float32)
+                imglist_d[key] = (label, img[1])
+                imgkeys.append(str(key))
+            self.imglist = imglist_d
+            self.seq = imgkeys
+        elif self.imgidx is not None:
+            self.seq = self.imgidx
+        else:
+            self.seq = None
+        if num_parts > 1 and self.seq is not None:
+            # distributed sharding (the dmlc::InputSplit part_index contract)
+            n_per = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n_per : (part_index + 1) * n_per]
+        self.path_root = path_root
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
+        if label_width > 1:
+            self.provide_label = [DataDesc(label_name, (batch_size, label_width))]
+        else:
+            self.provide_label = [DataDesc(label_name, (batch_size,))]
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """(reference: image.py ImageIter.next_sample)"""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                if self.imglist is None:
+                    return header.label, img
+                return self.imglist[idx][0], img
+            label, fname = self.imglist[idx]
+            return label, self.read_image(fname)
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def read_image(self, fname):
+        with open(os.path.join(self.path_root or "", fname), "rb") as fin:
+            return fin.read()
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, h, w, c), np.float32)
+        batch_label = np.zeros((batch_size, self.label_width), np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = imdecode(s)
+                for aug in self.auglist:
+                    data = aug(data)
+                arr = data.asnumpy()
+                batch_data[i] = arr
+                lab = np.asarray(label).reshape(-1)
+                batch_label[i] = lab[: self.label_width]
+                i += 1
+        except StopIteration:
+            if not i:
+                raise
+        # HWC -> CHW
+        batch_data = batch_data.transpose(0, 3, 1, 2)
+        label_out = batch_label if self.label_width > 1 else batch_label[:, 0]
+        return DataBatch(
+            [nd.array(batch_data)], [nd.array(label_out)], batch_size - i
+        )

@@ -1,0 +1,129 @@
+"""Image pipeline tests: imdecode/augmenters/ImageIter/ImageRecordIter
+(reference: src/io tests via tests/python/unittest/test_io.py + image aug in
+image_aug_default.cc)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img
+from mxnet_tpu import recordio
+
+PIL = pytest.importorskip("PIL")
+
+
+def _make_jpeg(h=40, w=60, seed=0):
+    from io import BytesIO
+
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    arr = (rng.rand(h, w, 3) * 255).astype(np.uint8)
+    bio = BytesIO()
+    Image.fromarray(arr).save(bio, format="JPEG")
+    return bio.getvalue(), arr
+
+
+def test_imdecode_resize_crop():
+    buf, arr = _make_jpeg()
+    im = img.imdecode(buf)
+    assert im.shape == (40, 60, 3)
+    r = img.resize_short(im, 30)
+    assert min(r.shape[:2]) == 30
+    c, _ = img.center_crop(im, (20, 20))
+    assert c.shape == (20, 20, 3)
+    rc, _ = img.random_crop(im, (20, 20))
+    assert rc.shape == (20, 20, 3)
+
+
+def test_color_normalize_and_augs():
+    buf, arr = _make_jpeg()
+    im = img.imdecode(buf)
+    out = img.color_normalize(im, mean=np.array([100.0, 100.0, 100.0]))
+    assert out.dtype == np.float32
+    flip = img.HorizontalFlipAug(1.0)(im)
+    np.testing.assert_allclose(flip.asnumpy(), im.asnumpy()[:, ::-1])
+    auglist = img.CreateAugmenter((3, 24, 24), rand_mirror=True, mean=True, std=True)
+    x = im
+    for aug in auglist:
+        x = aug(x)
+    assert x.shape == (24, 24, 3)
+
+
+def _make_rec(tmp_path, n=12):
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n):
+        buf, _ = _make_jpeg(seed=i)
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        w.write_idx(i, recordio.pack(header, buf))
+    w.close()
+    return rec_path, idx_path
+
+
+def test_image_record_iter(tmp_path):
+    rec_path, idx_path = _make_rec(tmp_path)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path, data_shape=(3, 24, 24),
+        batch_size=4, preprocess_threads=2, rand_crop=False,
+    )
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 24, 24)
+    assert batches[0].label[0].shape == (4,)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_record_iter_sharded(tmp_path):
+    rec_path, idx_path = _make_rec(tmp_path, n=16)
+    it0 = mx.io.ImageRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path, data_shape=(3, 24, 24),
+        batch_size=4, num_parts=2, part_index=0,
+    )
+    it1 = mx.io.ImageRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path, data_shape=(3, 24, 24),
+        batch_size=4, num_parts=2, part_index=1,
+    )
+    l0 = [b.label[0].asnumpy() for b in it0]
+    l1 = [b.label[0].asnumpy() for b in it1]
+    assert len(l0) == 2 and len(l1) == 2
+
+
+def test_image_iter_from_rec(tmp_path):
+    rec_path, idx_path = _make_rec(tmp_path)
+    it = img.ImageIter(
+        batch_size=4, data_shape=(3, 24, 24), path_imgrec=rec_path, path_imgidx=idx_path
+    )
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 3, 24, 24)
+
+
+def test_im2rec_roundtrip(tmp_path):
+    # write images to disk, list + pack via the tool, read back with ImageRecordIter
+    import subprocess
+    import sys
+
+    from PIL import Image
+
+    root = tmp_path / "imgs"
+    for cls in ["a", "b"]:
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(str(d / ("%d.jpg" % i)))
+    prefix = str(tmp_path / "pack")
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools", "im2rec.py")
+    subprocess.check_call(
+        [sys.executable, tool, prefix, str(root), "--list", "--recursive"],
+    )
+    subprocess.check_call([sys.executable, tool, prefix, str(root)])
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+        data_shape=(3, 24, 24), batch_size=3,
+    )
+    b = next(iter(it))
+    assert b.data[0].shape == (3, 3, 24, 24)
