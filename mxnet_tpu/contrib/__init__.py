@@ -1,3 +1,5 @@
 """Contrib namespace (reference: python/mxnet/contrib/__init__.py — autograd,
 contrib ops)."""
 from . import autograd  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import symbol  # noqa: F401

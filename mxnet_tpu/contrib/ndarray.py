@@ -1,0 +1,11 @@
+"""contrib ndarray namespace: expose _contrib_* ops under their short names
+(reference: python/mxnet/contrib/ndarray.py generated from the registry)."""
+import sys
+
+from .. import ndarray as _nd
+from ..ops.registry import list_ops
+
+_mod = sys.modules[__name__]
+for _name in list_ops():
+    if _name.startswith("_contrib_"):
+        setattr(_mod, _name[len("_contrib_"):], getattr(_nd, _name))

@@ -1,0 +1,11 @@
+"""contrib symbol namespace: expose _contrib_* ops under their short names
+(reference: python/mxnet/contrib/symbol.py generated from the registry)."""
+import sys
+
+from .. import symbol as _sym
+from ..ops.registry import list_ops
+
+_mod = sys.modules[__name__]
+for _name in list_ops():
+    if _name.startswith("_contrib_"):
+        setattr(_mod, _name[len("_contrib_"):], getattr(_sym, _name))

@@ -16,4 +16,6 @@ from . import nn  # noqa: F401
 from . import loss  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import contrib_ops  # noqa: F401
+from . import spatial  # noqa: F401
 from .registry import OpContext, Operator, get_op, list_ops, register, register_simple  # noqa: F401

@@ -1,0 +1,574 @@
+"""Contrib ops: SSD MultiBox family, Proposal, CTCLoss, FFT, count_sketch,
+quantize (reference: src/operator/contrib/* — multibox_prior.cc:78,
+multibox_target.cc:285, multibox_detection.cc:175, proposal.cc:450,
+ctc_loss.cc:52, fft.cc:28, count_sketch.cc:26).
+
+TPU design: the reference's hand CUDA kernels (anchor matching loops, greedy
+NMS, warp-ctc) become masked fixed-shape jnp computations + ``lax.fori_loop``
+where iteration is inherent (greedy NMS suppression, CTC time recursion via
+``lax.scan``). Everything is jit-compatible and differentiable where the
+reference's op is (CTC; the detection ops are zero-grad, matching the
+reference's Backward = 0).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import parse_shape
+from .registry import Param, get_op, register, register_simple
+
+
+def _tuple_f(default):
+    def _parse(v):
+        if isinstance(v, (tuple, list)):
+            return tuple(float(x) for x in v)
+        s = str(v).strip().strip("()[]")
+        if not s:
+            return ()
+        return tuple(float(t) for t in s.split(",") if t.strip())
+
+    return Param(_parse, default)
+
+
+# ---------------------------------------------------------------- MultiBoxPrior
+@register(
+    "_contrib_MultiBoxPrior",
+    arg_names=("data",),
+    params={
+        "sizes": _tuple_f((1.0,)),
+        "ratios": _tuple_f((1.0,)),
+        "clip": Param.bool(False),
+        "steps": _tuple_f((-1.0, -1.0)),
+        "offsets": _tuple_f((0.5, 0.5)),
+    },
+    alias=("MultiBoxPrior",),
+)
+def _multibox_prior(octx, attrs, args, auxs):
+    """Anchor generation: per cell, one box per size at ratio[0], plus one box
+    per extra ratio at sizes[0] (behavioral contract of multibox_prior.cc:12-52:
+    w=h=size/2 for the size set; w=s0*sqrt(r)/2, h=s0/(2*sqrt(r)) for ratios)."""
+    x = args[0]
+    H, W = x.shape[2], x.shape[3]
+    sizes = jnp.asarray(attrs["sizes"], jnp.float32)
+    ratios = jnp.asarray(attrs["ratios"], jnp.float32)
+    step_y, step_x = attrs["steps"]
+    if step_y <= 0 or step_x <= 0:
+        step_y, step_x = 1.0 / H, 1.0 / W
+    off_y, off_x = attrs["offsets"]
+    cy = (jnp.arange(H, dtype=jnp.float32) + off_y) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + off_x) * step_x
+    # half-extents for the anchor set at one cell: (num_sizes + num_ratios - 1, 2)
+    hw_sizes = jnp.stack([sizes / 2, sizes / 2], axis=1)  # ratio = 1 branch
+    r = jnp.sqrt(ratios[1:])
+    hw_ratios = jnp.stack([sizes[0] * r / 2, sizes[0] / r / 2], axis=1)
+    half = jnp.concatenate([hw_sizes, hw_ratios], axis=0)  # (K, 2) [w, h]
+    K = half.shape[0]
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+    centers = jnp.stack([cxg, cyg], axis=-1).reshape(H * W, 1, 2)  # [cx, cy]
+    mins = centers - half[None, :, :]
+    maxs = centers + half[None, :, :]
+    boxes = jnp.concatenate([mins, maxs], axis=-1).reshape(1, H * W * K, 4)
+    if attrs["clip"]:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return [jax.lax.stop_gradient(boxes)], []
+
+
+def _mbp_infer(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    K = len(attrs["sizes"]) + len(attrs["ratios"]) - 1
+    return [tuple(data)], [(1, data[2] * data[3] * K, 4)], []
+
+
+get_op("_contrib_MultiBoxPrior")._infer_shape = _mbp_infer
+
+
+# ------------------------------------------------------------- box utilities
+def _iou_corner(a, b):
+    """IoU between (..., 4) corner boxes a and b (broadcasting)."""
+    ix0 = jnp.maximum(a[..., 0], b[..., 0])
+    iy0 = jnp.maximum(a[..., 1], b[..., 1])
+    ix1 = jnp.minimum(a[..., 2], b[..., 2])
+    iy1 = jnp.minimum(a[..., 3], b[..., 3])
+    iw = jnp.maximum(ix1 - ix0, 0.0)
+    ih = jnp.maximum(iy1 - iy0, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+
+
+def _encode_loc(anchors, gt, variances):
+    """Center-form offset encoding (SSD standard, multibox_target contract)."""
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    acx = (anchors[..., 0] + anchors[..., 2]) / 2
+    acy = (anchors[..., 1] + anchors[..., 3]) / 2
+    gw = jnp.maximum(gt[..., 2] - gt[..., 0], 1e-12)
+    gh = jnp.maximum(gt[..., 3] - gt[..., 1], 1e-12)
+    gcx = (gt[..., 0] + gt[..., 2]) / 2
+    gcy = (gt[..., 1] + gt[..., 3]) / 2
+    v0, v1, v2, v3 = variances
+    return jnp.stack(
+        [
+            (gcx - acx) / jnp.maximum(aw, 1e-12) / v0,
+            (gcy - acy) / jnp.maximum(ah, 1e-12) / v1,
+            jnp.log(gw / jnp.maximum(aw, 1e-12)) / v2,
+            jnp.log(gh / jnp.maximum(ah, 1e-12)) / v3,
+        ],
+        axis=-1,
+    )
+
+
+def _decode_loc(anchors, pred, variances, clip):
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    acx = (anchors[..., 0] + anchors[..., 2]) / 2
+    acy = (anchors[..., 1] + anchors[..., 3]) / 2
+    v0, v1, v2, v3 = variances
+    cx = pred[..., 0] * v0 * aw + acx
+    cy = pred[..., 1] * v1 * ah + acy
+    w = jnp.exp(pred[..., 2] * v2) * aw / 2
+    h = jnp.exp(pred[..., 3] * v3) * ah / 2
+    out = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+# ---------------------------------------------------------------- MultiBoxTarget
+@register(
+    "_contrib_MultiBoxTarget",
+    arg_names=("anchor", "label", "cls_pred"),
+    params={
+        "overlap_threshold": Param.float(0.5),
+        "ignore_label": Param.float(-1.0),
+        "negative_mining_ratio": Param.float(-1.0),
+        "negative_mining_thresh": Param.float(0.5),
+        "minimum_negative_samples": Param.int(0),
+        "variances": _tuple_f((0.1, 0.1, 0.2, 0.2)),
+    },
+    num_outputs=3,
+    output_names=("loc_target", "loc_mask", "cls_target"),
+    alias=("MultiBoxTarget",),
+)
+def _multibox_target(octx, attrs, args, auxs):
+    """Anchor-GT matching + target encoding (multibox_target-inl.h contract):
+    bipartite best-anchor-per-gt match first, then IoU>threshold matches;
+    matched anchors get class gt+1 and encoded loc offsets; unmatched get
+    background 0 (or ignore_label when hard-negative mining samples them out).
+    """
+    anchors = args[0].reshape(-1, 4)  # (A, 4)
+    labels = args[1]  # (N, L, 5) [cls, x0, y0, x1, y1], cls<0 = pad
+    cls_preds = args[2]  # (N, C, A)
+    A = anchors.shape[0]
+    N, L, _ = labels.shape
+    variances = attrs["variances"]
+
+    def per_batch(lab, cp):
+        valid = lab[:, 0] >= 0  # (L,)
+        gt_boxes = lab[:, 1:5]
+        ious = _iou_corner(anchors[:, None, :], gt_boxes[None, :, :])  # (A, L)
+        ious = jnp.where(valid[None, :], ious, -1.0)
+        # 1) bipartite: each valid gt claims its best anchor
+        best_anchor_per_gt = jnp.argmax(ious, axis=0)  # (L,)
+        forced = jnp.zeros((A,), jnp.int32) - 1
+        forced = forced.at[best_anchor_per_gt].set(
+            jnp.where(valid, jnp.arange(L), -1).astype(jnp.int32)
+        )
+        # 2) threshold matching for the rest
+        best_gt = jnp.argmax(ious, axis=1).astype(jnp.int32)  # (A,)
+        best_iou = jnp.max(ious, axis=1)
+        matched_gt = jnp.where(
+            forced >= 0, forced,
+            jnp.where(best_iou > attrs["overlap_threshold"], best_gt, -1),
+        )
+        is_pos = matched_gt >= 0
+        safe_gt = jnp.maximum(matched_gt, 0)
+        cls_t = jnp.where(is_pos, lab[safe_gt, 0] + 1.0, 0.0)
+        loc_t = _encode_loc(anchors, gt_boxes[safe_gt], variances)
+        loc_t = jnp.where(is_pos[:, None], loc_t, 0.0)
+        mask = jnp.where(is_pos[:, None], 1.0, 0.0) * jnp.ones((A, 4))
+        # hard negative mining: rank negatives by background-class confidence
+        # deficit (max non-bg prob), keep ratio*num_pos
+        if attrs["negative_mining_ratio"] > 0:
+            num_pos = jnp.sum(is_pos)
+            max_neg = jnp.maximum(
+                (attrs["negative_mining_ratio"] * num_pos).astype(jnp.int32),
+                attrs["minimum_negative_samples"],
+            )
+            neg_ok = (~is_pos) & (best_iou < attrs["negative_mining_thresh"])
+            neg_score = jnp.where(neg_ok, jnp.max(cp[1:, :], axis=0), -jnp.inf)
+            order = jnp.argsort(-neg_score)
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A, dtype=jnp.int32))
+            keep_neg = neg_ok & (rank < max_neg)
+            cls_t = jnp.where(is_pos, cls_t, jnp.where(keep_neg, 0.0, attrs["ignore_label"]))
+        return loc_t.reshape(-1), mask.reshape(-1), cls_t
+
+    loc_target, loc_mask, cls_target = jax.vmap(per_batch)(labels, cls_preds)
+    stop = jax.lax.stop_gradient
+    return [stop(loc_target), stop(loc_mask), stop(cls_target)], []
+
+
+def _mbt_infer(attrs, in_shapes, aux_shapes):
+    anchor, label, cls_pred = in_shapes
+    A = anchor[1]
+    N = label[0]
+    return (
+        [tuple(anchor), tuple(label), tuple(cls_pred)],
+        [(N, A * 4), (N, A * 4), (N, A)],
+        [],
+    )
+
+
+get_op("_contrib_MultiBoxTarget")._infer_shape = _mbt_infer
+
+
+# ------------------------------------------------------------ MultiBoxDetection
+def _nms_loop(boxes, scores, cls_ids, nms_threshold, force_suppress, topk):
+    """Greedy NMS over score-sorted boxes: a fori_loop where step i suppresses
+    all lower-ranked boxes overlapping box i (class-aware unless force)."""
+    A = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    s = scores[order]
+    c = cls_ids[order]
+    n_iter = A if topk is None or topk <= 0 else min(topk, A)
+    keep = s > -jnp.inf  # all True; invalid already have -inf score
+
+    def body(i, keep):
+        ious = _iou_corner(b[i][None, :], b)
+        same_cls = jnp.ones((A,), bool) if force_suppress else (c == c[i])
+        later = jnp.arange(A) > i
+        suppress = (ious > nms_threshold) & same_cls & later & keep[i]
+        return keep & ~suppress
+
+    keep = jax.lax.fori_loop(0, n_iter, body, keep)
+    return b, s, c, keep
+
+
+@register(
+    "_contrib_MultiBoxDetection",
+    arg_names=("cls_prob", "loc_pred", "anchor"),
+    params={
+        "clip": Param.bool(True),
+        "threshold": Param.float(0.01),
+        "background_id": Param.int(0),
+        "nms_threshold": Param.float(0.5),
+        "force_suppress": Param.bool(False),
+        "variances": _tuple_f((0.1, 0.1, 0.2, 0.2)),
+        "nms_topk": Param.int(-1),
+    },
+    alias=("MultiBoxDetection",),
+)
+def _multibox_detection(octx, attrs, args, auxs):
+    """Decode + per-class greedy NMS → (N, A, 6) rows
+    [class_id, score, x0, y0, x1, y1], -1-filled for suppressed slots
+    (multibox_detection-inl.h contract)."""
+    cls_prob, loc_pred, anchors = args
+    N, C, A = cls_prob.shape
+    anchors = anchors.reshape(-1, 4)
+    bg = attrs["background_id"]
+
+    def per_batch(cp, lp):
+        # class with max prob excluding background
+        cls_only = jnp.concatenate([cp[:bg], cp[bg + 1 :]], axis=0) if C > 1 else cp
+        ids = jnp.argmax(cls_only, axis=0)
+        ids = jnp.where(ids >= bg, ids + 1, ids) if C > 1 else ids  # skip bg slot
+        score = jnp.max(cls_only, axis=0)
+        valid = score > attrs["threshold"]
+        boxes = _decode_loc(anchors, lp.reshape(-1, 4), attrs["variances"], attrs["clip"])
+        score = jnp.where(valid, score, -jnp.inf)
+        b, s, c, keep = _nms_loop(
+            boxes, score, ids, attrs["nms_threshold"], attrs["force_suppress"], attrs["nms_topk"]
+        )
+        ok = keep & (s > -jnp.inf)
+        row = jnp.concatenate(
+            [
+                jnp.where(ok, (c - (1 if C > 1 else 0)).astype(jnp.float32), -1.0)[:, None],
+                jnp.where(ok, s, -1.0)[:, None],
+                jnp.where(ok[:, None], b, -1.0),
+            ],
+            axis=1,
+        )
+        return row
+
+    out = jax.vmap(per_batch)(cls_prob, loc_pred.reshape(N, -1))
+    return [jax.lax.stop_gradient(out)], []
+
+
+def _mbd_infer(attrs, in_shapes, aux_shapes):
+    cls_prob = in_shapes[0]
+    N, C, A = cls_prob
+    return [tuple(s) for s in in_shapes], [(N, A, 6)], []
+
+
+get_op("_contrib_MultiBoxDetection")._infer_shape = _mbd_infer
+
+
+# ---------------------------------------------------------------- Proposal
+@register(
+    "_contrib_Proposal",
+    arg_names=("cls_prob", "bbox_pred", "im_info"),
+    params={
+        "rpn_pre_nms_top_n": Param.int(6000),
+        "rpn_post_nms_top_n": Param.int(300),
+        "threshold": Param.float(0.7),
+        "rpn_min_size": Param.int(16),
+        "scales": _tuple_f((4.0, 8.0, 16.0, 32.0)),
+        "ratios": _tuple_f((0.5, 1.0, 2.0)),
+        "feature_stride": Param.int(16),
+        "output_score": Param.bool(False),
+        "iou_loss": Param.bool(False),
+    },
+    num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1,
+    output_names=lambda attrs: ["output", "score"] if attrs.get("output_score") else ["output"],
+)
+def _proposal(octx, attrs, args, auxs):
+    """RPN proposal layer (proposal.cc contract): generate scale×ratio anchors
+    on the feature grid, apply bbox deltas, clip to image, filter small boxes,
+    take pre-NMS topk by fg score, greedy NMS, emit post-NMS topk rois
+    (batch_idx, x0, y0, x1, y1)."""
+    cls_prob, bbox_pred, im_info = args  # (N, 2K, H, W), (N, 4K, H, W), (N, 3)
+    N, twoK, H, W = cls_prob.shape
+    K = twoK // 2
+    stride = attrs["feature_stride"]
+    scales = jnp.asarray(attrs["scales"], jnp.float32)
+    ratios = jnp.asarray(attrs["ratios"], jnp.float32)
+    # base anchors centered at (stride-1)/2, standard Faster-RCNN enumeration
+    base = (stride - 1) / 2.0
+    ws = []
+    size = stride * stride
+    for r in attrs["ratios"]:
+        size_r = size / r
+        w0 = np.round(np.sqrt(size_r))
+        h0 = np.round(w0 * r)
+        for s in attrs["scales"]:
+            ws.append((w0 * s, h0 * s))
+    half = jnp.asarray(ws, jnp.float32) / 2.0  # (K, 2)
+    sy = jnp.arange(H, dtype=jnp.float32) * stride + base
+    sx = jnp.arange(W, dtype=jnp.float32) * stride + base
+    cyg, cxg = jnp.meshgrid(sy, sx, indexing="ij")
+    centers = jnp.stack([cxg, cyg], -1).reshape(-1, 1, 2)  # (HW, 1, 2)
+    anchors = jnp.concatenate(
+        [centers - half[None], centers + half[None]], axis=-1
+    ).reshape(-1, 4)  # (HW*K, 4) — order (h, w, k)
+
+    def per_batch(cp, bp, info):
+        im_h, im_w = info[0], info[1]
+        fg = cp[K:].transpose(1, 2, 0).reshape(-1)  # (H*W*K,) foreground scores
+        deltas = bp.reshape(K, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        # decode (unnormalized variances=1, pixel coords)
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        acx = anchors[:, 0] + aw / 2
+        acy = anchors[:, 1] + ah / 2
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        w = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        boxes = jnp.stack(
+            [
+                jnp.clip(boxes[:, 0], 0, im_w - 1),
+                jnp.clip(boxes[:, 1], 0, im_h - 1),
+                jnp.clip(boxes[:, 2], 0, im_w - 1),
+                jnp.clip(boxes[:, 3], 0, im_h - 1),
+            ],
+            -1,
+        )
+        min_size = attrs["rpn_min_size"] * info[2]
+        keep_size = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & (
+            (boxes[:, 3] - boxes[:, 1] + 1) >= min_size
+        )
+        fg = jnp.where(keep_size, fg, -jnp.inf)
+        pre_n = min(attrs["rpn_pre_nms_top_n"], fg.shape[0])
+        top_s, top_i = jax.lax.top_k(fg, pre_n)
+        top_b = boxes[top_i]
+        b, s, _, keep = _nms_loop(
+            top_b, top_s, jnp.zeros(pre_n, jnp.int32), attrs["threshold"], True,
+            attrs["rpn_post_nms_top_n"] * 4,
+        )
+        post_n = attrs["rpn_post_nms_top_n"]
+        s_kept = jnp.where(keep, s, -jnp.inf)
+        sel_s, sel_i = jax.lax.top_k(s_kept, min(post_n, pre_n))
+        rois = b[sel_i]
+        pad = post_n - rois.shape[0]
+        if pad > 0:
+            rois = jnp.concatenate([rois, jnp.zeros((pad, 4))], 0)
+            sel_s = jnp.concatenate([sel_s, jnp.full((pad,), -jnp.inf)], 0)
+        return rois, sel_s
+
+    rois, scores = jax.vmap(per_batch)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(
+        jnp.arange(N, dtype=jnp.float32)[:, None], rois.shape[1], axis=1
+    )[..., None]
+    out = jnp.concatenate([batch_idx, rois], axis=-1).reshape(-1, 5)
+    outs = [jax.lax.stop_gradient(out)]
+    if attrs["output_score"]:
+        outs.append(jax.lax.stop_gradient(scores.reshape(-1, 1)))
+    return outs, []
+
+
+def _proposal_infer(attrs, in_shapes, aux_shapes):
+    cls_prob = in_shapes[0]
+    N = cls_prob[0]
+    post = attrs["rpn_post_nms_top_n"]
+    outs = [(N * post, 5)]
+    if attrs.get("output_score"):
+        outs.append((N * post, 1))
+    return [tuple(s) for s in in_shapes], outs, []
+
+
+get_op("_contrib_Proposal")._infer_shape = _proposal_infer
+
+
+# ---------------------------------------------------------------- CTCLoss
+@register(
+    "_contrib_CTCLoss",
+    arg_names=("data", "label"),
+    params={},
+    num_outputs=2,
+    num_visible_outputs=1,
+    output_names=("output", "grad"),
+    alias=("CTCLoss", "_contrib_ctc_loss"),
+)
+def _ctc_loss(octx, attrs, args, auxs):
+    """CTC negative log-likelihood via the alpha (forward) recursion in log
+    space, scanned over time (reference wraps warp-ctc, ctc_loss.cc; blank=0,
+    labels 0-padded). Fully differentiable through lax.scan — the backward is
+    autodiff instead of warp-ctc's hand beta recursion."""
+    data, label = args  # (T, N, C), (N, L)
+    T, N, C = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)  # 0 = padding (and 0 = blank in alphabet)
+    S = 2 * L + 1
+    # extended label sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.zeros((N, S), jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    valid_lab = lab > 0
+    lab_len = jnp.sum(valid_lab, axis=1)  # (N,)
+    ext_len = 2 * lab_len + 1
+    neg_inf = -1e30
+    # allowed skip: s-2 -> s if ext[s] != 0 and ext[s] != ext[s-2]
+    can_skip = jnp.zeros((N, S), bool)
+    can_skip = can_skip.at[:, 2:].set(
+        (ext[:, 2:] != 0) & (ext[:, 2:] != ext[:, :-2])
+    )
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(lab_len > 0, jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0], neg_inf)
+    )
+
+    def step(alpha, logp_t):
+        a_prev = alpha
+        a_shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(can_skip, a_shift2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)  # (N, S)
+        return merged + emit, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, logp[1:])
+    # mask timesteps beyond ext_len positions: gather final two states
+    idx_last = jnp.maximum(ext_len - 1, 0)
+    idx_prev = jnp.maximum(ext_len - 2, 0)
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0]
+    loss = -jnp.logaddexp(a_last, a_prev)
+    grad_placeholder = jnp.zeros_like(data)
+    return [loss, grad_placeholder], []
+
+
+def _ctc_infer(attrs, in_shapes, aux_shapes):
+    data, label = in_shapes
+    return [tuple(data), tuple(label)], [(data[1],), tuple(data)], []
+
+
+get_op("_contrib_CTCLoss")._infer_shape = _ctc_infer
+get_op("_contrib_CTCLoss").is_loss = True
+
+
+# ---------------------------------------------------------------- FFT / IFFT
+def _fft(attrs, x):
+    """(reference: fft.cc — cuFFT; output interleaves re/im on last dim)"""
+    f = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1).reshape(x.shape[:-1] + (2 * x.shape[-1],))
+    return out.astype(jnp.float32)
+
+
+def _ifft(attrs, x):
+    n = x.shape[-1] // 2
+    pairs = x.reshape(x.shape[:-1] + (n, 2))
+    c = pairs[..., 0] + 1j * pairs[..., 1]
+    out = jnp.fft.ifft(c, axis=-1).real * n  # reference scales by n (cuFFT unnormalized)
+    return out.astype(jnp.float32)
+
+
+register_simple(
+    "_contrib_fft", _fft, arg_names=("data",),
+    params={"compute_size": Param.int(128)}, alias=("fft",),
+)
+register_simple(
+    "_contrib_ifft", _ifft, arg_names=("data",),
+    params={"compute_size": Param.int(128)}, alias=("ifft",),
+)
+
+
+# ---------------------------------------------------------------- count_sketch
+@register(
+    "_contrib_count_sketch",
+    arg_names=("data", "h", "s"),
+    params={"out_dim": Param.int(), "processing_batch_size": Param.int(32)},
+    alias=("count_sketch",),
+)
+def _count_sketch(octx, attrs, args, auxs):
+    """Count-sketch projection (count_sketch.cc): out[:, h[i]] += s[i]*x[:, i]."""
+    x, h, s = args
+    out_dim = attrs["out_dim"]
+    hi = jax.lax.stop_gradient(h).astype(jnp.int32).reshape(-1)
+    si = jax.lax.stop_gradient(s).reshape(-1)
+    out = jnp.zeros(x.shape[:-1] + (out_dim,), x.dtype)
+    return [out.at[..., hi].add(x * si)], []
+
+
+def _cs_infer(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    return [tuple(s) for s in in_shapes], [tuple(data[:-1]) + (attrs["out_dim"],)], []
+
+
+get_op("_contrib_count_sketch")._infer_shape = _cs_infer
+
+
+# ---------------------------------------------------------------- quantize
+@register(
+    "_contrib_quantize",
+    arg_names=("data", "min_range", "max_range"),
+    params={"out_type": Param.str("uint8")},
+    num_outputs=3,
+    output_names=("output", "min_range", "max_range"),
+    alias=("quantize",),
+)
+def _quantize(octx, attrs, args, auxs):
+    x, mn, mx = args
+    qmax = 255.0 if attrs["out_type"] == "uint8" else 127.0
+    scale = qmax / jnp.maximum(mx - mn, 1e-12)
+    q = jnp.clip(jnp.round((x - mn) * scale), 0, qmax)
+    dt = jnp.uint8 if attrs["out_type"] == "uint8" else jnp.int8
+    return [jax.lax.stop_gradient(q.astype(dt)), mn, mx], []
+
+
+@register(
+    "_contrib_dequantize",
+    arg_names=("data", "min_range", "max_range"),
+    params={"out_type": Param.str("float32")},
+    alias=("dequantize",),
+)
+def _dequantize(octx, attrs, args, auxs):
+    q, mn, mx = args
+    qmax = 255.0 if q.dtype == jnp.uint8 else 127.0
+    return [q.astype(jnp.float32) * (mx - mn) / qmax + mn], []

@@ -186,11 +186,6 @@ for _name, _fn in _UNARY.items():
 register_simple("_copy", lambda attrs, x: x + jnp.zeros((), x.dtype), arg_names=("data",), alias=("identity",))
 register_simple("BlockGrad", lambda attrs, x: jax.lax.stop_gradient(x), arg_names=("data",), alias=("stop_gradient",))
 register_simple(
-    "make_loss",
-    lambda attrs, x: x,
-    arg_names=("data",),
-)
-register_simple(
     "Cast",
     lambda attrs, x: x.astype(attrs["dtype"]),
     arg_names=("data",),

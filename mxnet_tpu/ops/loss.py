@@ -220,6 +220,7 @@ _mark_loss("SVMOutput")
         "valid_thresh": Param.float(0.0),
         "normalization": Param.str("null"),
     },
+    alias=("make_loss",),
 )
 def _make_loss(octx, attrs, args, auxs):
     scale = attrs["grad_scale"]

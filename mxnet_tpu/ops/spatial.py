@@ -1,0 +1,326 @@
+"""Spatial transform ops: ROIPooling, SpatialTransformer, GridGenerator,
+BilinearSampler, Correlation.
+
+Reference: src/operator/{roi_pooling,spatial_transformer,grid_generator,
+bilinear_sampler,correlation}.{cc,cu} — each a hand Forward/Backward CUDA pair.
+Here: vectorized gather/one-hot formulations whose backward is autodiff;
+bilinear sampling is differentiable end-to-end (matching the reference's
+hand-written BilinearSamplerBackward).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Param, get_op, register, register_simple
+
+
+# ---------------------------------------------------------------- ROIPooling
+@register(
+    "ROIPooling",
+    arg_names=("data", "rois"),
+    params={"pooled_size": Param.shape(), "spatial_scale": Param.float()},
+)
+def _roi_pooling(octx, attrs, args, auxs):
+    """Max-pool each roi into a fixed (ph, pw) grid (roi_pooling-inl.h).
+    rois: (R, 5) [batch_idx, x0, y0, x1, y1] in image coords."""
+    data, rois = args
+    N, C, H, W = data.shape
+    ph, pw = attrs["pooled_size"]
+    scale = attrs["spatial_scale"]
+
+    def one_roi(roi):
+        bidx = jax.lax.stop_gradient(roi[0]).astype(jnp.int32)
+        x0 = jnp.round(roi[1] * scale)
+        y0 = jnp.round(roi[2] * scale)
+        x1 = jnp.round(roi[3] * scale)
+        y1 = jnp.round(roi[4] * scale)
+        rw = jnp.maximum(x1 - x0 + 1, 1.0)
+        rh = jnp.maximum(y1 - y0 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bidx]  # (C, H, W)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+
+        def bin_val(i, j):
+            hstart = jnp.floor(y0 + i * bin_h)
+            hend = jnp.ceil(y0 + (i + 1) * bin_h)
+            wstart = jnp.floor(x0 + j * bin_w)
+            wend = jnp.ceil(x0 + (j + 1) * bin_w)
+            ymask = (ys >= hstart) & (ys < hend) & (ys >= 0) & (ys < H)
+            xmask = (xs >= wstart) & (xs < wend) & (xs >= 0) & (xs < W)
+            m = ymask[:, None] & xmask[None, :]
+            masked = jnp.where(m[None, :, :], img, -jnp.inf)
+            v = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.any(m), v, 0.0)
+
+        ii, jj = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw), indexing="ij")
+        vals = jax.vmap(jax.vmap(bin_val))(ii.astype(jnp.float32), jj.astype(jnp.float32))
+        return jnp.transpose(vals, (2, 0, 1))  # (C, ph, pw)
+
+    out = jax.vmap(one_roi)(rois)
+    return [out], []
+
+
+def _roi_infer(attrs, in_shapes, aux_shapes):
+    data, rois = in_shapes
+    ph, pw = attrs["pooled_size"]
+    return [tuple(data), tuple(rois)], [(rois[0], data[1], ph, pw)], []
+
+
+get_op("ROIPooling")._infer_shape = _roi_infer
+
+
+# ---------------------------------------------------------- bilinear sampling
+def _bilinear_sample(img, gx, gy):
+    """Differentiable bilinear sampling of img (C,H,W) at normalized grid
+    coords gx, gy in [-1, 1] (shape (Ho, Wo)). Out-of-range samples are 0
+    (matching bilinear_sampler-inl.h border handling)."""
+    C, H, W = img.shape
+    x = (gx + 1) * (W - 1) / 2
+    y = (gy + 1) * (H - 1) / 2
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    x1 = x0 + 1
+    y1 = y0 + 1
+    wx1 = x - x0
+    wy1 = y - y0
+    wx0 = 1 - wx1
+    wy0 = 1 - wy1
+
+    def gather(yy, xx):
+        valid = (xx >= 0) & (xx <= W - 1) & (yy >= 0) & (yy <= H - 1)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        v = img[:, yi, xi]  # (C, Ho, Wo)
+        return jnp.where(valid[None], v, 0.0)
+
+    out = (
+        gather(y0, x0) * (wy0 * wx0)[None]
+        + gather(y0, x1) * (wy0 * wx1)[None]
+        + gather(y1, x0) * (wy1 * wx0)[None]
+        + gather(y1, x1) * (wy1 * wx1)[None]
+    )
+    return out
+
+
+@register(
+    "BilinearSampler",
+    arg_names=("data", "grid"),
+    params={},
+)
+def _bilinear_sampler(octx, attrs, args, auxs):
+    """(reference: bilinear_sampler.cc — grid (N, 2, Ho, Wo) of x;y in [-1,1])"""
+    data, grid = args
+    out = jax.vmap(lambda img, g: _bilinear_sample(img, g[0], g[1]))(data, grid)
+    return [out], []
+
+
+def _bs_infer(attrs, in_shapes, aux_shapes):
+    data, grid = in_shapes
+    return [tuple(data), tuple(grid)], [(data[0], data[1], grid[2], grid[3])], []
+
+
+get_op("BilinearSampler")._infer_shape = _bs_infer
+
+
+# ---------------------------------------------------------------- GridGenerator
+@register(
+    "GridGenerator",
+    arg_names=("data",),
+    params={"transform_type": Param.str(), "target_shape": Param.shape((0, 0))},
+)
+def _grid_generator(octx, attrs, args, auxs):
+    """affine: data (N, 6) θ → sampling grid (N, 2, H, W); warp: data
+    (N, 2, H, W) optical flow → grid (grid_generator.cc contract)."""
+    x = args[0]
+    if attrs["transform_type"] == "affine":
+        H, W = attrs["target_shape"]
+        theta = x.reshape(-1, 2, 3)
+        ys = jnp.linspace(-1, 1, H)
+        xs = jnp.linspace(-1, 1, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, H*W)
+        out = jnp.einsum("nij,jk->nik", theta, coords).reshape(-1, 2, H, W)
+        return [out], []
+    # warp: grid = identity + normalized flow
+    N, _, H, W = x.shape
+    ys = jnp.linspace(-1, 1, H)
+    xs = jnp.linspace(-1, 1, W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    flow_x = x[:, 0] * 2 / jnp.maximum(W - 1, 1)
+    flow_y = x[:, 1] * 2 / jnp.maximum(H - 1, 1)
+    out = jnp.stack([gx[None] + flow_x, gy[None] + flow_y], axis=1)
+    return [out], []
+
+
+def _gg_infer(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    if attrs["transform_type"] == "affine":
+        H, W = attrs["target_shape"]
+        return [tuple(data)], [(data[0], 2, H, W)], []
+    return [tuple(data)], [tuple(data)], []
+
+
+get_op("GridGenerator")._infer_shape = _gg_infer
+
+
+# ---------------------------------------------------------- SpatialTransformer
+@register(
+    "SpatialTransformer",
+    arg_names=("data", "loc"),
+    params={
+        "target_shape": Param.shape((0, 0)),
+        "transform_type": Param.str("affine"),
+        "sampler_type": Param.str("bilinear"),
+        "cudnn_off": Param.bool(False),
+    },
+)
+def _spatial_transformer(octx, attrs, args, auxs):
+    """Affine grid + bilinear sampling (spatial_transformer.cc; the cuDNN path
+    cudnn_spatial_transformer.h is the same math)."""
+    data, loc = args
+    H, W = attrs["target_shape"]
+    theta = loc.reshape(-1, 2, 3)
+    ys = jnp.linspace(-1, 1, H)
+    xs = jnp.linspace(-1, 1, W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
+    grid = jnp.einsum("nij,jk->nik", theta, coords).reshape(-1, 2, H, W)
+    out = jax.vmap(lambda img, g: _bilinear_sample(img, g[0], g[1]))(data, grid)
+    return [out], []
+
+
+def _st_infer(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    H, W = attrs["target_shape"]
+    return [tuple(data), (data[0], 6)], [(data[0], data[1], H, W)], []
+
+
+get_op("SpatialTransformer")._infer_shape = _st_infer
+
+
+# ---------------------------------------------------------------- Correlation
+@register(
+    "Correlation",
+    arg_names=("data1", "data2"),
+    params={
+        "kernel_size": Param.int(1),
+        "max_displacement": Param.int(1),
+        "stride1": Param.int(1),
+        "stride2": Param.int(1),
+        "pad_size": Param.int(0),
+        "is_multiply": Param.bool(True),
+    },
+    num_outputs=3,
+    num_visible_outputs=1,
+    output_names=("output", "tmp1", "tmp2"),
+)
+def _correlation(octx, attrs, args, auxs):
+    """FlowNet correlation layer (correlation.cc): for each displacement d in a
+    (2D+1)^2 window, mean over a k×k patch of data1(x)·data2(x+d)."""
+    a, b = args
+    N, C, H, W = a.shape
+    pad = attrs["pad_size"]
+    k = attrs["kernel_size"]
+    D = attrs["max_displacement"]
+    s1, s2 = attrs["stride1"], attrs["stride2"]
+    bk = k // 2
+    ap = jnp.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    bp = jnp.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    n_disp = 2 * (D // s2) + 1
+    out_h = int(np.ceil((Hp - 2 * (bk + D)) / s1))
+    out_w = int(np.ceil((Wp - 2 * (bk + D)) / s1))
+    mult = attrs["is_multiply"]
+    rows = []
+    for dy in range(-D, D + 1, s2):
+        cols = []
+        for dx in range(-D, D + 1, s2):
+            b_shift = jnp.roll(bp, shift=(-dy, -dx), axis=(2, 3))
+            prod = ap * b_shift if mult else jnp.abs(ap - b_shift)
+            # mean over channels and the k×k kernel window
+            corr = jnp.mean(prod, axis=1, keepdims=False)
+            if k > 1:
+                corr = jax.lax.reduce_window(
+                    corr, 0.0, jax.lax.add, (1, k, k), (1, 1, 1),
+                    [(0, 0), (bk, bk), (bk, bk)],
+                ) / (k * k)
+            start = bk + D
+            corr = corr[:, start : start + out_h * s1 : s1, start : start + out_w * s1 : s1]
+            cols.append(corr)
+        rows.extend(cols)
+    out = jnp.stack(rows, axis=1)  # (N, n_disp^2, out_h, out_w)
+    return [out, jnp.zeros_like(ap), jnp.zeros_like(bp)], []
+
+
+def _corr_infer(attrs, in_shapes, aux_shapes):
+    data1 = in_shapes[0]
+    N, C, H, W = data1
+    pad = attrs["pad_size"]
+    k = attrs["kernel_size"]
+    D = attrs["max_displacement"]
+    s1, s2 = attrs["stride1"], attrs["stride2"]
+    bk = k // 2
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    n_disp = 2 * (D // s2) + 1
+    out_h = int(np.ceil((Hp - 2 * (bk + D)) / s1))
+    out_w = int(np.ceil((Wp - 2 * (bk + D)) / s1))
+    return (
+        [tuple(data1), tuple(data1)],
+        [(N, n_disp * n_disp, out_h, out_w), (N, C, Hp, Wp), (N, C, Hp, Wp)],
+        [],
+    )
+
+
+get_op("Correlation")._infer_shape = _corr_infer
+
+
+# ----------------------------------------------------- KL sparse regularization
+@register(
+    "IdentityAttachKLSparseReg",
+    arg_names=("data",),
+    aux_names=("moving_avg",),
+    params={
+        "sparseness_target": Param.float(0.1),
+        "penalty": Param.float(0.001),
+        "momentum": Param.float(0.9),
+    },
+    alias=("identity_attach_KL_sparse_reg",),
+)
+def _kl_sparse_reg(octx, attrs, args, auxs):
+    """Identity forward; adds KL(ρ||ρ̂) sparsity gradient via the moving
+    average of activations (identity_attach_KL_sparse_reg-inl.h)."""
+    x = args[0]
+    (mov,) = auxs
+    rho = attrs["sparseness_target"]
+    penalty = attrs["penalty"]
+    mom = attrs["momentum"]
+    rho_hat = jnp.mean(x, axis=0)
+    new_mov = mov * mom + jax.lax.stop_gradient(rho_hat) * (1 - mom)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def f_fwd(x):
+        return x, None
+
+    def f_bwd(_, g):
+        kl_grad = penalty * (-rho / jnp.maximum(new_mov, 1e-12) + (1 - rho) / jnp.maximum(1 - new_mov, 1e-12))
+        return (g + kl_grad[None, :],)
+
+    f.defvjp(f_fwd, f_bwd)
+    return [f(x)], [new_mov]
+
+
+def _kl_infer(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    return [tuple(data)], [tuple(data)], [(data[1],)]
+
+
+get_op("IdentityAttachKLSparseReg")._infer_shape = _kl_infer

@@ -1,0 +1,228 @@
+"""Contrib + spatial op tests (reference: SSD/CTC/spatial ops tested via
+tests/python/unittest/test_operator.py and example pipelines)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+
+rng = np.random.RandomState(42)
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = mx.contrib.ndarray.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    # K = num_sizes - 1 + num_ratios = 3
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first cell center = (0.5/4, 0.5/4); first anchor size 0.5 → half 0.25
+    np.testing.assert_allclose(a[0], [0.125 - 0.25, 0.125 - 0.25, 0.125 + 0.25, 0.125 + 0.25], rtol=1e-5)
+    # size 0.25 anchor
+    np.testing.assert_allclose(a[1], [0.125 - 0.125, 0.125 - 0.125, 0.25, 0.25], rtol=1e-5)
+    # ratio-2 anchor at size 0.5: w = 0.5*sqrt(2)/2, h = 0.5/sqrt(2)/2
+    w = 0.5 * np.sqrt(2) / 2
+    h = 0.5 / np.sqrt(2) / 2
+    np.testing.assert_allclose(a[2], [0.125 - w, 0.125 - h, 0.125 + w, 0.125 + h], rtol=1e-5)
+    clipped = mx.contrib.ndarray.MultiBoxPrior(x, sizes=(0.9,), clip=True)
+    assert clipped.asnumpy().min() >= 0 and clipped.asnumpy().max() <= 1
+
+
+def test_multibox_target():
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0],
+                                  [0.0, 0.5, 0.5, 1.0]]], np.float32))
+    # one gt box matching anchor 0 exactly, class 1
+    labels = nd.array(np.array([[[1.0, 0.0, 0.0, 0.5, 0.5],
+                                 [-1, -1, -1, -1, -1]]], np.float32))
+    cls_preds = nd.array(rng.rand(1, 3, 3).astype(np.float32))
+    out = mx.contrib.ndarray.MultiBoxTarget(anchors, labels, cls_preds)
+    loc_target, loc_mask, cls_target = out
+    assert loc_target.shape == (1, 12)
+    assert cls_target.shape == (1, 3)
+    ct = cls_target.asnumpy()[0]
+    assert ct[0] == 2.0  # class 1 -> target 2 (bg=0 offset)
+    assert ct[1] == 0.0 and ct[2] == 0.0
+    lm = loc_mask.asnumpy()[0]
+    assert (lm[:4] == 1).all() and (lm[4:] == 0).all()
+    # exact match → zero offsets
+    np.testing.assert_allclose(loc_target.asnumpy()[0, :4], 0, atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    anchors = nd.array(rng.rand(1, 20, 4).astype(np.float32))
+    labels = nd.array(np.array([[[-1, -1, -1, -1, -1]]], np.float32))
+    cls_preds = nd.array(rng.rand(1, 3, 20).astype(np.float32))
+    _, _, cls_target = mx.contrib.ndarray.MultiBoxTarget(
+        anchors, labels, cls_preds, negative_mining_ratio=2.0, minimum_negative_samples=3
+    )
+    ct = cls_target.asnumpy()[0]
+    assert (ct == 0).sum() == 3  # min negatives kept, rest ignored
+    assert (ct == -1).sum() == 17
+
+
+def test_multibox_detection():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    cls_prob = nd.array(np.array([[[0.1, 0.8], [0.9, 0.1], [0.0, 0.1]]], np.float32))
+    # rows = [background, class0, class1] probs per anchor
+    loc_pred = nd.zeros((1, 8))
+    out = mx.contrib.ndarray.MultiBoxDetection(cls_prob, loc_pred, anchors, threshold=0.5)
+    o = out.asnumpy()[0]
+    assert out.shape == (1, 2, 6)
+    # best detection: anchor0 class0 score 0.9
+    assert o[0][0] == 0.0 and abs(o[0][1] - 0.9) < 1e-5
+    np.testing.assert_allclose(o[0][2:], [0.1, 0.1, 0.4, 0.4], atol=1e-5)
+
+
+def test_multibox_detection_nms():
+    # two overlapping boxes same class: lower one suppressed
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.4, 0.4], [0.12, 0.12, 0.42, 0.42]]], np.float32))
+    cls_prob = nd.array(np.array([[[0.1, 0.2], [0.9, 0.8]]], np.float32))
+    loc_pred = nd.zeros((1, 8))
+    out = mx.contrib.ndarray.MultiBoxDetection(
+        cls_prob, loc_pred, anchors, threshold=0.5, nms_threshold=0.5
+    ).asnumpy()[0]
+    assert out[0][0] == 0.0
+    assert out[1][0] == -1.0  # suppressed
+
+
+def test_ctc_loss_simple():
+    # single sequence, alphabet {blank=0, 1}: T=2 emissions of label [1]
+    T, N, C = 2, 1, 3
+    logits = np.zeros((T, N, C), np.float32)
+    label = np.array([[1, 0]], np.float32)  # label "1", padded
+    loss = mx.contrib.ndarray.CTCLoss(nd.array(logits), nd.array(label))
+    # uniform probs p=1/3: paths for "1": (b,1),(1,b),(1,1) → 3*(1/9) = 1/3
+    expected = -np.log(1.0 / 3.0)
+    np.testing.assert_allclose(loss.asnumpy(), [expected], rtol=1e-4)
+
+
+def test_ctc_loss_grad_flows():
+    T, N, C = 5, 2, 4
+    x = rng.rand(T, N, C).astype(np.float32)
+    label = np.array([[1, 2], [3, 0]], np.float32)
+    data = sym.Variable("data")
+    lab = sym.Variable("label")
+    loss = sym.make_loss(sym.sum(getattr(sym, "_contrib_CTCLoss")(data, lab)))
+    ex = loss.bind(
+        mx.cpu(), {"data": nd.array(x), "label": nd.array(label)},
+        args_grad={"data": nd.zeros((T, N, C))}, grad_req={"data": "write", "label": "null"},
+    )
+    ex.forward(is_train=True)
+    assert np.isfinite(ex.outputs[0].asnumpy()).all()
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_fft_ifft_roundtrip():
+    x = rng.rand(2, 8).astype(np.float32)
+    f = mx.contrib.ndarray.fft(nd.array(x))
+    assert f.shape == (2, 16)
+    back = mx.contrib.ndarray.ifft(f)
+    np.testing.assert_allclose(back.asnumpy(), x * 8, rtol=1e-4)  # cuFFT-style unnormalized
+
+
+def test_quantize_dequantize():
+    x = rng.rand(3, 4).astype(np.float32)
+    q, mn, mx_ = mx.contrib.ndarray.quantize(
+        nd.array(x), nd.array([0.0]), nd.array([1.0])
+    )
+    assert q.dtype == np.uint8
+    back = mx.contrib.ndarray.dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x, atol=1 / 255.0 + 1e-6)
+
+
+def test_count_sketch():
+    x = nd.array(np.array([[1.0, 2.0, 3.0]], np.float32))
+    h = nd.array(np.array([0, 1, 0], np.float32))
+    s = nd.array(np.array([1, -1, 1], np.float32))
+    out = mx.contrib.ndarray.count_sketch(x, h, s, out_dim=2)
+    np.testing.assert_allclose(out.asnumpy(), [[4.0, -2.0]], rtol=1e-5)
+
+
+def test_proposal_shapes():
+    N, K, H, W = 1, 12, 4, 4  # 4 scales x 3 ratios
+    cls_prob = nd.array(rng.rand(N, 2 * K, H, W).astype(np.float32))
+    bbox_pred = nd.array((rng.rand(N, 4 * K, H, W).astype(np.float32) - 0.5) * 0.1)
+    im_info = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    rois = getattr(mx.contrib.ndarray, "Proposal")(
+        cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10
+    )
+    assert rois.shape == (10, 5)
+    r = rois.asnumpy()
+    assert (r[:, 0] == 0).all()  # batch idx
+
+
+# ---- spatial ops ----------------------------------------------------------
+def test_roi_pooling():
+    data = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+    out = nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(out.asnumpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_bilinear_sampler_identity():
+    data = nd.array(rng.rand(1, 2, 4, 4).astype(np.float32))
+    ys = np.linspace(-1, 1, 4)
+    xs = np.linspace(-1, 1, 4)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    grid = nd.array(np.stack([gx, gy])[None].astype(np.float32))
+    out = nd.BilinearSampler(data, grid)
+    np.testing.assert_allclose(out.asnumpy(), data.asnumpy(), rtol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    data = nd.array(rng.rand(1, 1, 5, 5).astype(np.float32))
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    out = nd.SpatialTransformer(
+        data, theta, target_shape=(5, 5), transform_type="affine", sampler_type="bilinear"
+    )
+    np.testing.assert_allclose(out.asnumpy(), data.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_grid_generator_affine():
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    grid = nd.GridGenerator(theta, transform_type="affine", target_shape=(3, 3))
+    assert grid.shape == (1, 2, 3, 3)
+    g = grid.asnumpy()[0]
+    np.testing.assert_allclose(g[0][:, 0], [-1, -1, -1], atol=1e-6)
+    np.testing.assert_allclose(g[1][0], [-1, -1, -1], atol=1e-6)
+
+
+def test_correlation_self():
+    x = nd.array(rng.rand(1, 2, 6, 6).astype(np.float32))
+    out = nd.Correlation(
+        x, x, kernel_size=1, max_displacement=1, stride1=1, stride2=1, pad_size=1
+    )
+    # displacement grid 3x3 = 9 channels
+    assert out.shape[1] == 9
+    o = out.asnumpy()
+    # zero-displacement channel (center, idx 4) is mean of squares > others on average
+    assert o[:, 4].mean() >= o[:, 0].mean()
+
+
+def test_spatial_transformer_grad():
+    data = sym.Variable("data")
+    loc = sym.Variable("loc")
+    st = sym.SpatialTransformer(data, loc, target_shape=(4, 4), transform_type="affine",
+                                sampler_type="bilinear")
+    out = sym.MakeLoss(sym.sum(st))
+    x = rng.rand(1, 1, 4, 4).astype(np.float32)
+    theta = np.array([[1, 0, 0.1, 0, 1, -0.1]], np.float32)
+    ex = out.bind(
+        mx.cpu(), {"data": nd.array(x), "loc": nd.array(theta)},
+        args_grad={"data": nd.zeros((1, 1, 4, 4)), "loc": nd.zeros((1, 6))},
+    )
+    ex.forward(is_train=True)
+    ex.backward()
+    assert np.abs(ex.grad_dict["loc"].asnumpy()).sum() > 0
+    assert np.abs(ex.grad_dict["data"].asnumpy()).sum() > 0
+
+
+def test_kl_sparse_reg():
+    x = nd.array(rng.rand(4, 3).astype(np.float32))
+    mov = nd.zeros((3,))
+    out = nd.IdentityAttachKLSparseReg(x, mov)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    assert np.abs(mov.asnumpy()).sum() > 0  # moving average updated
