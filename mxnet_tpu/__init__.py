@@ -46,4 +46,8 @@ from . import parallel
 from . import contrib
 from . import test_utils
 
+# later-MXNet convenience aliases: mx.nd.contrib.<op> / mx.sym.contrib.<op>
+ndarray.contrib = contrib.ndarray
+symbol.contrib = contrib.symbol
+
 __version__ = "0.1.0"

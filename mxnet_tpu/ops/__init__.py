@@ -18,4 +18,5 @@ from . import optimizer_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import spatial  # noqa: F401
+from . import attention  # noqa: F401
 from .registry import OpContext, Operator, get_op, list_ops, register, register_simple  # noqa: F401

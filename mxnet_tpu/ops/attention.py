@@ -1,0 +1,358 @@
+"""Attention ops — flash (memory-efficient) multi-head attention.
+
+The reference (MXNet v0.10.1) predates attention entirely — its long-sequence
+story is bucketing + fused cuDNN RNNs (SURVEY §5 "Long-context"). This module is
+the green-field TPU-first design that gives the framework a modern long-context
+path while staying inside the op-registry contract (ops/registry.py).
+
+Design:
+
+* ``flash_attention(q, k, v)`` operates on ``(batch, heads, seq, head_dim)``.
+  Forward and backward are the FlashAttention online-softmax algorithm expressed
+  as ``lax.scan`` over key/value blocks — O(seq) memory instead of O(seq^2),
+  static shapes, MXU-sized matmul blocks. ``jax.custom_vjp`` saves only
+  ``(q, k, v, out, lse)`` residuals; the backward pass is the standard
+  dq/dk/dv block recurrence (recompute-based, no S matrix ever materialised).
+* On TPU the forward uses a Pallas kernel (``_pallas_forward``) blocked to the
+  (8,128)/MXU tiling; everywhere else (CPU tests, odd shapes) the pure-XLA scan
+  path runs. Both produce identical (out, lse) residuals so the backward is
+  shared.
+* The op is registered as ``_contrib_FlashAttention`` so it is reachable from
+  both ``mx.nd.contrib.FlashAttention`` and ``mx.sym.contrib.FlashAttention``
+  (the escape-hatch naming the reference uses for new ops, SURVEY §2.3 contrib).
+* Ring/Ulysses sequence parallelism (parallel/ring.py) reuses the same block
+  kernel: a ring step is one ``_block_update`` against a remote KV shard.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import Param, register
+
+__all__ = ["flash_attention", "attention_reference"]
+
+_NEG_INF = -1e30
+
+
+def _scale(sm_scale, d):
+    return 1.0 / np.sqrt(d) if sm_scale is None else sm_scale
+
+
+def attention_reference(q, k, v, causal=False, sm_scale=None):
+    """Naive softmax attention — the numeric oracle for tests (O(S^2) memory)."""
+    sm_scale = _scale(sm_scale, q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None]
+        ki = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ------------------------------------------------------------------ block math
+def _block_update(q, k_blk, v_blk, m, l, acc, sm_scale, mask=None):
+    """One online-softmax update of (m, l, acc) with a KV block.
+
+    q: (B,H,Sq,D) f32; k_blk/v_blk: (B,H,Bk,D); m,l: (B,H,Sq); acc: (B,H,Sq,D).
+    mask: optional (Sq, Bk) bool — True = attend.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(s - m_new[..., None])
+    scale = jnp.exp(m - m_new)
+    l_new = l * scale + jnp.sum(p, axis=-1)
+    acc_new = acc * scale[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v_blk, preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
+def _scan_forward(q, k, v, causal, sm_scale, block_k):
+    """Pure-XLA flash forward: lax.scan over KV blocks. Returns (out, lse) f32."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_k = min(block_k, sk)
+    n_blk = -(-sk // block_k)
+    pad = n_blk * block_k - sk
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    # (n_blk, B, H, block_k, D) scan-major layout
+    kb = jnp.moveaxis(kf.reshape(b, h, n_blk, block_k, d), 2, 0)
+    vb = jnp.moveaxis(vf.reshape(b, h, n_blk, block_k, d), 2, 0)
+    qi = jnp.arange(sq)
+
+    def step(carry, xs):
+        m, l, acc = carry
+        k_blk, v_blk, blk_idx = xs
+        ki = blk_idx * block_k + jnp.arange(block_k)
+        mask = ki[None, :] < sk  # (1, Bk) padding mask
+        if causal:
+            mask = mask & (qi[:, None] >= ki[None, :])
+        else:
+            mask = jnp.broadcast_to(mask, (sq, block_k))
+        m, l, acc = _block_update(qf, k_blk, v_blk, m, l, acc, sm_scale, mask)
+        return (m, l, acc), None
+
+    m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    (m, l, acc), _ = lax.scan(step, (m0, l0, acc0), (kb, vb, jnp.arange(n_blk)))
+    l = jnp.maximum(l, 1e-30)
+    out = acc / l[..., None]
+    lse = m + jnp.log(l)
+    return out, lse
+
+
+def _pallas_forward(q, k, v, causal, sm_scale, block_q=128, block_k=128, interpret=False):
+    """Pallas TPU flash-attention forward.
+
+    Grid (batch*heads, q_blocks, kv_blocks) with the KV axis innermost: TPU
+    executes the grid sequentially along the last axis, so (m, l, acc) live in
+    VMEM scratch carried across KV steps — per-core VMEM is O(block_q·d +
+    block_k·d), independent of sequence length. Output is written on the last
+    KV step. Returns (out, lse) float32, identical residuals to
+    ``_scan_forward``.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q = -(-sq // block_q)
+    n_k = -(-sk // block_k)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref):
+        qi_blk = pl.program_id(1)
+        kj = pl.program_id(2)
+
+        @pl.when(kj == 0)
+        def _init():
+            m_ref[:] = jnp.full((block_q,), _NEG_INF, jnp.float32)
+            l_ref[:] = jnp.zeros((block_q,), jnp.float32)
+            acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
+
+        # causal: skip blocks strictly above the diagonal
+        first_q_pos = qi_blk * block_q + block_q - 1  # last row of the q block
+        run = (kj * block_k <= first_q_pos) if causal else True
+
+        @pl.when(run)
+        def _step():
+            qv = q_ref[0].astype(jnp.float32)
+            kv = k_ref[0].astype(jnp.float32)
+            vv = v_ref[0].astype(jnp.float32)
+            s = jnp.dot(qv, kv.T, preferred_element_type=jnp.float32) * sm_scale
+            q_pos = qi_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = k_pos < sk
+            if causal:
+                mask = mask & (q_pos >= k_pos)
+            s = jnp.where(mask, s, _NEG_INF)
+            m = m_ref[:]
+            m_blk = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m, m_blk)
+            p = jnp.exp(s - m_new[:, None])
+            scale = jnp.exp(m - m_new)
+            m_ref[:] = m_new
+            l_ref[:] = l_ref[:] * scale + jnp.sum(p, axis=-1)
+            acc_ref[:] = acc_ref[:] * scale[:, None] + jnp.dot(
+                p, vv, preferred_element_type=jnp.float32
+            )
+
+        @pl.when(kj == n_k - 1)
+        def _finish():
+            l = jnp.maximum(l_ref[:], 1e-30)
+            o_ref[0] = acc_ref[:] / l[:, None]
+            lse_ref[0] = (m_ref[:] + jnp.log(l))[None, :]
+
+    bh = b * h
+    qr = q.reshape(bh, sq, d)
+    kr = k.reshape(bh, sk, d)
+    vr = v.reshape(bh, sk, d)
+    pad_q = n_q * block_q - sq
+    pad_k = n_k * block_k - sk
+    if pad_q:
+        qr = jnp.pad(qr, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kr = jnp.pad(kr, ((0, 0), (0, pad_k), (0, 0)))
+        vr = jnp.pad(vr, ((0, 0), (0, pad_k), (0, 0)))
+    grid = (bh, n_q, n_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n_q * block_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, n_q * block_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    out = out[:, :sq].reshape(b, h, sq, d)
+    lse = lse[:, 0, :sq].reshape(b, h, sq)
+    return out, lse
+
+
+def _use_pallas(q, k):
+    if jax.default_backend() != "tpu":
+        return False
+    d = q.shape[-1]
+    return d % 128 == 0 and q.shape[2] >= 128 and k.shape[2] >= 128
+
+
+def _scan_backward(q, k, v, out, lse, g, causal, sm_scale, block_k):
+    """Flash backward: recompute P per block from saved lse; accumulate dq/dk/dv."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_k = min(block_k, sk)
+    n_blk = -(-sk // block_k)
+    pad = n_blk * block_k - sk
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    gf = g.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = jnp.moveaxis(kf.reshape(b, h, n_blk, block_k, d), 2, 0)
+    vb = jnp.moveaxis(vf.reshape(b, h, n_blk, block_k, d), 2, 0)
+    delta = jnp.sum(of * gf, axis=-1)  # (B,H,Sq)
+    qi = jnp.arange(sq)
+
+    def step(dq, xs):
+        k_blk, v_blk, blk_idx = xs
+        ki = blk_idx * block_k + jnp.arange(block_k)
+        mask = ki[None, :] < sk
+        if causal:
+            mask = mask & (qi[:, None] >= ki[None, :])
+        else:
+            mask = jnp.broadcast_to(mask, (sq, block_k))
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk, preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # (B,H,Sq,Bk)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, gf, preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v_blk, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk, preferred_element_type=jnp.float32)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf, preferred_element_type=jnp.float32)
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    dq, (dk_b, dv_b) = lax.scan(step, dq0, (kb, vb, jnp.arange(n_blk)))
+    dk = jnp.moveaxis(dk_b, 0, 2).reshape(b, h, n_blk * block_k, d)[:, :, :sk]
+    dv = jnp.moveaxis(dv_b, 0, 2).reshape(b, h, n_blk * block_k, d)[:, :, :sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_k=256):
+    """Memory-efficient attention over (batch, heads, seq, head_dim)."""
+    out, _ = _forward_impl(q, k, v, causal, sm_scale, block_k)
+    return out
+
+
+def _forward_impl(q, k, v, causal, sm_scale, block_k):
+    sm_scale = _scale(sm_scale, q.shape[-1])
+    if _use_pallas(q, k):
+        out, lse = _pallas_forward(q, k, v, causal, sm_scale)
+    else:
+        out, lse = _scan_forward(q, k, v, causal, sm_scale, block_k)
+    return out.astype(q.dtype), lse
+
+
+def _fa_fwd(q, k, v, causal, sm_scale, block_k):
+    out, lse = _forward_impl(q, k, v, causal, sm_scale, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, sm_scale, block_k, res, g):
+    q, k, v, out, lse = res
+    return _scan_backward(q, k, v, out, lse, g, causal, _scale(sm_scale, q.shape[-1]), block_k)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ------------------------------------------------------------- registered ops
+@register(
+    "_contrib_FlashAttention",
+    arg_names=("query", "key", "value"),
+    params={
+        "causal": Param.bool(False),
+        "sm_scale": Param.float(-1.0),
+    },
+)
+def _flash_attention_op(octx, attrs, args, auxs):
+    q, k, v = args
+    scale = attrs["sm_scale"]
+    out = flash_attention(q, k, v, attrs["causal"], None if scale <= 0 else scale)
+    return [out], []
+
+
+@register(
+    "_contrib_MultiHeadAttention",
+    arg_names=("data", "in_weight", "out_weight"),
+    params={
+        "num_heads": Param.int(),
+        "causal": Param.bool(True),
+    },
+)
+def _mha_op(octx, attrs, args, auxs):
+    """Self-attention block over (batch, seq, model): fused qkv projection +
+    flash attention + output projection. in_weight: (3*model, model),
+    out_weight: (model, model) — weights laid out like FullyConnected (out, in)."""
+    x, w_in, w_out = args
+    bsz, seq, model = x.shape
+    heads = attrs["num_heads"]
+    hd = model // heads
+    qkv = jnp.einsum("bsm,nm->bsn", x, w_in)  # (B,S,3*model)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def split_heads(t):
+        return t.reshape(bsz, seq, heads, hd).transpose(0, 2, 1, 3)
+
+    out = flash_attention(split_heads(q), split_heads(k), split_heads(v), attrs["causal"])
+    out = out.transpose(0, 2, 1, 3).reshape(bsz, seq, model)
+    return [jnp.einsum("bsm,nm->bsn", out, w_out)], []
+
+
+def _mha_infer_shape(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    if data is None:
+        raise ValueError("MultiHeadAttention: data shape required")
+    model = data[2]
+    if in_shapes[1] is None:
+        in_shapes[1] = (3 * model, model)
+    if in_shapes[2] is None:
+        in_shapes[2] = (model, model)
+    return in_shapes, [tuple(data)], []
+
+
+from .registry import get_op  # noqa: E402
+
+get_op("_contrib_MultiHeadAttention")._infer_shape = _mha_infer_shape
