@@ -1,0 +1,122 @@
+"""Loader + ctypes bindings for the native runtime (libmxtpu.so).
+
+The reference ships its runtime as one C++ shared library loaded by the
+Python frontend (reference: python/mxnet/base.py _load_lib / libinfo.py find_lib_path);
+here the library holds the host-side runtime: the threaded dependency engine
+(src/engine.cc), pooled host allocator (src/allocator.cc), sharded RecordIO
+reader (src/recordio.cc) and the parameter-server transport (src/ps.cc).
+
+Built on demand with `make` (g++) into mxnet_tpu/src/build/libmxtpu.so.
+``get_lib()`` returns None if no toolchain is available — callers fall back
+to pure-python paths so the framework stays importable anywhere.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_LIB_PATH = os.path.join(_SRC_DIR, "build", "libmxtpu.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    try:
+        subprocess.run(
+            ["make", "-s", "-j4"], cwd=_SRC_DIR, check=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=300,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _declare(lib):
+    c = ctypes
+    # engine
+    lib.mxt_engine_create.restype = c.c_void_p
+    lib.mxt_engine_create.argtypes = [c.c_int]
+    lib.mxt_engine_destroy.argtypes = [c.c_void_p]
+    lib.mxt_engine_new_var.restype = c.c_void_p
+    lib.mxt_engine_new_var.argtypes = [c.c_void_p]
+    lib.mxt_engine_delete_var.argtypes = [c.c_void_p, c.c_void_p]
+    lib.mxt_engine_push.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p,
+        c.POINTER(c.c_void_p), c.c_int, c.POINTER(c.c_void_p), c.c_int, c.c_int,
+    ]
+    lib.mxt_engine_wait_for_var.argtypes = [c.c_void_p, c.c_void_p]
+    lib.mxt_engine_wait_all.argtypes = [c.c_void_p]
+    lib.mxt_engine_outstanding.restype = c.c_longlong
+    lib.mxt_engine_outstanding.argtypes = [c.c_void_p]
+    # allocator
+    lib.mxt_alloc.restype = c.c_void_p
+    lib.mxt_alloc.argtypes = [c.c_size_t]
+    lib.mxt_free.argtypes = [c.c_void_p, c.c_size_t]
+    lib.mxt_pool_in_use.restype = c.c_longlong
+    lib.mxt_pool_pooled.restype = c.c_longlong
+    lib.mxt_pool_set_cap.argtypes = [c.c_longlong]
+    # recordio
+    lib.mxt_rec_reader_open.restype = c.c_void_p
+    lib.mxt_rec_reader_open.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int]
+    lib.mxt_rec_reader_next.restype = c.c_int
+    lib.mxt_rec_reader_next.argtypes = [
+        c.c_void_p, c.POINTER(c.POINTER(c.c_char)), c.POINTER(c.c_size_t)]
+    lib.mxt_rec_free.argtypes = [c.POINTER(c.c_char), c.c_size_t]
+    lib.mxt_rec_reader_close.argtypes = [c.c_void_p]
+    # ps
+    lib.mxt_ps_server_create.restype = c.c_void_p
+    lib.mxt_ps_server_create.argtypes = [c.c_int, c.c_int, c.c_int]
+    lib.mxt_ps_server_set_updater.argtypes = [c.c_void_p, c.c_void_p]
+    lib.mxt_ps_server_wait.argtypes = [c.c_void_p]
+    lib.mxt_ps_server_destroy.argtypes = [c.c_void_p]
+    lib.mxt_ps_client_create.restype = c.c_void_p
+    lib.mxt_ps_client_create.argtypes = [c.c_char_p, c.c_int]
+    lib.mxt_ps_client_push.restype = c.c_int
+    lib.mxt_ps_client_push.argtypes = [
+        c.c_void_p, c.c_int, c.POINTER(c.c_float), c.c_ulonglong]
+    lib.mxt_ps_client_pull.restype = c.c_longlong
+    lib.mxt_ps_client_pull.argtypes = [
+        c.c_void_p, c.c_int, c.POINTER(c.c_float), c.c_ulonglong]
+    lib.mxt_ps_client_pushpull.restype = c.c_longlong
+    lib.mxt_ps_client_pushpull.argtypes = [
+        c.c_void_p, c.c_int, c.POINTER(c.c_float), c.c_ulonglong,
+        c.POINTER(c.c_float), c.c_ulonglong]
+    lib.mxt_ps_client_barrier.restype = c.c_int
+    lib.mxt_ps_client_barrier.argtypes = [c.c_void_p]
+    lib.mxt_ps_client_command.restype = c.c_int
+    lib.mxt_ps_client_command.argtypes = [c.c_void_p, c.c_char_p]
+    lib.mxt_ps_client_stop.restype = c.c_int
+    lib.mxt_ps_client_stop.argtypes = [c.c_void_p]
+    lib.mxt_ps_client_destroy.argtypes = [c.c_void_p]
+    return lib
+
+
+def get_lib():
+    """Return the loaded native library, building it if needed, or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH):
+            if os.environ.get("MXNET_TPU_NO_NATIVE"):
+                return None
+            if not _build():
+                return None
+        try:
+            _lib = _declare(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+# C callback signatures
+ENGINE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+UPDATER_FN = ctypes.CFUNCTYPE(
+    None, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+    ctypes.POINTER(ctypes.c_float), ctypes.c_uint64)

@@ -1,0 +1,168 @@
+"""Engine — host-side async dependency scheduler over the native runtime.
+
+Reference: include/mxnet/engine.h:75-250 (NewVariable/NewOperator/PushAsync/
+WaitForVar/WaitForAll) with ThreadedEnginePerDevice as the default
+implementation and NaiveEngine as the synchronous debug fallback, selected by
+``MXNET_ENGINE_TYPE`` (src/engine/engine.cc:13-39).
+
+On TPU the *device* stream is XLA's own async dispatch (every jitted call is
+already non-blocking), so this engine schedules the HOST side of the
+framework: data-pipeline stages, checkpoint/serialization work, kvstore
+server handlers and custom-op callbacks — anything the reference ran on its
+CPU worker pools. The dependency model is identical: ops declare const
+(read) and mutable (write) vars; writes are exclusive, reads shared, FIFO
+per var.
+
+``MXNET_ENGINE_TYPE=NaiveEngine`` runs everything inline on the pushing
+thread (the reference's bisection tool for scheduling bugs);
+``MXNET_CPU_WORKER_NTHREADS`` sizes the pool.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ._native import ENGINE_FN, get_lib
+
+__all__ = ["Engine", "NaiveEngine", "ThreadedEngine", "get_engine", "Var"]
+
+
+class Var:
+    """Opaque dependency token (reference: engine.h VarHandle)."""
+
+    __slots__ = ("handle",)
+
+    def __init__(self, handle):
+        self.handle = handle
+
+
+class Engine:
+    def new_variable(self):
+        raise NotImplementedError
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        raise NotImplementedError
+
+    def wait_for_var(self, var):
+        raise NotImplementedError
+
+    def wait_all(self):
+        raise NotImplementedError
+
+    def delete_variable(self, var):
+        raise NotImplementedError
+
+
+class NaiveEngine(Engine):
+    """Synchronous engine: push == run (reference: src/engine/naive_engine.cc)."""
+
+    def new_variable(self):
+        return Var(None)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        fn()
+
+    def wait_for_var(self, var):
+        pass
+
+    def wait_all(self):
+        pass
+
+    def delete_variable(self, var):
+        pass
+
+
+class ThreadedEngine(Engine):
+    """Native threaded dependency engine (src/engine.cc via ctypes).
+
+    Python callables are retained until their op completes; the C++ side
+    invokes them on worker threads through a single trampoline.
+    """
+
+    def __init__(self, num_workers=None):
+        import ctypes
+
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable (no g++?); "
+                               "set MXNET_ENGINE_TYPE=NaiveEngine")
+        if num_workers is None:
+            num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS",
+                                             str(min(8, os.cpu_count() or 1))))
+        self._lib = lib
+        self._handle = lib.mxt_engine_create(num_workers)
+        self._pending = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = [1]
+        self._ctypes = ctypes
+
+        def _trampoline(arg):
+            key = int(arg)
+            with self._pending_lock:
+                fn = self._pending.pop(key)
+            try:
+                fn()
+            except Exception:  # worker threads must never die on user errors
+                import traceback
+
+                traceback.print_exc()
+
+        self._trampoline = ENGINE_FN(_trampoline)  # keep alive
+
+    def new_variable(self):
+        return Var(self._lib.mxt_engine_new_var(self._handle))
+
+    def _var_array(self, vars_):
+        import ctypes
+
+        arr = (ctypes.c_void_p * max(len(vars_), 1))()
+        for i, v in enumerate(vars_):
+            arr[i] = v.handle
+        return arr
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        with self._pending_lock:
+            key = self._next_id[0]
+            self._next_id[0] += 1
+            self._pending[key] = fn
+        cv = self._var_array(const_vars)
+        mv = self._var_array(mutable_vars)
+        self._lib.mxt_engine_push(
+            self._handle, self._ctypes.cast(self._trampoline, self._ctypes.c_void_p),
+            key, cv, len(const_vars), mv, len(mutable_vars), priority)
+
+    def wait_for_var(self, var):
+        self._lib.mxt_engine_wait_for_var(self._handle, var.handle)
+
+    def wait_all(self):
+        self._lib.mxt_engine_wait_all(self._handle)
+
+    def delete_variable(self, var):
+        self._lib.mxt_engine_delete_var(self._handle, var.handle)
+
+    def __del__(self):
+        try:
+            self._lib.mxt_engine_wait_all(self._handle)
+            self._lib.mxt_engine_destroy(self._handle)
+        except Exception:
+            pass
+
+
+_engine = None
+_engine_lock = threading.Lock()
+
+
+def get_engine():
+    """Process-global engine singleton (reference: Engine::Get, engine.cc:42-50)."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            kind = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+            if kind == "NaiveEngine":
+                _engine = NaiveEngine()
+            else:
+                try:
+                    _engine = ThreadedEngine()
+                except RuntimeError:
+                    _engine = NaiveEngine()
+        return _engine

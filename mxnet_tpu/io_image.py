@@ -90,16 +90,12 @@ class ImageRecordIter(DataIter):
                 yield rec.read_idx(k)
             rec.close()
         else:
-            rec = recordio.MXRecordIO(self.path_imgrec, "r")
-            i = 0
-            while True:
-                s = rec.read()
-                if s is None:
-                    break
-                if self.num_parts > 1 and i % self.num_parts != self.part_index:
-                    i += 1
-                    continue
-                i += 1
+            # native sharded reader: byte-range split + background producer
+            # thread (the reference's InputSplit contract); python fallback
+            # inside RecReader keeps round-robin semantics.
+            rec = recordio.RecReader(
+                self.path_imgrec, self.part_index, self.num_parts)
+            for s in rec:
                 yield s
             rec.close()
 

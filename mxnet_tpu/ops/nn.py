@@ -44,15 +44,13 @@ def _fully_connected(octx, attrs, args, auxs):
         x = data.reshape(data.shape[0], -1)
     else:
         x = data
-    out = jnp.dot(x, weight.T, preferred_element_type=_acc(x.dtype))
+    # No preferred_element_type: the MXU accumulates bf16 dots in fp32
+    # natively, and this JAX version's conv/dot transpose rules reject a
+    # widened cotangent dtype under vjp.
+    out = jnp.dot(x, weight.T)
     if not attrs["no_bias"]:
         out = out + args[2]
-    return [out.astype(data.dtype)], []
-
-
-def _acc(dt):
-    dt = np.dtype(dt)
-    return np.float32 if dt in (np.dtype(np.float16), np.dtype(jnp.bfloat16)) else None
+    return [out], []
 
 
 def _fc_infer_shape(attrs, in_shapes, aux_shapes):
@@ -127,8 +125,7 @@ def _convolution(octx, attrs, args, auxs):
         rhs_dilation=dilate,
         dimension_numbers=_conv_dn(nd),
         feature_group_count=attrs["num_group"],
-        preferred_element_type=_acc(data.dtype),
-    ).astype(data.dtype)
+    )
     if not attrs["no_bias"]:
         bias = args[2]
         out = out + bias.reshape((1, -1) + (1,) * nd)
@@ -193,8 +190,7 @@ def _deconvolution(octx, attrs, args, auxs):
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=attrs["num_group"],
-        preferred_element_type=_acc(data.dtype),
-    ).astype(data.dtype)
+    )
     if not attrs["no_bias"]:
         out = out + args[2].reshape((1, -1) + (1,) * nd)
     return [out], []

@@ -18,7 +18,7 @@ import numpy as np
 
 from .base import MXNetError
 
-__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack", "unpack_img", "pack_img"]
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "RecReader", "IRHeader", "pack", "unpack", "unpack_img", "pack_img"]
 
 _kMagic = 0xCED7230A
 
@@ -162,6 +162,70 @@ class MXIndexedRecordIO(MXRecordIO):
         self.write(buf)
         self.idx[key] = pos
         self.keys.append(key)
+
+
+class RecReader:
+    """Native threaded sharded .rec reader (src/recordio.cc via ctypes).
+
+    The analog of the reference's dmlc::InputSplit + background parser thread
+    (src/io/iter_image_recordio_2.cc:67): owns a byte-range shard
+    [part_index/num_parts) of the file, scans to the first magic-aligned
+    record, and produces records from a background thread into a bounded
+    queue. Iterate to get bytes objects. Falls back to MXRecordIO when the
+    native runtime is unavailable.
+    """
+
+    def __init__(self, uri, part_index=0, num_parts=1, queue_size=64):
+        from ._native import get_lib
+
+        self.uri = uri
+        self._lib = get_lib()
+        self._handle = None
+        self._fallback = None
+        self._fallback_i = 0
+        self.part_index = part_index
+        self.num_parts = num_parts
+        if self._lib is not None:
+            self._handle = self._lib.mxt_rec_reader_open(
+                uri.encode(), part_index, num_parts, queue_size)
+        if self._handle is None:
+            self._fallback = MXRecordIO(uri, "r")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._handle is not None:
+            data = ctypes.POINTER(ctypes.c_char)()
+            length = ctypes.c_size_t()
+            if not self._lib.mxt_rec_reader_next(
+                    self._handle, ctypes.byref(data), ctypes.byref(length)):
+                raise StopIteration
+            buf = ctypes.string_at(data, length.value)
+            self._lib.mxt_rec_free(data, length)
+            return buf
+        # python fallback: round-robin record sharding
+        while True:
+            s = self._fallback.read()
+            if s is None:
+                raise StopIteration
+            i = self._fallback_i
+            self._fallback_i += 1
+            if self.num_parts <= 1 or i % self.num_parts == self.part_index:
+                return s
+
+    next = __next__
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.mxt_rec_reader_close(self._handle)
+            self._handle = None
+        if self._fallback is not None:
+            self._fallback.close()
+            self._fallback = None
+
+    def __del__(self):
+        self.close()
 
 
 IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])

@@ -1,0 +1,476 @@
+// Native parameter-server transport — rebuild of the reference's distributed
+// KVStore backbone (reference: ps-lite ZPush/ZPull consumed by
+// src/kvstore/kvstore_dist.h:88-133; server aggregation logic
+// src/kvstore/kvstore_dist_server.h:136-219 — sync mode merges pushes from
+// all workers then applies the updater, async applies per push; barrier via
+// ps::Postoffice, kvstore_dist.h:144-146).
+//
+// TPU-native role: the *synchronous* data-parallel fast path on a pod uses
+// XLA collectives over ICI/DCN (parallel/spmd.py), not this. This server
+// exists for the reference's other semantics that collectives cannot
+// express: `dist_async` (per-push updates, no lockstep), server-side
+// optimizer state, and elastic worker membership — and as the host-side
+// coordination plane (barriers, key init) for `dist_sync` when the trainer
+// is not jit-fused.
+//
+// Transport: plain TCP, one connection per worker, blocking RPCs framed as
+//   [uint32 type][int32 key][uint64 nbytes][payload]
+// float32 payloads (the reference also ships flattened fp32 buffers,
+// kvstore_dist.h:95). Multi-server sharding is done caller-side: the Python
+// KVStore assigns key -> server by hash, one RecClient per server.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <memory>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace mxt {
+
+enum MsgType : uint32_t {
+  kPush = 1,
+  kPull = 2,
+  kResp = 3,
+  kBarrier = 4,
+  kCommand = 6,
+  kStop = 7,
+  kPushPull = 8,
+};
+
+#pragma pack(push, 1)
+struct MsgHeader {
+  uint32_t type;
+  int32_t key;
+  uint64_t nbytes;
+};
+#pragma pack(pop)
+
+static bool ReadAll(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t k = ::read(fd, p, n);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+static bool WriteAll(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t k = ::write(fd, p, n);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+// Server-side updater callback: (key, grad, weight, n) — mutates weight in
+// place. Registered from the hosting process (Python server runs the real
+// pickled optimizer through this hook, reference kvstore_server.py:36-44).
+typedef void (*UpdaterFn)(int key, const float* grad, float* weight,
+                          uint64_t n);
+
+class PSServer {
+ public:
+  PSServer(int port, int num_workers, bool sync)
+      : num_workers_(num_workers), sync_(sync) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+            0 ||
+        listen(listen_fd_, 128) != 0) {
+      failed_ = true;
+      return;
+    }
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~PSServer() { Stop(); }
+
+  void SetUpdater(UpdaterFn fn) { updater_ = fn; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    // wake every blocked conn thread (sync-push/pull/barrier waits check
+    // stopping_ in their predicates but need the notify to re-evaluate)
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      for (auto& kv : entries_) kv.second->cv.notify_all();
+    }
+    {
+      std::unique_lock<std::mutex> lk(barrier_mu_);
+      barrier_cv_.notify_all();
+    }
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> conns;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      conns.swap(conn_threads_);
+    }
+    for (auto& t : conns) t.join();
+  }
+
+  // Block until a worker sends kStop (reference: KVStoreDistServer::Run
+  // blocks in Executor::Start, kvstore_dist_server.h:33).
+  void WaitStopped() {
+    std::unique_lock<std::mutex> lk(stop_mu_);
+    stop_cv_.wait(lk, [&] { return stop_requested_; });
+  }
+
+  bool failed() const { return failed_; }
+
+ private:
+  struct Entry {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::vector<float> weight;
+    std::vector<float> merged;
+    int pending = 0;    // pushes merged so far this round
+    int64_t version = 0;  // bumped when a sync round commits
+    bool inited = false;
+  };
+
+  void AcceptLoop() {
+    for (;;) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::unique_lock<std::mutex> lk(mu_);
+      if (stopping_) {
+        ::close(fd);
+        return;
+      }
+      conn_threads_.emplace_back([this, fd] { ConnLoop(fd); });
+    }
+  }
+
+  Entry* GetEntry(int key) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto& e = entries_[key];
+    if (!e) e.reset(new Entry());
+    return e.get();
+  }
+
+  // First push for a key initializes the weight (reference: kv.init goes
+  // through the same DataHandle path, kvstore_dist_server.h:149-160).
+  void HandlePush(int key, Entry* e, const float* data, uint64_t n) {
+    std::unique_lock<std::mutex> lk(e->mu);
+    if (!e->inited) {
+      e->weight.assign(data, data + n);
+      e->inited = true;
+      e->version++;
+      e->cv.notify_all();
+      return;
+    }
+    if (e->weight.size() != n) e->weight.resize(n, 0.f);
+    if (!sync_) {  // async: apply immediately (dist_server.h:199-207)
+      ApplyLocked(key, e, data, n);
+      return;
+    }
+    // sync: merge; the worker completing the round applies + commits
+    if (e->merged.size() != n) e->merged.assign(n, 0.f);
+    for (uint64_t i = 0; i < n; ++i) e->merged[i] += data[i];
+    e->pending++;
+    if (e->pending >= num_workers_) {
+      ApplyLocked(key, e, e->merged.data(), n);
+      e->merged.assign(n, 0.f);
+      e->pending = 0;
+      e->version++;
+      e->cv.notify_all();
+    } else {
+      int64_t v = e->version;
+      e->cv.wait(lk, [&] { return e->version != v || stopping_; });
+    }
+  }
+
+  void ApplyLocked(int key, Entry* e, const float* grad, uint64_t n) {
+    if (updater_) {
+      updater_(key, grad, e->weight.data(), n);
+    } else {
+      // no updater: store the merged value (dist_server.h else-branch —
+      // update_on_kvstore=False workers pull merged grads back)
+      memcpy(e->weight.data(), grad, n * sizeof(float));
+    }
+  }
+
+  void ConnLoop(int fd) {
+    std::vector<float> buf;
+    for (;;) {
+      MsgHeader h;
+      if (!ReadAll(fd, &h, sizeof(h))) break;
+      if (h.type == kStop) {
+        MsgHeader r{kResp, 0, 0};
+        WriteAll(fd, &r, sizeof(r));
+        std::unique_lock<std::mutex> lk(stop_mu_);
+        stop_requested_ = true;
+        stop_cv_.notify_all();
+        break;
+      }
+      switch (h.type) {
+        case kPush: {
+          uint64_t n = h.nbytes / sizeof(float);
+          buf.resize(n);
+          if (!ReadAll(fd, buf.data(), h.nbytes)) return CloseFd(fd);
+          Entry* e = GetEntry(h.key);
+          HandlePush(h.key, e, buf.data(), n);
+          MsgHeader r{kResp, h.key, 0};
+          if (!WriteAll(fd, &r, sizeof(r))) return CloseFd(fd);
+          break;
+        }
+        case kPull: {
+          Entry* e = GetEntry(h.key);
+          std::unique_lock<std::mutex> lk(e->mu);
+          e->cv.wait(lk, [&] { return e->inited || stopping_; });
+          MsgHeader r{kResp, h.key,
+                      static_cast<uint64_t>(e->weight.size() * sizeof(float))};
+          if (!WriteAll(fd, &r, sizeof(r))) return CloseFd(fd);
+          if (!WriteAll(fd, e->weight.data(), r.nbytes)) return CloseFd(fd);
+          break;
+        }
+        case kPushPull: {  // fused push+pull round trip (saves one RTT)
+          uint64_t n = h.nbytes / sizeof(float);
+          buf.resize(n);
+          if (!ReadAll(fd, buf.data(), h.nbytes)) return CloseFd(fd);
+          Entry* e = GetEntry(h.key);
+          HandlePush(h.key, e, buf.data(), n);
+          std::unique_lock<std::mutex> lk(e->mu);
+          MsgHeader r{kResp, h.key,
+                      static_cast<uint64_t>(e->weight.size() * sizeof(float))};
+          if (!WriteAll(fd, &r, sizeof(r))) return CloseFd(fd);
+          if (!WriteAll(fd, e->weight.data(), r.nbytes)) return CloseFd(fd);
+          break;
+        }
+        case kBarrier: {
+          std::unique_lock<std::mutex> lk(barrier_mu_);
+          int64_t gen = barrier_gen_;
+          if (++barrier_count_ >= num_workers_) {
+            barrier_count_ = 0;
+            barrier_gen_++;
+            barrier_cv_.notify_all();
+          } else {
+            barrier_cv_.wait(
+                lk, [&] { return barrier_gen_ != gen || stopping_; });
+          }
+          MsgHeader r{kResp, 0, 0};
+          if (!WriteAll(fd, &r, sizeof(r))) return CloseFd(fd);
+          break;
+        }
+        case kCommand: {
+          std::string cmd(h.nbytes, '\0');
+          if (h.nbytes && !ReadAll(fd, &cmd[0], h.nbytes)) return CloseFd(fd);
+          if (cmd.rfind("sync:", 0) == 0) sync_ = cmd[5] == '1';
+          MsgHeader r{kResp, 0, 0};
+          if (!WriteAll(fd, &r, sizeof(r))) return CloseFd(fd);
+          break;
+        }
+        default:
+          return CloseFd(fd);
+      }
+    }
+    ::close(fd);
+  }
+
+  static void CloseFd(int fd) { ::close(fd); }
+
+  int listen_fd_ = -1;
+  int num_workers_;
+  std::atomic<bool> sync_{true};
+  std::atomic<bool> stopping_{false};
+  bool failed_ = false;
+  std::thread accept_thread_;
+  std::mutex mu_;
+  std::map<int, std::unique_ptr<Entry>> entries_;
+  std::vector<std::thread> conn_threads_;
+  std::mutex barrier_mu_;
+  std::condition_variable barrier_cv_;
+  int barrier_count_ = 0;
+  int64_t barrier_gen_ = 0;
+  std::mutex stop_mu_;
+  std::condition_variable stop_cv_;
+  bool stop_requested_ = false;
+  UpdaterFn updater_ = nullptr;
+
+  // PSServer is non-copyable
+  PSServer(const PSServer&) = delete;
+  PSServer& operator=(const PSServer&) = delete;
+};
+
+class PSClient {
+ public:
+  PSClient(const char* host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    inet_pton(AF_INET, host, &addr.sin_addr);
+    // retry: workers may start before the server (launch.py races too)
+    for (int attempt = 0; attempt < 600; ++attempt) {
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        int one = 1;
+        setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return;
+      }
+      ::close(fd_);
+      struct timespec ts = {0, 100 * 1000 * 1000};
+      nanosleep(&ts, nullptr);
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    }
+    ::close(fd_);
+    fd_ = -1;
+  }
+
+  ~PSClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return fd_ >= 0; }
+
+  bool Push(int key, const float* data, uint64_t n) {
+    std::unique_lock<std::mutex> lk(mu_);
+    MsgHeader h{kPush, key, n * sizeof(float)};
+    if (!WriteAll(fd_, &h, sizeof(h)) ||
+        !WriteAll(fd_, data, h.nbytes))
+      return false;
+    MsgHeader r;
+    return ReadAll(fd_, &r, sizeof(r));
+  }
+
+  // Pull into caller buffer of capacity cap floats; returns #floats or -1.
+  int64_t Pull(int key, float* out, uint64_t cap) {
+    std::unique_lock<std::mutex> lk(mu_);
+    MsgHeader h{kPull, key, 0};
+    if (!WriteAll(fd_, &h, sizeof(h))) return -1;
+    return ReadResp(out, cap);
+  }
+
+  int64_t PushPull(int key, const float* data, uint64_t n, float* out,
+                   uint64_t cap) {
+    std::unique_lock<std::mutex> lk(mu_);
+    MsgHeader h{kPushPull, key, n * sizeof(float)};
+    if (!WriteAll(fd_, &h, sizeof(h)) || !WriteAll(fd_, data, h.nbytes))
+      return -1;
+    return ReadResp(out, cap);
+  }
+
+  bool Barrier() {
+    std::unique_lock<std::mutex> lk(mu_);
+    MsgHeader h{kBarrier, 0, 0};
+    if (!WriteAll(fd_, &h, sizeof(h))) return false;
+    MsgHeader r;
+    return ReadAll(fd_, &r, sizeof(r));
+  }
+
+  bool Command(const char* cmd) {
+    std::unique_lock<std::mutex> lk(mu_);
+    uint64_t n = strlen(cmd);
+    MsgHeader h{kCommand, 0, n};
+    if (!WriteAll(fd_, &h, sizeof(h)) || !WriteAll(fd_, cmd, n)) return false;
+    MsgHeader r;
+    return ReadAll(fd_, &r, sizeof(r));
+  }
+
+  bool Stop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    MsgHeader h{kStop, 0, 0};
+    if (!WriteAll(fd_, &h, sizeof(h))) return false;
+    MsgHeader r;
+    return ReadAll(fd_, &r, sizeof(r));
+  }
+
+ private:
+  int64_t ReadResp(float* out, uint64_t cap) {
+    MsgHeader r;
+    if (!ReadAll(fd_, &r, sizeof(r))) return -1;
+    uint64_t n = r.nbytes / sizeof(float);
+    if (n > cap) {  // drain to keep the stream consistent
+      std::vector<float> tmp(n);
+      ReadAll(fd_, tmp.data(), r.nbytes);
+      memcpy(out, tmp.data(), cap * sizeof(float));
+      return static_cast<int64_t>(n);
+    }
+    if (n && !ReadAll(fd_, out, r.nbytes)) return -1;
+    return static_cast<int64_t>(n);
+  }
+
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace mxt
+
+extern "C" {
+
+void* mxt_ps_server_create(int port, int num_workers, int sync) {
+  auto* s = new mxt::PSServer(port, num_workers, sync != 0);
+  if (s->failed()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+void mxt_ps_server_set_updater(void* h, mxt::UpdaterFn fn) {
+  static_cast<mxt::PSServer*>(h)->SetUpdater(fn);
+}
+void mxt_ps_server_wait(void* h) {
+  static_cast<mxt::PSServer*>(h)->WaitStopped();
+}
+void mxt_ps_server_destroy(void* h) { delete static_cast<mxt::PSServer*>(h); }
+
+void* mxt_ps_client_create(const char* host, int port) {
+  auto* c = new mxt::PSClient(host, port);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+int mxt_ps_client_push(void* h, int key, const float* data,
+                       unsigned long long n) {
+  return static_cast<mxt::PSClient*>(h)->Push(key, data, n) ? 0 : -1;
+}
+long long mxt_ps_client_pull(void* h, int key, float* out,
+                             unsigned long long cap) {
+  return static_cast<mxt::PSClient*>(h)->Pull(key, out, cap);
+}
+long long mxt_ps_client_pushpull(void* h, int key, const float* data,
+                                 unsigned long long n, float* out,
+                                 unsigned long long cap) {
+  return static_cast<mxt::PSClient*>(h)->PushPull(key, data, n, out, cap);
+}
+int mxt_ps_client_barrier(void* h) {
+  return static_cast<mxt::PSClient*>(h)->Barrier() ? 0 : -1;
+}
+int mxt_ps_client_command(void* h, const char* cmd) {
+  return static_cast<mxt::PSClient*>(h)->Command(cmd) ? 0 : -1;
+}
+int mxt_ps_client_stop(void* h) {
+  return static_cast<mxt::PSClient*>(h)->Stop() ? 0 : -1;
+}
+void mxt_ps_client_destroy(void* h) { delete static_cast<mxt::PSClient*>(h); }
+
+}  // extern "C"
