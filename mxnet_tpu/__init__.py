@@ -50,4 +50,13 @@ from . import test_utils
 ndarray.contrib = contrib.ndarray
 symbol.contrib = contrib.symbol
 
+from . import engine
+
+# server-role processes block here until the cluster shuts down
+# (reference: python/mxnet/__init__.py → kvstore_server._init_kvstore_server_module)
+if __import__("os").environ.get("DMLC_ROLE") in ("server", "scheduler"):
+    from .kvstore_server import _init_kvstore_server_module
+
+    _init_kvstore_server_module()
+
 __version__ = "0.1.0"

@@ -72,6 +72,7 @@ def _declare(lib):
     lib.mxt_ps_server_create.restype = c.c_void_p
     lib.mxt_ps_server_create.argtypes = [c.c_int, c.c_int, c.c_int]
     lib.mxt_ps_server_set_updater.argtypes = [c.c_void_p, c.c_void_p]
+    lib.mxt_ps_server_set_command_handler.argtypes = [c.c_void_p, c.c_void_p]
     lib.mxt_ps_server_wait.argtypes = [c.c_void_p]
     lib.mxt_ps_server_destroy.argtypes = [c.c_void_p]
     lib.mxt_ps_client_create.restype = c.c_void_p
@@ -120,3 +121,4 @@ ENGINE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 UPDATER_FN = ctypes.CFUNCTYPE(
     None, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
     ctypes.POINTER(ctypes.c_float), ctypes.c_uint64)
+COMMAND_FN = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_char), ctypes.c_uint64)

@@ -20,6 +20,7 @@ TPU design (SURVEY §7 step 5-6):
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import numpy as np
@@ -182,7 +183,7 @@ class KVStore:
         server; locally installs get_updater(optimizer))."""
         if "dist" in self.name and self.rank == 0:
             # serialize like the reference so multi-host servers share it
-            optim_str = pickle.dumps(optimizer, 0)
+            optim_str = pickle.dumps(optimizer)
             self._send_command_to_servers(0, optim_str)
         self._optimizer = optimizer
         self.set_updater(opt.get_updater(optimizer))
@@ -222,6 +223,175 @@ class KVStore:
             self._updater.set_states(fin.read())
 
 
+class KVStoreDist(KVStore):
+    """Multi-process distributed store over the native PS transport
+    (reference: src/kvstore/kvstore_dist.h — push = local Comm.Reduce then
+    ZPush of a flattened fp32 buffer to the key's server shard, pull = ZPull
+    into a recv buffer then local Broadcast; barrier via Postoffice).
+
+    Cluster shape comes from the reference's launcher env contract
+    (tools/launch.py → DMLC_*): DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT locate
+    server 0; DMLC_NUM_SERVER servers listen on consecutive ports;
+    DMLC_NUM_WORKER workers; DMLC_WORKER_ID is this worker's rank. Keys shard
+    across servers by hash (the reference shards key ranges, EncodeKey).
+
+    RPC scheduling: pushes run async on the native engine with a per-key var
+    (the reference wraps ZPush/ZPull in Engine::PushAsync against the recv
+    buffer's var, kvstore_dist.h:122-129); pull waits on the key's var so
+    push→pull per key stays ordered while different keys overlap.
+    """
+
+    def __init__(self, name):
+        super().__init__(name)
+        from ._native import get_lib
+
+        self._lib = get_lib()
+        if self._lib is None:
+            raise MXNetError("dist kvstore needs the native runtime (libmxtpu)")
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+        self._nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        self._clients = []
+        for s in range(self._num_servers):
+            h = self._lib.mxt_ps_client_create(host.encode(), port + s)
+            if not h:
+                raise MXNetError("cannot reach PS server %s:%d" % (host, port + s))
+            self._clients.append(h)
+        if "async" in name and self._rank == 0:
+            for c in self._clients:
+                self._lib.mxt_ps_client_command(c, b"sync:0")
+        from .engine import get_engine
+
+        self._engine = get_engine()
+        self._key_vars = {}
+        self._push_error = None
+        self._update_on_kvstore = True
+
+    # ---- helpers --------------------------------------------------------
+    def _ikey(self, k):
+        return k if isinstance(k, int) else _str_key_int(k)
+
+    def _client_for(self, ikey):
+        return self._clients[ikey % self._num_servers]
+
+    def _var(self, k):
+        if k not in self._key_vars:
+            self._key_vars[k] = self._engine.new_variable()
+        return self._key_vars[k]
+
+    def _zpush(self, ikey, arr_np):
+        import ctypes
+
+        flat = np.ascontiguousarray(arr_np.reshape(-1), np.float32)
+        rc = self._lib.mxt_ps_client_push(
+            self._client_for(ikey), ikey,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), flat.size)
+        if rc != 0:
+            # remembered and re-raised at the next sync point: pushes run on
+            # engine threads where a raise only prints
+            self._push_error = "push failed for key %d (server down?)" % ikey
+            raise MXNetError(self._push_error)
+
+    def _zpull(self, ikey, n):
+        import ctypes
+
+        out = np.empty(n, np.float32)
+        got = self._lib.mxt_ps_client_pull(
+            self._client_for(ikey), ikey,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+        if got != n:
+            raise MXNetError("pull size mismatch for key %d: %d != %d" % (ikey, got, n))
+        return out
+
+    # ---- API ------------------------------------------------------------
+    def init(self, key, value):
+        keys, single = _key_list(key)
+        if single:
+            values = [[value]] if isinstance(value, NDArray) else [list(value)]
+        else:
+            values = _value_list(value, len(keys))
+        for k, vs in zip(keys, values):
+            if self._rank == 0:
+                self._zpush(self._ikey(k), vs[0].asnumpy())
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, single = _key_list(key)
+        if single:
+            grouped = [[value]] if isinstance(value, NDArray) else [list(value)]
+        else:
+            grouped = _value_list(value, len(keys))
+        for k, vs in zip(keys, grouped):
+            merged = (self._comm.reduce_key(k, vs)
+                      if isinstance(self._comm, CommDevice)
+                      else self._comm.reduce(vs))
+            arr = merged.asnumpy()
+            ikey = self._ikey(k)
+            self._engine.push(
+                lambda ikey=ikey, arr=arr: self._zpush(ikey, arr),
+                mutable_vars=[self._var(k)], priority=priority)
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, single = _key_list(key)
+        if single:
+            outs = [[out]] if isinstance(out, NDArray) else [list(out)]
+        else:
+            outs = _value_list(out, len(keys))
+        for k, os_ in zip(keys, outs):
+            self._engine.wait_for_var(self._var(k))  # order after pushes
+            if self._push_error:
+                raise MXNetError(self._push_error)
+            n = int(np.prod(os_[0].shape))
+            flat = self._zpull(self._ikey(k), n)
+            src = NDArray(flat.reshape(os_[0].shape), ctx=os_[0].context)
+            self._comm.broadcast(src, os_)
+
+    def set_optimizer(self, optimizer):
+        if self._rank == 0:
+            # default protocol (the reference used 0 for py2 bindings; some
+            # of our optimizer attrs are __slots__ classes protocol 0 rejects)
+            optim_str = pickle.dumps(optimizer)
+            self._send_command_to_servers(0, optim_str)
+        self.barrier()
+        self._optimizer = optimizer
+        # updates happen server-side; no local updater (reference:
+        # update_on_kvstore=True forces server updates in dist mode)
+
+    def _send_command_to_servers(self, head, body):
+        import base64
+
+        cmd = b"optim:" + base64.b64encode(body)
+        for c in self._clients:
+            self._lib.mxt_ps_client_command(c, cmd)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._nw
+
+    def barrier(self):
+        self._engine.wait_all()
+        self._lib.mxt_ps_client_barrier(self._clients[0])
+
+    def _stop_servers(self):
+        """Shut down server processes (rank 0, exit path)."""
+        for c in self._clients:
+            self._lib.mxt_ps_client_stop(c)
+
+    def __del__(self):
+        try:
+            for c in self._clients:
+                self._lib.mxt_ps_client_destroy(c)
+        except Exception:
+            pass
+
+
 def _process_index():
     try:
         import jax
@@ -241,7 +411,11 @@ def _process_count():
 
 
 def _str_key_int(k):
-    return abs(hash(k)) % (1 << 31)
+    # deterministic across processes (python hash() is seed-randomized, which
+    # would shard the same str key differently on each dist worker)
+    import zlib
+
+    return zlib.crc32(k.encode()) & 0x7FFFFFFF
 
 
 def create(name="local"):
@@ -257,4 +431,10 @@ def create(name="local"):
     )
     if name not in valid:
         raise MXNetError("Unknown KVStore type %s" % name)
+    # dist_* with a launcher-provided cluster (DMLC_* env, tools/launch.py)
+    # becomes a real multi-process PS-backed store; without the env it stays
+    # a single-process store so launch-less scripts behave like the
+    # reference's 1-worker dist mode.
+    if name.startswith("dist") and "DMLC_PS_ROOT_URI" in os.environ:
+        return KVStoreDist(name)
     return KVStore(name)

@@ -1,0 +1,186 @@
+"""Parameter-server process entry.
+
+Reference: python/mxnet/kvstore_server.py — `_init_kvstore_server_module`
+(:58-68) blocks server-role processes inside ``import mxnet``; the worker's
+rank 0 sends a pickled optimizer which the server installs as its updater
+(:36-44 command handler → pickle.loads → get_updater).
+
+Here the transport lives in the native runtime (src/ps.cc). This module
+hosts it in a Python process so the *real* optimizer (any Optimizer
+subclass, custom LR schedules, pickled user classes) runs server-side, key
+by key, on flat fp32 views — the reference's server also updates flattened
+1-D NDArrays.
+"""
+from __future__ import annotations
+
+import base64
+import os
+import pickle
+import threading
+
+import numpy as np
+
+from ._native import COMMAND_FN, UPDATER_FN, get_lib
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """Hosts one PS shard (reference: kvstore_server.py:20 KVStoreServer)."""
+
+    def __init__(self, port=None, num_workers=None, sync=True):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        if port is None:
+            base = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+            port = base + int(os.environ.get("DMLC_SERVER_ID", "0"))
+        if num_workers is None:
+            num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._handle = lib.mxt_ps_server_create(port, num_workers, 1 if sync else 0)
+        if not self._handle:
+            raise RuntimeError("cannot bind PS server port %d" % port)
+        self._updater = None
+        self._updater_lock = threading.Lock()
+        self._states = {}
+
+        # ALL python work (optimizer unpickle + update) runs on the server's
+        # MAIN thread via this queue — the reference's single-threaded
+        # Executor run-loop design (kvstore_dist_server.h:28-85), and a hard
+        # requirement here: the main thread blocks inside `import mxnet_tpu`
+        # holding the module import lock, so any import from a C++ conn
+        # thread (e.g. unpickling mxnet_tpu.optimizer.SGD) would deadlock.
+        import queue
+
+        self._exec_q = queue.Queue()
+
+        def _on_main(fn):
+            done = threading.Event()
+            box = {}
+
+            def task():
+                try:
+                    fn()
+                except Exception as e:  # surface in server log, don't wedge
+                    box["err"] = e
+                finally:
+                    done.set()
+
+            self._exec_q.put(task)
+            done.wait()
+            if "err" in box:
+                import traceback
+
+                traceback.print_exception(box["err"])
+
+        def _apply(key, grad_ptr, weight_ptr, n):
+            # flat fp32 views over the server's buffers; optimizer updates
+            # in place (reference: DataHandle → updater_(key, merged, &stored);
+            # with no optimizer installed the merged value is stored directly,
+            # dist_server.h else-branch — update_on_kvstore=False pulls
+            # merged grads back)
+            import ctypes
+
+            grad = np.ctypeslib.as_array(
+                ctypes.cast(grad_ptr, ctypes.POINTER(ctypes.c_float)), (n,))
+            weight = np.ctypeslib.as_array(
+                ctypes.cast(weight_ptr, ctypes.POINTER(ctypes.c_float)), (n,))
+            with self._updater_lock:
+                fn = self._updater
+            if fn is None:
+                weight[:] = grad
+            else:
+                _on_main(lambda: fn(int(key), grad, weight))
+
+        def _command(cmd_ptr, n):
+            import ctypes
+
+            cmd = ctypes.string_at(cmd_ptr, n)
+            if cmd.startswith(b"optim:"):
+                blob = base64.b64decode(cmd[6:])
+                _on_main(lambda: self._set_optimizer(pickle.loads(blob)))
+
+        self._apply_cb = UPDATER_FN(_apply)        # keep refs alive
+        self._command_cb = COMMAND_FN(_command)
+        import ctypes
+
+        lib.mxt_ps_server_set_updater(
+            self._handle, ctypes.cast(self._apply_cb, ctypes.c_void_p))
+        lib.mxt_ps_server_set_command_handler(
+            self._handle, ctypes.cast(self._command_cb, ctypes.c_void_p))
+
+    def _set_optimizer(self, optimizer):
+        from . import optimizer as opt
+        from .ndarray import NDArray
+
+        updater = opt.get_updater(optimizer)
+
+        def apply_np(key, grad_np, weight_np):
+            g = NDArray(np.array(grad_np))
+            w = NDArray(weight_np.copy())
+            updater(key, g, w)
+            weight_np[:] = w.asnumpy()
+
+        with self._updater_lock:
+            self._updater = apply_np
+
+    def run(self):
+        """Serve until a worker sends the stop command, executing python
+        work (optimizer updates) on THIS thread (reference: KVStoreServer.run
+        → single-threaded Executor loop, kvstore_dist_server.h:28-85)."""
+
+        def waiter():
+            self._lib.mxt_ps_server_wait(self._handle)
+            self._exec_q.put(None)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        while True:
+            task = self._exec_q.get()
+            if task is None:
+                break
+            task()
+        t.join()
+        # destroy joins conn threads, whose in-flight handlers may still
+        # enqueue work (e.g. an async push racing the stop) — keep executing
+        # those on a drainer so their done.wait() can't wedge the join. The
+        # import-lock constraint no longer applies: anything they run was
+        # already imported by earlier main-thread tasks.
+        import queue as _q
+
+        stop_drain = threading.Event()
+
+        def drainer():
+            while not stop_drain.is_set():
+                try:
+                    task = self._exec_q.get(timeout=0.05)
+                except _q.Empty:
+                    continue
+                if task is not None:
+                    task()
+
+        d = threading.Thread(target=drainer)
+        d.start()
+        self._lib.mxt_ps_server_destroy(self._handle)
+        stop_drain.set()
+        d.join()
+        self._handle = None
+
+
+def _init_kvstore_server_module():
+    """Block server-role processes here (reference: kvstore_server.py:58-68,
+    called from `import mxnet` when DMLC_ROLE=server)."""
+    role = os.environ.get("DMLC_ROLE", "")
+    if role == "server":
+        server = KVStoreServer()
+        server.run()
+        import sys
+
+        sys.exit(0)
+    # the reference's scheduler role does rendezvous; our workers connect
+    # directly to servers, so a scheduler process just exits cleanly
+    if role == "scheduler":
+        import sys
+
+        sys.exit(0)

@@ -53,6 +53,10 @@ enum MsgType : uint32_t {
 struct MsgHeader {
   uint32_t type;
   int32_t key;
+  uint64_t req_id;  // echoed in the response: one connection carries many
+                    // outstanding RPCs (ps-lite is an async message stream;
+                    // blocking per-connection RPCs head-of-line-deadlock BSP
+                    // rounds across keys)
   uint64_t nbytes;
 };
 #pragma pack(pop)
@@ -84,6 +88,10 @@ static bool WriteAll(int fd, const void* buf, size_t n) {
 // pickled optimizer through this hook, reference kvstore_server.py:36-44).
 typedef void (*UpdaterFn)(int key, const float* grad, float* weight,
                           uint64_t n);
+// Command callback: arbitrary control strings from workers (reference:
+// KVStoreDistServer::CommandHandle, kvstore_dist_server.h:121-134 — carries
+// the pickled optimizer and sync-mode switches).
+typedef void (*CommandFn)(const char* cmd, uint64_t len);
 
 class PSServer {
  public:
@@ -108,6 +116,7 @@ class PSServer {
   ~PSServer() { Stop(); }
 
   void SetUpdater(UpdaterFn fn) { updater_ = fn; }
+  void SetCommandHandler(CommandFn fn) { cmd_handler_ = fn; }
 
   void Stop() {
     bool expected = false;
@@ -217,84 +226,124 @@ class PSServer {
     }
   }
 
+  // One reader per connection; each request dispatches to its own handler
+  // thread so a BSP-blocked push never blocks later requests on the same
+  // connection (ps-lite's async stream semantics). Responses serialize on a
+  // per-connection write mutex and carry the request id.
+  struct Conn {
+    int fd;
+    std::mutex wmu;
+    std::mutex hmu;
+    std::condition_variable hcv;
+    int inflight = 0;
+  };
+
+  void Respond(Conn* c, const MsgHeader& h, const void* payload) {
+    std::unique_lock<std::mutex> lk(c->wmu);
+    WriteAll(c->fd, &h, sizeof(h));
+    if (h.nbytes && payload) WriteAll(c->fd, payload, h.nbytes);
+  }
+
+  void Handle(Conn* c, MsgHeader h, std::vector<float> buf, std::string cmd) {
+    switch (h.type) {
+      case kPush: {
+        Entry* e = GetEntry(h.key);
+        HandlePush(h.key, e, buf.data(), buf.size());
+        Respond(c, MsgHeader{kResp, h.key, h.req_id, 0}, nullptr);
+        break;
+      }
+      case kPull: {
+        // no blocking on un-inited keys: init is barriered by the caller
+        // (kvstore.py init), so an empty entry is a user error — a 0-byte
+        // response lets the client raise instead of wedging
+        Entry* e = GetEntry(h.key);
+        std::unique_lock<std::mutex> lk(e->mu);
+        std::vector<float> w = e->weight;  // copy under lock, send outside
+        lk.unlock();
+        Respond(c, MsgHeader{kResp, h.key, h.req_id,
+                             static_cast<uint64_t>(w.size() * sizeof(float))},
+                w.data());
+        break;
+      }
+      case kPushPull: {
+        Entry* e = GetEntry(h.key);
+        HandlePush(h.key, e, buf.data(), buf.size());
+        std::unique_lock<std::mutex> lk(e->mu);
+        std::vector<float> w = e->weight;
+        lk.unlock();
+        Respond(c, MsgHeader{kResp, h.key, h.req_id,
+                             static_cast<uint64_t>(w.size() * sizeof(float))},
+                w.data());
+        break;
+      }
+      case kBarrier: {
+        std::unique_lock<std::mutex> lk(barrier_mu_);
+        int64_t gen = barrier_gen_;
+        if (++barrier_count_ >= num_workers_) {
+          barrier_count_ = 0;
+          barrier_gen_++;
+          barrier_cv_.notify_all();
+        } else {
+          barrier_cv_.wait(lk,
+                           [&] { return barrier_gen_ != gen || stopping_; });
+        }
+        lk.unlock();
+        Respond(c, MsgHeader{kResp, 0, h.req_id, 0}, nullptr);
+        break;
+      }
+      case kCommand: {
+        if (cmd.rfind("sync:", 0) == 0) sync_ = cmd[5] == '1';
+        if (cmd_handler_) cmd_handler_(cmd.data(), cmd.size());
+        Respond(c, MsgHeader{kResp, 0, h.req_id, 0}, nullptr);
+        break;
+      }
+      default:
+        break;
+    }
+    std::unique_lock<std::mutex> lk(c->hmu);
+    if (--c->inflight == 0) c->hcv.notify_all();
+  }
+
   void ConnLoop(int fd) {
-    std::vector<float> buf;
+    Conn conn;
+    conn.fd = fd;
     for (;;) {
       MsgHeader h;
       if (!ReadAll(fd, &h, sizeof(h))) break;
       if (h.type == kStop) {
-        MsgHeader r{kResp, 0, 0};
-        WriteAll(fd, &r, sizeof(r));
+        Respond(&conn, MsgHeader{kResp, 0, h.req_id, 0}, nullptr);
         std::unique_lock<std::mutex> lk(stop_mu_);
         stop_requested_ = true;
         stop_cv_.notify_all();
         break;
       }
-      switch (h.type) {
-        case kPush: {
-          uint64_t n = h.nbytes / sizeof(float);
-          buf.resize(n);
-          if (!ReadAll(fd, buf.data(), h.nbytes)) return CloseFd(fd);
-          Entry* e = GetEntry(h.key);
-          HandlePush(h.key, e, buf.data(), n);
-          MsgHeader r{kResp, h.key, 0};
-          if (!WriteAll(fd, &r, sizeof(r))) return CloseFd(fd);
-          break;
-        }
-        case kPull: {
-          Entry* e = GetEntry(h.key);
-          std::unique_lock<std::mutex> lk(e->mu);
-          e->cv.wait(lk, [&] { return e->inited || stopping_; });
-          MsgHeader r{kResp, h.key,
-                      static_cast<uint64_t>(e->weight.size() * sizeof(float))};
-          if (!WriteAll(fd, &r, sizeof(r))) return CloseFd(fd);
-          if (!WriteAll(fd, e->weight.data(), r.nbytes)) return CloseFd(fd);
-          break;
-        }
-        case kPushPull: {  // fused push+pull round trip (saves one RTT)
-          uint64_t n = h.nbytes / sizeof(float);
-          buf.resize(n);
-          if (!ReadAll(fd, buf.data(), h.nbytes)) return CloseFd(fd);
-          Entry* e = GetEntry(h.key);
-          HandlePush(h.key, e, buf.data(), n);
-          std::unique_lock<std::mutex> lk(e->mu);
-          MsgHeader r{kResp, h.key,
-                      static_cast<uint64_t>(e->weight.size() * sizeof(float))};
-          if (!WriteAll(fd, &r, sizeof(r))) return CloseFd(fd);
-          if (!WriteAll(fd, e->weight.data(), r.nbytes)) return CloseFd(fd);
-          break;
-        }
-        case kBarrier: {
-          std::unique_lock<std::mutex> lk(barrier_mu_);
-          int64_t gen = barrier_gen_;
-          if (++barrier_count_ >= num_workers_) {
-            barrier_count_ = 0;
-            barrier_gen_++;
-            barrier_cv_.notify_all();
-          } else {
-            barrier_cv_.wait(
-                lk, [&] { return barrier_gen_ != gen || stopping_; });
-          }
-          MsgHeader r{kResp, 0, 0};
-          if (!WriteAll(fd, &r, sizeof(r))) return CloseFd(fd);
-          break;
-        }
-        case kCommand: {
-          std::string cmd(h.nbytes, '\0');
-          if (h.nbytes && !ReadAll(fd, &cmd[0], h.nbytes)) return CloseFd(fd);
-          if (cmd.rfind("sync:", 0) == 0) sync_ = cmd[5] == '1';
-          MsgHeader r{kResp, 0, 0};
-          if (!WriteAll(fd, &r, sizeof(r))) return CloseFd(fd);
-          break;
-        }
-        default:
-          return CloseFd(fd);
+      std::vector<float> buf;
+      std::string cmd;
+      if (h.type == kPush || h.type == kPushPull) {
+        buf.resize(h.nbytes / sizeof(float));
+        if (h.nbytes && !ReadAll(fd, buf.data(), h.nbytes)) break;
+      } else if (h.type == kCommand) {
+        cmd.resize(h.nbytes);
+        if (h.nbytes && !ReadAll(fd, &cmd[0], h.nbytes)) break;
       }
+      {
+        std::unique_lock<std::mutex> lk(conn.hmu);
+        conn.inflight++;
+      }
+      // detached: a long-lived worker connection makes millions of RPCs, so
+      // retaining joinable threads until teardown would accumulate without
+      // bound; the inflight counter below is the (only) join point, and it
+      // is reached before `conn` goes out of scope.
+      std::thread(&PSServer::Handle, this, &conn, h, std::move(buf),
+                  std::move(cmd))
+          .detach();
+    }
+    {  // drain outstanding handlers before closing the socket
+      std::unique_lock<std::mutex> lk(conn.hmu);
+      conn.hcv.wait(lk, [&] { return conn.inflight == 0; });
     }
     ::close(fd);
   }
-
-  static void CloseFd(int fd) { ::close(fd); }
 
   int listen_fd_ = -1;
   int num_workers_;
@@ -313,6 +362,7 @@ class PSServer {
   std::condition_variable stop_cv_;
   bool stop_requested_ = false;
   UpdaterFn updater_ = nullptr;
+  CommandFn cmd_handler_ = nullptr;
 
   // PSServer is non-copyable
   PSServer(const PSServer&) = delete;
@@ -333,6 +383,7 @@ class PSClient {
           0) {
         int one = 1;
         setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        reader_ = std::thread([this] { ReaderLoop(); });
         return;
       }
       ::close(fd_);
@@ -345,80 +396,155 @@ class PSClient {
   }
 
   ~PSClient() {
-    if (fd_ >= 0) ::close(fd_);
+    if (fd_ >= 0) {
+      ::shutdown(fd_, SHUT_RDWR);
+      if (reader_.joinable()) reader_.join();
+      ::close(fd_);
+    }
   }
 
   bool ok() const { return fd_ >= 0; }
 
   bool Push(int key, const float* data, uint64_t n) {
-    std::unique_lock<std::mutex> lk(mu_);
-    MsgHeader h{kPush, key, n * sizeof(float)};
-    if (!WriteAll(fd_, &h, sizeof(h)) ||
-        !WriteAll(fd_, data, h.nbytes))
-      return false;
-    MsgHeader r;
-    return ReadAll(fd_, &r, sizeof(r));
+    Pending p;
+    if (!Send(kPush, key, &p, data, n * sizeof(float))) return false;
+    return Await(&p) >= 0;
   }
 
   // Pull into caller buffer of capacity cap floats; returns #floats or -1.
   int64_t Pull(int key, float* out, uint64_t cap) {
-    std::unique_lock<std::mutex> lk(mu_);
-    MsgHeader h{kPull, key, 0};
-    if (!WriteAll(fd_, &h, sizeof(h))) return -1;
-    return ReadResp(out, cap);
+    Pending p;
+    p.out = out;
+    p.cap = cap;
+    if (!Send(kPull, key, &p, nullptr, 0)) return -1;
+    return Await(&p);
   }
 
   int64_t PushPull(int key, const float* data, uint64_t n, float* out,
                    uint64_t cap) {
-    std::unique_lock<std::mutex> lk(mu_);
-    MsgHeader h{kPushPull, key, n * sizeof(float)};
-    if (!WriteAll(fd_, &h, sizeof(h)) || !WriteAll(fd_, data, h.nbytes))
-      return -1;
-    return ReadResp(out, cap);
+    Pending p;
+    p.out = out;
+    p.cap = cap;
+    if (!Send(kPushPull, key, &p, data, n * sizeof(float))) return -1;
+    return Await(&p);
   }
 
   bool Barrier() {
-    std::unique_lock<std::mutex> lk(mu_);
-    MsgHeader h{kBarrier, 0, 0};
-    if (!WriteAll(fd_, &h, sizeof(h))) return false;
-    MsgHeader r;
-    return ReadAll(fd_, &r, sizeof(r));
+    Pending p;
+    if (!Send(kBarrier, 0, &p, nullptr, 0)) return false;
+    return Await(&p) >= 0;
   }
 
   bool Command(const char* cmd) {
-    std::unique_lock<std::mutex> lk(mu_);
-    uint64_t n = strlen(cmd);
-    MsgHeader h{kCommand, 0, n};
-    if (!WriteAll(fd_, &h, sizeof(h)) || !WriteAll(fd_, cmd, n)) return false;
-    MsgHeader r;
-    return ReadAll(fd_, &r, sizeof(r));
+    Pending p;
+    if (!Send(kCommand, 0, &p, cmd, strlen(cmd))) return false;
+    return Await(&p) >= 0;
   }
 
   bool Stop() {
-    std::unique_lock<std::mutex> lk(mu_);
-    MsgHeader h{kStop, 0, 0};
-    if (!WriteAll(fd_, &h, sizeof(h))) return false;
-    MsgHeader r;
-    return ReadAll(fd_, &r, sizeof(r));
+    Pending p;
+    if (!Send(kStop, 0, &p, nullptr, 0)) return false;
+    return Await(&p) >= 0;
   }
 
  private:
-  int64_t ReadResp(float* out, uint64_t cap) {
-    MsgHeader r;
-    if (!ReadAll(fd_, &r, sizeof(r))) return -1;
-    uint64_t n = r.nbytes / sizeof(float);
-    if (n > cap) {  // drain to keep the stream consistent
-      std::vector<float> tmp(n);
-      ReadAll(fd_, tmp.data(), r.nbytes);
-      memcpy(out, tmp.data(), cap * sizeof(float));
-      return static_cast<int64_t>(n);
+  // One outstanding RPC registration: the reader thread fills result/copies
+  // payload and signals. Many may be in flight on the single socket.
+  struct Pending {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    int64_t result = -1;  // #floats (or 0) on success, -1 on failure
+    float* out = nullptr;
+    uint64_t cap = 0;
+  };
+
+  bool Send(uint32_t type, int key, Pending* p, const void* payload,
+            uint64_t nbytes) {
+    if (fd_ < 0) return false;
+    uint64_t id;
+    {
+      std::unique_lock<std::mutex> lk(pmu_);
+      if (dead_) return false;
+      id = next_id_++;
+      pending_[id] = p;
     }
-    if (n && !ReadAll(fd_, out, r.nbytes)) return -1;
-    return static_cast<int64_t>(n);
+    MsgHeader h{type, key, id, nbytes};
+    std::unique_lock<std::mutex> lk(wmu_);
+    if (!WriteAll(fd_, &h, sizeof(h)) ||
+        (nbytes && !WriteAll(fd_, payload, nbytes))) {
+      lk.unlock();
+      std::unique_lock<std::mutex> plk(pmu_);
+      pending_.erase(id);
+      return false;
+    }
+    return true;
+  }
+
+  int64_t Await(Pending* p) {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv.wait(lk, [&] { return p->done; });
+    return p->result;
+  }
+
+  void ReaderLoop() {
+    std::vector<float> scratch;
+    for (;;) {
+      MsgHeader h;
+      if (!ReadAll(fd_, &h, sizeof(h))) break;
+      Pending* p = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(pmu_);
+        auto it = pending_.find(h.req_id);
+        if (it != pending_.end()) {
+          p = it->second;
+          pending_.erase(it);
+        }
+      }
+      uint64_t n = h.nbytes / sizeof(float);
+      int64_t result = static_cast<int64_t>(n);
+      bool read_ok = true;
+      if (p && p->out && n) {
+        if (n <= p->cap) {
+          read_ok = ReadAll(fd_, p->out, h.nbytes);
+        } else {  // drain oversized payload, report true size
+          scratch.resize(n);
+          read_ok = ReadAll(fd_, scratch.data(), h.nbytes);
+          if (read_ok) memcpy(p->out, scratch.data(), p->cap * sizeof(float));
+        }
+      } else if (n) {
+        scratch.resize(n);
+        read_ok = ReadAll(fd_, scratch.data(), h.nbytes);
+      }
+      if (p) {
+        // p was already popped from pending_, so the failure sweep below
+        // cannot see it — signal (with -1 on a failed payload read) here
+        std::unique_lock<std::mutex> lk(p->mu);
+        p->done = true;
+        p->result = read_ok ? result : -1;
+        p->cv.notify_all();
+      }
+      if (!read_ok) break;
+    }
+    // socket failed/closed: fail every outstanding + future RPC
+    std::unique_lock<std::mutex> lk(pmu_);
+    dead_ = true;
+    for (auto& kv : pending_) {
+      std::unique_lock<std::mutex> plk(kv.second->mu);
+      kv.second->done = true;
+      kv.second->result = -1;
+      kv.second->cv.notify_all();
+    }
+    pending_.clear();
   }
 
   int fd_ = -1;
-  std::mutex mu_;
+  std::thread reader_;
+  std::mutex wmu_;   // serializes frame writes
+  std::mutex pmu_;   // guards pending_/next_id_/dead_
+  std::map<uint64_t, Pending*> pending_;
+  uint64_t next_id_ = 1;
+  bool dead_ = false;
 };
 
 }  // namespace mxt
@@ -435,6 +561,9 @@ void* mxt_ps_server_create(int port, int num_workers, int sync) {
 }
 void mxt_ps_server_set_updater(void* h, mxt::UpdaterFn fn) {
   static_cast<mxt::PSServer*>(h)->SetUpdater(fn);
+}
+void mxt_ps_server_set_command_handler(void* h, mxt::CommandFn fn) {
+  static_cast<mxt::PSServer*>(h)->SetCommandHandler(fn);
 }
 void mxt_ps_server_wait(void* h) {
   static_cast<mxt::PSServer*>(h)->WaitStopped();

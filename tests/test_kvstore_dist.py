@@ -1,0 +1,201 @@
+"""Distributed KVStore tests — real multi-process PS over localhost.
+
+Mirrors the reference's nightly strategy (tests/nightly/dist_sync_kvstore.py:
+each worker pushes rank-dependent values, the BSP-aggregated result is an
+arithmetic identity checked on every worker; run under tools/launch.py -n N).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu._native import get_lib
+
+needs_native = pytest.mark.skipif(get_lib() is None, reason="native lib unavailable")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_SYNC = r"""
+import os
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+assert nw == 2, nw
+shape = (5, 3)
+kv.init(3, mx.nd.zeros(shape))
+# no optimizer on the server: stored value becomes the merged push
+for step in range(3):
+    kv.push(3, mx.nd.ones(shape) * (rank + 1) * (step + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull(3, out=out)
+    expect = (1 + 2) * (step + 1)  # sum over ranks, BSP round
+    assert np.allclose(out.asnumpy(), expect), (rank, step, out.asnumpy()[0, 0], expect)
+# str keys + list form
+kv.init(["a", "b"], [mx.nd.zeros((4,)), mx.nd.zeros((4,))])
+kv.push(["a", "b"], [mx.nd.ones((4,)) * (rank + 1), mx.nd.ones((4,)) * 10 * (rank + 1)])
+outs = [mx.nd.zeros((4,)), mx.nd.zeros((4,))]
+kv.pull(["a", "b"], out=outs)
+assert np.allclose(outs[0].asnumpy(), 3.0)
+assert np.allclose(outs[1].asnumpy(), 30.0)
+kv.barrier()
+if rank == 0:
+    kv._stop_servers()
+print("WORKER_OK", rank)
+"""
+
+WORKER_OPTIMIZER = r"""
+import os
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_sync")
+rank = kv.rank
+shape = (6,)
+kv.init(0, mx.nd.ones(shape))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0))
+# each worker pushes grad = 1; server sees merged grad 2 -> w -= 0.5*2 = 1
+kv.push(0, mx.nd.ones(shape))
+out = mx.nd.zeros(shape)
+kv.pull(0, out=out)
+assert np.allclose(out.asnumpy(), 0.0), out.asnumpy()
+kv.barrier()
+if rank == 0:
+    kv._stop_servers()
+print("WORKER_OK", rank)
+"""
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_cluster(script, n_workers=2, timeout=180):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DMLC_ROLE", None)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", str(n_workers), "-s", "1", "--port", str(_free_port()),
+           sys.executable, "-c", script]
+    # own process group so a hang can't leak workers into later tests
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        out, err = proc.communicate()
+        raise AssertionError("cluster hung: %s %s" % (out, err))
+    assert proc.returncode == 0, (out, err)
+    assert out.count("WORKER_OK") == n_workers, (out, err)
+
+
+@needs_native
+def test_dist_sync_push_pull_identity():
+    _run_cluster(WORKER_SYNC)
+
+
+@needs_native
+def test_dist_sync_server_side_optimizer():
+    _run_cluster(WORKER_OPTIMIZER)
+
+
+@needs_native
+def test_dist_single_process_fallback():
+    # without DMLC env, dist_sync degrades to the single-process store
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    assert "DMLC_PS_ROOT_URI" not in os.environ
+    kv = mx.kv.create("dist_sync")
+    kv.init(9, mx.nd.ones((3,)))
+    kv.push(9, mx.nd.ones((3,)) * 4)
+    out = mx.nd.zeros((3,))
+    kv.pull(9, out=out)
+    assert np.allclose(out.asnumpy(), 4.0)
+
+
+WORKER_FIT = r"""
+import numpy as np
+import mxnet_tpu as mx
+
+rng = np.random.RandomState(42)  # same data on both workers
+X = rng.randn(128, 10).astype(np.float32)
+w_true = rng.randn(10, 1).astype(np.float32)
+y = (X @ w_true > 0).astype(np.float32).reshape(-1)
+
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+# shard the data like a real dist job (reference: part_index/num_parts)
+Xs, ys = X[rank::nw], y[rank::nw]
+it = mx.io.NDArrayIter(Xs, ys, batch_size=16, shuffle=False)
+
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+mod = mx.mod.Module(net, context=mx.cpu())
+mod.fit(it, num_epoch=8, kvstore=kv, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2.0),
+        eval_metric="acc", force_init=True)
+score = mod.score(it, mx.metric.Accuracy())[0][1]
+# both workers see identical global updates -> identical params
+arg, _ = mod.get_params()
+sig = float(sum(float(np.abs(v.asnumpy()).sum()) for v in arg.values()))
+print("FIT_SCORE", rank, score, round(sig, 4), flush=True)
+kv.barrier()
+if rank == 0:
+    kv._stop_servers()
+print("WORKER_OK", rank)
+"""
+
+
+@needs_native
+def test_dist_sync_module_fit():
+    """End-to-end Module.fit over 2 PS workers (reference: nightly dist_lenet)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DMLC_ROLE", None)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "-s", "1", "--port", str(_free_port()),
+           sys.executable, "-c", WORKER_FIT]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        out, err = proc.communicate()
+        raise AssertionError("cluster hung: %s %s" % (out, err))
+    assert proc.returncode == 0, (out, err)
+    lines = [l for l in out.splitlines() if l.startswith("FIT_SCORE")]
+    assert len(lines) == 2, (out, err)
+    scores = {}
+    sigs = {}
+    for l in lines:
+        _, rank, score, sig = l.split()
+        scores[rank] = float(score)
+        sigs[rank] = float(sig)
+    # params identical across workers (same BSP updates applied server-side)
+    assert abs(sigs["0"] - sigs["1"]) < 1e-3, sigs
+    # training actually learned something
+    assert min(scores.values()) > 0.75, scores

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Cluster launcher — spawn PS servers + workers for dist training.
+
+Reference: tools/launch.py (dmlc-tracker submit: ssh/mpi/sge/yarn/local,
+:13-60) setting the DMLC_* env contract consumed by ps-lite. The same
+contract drives mxnet_tpu's native PS (kvstore.py KVStoreDist /
+kvstore_server.py):
+
+  DMLC_ROLE            worker | server | scheduler
+  DMLC_PS_ROOT_URI     host of server 0
+  DMLC_PS_ROOT_PORT    port of server 0 (server i listens on port+i)
+  DMLC_NUM_WORKER / DMLC_NUM_SERVER
+  DMLC_WORKER_ID / DMLC_SERVER_ID
+
+Launchers: `local` (all processes on this host — the dev/test path) and
+`ssh` (one process per host from a hostfile, reference dmlc-tracker ssh.py).
+On TPU pods the *sync* data path needs no launcher at all (jax initializes
+from the pod runtime); this launcher exists for dist_async / PS semantics
+and CPU-host clusters.
+
+Usage: python tools/launch.py -n 2 -s 1 python train_mnist.py --kv-store dist_sync
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Launch a dist training job")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=None,
+                    help="default: same as workers")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="ssh launcher: file with one host per line")
+    ap.add_argument("--host", default="127.0.0.1", help="PS root host")
+    ap.add_argument("--port", type=int, default=9091, help="PS root port")
+    ap.add_argument("--sync-dst-dir", default=None,
+                    help="ssh launcher: rsync working dir to hosts first")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    if args.num_servers is None:
+        args.num_servers = args.num_workers
+
+    base_env = {
+        "DMLC_PS_ROOT_URI": args.host,
+        "DMLC_PS_ROOT_PORT": str(args.port),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+    }
+
+    if args.launcher == "local":
+        procs = []
+
+        def spawn(role, idx):
+            env = dict(os.environ)
+            env.update(base_env)
+            env["DMLC_ROLE"] = role
+            if role == "server":
+                env["DMLC_SERVER_ID"] = str(idx)
+            else:
+                env["DMLC_WORKER_ID"] = str(idx)
+            return subprocess.Popen(args.command, env=env)
+
+        for i in range(args.num_servers):
+            procs.append(("server", spawn("server", i)))
+        for i in range(args.num_workers):
+            procs.append(("worker", spawn("worker", i)))
+
+        def kill_all(*_):
+            for _, p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            sys.exit(1)
+
+        signal.signal(signal.SIGINT, kill_all)
+        signal.signal(signal.SIGTERM, kill_all)
+        # any worker failing kills the job (a dead worker wedges BSP rounds
+        # and barriers for everyone else)
+        import time
+
+        rc = 0
+        workers = [p for role, p in procs if role == "worker"]
+        pending = set(workers)
+        while pending:
+            for p in list(pending):
+                code = p.poll()
+                if code is None:
+                    continue
+                pending.discard(p)
+                rc |= code
+                if code != 0:
+                    for _, q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+                    pending.clear()
+            time.sleep(0.2)
+        # workers done: servers were told to stop by worker rank 0; reap
+        for role, p in procs:
+            if role == "server":
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.terminate()
+        sys.exit(rc)
+
+    # ssh launcher (reference: dmlc-tracker ssh.py): hosts round-robin
+    assert args.hostfile, "--hostfile required for ssh launcher"
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    assert hosts, "empty hostfile"
+    procs = []
+    cwd = os.getcwd()
+    if args.sync_dst_dir:
+        for h in hosts:
+            subprocess.run(["rsync", "-a", cwd + "/", "%s:%s/" % (h, args.sync_dst_dir)],
+                           check=True)
+        cwd = args.sync_dst_dir
+
+    def ssh_spawn(host, role, idx):
+        env = dict(base_env)
+        env["DMLC_ROLE"] = role
+        env["DMLC_SERVER_ID" if role == "server" else "DMLC_WORKER_ID"] = str(idx)
+        envs = " ".join("%s=%s" % kv for kv in env.items())
+        cmd = "cd %s && %s %s" % (cwd, envs, " ".join(args.command))
+        return subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no", host, cmd])
+
+    # ALL servers run on --host: workers dial DMLC_PS_ROOT_URI:port+i for
+    # every server i, so servers scattered across hosts would be unreachable
+    for i in range(args.num_servers):
+        procs.append(("server", ssh_spawn(args.host, "server", i)))
+    for i in range(args.num_workers):
+        procs.append(("worker", ssh_spawn(hosts[i % len(hosts)], "worker", i)))
+    rc = 0
+    for role, p in procs:
+        if role == "worker":
+            rc |= p.wait()
+    for role, p in procs:
+        if role == "server":
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.terminate()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
