@@ -1,0 +1,78 @@
+"""DCGAN on synthetic/MNIST data (reference: example/gan/dcgan.py — two
+Modules trained adversarially with shared batches; generator uses
+Deconvolution+BatchNorm+Activation stacks).
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import dcgan_generator, dcgan_discriminator
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--z-dim", type=int, default=100)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.0002)
+    ap.add_argument("--steps-per-epoch", type=int, default=50)
+    args = ap.parse_args()
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    gen = dcgan_generator(ngf=32, nc=1, z_dim=args.z_dim, out_size=32)
+    dis = dcgan_discriminator(ndf=32)
+
+    gen_mod = mx.mod.Module(gen, data_names=("rand",), label_names=None, context=ctx)
+    gen_mod.bind(data_shapes=[("rand", (args.batch_size, args.z_dim, 1, 1))],
+                 inputs_need_grad=True)
+    gen_mod.init_params(initializer=mx.init.Normal(0.02))
+    gen_mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": args.lr, "beta1": 0.5})
+
+    dis_mod = mx.mod.Module(dis, data_names=("data",), label_names=("label",), context=ctx)
+    dis_mod.bind(data_shapes=[("data", (args.batch_size, 1, 32, 32))],
+                 label_shapes=[("label", (args.batch_size,))],
+                 inputs_need_grad=True)
+    dis_mod.init_params(initializer=mx.init.Normal(0.02))
+    dis_mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": args.lr, "beta1": 0.5})
+
+    rng = np.random.RandomState(0)
+    metric_d = mx.metric.CustomMetric(lambda l, p: float(((p > 0.5) == (l > 0.5)).mean()),
+                                      name="dacc")
+    for epoch in range(args.num_epochs):
+        for step in range(args.steps_per_epoch):
+            real = mx.nd.array(rng.rand(args.batch_size, 1, 32, 32) * 2 - 1)
+            z = mx.nd.array(rng.randn(args.batch_size, args.z_dim, 1, 1))
+            # G forward
+            gen_mod.forward(mx.io.DataBatch([z], None), is_train=True)
+            fake = gen_mod.get_outputs()[0]
+            # D on fake (label 0)
+            dis_mod.forward(mx.io.DataBatch([fake], [mx.nd.zeros((args.batch_size,))]),
+                            is_train=True)
+            dis_mod.backward()
+            grads_fake = [[g.copy() for g in grads] for grads in dis_mod._exec_group.grad_arrays]
+            # D on real (label 1)
+            dis_mod.forward(mx.io.DataBatch([real], [mx.nd.ones((args.batch_size,))]),
+                            is_train=True)
+            dis_mod.backward()
+            for gss, gfs in zip(dis_mod._exec_group.grad_arrays, grads_fake):
+                for gs, gf in zip(gss, gfs):
+                    gs += gf
+            dis_mod.update()
+            # G step: D(fake) with label 1, propagate into G
+            dis_mod.forward(mx.io.DataBatch([fake], [mx.nd.ones((args.batch_size,))]),
+                            is_train=True)
+            dis_mod.backward()
+            diff = dis_mod.get_input_grads()[0]
+            gen_mod.backward([diff])
+            gen_mod.update()
+        gen_mod.forward(mx.io.DataBatch([mx.nd.array(rng.randn(args.batch_size, args.z_dim, 1, 1))], None),
+                        is_train=False)
+        sample = gen_mod.get_outputs()[0].asnumpy()
+        print("epoch %d: sample mean %.4f std %.4f" % (epoch, sample.mean(), sample.std()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""LSTM language model with bucketing (reference: example/rnn/lstm_bucketing.py:
+BucketSentenceIter + BucketingModule + per-bucket unrolled LSTM, Perplexity
+metric). Reads a tokenized text file via --data; synthetic corpus fallback.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def tokenize_text(fname, vocab=None, buckets=None, batch_size=32):
+    with open(fname) as f:
+        lines = [l.strip().split() for l in f if l.strip()]
+    if vocab is None:
+        vocab = {"<pad>": 0, "<unk>": 1}
+        for l in lines:
+            for w in l:
+                vocab.setdefault(w, len(vocab))
+    sent = [[vocab.get(w, 1) for w in l] for l in lines]
+    return sent, vocab
+
+
+def synthetic_corpus(n=500, vmax=100, seed=0):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(2, vmax, rng.randint(5, 60))) for _ in range(n)], \
+        {str(i): i for i in range(vmax)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None, help="tokenized text file")
+    ap.add_argument("--num-hidden", type=int, default=200)
+    ap.add_argument("--num-embed", type=int, default=200)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    buckets = [10, 20, 30, 40, 60]
+    if args.data:
+        sentences, vocab = tokenize_text(args.data)
+    else:
+        sentences, vocab = synthetic_corpus()
+    vocab_size = max(max(max(s) for s in sentences) + 1, len(vocab))
+
+    train = mx.rnn.BucketSentenceIter(sentences, args.batch_size, buckets=buckets)
+
+    cell = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        cell.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden, prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        outputs, _ = cell.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=train.default_bucket_key,
+                                 context=ctx)
+    mod.fit(train, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=[mx.callback.Speedometer(args.batch_size, 50)],
+            eval_metric=mx.metric.Perplexity(ignore_label=None))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Train CIFAR-10 with ResNet (reference: example/image-classification/
+train_cifar10.py). Real data via --data-dir holding cifar10_train.rec /
+cifar10_val.rec (pack with tools/im2rec.py); synthetic fallback otherwise.
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import resnet
+
+
+def get_iters(args, kv):
+    rec = os.path.join(args.data_dir, "cifar10_train.rec")
+    if os.path.exists(rec):
+        train = mx.io_image.ImageRecordIter(
+            path_imgrec=rec, data_shape=(3, 32, 32), batch_size=args.batch_size,
+            rand_crop=True, rand_mirror=True, shuffle=True,
+            part_index=kv.rank, num_parts=max(kv.num_workers, 1))
+        val = mx.io_image.ImageRecordIter(
+            path_imgrec=os.path.join(args.data_dir, "cifar10_val.rec"),
+            data_shape=(3, 32, 32), batch_size=args.batch_size)
+        return train, val
+    rng = np.random.RandomState(0)
+    X = rng.rand(2048, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (2048,)).astype(np.float32)
+    sh = slice(kv.rank, None, max(kv.num_workers, 1))
+    return (mx.io.NDArrayIter(X[sh], y[sh], args.batch_size, shuffle=True),
+            mx.io.NDArrayIter(X[:256], y[:256], args.batch_size))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-layers", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--data-dir", default="cifar10/")
+    ap.add_argument("--model-prefix", default=None)
+    args = ap.parse_args()
+
+    kv = mx.kv.create(args.kv_store)
+    net = resnet(num_classes=10, num_layers=args.num_layers, image_shape="3,32,32")
+    train, val = get_iters(args, kv)
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(net, context=ctx)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs, kvstore=kv,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4},
+            initializer=mx.init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2),
+            batch_end_callback=[mx.callback.Speedometer(args.batch_size, 50)],
+            epoch_end_callback=([mx.callback.do_checkpoint(args.model_prefix)]
+                                if args.model_prefix else []),
+            eval_metric=["acc"])
+
+
+if __name__ == "__main__":
+    main()

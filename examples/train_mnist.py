@@ -1,0 +1,93 @@
+"""Train MNIST — the reference's canonical first script
+(reference: example/image-classification/train_mnist.py).
+
+Uses the real MNIST if present at --data-dir (idx files), else synthetic
+digits so the script runs anywhere. Works with --kv-store local/device/
+dist_sync (under tools/launch.py).
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def get_mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu2", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def get_lenet():
+    from mxnet_tpu.models import lenet
+
+    return lenet(num_classes=10)
+
+
+def get_iters(args):
+    data_dir = args.data_dir
+    img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(img):
+        train = mx.io.MNISTIter(
+            image=img, label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True, flat=args.network == "mlp",
+            part_index=args.part_index, num_parts=args.num_parts)
+        val = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size, flat=args.network == "mlp")
+        return train, val
+    # synthetic fallback
+    rng = np.random.RandomState(0)
+    n = 2048
+    X = rng.rand(n, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, (n,)).astype(np.float32)
+    if args.network == "mlp":
+        X = X.reshape(n, 784)
+    shard = slice(args.part_index, None, args.num_parts)
+    train = mx.io.NDArrayIter(X[shard], y[shard], args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(X[:512], y[:512], args.batch_size)
+    return train, val
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--data-dir", default="mnist/")
+    ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--gpus", default=None, help="unused on TPU; kept for CLI parity")
+    args = ap.parse_args()
+
+    kv = mx.kv.create(args.kv_store)
+    args.part_index, args.num_parts = kv.rank, max(kv.num_workers, 1)
+    net = get_mlp() if args.network == "mlp" else get_lenet()
+    train, val = get_iters(args)
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(net, context=ctx)
+    cbs = [mx.callback.Speedometer(args.batch_size, 50)]
+    epoch_cbs = []
+    if args.model_prefix:
+        epoch_cbs.append(mx.callback.do_checkpoint(args.model_prefix))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs, kvstore=kv,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=cbs, epoch_end_callback=epoch_cbs,
+            eval_metric="acc")
+    if kv.rank == 0 and hasattr(kv, "_stop_servers"):
+        kv.barrier()
+        kv._stop_servers()
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,12 @@ ndarray.contrib = contrib.ndarray
 symbol.contrib = contrib.symbol
 
 from . import engine
+from . import operator
+
+# Custom registers into the op registry after symbol/ndarray generated their
+# functions at import — generate its wrappers explicitly
+symbol.Custom = symbol._make_symbol_function("Custom")
+ndarray.Custom = ndarray._make_ndarray_function("Custom")
 
 # server-role processes block here until the cluster shuts down
 # (reference: python/mxnet/__init__.py → kvstore_server._init_kvstore_server_module)

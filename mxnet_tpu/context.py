@@ -108,3 +108,19 @@ def tpu(device_id=0):
 def current_context():
     """Return the current context in the with-scope stack (default cpu(0))."""
     return _default_value()
+
+
+def num_tpus():
+    """Number of attached accelerator chips (0 on CPU-only hosts) — the
+    analog of the reference's mx.context counting via cudaGetDeviceCount."""
+    try:
+        import jax
+
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def num_gpus():
+    """Reference-script compatibility alias for :func:`num_tpus`."""
+    return num_tpus()

@@ -1,0 +1,303 @@
+"""Custom python operators — the user escape hatch.
+
+Reference: python/mxnet/operator.py — ``CustomOp``/``CustomOpProp`` (:396,
+:442) registered via ``register`` (:576, C side ``MXCustomOpRegister`` +
+src/operator/custom/custom-inl.h running callbacks as kAsync engine ops),
+plus the legacy ``NumpyOp``/``NDArrayOp`` (:126, :226).
+
+TPU design: a custom op is host Python inside an XLA graph. Forward lowers
+to ``jax.pure_callback`` (the XLA host-callback — the analog of the
+reference's kAsync engine callback into Python) with shapes from the prop's
+``infer_shape``; the gradient is a ``jax.custom_vjp`` whose backward is a
+second ``pure_callback`` into ``CustomOp.backward``. Works identically under
+``mx.nd.Custom`` (imperative), inside ``Symbol`` graphs, and under jit —
+but, being a host round-trip, it synchronizes the device pipeline exactly
+like the reference's custom ops serialized their engine stream.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ops.registry import Operator, _OP_REGISTRY
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators",
+           "NumpyOp", "NDArrayOp"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for custom operators (reference: operator.py:396)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """(reference: operator.py CustomOp.assign — honor the write request)"""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError("unknown req %s" % req)
+
+
+class CustomOpProp:
+    """Operator property: shapes/types/instantiation (reference: operator.py:442)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``op_type`` name
+    (reference: operator.py:576 register → MXCustomOpRegister)."""
+
+    def _reg(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return _reg
+
+
+def get_all_registered_operators():
+    return sorted(_CUSTOM_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# the 'Custom' op bridging the prop/op classes into the op registry
+# (reference: src/operator/custom/custom.cc registered as "Custom")
+# ---------------------------------------------------------------------------
+
+def _get_prop(attrs):
+    op_type = attrs.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom op needs op_type attr")
+    if op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError("Custom op type '%s' not registered" % op_type)
+    kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    return _CUSTOM_REGISTRY[op_type](**kwargs)
+
+
+def _custom_arg_names(attrs):
+    return _get_prop(attrs).list_arguments()
+
+
+def _custom_aux_names(attrs):
+    return _get_prop(attrs).list_auxiliary_states()
+
+
+def _custom_num_outputs(attrs):
+    return len(_get_prop(attrs).list_outputs())
+
+
+def _np_list(arrays):
+    from .ndarray import NDArray
+
+    return [NDArray(np.asarray(a)) for a in arrays]
+
+
+def _custom_forward(octx, attrs, args, auxs):
+    import jax
+
+    prop = _get_prop(attrs)
+    n_out = len(prop.list_outputs())
+    in_shapes = [tuple(a.shape) for a in args]
+    in_dtypes = [np.dtype(a.dtype) for a in args]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    _, out_dtypes, _ = prop.infer_type(in_dtypes)
+    out_struct = tuple(
+        jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+        for s, d in zip(out_shapes, out_dtypes))
+    aux_struct = tuple(jax.ShapeDtypeStruct(tuple(a.shape), np.dtype(a.dtype))
+                       for a in auxs)
+    is_train = bool(octx.is_train)
+    need_top = prop.need_top_grad()
+    n_args = len(args)
+
+    def host_forward(*host_args):
+        a_in = _np_list(host_args[:n_args])
+        a_aux = _np_list(host_args[n_args:])
+        op = prop.create_operator(None, in_shapes, in_dtypes)
+        outs = _np_list([np.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)])
+        op.forward(is_train, ["write"] * n_out, a_in, outs, a_aux)
+        res = [o.asnumpy().astype(d) for o, d in zip(outs, out_dtypes)]
+        res += [a.asnumpy() for a in a_aux]  # aux may be mutated in place
+        return tuple(res)
+
+    def host_backward(*host_args):
+        # layout: out_grads..., in_data..., out_data..., auxs...
+        i = 0
+        g_out = _np_list(host_args[i:i + n_out]); i += n_out
+        a_in = _np_list(host_args[i:i + n_args]); i += n_args
+        a_out = _np_list(host_args[i:i + n_out]); i += n_out
+        a_aux = _np_list(host_args[i:])
+        op = prop.create_operator(None, in_shapes, in_dtypes)
+        grads = _np_list([np.zeros(s, d) for s, d in zip(in_shapes, in_dtypes)])
+        op.backward(["write"] * n_args, g_out, a_in, a_out, grads, a_aux)
+        return tuple(g.asnumpy().astype(d) for g, d in zip(grads, in_dtypes))
+
+    @jax.custom_vjp
+    def run(args_t, auxs_t):
+        res = jax.pure_callback(host_forward, out_struct + aux_struct,
+                                *args_t, *auxs_t)
+        return list(res[:n_out]), list(res[n_out:])
+
+    def run_fwd(args_t, auxs_t):
+        outs, new_auxs = run(args_t, auxs_t)
+        return (outs, new_auxs), (tuple(args_t), tuple(outs), tuple(auxs_t))
+
+    def run_bwd(res, cts):
+        args_t, outs_t, auxs_t = res
+        g_outs, _g_auxs = cts
+        g_outs = [jax.numpy.zeros_like(o) if g is None else g
+                  for g, o in zip(g_outs, outs_t)]
+        in_struct = tuple(jax.ShapeDtypeStruct(s, d)
+                          for s, d in zip(in_shapes, in_dtypes))
+        grads = jax.pure_callback(host_backward, in_struct,
+                                  *g_outs, *args_t, *outs_t, *auxs_t)
+        return (list(grads), [jax.numpy.zeros_like(a) for a in auxs_t])
+
+    run.defvjp(run_fwd, run_bwd)
+    outs, new_auxs = run(list(args), list(auxs))
+    return list(outs), list(new_auxs)
+
+
+def _custom_infer_shape(attrs, in_shapes, aux_shapes):
+    prop = _get_prop(attrs)
+    ins, outs, auxs = prop.infer_shape([list(s) if s else None for s in in_shapes])
+    return ([tuple(s) for s in ins], [tuple(s) for s in outs],
+            [tuple(s) for s in auxs])
+
+
+_OP_REGISTRY["Custom"] = Operator(
+    "Custom",
+    _custom_forward,
+    arg_names=_custom_arg_names,
+    aux_names=_custom_aux_names,
+    num_outputs=_custom_num_outputs,
+    infer_shape=_custom_infer_shape,
+    keep_extras=True,
+)
+# Custom takes arbitrary string kwargs forwarded to the prop ctor; the registry
+# treats unknown attrs as pass-through extras, so no Param schema is declared.
+
+
+# ---------------------------------------------------------------------------
+# legacy python-op APIs (reference: operator.py:126 NumpyOp, :226 NDArrayOp) —
+# thin adapters onto the CustomOp machinery
+# ---------------------------------------------------------------------------
+
+class _LegacyProp(CustomOpProp):
+    def __init__(self, legacy):
+        super().__init__(need_top_grad=legacy.need_top_grad_)
+        self._legacy = legacy
+
+    def list_arguments(self):
+        return self._legacy.list_arguments()
+
+    def list_outputs(self):
+        return self._legacy.list_outputs()
+
+    def infer_shape(self, in_shape):
+        res = self._legacy.infer_shape(in_shape)
+        return (res[0], res[1], []) if len(res) == 2 else res
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        legacy = self._legacy
+
+        class _Adapter(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                xs = [a.asnumpy() for a in in_data]
+                ys = [o.asnumpy() for o in out_data]
+                legacy.forward(in_data=xs, out_data=ys)
+                for o, y in zip(out_data, ys):
+                    self.assign(o, req[0], y)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                ograd = [g.asnumpy() for g in out_grad]
+                xs = [a.asnumpy() for a in in_data]
+                ys = [o.asnumpy() for o in out_data]
+                igrad = [g.asnumpy() for g in in_grad]
+                legacy.backward(out_grad=ograd, in_data=xs, out_data=ys,
+                                in_grad=igrad)
+                for g, v in zip(in_grad, igrad):
+                    self.assign(g, req[0], v)
+
+        return _Adapter()
+
+
+class NumpyOp:
+    """Legacy numpy custom op (reference: operator.py:126). Subclass and
+    implement forward/backward/list_*/infer_shape; call the instance on
+    symbols: ``op = MyOp(); y = op(x, name=...)``."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        from . import symbol as sym_mod
+
+        name = "numpy_op_%d" % id(self)
+        if name not in _CUSTOM_REGISTRY:
+            legacy = self
+            _CUSTOM_REGISTRY[name] = lambda **kw: _LegacyProp(legacy)
+        kwargs["op_type"] = name
+        return sym_mod.Custom(*args, **kwargs)
+
+
+NDArrayOp = NumpyOp  # same python-side contract in this rebuild

@@ -119,6 +119,7 @@ class Operator:
         key_var_num_args=None,
         num_visible_outputs=None,
         alias=(),
+        keep_extras=False,
     ):
         self.name = name
         self.forward = forward
@@ -135,6 +136,10 @@ class Operator:
         self.key_var_num_args = key_var_num_args
         self._num_visible_outputs = num_visible_outputs
         self.alias = alias
+        # ops with open-ended kwargs (Custom forwards them to the user prop
+        # ctor) keep unknown attrs in the canonical dict instead of the
+        # node-attr side channel
+        self.keep_extras = keep_extras
 
     # ---- introspection ---------------------------------------------------
     def arg_names(self, attrs):
@@ -187,6 +192,11 @@ class Operator:
                 if p.required:
                     raise MXNetError("op %s: required attr '%s' missing" % (self.name, k))
                 out[k] = p.default
+        if self.keep_extras:
+            # graph-attr style keys (__key__, ctx_group) still go on the node
+            node_attrs = {k: v for k, v in extra.items() if k.startswith("__") or k == "ctx_group"}
+            out.update({k: v for k, v in extra.items() if k not in node_attrs})
+            return out, node_attrs
         return out, extra
 
     # ---- inference -------------------------------------------------------

@@ -1,0 +1,116 @@
+"""Standalone inference API.
+
+Reference: include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc (344
+LoC): create a predictor from a Symbol JSON string + parameter blob (the
+NDArray-dict save format), set named inputs, forward, read outputs — the
+deployment surface used by the amalgamation/mobile builds and the C++/Go
+predict clients.
+
+TPU design: one jitted forward executable per (graph, input shapes); params
+live on device between calls. ``Predictor.reshape`` re-jits for new input
+shapes (the reference's PredReshape) with the XLA compile cache making
+repeats free.
+"""
+from __future__ import annotations
+
+import io as _io
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .base import MXNetError
+from .context import cpu
+
+__all__ = ["Predictor", "load_ndarray_file"]
+
+
+def load_ndarray_file(blob):
+    """Parse a parameter blob (bytes of the NDArray-dict save format) into a
+    dict (reference: MXNDListCreate, c_predict_api.cc)."""
+    return nd.load(_io.BytesIO(blob) if isinstance(blob, (bytes, bytearray)) else blob)
+
+
+class Predictor:
+    """(reference: MXPredCreate/MXPredCreatePartialOut c_predict_api.cc)
+
+    ::
+
+        pred = Predictor(open("model-symbol.json").read(),
+                         open("model-0001.params","rb").read(),
+                         input_shapes={"data": (1, 3, 224, 224)})
+        pred.set_input("data", img)
+        pred.forward()
+        out = pred.get_output(0)
+    """
+
+    def __init__(self, symbol_json, param_blob, ctx=None, input_shapes=None,
+                 output_names=None):
+        if isinstance(symbol_json, bytes):
+            symbol_json = symbol_json.decode()
+        self.symbol = sym_mod.load_json(symbol_json)
+        if output_names:  # partial-out predictor (MXPredCreatePartialOut)
+            outs = self.symbol.get_internals()
+            if isinstance(output_names, str):
+                self.symbol = outs[output_names]
+            else:
+                self.symbol = sym_mod.Group([outs[n] for n in output_names])
+        self.ctx = ctx or cpu()
+        params = load_ndarray_file(param_blob) if not isinstance(param_blob, dict) else param_blob
+        self._arg_params = {k[4:]: v for k, v in params.items() if k.startswith("arg:")}
+        self._aux_params = {k[4:]: v for k, v in params.items() if k.startswith("aux:")}
+        # also accept un-prefixed dicts (Module.save_checkpoint params load)
+        for k, v in params.items():
+            if ":" not in k:
+                self._arg_params[k] = v
+        if not input_shapes:
+            raise MXNetError("input_shapes required (name -> shape)")
+        self._input_shapes = dict(input_shapes)
+        self._bind()
+
+    def _bind(self):
+        arg_names = self.symbol.list_arguments()
+        self._input_names = [n for n in arg_names
+                             if n not in self._arg_params or n in self._input_shapes]
+        missing = [n for n in self._input_names if n not in self._input_shapes]
+        if missing:
+            raise MXNetError("missing input shapes for %s" % missing)
+        self._exe = self.symbol.simple_bind(
+            ctx=self.ctx, grad_req="null", **self._input_shapes)
+        for n, v in self._arg_params.items():
+            if n in self._exe.arg_dict:
+                self._exe.arg_dict[n][:] = v
+        for n, v in self._aux_params.items():
+            if n in self._exe.aux_dict:
+                self._exe.aux_dict[n][:] = v
+        self._outputs = None
+
+    def set_input(self, name, data):
+        """(reference: MXPredSetInput)"""
+        if name not in self._exe.arg_dict:
+            raise MXNetError("unknown input %s" % name)
+        self._exe.arg_dict[name][:] = np.asarray(data, np.float32)
+
+    def forward(self, **inputs):
+        """(reference: MXPredForward); optionally pass inputs as kwargs."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self._outputs = self._exe.forward(is_train=False)
+        return self
+
+    def get_output(self, index):
+        """(reference: MXPredGetOutput) -> numpy array"""
+        if self._outputs is None:
+            raise MXNetError("call forward() first")
+        return self._outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self):
+        return len(self.symbol.list_outputs())
+
+    def reshape(self, input_shapes):
+        """(reference: MXPredReshape) — rebind for new shapes; the XLA
+        compile cache makes repeated shapes free."""
+        self._input_shapes.update(input_shapes)
+        self._bind()
+        return self

@@ -1,0 +1,109 @@
+"""Custom python op tests (reference: tests/python/unittest/test_operator.py
+test_custom_op — define a CustomOp, check forward/backward numerics, use in
+a bound symbol and through Module).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import operator as mxop
+
+
+@mxop.register("sqr_test")
+class SqrProp(mxop.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class Sqr(mxop.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0].asnumpy() ** 2)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                self.assign(in_grad[0], req[0],
+                            2 * in_data[0].asnumpy() * out_grad[0].asnumpy())
+
+        return Sqr()
+
+
+def test_custom_imperative():
+    x = mx.nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    y = mx.nd.Custom(x, op_type="sqr_test")
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2)
+
+
+def test_custom_symbolic_forward_backward():
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data, op_type="sqr_test", name="sqr")
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    exe = y.simple_bind(ctx=mx.cpu(), data=(3, 4))
+    exe.arg_dict["data"][:] = x
+    out = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, x ** 2, rtol=1e-5)
+    exe.backward(out_grads=[mx.nd.ones((3, 4))])
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * x, rtol=1e-5)
+
+
+def test_custom_in_graph_with_loss():
+    # custom op composed under a softmax head, trained a step via Module
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=6, name="fc")
+    net = mx.sym.Custom(net, op_type="sqr_test", name="csqr")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(3)
+    X = rng.rand(20, 5).astype(np.float32)
+    y = rng.randint(0, 6, (20,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=10)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            initializer=mx.init.Uniform(0.1))
+    out = mod.predict(it)
+    assert out.shape == (20, 6)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_numpy_op_legacy():
+    class MySigmoid(mxop.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+        def forward(self, in_data, out_data):
+            out_data[0][:] = 1.0 / (1.0 + np.exp(-in_data[0]))
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            y = out_data[0]
+            in_grad[0][:] = out_grad[0] * y * (1 - y)
+
+    op = MySigmoid()
+    x_sym = mx.sym.Variable("x")
+    y = op(x_sym, name="mysig")
+    x = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+    exe = y.simple_bind(ctx=mx.cpu(), x=(4, 3))
+    exe.arg_dict["x"][:] = x
+    out = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, 1 / (1 + np.exp(-x)), rtol=1e-5)
+    exe.backward(out_grads=[mx.nd.ones((4, 3))])
+    np.testing.assert_allclose(
+        exe.grad_dict["x"].asnumpy(), out * (1 - out), rtol=1e-4)
+
+
+def test_custom_registry_listing():
+    assert "sqr_test" in mxop.get_all_registered_operators()
