@@ -7,7 +7,7 @@ import argparse
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu.models import dcgan_generator, dcgan_discriminator
+from mxnet_tpu.models import make_generator, make_discriminator
 
 
 def main():
@@ -20,8 +20,8 @@ def main():
     args = ap.parse_args()
 
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
-    gen = dcgan_generator(ngf=32, nc=1, z_dim=args.z_dim, out_size=32)
-    dis = dcgan_discriminator(ndf=32)
+    gen = make_generator(ngf=32, nc=1)
+    dis = make_discriminator(ndf=32)
 
     gen_mod = mx.mod.Module(gen, data_names=("rand",), label_names=None, context=ctx)
     gen_mod.bind(data_shapes=[("rand", (args.batch_size, args.z_dim, 1, 1))],
@@ -31,7 +31,7 @@ def main():
                            optimizer_params={"learning_rate": args.lr, "beta1": 0.5})
 
     dis_mod = mx.mod.Module(dis, data_names=("data",), label_names=("label",), context=ctx)
-    dis_mod.bind(data_shapes=[("data", (args.batch_size, 1, 32, 32))],
+    dis_mod.bind(data_shapes=[("data", (args.batch_size, 1, 64, 64))],
                  label_shapes=[("label", (args.batch_size,))],
                  inputs_need_grad=True)
     dis_mod.init_params(initializer=mx.init.Normal(0.02))
@@ -43,7 +43,7 @@ def main():
                                       name="dacc")
     for epoch in range(args.num_epochs):
         for step in range(args.steps_per_epoch):
-            real = mx.nd.array(rng.rand(args.batch_size, 1, 32, 32) * 2 - 1)
+            real = mx.nd.array(rng.rand(args.batch_size, 1, 64, 64) * 2 - 1)
             z = mx.nd.array(rng.randn(args.batch_size, args.z_dim, 1, 1))
             # G forward
             gen_mod.forward(mx.io.DataBatch([z], None), is_train=True)

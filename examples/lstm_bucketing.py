@@ -9,7 +9,7 @@ import numpy as np
 import mxnet_tpu as mx
 
 
-def tokenize_text(fname, vocab=None, buckets=None, batch_size=32):
+def tokenize_text(fname, vocab=None):
     with open(fname) as f:
         lines = [l.strip().split() for l in f if l.strip()]
     if vocab is None:

@@ -84,9 +84,10 @@ def main():
             initializer=mx.init.Xavier(),
             batch_end_callback=cbs, epoch_end_callback=epoch_cbs,
             eval_metric="acc")
-    if kv.rank == 0 and hasattr(kv, "_stop_servers"):
-        kv.barrier()
-        kv._stop_servers()
+    if hasattr(kv, "_stop_servers"):
+        kv.barrier()  # collective: every worker must participate
+        if kv.rank == 0:
+            kv._stop_servers()
 
 
 if __name__ == "__main__":

@@ -31,6 +31,7 @@ from . import lr_scheduler
 from . import callback
 from . import monitor
 from . import io
+from . import io_image
 from . import recordio
 from . import kvstore as kv
 from .kvstore import KVStore, create as _kv_create

@@ -542,19 +542,27 @@ def save(fname, data):
 
 
 def load(fname):
-    """Load arrays saved by :func:`save`. Returns list or dict."""
+    """Load arrays saved by :func:`save`. Accepts a path or a binary
+    file-like object (the predict API passes parameter blobs as BytesIO).
+    Returns list or dict."""
+    if hasattr(fname, "read"):
+        return _load_stream(fname)
     with open(fname, "rb") as f:
-        (magic,) = struct.unpack("<Q", f.read(8))
-        if magic != _LIST_MAGIC:
-            raise MXNetError("Invalid NDArray list file")
-        f.read(8)
-        (n_arr,) = struct.unpack("<Q", f.read(8))
-        (n_names,) = struct.unpack("<Q", f.read(8))
-        arrays = [_read_ndarray(f) for _ in range(n_arr)]
-        names = []
-        for _ in range(n_names):
-            (ln,) = struct.unpack("<Q", f.read(8))
-            names.append(f.read(ln).decode("utf-8"))
+        return _load_stream(f)
+
+
+def _load_stream(f):
+    (magic,) = struct.unpack("<Q", f.read(8))
+    if magic != _LIST_MAGIC:
+        raise MXNetError("Invalid NDArray list file")
+    f.read(8)
+    (n_arr,) = struct.unpack("<Q", f.read(8))
+    (n_names,) = struct.unpack("<Q", f.read(8))
+    arrays = [_read_ndarray(f) for _ in range(n_arr)]
+    names = []
+    for _ in range(n_names):
+        (ln,) = struct.unpack("<Q", f.read(8))
+        names.append(f.read(ln).decode("utf-8"))
     if n_names:
         return dict(zip(names, arrays))
     return arrays

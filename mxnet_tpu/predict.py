@@ -74,6 +74,15 @@ class Predictor:
                              if n not in self._arg_params or n in self._input_shapes]
         missing = [n for n in self._input_names if n not in self._input_shapes]
         if missing:
+            # label inputs (e.g. softmax_label) are inferable from the data
+            # shapes — the reference predict API also only takes data shapes
+            # (c_predict_api.cc MXPredCreate)
+            inferred, _, _ = self.symbol.infer_shape_partial(**self._input_shapes)
+            for n, shp in zip(arg_names, inferred):
+                if n in missing and shp is not None and 0 not in tuple(shp):
+                    self._input_shapes[n] = tuple(shp)
+            missing = [n for n in self._input_names if n not in self._input_shapes]
+        if missing:
             raise MXNetError("missing input shapes for %s" % missing)
         self._exe = self.symbol.simple_bind(
             ctx=self.ctx, grad_req="null", **self._input_shapes)
