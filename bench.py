@@ -3,6 +3,8 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline: the reference's best published single-chip ResNet-50 training number,
 181.53 img/s fp32 batch 32 on P100 (docs/how_to/perf.md:188, BASELINE.md).
+Measured at the same batch 32 so vs_baseline is like-for-like (batch-128 runs
+~10% faster; set MXNET_TPU_BENCH_BATCH to explore).
 
 Methodology mirrors the reference's own benchmark drivers
 (example/image-classification/benchmark_score.py keeps the synthetic batch
@@ -26,7 +28,9 @@ import numpy as np
 
 
 def main():
-    batch = int(os.environ.get("MXNET_TPU_BENCH_BATCH", "128"))
+    # batch 32 matches the baseline's config for a like-for-like ratio
+    # (P100 number is fp32 batch 32); MXNET_TPU_BENCH_BATCH explores others
+    batch = int(os.environ.get("MXNET_TPU_BENCH_BATCH", "32"))
     dtype_name = os.environ.get("MXNET_TPU_BENCH_DTYPE", "bfloat16")
     steps = int(os.environ.get("MXNET_TPU_BENCH_STEPS", "50"))
     warmup = int(os.environ.get("MXNET_TPU_BENCH_WARMUP", "5"))

@@ -1,0 +1,88 @@
+"""Model-parallel stacked LSTM: place layers on different devices via ctx_group
+(reference: example/model-parallel-lstm/lstm.py — LSTM layers pinned to
+different GPUs with AttrScope(ctx_group=...), bound through group2ctx).
+
+On TPU the placement hints map to SPMD stage sharding over the mesh instead of
+per-layer device pinning: XLA schedules the pipeline dataflow the way the
+reference's async engine overlapped stages. The user contract (AttrScope +
+group2ctx bind) is identical.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.rnn import LSTMCell
+
+
+def build(seq_len, num_hidden, num_layers, vocab, num_groups):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    embed = sym.Embedding(data=data, input_dim=vocab, output_dim=num_hidden, name="embed")
+    outputs = sym.SliceChannel(embed, num_outputs=seq_len, axis=1, squeeze_axis=True)
+    outputs = list(outputs)
+    for i in range(num_layers):
+        group = "layer%d" % (i % num_groups)
+        with mx.AttrScope(ctx_group=group):
+            cell = LSTMCell(num_hidden=num_hidden, prefix="lstm_l%d_" % i)
+            new_outputs = []
+            states = cell.begin_state()
+            for t in range(seq_len):
+                out, states = cell(outputs[t], states)
+                new_outputs.append(out)
+            outputs = new_outputs
+    with mx.AttrScope(ctx_group="out"):
+        concat = sym.Concat(*[sym.expand_dims(o, axis=1) for o in outputs], dim=1)
+        pred = sym.FullyConnected(
+            data=sym.Reshape(concat, shape=(-1, num_hidden)), num_hidden=vocab, name="pred")
+        out = sym.SoftmaxOutput(data=pred, label=sym.Reshape(label, shape=(-1,)),
+                                name="softmax")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=8)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-layers", type=int, default=4)
+    ap.add_argument("--num-groups", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=16)
+    args = ap.parse_args()
+
+    net = build(args.seq_len, args.num_hidden, args.num_layers, args.vocab, args.num_groups)
+
+    # map each layer group to a device, reference-style group2ctx
+    ndev = max(mx.context.num_tpus(), 1)
+    mk = (lambda i: mx.tpu(i % ndev)) if mx.context.num_tpus() else (lambda i: mx.cpu(i))
+    group2ctx = {"layer%d" % g: mk(g) for g in range(args.num_groups)}
+    group2ctx["out"] = mk(args.num_groups)
+
+    ex = net.simple_bind(
+        ctx=mk(0), grad_req="write", group2ctx=group2ctx,
+        data=(args.batch_size, args.seq_len),
+        softmax_label=(args.batch_size, args.seq_len),
+    )
+    rng = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = (rng.rand(*arr.shape) * 0.1).astype(np.float32)
+    ex.arg_dict["data"][:] = rng.randint(0, args.vocab, (args.batch_size, args.seq_len)).astype(np.float32)
+    ex.arg_dict["softmax_label"][:] = rng.randint(0, args.vocab, (args.batch_size, args.seq_len)).astype(np.float32)
+    lr = 0.5
+    labels = ex.arg_dict["softmax_label"].asnumpy().reshape(-1).astype(int)
+    for step in range(5):
+        ex.forward(is_train=True)
+        ex.backward()
+        for name, arr in ex.arg_dict.items():
+            g = ex.grad_dict.get(name)
+            if g is not None and name not in ("data", "softmax_label"):
+                arr[:] = arr - lr * g
+        probs = ex.outputs[0].asnumpy()
+        nll = -np.log(np.maximum(probs[np.arange(len(labels)), labels], 1e-10)).mean()
+        print("step %d: nll %.4f" % (step, nll))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Profiler demo (reference: example/profiler/profiler_executor.py +
+profiler_matmul.py): record per-op execution into a chrome://tracing JSON.
+
+Run, then open chrome://tracing and load profile_output.json.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--file", default="profile_output.json")
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    mx.profiler.profiler_set_config(mode="all", filename=args.file)
+    mx.profiler.profiler_set_state("run")
+
+    # symbolic: a small MLP step
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=256, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    ex = net.simple_bind(ctx=mx.current_context(), data=(32, 128))
+    ex.arg_dict["data"][:] = np.random.rand(32, 128).astype(np.float32)
+    for _ in range(args.iters):
+        ex.forward(is_train=True)
+        ex.backward()
+    # imperative: matmul chain
+    a = nd.array(np.random.rand(256, 256).astype(np.float32))
+    for _ in range(args.iters):
+        a = nd.dot(a, a)
+        a = a / nd.norm(a)
+    a.wait_to_read()
+
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    print("wrote", args.file)
+
+
+if __name__ == "__main__":
+    main()

@@ -3,6 +3,7 @@ train_cifar10.py). Real data via --data-dir holding cifar10_train.rec /
 cifar10_val.rec (pack with tools/im2rec.py); synthetic fallback otherwise.
 """
 import argparse
+import logging
 import os
 
 import numpy as np
@@ -31,6 +32,7 @@ def get_iters(args, kv):
 
 
 def main():
+    logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=128)
     ap.add_argument("--num-layers", type=int, default=20)

@@ -1,0 +1,103 @@
+"""Train ImageNet-1k classifiers (reference: example/image-classification/
+train_imagenet.py + common/fit.py). Any model-zoo network via --network
+(resnet, resnext, inception-bn, inception-v3, googlenet, vgg, alexnet).
+
+Real data via --data-dir holding train.rec/val.rec (pack with tools/im2rec.py);
+synthetic fallback otherwise so the script is runnable anywhere. On a TPU host,
+`--kv-store device` shards the batch across all local chips via the SPMD mesh
+(the analog of the reference's multi-GPU data parallelism).
+"""
+import argparse
+import logging
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+NETWORKS = {
+    "resnet": lambda a: models.resnet(num_classes=a.num_classes, num_layers=a.num_layers,
+                                      image_shape=a.image_shape),
+    "resnext": lambda a: models.resnext(num_classes=a.num_classes, num_layers=a.num_layers,
+                                        image_shape=a.image_shape, num_group=a.num_group),
+    "inception-bn": lambda a: models.inception_bn(num_classes=a.num_classes),
+    "inception-v3": lambda a: models.inception_v3(num_classes=a.num_classes),
+    "googlenet": lambda a: models.googlenet(num_classes=a.num_classes),
+    "vgg": lambda a: models.vgg(num_classes=a.num_classes, num_layers=a.num_layers),
+    "alexnet": lambda a: models.alexnet(num_classes=a.num_classes),
+    "mlp": lambda a: models.mlp(num_classes=a.num_classes),
+}
+
+
+def get_iters(args, kv, data_shape):
+    rec = os.path.join(args.data_dir, "train.rec")
+    if os.path.exists(rec):
+        train = mx.io_image.ImageRecordIter(
+            path_imgrec=rec, data_shape=data_shape, batch_size=args.batch_size,
+            rand_crop=True, rand_mirror=True, shuffle=True,
+            mean_r=123.68, mean_g=116.779, mean_b=103.939,
+            part_index=kv.rank, num_parts=max(kv.num_workers, 1))
+        val_rec = os.path.join(args.data_dir, "val.rec")
+        val = mx.io_image.ImageRecordIter(
+            path_imgrec=val_rec, data_shape=data_shape, batch_size=args.batch_size,
+            mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        ) if os.path.exists(val_rec) else None
+        return train, val
+    rng = np.random.RandomState(0)
+    n = args.num_examples
+    X = rng.rand(n, *data_shape).astype(np.float32)
+    y = rng.randint(0, args.num_classes, (n,)).astype(np.float32)
+    sh = slice(kv.rank, None, max(kv.num_workers, 1))
+    return (mx.io.NDArrayIter(X[sh], y[sh], args.batch_size, shuffle=True),
+            mx.io.NDArrayIter(X[: 4 * args.batch_size], y[: 4 * args.batch_size],
+                              args.batch_size))
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet", choices=sorted(NETWORKS))
+    ap.add_argument("--num-layers", type=int, default=50)
+    ap.add_argument("--num-group", type=int, default=32)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-examples", type=int, default=1024)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--lr-factor", type=float, default=0.1)
+    ap.add_argument("--lr-step-epochs", default="30,60,90")
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--data-dir", default="imagenet/")
+    ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    ap.add_argument("--disp-batches", type=int, default=20)
+    args = ap.parse_args()
+
+    kv = mx.kv.create(args.kv_store)
+    data_shape = tuple(int(x) for x in args.image_shape.split(","))
+    net = NETWORKS[args.network](args)
+    train, val = get_iters(args, kv, data_shape)
+
+    epoch_size = max(args.num_examples // args.batch_size // max(kv.num_workers, 1), 1)
+    steps = [int(e) * epoch_size for e in args.lr_step_epochs.split(",") if e.strip()]
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=steps, factor=args.lr_factor) if steps else None
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(net, context=ctx)
+    mod.fit(
+        train, eval_data=val, num_epoch=args.num_epochs, kvstore=kv,
+        optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4,
+                          "lr_scheduler": sched},
+        initializer=mx.init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2),
+        batch_end_callback=[mx.callback.Speedometer(args.batch_size, args.disp_batches)],
+        epoch_end_callback=([mx.callback.do_checkpoint(args.model_prefix)]
+                            if args.model_prefix else []),
+        eval_metric=["acc", mx.metric.TopKAccuracy(top_k=5)],
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Train SSD-300 on a detection RecordIO (reference: example/ssd/train.py).
+
+Real data via --data-dir holding train.rec packed with box labels
+(tools/im2rec.py with label_width>5); synthetic fallback otherwise. The loss
+graph follows the reference: MultiBoxTarget matching + SmoothL1 loc loss +
+hard-negative-mined softmax cls loss.
+"""
+import argparse
+import logging
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import ssd
+
+
+class MultiBoxMetric(mx.metric.EvalMetric):
+    """Cross-entropy cls loss + SmoothL1 loc loss readouts
+    (reference: example/ssd/train/metric.py)."""
+
+    def __init__(self):
+        super().__init__("MultiBox")
+        self.num = 2
+        self.name = ["CrossEntropy", "SmoothL1"]
+        self.reset()
+
+    def reset(self):
+        self.num_inst = [0, 0]
+        self.sum_metric = [0.0, 0.0]
+
+    def update(self, labels, preds):
+        cls_prob = preds[0].asnumpy()
+        loc_loss = preds[1].asnumpy()
+        cls_label = preds[2].asnumpy()
+        valid = (cls_label >= 0).astype(np.float32)
+        label = cls_label.astype(np.int64)
+        prob = np.moveaxis(cls_prob, 1, -1).reshape(-1, cls_prob.shape[1])
+        p = prob[np.arange(prob.shape[0]), np.maximum(label.reshape(-1), 0)]
+        ce = (-np.log(np.maximum(p, 1e-10)) * valid.reshape(-1)).sum()
+        self.sum_metric[0] += float(ce)
+        self.num_inst[0] += int(valid.sum())
+        self.sum_metric[1] += float(loc_loss.sum())
+        self.num_inst[1] += int(valid.sum())
+
+    def get(self):
+        return (self.name,
+                [s / n if n else float("nan") for s, n in zip(self.sum_metric, self.num_inst)])
+
+
+def get_iter(args, kv):
+    rec = os.path.join(args.data_dir, "train.rec")
+    if os.path.exists(rec):
+        return mx.io_image.ImageDetRecordIter(
+            path_imgrec=rec, data_shape=(3, 300, 300), batch_size=args.batch_size,
+            mean_r=123.68, mean_g=116.779, mean_b=103.939,
+            part_index=kv.rank, num_parts=max(kv.num_workers, 1))
+    rng = np.random.RandomState(0)
+    n = args.num_examples
+    X = rng.rand(n, 3, 300, 300).astype(np.float32)
+    # labels: (n, max_objects, 5) rows [cls, x0, y0, x1, y1], -1 padded
+    Y = -np.ones((n, 8, 5), np.float32)
+    for i in range(n):
+        for j in range(rng.randint(1, 4)):
+            x0, y0 = rng.rand(2) * 0.6
+            Y[i, j] = [rng.randint(0, args.num_classes), x0, y0,
+                       x0 + 0.2 + rng.rand() * 0.2, y0 + 0.2 + rng.rand() * 0.2]
+    return mx.io.NDArrayIter({"data": X}, {"label": Y}, args.batch_size,
+                             shuffle=True, label_name="label")
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-classes", type=int, default=20)
+    ap.add_argument("--num-examples", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.004)
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--data-dir", default="voc/")
+    ap.add_argument("--model-prefix", default=None)
+    args = ap.parse_args()
+
+    kv = mx.kv.create(args.kv_store)
+    net = ssd.get_symbol_train(num_classes=args.num_classes)
+    train = get_iter(args, kv)
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(net, label_names=["label"], context=ctx)
+    mod.fit(train, num_epoch=args.num_epochs, kvstore=kv,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9, "wd": 5e-4},
+            initializer=mx.init.Xavier(),
+            eval_metric=MultiBoxMetric(),
+            batch_end_callback=[mx.callback.Speedometer(args.batch_size, 5)],
+            epoch_end_callback=([mx.callback.do_checkpoint(args.model_prefix)]
+                                if args.model_prefix else []))
+
+
+if __name__ == "__main__":
+    main()

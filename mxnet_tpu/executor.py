@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import profiler as _profiler
 from . import random as _random
 from .base import MXNetError
 from .ops.registry import OpContext, get_op
@@ -222,8 +223,12 @@ class Executor:
             self._jit_fwd[is_train] = fn
         return fn
 
+    def _profile_name(self, kind):
+        return "executor_%s[%s]" % (kind, getattr(self._symbol, "name", None) or "graph")
+
     def _run_forward(self, is_train, rng):
-        outs, new_aux = self._get_jit_fwd(is_train)(self._arg_data, self._aux_data, rng)
+        with _profiler.record_span(self._profile_name("forward"), "executor"):
+            outs, new_aux = self._get_jit_fwd(is_train)(self._arg_data, self._aux_data, rng)
         if is_train:
             for arr, new in zip(self.aux_arrays, new_aux):
                 arr._set_data(new)
@@ -297,7 +302,8 @@ class Executor:
             if isinstance(out_grads, nd.NDArray):
                 out_grads = [out_grads]
             ogs = [g.data if isinstance(g, nd.NDArray) else jnp.asarray(g) for g in out_grads]
-        outs, grads, new_aux = self._build_fwd_bwd()(args, auxs, ogs, rng)
+        with _profiler.record_span(self._profile_name("fwd_bwd"), "executor"):
+            outs, grads, new_aux = self._build_fwd_bwd()(args, auxs, ogs, rng)
         self._outputs_cache = outs
         self._pending = None
         for arr, new in zip(self.aux_arrays, new_aux):

@@ -25,10 +25,12 @@ from .image import CreateAugmenter, imdecode
 from .io import DataBatch, DataDesc, DataIter
 from . import recordio
 
-__all__ = ["ImageRecordIter"]
+__all__ = ["ImageRecordIter", "ImageDetRecordIter"]
 
 
 class ImageRecordIter(DataIter):
+    _label_pad = 0.0
+
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, part_index=0, num_parts=1,
                  preprocess_threads=4, prefetch_buffer=4,
@@ -132,7 +134,10 @@ class ImageRecordIter(DataIter):
             c, h, w = self.data_shape
             done_workers = 0
             buf_data = np.zeros((self.batch_size, c, h, w), np.float32)
-            buf_label = np.zeros((self.batch_size, self.label_width), np.float32)
+            # detection iters pad with -1 (invalid class) so short labels can't
+            # alias real class-0 objects; classification keeps 0
+            buf_label = np.full((self.batch_size, self.label_width),
+                                self._label_pad, np.float32)
             i = 0
             while done_workers < self.preprocess_threads:
                 item = self._decoded_q.get()
@@ -141,6 +146,7 @@ class ImageRecordIter(DataIter):
                     continue
                 arr, label = item
                 buf_data[i] = arr
+                buf_label[i, :] = self._label_pad
                 buf_label[i, : len(label[: self.label_width])] = label[: self.label_width]
                 i += 1
                 if i == self.batch_size:
@@ -186,5 +192,82 @@ class ImageRecordIter(DataIter):
         label_out = label if self.label_width > 1 else label[:, 0]
         return DataBatch(
             [nd.array(data)], [nd.array(label_out)], pad=pad,
+            provide_data=self.provide_data, provide_label=self.provide_label,
+        )
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection variant: variable-object box labels per record
+    (reference: src/io/iter_image_det_recordio.cc, the SSD pipeline;
+    box-aware augmenters image_det_aug_default.cc).
+
+    Record label layout (reference det recordio contract): a flat float list,
+    optionally prefixed with [header_width, object_width]; objects are rows of
+    ``object_width`` floats ``[class, x0, y0, x1, y1, ...]`` with corner
+    coordinates normalized to [0, 1]. Batches emit ``(batch, max_objects,
+    object_width)`` padded with -1 rows — the shape MultiBoxTarget consumes.
+    Horizontal flips mirror the boxes; crop-style augmenters are disabled
+    because they would invalidate the boxes (the reference uses the dedicated
+    det augmenter for that).
+    """
+
+    _label_pad = -1.0
+
+    # widest [header_width, object_width] prefix we strip (reference det
+    # recordio headers are 2 floats; pad generously so truncation in the
+    # batcher can never eat a trailing object)
+    _MAX_HEADER = 16
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=-1,
+                 max_objects=32, object_width=5, rand_mirror=False, **kwargs):
+        self.object_width = int(object_width)
+        # honor the reference's label_pad_width-style knob: a positive
+        # label_width fixes the padded label length and implies max_objects
+        self.max_objects = (int(label_width) // self.object_width
+                            if int(label_width) > 0 else int(max_objects))
+        self._det_rand_mirror = bool(rand_mirror)
+        kwargs.pop("rand_crop", None)
+        kwargs.pop("rand_resize", None)
+        super().__init__(
+            path_imgrec, data_shape, batch_size,
+            label_width=self.max_objects * self.object_width + self._MAX_HEADER,
+            rand_mirror=False, **kwargs)
+        label_name = self.provide_label[0].name
+        self.provide_label = [DataDesc(
+            label_name, (batch_size, self.max_objects, self.object_width))]
+        self._rng = np.random.RandomState(kwargs.get("seed", 0) or 0)
+
+    def _parse_det_label(self, flat):
+        flat = np.asarray(flat, np.float32).reshape(-1)
+        ow = self.object_width
+        if flat.size >= 2 and float(flat[0]).is_integer() and 2 <= flat[0] <= 16:
+            hdr = int(flat[0])
+            if flat.size > hdr and float(flat[1]).is_integer() and flat[1] >= 5:
+                ow = int(flat[1])
+                flat = flat[hdr:]
+        n = flat.size // ow
+        out = -np.ones((self.max_objects, self.object_width), np.float32)
+        boxes = flat[: n * ow].reshape(n, ow)[: self.max_objects, : self.object_width]
+        # a record may carry narrower objects than configured; missing trailing
+        # fields stay -1
+        out[: boxes.shape[0], : boxes.shape[1]] = boxes
+        return out
+
+    def next(self):
+        item = self._out_q.get()
+        if item is None:
+            raise StopIteration
+        data, label, pad = item
+        boxes = np.stack([self._parse_det_label(row) for row in label])
+        if self._det_rand_mirror:
+            for i in range(data.shape[0]):
+                if self._rng.rand() < 0.5:
+                    data[i] = data[i, :, :, ::-1]
+                    valid = boxes[i, :, 0] >= 0
+                    x0 = boxes[i, valid, 1].copy()
+                    boxes[i, valid, 1] = 1.0 - boxes[i, valid, 3]
+                    boxes[i, valid, 3] = 1.0 - x0
+        return DataBatch(
+            [nd.array(data)], [nd.array(boxes)], pad=pad,
             provide_data=self.provide_data, provide_label=self.provide_label,
         )

@@ -7,6 +7,10 @@ measure (BASELINE.md): ResNet-50/152 ImageNet, Inception-BN/v3, AlexNet, VGG,
 LeNet MNIST, LSTM LM, DCGAN.
 """
 from .lenet import get_symbol as lenet
+from .googlenet import get_symbol as googlenet
+from .inception_v3 import get_symbol as inception_v3
+from .resnext import get_symbol as resnext
+from . import ssd
 from .mlp import get_symbol as mlp
 from .alexnet import get_symbol as alexnet
 from .vgg import get_symbol as vgg

@@ -29,6 +29,7 @@ import sys
 
 import numpy as np
 
+from . import profiler as _profiler
 from . import random as _random
 from .base import MXNetError, _DTYPE_MX_TO_NP, _DTYPE_NP_TO_MX
 from .context import Context, cpu, current_context
@@ -109,7 +110,8 @@ def imperative_invoke(op_name, ndargs, attrs, out=None):
     if op.stochastic:
         rng = jax.device_put(_random.next_key(), ctx.jax_device)
     fn = _get_jitted(op, attrs, len(args), len(auxs), is_train)
-    outs, new_auxs = fn(args, auxs, rng)
+    with _profiler.record_span(op_name, "operator"):
+        outs, new_auxs = fn(args, auxs, rng)
     # write updated aux back into the caller's arrays (FMutateInputs semantics)
     for nda, new in zip(ndargs[n_expected:], new_auxs):
         if isinstance(nda, NDArray):

@@ -433,7 +433,7 @@ get_op("_contrib_Proposal")._infer_shape = _proposal_infer
     num_outputs=2,
     num_visible_outputs=1,
     output_names=("output", "grad"),
-    alias=("CTCLoss", "_contrib_ctc_loss"),
+    alias=("CTCLoss", "_contrib_ctc_loss", "WarpCTC"),
 )
 def _ctc_loss(octx, attrs, args, auxs):
     """CTC negative log-likelihood via the alpha (forward) recursion in log

@@ -184,6 +184,10 @@ for _name, _fn in _UNARY.items():
 
 # identity / gradient-control ops (reference: elemwise_unary_op.cc _copy/BlockGrad)
 register_simple("_copy", lambda attrs, x: x + jnp.zeros((), x.dtype), arg_names=("data",), alias=("identity",))
+# device-placement copy node (reference: PlaceDevice pass inserts _CrossDeviceCopy,
+# graph_executor.cc:321; on TPU placement is SPMD-sharded so this is identity —
+# XLA inserts the actual transfers)
+register_simple("_CrossDeviceCopy", lambda attrs, x: x + jnp.zeros((), x.dtype), arg_names=("data",))
 register_simple("BlockGrad", lambda attrs, x: jax.lax.stop_gradient(x), arg_names=("data",), alias=("stop_gradient",))
 register_simple(
     "Cast",
@@ -218,3 +222,40 @@ def _add_n(octx, attrs, args, auxs):
 
 # scatter-style grad accumulation helper (reference: _grad_add chained adds)
 register_simple("_grad_add", lambda attrs, x, y: x + y, arg_names=("lhs", "rhs"))
+
+
+def _smooth_l1(attrs, x):
+    # reference: elemwise_binary_scalar_op_extended.cc:62 (mshadow_op::smooth_l1_loss):
+    # f(x) = 0.5*(sigma*x)^2 if |x| < 1/sigma^2 else |x| - 0.5/sigma^2
+    sigma = np.asarray(attrs["scalar"], dtype=x.dtype)
+    sigma2 = sigma * sigma
+    return jnp.where(
+        jnp.abs(x) < 1.0 / sigma2,
+        0.5 * jnp.square(sigma * x),
+        jnp.abs(x) - 0.5 / sigma2,
+    )
+
+
+register_simple(
+    "smooth_l1",
+    _smooth_l1,
+    arg_names=("data",),
+    params={"scalar": _f(1.0)},
+)
+
+# identity over lhs whose shape/dtype attrs come from rhs; grad flows to lhs only
+# (reference: elemwise_unary_op.cc:114 _identity_with_attr_like_rhs — used by
+# slice-assign gradients)
+register_simple(
+    "_identity_with_attr_like_rhs",
+    lambda attrs, lhs, rhs: lhs + jnp.zeros((), lhs.dtype),
+    arg_names=("lhs", "rhs"),
+)
+
+# gradient placeholder node (reference: nnvm no_gradient op): a zero scalar that
+# blocks gradient flow; appears in graphs where an input has no defined gradient
+register_simple(
+    "_NoGradient",
+    lambda attrs: jax.lax.stop_gradient(jnp.zeros(())),
+    arg_names=(),
+)

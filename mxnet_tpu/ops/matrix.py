@@ -360,6 +360,38 @@ def _crop(octx, attrs, args, auxs):
     return [x[:, :, oh : oh + th, ow : ow + tw]], []
 
 
+# ---- slice assignment (matrix_op.cc:258 _slice_assign / :283 _crop_assign_scalar)
+def _region(attrs, shape):
+    begin, end = attrs["begin"], attrs["end"]
+    idx = []
+    for i in range(len(shape)):
+        b = begin[i] if i < len(begin) and begin[i] is not None else 0
+        e = end[i] if i < len(end) and end[i] is not None else shape[i]
+        idx.append(slice(b, e))
+    return tuple(idx)
+
+
+register_simple(
+    "_slice_assign",
+    lambda attrs, lhs, rhs: lhs.at[_region(attrs, lhs.shape)].set(rhs.astype(lhs.dtype)),
+    arg_names=("lhs", "rhs"),
+    params={"begin": Param(_parse_shape_opt), "end": Param(_parse_shape_opt)},
+    alias=("_crop_assign",),
+)
+
+register_simple(
+    "_crop_assign_scalar",
+    lambda attrs, x: x.at[_region(attrs, x.shape)].set(np.asarray(attrs["scalar"], x.dtype)),
+    arg_names=("data",),
+    params={
+        "begin": Param(_parse_shape_opt),
+        "end": Param(_parse_shape_opt),
+        "scalar": Param.float(0.0),
+    },
+    alias=("_slice_assign_scalar",),
+)
+
+
 # ---- where (control_flow.cc) ----------------------------------------------
 register_simple(
     "where",

@@ -57,30 +57,53 @@ def profiler_set_state(state="stop"):
         return
 
 
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "category", "t0")
+
+    def __init__(self, name, category):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *a):
+        if _state["running"]:
+            with _state["lock"]:
+                _state["events"].append(
+                    {
+                        "name": self.name,
+                        "cat": self.category,
+                        "ph": "X",
+                        "ts": self.t0 * 1e6,
+                        "dur": (time.time() - self.t0) * 1e6,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % (1 << 16),
+                    }
+                )
+        return False
+
+
 def record_span(name, category="operator"):
-    """Context manager recording one span while the profiler runs."""
-
-    class _Span:
-        def __enter__(self):
-            self.t0 = time.time()
-            return self
-
-        def __exit__(self, *a):
-            if _state["running"]:
-                with _state["lock"]:
-                    _state["events"].append(
-                        {
-                            "name": name,
-                            "cat": category,
-                            "ph": "X",
-                            "ts": self.t0 * 1e6,
-                            "dur": (time.time() - self.t0) * 1e6,
-                            "pid": os.getpid(),
-                            "tid": threading.get_ident() % (1 << 16),
-                        }
-                    )
-
-    return _Span()
+    """Context manager recording one span while the profiler runs; a shared
+    no-op when stopped so the imperative hot path pays ~nothing."""
+    if not _state["running"]:
+        return _NULL_SPAN
+    return _Span(name, category)
 
 
 def dump_profile():

@@ -14,6 +14,7 @@ arg_nodes / heads with string attrs) so graphs round-trip between frameworks.
 """
 from __future__ import annotations
 
+import ast
 import builtins
 import json
 import sys
@@ -428,7 +429,15 @@ def _infer(sym, provided, kind, partial):
     known = {}  # id(node) -> list of per-output values
     for node in order:
         if node.is_variable:
-            known[id(node)] = [provided.get(node.name)]
+            val = provided.get(node.name)
+            if val is None:
+                # fall back to attrs declared on the Variable itself
+                # (reference: symbol.py Variable(shape=...) → __shape__ attr)
+                if kind == "shape" and node._extra_attrs.get("__shape__"):
+                    val = tuple(ast.literal_eval(node._extra_attrs["__shape__"]))
+                elif kind != "shape" and node._extra_attrs.get("__dtype__"):
+                    val = np.dtype(node._extra_attrs["__dtype__"])
+            known[id(node)] = [val]
     changed = True
     for node in order:
         if node.is_variable:

@@ -140,3 +140,52 @@ def test_mnist_iter(tmp_path):
     assert b.data[0].shape == (5, 1, 28, 28)
     assert b.data[0].asnumpy().max() <= 1.0
     np.testing.assert_allclose(b.label[0].asnumpy(), lbls[:5].astype(np.float32))
+
+
+def _write_det_rec(path, n, label_fn):
+    import io as _io
+
+    from PIL import Image
+
+    rec = recordio.MXRecordIO(str(path), "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = Image.fromarray((rng.rand(16, 16, 3) * 255).astype(np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG")
+        rec.write(recordio.pack(recordio.IRHeader(0, label_fn(i), i, 0), buf.getvalue()))
+    rec.close()
+
+
+def test_image_det_record_iter(tmp_path):
+    """Detection iter: header strip, -1 padding, full-record retention
+    (reference: src/io/iter_image_det_recordio.cc label contract)."""
+    path = tmp_path / "det.rec"
+    # [hdr=2, ow=5] + max_objects objects: nothing may be dropped
+    _write_det_rec(path, 4, lambda i: [2, 5] + sum(
+        [[k, 0.1, 0.1, 0.5, 0.5] for k in range(4)], []))
+    it = mx.io_image.ImageDetRecordIter(str(path), (3, 16, 16), batch_size=2,
+                                        max_objects=4)
+    lab = it.next().label[0].asnumpy()
+    assert lab.shape == (2, 4, 5)
+    assert int((lab[0, :, 0] >= 0).sum()) == 4  # all objects kept
+
+    # single short object: pad rows must be -1 (not class-0 ghosts)
+    path2 = tmp_path / "det2.rec"
+    _write_det_rec(path2, 4, lambda i: [2, 5, 1, 0.1, 0.1, 0.6, 0.6])
+    it = mx.io_image.ImageDetRecordIter(str(path2), (3, 16, 16), batch_size=2,
+                                        max_objects=3)
+    lab = it.next().label[0].asnumpy()
+    assert (lab[:, 1:, 0] == -1).all()
+    np.testing.assert_allclose(lab[0, 0], [1, 0.1, 0.1, 0.6, 0.6], atol=1e-6)
+
+    # wider configured object_width than record: missing fields stay -1
+    it = mx.io_image.ImageDetRecordIter(str(path2), (3, 16, 16), batch_size=2,
+                                        max_objects=3, object_width=6)
+    lab = it.next().label[0].asnumpy()
+    assert lab.shape == (2, 3, 6) and lab[0, 0, 5] == -1
+
+    # label_width knob implies max_objects (reference label_pad_width)
+    it = mx.io_image.ImageDetRecordIter(str(path2), (3, 16, 16), batch_size=2,
+                                        label_width=10)
+    assert it.provide_label[0].shape == (2, 2, 5)

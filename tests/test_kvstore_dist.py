@@ -129,6 +129,7 @@ def test_dist_single_process_fallback():
 
 
 WORKER_FIT = r"""
+import os
 import numpy as np
 import mxnet_tpu as mx
 
@@ -157,7 +158,9 @@ score = mod.score(it, mx.metric.Accuracy())[0][1]
 # both workers see identical global updates -> identical params
 arg, _ = mod.get_params()
 sig = float(sum(float(np.abs(v.asnumpy()).sum()) for v in arg.values()))
-print("FIT_SCORE", rank, score, round(sig, 4), flush=True)
+# single write() syscall so concurrent workers' lines can't interleave on the
+# shared pipe (atomic under PIPE_BUF)
+os.write(1, ("FIT_SCORE %d %s %s\n" % (rank, score, round(sig, 4))).encode())
 kv.barrier()
 if rank == 0:
     kv._stop_servers()

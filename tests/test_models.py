@@ -101,3 +101,42 @@ def test_dcgan_generator_discriminator():
     # one G step + one D step
     out = _one_step(disc, (2, 3, 64, 64), (2, 1), label_name="label")
     assert out.shape == (2, 1)
+
+
+def test_googlenet_shapes():
+    net = models.googlenet(num_classes=1000)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 1000)
+
+
+def test_inception_v3_shapes():
+    net = models.inception_v3(num_classes=1000)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes[0] == (1, 1000)
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    n_params = sum(int(np.prod(s)) for n, s in d.items()
+                   if n not in ("data", "softmax_label"))
+    assert 20e6 < n_params < 25e6  # ~23.8M params in Inception-v3 w/o aux head
+
+
+def test_resnext_model():
+    # cifar-size resnext trains one step; imagenet config checks shapes
+    net = models.resnext(num_classes=10, num_layers=20, image_shape="3,28,28", num_group=8)
+    out = _one_step(net, (2, 3, 28, 28), (2,))
+    assert out.shape == (2, 10)
+    net = models.resnext(num_classes=1000, num_layers=101, num_group=32)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 1000)
+
+
+def test_ssd_shapes():
+    from mxnet_tpu.models import ssd
+
+    net = ssd.get_symbol_train(num_classes=20)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 300, 300), label=(1, 8, 5))
+    # canonical SSD-300: 8732 anchors, 21 classes (20 + background)
+    assert out_shapes[0] == (1, 21, 8732)
+    assert out_shapes[3] == (1, 8732, 6)
+    neti = ssd.get_symbol(num_classes=20)
+    _, out_shapes, _ = neti.infer_shape(data=(1, 3, 300, 300))
+    assert out_shapes[0] == (1, 8732, 6)

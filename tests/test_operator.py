@@ -514,3 +514,63 @@ def test_rnn_op_shapes_and_run():
         state_size=H, num_layers=1, mode="gru", bidirectional=True,
     )
     assert out.shape == (T, N, 2 * H)
+
+
+def test_smooth_l1():
+    # reference: elemwise_binary_scalar_op_extended.cc:77
+    # smooth_l1([1,2,3,4], sigma=1) = [0.5, 1.5, 2.5, 3.5]
+    x = nd.array(np.array([1, 2, 3, 4], np.float32))
+    out = nd.smooth_l1(x, scalar=1.0)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 1.5, 2.5, 3.5], rtol=1e-6)
+    # quadratic region with sigma=2: |x| < 1/4 -> 0.5*(2x)^2
+    x2 = nd.array(np.array([0.1, -0.2, 1.0], np.float32))
+    out2 = nd.smooth_l1(x2, scalar=2.0).asnumpy()
+    np.testing.assert_allclose(out2, [0.5 * 0.2**2, 0.5 * 0.4**2, 1.0 - 0.125], rtol=1e-5)
+    # gradient: sigma^2*x inside, sign(x) outside
+    data = sym.Variable("data")
+    s = sym.smooth_l1(data, scalar=1.0)
+    check_numeric_gradient(s, [np.array([[0.3, -0.4, 2.0, -3.0]], np.float32)])
+
+
+def test_slice_assign():
+    lhs = rng.rand(4, 5).astype(np.float32)
+    rhs = rng.rand(2, 3).astype(np.float32)
+    out = nd._slice_assign(
+        nd.array(lhs), nd.array(rhs), begin=(1, 1), end=(3, 4)
+    ).asnumpy()
+    want = lhs.copy()
+    want[1:3, 1:4] = rhs
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    # scalar variant (alias _crop_assign_scalar)
+    out2 = nd._crop_assign_scalar(nd.array(lhs), begin=(0, 0), end=(2, 2), scalar=7.0).asnumpy()
+    want2 = lhs.copy()
+    want2[:2, :2] = 7.0
+    np.testing.assert_allclose(out2, want2, rtol=1e-6)
+    # NDArray sliced-set sugar path still matches
+    a = nd.array(lhs)
+    gout = nd._crop_assign(nd.array(lhs), nd.array(rhs), begin=(2, 0), end=(4, 3)).asnumpy()
+    want3 = lhs.copy()
+    want3[2:4, 0:3] = rhs
+    np.testing.assert_allclose(gout, want3, rtol=1e-6)
+
+
+def test_identity_with_attr_like_rhs_and_nogradient():
+    lhs = nd.array(rng.rand(3, 3).astype(np.float32))
+    rhs = nd.array(np.zeros((3, 3), np.float32))
+    out = nd._identity_with_attr_like_rhs(lhs, rhs)
+    np.testing.assert_allclose(out.asnumpy(), lhs.asnumpy(), rtol=1e-6)
+    # grad flows to lhs only
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    s = sym._identity_with_attr_like_rhs(a, b)
+    ex = s.simple_bind(ctx=mx.cpu(), a=(3, 3), b=(3, 3))
+    ex.forward(is_train=True)
+    ex.backward(nd.ones((3, 3)))
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), np.ones((3, 3)), rtol=1e-6)
+    np.testing.assert_allclose(ex.grad_dict["b"].asnumpy(), np.zeros((3, 3)), rtol=1e-6)
+    assert nd._NoGradient().asnumpy() == 0.0
+
+
+def test_cross_device_copy_identity():
+    x = nd.array(rng.rand(2, 2).astype(np.float32))
+    np.testing.assert_allclose(nd._CrossDeviceCopy(x).asnumpy(), x.asnumpy())

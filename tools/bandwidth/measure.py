@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""KVStore communication micro-benchmark (reference: tools/bandwidth/measure.py):
+time push+pull round-trips of model-sized gradients through a kvstore and
+report effective algorithm bandwidth per device.
+
+On TPU the `device` store rides ICI all-reduce (psum over the local mesh);
+`local` stages through host memory; `dist_*` adds the DCN/PS tier. The
+reported number is the classic allreduce algo-bandwidth: 2*(n-1)/n * bytes /
+time summed over keys.
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def get_shapes(network, num_classes):
+    from mxnet_tpu import models
+
+    builders = {
+        "resnet": lambda: models.resnet(num_classes=num_classes, num_layers=50,
+                                        image_shape="3,224,224"),
+        "alexnet": lambda: models.alexnet(num_classes=num_classes),
+        "vgg": lambda: models.vgg(num_classes=num_classes, num_layers=16),
+        "inception-bn": lambda: models.inception_bn(num_classes=num_classes),
+    }
+    net = builders[network]()
+    arg_shapes, _, _ = net.infer_shape(data=(32, 3, 224, 224))
+    names = net.list_arguments()
+    return [(n, s) for n, s in zip(names, arg_shapes)
+            if n not in ("data", "softmax_label")]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet")
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--num-devices", type=int, default=0,
+                    help="0 = all local devices")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--test-gradient-ratio", type=float, default=1.0,
+                    help="fraction of largest grads to test")
+    args = ap.parse_args()
+
+    kv = mx.kv.create(args.kv_store)
+    ndev = args.num_devices or max(mx.context.num_tpus(), 1)
+    devs = ([mx.tpu(i) for i in range(ndev)] if mx.context.num_tpus()
+            else [mx.cpu(i) for i in range(ndev)])
+
+    shapes = get_shapes(args.network, args.num_classes)
+    shapes.sort(key=lambda t: -int(np.prod(t[1])))
+    shapes = shapes[: max(1, int(len(shapes) * args.test_gradient_ratio))]
+    total_bytes = sum(int(np.prod(s)) * 4 for _, s in shapes)
+
+    grads = {}
+    for i, (name, shape) in enumerate(shapes):
+        kv.init(i, nd.zeros(shape))
+        grads[i] = [nd.array(np.ones(shape, np.float32)) for _ in devs]
+
+    # warmup
+    for i, (name, shape) in enumerate(shapes):
+        kv.push(i, grads[i])
+        kv.pull(i, grads[i])
+    for g in grads.values():
+        for a in g:
+            a.wait_to_read()
+
+    tic = time.time()
+    for _ in range(args.iters):
+        for i in range(len(shapes)):
+            kv.push(i, grads[i])
+            kv.pull(i, grads[i])
+        for g in grads.values():
+            for a in g:
+                a.wait_to_read()
+    elapsed = (time.time() - tic) / args.iters
+
+    n = len(devs)
+    algo_bw = 2 * (n - 1) / max(n, 1) * total_bytes / elapsed / 1e9
+    print("kvstore=%s devices=%d grads=%d bytes=%.1fMB time/iter=%.1fms algo-bw=%.2fGB/s"
+          % (args.kv_store, n, len(shapes), total_bytes / 1e6, elapsed * 1e3, algo_bw))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Kill stray distributed-training processes on this host
+(reference: tools/kill-mxnet.py — pkill of dangling PS/worker processes left
+by a crashed launch).
+
+Finds python processes whose environment/cmdline carry the DMLC_* launch
+contract (tools/launch.py) or that run a known trainer script, and SIGTERMs
+(then SIGKILLs) them. Never touches the calling process.
+"""
+import argparse
+import os
+import signal
+import time
+
+
+def find_procs(pattern):
+    me = os.getpid()
+    victims = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            with open("/proc/%s/cmdline" % pid, "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            with open("/proc/%s/environ" % pid, "rb") as f:
+                env = f.read().replace(b"\0", b" ").decode(errors="replace")
+        except (OSError, PermissionError):
+            continue
+        if "DMLC_ROLE" in env or (pattern and pattern in cmd):
+            victims.append((int(pid), cmd.strip()))
+    return victims
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("pattern", nargs="?", default="",
+                    help="extra cmdline substring to match (e.g. train_mnist.py)")
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+
+    victims = find_procs(args.pattern)
+    if not victims:
+        print("no matching processes")
+        return
+    for pid, cmd in victims:
+        print("kill %d: %s" % (pid, cmd[:100]))
+        if not args.dry_run:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+    if args.dry_run:
+        return
+    time.sleep(1.0)
+    for pid, _ in victims:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Parse training logs into a per-epoch table (reference: tools/parse_log.py).
+
+Consumes the logging format emitted by Module.fit / Speedometer:
+  Epoch[0] Batch [20]	Speed: 12345.67 samples/sec	accuracy=0.123456
+  Epoch[0] Train-accuracy=0.93
+  Epoch[0] Validation-accuracy=0.95
+  Epoch[0] Time cost=12.345
+"""
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    rows = {}
+    for line in lines:
+        m = re.search(r"Epoch\[(\d+)\] Train-([\w-]+)=([\d.eE+-]+)", line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["train-" + m.group(2)] = float(m.group(3))
+            continue
+        m = re.search(r"Epoch\[(\d+)\] Validation-([\w-]+)=([\d.eE+-]+)", line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["val-" + m.group(2)] = float(m.group(3))
+            continue
+        m = re.search(r"Epoch\[(\d+)\] Time cost=([\d.eE+-]+)", line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+            continue
+        m = re.search(r"Epoch\[(\d+)\] Batch \[(\d+)\]\s+Speed: ([\d.eE+-]+)", line)
+        if m:
+            r = rows.setdefault(int(m.group(1)), {})
+            r.setdefault("_speeds", []).append(float(m.group(3)))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile", nargs="?", default="-")
+    ap.add_argument("--format", default="markdown", choices=["markdown", "csv"])
+    args = ap.parse_args()
+    lines = sys.stdin if args.logfile == "-" else open(args.logfile)
+    rows = parse(lines)
+    cols = sorted({k for r in rows.values() for k in r if not k.startswith("_")})
+    cols = ["epoch"] + cols + ["speed"]
+    sep = "," if args.format == "csv" else " | "
+    print(sep.join(cols))
+    if args.format == "markdown":
+        print(sep.join("---" for _ in cols))
+    for e in sorted(rows):
+        r = rows[e]
+        speeds = r.get("_speeds", [])
+        speed = sum(speeds) / len(speeds) if speeds else float("nan")
+        vals = [str(e)] + ["%.6g" % r.get(c, float("nan")) for c in cols[1:-1]] + ["%.1f" % speed]
+        print(sep.join(vals))
+
+
+if __name__ == "__main__":
+    main()
