@@ -43,6 +43,9 @@ from . import rnn
 from . import visualization
 from . import visualization as viz
 from . import profiler
+from . import rtc
+from . import torch_bridge
+from . import torch_bridge as th
 from . import parallel
 from . import contrib
 from . import test_utils

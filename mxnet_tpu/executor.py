@@ -25,6 +25,8 @@ and written back after each training step, preserving FMutateInputs semantics.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from . import profiler as _profiler
@@ -257,6 +259,12 @@ class Executor:
         if self._jit_fwd_bwd is not None:
             return self._jit_fwd_bwd
         diff_idx = list(self._diff_idx)
+        # activation recompute (reference: MXNET_BACKWARD_DO_MIRROR,
+        # graph_executor.cc:213-226 — rebuild cheap activations in backward
+        # instead of keeping them): jax.checkpoint over the whole forward is
+        # the TPU analog; XLA rematerializes instead of storing residuals.
+        do_mirror = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0").strip().lower() not in (
+            "0", "", "false", "no", "off")
 
         def run(args, auxs, out_grads, rng):
             def f(diff_args):
@@ -265,6 +273,9 @@ class Executor:
                     full[i] = a
                 outs, new_aux = self._graph_fn(full, auxs, rng, True)
                 return outs, new_aux
+
+            if do_mirror:
+                f = jax.checkpoint(f)
 
             diff_args = [args[i] for i in diff_idx]
             outs, vjp_fn, new_aux = jax.vjp(f, diff_args, has_aux=True)

@@ -1,0 +1,84 @@
+"""Runtime-compiled custom kernels (reference: python/mxnet/rtc.py Rtc:7 +
+src/common/mxrtc.cc NVRTC compile :46-124, C API MXRtcCreate/MXRtcPush).
+
+The reference compiles CUDA C source at runtime with NVRTC and launches it on
+NDArrays. The TPU-native equivalent compiles a *kernel source string* with
+jax: the body is Python text over jax.numpy (``jnp``), jax.lax (``lax``) and
+optionally Pallas (``pl``/``pltpu``), jit-compiled at first push — the same
+write-a-kernel-in-a-python-string workflow, with XLA/Mosaic as the "RTC"
+backend instead of NVRTC.
+
+Example::
+
+    x = mx.nd.ones((10,))
+    y = mx.nd.zeros((10,))
+    rtc = mx.rtc.Rtc("mykernel", [("x", x)], [("y", y)], "y = x * 2 + 1")
+    rtc.push([x], [y], grid_dims=None, block_dims=None)
+
+The kernel body assigns each output name from the input names; it is executed
+with the named arrays in scope. ``grid_dims``/``block_dims`` are accepted for
+API compatibility and ignored — XLA owns the launch geometry on TPU.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["Rtc"]
+
+
+class Rtc:
+    def __init__(self, name, inputs, outputs, kernel):
+        self.name = name
+        self._input_names = [i[0] for i in inputs]
+        self._output_names = [o[0] for o in outputs]
+        if not self._output_names:
+            raise MXNetError("Rtc kernel needs at least one output")
+        self._source = kernel
+        self._compiled = None
+
+    def _compile(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        try:
+            from jax.experimental import pallas as pl  # noqa: F401
+            try:
+                from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+            except ImportError:  # pragma: no cover - platform-dependent
+                pltpu = None
+        except ImportError:  # pragma: no cover
+            pl = pltpu = None
+
+        src = "\n".join("    " + line for line in self._source.splitlines())
+        fn_src = "def __kernel__(%s):\n%s\n    return (%s)" % (
+            ", ".join(self._input_names), src or "    pass",
+            ", ".join(self._output_names) + ("," if len(self._output_names) == 1 else ""),
+        )
+        scope = {"jnp": jnp, "lax": lax, "jax": jax, "pl": pl, "pltpu": pltpu}
+        try:
+            exec(compile(fn_src, "<mx.rtc:%s>" % self.name, "exec"), scope)
+        except SyntaxError as e:
+            raise MXNetError("Rtc kernel '%s' failed to compile: %s" % (self.name, e)) from e
+        self._compiled = jax.jit(scope["__kernel__"])
+
+    def push(self, inputs, outputs, grid_dims=None, block_dims=None):
+        """Run the kernel (reference: rtc.py push → MXRtcPush). grid/block dims
+        are part of the reference signature; XLA chooses the schedule here."""
+        from . import ndarray as nd
+
+        if len(inputs) != len(self._input_names) or len(outputs) != len(self._output_names):
+            raise MXNetError(
+                "Rtc kernel '%s' expects %d inputs / %d outputs, got %d / %d"
+                % (self.name, len(self._input_names), len(self._output_names),
+                   len(inputs), len(outputs)))
+        if self._compiled is None:
+            self._compile()
+        args = [a.data if isinstance(a, nd.NDArray) else a for a in inputs]
+        try:
+            outs = self._compiled(*args)
+        except Exception as e:  # surface tracing errors with the kernel name
+            raise MXNetError("Rtc kernel '%s' failed: %s" % (self.name, e)) from e
+        for dst, val in zip(outputs, outs):
+            dst._set_data(val.astype(dst.dtype))
+        return outputs

@@ -1,0 +1,100 @@
+"""Torch interop (reference: python/mxnet/torch.py + plugin/torch — TorchModule/
+TorchCriterion ops bridging Torch tensors/modules into the NDArray runtime).
+
+The reference embeds LuaJIT Torch; here the bridge targets PyTorch (present in
+the environment, CPU build). Transfers stage through host numpy copies — the
+device buffer is fetched, so round-trips are not free:
+
+* ``to_torch(nd_arr)`` / ``from_torch(tensor)`` — NDArray ↔ torch.Tensor;
+* ``function(torch_fn)`` — wrap any torch callable into an NDArray function
+  (the analog of the generated ``mx.th.*`` functions);
+* ``TorchModule`` — run a ``torch.nn.Module`` forward as an NDArray op, the
+  analog of plugin/torch's TorchModule operator. Backward runs through
+  torch.autograd, so a torch module can be used as a fixed feature extractor
+  or fine-tuned with gradients flowing back into MXNet arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+
+__all__ = ["to_torch", "from_torch", "function", "TorchModule"]
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("torch is not available in this environment") from e
+    return torch
+
+
+def to_torch(arr):
+    """NDArray → torch.Tensor (host copy; the TPU buffer is fetched)."""
+    torch = _torch()
+    # copy: asnumpy() may return a read-only view of the device buffer
+    return torch.from_numpy(np.array(arr.asnumpy()))
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor → NDArray on ctx (default current context)."""
+    return nd.array(tensor.detach().cpu().numpy(), ctx=ctx)
+
+
+def function(torch_fn):
+    """Wrap a torch callable into an NDArray→NDArray function."""
+
+    def wrapped(*args, **kwargs):
+        targs = [to_torch(a) if isinstance(a, nd.NDArray) else a for a in args]
+        tkwargs = {k: to_torch(v) if isinstance(v, nd.NDArray) else v
+                   for k, v in kwargs.items()}
+        out = torch_fn(*targs, **tkwargs)
+        torch = _torch()
+        if isinstance(out, (list, tuple)):
+            return [from_torch(o) if isinstance(o, torch.Tensor) else o for o in out]
+        return from_torch(out) if isinstance(out, torch.Tensor) else out
+
+    wrapped.__name__ = getattr(torch_fn, "__name__", "torch_fn")
+    return wrapped
+
+
+class TorchModule:
+    """Run a torch.nn.Module on NDArrays with optional backward.
+
+    forward(x) -> NDArray; backward(out_grad) -> input gradient NDArray.
+    Parameters stay inside the torch module; step(lr) applies a plain SGD
+    update to them (enough for the plugin's fine-tuning use case).
+    """
+
+    def __init__(self, module):
+        self.module = module
+        self._last = None
+
+    def forward(self, x, is_train=False):
+        torch = _torch()
+        tx = to_torch(x)
+        if is_train:
+            tx = tx.clone().requires_grad_(True)
+            out = self.module(tx)
+            self._last = (tx, out)
+            return from_torch(out)
+        self._last = None  # an eval forward invalidates any pending backward
+        with torch.no_grad():
+            return from_torch(self.module(tx))
+
+    def backward(self, out_grad):
+        if self._last is None:
+            raise RuntimeError("backward before forward(is_train=True)")
+        tx, out = self._last
+        out.backward(to_torch(out_grad))
+        self._last = None
+        return from_torch(tx.grad)
+
+    def step(self, lr):
+        torch = _torch()
+        with torch.no_grad():
+            for p in self.module.parameters():
+                if p.grad is not None:
+                    p -= lr * p.grad
+                    p.grad.zero_()

@@ -231,3 +231,70 @@ def test_infer_type():
     arg_types, out_types, _ = net.infer_type(data=np.float32)
     assert all(t == np.float32 for t in arg_types)
     assert out_types[0] == np.float32
+
+
+def test_rtc_kernel():
+    """mx.rtc: runtime-compiled kernels (reference: rtc.py Rtc + mxrtc.cc)."""
+    from mxnet_tpu import ndarray as nd
+
+    x = nd.ones((10,))
+    y = nd.zeros((10,))
+    r = mx.rtc.Rtc("mykernel", [("x", x)], [("y", y)], "y = x * 2 + 1")
+    r.push([x], [y])
+    np.testing.assert_allclose(y.asnumpy(), np.full(10, 3.0))
+    # multi-statement body with jnp in scope
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = nd.zeros((3, 2))
+    r2 = mx.rtc.Rtc("t", [("a", a)], [("out", out)], "tmp = jnp.transpose(a)\nout = tmp + 1")
+    r2.push([a], [out])
+    np.testing.assert_allclose(out.asnumpy(), np.arange(6).reshape(2, 3).T + 1)
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.Rtc("bad", [("x", x)], [("y", y)], "y = (").push([x], [y])
+
+
+def test_torch_bridge():
+    """Torch interop (reference: python/mxnet/torch.py + plugin/torch)."""
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu import ndarray as nd
+
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    t = mx.th.to_torch(a)
+    assert isinstance(t, torch.Tensor) and t.shape == (3, 4)
+    back = mx.th.from_torch(t)
+    np.testing.assert_allclose(back.asnumpy(), a.asnumpy())
+
+    f = mx.th.function(torch.sigmoid)
+    np.testing.assert_allclose(f(nd.zeros((2,))).asnumpy(), [0.5, 0.5])
+
+    lin = torch.nn.Linear(4, 2)
+    tm = mx.th.TorchModule(lin)
+    out = tm.forward(a, is_train=True)
+    assert out.shape == (3, 2)
+    g = tm.backward(nd.ones((3, 2)))
+    assert g.shape == (3, 4)
+    # grads accumulated on torch params; step applies SGD
+    w0 = lin.weight.detach().clone()
+    tm.step(0.1)
+    assert not torch.equal(w0, lin.weight)
+
+
+def test_backward_do_mirror_same_grads(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR must not change numerics (only memory/compute)."""
+    from mxnet_tpu import ndarray as nd
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng_ = np.random.RandomState(7)
+    vals = {}
+    grads = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", flag)
+        ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+        for n, arr in ex.arg_dict.items():
+            vals.setdefault(n, rng_.rand(*arr.shape).astype(np.float32))
+            arr[:] = vals[n]
+        ex.forward(is_train=True)
+        ex.backward()
+        grads[flag] = ex.grad_dict["fc_weight"].asnumpy()
+    np.testing.assert_allclose(grads["1"], grads["0"], rtol=1e-5)
