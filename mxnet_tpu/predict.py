@@ -123,3 +123,42 @@ class Predictor:
         self._input_shapes.update(input_shapes)
         self._bind()
         return self
+
+
+# ---- C-shim helpers (consumed by src/c_predict_api.cc via the embedded
+# interpreter; byte-oriented so the C side never touches numpy) -------------
+def _c_create(symbol_json, param_bytes, input_names, input_shapes, output_names=None):
+    shapes = {n: tuple(s) for n, s in zip(input_names, input_shapes)}
+    return Predictor(symbol_json, bytes(param_bytes), input_shapes=shapes,
+                     output_names=list(output_names) if output_names else None)
+
+
+def _c_forward(pred):
+    pred.forward()
+
+
+def _c_output_shape(pred, index):
+    # shape only — no device fetch (the C API calls this before every read)
+    if pred._outputs is None:
+        raise MXNetError("call forward() first")
+    return list(pred._outputs[index].shape)
+
+
+def _c_get_output(pred, index):
+    out = np.ascontiguousarray(pred.get_output(index), dtype=np.float32)
+    return out.tobytes()
+
+
+def _c_ndlist(blob):
+    d = load_ndarray_file(bytes(blob))
+    names = list(d.keys())
+    return names, [np.ascontiguousarray(d[n].asnumpy(), np.float32).tobytes() for n in names], [
+        list(d[n].shape) for n in names]
+
+
+def _c_set_input_flat(pred, name, data_bytes):
+    if name not in pred._exe.arg_dict:
+        raise MXNetError("unknown input %s" % name)
+    shape = pred._exe.arg_dict[name].shape
+    arr = np.frombuffer(bytes(data_bytes), dtype=np.float32).reshape(shape)
+    pred.set_input(name, arr)

@@ -79,6 +79,11 @@ class Rtc:
             outs = self._compiled(*args)
         except Exception as e:  # surface tracing errors with the kernel name
             raise MXNetError("Rtc kernel '%s' failed: %s" % (self.name, e)) from e
-        for dst, val in zip(outputs, outs):
+        for name, dst, val in zip(self._output_names, outputs, outs):
+            if tuple(val.shape) != tuple(dst.shape):
+                raise MXNetError(
+                    "Rtc kernel '%s' output '%s' computed shape %s but the "
+                    "bound array is %s" % (self.name, name, tuple(val.shape),
+                                           tuple(dst.shape)))
             dst._set_data(val.astype(dst.dtype))
         return outputs

@@ -1,0 +1,96 @@
+"""C predict API tests: a compiled C client drives libmxtpu_predict.so
+(reference: c_predict_api.cc + amalgamation's C predict clients;
+tests mirror tests/python/predict/ usage).
+
+Requires g++ and python3-config (both baked into the image); skipped if the
+shim can't build.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(ROOT, "mxnet_tpu", "src")
+
+needs_toolchain = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("python3-config") is None,
+    reason="no C++ toolchain")
+
+
+def _build_shim():
+    r = subprocess.run(["make", "c_predict"], cwd=SRC, capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("predict shim build failed: %s" % r.stderr[-500:])
+    return os.path.join(SRC, "build", "libmxtpu_predict.so")
+
+
+CLIENT_CPP = r"""
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include "mxnet_predict.hpp"
+static std::string slurp(const char* p) {
+  std::ifstream f(p, std::ios::binary);
+  std::ostringstream ss; ss << f.rdbuf(); return ss.str();
+}
+int main(int argc, char** argv) {
+  (void)argc;
+  mxtpu::Predictor pred(slurp(argv[1]), slurp(argv[2]), {{"data", {1, 8}}});
+  std::vector<float> in(8);
+  for (int i = 0; i < 8; ++i) in[i] = i / 8.0f;
+  pred.SetInput("data", in.data(), in.size());
+  pred.Forward();
+  auto out = pred.GetOutput(0);
+  float sum = 0;
+  for (float v : out) sum += v;
+  mxtpu::NDList params(slurp(argv[2]));
+  std::cout << "OUT " << out.size() << " " << sum << " " << params.size()
+            << std::endl;
+  return (sum > 0.99f && sum < 1.01f) ? 0 : 1;
+}
+"""
+
+
+@needs_toolchain
+def test_c_predict_client(tmp_path):
+    import mxnet_tpu as mx
+
+    lib = _build_shim()
+    # train + checkpoint a tiny net
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=16), num_epoch=2,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.3},
+            initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+
+    src = tmp_path / "client.cpp"
+    src.write_text(CLIENT_CPP)
+    exe = str(tmp_path / "client")
+    r = subprocess.run(
+        ["g++", "-std=c++17", "-I", os.path.join(SRC, "include"), str(src),
+         "-o", exe, "-L", os.path.dirname(lib), "-lmxtpu_predict",
+         "-Wl,-rpath," + os.path.dirname(lib)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [exe, prefix + "-symbol.json", prefix + "-0001.params"],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    parts = r.stdout.split()
+    assert parts[0] == "OUT" and parts[1] == "2" and parts[3] == "4"
