@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# CI entry point (reference: Jenkinsfile + tests/ci_build/ci_build.sh — the
+# docker-matrix build/test driver). One stage per reference CI axis:
+#   unit      python unit tests on the virtual 8-device CPU mesh
+#   native    C++ runtime build + native-path tests
+#   predict   C predict shim build + compiled-client test
+#   entry     driver contract: graft entry compile + multichip dryrun
+#   bench     (opt-in, needs a TPU) headline benchmark
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+stage="${1:-all}"
+
+run_unit() {
+  # the native/predict suites run in their own stages under `all`
+  python -m pytest tests/ -x -q "$@"
+}
+
+run_native() {
+  make -C mxnet_tpu/src
+  python -m pytest tests/test_native.py tests/test_kvstore_dist.py -x -q
+}
+
+run_predict() {
+  make -C mxnet_tpu/src c_predict
+  python -m pytest tests/test_c_predict.py -x -q
+}
+
+run_entry() {
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -c "import __graft_entry__ as g; g.entry(); g.dryrun_multichip(8); print('entry ok')"
+}
+
+run_bench() {
+  python bench.py
+}
+
+case "$stage" in
+  unit) run_unit ;;
+  native) run_native ;;
+  predict) run_predict ;;
+  entry) run_entry ;;
+  bench) run_bench ;;
+  all) run_native; run_predict; run_entry;
+       run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
+                --ignore=tests/test_c_predict.py ;;
+  *) echo "unknown stage: $stage (unit|native|predict|entry|bench|all)"; exit 2 ;;
+esac

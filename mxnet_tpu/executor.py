@@ -285,6 +285,22 @@ class Executor:
         self._jit_fwd_bwd = jax.jit(run)
         return self._jit_fwd_bwd
 
+    def memory_analysis(self):
+        """XLA's compile-time memory analysis of the fused fwd+bwd program
+        (temp/argument/output bytes). The observability hook behind
+        examples/memcost.py — device live-stats are not exposed on tunneled
+        transports, but the compiler's plan is exact for a static graph."""
+        import jax
+
+        # abstract out-grads and a fixed key: lowering only needs shapes, and
+        # consuming the training rng stream here would shift later steps'
+        # randomness (an observability call must not perturb training)
+        ogs = [jax.ShapeDtypeStruct(tuple(sd.shape), sd.dtype)
+               for sd in self._eval_out_shapes(self._arg_data, self._aux_data)]
+        rng = self._rng_base  # fixed key, not _next_rng(): don't advance _step
+        lowered = self._build_fwd_bwd().lower(self._arg_data, self._aux_data, ogs, rng)
+        return lowered.compile().memory_analysis()
+
     def backward(self, out_grads=None):
         """Backward pass (reference: executor.py:143 → GraphExecutor::Backward).
 

@@ -212,6 +212,19 @@ class KVStore:
                 jax.experimental.multihost_utils.sync_global_devices("kvstore_barrier")
             )
 
+    def get_num_dead_node(self, node_id=0, timeout=120):
+        """Count unreachable cluster nodes (reference: kvstore_dist.h:159-168
+        get_num_dead_node via ps-lite liveness; C API MXKVStoreGetNumDeadNode).
+        Single-process stores have no peers to lose."""
+        return 0
+
+    @property
+    def is_recovery(self):
+        """Whether this process is restarting into an existing job (reference:
+        ps::Postoffice::is_recovery(), used to skip the init barrier on
+        restart, kvstore_dist.h:39-42). Set DMLC_PS_RECOVERY=1 on relaunch."""
+        return os.environ.get("DMLC_PS_RECOVERY", "0") not in ("0", "")
+
     def save_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot save states for distributed training"
         with open(fname, "wb") as fout:
@@ -378,6 +391,19 @@ class KVStoreDist(KVStore):
     def barrier(self):
         self._engine.wait_all()
         self._lib.mxt_ps_client_barrier(self._clients[0])
+
+    def get_num_dead_node(self, node_id=0, timeout=120):
+        """Probe each PS server with a deadline-bounded command round-trip;
+        unreachable OR unresponsive servers count as dead (reference:
+        kvstore_dist.h:159-168 — ps-lite liveness over the server group;
+        workers don't track each other here either)."""
+        del node_id  # kept for API parity; all servers are probed
+        timeout_ms = max(int(timeout * 1000), 1)
+        dead = 0
+        for c in self._clients:
+            if self._lib.mxt_ps_client_probe(c, b"ping", timeout_ms) != 0:
+                dead += 1
+        return dead
 
     def _stop_servers(self):
         """Shut down server processes (rank 0, exit path)."""

@@ -394,8 +394,11 @@ def _batch_norm(octx, attrs, args, auxs):
     if attrs["fix_gamma"]:
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
     if octx.is_train and not attrs["use_global_stats"]:
-        mean = jnp.mean(x, axis=red)
-        var = jnp.var(x, axis=red)
+        # stats accumulate in fp32 even when the graph runs bf16 — bf16
+        # reduction over N*H*W elements loses too many mantissa bits
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=red).astype(x.dtype)
+        var = jnp.var(xf, axis=red).astype(x.dtype)
         m = attrs["momentum"]
         new_mean = mmean * m + jax.lax.stop_gradient(mean) * (1 - m)
         new_var = mvar * m + jax.lax.stop_gradient(var) * (1 - m)

@@ -138,10 +138,13 @@ def _c_forward(pred):
 
 
 def _c_output_shape(pred, index):
-    # shape only — no device fetch (the C API calls this before every read)
-    if pred._outputs is None:
-        raise MXNetError("call forward() first")
-    return list(pred._outputs[index].shape)
+    # shape only — no device fetch, and valid right after create (reference:
+    # MXPredGetOutputShape works before the first forward so clients can size
+    # their output buffers)
+    if pred._outputs is not None:
+        return list(pred._outputs[index].shape)
+    _, out_shapes, _ = pred.symbol.infer_shape(**pred._input_shapes)
+    return list(out_shapes[index])
 
 
 def _c_get_output(pred, index):

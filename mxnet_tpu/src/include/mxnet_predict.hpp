@@ -81,6 +81,12 @@ class NDList {
     Check(MXNDListCreate(blob.data(), static_cast<int>(blob.size()), &handle_,
                          &size_));
   }
+  NDList(const NDList&) = delete;
+  NDList& operator=(const NDList&) = delete;
+  NDList(NDList&& o) noexcept : handle_(o.handle_), size_(o.size_) {
+    o.handle_ = nullptr;
+    o.size_ = 0;
+  }
   ~NDList() {
     if (handle_) MXNDListFree(handle_);
   }

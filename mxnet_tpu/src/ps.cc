@@ -26,6 +26,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -441,6 +442,31 @@ class PSClient {
     return Await(&p) >= 0;
   }
 
+  // Liveness probe: a command round-trip with a deadline. A wedged server
+  // (socket open, not responding) must yield false, not a hang — the one
+  // case get_num_dead_node exists for (reference: ps-lite heartbeats).
+  bool CommandTimeout(const char* cmd, int timeout_ms) {
+    Pending p;
+    uint64_t id = 0;
+    if (!Send(kCommand, 0, &p, cmd, strlen(cmd), &id)) return false;
+    std::unique_lock<std::mutex> lk(p.mu);
+    if (p.cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                      [&] { return p.done; }))
+      return p.result >= 0;
+    lk.unlock();
+    bool removed;
+    {
+      std::unique_lock<std::mutex> plk(pmu_);
+      removed = pending_.erase(id) > 0;
+    }
+    lk.lock();
+    if (removed) return false;  // reader never saw a response; p is ours again
+    // the reader popped p and is mid-fill: the response arrived, wait for the
+    // signal (prompt — payload for command responses is empty)
+    p.cv.wait(lk, [&] { return p.done; });
+    return p.result >= 0;
+  }
+
   bool Stop() {
     Pending p;
     if (!Send(kStop, 0, &p, nullptr, 0)) return false;
@@ -460,7 +486,7 @@ class PSClient {
   };
 
   bool Send(uint32_t type, int key, Pending* p, const void* payload,
-            uint64_t nbytes) {
+            uint64_t nbytes, uint64_t* out_id = nullptr) {
     if (fd_ < 0) return false;
     uint64_t id;
     {
@@ -469,6 +495,7 @@ class PSClient {
       id = next_id_++;
       pending_[id] = p;
     }
+    if (out_id) *out_id = id;
     MsgHeader h{type, key, id, nbytes};
     std::unique_lock<std::mutex> lk(wmu_);
     if (!WriteAll(fd_, &h, sizeof(h)) ||
@@ -596,6 +623,9 @@ int mxt_ps_client_barrier(void* h) {
 }
 int mxt_ps_client_command(void* h, const char* cmd) {
   return static_cast<mxt::PSClient*>(h)->Command(cmd) ? 0 : -1;
+}
+int mxt_ps_client_probe(void* h, const char* cmd, int timeout_ms) {
+  return static_cast<mxt::PSClient*>(h)->CommandTimeout(cmd, timeout_ms) ? 0 : -1;
 }
 int mxt_ps_client_stop(void* h) {
   return static_cast<mxt::PSClient*>(h)->Stop() ? 0 : -1;

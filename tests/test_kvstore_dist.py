@@ -202,3 +202,38 @@ def test_dist_sync_module_fit():
     assert abs(sigs["0"] - sigs["1"]) < 1e-3, sigs
     # training actually learned something
     assert min(scores.values()) > 0.75, scores
+
+
+WORKER_LIVENESS = r"""
+import os
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_sync")
+rank = kv.rank
+kv.init(0, mx.nd.ones((4,)))
+assert kv.get_num_dead_node() == 0, "server should be alive"
+assert not kv.is_recovery
+kv.barrier()
+if rank == 0:
+    kv._stop_servers()
+    import time
+    time.sleep(0.5)
+    # after stop, the probe must report the server dead
+    assert kv.get_num_dead_node() >= 1, "stopped server still reported alive"
+print("WORKER_OK", rank)
+"""
+
+
+@needs_native
+def test_dist_dead_node_detection():
+    """Liveness probing (reference: kvstore_dist.h:159-168 get_num_dead_node)."""
+    _run_cluster(WORKER_LIVENESS)
+
+
+def test_local_kvstore_liveness_api():
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("local")
+    assert kv.get_num_dead_node() == 0
+    assert kv.is_recovery in (True, False)
