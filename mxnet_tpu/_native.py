@@ -93,6 +93,8 @@ def _declare(lib):
     lib.mxt_ps_client_command.argtypes = [c.c_void_p, c.c_char_p]
     lib.mxt_ps_client_probe.restype = c.c_int
     lib.mxt_ps_client_probe.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.mxt_ps_probe.restype = c.c_int
+    lib.mxt_ps_probe.argtypes = [c.c_char_p, c.c_int, c.c_int]
     lib.mxt_ps_client_stop.restype = c.c_int
     lib.mxt_ps_client_stop.argtypes = [c.c_void_p]
     lib.mxt_ps_client_destroy.argtypes = [c.c_void_p]

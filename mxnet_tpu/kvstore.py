@@ -223,7 +223,8 @@ class KVStore:
         """Whether this process is restarting into an existing job (reference:
         ps::Postoffice::is_recovery(), used to skip the init barrier on
         restart, kvstore_dist.h:39-42). Set DMLC_PS_RECOVERY=1 on relaunch."""
-        return os.environ.get("DMLC_PS_RECOVERY", "0") not in ("0", "")
+        return os.environ.get("DMLC_PS_RECOVERY", "0").strip().lower() not in (
+            "0", "", "false", "no", "off")
 
     def save_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot save states for distributed training"
@@ -263,6 +264,8 @@ class KVStoreDist(KVStore):
             raise MXNetError("dist kvstore needs the native runtime (libmxtpu)")
         host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        self._server_addrs = [(host, port + s)
+                              for s in range(int(os.environ.get("DMLC_NUM_SERVER", "1")))]
         self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
         self._nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
         self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
@@ -393,17 +396,28 @@ class KVStoreDist(KVStore):
         self._lib.mxt_ps_client_barrier(self._clients[0])
 
     def get_num_dead_node(self, node_id=0, timeout=120):
-        """Probe each PS server with a deadline-bounded command round-trip;
-        unreachable OR unresponsive servers count as dead (reference:
+        """Probe each PS server on a FRESH deadline-bounded connection —
+        concurrently, so N wedged servers cost one timeout, not N (reference:
         kvstore_dist.h:159-168 — ps-lite liveness over the server group;
-        workers don't track each other here either)."""
+        workers don't track each other here either). A fresh socket also
+        can't block behind an in-flight bulk push on the shared client
+        connection."""
+        import threading
+
         del node_id  # kept for API parity; all servers are probed
         timeout_ms = max(int(timeout * 1000), 1)
-        dead = 0
-        for c in self._clients:
-            if self._lib.mxt_ps_client_probe(c, b"ping", timeout_ms) != 0:
-                dead += 1
-        return dead
+        results = [0] * len(self._server_addrs)
+
+        def probe(i, host, port):
+            results[i] = self._lib.mxt_ps_probe(host.encode(), port, timeout_ms)
+
+        threads = [threading.Thread(target=probe, args=(i, h, p), daemon=True)
+                   for i, (h, p) in enumerate(self._server_addrs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout + 5)
+        return sum(1 for r in results if r != 0)
 
     def _stop_servers(self):
         """Shut down server processes (rank 0, exit path)."""

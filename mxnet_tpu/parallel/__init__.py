@@ -8,5 +8,7 @@ multi-device Comm (src/kvstore/comm.h) and ps-lite distributed tier.
 * ring.py — ring attention (sequence parallelism) over ppermute.
 """
 from .mesh import build_mesh, local_mesh  # noqa: F401
+from .moe import moe_ffn  # noqa: F401
+from .pipeline import pipeline_apply  # noqa: F401
 from .ring import ring_attention, ulysses_attention  # noqa: F401
 from .spmd import SPMDTrainer  # noqa: F401

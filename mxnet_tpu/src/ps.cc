@@ -22,6 +22,8 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <fcntl.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -626,6 +628,76 @@ int mxt_ps_client_command(void* h, const char* cmd) {
 }
 int mxt_ps_client_probe(void* h, const char* cmd, int timeout_ms) {
   return static_cast<mxt::PSClient*>(h)->CommandTimeout(cmd, timeout_ms) ? 0 : -1;
+}
+
+// Standalone liveness probe on a FRESH connection with a deadline on every
+// phase (connect, send, receive). Unlike client_probe it cannot block on the
+// shared client socket's write mutex when a bulk Push has wedged — the
+// failure mode a liveness check exists to detect.
+int mxt_ps_probe(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc != 0 && errno != EINPROGRESS) {
+    ::close(fd);
+    return -1;
+  }
+  auto wait_io = [&](short events) {
+    pollfd p{fd, events, 0};
+    return ::poll(&p, 1, timeout_ms) == 1 && !(p.revents & (POLLERR | POLLHUP));
+  };
+  if (rc != 0) {
+    if (!wait_io(POLLOUT)) {
+      ::close(fd);
+      return -1;
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err != 0) {
+      ::close(fd);
+      return -1;
+    }
+  }
+  const char ping[] = "ping";
+  mxt::MsgHeader h{mxt::kCommand, 0, 1, sizeof(ping) - 1};
+  char buf[sizeof(h) + sizeof(ping) - 1];
+  memcpy(buf, &h, sizeof(h));
+  memcpy(buf + sizeof(h), ping, sizeof(ping) - 1);
+  size_t sent = 0;
+  while (sent < sizeof(buf)) {
+    if (!wait_io(POLLOUT)) {
+      ::close(fd);
+      return -1;
+    }
+    ssize_t n = ::send(fd, buf + sent, sizeof(buf) - sent, MSG_NOSIGNAL);
+    if (n <= 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+      ::close(fd);
+      return -1;
+    }
+    if (n > 0) sent += static_cast<size_t>(n);
+  }
+  mxt::MsgHeader resp;
+  size_t got = 0;
+  while (got < sizeof(resp)) {
+    if (!wait_io(POLLIN)) {
+      ::close(fd);
+      return -1;
+    }
+    ssize_t n = ::recv(fd, reinterpret_cast<char*>(&resp) + got,
+                       sizeof(resp) - got, 0);
+    if (n == 0 || (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
+      ::close(fd);  // n == 0: peer closed before responding
+      return -1;
+    }
+    if (n > 0) got += static_cast<size_t>(n);
+  }
+  ::close(fd);
+  return resp.type == mxt::kResp ? 0 : -1;
 }
 int mxt_ps_client_stop(void* h) {
   return static_cast<mxt::PSClient*>(h)->Stop() ? 0 : -1;
