@@ -17,4 +17,5 @@ from .vgg import get_symbol as vgg
 from .resnet import get_symbol as resnet
 from .inception_bn import get_symbol as inception_bn
 from .lstm_lm import get_symbol as lstm_lm
+from .transformer_lm import get_symbol as transformer_lm
 from .dcgan import make_generator, make_discriminator

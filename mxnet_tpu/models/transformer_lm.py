@@ -1,0 +1,57 @@
+"""Decoder-only Transformer language model.
+
+Not in the reference zoo (its LM story is LSTM + bucketing, example/rnn) — this
+is the long-context flagship for the TPU build: causal flash attention via the
+``_contrib_MultiHeadAttention`` fused block (Pallas kernel on TPU,
+ops/attention.py), pre-norm residual blocks, and a weight layout that shards
+cleanly over a dp×tp mesh (qkv/out projections and the FFN are
+FullyConnected-shaped, so SPMDTrainer param_rules like ``{r".*_ffn1_weight":
+("tp", None)}`` apply). For sequences beyond one chip's memory, the same
+attention math lowers to ring attention over an sp axis (parallel/ring.py).
+"""
+from .. import symbol as sym
+from ..initializer import Normal, One, Zero
+
+
+def _layer_norm(x, model_dim, name):
+    # composed from reference-era primitives (no LayerNorm op in v0.10)
+    mean = sym.mean(x, axis=-1, keepdims=True)
+    var = sym.mean(sym.square(sym.broadcast_minus(x, mean)), axis=-1, keepdims=True)
+    xhat = sym.broadcast_div(sym.broadcast_minus(x, mean), sym.sqrt(var + 1e-5))
+    g = sym.Variable(name + "_gamma", shape=(1, 1, model_dim), init=One())
+    b = sym.Variable(name + "_beta", shape=(1, 1, model_dim), init=Zero())
+    return sym.broadcast_add(sym.broadcast_mul(xhat, g), b)
+
+
+def block(x, num_heads, model_dim, ffn_dim, seq_len, name):
+    h = _layer_norm(x, model_dim, name + "_ln1")
+    w_in = sym.Variable(name + "_attn_in_weight")
+    w_out = sym.Variable(name + "_attn_out_weight")
+    attn = sym.contrib.MultiHeadAttention(
+        h, w_in, w_out, num_heads=num_heads, causal=True, name=name + "_attn")
+    x = x + attn
+    h = _layer_norm(x, model_dim, name + "_ln2")
+    f = sym.FullyConnected(sym.Reshape(h, shape=(-1, model_dim)),
+                           num_hidden=ffn_dim, name=name + "_ffn1")
+    f = sym.Activation(f, act_type="relu", name=name + "_relu")
+    f = sym.FullyConnected(f, num_hidden=model_dim, name=name + "_ffn2")
+    f = sym.Reshape(f, shape=(-1, seq_len, model_dim))
+    return x + f
+
+
+def get_symbol(vocab_size=32000, num_layers=4, model_dim=256, num_heads=4,
+               ffn_dim=1024, seq_len=128, **kwargs):
+    data = sym.Variable("data")  # (batch, seq) float token ids
+    label = sym.Variable("softmax_label")
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=model_dim,
+                      name="embed")
+    pos = sym.Variable("pos_embed_weight", shape=(1, seq_len, model_dim),
+                       init=Normal(0.02))
+    x = sym.broadcast_add(x, pos)
+    for i in range(num_layers):
+        x = block(x, num_heads, model_dim, ffn_dim, seq_len, "layer%d" % i)
+    x = _layer_norm(x, model_dim, "final_ln")
+    logits = sym.FullyConnected(sym.Reshape(x, shape=(-1, model_dim)),
+                                num_hidden=vocab_size, name="lm_head")
+    return sym.SoftmaxOutput(logits, label=sym.Reshape(label, shape=(-1,)),
+                             name="softmax")

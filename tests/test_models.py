@@ -140,3 +140,22 @@ def test_ssd_shapes():
     neti = ssd.get_symbol(num_classes=20)
     _, out_shapes, _ = neti.infer_shape(data=(1, 3, 300, 300))
     assert out_shapes[0] == (1, 8732, 6)
+
+
+def test_transformer_lm_learns_previous_token_task():
+    """Predict the PREVIOUS token: solvable only through the causal attention
+    path (position t must read position t-1), so a broken MHA block cannot be
+    compensated by the embedding->FFN residual stream."""
+    V, T, B = 16, 8, 16
+    net = models.transformer_lm(vocab_size=V, num_layers=1, model_dim=32,
+                                num_heads=2, ffn_dim=64, seq_len=T)
+    rng_ = np.random.RandomState(0)
+    X = rng_.randint(1, V, (64, T)).astype(np.float32)
+    Y = np.concatenate([np.zeros((64, 1), np.float32), X[:, :-1]], axis=1)
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(X, Y, batch_size=B)
+    mod.fit(it, num_epoch=25, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-2},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    score = mod.score(it, mx.metric.Accuracy())[0][1]
+    assert score > 0.85, score
