@@ -93,17 +93,57 @@ def build_graph_fn(symbol):
     return graph_fn, arg_names, aux_names
 
 
+# ops whose listed inputs carry integer ids; bf16 holds integers exactly only
+# up to 256, so casting these under compute_dtype silently merges ids — they
+# are auto-exempted from the mixed-precision downcast
+_INDEX_ARG_POSITIONS = {
+    "Embedding": (0,),
+    "take": (1,),
+    "batch_take": (1,),
+    "one_hot": (0,),
+    "gather_nd": (1,),
+    "scatter_nd": (1,),
+    "pick": (1,),
+    "choose_element_0index": (1,),
+    "fill_element_0index": (1,),
+}
+
+
+def _index_like_inputs(symbol):
+    """Names of Variable inputs that feed an index argument of any op."""
+    from .symbol import _topo_order
+
+    exempt = set()
+    for node in _topo_order(symbol._entries):
+        if node.is_variable:
+            continue
+        for pos in _INDEX_ARG_POSITIONS.get(node.op, ()):
+            if pos < len(node.inputs):
+                inp, _ = node.inputs[pos]
+                if inp.is_variable:
+                    exempt.add(inp.name)
+    return exempt
+
+
 class Executor:
     """A bound, compiled computation graph."""
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None, group2ctx=None, shared_exec=None):
+                 aux_states=None, group2ctx=None, shared_exec=None,
+                 compute_dtype=None, cast_exempt=()):
         from . import ndarray as nd
 
         self._symbol = symbol
         self._ctx = ctx
         self._group2ctx = group2ctx  # placement hints; compute is SPMD-scheduled by XLA
         self.monitor_callback = None
+        # mixed precision (the TPU-native form of the reference's fp16 symbols,
+        # e.g. resnet_fp16.py's per-weight Casts): float32 args are cast to
+        # compute_dtype inside the jitted graph — master copies stay fp32, and
+        # backward() casts grads back, so optimizer updates run fp32.
+        # cast_exempt names (labels, index-like inputs) keep their dtype.
+        self._compute_dtype = np.dtype(compute_dtype) if compute_dtype else None
+        self._cast_exempt = frozenset(cast_exempt) | _index_like_inputs(symbol)
 
         self._graph_fn, self._arg_names, self._aux_names = build_graph_fn(symbol)
 
@@ -212,6 +252,18 @@ class Executor:
             self._outputs_cache = self._run_forward(False, rng)
         return self.outputs
 
+    def _cast_compute(self, arg_list):
+        """Inside-jit downcast of float32 args to the compute dtype."""
+        if self._compute_dtype is None:
+            return arg_list
+        cd = self._compute_dtype
+        return [
+            a.astype(cd)
+            if (name not in self._cast_exempt and a.dtype == np.float32)
+            else a
+            for name, a in zip(self._arg_names, arg_list)
+        ]
+
     def _get_jit_fwd(self, is_train):
         import jax
 
@@ -219,7 +271,10 @@ class Executor:
         if fn is None:
 
             def run(args, auxs, rng):
-                return self._graph_fn(args, auxs, rng, is_train)
+                outs, new_aux = self._graph_fn(self._cast_compute(args), auxs, rng, is_train)
+                # aux states (BN moving stats) keep their master dtype
+                new_aux = [na.astype(a.dtype) for na, a in zip(new_aux, auxs)]
+                return outs, new_aux
 
             fn = jax.jit(run)
             self._jit_fwd[is_train] = fn
@@ -271,7 +326,8 @@ class Executor:
                 full = list(args)
                 for i, a in zip(diff_idx, diff_args):
                     full[i] = a
-                outs, new_aux = self._graph_fn(full, auxs, rng, True)
+                outs, new_aux = self._graph_fn(self._cast_compute(full), auxs, rng, True)
+                new_aux = [na.astype(a.dtype) for na, a in zip(new_aux, auxs)]
                 return outs, new_aux
 
             if do_mirror:
@@ -329,6 +385,10 @@ class Executor:
             if isinstance(out_grads, nd.NDArray):
                 out_grads = [out_grads]
             ogs = [g.data if isinstance(g, nd.NDArray) else jnp.asarray(g) for g in out_grads]
+            # under compute_dtype the graph outputs (and so vjp cotangents) are
+            # bf16; cast user-supplied fp32 head grads to match
+            ogs = [g.astype(sd.dtype) for g, sd in
+                   zip(ogs, self._eval_out_shapes(args, auxs))]
         with _profiler.record_span(self._profile_name("fwd_bwd"), "executor"):
             outs, grads, new_aux = self._build_fwd_bwd()(args, auxs, ogs, rng)
         self._outputs_cache = outs
@@ -350,8 +410,11 @@ class Executor:
         import jax
 
         if self._out_shape_cache is None:
+            # evaluate through the same compute-dtype cast the real jit uses so
+            # dtypes (e.g. bf16 outputs) match the vjp's expectations
             outs, _ = jax.eval_shape(
-                lambda a, x: self._graph_fn(a, x, None, False), args, auxs
+                lambda a, x: self._graph_fn(self._cast_compute(a), x, None, False),
+                args, auxs,
             )
             self._out_shape_cache = outs
         return self._out_shape_cache
@@ -419,6 +482,7 @@ class Executor:
             self._symbol, self._ctx, new_args, new_grads,
             [self._grad_req[n] for n in self._arg_names], new_aux,
             group2ctx=self._group2ctx,
+            compute_dtype=self._compute_dtype, cast_exempt=self._cast_exempt,
         )
 
     def set_monitor_callback(self, callback):

@@ -72,7 +72,8 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad, shared_group=None,
                  logger=logging, fixed_param_names=None, grad_req="write",
-                 state_names=None):
+                 state_names=None, compute_dtype=None):
+        self.compute_dtype = compute_dtype
         self.param_names = param_names
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -196,9 +197,14 @@ class DataParallelExecutorGroup:
             aux_arrays = shared_exec.aux_arrays
         else:
             aux_arrays = [nd.zeros(s, ctx=ctx) for s in aux_shapes]
+        label_names = ([l.name for l in sliced_label]
+                       if label_shapes is not None else [])
         return self.symbol.bind(
             ctx, arg_arrays, args_grad=grad_arrays,
             grad_req=self.grad_req, aux_states=aux_arrays, shared_exec=shared_exec,
+            compute_dtype=self.compute_dtype,
+            # labels often carry class/token ids: keep them out of the downcast
+            cast_exempt=label_names,
         )
 
     def _collect_arrays(self):

@@ -25,7 +25,7 @@ __all__ = ["Module"]
 class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
-                 fixed_param_names=None, state_names=None):
+                 fixed_param_names=None, state_names=None, compute_dtype=None):
         super().__init__(logger=logger)
         if context is None:
             context = [ctx_mod.current_context()]
@@ -38,6 +38,9 @@ class Module(BaseModule):
         self._work_load_list = work_load_list
 
         self._symbol = symbol
+        # mixed precision: run the graph in this dtype with fp32 master params
+        # (the TPU-native form of the reference's *_fp16 symbols)
+        self._compute_dtype = compute_dtype
         data_names = list(data_names) if data_names is not None else []
         label_names = list(label_names) if label_names is not None else []
 
@@ -244,6 +247,7 @@ class Module(BaseModule):
             self._label_shapes, self._param_names, for_training, inputs_need_grad,
             shared_group, logger=self.logger, fixed_param_names=self._fixed_param_names,
             grad_req=grad_req, state_names=self._state_names,
+            compute_dtype=self._compute_dtype,
         )
         self._total_exec_bytes = 0
         if shared_module is not None:

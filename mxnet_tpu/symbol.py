@@ -362,7 +362,8 @@ class Symbol:
 
     # ---- binding --------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None, group2ctx=None,
-                    shared_arg_names=None, shared_exec=None, shared_buffer=None, **kwargs):
+                    shared_arg_names=None, shared_exec=None, shared_buffer=None,
+                    compute_dtype=None, cast_exempt=(), **kwargs):
         """Shape-inferred allocation + bind (reference: symbol.py:1157).
 
         kwargs are input shapes. Allocates arg/grad/aux NDArrays and returns a
@@ -385,16 +386,18 @@ class Symbol:
         else:
             args_grad = [nd.zeros(s, ctx=ctx, dtype=t) for s, t in zip(arg_shapes, arg_types)]
         return self.bind(ctx, args, args_grad=args_grad, grad_req=grad_req,
-                         aux_states=aux_states, group2ctx=group2ctx, shared_exec=shared_exec)
+                         aux_states=aux_states, group2ctx=group2ctx, shared_exec=shared_exec,
+                         compute_dtype=compute_dtype, cast_exempt=cast_exempt)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
-             group2ctx=None, shared_exec=None):
+             group2ctx=None, shared_exec=None, compute_dtype=None, cast_exempt=()):
         """Bind symbol to arrays, return Executor (reference: symbol.py:1256 →
         Executor::Bind, src/executor/graph_executor.cc:915)."""
         from .executor import Executor
 
         return Executor(self, ctx, args, args_grad, grad_req, aux_states,
-                        group2ctx=group2ctx, shared_exec=shared_exec)
+                        group2ctx=group2ctx, shared_exec=shared_exec,
+                        compute_dtype=compute_dtype, cast_exempt=cast_exempt)
 
     # ---- eval convenience ----------------------------------------------
     def eval(self, ctx=None, **kwargs):

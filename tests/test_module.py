@@ -191,3 +191,29 @@ def test_bucketing_module():
     # shared params across buckets
     assert mod._buckets[4]._exec_group.execs[0].arg_dict["fc_weight"] is \
         mod._buckets[8]._exec_group.execs[0].arg_dict["fc_weight"]
+
+
+def test_module_bf16_compute_dtype():
+    """Mixed precision at the Module level (the TPU-native analog of the
+    reference's *_fp16 symbols, e.g. resnet_fp16.py): graph runs bf16, master
+    params and optimizer updates stay fp32, accuracy matches fp32."""
+    from mxnet_tpu import models
+
+    def run(cd):
+        mx.random.seed(0)
+        rng_ = np.random.RandomState(0)
+        templates = rng_.rand(4, 1, 28, 28).astype(np.float32)
+        y = rng_.randint(0, 4, 128)
+        X = templates[y] + 0.3 * rng_.rand(128, 1, 28, 28).astype(np.float32)
+        net = models.lenet(num_classes=4)
+        mod = mx.mod.Module(net, compute_dtype=cd)
+        it = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=32, shuffle=True)
+        mod.fit(it, num_epoch=4, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.01, "momentum": 0.9},
+                initializer=mx.init.Xavier(), eval_metric="acc")
+        score = mod.score(it, mx.metric.Accuracy())[0][1]
+        arg, _ = mod.get_params()
+        assert all(v.dtype == np.float32 for v in arg.values())
+        return score
+
+    assert run("bfloat16") > 0.95
