@@ -1,0 +1,71 @@
+"""Fast Gradient Sign Method adversarial examples (reference:
+example/adversary/adversary_generation.ipynb — perturb inputs along the sign
+of the input gradient to flip a trained classifier's predictions).
+
+Trains a small MLP on synthetic two-class data, then crafts FGSM
+perturbations through the symbolic executor's input gradients
+(``inputs_need_grad`` path) and reports the accuracy drop.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def make_data(n, rng):
+    X = rng.rand(n, 64).astype(np.float32)
+    w = rng.randn(64).astype(np.float32)
+    y = (X @ w > np.median(X @ w)).astype(np.float32)
+    return X, y
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epsilon", type=float, default=0.15)
+    ap.add_argument("--num-epochs", type=int, default=20)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    X, y = make_data(1024, rng)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    clean_acc = mod.score(it, mx.metric.Accuracy())[0][1]
+
+    # bind a gradient-to-input executor with the trained weights
+    ex = net.simple_bind(ctx=mx.current_context(), data=(1024, 64),
+                         grad_req={"data": "write", "fc1_weight": "null",
+                                   "fc1_bias": "null", "fc2_weight": "null",
+                                   "fc2_bias": "null", "softmax_label": "null"})
+    arg_params, _ = mod.get_params()
+    for name, arr in arg_params.items():
+        ex.arg_dict[name][:] = arr
+    ex.arg_dict["data"][:] = X
+    ex.arg_dict["softmax_label"][:] = y
+    ex.forward(is_train=True)
+    ex.backward()
+    grad_sign = np.sign(ex.grad_dict["data"].asnumpy())
+
+    X_adv = np.clip(X + args.epsilon * grad_sign, 0, 1).astype(np.float32)
+    adv_acc = mod.score(mx.io.NDArrayIter(X_adv, y, batch_size=64),
+                        mx.metric.Accuracy())[0][1]
+    print("clean accuracy:      %.4f" % clean_acc)
+    print("FGSM(eps=%.2f) acc:  %.4f" % (args.epsilon, adv_acc))
+    assert adv_acc < clean_acc, "perturbation should hurt accuracy"
+
+
+if __name__ == "__main__":
+    main()

@@ -163,7 +163,9 @@ class SPMDTrainer:
             new_auxs = dict(zip(aux_order, new_aux))
             return new_params, new_auxs, new_moms, outs
 
-        donate = (0, 2) if self._donate else ()
+        # params, auxs (BN stats), and momenta all move every step — donate all
+        # three so XLA reuses their buffers in place
+        donate = (0, 1, 2) if self._donate else ()
         self._step_fn = jax.jit(step, donate_argnums=donate)
         return self._step_fn
 
