@@ -108,29 +108,40 @@ class ImageRecordIter(DataIter):
 
         def reader():
             try:
-                for s in self._record_stream():
+                for seq, s in enumerate(self._record_stream()):
                     if self._stop.is_set():
                         return
-                    self._raw_q.put(s)
+                    self._raw_q.put((seq, s))
             finally:
                 for _ in range(self.preprocess_threads):
                     self._raw_q.put(None)
 
         def worker():
-            while not self._stop.is_set():
-                s = self._raw_q.get()
-                if s is None:
-                    self._decoded_q.put(None)
-                    return
-                header, img = recordio.unpack(s)
-                data = imdecode(img)
-                for aug in self.auglist:
-                    data = aug(data)
-                arr = data.asnumpy().transpose(2, 0, 1)  # HWC -> CHW
-                label = np.asarray(header.label).reshape(-1)
-                self._decoded_q.put((arr, label))
+            try:
+                while not self._stop.is_set():
+                    item = self._raw_q.get()
+                    if item is None:
+                        return
+                    seq, s = item
+                    try:
+                        header, img = recordio.unpack(s)
+                        data = imdecode(img)
+                        for aug in self.auglist:
+                            data = aug(data)
+                        arr = data.asnumpy().transpose(2, 0, 1)  # HWC -> CHW
+                        label = np.asarray(header.label).reshape(-1)
+                        self._decoded_q.put((seq, arr, label))
+                    except Exception:  # noqa: BLE001 — corrupt record: skip,
+                        # but still claim the seq so reassembly can't stall
+                        self._decoded_q.put((seq, None, None))
+            finally:
+                # sentinel posts even if the thread dies, so the batcher's
+                # done_workers count always completes
+                self._decoded_q.put(None)
 
         def batcher():
+            import heapq
+
             c, h, w = self.data_shape
             done_workers = 0
             buf_data = np.zeros((self.batch_size, c, h, w), np.float32)
@@ -139,12 +150,19 @@ class ImageRecordIter(DataIter):
             buf_label = np.full((self.batch_size, self.label_width),
                                 self._label_pad, np.float32)
             i = 0
-            while done_workers < self.preprocess_threads:
-                item = self._decoded_q.get()
-                if item is None:
-                    done_workers += 1
-                    continue
-                arr, label = item
+            # decode workers finish out of order; reassemble by sequence number
+            # so batches keep record order (the reference's InstVector ordering,
+            # iter_image_recordio_2.cc)
+            pending = []
+            next_seq = 0
+
+            def _drain():
+                nonlocal next_seq
+                while pending and pending[0][0] == next_seq:
+                    yield heapq.heappop(pending)[1:]
+                    next_seq += 1
+
+            def _emit(arr, label, i):
                 buf_data[i] = arr
                 buf_label[i, :] = self._label_pad
                 buf_label[i, : len(label[: self.label_width])] = label[: self.label_width]
@@ -152,6 +170,22 @@ class ImageRecordIter(DataIter):
                 if i == self.batch_size:
                     self._out_q.put((buf_data.copy(), buf_label.copy(), 0))
                     i = 0
+                return i
+
+            while done_workers < self.preprocess_threads:
+                item = self._decoded_q.get()
+                if item is None:
+                    done_workers += 1
+                    continue
+                heapq.heappush(pending, item)
+                for arr, label in _drain():
+                    if arr is not None:  # None = corrupt record, skipped
+                        i = _emit(arr, label, i)
+            # stragglers (only if a worker died mid-sequence)
+            while pending:
+                arr, label = heapq.heappop(pending)[1:]
+                if arr is not None:
+                    i = _emit(arr, label, i)
             if i > 0:
                 # pad the final batch (reference: round_batch/pad semantics)
                 pad = self.batch_size - i

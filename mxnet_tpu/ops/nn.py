@@ -394,20 +394,26 @@ def _batch_norm(octx, attrs, args, auxs):
     if attrs["fix_gamma"]:
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
     if octx.is_train and not attrs["use_global_stats"]:
-        # stats accumulate in fp32 even when the graph runs bf16 — bf16
-        # reduction over N*H*W elements loses too many mantissa bits
+        # stats stay fp32 end to end even when the graph runs bf16 — the
+        # reduction, the moving-average update, and the rsqrt all happen at
+        # full precision; only the normalization math drops to x's dtype
         xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=red).astype(x.dtype)
-        var = jnp.var(xf, axis=red).astype(x.dtype)
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.var(xf, axis=red)
         m = attrs["momentum"]
         new_mean = mmean * m + jax.lax.stop_gradient(mean) * (1 - m)
         new_var = mvar * m + jax.lax.stop_gradient(var) * (1 - m)
     else:
         mean, var = mmean, mvar
         new_mean, new_var = mmean, mvar
-    inv = jax.lax.rsqrt(var.reshape(bshape) + attrs["eps"])
-    out = (x - mean.reshape(bshape)) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
-    return [out, mean, var], [new_mean, new_var]
+    # rsqrt in fp32, then normalize in x's dtype so bf16 activations stay
+    # bf16 (fp32 stats must not promote the tensor — the next conv requires
+    # matching dtypes)
+    inv = jax.lax.rsqrt(var.reshape(bshape).astype(jnp.float32) + attrs["eps"]).astype(x.dtype)
+    out = ((x - mean.reshape(bshape).astype(x.dtype)) * inv
+           * gamma.reshape(bshape).astype(x.dtype)
+           + beta.reshape(bshape).astype(x.dtype))
+    return [out, mean.astype(x.dtype), var.astype(x.dtype)], [new_mean, new_var]
 
 
 def _bn_infer_shape(attrs, in_shapes, aux_shapes):

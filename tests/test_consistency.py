@@ -1,0 +1,73 @@
+"""Cross-backend / cross-dtype consistency (reference:
+tests/python/gpu/test_operator_gpu.py — the whole CPU operator suite rerun on
+GPU plus check_consistency over [gpu-fp32, gpu-fp16, cpu] combos; here the
+portability axes are cpu-device-id pairs and fp32-vs-bf16 compute).
+
+Each case runs one symbol on multiple configs and cross-compares forward
+outputs through mxnet_tpu.test_utils.check_consistency."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import check_consistency
+
+np.random.seed(7)
+
+
+def _ctxes(shapes):
+    # two "devices" (reference trick: CPU device ids act as fake devices,
+    # test_multi_device_exec.py:20-33)
+    return [{"ctx": mx.cpu(0), "shapes": shapes},
+            {"ctx": mx.cpu(1), "shapes": shapes}]
+
+
+def test_conv_consistency():
+    net = sym.Convolution(sym.Variable("data"), num_filter=8, kernel=(3, 3),
+                          pad=(1, 1), name="conv")
+    check_consistency(net, _ctxes({"data": (2, 3, 10, 10)}))
+
+
+def test_fc_softmax_consistency():
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=16, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    check_consistency(net, _ctxes({"data": (4, 12), "softmax_label": (4,)}))
+
+
+def test_pooling_bn_consistency():
+    net = sym.Pooling(sym.Variable("data"), kernel=(2, 2), stride=(2, 2),
+                      pool_type="max")
+    net = sym.BatchNorm(net, fix_gamma=False, name="bn")
+    check_consistency(net, _ctxes({"data": (2, 4, 8, 8)}))
+
+
+@pytest.mark.parametrize("op", ["tanh", "sigmoid", "relu", "exp"])
+def test_unary_consistency(op):
+    net = getattr(sym, op)(sym.Variable("data"))
+    check_consistency(net, _ctxes({"data": (3, 7)}))
+
+
+def test_bf16_vs_fp32_forward_consistency():
+    """fp32 vs bf16 compute must agree within bf16 tolerance (the fp16-vs-fp32
+    column of the reference's check_consistency matrix)."""
+    net = sym.Convolution(sym.Variable("data"), num_filter=8, kernel=(3, 3),
+                          pad=(1, 1), no_bias=True, name="conv")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=8, name="fc")
+    shapes = {"data": (2, 3, 8, 8)}
+    rng = np.random.RandomState(0)
+    ex32 = net.simple_bind(ctx=mx.cpu(), **shapes)
+    ex16 = net.simple_bind(ctx=mx.cpu(), compute_dtype="bfloat16", **shapes)
+    for name, arr in ex32.arg_dict.items():
+        vals = rng.rand(*arr.shape).astype(np.float32)
+        arr[:] = vals
+        ex16.arg_dict[name][:] = vals
+    o32 = ex32.forward(is_train=False)[0].asnumpy()
+    o16 = np.asarray(ex16.forward(is_train=False)[0].asnumpy(), np.float32)
+    # bf16 has ~8 mantissa bits -> 2-3 decimal digits
+    np.testing.assert_allclose(o16, o32, rtol=5e-2, atol=5e-2)
+    # and bf16 grads flow back as fp32 with finite values
+    ex16.forward(is_train=True)
+    ex16.backward(mx.nd.ones(o32.shape))
+    g = ex16.grad_dict["fc_weight"]
+    assert g.dtype == np.float32 and np.isfinite(g.asnumpy()).all()

@@ -189,3 +189,35 @@ def test_image_det_record_iter(tmp_path):
     it = mx.io_image.ImageDetRecordIter(str(path2), (3, 16, 16), batch_size=2,
                                         label_width=10)
     assert it.provide_label[0].shape == (2, 2, 5)
+
+
+def test_image_record_iter_order_and_corrupt_records(tmp_path):
+    """Decode order is preserved under threaded decode (reference: InstVector
+    ordering, iter_image_recordio_2.cc), and a corrupt record is skipped
+    without stalling the sequence-reassembly pipeline."""
+    import io as _io
+
+    from PIL import Image
+
+    path = tmp_path / "mix.rec"
+    rec = recordio.MXRecordIO(str(path), "w")
+    rng = np.random.RandomState(0)
+    for i in range(20):
+        if i == 7:  # undecodable payload
+            rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0), b"NOT A JPEG"))
+            continue
+        img = Image.fromarray((rng.rand(8, 8, 3) * 255).astype(np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG")
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0), buf.getvalue()))
+    rec.close()
+    it = mx.io_image.ImageRecordIter(str(path), (3, 8, 8), batch_size=4,
+                                     preprocess_threads=3)
+    labels = []
+    try:
+        while True:
+            labels.extend(it.next().label[0].asnumpy().tolist())
+    except StopIteration:
+        pass
+    expect = [float(i) for i in range(20) if i != 7]
+    assert labels[: len(expect)] == expect, labels
