@@ -3,6 +3,7 @@ Modules trained adversarially with shared batches; generator uses
 Deconvolution+BatchNorm+Activation stacks).
 """
 import argparse
+import logging
 
 import numpy as np
 
@@ -11,6 +12,7 @@ from mxnet_tpu.models import make_generator, make_discriminator
 
 
 def main():
+    logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--z-dim", type=int, default=100)

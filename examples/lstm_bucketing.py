@@ -3,6 +3,7 @@ BucketSentenceIter + BucketingModule + per-bucket unrolled LSTM, Perplexity
 metric). Reads a tokenized text file via --data; synthetic corpus fallback.
 """
 import argparse
+import logging
 
 import numpy as np
 
@@ -28,6 +29,7 @@ def synthetic_corpus(n=500, vmax=100, seed=0):
 
 
 def main():
+    logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
     ap.add_argument("--data", default=None, help="tokenized text file")
     ap.add_argument("--num-hidden", type=int, default=200)

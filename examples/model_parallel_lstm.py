@@ -8,6 +8,7 @@ reference's async engine overlapped stages. The user contract (AttrScope +
 group2ctx bind) is identical.
 """
 import argparse
+import logging
 
 import numpy as np
 
@@ -42,6 +43,7 @@ def build(seq_len, num_hidden, num_layers, vocab, num_groups):
 
 
 def main():
+    logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq-len", type=int, default=8)
     ap.add_argument("--num-hidden", type=int, default=64)

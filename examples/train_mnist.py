@@ -6,6 +6,7 @@ digits so the script runs anywhere. Works with --kv-store local/device/
 dist_sync (under tools/launch.py).
 """
 import argparse
+import logging
 import os
 
 import numpy as np
@@ -43,11 +44,14 @@ def get_iters(args):
             label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
             batch_size=args.batch_size, flat=args.network == "mlp")
         return train, val
-    # synthetic fallback
+    # synthetic fallback: class-template digits + noise, so training actually
+    # converges and the script demos meaningfully without the dataset
     rng = np.random.RandomState(0)
     n = 2048
-    X = rng.rand(n, 1, 28, 28).astype(np.float32)
+    templates = rng.rand(10, 1, 28, 28).astype(np.float32)
     y = rng.randint(0, 10, (n,)).astype(np.float32)
+    X = (templates[y.astype(int)] * 0.7
+         + 0.3 * rng.rand(n, 1, 28, 28)).astype(np.float32)
     if args.network == "mlp":
         X = X.reshape(n, 784)
     shard = slice(args.part_index, None, args.num_parts)
@@ -57,6 +61,7 @@ def get_iters(args):
 
 
 def main():
+    logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
     ap.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
     ap.add_argument("--batch-size", type=int, default=64)

@@ -14,6 +14,7 @@ part_index/num_parts contract of dmlc::InputSplit.
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 
@@ -74,6 +75,7 @@ class ImageRecordIter(DataIter):
         else:
             self.provide_label = [DataDesc(label_name, (batch_size,))]
         self._epoch = 0
+        self._skipped = 0  # corrupt/undecodable records dropped (logged)
         self._start_pipeline()
 
     # ---- pipeline --------------------------------------------------------
@@ -131,8 +133,16 @@ class ImageRecordIter(DataIter):
                         arr = data.asnumpy().transpose(2, 0, 1)  # HWC -> CHW
                         label = np.asarray(header.label).reshape(-1)
                         self._decoded_q.put((seq, arr, label))
-                    except Exception:  # noqa: BLE001 — corrupt record: skip,
-                        # but still claim the seq so reassembly can't stall
+                    except Exception as e:  # noqa: BLE001 — corrupt record:
+                        # skip, but still claim the seq so reassembly can't
+                        # stall; count + log so systematic failures (every
+                        # record bad -> empty iterator) are diagnosable
+                        n = self._skipped
+                        self._skipped = n + 1
+                        if n < 5 or n % 1000 == 0:
+                            logging.warning(
+                                "ImageRecordIter: skipping record %d (%s: %s); "
+                                "%d skipped so far", seq, type(e).__name__, e, n + 1)
                         self._decoded_q.put((seq, None, None))
             finally:
                 # sentinel posts even if the thread dies, so the batcher's
@@ -172,6 +182,10 @@ class ImageRecordIter(DataIter):
                     i = 0
                 return i
 
+            # bound on buffered out-of-order images: past this we give up on
+            # strict ordering for the stuck gap rather than buffer the whole
+            # shard in host RAM (one slow/huge record must not OOM the host)
+            pending_cap = max(64, self.batch_size * 4, self.preprocess_threads * 16)
             while done_workers < self.preprocess_threads:
                 item = self._decoded_q.get()
                 if item is None:
@@ -181,6 +195,18 @@ class ImageRecordIter(DataIter):
                 for arr, label in _drain():
                     if arr is not None:  # None = corrupt record, skipped
                         i = _emit(arr, label, i)
+                if len(pending) > pending_cap:
+                    seq, arr, label = heapq.heappop(pending)
+                    logging.warning(
+                        "ImageRecordIter: record %d still decoding after %d "
+                        "newer records; emitting out of order to bound memory",
+                        next_seq, len(pending))
+                    next_seq = seq + 1
+                    if arr is not None:
+                        i = _emit(arr, label, i)
+                    for arr, label in _drain():
+                        if arr is not None:
+                            i = _emit(arr, label, i)
             # stragglers (only if a worker died mid-sequence)
             while pending:
                 arr, label = heapq.heappop(pending)[1:]
