@@ -191,6 +191,13 @@ class ImageRecordIter(DataIter):
                 if item is None:
                     done_workers += 1
                     continue
+                if item[0] < next_seq:
+                    # a slow record the cap branch already skipped past: emit
+                    # now (out of order) — pushing it would wedge the heap top
+                    # below next_seq and stall draining until the next overflow
+                    if item[1] is not None:
+                        i = _emit(item[1], item[2], i)
+                    continue
                 heapq.heappush(pending, item)
                 for arr, label in _drain():
                     if arr is not None:  # None = corrupt record, skipped

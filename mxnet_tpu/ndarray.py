@@ -488,38 +488,53 @@ _DTYPE_TO_FLAG = {np.dtype(k): v for k, v in _DTYPE_NP_TO_MX.items()}
 
 
 def _write_ndarray(f, arr):
+    # byte-for-byte the reference's NDArray::Save (ndarray.cc:620-643):
+    # u32 magic | TShape [u32 ndim, u32 dims...] | Context [i32 dev_type,
+    # i32 dev_id] | i32 type_flag | raw contiguous data — so checkpoints
+    # interchange with the reference both ways
     f.write(struct.pack("<I", _NDARRAY_MAGIC))
-    f.write(struct.pack("<ii", arr.context.device_typeid, arr.context.device_id))
     shape = arr.shape
     f.write(struct.pack("<I", len(shape)))
     for s in shape:
-        f.write(struct.pack("<q", s))
+        f.write(struct.pack("<I", s))
+    f.write(struct.pack("<ii", 1, 0))  # saved as cpu ctx, like the reference
     np_arr = arr.asnumpy()
-    flag = _DTYPE_NP_TO_MX.get(np.dtype(np_arr.dtype), 0)
+    flag = _DTYPE_NP_TO_MX.get(np.dtype(np_arr.dtype))
+    if flag is None:
+        # without an explicit nbytes field the loader derives sizes from the
+        # type flag, so a wrong flag would silently desync the whole stream
+        raise MXNetError("cannot save dtype %s: not a reference NDArray dtype"
+                         % np_arr.dtype)
     f.write(struct.pack("<i", flag))
-    b = np.ascontiguousarray(np_arr).tobytes()
-    f.write(struct.pack("<Q", len(b)))
-    f.write(b)
+    f.write(np.ascontiguousarray(np_arr).tobytes())
 
 
 def _read_ndarray(f):
     (magic,) = struct.unpack("<I", f.read(4))
     if magic != _NDARRAY_MAGIC:
-        raise MXNetError("Invalid NDArray file format")
+        # legacy pre-V1 files: the "magic" is ndim (LegacyTShapeLoad,
+        # ndarray.cc:645-660)
+        ndim = magic
+        if ndim > 64:
+            raise MXNetError("Invalid NDArray file format")
+    else:
+        (ndim,) = struct.unpack("<I", f.read(4))
+    shape = tuple(struct.unpack("<I", f.read(4))[0] for _ in range(ndim))
+    if ndim == 0:
+        return array(np.zeros(0, np.float32))  # is_none() save stops at shape
     dev_type, dev_id = struct.unpack("<ii", f.read(8))
-    (ndim,) = struct.unpack("<I", f.read(4))
-    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
     (flag,) = struct.unpack("<i", f.read(4))
-    (nbytes,) = struct.unpack("<Q", f.read(8))
-    dt = _DTYPE_MX_TO_NP[flag]
+    dt = np.dtype(_DTYPE_MX_TO_NP[flag])
+    nbytes = int(np.prod(shape)) * dt.itemsize
     data = np.frombuffer(f.read(nbytes), dtype=dt).reshape(shape)
     return array(data, dtype=dt)
 
 
 def save(fname, data):
-    """Save a list or str->NDArray dict (reference format: magic 0x112 header +
-    named NDArray blobs, src/ndarray/ndarray.cc:695-717; file layout is this
-    framework's own since mshadow's TShape wire format is not public)."""
+    """Save a list or str->NDArray dict in the reference's exact binary format
+    (src/ndarray/ndarray.cc:695-717): u64 0x112 magic, u64 reserved, then the
+    dmlc-serialized vectors — [u64 count, NDArray blobs], [u64 count, strings]
+    — so .params files interchange with the reference both ways."""
     if isinstance(data, NDArray):
         data = [data]
     names = []
@@ -534,9 +549,9 @@ def save(fname, data):
         f.write(struct.pack("<Q", _LIST_MAGIC))
         f.write(struct.pack("<Q", 0))  # reserved
         f.write(struct.pack("<Q", len(arrays)))
-        f.write(struct.pack("<Q", len(names)))
         for arr in arrays:
             _write_ndarray(f, arr)
+        f.write(struct.pack("<Q", len(names)))
         for n in names:
             nb = n.encode("utf-8")
             f.write(struct.pack("<Q", len(nb)))
@@ -557,10 +572,21 @@ def _load_stream(f):
     (magic,) = struct.unpack("<Q", f.read(8))
     if magic != _LIST_MAGIC:
         raise MXNetError("Invalid NDArray list file")
-    f.read(8)
+    f.read(8)  # reserved
     (n_arr,) = struct.unpack("<Q", f.read(8))
-    (n_names,) = struct.unpack("<Q", f.read(8))
+    # reject files written by this framework's pre-release layout (n_names as
+    # a second u64 up front, then per-array magic) with a clear message
+    # instead of misparsing them through the legacy-TShape heuristic
+    peek = f.read(12)
+    if (len(peek) == 12
+            and struct.unpack("<I", peek[8:12])[0] == _NDARRAY_MAGIC
+            and struct.unpack("<Q", peek[:8])[0] <= n_arr):
+        raise MXNetError(
+            "this .params file uses a pre-release layout; re-save it with the "
+            "current version (load with the old build, then save)")
+    f.seek(-len(peek), 1)
     arrays = [_read_ndarray(f) for _ in range(n_arr)]
+    (n_names,) = struct.unpack("<Q", f.read(8))
     names = []
     for _ in range(n_names):
         (ln,) = struct.unpack("<Q", f.read(8))

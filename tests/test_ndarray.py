@@ -174,3 +174,38 @@ def test_ndarray_pickle():
     a = nd.array(np.random.rand(3, 3))
     b = pickle.loads(pickle.dumps(a))
     np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+
+
+def test_save_format_byte_compatible_with_reference():
+    """The .params binary layout must match the reference byte for byte
+    (ndarray.cc:618-643 NDArray::Save + :695-717 list save), so checkpoints
+    interchange across frameworks. This test hand-builds a file with the
+    reference's documented layout and loads it; then saves and re-parses the
+    bytes field by field."""
+    import struct
+    import tempfile
+
+    # hand-build a reference-format file: one (2,3) fp32 array named "w"
+    vals = np.arange(6, dtype=np.float32).reshape(2, 3)
+    blob = b""
+    blob += struct.pack("<Q", 0x112)          # list magic
+    blob += struct.pack("<Q", 0)              # reserved
+    blob += struct.pack("<Q", 1)              # ndarray count
+    blob += struct.pack("<I", 0xF993FAC8)     # NDArray V1 magic
+    blob += struct.pack("<I", 2)              # ndim
+    blob += struct.pack("<II", 2, 3)          # dims (u32, mshadow index_t)
+    blob += struct.pack("<ii", 1, 0)          # Context: cpu(0)
+    blob += struct.pack("<i", 0)              # type_flag: float32
+    blob += vals.tobytes()
+    blob += struct.pack("<Q", 1)              # names count
+    blob += struct.pack("<Q", 1) + b"w"       # name "w"
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as fh:
+        fh.write(blob)
+        path = fh.name
+    loaded = nd.load(path)
+    assert list(loaded) == ["w"]
+    np.testing.assert_allclose(loaded["w"].asnumpy(), vals)
+
+    # our save must emit the identical bytes
+    nd.save(path, {"w": nd.array(vals)})
+    assert open(path, "rb").read() == blob
