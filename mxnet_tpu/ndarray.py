@@ -492,19 +492,32 @@ def _write_ndarray(f, arr):
     # u32 magic | TShape [u32 ndim, u32 dims...] | Context [i32 dev_type,
     # i32 dev_id] | i32 type_flag | raw contiguous data — so checkpoints
     # interchange with the reference both ways
-    f.write(struct.pack("<I", _NDARRAY_MAGIC))
     shape = arr.shape
+    if len(shape) == 0:
+        # the reference cannot represent 0-dim arrays (TShape ndim >= 1; an
+        # ndim-0 record means is_none and carries no data), and writing data
+        # the reader must not consume would desync every later blob
+        raise MXNetError("cannot save a 0-dim NDArray in the reference "
+                         ".params format; reshape to (1,) first")
+    np_arr = arr.asnumpy()
+    flag = _DTYPE_NP_TO_MX.get(np.dtype(np_arr.dtype))
+    if flag is not None and flag > 6:
+        # flags 7+ (bfloat16/bool/uint32/uint64) are TPU-build extensions the
+        # reference's loader rejects; bf16 widens losslessly to fp32 so the
+        # file stays interchangeable, the rest have no reference equivalent
+        if np_arr.dtype == _DTYPE_MX_TO_NP[7]:  # bfloat16
+            np_arr = np_arr.astype(np.float32)
+            flag = 0
+        else:
+            flag = None
+    if flag is None:
+        raise MXNetError("cannot save dtype %s: not a reference NDArray dtype"
+                         % np_arr.dtype)
+    f.write(struct.pack("<I", _NDARRAY_MAGIC))
     f.write(struct.pack("<I", len(shape)))
     for s in shape:
         f.write(struct.pack("<I", s))
     f.write(struct.pack("<ii", 1, 0))  # saved as cpu ctx, like the reference
-    np_arr = arr.asnumpy()
-    flag = _DTYPE_NP_TO_MX.get(np.dtype(np_arr.dtype))
-    if flag is None:
-        # without an explicit nbytes field the loader derives sizes from the
-        # type flag, so a wrong flag would silently desync the whole stream
-        raise MXNetError("cannot save dtype %s: not a reference NDArray dtype"
-                         % np_arr.dtype)
     f.write(struct.pack("<i", flag))
     f.write(np.ascontiguousarray(np_arr).tobytes())
 
@@ -522,8 +535,16 @@ def _read_ndarray(f):
     shape = tuple(struct.unpack("<I", f.read(4))[0] for _ in range(ndim))
     if ndim == 0:
         return array(np.zeros(0, np.float32))  # is_none() save stops at shape
+    # corrupt blobs routed through the legacy-ndim heuristic would otherwise
+    # drive unbounded reads or raw KeyErrors — sanity-check before trusting
+    if any(s > 2**31 for s in shape) or int(np.prod(shape)) > 2**40:
+        raise MXNetError("Invalid NDArray file format (implausible shape %s)"
+                         % (shape,))
     dev_type, dev_id = struct.unpack("<ii", f.read(8))
     (flag,) = struct.unpack("<i", f.read(4))
+    if flag not in _DTYPE_MX_TO_NP:
+        raise MXNetError("Invalid NDArray file format (unknown type flag %d)"
+                         % flag)
     dt = np.dtype(_DTYPE_MX_TO_NP[flag])
     nbytes = int(np.prod(shape)) * dt.itemsize
     data = np.frombuffer(f.read(nbytes), dtype=dt).reshape(shape)

@@ -550,11 +550,17 @@ def load_json(json_str):
     built = []
     for meta in nodes_meta:
         attrs = meta.get("attrs", meta.get("param", {})) or {}
+        # pre-NNVM files carry user attrs (ctx_group, lr_mult, ...) in a
+        # separate "attr" dict (reference: legacy_json_util.cc upgrade)
+        user_attrs = dict(meta.get("attr", {}) or {})
         if meta["op"] == "null":
-            node = _Node(None, meta["name"], {}, [], dict(attrs))
+            merged = dict(attrs)
+            merged.update(user_attrs)
+            node = _Node(None, meta["name"], {}, [], merged)
         else:
             op = get_op(meta["op"])
             cattrs, extra = op.canonicalize_attrs(attrs)
+            extra.update(user_attrs)
             inputs = [(built[i], k) for i, k, *_ in meta["inputs"]]
             node = _Node(meta["op"], meta["name"], cattrs, inputs, extra)
         built.append(node)

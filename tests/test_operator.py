@@ -574,3 +574,40 @@ def test_identity_with_attr_like_rhs_and_nogradient():
 def test_cross_device_copy_identity():
     x = nd.array(rng.rand(2, 2).astype(np.float32))
     np.testing.assert_allclose(nd._CrossDeviceCopy(x).asnumpy(), x.asnumpy())
+
+
+def test_reshape_magic_codes():
+    """mx-style reshape special codes (reference: matrix_op-inl.h Reshape
+    doc: 0=keep, -1=infer, -2=copy rest, -3=merge two, -4=split)."""
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert nd.reshape(x, shape=(-1,)).shape == (24,)
+    assert nd.reshape(x, shape=(0, -1)).shape == (2, 12)
+    assert nd.reshape(x, shape=(-2,)).shape == (2, 3, 4)
+    assert nd.reshape(x, shape=(0, -2)).shape == (2, 3, 4)
+    assert nd.reshape(x, shape=(-3, 4)).shape == (6, 4)
+    assert nd.reshape(x, shape=(-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+    assert nd.reshape(x, shape=(2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+    # values preserved through any code path
+    np.testing.assert_allclose(
+        nd.reshape(x, shape=(-3, 4)).asnumpy().ravel(), np.arange(24))
+
+
+def test_take_modes():
+    """take clip/wrap out-of-range semantics (reference: indexing_op.h)."""
+    a = nd.array(np.arange(10, dtype=np.float32))
+    idx = nd.array(np.array([-1, 3, 12], np.float32))
+    np.testing.assert_allclose(nd.take(a, idx, mode="clip").asnumpy(), [0, 3, 9])
+    np.testing.assert_allclose(nd.take(a, idx, mode="wrap").asnumpy(), [9, 3, 2])
+
+
+def test_topk_ret_types():
+    """topk value/indices/both/mask variants (reference: ordering_op.cc)."""
+    b = nd.array(np.array([[3.0, 1.0, 4.0, 1.0], [5.0, 9.0, 2.0, 6.0]], np.float32))
+    np.testing.assert_allclose(nd.topk(b, k=2, ret_typ="value").asnumpy(),
+                               [[4, 3], [9, 6]])
+    idx = nd.topk(b, k=2, ret_typ="indices").asnumpy()
+    np.testing.assert_allclose(idx, [[2, 0], [1, 3]])
+    both = nd.topk(b, k=2, ret_typ="both")
+    np.testing.assert_allclose(both[0].asnumpy(), [[4, 3], [9, 6]])
+    mask = nd.topk(b, k=2, ret_typ="mask").asnumpy()
+    np.testing.assert_allclose(mask, [[1, 0, 1, 0], [0, 1, 0, 1]])

@@ -113,3 +113,45 @@ def test_symbol_arithmetic_graph():
     ex.forward()
     expected = (x + y) * 2 - x / y + (1 - x) + x ** 2
     np.testing.assert_allclose(ex.outputs[0].asnumpy(), expected, rtol=1e-5)
+
+
+def test_load_legacy_json_schema(tmp_path):
+    """The reference's PRE-NNVM json schema ('param' dict, 'attr' extras,
+    backward_source_id, 2-element inputs) must load and infer (reference:
+    legacy_json_util.cc upgrade path; test_symbol.py:170 loads such a file)."""
+    import json
+
+    legacy = {
+        "nodes": [
+            {"op": "null", "param": {}, "name": "data", "inputs": [],
+             "backward_source_id": -1,
+             "attr": {"ctx_group": "stage1", "lr_mult": "0.2"}},
+            {"op": "null", "param": {}, "name": "fc1_weight", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "fc1_bias", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "FullyConnected",
+             "param": {"no_bias": "False", "num_hidden": "8"},
+             "name": "fc1", "inputs": [[0, 0], [1, 0], [2, 0]],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "softmax_label", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "SoftmaxOutput", "param": {}, "name": "softmax",
+             "inputs": [[3, 0], [4, 0]], "backward_source_id": -1},
+        ],
+        "arg_nodes": [0, 1, 2, 4],
+        "heads": [[5, 0]],
+    }
+    path = tmp_path / "legacy.json"
+    path.write_text(json.dumps(legacy))
+    s = sym.load(str(path))
+    assert s.list_arguments()[:3] == ["data", "fc1_weight", "fc1_bias"]
+    _, outs, _ = s.infer_shape(data=(2, 16))
+    assert outs == [(2, 8)]
+    # attrs ride through the upgrade (ctx_group/lr_mult on the data node)
+    attrs = s.attr_dict()
+    assert attrs.get("data", {}).get("ctx_group") == "stage1", attrs.get("data")
+    assert attrs.get("data", {}).get("lr_mult") == "0.2"
+    # round-trip through the current schema still loads
+    s2 = sym.load_json(s.tojson())
+    assert s2.list_outputs() == s.list_outputs()
