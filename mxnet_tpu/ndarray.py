@@ -532,12 +532,18 @@ def _read_ndarray(f):
             raise MXNetError("Invalid NDArray file format")
     else:
         (ndim,) = struct.unpack("<I", f.read(4))
+    if ndim > 64:  # both paths: a corrupt header must not drive EOF-long reads
+        raise MXNetError("Invalid NDArray file format (implausible ndim %d)" % ndim)
     shape = tuple(struct.unpack("<I", f.read(4))[0] for _ in range(ndim))
     if ndim == 0:
         return array(np.zeros(0, np.float32))  # is_none() save stops at shape
     # corrupt blobs routed through the legacy-ndim heuristic would otherwise
-    # drive unbounded reads or raw KeyErrors — sanity-check before trusting
-    if any(s > 2**31 for s in shape) or int(np.prod(shape)) > 2**40:
+    # drive unbounded reads or raw KeyErrors — sanity-check with exact python
+    # ints (np.prod silently wraps in int64) before trusting the shape
+    import math
+
+    n_elem = math.prod(shape)
+    if any(s > 2**31 for s in shape) or n_elem > 2**40:
         raise MXNetError("Invalid NDArray file format (implausible shape %s)"
                          % (shape,))
     dev_type, dev_id = struct.unpack("<ii", f.read(8))
@@ -546,8 +552,7 @@ def _read_ndarray(f):
         raise MXNetError("Invalid NDArray file format (unknown type flag %d)"
                          % flag)
     dt = np.dtype(_DTYPE_MX_TO_NP[flag])
-    nbytes = int(np.prod(shape)) * dt.itemsize
-    data = np.frombuffer(f.read(nbytes), dtype=dt).reshape(shape)
+    data = np.frombuffer(f.read(n_elem * dt.itemsize), dtype=dt).reshape(shape)
     return array(data, dtype=dt)
 
 
