@@ -69,7 +69,9 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None):
-    """(reference: model.py:99)"""
+    """(reference: model.py:99). When the updater supports it, all parameter
+    updates run as ONE jitted program instead of a dispatch per parameter."""
+    pairs = []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
@@ -79,7 +81,12 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None)
             kvstore.pull(index, grad_list, priority=-index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
-            updater(index * num_device + k, g, w)
+            pairs.append((index * num_device + k, g, w))
+    if hasattr(updater, "update_all"):
+        updater.update_all(pairs)
+    else:
+        for index, g, w in pairs:
+            updater(index, g, w)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):

@@ -526,10 +526,9 @@ def _read_ndarray(f):
     (magic,) = struct.unpack("<I", f.read(4))
     if magic != _NDARRAY_MAGIC:
         # legacy pre-V1 files: the "magic" is ndim (LegacyTShapeLoad,
-        # ndarray.cc:645-660)
+        # ndarray.cc:645-660); the shared implausible-ndim guard below rejects
+        # corrupt values
         ndim = magic
-        if ndim > 64:
-            raise MXNetError("Invalid NDArray file format")
     else:
         (ndim,) = struct.unpack("<I", f.read(4))
     if ndim > 64:  # both paths: a corrupt header must not drive EOF-long reads

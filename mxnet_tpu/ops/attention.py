@@ -134,7 +134,7 @@ def _pallas_forward(q, k, v, causal, sm_scale, block_q=512, block_k=1024, interp
     sk = k.shape[2]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    n_q = -(-sq // block_q)
+    n_q = -(-sq // block_q)  # ragged tails are masked inside the kernel
     n_k = -(-sk // block_k)
 
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref):
@@ -222,11 +222,15 @@ def _pallas_forward(q, k, v, causal, sm_scale, block_q=512, block_k=1024, interp
 
 def _pallas_shapes_ok(q, k):
     """Shapes the Pallas kernel handles; platform choice happens separately
-    at lowering time (lax.platform_dependent in _forward_impl)."""
+    at lowering time (lax.platform_dependent in _forward_impl). Ragged block
+    tails are masked inside the kernel, but hardware Mosaic wants the
+    second-minor tile aligned — require sequence multiples of 128 on the
+    Pallas path; anything else takes the scan lowering."""
     d = q.shape[-1]
     # Mosaic pads the lane dim, so any multiple of 8 works; 64 is the common
     # head_dim and must not fall back to the scan path
-    return d % 8 == 0 and q.shape[2] >= 128 and k.shape[2] >= 128
+    return (d % 8 == 0 and q.shape[2] % 128 == 0 and k.shape[2] % 128 == 0
+            and q.shape[2] >= 128 and k.shape[2] >= 128)
 
 
 def _scan_backward(q, k, v, out, lse, g, causal, sm_scale, block_k):

@@ -138,3 +138,29 @@ def test_create_by_name():
     assert isinstance(opt.create("sgd"), opt.SGD)
     assert isinstance(opt.create("adam"), opt.Adam)
     assert isinstance(opt.create("rmsprop"), opt.RMSProp)
+
+
+def test_fused_updater_matches_per_param():
+    """FusedUpdater's single-program update must match Updater's per-index
+    updates bit-for-bit in math (same lr/wd/momentum/bias-correction)."""
+    from mxnet_tpu.optimizer import Adam, FusedUpdater, SGD, Updater
+
+    rng_ = np.random.RandomState(3)
+    for make_opt in (lambda: SGD(learning_rate=0.1, momentum=0.9, wd=1e-3,
+                                 rescale_grad=0.5),
+                     lambda: SGD(learning_rate=0.1),
+                     lambda: Adam(learning_rate=0.01, wd=1e-3)):
+        shapes = [(4, 3), (7,), (2, 2, 2)]
+        ws_np = [rng_.rand(*s).astype(np.float32) for s in shapes]
+        gs_np = [rng_.randn(*s).astype(np.float32) for s in shapes]
+        ref_w = [nd.array(w) for w in ws_np]
+        fus_w = [nd.array(w) for w in ws_np]
+        ref_up, fus_up = Updater(make_opt()), FusedUpdater(make_opt())
+        for step in range(3):
+            for i in range(len(shapes)):
+                ref_up(i, nd.array(gs_np[i]), ref_w[i])
+            fus_up.update_all([(i, nd.array(gs_np[i]), fus_w[i])
+                               for i in range(len(shapes))])
+        for r, f in zip(ref_w, fus_w):
+            np.testing.assert_allclose(f.asnumpy(), r.asnumpy(), rtol=1e-5,
+                                       atol=1e-6)
