@@ -1,0 +1,65 @@
+"""Flash-attention kernel throughput (backs the numbers in
+docs/long_context.md): Pallas kernel vs XLA scan lowering vs the jax library
+flash kernel, bf16, causal, batch 4 x 8 heads x seq 4096 x head_dim 64.
+
+Prints one JSON line per variant: {"variant", "ms", "tflops"}.
+Methodology matches bench.py: dispatch a pipelined loop, force completion with
+one scalar fetch (reliable on tunneled transports), report amortized time.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import attention as A
+
+    B = int(os.environ.get("MXNET_TPU_BENCH_BATCH", "4"))
+    H = int(os.environ.get("MXNET_TPU_BENCH_HEADS", "8"))
+    T = int(os.environ.get("MXNET_TPU_BENCH_SEQ", "4096"))
+    D = int(os.environ.get("MXNET_TPU_BENCH_HEAD_DIM", "64"))
+    steps = int(os.environ.get("MXNET_TPU_BENCH_STEPS", "50"))
+    rng = np.random.RandomState(0)
+    q = jax.device_put((rng.rand(B, H, T, D) * 0.1).astype(jnp.bfloat16))
+    flops = 4 * B * H * T * T * D / 2  # causal half
+
+    def bench(fn):
+        out = fn(q)
+        float(np.asarray(jnp.sum(out)))  # warm + compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(q)
+        float(np.asarray(jnp.sum(out)))  # completion barrier
+        return (time.perf_counter() - t0) / steps
+
+    scale = float(1.0 / np.sqrt(D))
+    variants = {
+        "pallas_flash": jax.jit(lambda a: A._pallas_forward(a, a, a, True, scale)[0]),
+        "xla_scan": jax.jit(lambda a: A._scan_forward(a, a, a, True, scale, 256)[0]),
+    }
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash,
+        )
+
+        variants["jax_library_flash"] = jax.jit(
+            lambda a: jax_flash(a, a, a, causal=True, sm_scale=scale))
+    except ImportError:
+        pass
+
+    for name, fn in variants.items():
+        dt = bench(fn)
+        print(json.dumps({
+            "variant": name, "seq": T, "head_dim": D,
+            "ms": round(dt * 1e3, 2),
+            "tflops": round(flops / dt / 1e12, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -647,5 +647,9 @@ _cur_module = sys.modules[__name__]
 for _name in list_ops():
     _fn = _make_ndarray_function(_name)
     setattr(_cur_module, _name, _fn)
+# rich generated docstrings (reference: ndarray_doc.py attachment)
+from . import op_doc as _op_doc  # noqa: E402
+
+_op_doc.attach_docs(_cur_module, list_ops(), "imperative")
     # public names: strip no leading underscore ops only
 transpose = getattr(_cur_module, "transpose")

@@ -56,34 +56,36 @@ class Param:
     required flag. Gives every op keyword validation + canonicalization so attrs
     round-trip through Symbol JSON identically to the reference."""
 
-    __slots__ = ("parse", "default", "required")
+    __slots__ = ("parse", "default", "required", "kind")
 
     _REQUIRED = object()
 
-    def __init__(self, parse, default=_REQUIRED):
+    def __init__(self, parse, default=_REQUIRED, kind=None):
         self.parse = parse
         self.default = default
         self.required = default is Param._REQUIRED
+        # human-readable type name for generated docs (op_doc.py)
+        self.kind = kind or getattr(parse, "__name__", "value").replace("parse_", "")
 
     @staticmethod
     def shape(default=_REQUIRED):
-        return Param(parse_shape, default)
+        return Param(parse_shape, default, kind="shape")
 
     @staticmethod
     def int(default=_REQUIRED):
-        return Param(lambda v: int(float(v)), default)
+        return Param(lambda v: int(float(v)), default, kind="int")
 
     @staticmethod
     def float(default=_REQUIRED):
-        return Param(float, default)
+        return Param(float, default, kind="float")
 
     @staticmethod
     def bool(default=_REQUIRED):
-        return Param(parse_bool, default)
+        return Param(parse_bool, default, kind="boolean")
 
     @staticmethod
     def str(default=_REQUIRED):
-        return Param(lambda v: str(v), default)
+        return Param(lambda v: str(v), default, kind="string")
 
     @staticmethod
     def dtype(default=_REQUIRED):
@@ -98,7 +100,7 @@ class Param:
                 return np.dtype(jnp.bfloat16)
             return np.dtype(v)
 
-        return Param(_parse, default)
+        return Param(_parse, default, kind="dtype")
 
 
 class Operator:

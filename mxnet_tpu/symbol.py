@@ -630,6 +630,10 @@ def _make_symbol_function(op_name):
 _cur_module = sys.modules[__name__]
 for _name in list_ops():
     setattr(_cur_module, _name, _make_symbol_function(_name))
+# rich generated docstrings (reference: symbol_doc.py attachment)
+from . import op_doc as _op_doc  # noqa: E402
+
+_op_doc.attach_docs(_cur_module, list_ops(), "symbolic")
 
 
 def zeros(shape, dtype=None, **kwargs):

@@ -298,3 +298,20 @@ def test_backward_do_mirror_same_grads(monkeypatch):
         ex.backward()
         grads[flag] = ex.grad_dict["fc_weight"].asnumpy()
     np.testing.assert_allclose(grads["1"], grads["0"], rtol=1e-5)
+
+
+def test_generated_op_docs():
+    """Generated docstrings carry inputs + parameter tables (reference:
+    symbol_doc.py/ndarray_doc.py doc attachment over op metadata)."""
+    from mxnet_tpu import ndarray as nd_mod
+    from mxnet_tpu import symbol as sym_mod
+
+    doc = nd_mod.Convolution.__doc__
+    assert "Inputs: data, weight, bias" in doc
+    assert "kernel : shape (required)" in doc
+    assert "num_filter : int (required)" in doc
+    sdoc = sym_mod.slice_axis.__doc__
+    assert sdoc.startswith("Symbolic form")
+    assert "axis : int (required)" in sdoc
+    # every public generated fn got a parameter table when it has params
+    assert "Parameters" in nd_mod.topk.__doc__
