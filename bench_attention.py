@@ -29,11 +29,11 @@ def main():
     flops = 4 * B * H * T * T * D / 2  # causal half
 
     def bench(fn):
-        out = fn(q)
+        out = fn()
         float(np.asarray(jnp.sum(out)))  # warm + compile
         t0 = time.perf_counter()
         for _ in range(steps):
-            out = fn(q)
+            out = fn()
         float(np.asarray(jnp.sum(out)))  # completion barrier
         return (time.perf_counter() - t0) / steps
 
@@ -52,8 +52,33 @@ def main():
     except ImportError:
         pass
 
+    # backward pass variants (training is bwd-dominated). Distinct q/k/v/g
+    # arrays passed as ARGUMENTS — same-array closure inputs let XLA CSE the
+    # recompute matmuls and overstate throughput.
+    k_in = jax.device_put((rng.rand(B, H, T, D) * 0.1).astype(jnp.bfloat16))
+    v_in = jax.device_put((rng.rand(B, H, T, D) * 0.1).astype(jnp.bfloat16))
+    g_in = jax.device_put((rng.rand(B, H, T, D) * 0.1).astype(jnp.bfloat16))
+    out, lse = jax.jit(lambda a, b, c: A._pallas_forward(a, b, c, True, scale))(q, k_in, v_in)
+    bflops = flops * 2.5
+    # reduce over ALL THREE grads: returning only dq would let XLA dead-code-
+    # eliminate the dk/dv computation and overstate throughput ~2x
+    def _total(grads):
+        return sum(jnp.sum(t.astype(jnp.float32)) for t in grads)
+
+    bwd = {
+        "pallas_backward": jax.jit(
+            lambda a, b, c, o, l, gg: _total(A._pallas_backward(a, b, c, o, l, gg, True, scale))),
+        "scan_backward": jax.jit(
+            lambda a, b, c, o, l, gg: _total(A._scan_backward(a, b, c, o, l, gg, True, scale, 256))),
+    }
+    for name, f in bwd.items():
+        dt = bench(lambda: f(q, k_in, v_in, out, lse, g_in))
+        print(json.dumps({"variant": name, "seq": T, "head_dim": D,
+                          "ms": round(dt * 1e3, 2),
+                          "tflops": round(bflops / dt / 1e12, 1)}), flush=True)
+
     for name, fn in variants.items():
-        dt = bench(fn)
+        dt = bench(lambda: fn(q))
         print(json.dumps({
             "variant": name, "seq": T, "head_dim": D,
             "ms": round(dt * 1e3, 2),

@@ -49,6 +49,7 @@ from . import torch_bridge as th
 from . import parallel
 from . import contrib
 from . import test_utils
+from . import utils
 
 # later-MXNet convenience aliases: mx.nd.contrib.<op> / mx.sym.contrib.<op>
 ndarray.contrib = contrib.ndarray

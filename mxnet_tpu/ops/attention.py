@@ -233,6 +233,143 @@ def _pallas_shapes_ok(q, k):
             and q.shape[2] >= 128 and k.shape[2] >= 128)
 
 
+def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale,
+                     block_q=512, block_k=512, interpret=False):
+    """Pallas TPU flash-attention backward — two kernels, each recomputing P
+    from the saved lse (no S matrix materialised, same residuals as the scan
+    path): dk/dv iterate q-blocks innermost with the (block_k, d) accumulators
+    in VMEM; dq iterates kv-blocks innermost. delta = rowsum(dout*out) is
+    precomputed in XLA."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q = -(-sq // block_q)
+    n_k = -(-sk // block_k)
+    bh = b * h
+
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
+
+    def prep(x, s, pad_to):
+        x = x.reshape(bh, s, -1)
+        pad = pad_to - s
+        return jnp.pad(x, ((0, 0), (0, pad), (0, 0))) if pad else x
+
+    qr = prep(q, sq, n_q * block_q)
+    gr = prep(g, sq, n_q * block_q)
+    kr = prep(k, sk, n_k * block_k)
+    vr = prep(v, sk, n_k * block_k)
+    lse_r = prep(lse[..., None], sq, n_q * block_q)[..., 0].reshape(bh, 1, -1)
+    delta_r = prep(delta[..., None], sq, n_q * block_q)[..., 0].reshape(bh, 1, -1)
+
+    def recompute(qv, gv, kv, vv, lse_row, delta_row, qi_blk, kj):
+        s = jnp.dot(qv, kv.T, preferred_element_type=jnp.float32) * sm_scale
+        q_pos = qi_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < sk
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse_row[:, None])  # (bq, bk); 0 where masked
+        dp = jnp.dot(gv, vv.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_row[:, None]) * sm_scale
+        return p, ds
+
+    def kernel_dkv(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc):
+        kj = pl.program_id(1)
+        qi_blk = pl.program_id(2)
+
+        @pl.when(qi_blk == 0)
+        def _init():
+            dk_acc[:] = jnp.zeros((block_k, d), jnp.float32)
+            dv_acc[:] = jnp.zeros((block_k, d), jnp.float32)
+
+        run = (qi_blk * block_q + block_q - 1 >= kj * block_k) if causal else True
+
+        @pl.when(run)
+        def _step():
+            qv = q_ref[0].astype(jnp.float32)
+            gv = g_ref[0].astype(jnp.float32)
+            kv = k_ref[0].astype(jnp.float32)
+            vv = v_ref[0].astype(jnp.float32)
+            p, ds = recompute(qv, gv, kv, vv, lse_ref[0, 0], delta_ref[0, 0],
+                              qi_blk, kj)
+            dv_acc[:] += jnp.dot(p.T, gv, preferred_element_type=jnp.float32)
+            dk_acc[:] += jnp.dot(ds.T, qv, preferred_element_type=jnp.float32)
+
+        @pl.when(qi_blk == n_q - 1)
+        def _finish():
+            dk_ref[0] = dk_acc[:]
+            dv_ref[0] = dv_acc[:]
+
+    def kernel_dq(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref,
+                  dq_ref, dq_acc):
+        qi_blk = pl.program_id(1)
+        kj = pl.program_id(2)
+
+        @pl.when(kj == 0)
+        def _init():
+            dq_acc[:] = jnp.zeros((block_q, d), jnp.float32)
+
+        run = (kj * block_k <= qi_blk * block_q + block_q - 1) if causal else True
+
+        @pl.when(run)
+        def _step():
+            qv = q_ref[0].astype(jnp.float32)
+            gv = g_ref[0].astype(jnp.float32)
+            kv = k_ref[0].astype(jnp.float32)
+            vv = v_ref[0].astype(jnp.float32)
+            _, ds = recompute(qv, gv, kv, vv, lse_ref[0, 0], delta_ref[0, 0],
+                              qi_blk, kj)
+            dq_acc[:] += jnp.dot(ds, kv, preferred_element_type=jnp.float32)
+
+        @pl.when(kj == n_k - 1)
+        def _finish():
+            dq_ref[0] = dq_acc[:]
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, kk, 0))
+    kv_spec_outer = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, kk))
+    dk, dv = pl.pallas_call(
+        kernel_dkv,
+        grid=(bh, n_k, n_q),
+        in_specs=[q_spec, q_spec, kv_spec_outer, kv_spec_outer, row_spec, row_spec],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n_k * block_k, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, n_k * block_k, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, gr, kr, vr, lse_r, delta_r)
+
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0))
+    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0))
+    row_spec2 = pl.BlockSpec((1, 1, block_q), lambda i, j, kk: (i, 0, j))
+    (dq,) = pl.pallas_call(
+        kernel_dq,
+        grid=(bh, n_q, n_k),
+        in_specs=[q_spec2, q_spec2, kv_spec2, kv_spec2, row_spec2, row_spec2],
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, n_q * block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, gr, kr, vr, lse_r, delta_r)
+
+    dq = dq[:, :sq].reshape(b, h, sq, d).astype(q.dtype)
+    dk = dk[:, :sk].reshape(b, h, sk, d).astype(k.dtype)
+    dv = dv[:, :sk].reshape(b, h, sk, d).astype(v.dtype)
+    return dq, dk, dv
+
+
 def _scan_backward(q, k, v, out, lse, g, causal, sm_scale, block_k):
     """Flash backward: recompute P per block from saved lse; accumulate dq/dk/dv."""
     b, h, sq, d = q.shape
@@ -307,7 +444,15 @@ def _fa_fwd(q, k, v, causal, sm_scale, block_k):
 
 def _fa_bwd(causal, sm_scale, block_k, res, g):
     q, k, v, out, lse = res
-    return _scan_backward(q, k, v, out, lse, g, causal, _scale(sm_scale, q.shape[-1]), block_k)
+    scale = _scale(sm_scale, q.shape[-1])
+    if _pallas_shapes_ok(q, k):
+        return lax.platform_dependent(
+            q, k, v, out, lse, g,
+            tpu=functools.partial(_pallas_backward, causal=causal, sm_scale=scale),
+            default=functools.partial(_scan_backward, causal=causal,
+                                      sm_scale=scale, block_k=block_k),
+        )
+    return _scan_backward(q, k, v, out, lse, g, causal, scale, block_k)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -1,0 +1,80 @@
+"""General utilities (the `utils/` package of the TPU build's layout; the
+reference scatters these across python/mxnet/base.py and test_utils.py).
+
+Small, dependency-free helpers used across examples/tools plus re-exports of
+the test harness so `mxnet_tpu.utils` is the one-stop helper namespace.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from ..test_utils import (  # noqa: F401 — canonical comparison helpers
+    assert_almost_equal, check_consistency, check_numeric_gradient,
+    check_symbolic_backward, check_symbolic_forward,
+)
+
+__all__ = [
+    "seed_everything", "makedirs", "split_data", "clip_global_norm",
+    "assert_almost_equal", "check_consistency", "check_numeric_gradient",
+    "check_symbolic_backward", "check_symbolic_forward",
+]
+
+
+def seed_everything(seed):
+    """Seed python, numpy, and the framework's device RNG chain in one call."""
+    from .. import random as mxrandom
+
+    _pyrandom.seed(seed)
+    np.random.seed(seed % (2**32))
+    mxrandom.seed(seed)
+
+
+def makedirs(d):
+    """mkdir -p (reference helpers used os.makedirs guards throughout)."""
+    os.makedirs(d, exist_ok=True)
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray along ``batch_axis`` into ``num_slice`` pieces — the
+    manual form of the Module's batch scatter (reference:
+    executor_manager.py:14 _split_input_slice)."""
+    from .. import ndarray as nd
+
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along axis %d"
+            % (data.shape, num_slice, batch_axis))
+    if size < num_slice:
+        raise ValueError(
+            "too many slices: axis %d has size %d < num_slice %d"
+            % (batch_axis, size, num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * len(data.shape)
+        idx[batch_axis] = slice(lo, hi)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale a list of gradient NDArrays so their global L2 norm is at most
+    ``max_norm``; returns the pre-clip norm (the standard RNN training helper
+    the reference-era examples implemented by hand)."""
+    from .. import ndarray as nd
+
+    # device-side reduction: one scalar fetch total, not a full-array
+    # transfer + sync per parameter
+    total = nd.add_n(*[nd.sum(a * a) for a in arrays]) if len(arrays) > 1 else nd.sum(arrays[0] * arrays[0])
+    norm = float(np.sqrt(float(total.asnumpy())))
+    if norm > max_norm:
+        scale = max_norm / (norm + 1e-12)
+        for a in arrays:
+            a[:] = a * scale
+    return norm
