@@ -38,9 +38,11 @@ def main():
         return (time.perf_counter() - t0) / steps
 
     scale = float(1.0 / np.sqrt(D))
+    k_fwd = jax.device_put((rng.rand(B, H, T, D) * 0.1).astype(jnp.bfloat16))
+    v_fwd = jax.device_put((rng.rand(B, H, T, D) * 0.1).astype(jnp.bfloat16))
     variants = {
-        "pallas_flash": jax.jit(lambda a: A._pallas_forward(a, a, a, True, scale)[0]),
-        "xla_scan": jax.jit(lambda a: A._scan_forward(a, a, a, True, scale, 256)[0]),
+        "pallas_flash": jax.jit(lambda a, b, c: A._pallas_forward(a, b, c, True, scale)[0]),
+        "xla_scan": jax.jit(lambda a, b, c: A._scan_forward(a, b, c, True, scale, 256)[0]),
     }
     try:
         from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -48,7 +50,7 @@ def main():
         )
 
         variants["jax_library_flash"] = jax.jit(
-            lambda a: jax_flash(a, a, a, causal=True, sm_scale=scale))
+            lambda a, b, c: jax_flash(a, b, c, causal=True, sm_scale=scale))
     except ImportError:
         pass
 
@@ -78,7 +80,7 @@ def main():
                           "tflops": round(bflops / dt / 1e12, 1)}), flush=True)
 
     for name, fn in variants.items():
-        dt = bench(lambda: fn(q))
+        dt = bench(lambda: fn(q, k_fwd, v_fwd))
         print(json.dumps({
             "variant": name, "seq": T, "head_dim": D,
             "ms": round(dt * 1e3, 2),

@@ -27,6 +27,7 @@ def seed_everything(seed):
     """Seed python, numpy, and the framework's device RNG chain in one call."""
     from .. import random as mxrandom
 
+    seed = int(seed)  # accept numpy integers etc.
     _pyrandom.seed(seed)
     np.random.seed(seed % (2**32))
     mxrandom.seed(seed)
@@ -41,8 +42,6 @@ def split_data(data, num_slice, batch_axis=0, even_split=True):
     """Split an NDArray along ``batch_axis`` into ``num_slice`` pieces — the
     manual form of the Module's batch scatter (reference:
     executor_manager.py:14 _split_input_slice)."""
-    from .. import ndarray as nd
-
     size = data.shape[batch_axis]
     if even_split and size % num_slice != 0:
         raise ValueError(
@@ -68,6 +67,9 @@ def clip_global_norm(arrays, max_norm):
     ``max_norm``; returns the pre-clip norm (the standard RNN training helper
     the reference-era examples implemented by hand)."""
     from .. import ndarray as nd
+
+    if not arrays:
+        return 0.0
 
     # device-side reduction: one scalar fetch total, not a full-array
     # transfer + sync per parameter

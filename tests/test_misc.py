@@ -315,3 +315,34 @@ def test_generated_op_docs():
     assert "axis : int (required)" in sdoc
     # every public generated fn got a parameter table when it has params
     assert "Parameters" in nd_mod.topk.__doc__
+
+
+def test_monitor_and_callbacks():
+    """Monitor output-stat hooks + Speedometer/log_train_metric callbacks
+    (reference: monitor.py:16, callback.py:76-150)."""
+    from mxnet_tpu import ndarray as nd
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    for n, a in ex.arg_dict.items():
+        a[:] = np.random.RandomState(0).rand(*a.shape).astype(np.float32)
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True)
+    stats = mon.toc()
+    assert stats, "monitor collected no stats"
+    names = [s[1] for s in stats]
+    assert any("output" in n or "softmax" in n for n in names), names
+
+    # callbacks drive on BatchEndParam-shaped records without raising
+    from mxnet_tpu.callback import Speedometer, log_train_metric
+    from mxnet_tpu.model import BatchEndParam
+
+    metric = mx.metric.Accuracy()
+    metric.update([nd.array(np.zeros(2))], [nd.array(np.zeros((2, 2)))])
+    param = BatchEndParam(epoch=0, nbatch=50, eval_metric=metric, locals=None)
+    Speedometer(batch_size=2, frequent=50)(param)
+    log_train_metric(50)(param)
