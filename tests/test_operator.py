@@ -611,3 +611,76 @@ def test_topk_ret_types():
     np.testing.assert_allclose(both[0].asnumpy(), [[4, 3], [9, 6]])
     mask = nd.topk(b, k=2, ret_typ="mask").asnumpy()
     np.testing.assert_allclose(mask, [[1, 0, 1, 0], [0, 1, 0, 1]])
+
+
+def test_convolution_dilated_numeric():
+    x = rng.rand(1, 2, 9, 9).astype(np.float32)
+    w = rng.rand(3, 2, 3, 3).astype(np.float32)
+    conv = sym.Convolution(
+        sym.Variable("x"), sym.Variable("w"), kernel=(3, 3), num_filter=3,
+        dilate=(2, 2), no_bias=True)
+    _, out_shapes, _ = conv.infer_shape(x=(1, 2, 9, 9))
+    assert out_shapes[0] == (1, 3, 5, 5)  # 9 - (3-1)*2 = 5
+    check_numeric_gradient(conv, {"x": x, "w": w}, rtol=5e-2, atol=5e-2)
+
+
+def test_convolution_1d_3d():
+    """kernel rank selects 1D/3D convolution (reference: convolution-inl.h
+    handles 1-3 spatial dims)."""
+    c1 = sym.Convolution(sym.Variable("x"), kernel=(3,), num_filter=4, no_bias=True)
+    _, outs, _ = c1.infer_shape(x=(2, 3, 10))
+    assert outs[0] == (2, 4, 8)
+    c3 = sym.Convolution(sym.Variable("x"), kernel=(2, 2, 2), num_filter=2,
+                         stride=(2, 2, 2), no_bias=True)
+    _, outs, _ = c3.infer_shape(x=(1, 1, 4, 4, 4))
+    assert outs[0] == (1, 2, 2, 2, 2)
+    # 1D numerics vs manual correlation
+    x = rng.rand(1, 1, 6).astype(np.float32)
+    w = rng.rand(1, 1, 3).astype(np.float32)
+    want = np.array([[ [np.sum(x[0, 0, i:i+3] * w[0, 0]) for i in range(4)] ]],
+                    np.float32)
+    check_symbolic_forward(
+        sym.Convolution(sym.Variable("x"), sym.Variable("w"), kernel=(3,),
+                        num_filter=1, no_bias=True),
+        {"x": x, "w": w}, [want], rtol=1e-4)
+
+
+def test_deconvolution_numeric_gradient():
+    x = rng.rand(1, 2, 4, 4).astype(np.float32)
+    w = rng.rand(2, 3, 3, 3).astype(np.float32)
+    deconv = sym.Deconvolution(
+        sym.Variable("x"), sym.Variable("w"), kernel=(3, 3), num_filter=3,
+        stride=(2, 2), no_bias=True)
+    check_numeric_gradient(deconv, {"x": x, "w": w}, rtol=5e-2, atol=5e-2)
+    # deconv is conv's transpose: forward shape grows
+    _, outs, _ = deconv.infer_shape(x=(1, 2, 4, 4))
+    assert outs[0][2] == (4 - 1) * 2 + 3  # 9
+
+
+def test_pooling_numeric_gradient():
+    # tie-free data: a shuffled arange keeps every 3x3 window's values far
+    # apart, so the max-pool argmax can't flip mid-finite-difference
+    local = np.random.RandomState(5)
+    x = local.permutation(36).astype(np.float32).reshape(1, 1, 6, 6) * 0.1
+    for pt in ("max", "avg"):
+        pool = sym.Pooling(sym.Variable("x"), kernel=(3, 3), stride=(2, 2),
+                           pool_type=pt)
+        check_numeric_gradient(pool, {"x": x}, rtol=5e-2, atol=5e-2)
+
+
+def test_lrn_formula():
+    """LRN forward vs the reference formula (lrn-inl.h): out = x /
+    (knorm + alpha/n * sum_window x^2)^beta."""
+    x = rng.rand(1, 6, 3, 3).astype(np.float32)
+    n, alpha, beta, knorm = 5, 1e-4, 0.75, 2.0
+    lrn = sym.LRN(sym.Variable("x"), nsize=n, alpha=alpha, beta=beta, knorm=knorm)
+    half = n // 2
+    sq = x ** 2
+    denom = np.zeros_like(x)
+    C = x.shape[1]
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C, c + half + 1)
+        denom[:, c] = sq[:, lo:hi].sum(axis=1)
+    # the reference multiplies alpha/nsize by the window sum
+    want = x / (knorm + (alpha / n) * denom) ** beta
+    check_symbolic_forward(lrn, {"x": x}, [want], rtol=1e-4, atol=1e-5)
