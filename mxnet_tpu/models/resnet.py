@@ -108,7 +108,7 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
     if isinstance(image_shape, str):
         image_shape = [int(l) for l in image_shape.split(",")]
     (nchannel, height, width) = image_shape
-    if height <= 28:
+    if height <= 32:  # cifar-scale (reference crops cifar to 28; accept native 32 too)
         num_stages = 3
         if (num_layers - 2) % 9 == 0 and num_layers >= 164:
             per_unit = [(num_layers - 2) // 9]
