@@ -108,6 +108,9 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
     if isinstance(image_shape, str):
         image_shape = [int(l) for l in image_shape.split(",")]
     (nchannel, height, width) = image_shape
+    # height <= 32 selects the 3-stage cifar depth table ((n-2) % 6 == 0 basic
+    # / (n-2) % 9 == 0 >= 164 bottleneck — the reference's rule at its 28-crop
+    # scale); imagenet depths (18/34/50/...) apply only above 32
     if height <= 32:  # cifar-scale (reference crops cifar to 28; accept native 32 too)
         num_stages = 3
         if (num_layers - 2) % 9 == 0 and num_layers >= 164:
