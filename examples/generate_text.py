@@ -1,0 +1,80 @@
+"""Train the Transformer LM, then generate with KV-cache incremental decoding
+(the serving-side companion to examples/train_lm.py; no reference analog —
+the 2017 era predates attention serving).
+
+Trains on a synthetic cyclic token stream (next = current + 1 mod V), then
+greedily decodes: prefill the prompt through the cached decoder and continue.
+Every decode step is the same (batch, 1) XLA executable — the KV caches are
+aux states mutated in place, so generation never recompiles.
+"""
+import argparse
+import importlib
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--model-dim", type=int, default=64)
+    ap.add_argument("--num-heads", type=int, default=2)
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--prompt-len", type=int, default=5)
+    ap.add_argument("--gen-len", type=int, default=20)
+    args = ap.parse_args()
+
+    tlm = importlib.import_module("mxnet_tpu.models.transformer_lm")
+    cfg = dict(vocab_size=args.vocab, num_layers=args.num_layers,
+               model_dim=args.model_dim, num_heads=args.num_heads,
+               ffn_dim=4 * args.model_dim, seq_len=args.seq_len)
+
+    # train on the +1 cycle
+    rng = np.random.RandomState(0)
+    start = rng.randint(0, args.vocab, (1024, 1))
+    X = (start + np.arange(args.seq_len)) % args.vocab
+    Y = (X + 1) % args.vocab
+    mod = mx.mod.Module(tlm.get_symbol(**cfg))
+    mod.fit(mx.io.NDArrayIter(X.astype(np.float32), Y.astype(np.float32),
+                              batch_size=32, shuffle=True),
+            num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 3e-3},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    arg_params, aux_params = mod.get_params()
+
+    # bind the cached decoder and load the trained weights
+    ex = tlm.get_decode_symbol(**cfg).simple_bind(
+        ctx=mx.current_context(), grad_req="null", data=(1, 1))
+    for name, arr in arg_params.items():
+        if name in ex.arg_dict:
+            ex.arg_dict[name][:] = arr
+
+    def step(token, t):
+        probs = tlm.decode_step(ex, [token], t, args.seq_len)
+        return int(np.argmax(probs[0]))
+
+    prompt = [int(x) for x in (7 + np.arange(args.prompt_len)) % args.vocab]
+    nxt = None
+    for t, tok in enumerate(prompt):
+        nxt = step(tok, t)
+    generated = []
+    for t in range(len(prompt), len(prompt) + args.gen_len):
+        generated.append(nxt)
+        nxt = step(nxt, t)
+
+    print("prompt:    ", prompt)
+    print("generated: ", generated)
+    expect = [(prompt[-1] + 1 + i) % args.vocab for i in range(args.gen_len)]
+    acc = np.mean([g == e for g, e in zip(generated, expect)])
+    print("pattern continuation accuracy: %.2f" % acc)
+    assert acc > 0.9, "decoder failed to continue the learned pattern"
+
+
+if __name__ == "__main__":
+    main()

@@ -23,12 +23,18 @@ def _layer_norm(x, model_dim, name):
     return sym.broadcast_add(sym.broadcast_mul(xhat, g), b)
 
 
-def block(x, num_heads, model_dim, ffn_dim, seq_len, name):
+def block(x, num_heads, model_dim, ffn_dim, seq_len, name, attn_fn=None):
+    """Pre-norm residual block. ``attn_fn(h, w_in, w_out, name)`` builds the
+    attention sub-graph — the full causal block for training (default) or the
+    cached one-token step for decoding — so the two graphs can never drift."""
     h = _layer_norm(x, model_dim, name + "_ln1")
     w_in = sym.Variable(name + "_attn_in_weight")
     w_out = sym.Variable(name + "_attn_out_weight")
-    attn = sym.contrib.MultiHeadAttention(
-        h, w_in, w_out, num_heads=num_heads, causal=True, name=name + "_attn")
+    if attn_fn is None:
+        attn = sym.contrib.MultiHeadAttention(
+            h, w_in, w_out, num_heads=num_heads, causal=True, name=name + "_attn")
+    else:
+        attn = attn_fn(h, w_in, w_out, name)
     x = x + attn
     h = _layer_norm(x, model_dim, name + "_ln2")
     f = sym.FullyConnected(sym.Reshape(h, shape=(-1, model_dim)),
@@ -55,3 +61,64 @@ def get_symbol(vocab_size=32000, num_layers=4, model_dim=256, num_heads=4,
                                 num_hidden=vocab_size, name="lm_head")
     return sym.SoftmaxOutput(logits, label=sym.Reshape(label, shape=(-1,)),
                              name="softmax")
+
+
+def get_decode_symbol(vocab_size=32000, num_layers=4, model_dim=256,
+                      num_heads=4, ffn_dim=1024, seq_len=128, **kwargs):
+    """One-token autoregressive decode graph sharing the training graph's
+    parameter names, with per-layer KV caches as aux states
+    (``_contrib_CachedMultiHeadAttention``): bind once at (batch, 1), load the
+    trained checkpoint, and step — each step is one cached XLA executable, no
+    per-length recompilation.
+
+    data: (batch, 1) token ids; position: (1,) step index, which MUST stay
+    below ``seq_len`` — the op clips out-of-range positions inside the jitted
+    graph (no data-dependent errors under XLA), so stepping past the cache
+    silently overwrites the last slot: guard host-side (``decode_step`` does).
+    Step through ``decode_step`` (or call forward(is_train=True) AND read the
+    outputs every step: executor forwards are deferred, so skipping the read
+    would drop the cache write-back).
+    """
+    data = sym.Variable("data")
+    position = sym.Variable("position", shape=(1,))
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=model_dim,
+                      name="embed")
+    pos_tab = sym.Reshape(
+        sym.Variable("pos_embed_weight", shape=(1, seq_len, model_dim),
+                     init=Normal(0.02)),
+        shape=(seq_len, model_dim))
+    pos_row = sym.take(pos_tab, position, axis=0)  # (1, model)
+    x = sym.broadcast_add(x, sym.Reshape(pos_row, shape=(1, 1, model_dim)))
+
+    def cached_attn(h, w_in, w_out, name):
+        return sym.contrib.CachedMultiHeadAttention(
+            h, w_in, w_out, position, num_heads=num_heads, max_len=seq_len,
+            name=name + "_cached")
+
+    for i in range(num_layers):
+        x = block(x, num_heads, model_dim, ffn_dim, 1, "layer%d" % i,
+                  attn_fn=cached_attn)
+    x = _layer_norm(x, model_dim, "final_ln")
+    logits = sym.FullyConnected(sym.Reshape(x, shape=(-1, model_dim)),
+                                num_hidden=vocab_size, name="lm_head")
+    return sym.softmax(logits, axis=-1)
+
+
+def decode_step(executor, tokens, position, max_len):
+    """Advance the cached decoder one step and return next-token
+    probabilities (numpy, (batch, vocab)).
+
+    Encapsulates the two contract points a raw executor user can get wrong:
+    the host-side max_len guard (the jitted op clips silently) and the output
+    read that materializes the deferred forward so the KV-cache aux write-back
+    actually happens."""
+    import numpy as _np
+
+    if position >= max_len:
+        raise ValueError(
+            "decode position %d >= max_len %d: the KV cache is full — rebind "
+            "with a larger seq_len" % (position, max_len))
+    executor.arg_dict["data"][:] = _np.asarray(tokens, _np.float32).reshape(-1, 1)
+    executor.arg_dict["position"][:] = _np.array([position], _np.float32)
+    executor.forward(is_train=True)  # aux write-back persists the caches
+    return executor.outputs[0].asnumpy()

@@ -516,3 +516,79 @@ def _mha_infer_shape(attrs, in_shapes, aux_shapes):
 from .registry import get_op  # noqa: E402
 
 get_op("_contrib_MultiHeadAttention")._infer_shape = _mha_infer_shape
+
+
+# ---------------------------------------------------- incremental decoding
+@register(
+    "_contrib_CachedMultiHeadAttention",
+    arg_names=("data", "in_weight", "out_weight", "position"),
+    aux_names=("cache_k", "cache_v"),
+    params={
+        "num_heads": Param.int(),
+        "max_len": Param.int(),
+    },
+)
+def _cached_mha_op(octx, attrs, args, auxs):
+    """One autoregressive decode step with static-shape KV caches.
+
+    Not in the reference (its era predates attention serving); this is the
+    TPU-idiomatic incremental decoder: caches are AUX STATES of fixed shape
+    (batch, heads, max_len, head_dim) mutated in place each step (the same
+    FMutateInputs mechanism BatchNorm's moving stats use), so every step
+    compiles once and replays — no per-length recompilation, the KV-cache
+    analog of the paged-attention serving pattern.
+
+    data: (B, 1, model) — the current token's hidden state;
+    position: (1,) float — the step index t (tokens 0..t-1 already cached).
+    Returns (B, 1, model); writes the step's k/v into the caches at t.
+    """
+    x, w_in, w_out, position = args
+    cache_k, cache_v = auxs
+    bsz, one, model = x.shape
+    heads = attrs["num_heads"]
+    max_len = attrs["max_len"]
+    hd = model // heads
+    pos = jnp.clip(position.reshape(()).astype(jnp.int32), 0, max_len - 1)
+
+    qkv = jnp.einsum("bsm,nm->bsn", x, w_in)  # (B, 1, 3*model)
+    q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+
+    def heads_first(t):
+        return t.reshape(bsz, 1, heads, hd).transpose(0, 2, 1, 3)  # (B,H,1,hd)
+
+    q, k_new, v_new = heads_first(q), heads_first(k_new), heads_first(v_new)
+    new_k = jax.lax.dynamic_update_slice(cache_k, k_new.astype(cache_k.dtype),
+                                         (0, 0, pos, 0))
+    new_v = jax.lax.dynamic_update_slice(cache_v, v_new.astype(cache_v.dtype),
+                                         (0, 0, pos, 0))
+    # attend q over positions <= t
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, new_k,
+                   preferred_element_type=jnp.float32) / np.sqrt(hd)
+    valid = jnp.arange(max_len) <= pos
+    s = jnp.where(valid[None, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(new_v.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, new_v)  # (B,H,1,hd)
+    out = out.transpose(0, 2, 1, 3).reshape(bsz, 1, model)
+    out = jnp.einsum("bsm,nm->bsn", out, w_out)
+    return [out], [new_k, new_v]
+
+
+def _cached_mha_infer(attrs, in_shapes, aux_shapes):
+    data = in_shapes[0]
+    if data is None:
+        raise ValueError("CachedMultiHeadAttention: data shape required")
+    b, one, model = data
+    heads = attrs["num_heads"]
+    max_len = attrs["max_len"]
+    hd = model // heads
+    if in_shapes[1] is None:
+        in_shapes[1] = (3 * model, model)
+    if in_shapes[2] is None:
+        in_shapes[2] = (model, model)
+    if in_shapes[3] is None:
+        in_shapes[3] = (1,)
+    cache = (b, heads, max_len, hd)
+    return in_shapes, [tuple(data)], [cache, cache]
+
+
+get_op("_contrib_CachedMultiHeadAttention")._infer_shape = _cached_mha_infer

@@ -159,3 +159,38 @@ def test_transformer_lm_learns_previous_token_task():
             initializer=mx.init.Xavier(), eval_metric="acc")
     score = mod.score(it, mx.metric.Accuracy())[0][1]
     assert score > 0.85, score
+
+
+def test_transformer_kv_cache_decode_matches_full_forward():
+    """Incremental decoding with KV-cache aux states must reproduce the full
+    forward's next-token distribution at every position (the correctness
+    contract of _contrib_CachedMultiHeadAttention)."""
+    import importlib
+
+    tlm = importlib.import_module("mxnet_tpu.models.transformer_lm")
+    V, L, M, H, F, T = 17, 2, 32, 2, 48, 12
+    train = tlm.get_symbol(vocab_size=V, num_layers=L, model_dim=M,
+                           num_heads=H, ffn_dim=F, seq_len=T)
+    decode = tlm.get_decode_symbol(vocab_size=V, num_layers=L, model_dim=M,
+                                   num_heads=H, ffn_dim=F, seq_len=T)
+    mx.random.seed(0)
+    ex_train = train.simple_bind(ctx=mx.cpu(), data=(1, T), softmax_label=(1, T))
+    rng_ = np.random.RandomState(0)
+    for n_, a in ex_train.arg_dict.items():
+        if n_ not in ("data", "softmax_label"):
+            a[:] = (rng_.rand(*a.shape) * 0.2 - 0.1).astype(np.float32)
+    toks = rng_.randint(0, V, (1, T)).astype(np.float32)
+    ex_train.arg_dict["data"][:] = toks
+    ex_train.forward(is_train=False)
+    full_probs = ex_train.outputs[0].asnumpy().reshape(T, V)
+
+    ex_dec = decode.simple_bind(ctx=mx.cpu(), grad_req="null", data=(1, 1))
+    for n_, a in ex_dec.arg_dict.items():
+        if n_ in ex_train.arg_dict and n_ != "data":
+            a[:] = ex_train.arg_dict[n_].asnumpy()
+    for t in range(T):
+        ex_dec.arg_dict["data"][:] = toks[:, t:t + 1]
+        ex_dec.arg_dict["position"][:] = np.array([t], np.float32)
+        ex_dec.forward(is_train=True)  # aux write-back persists the caches
+        np.testing.assert_allclose(ex_dec.outputs[0].asnumpy()[0],
+                                   full_probs[t], rtol=2e-4, atol=2e-5)
