@@ -14,7 +14,6 @@ import logging
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import models
 
 
 def main():
@@ -29,6 +28,8 @@ def main():
     ap.add_argument("--prompt-len", type=int, default=5)
     ap.add_argument("--gen-len", type=int, default=20)
     args = ap.parse_args()
+    if args.prompt_len < 1:
+        ap.error("--prompt-len must be >= 1 (the decoder needs a seed token)")
 
     tlm = importlib.import_module("mxnet_tpu.models.transformer_lm")
     cfg = dict(vocab_size=args.vocab, num_layers=args.num_layers,
@@ -46,7 +47,7 @@ def main():
             num_epoch=args.num_epochs, optimizer="adam",
             optimizer_params={"learning_rate": 3e-3},
             initializer=mx.init.Xavier(), eval_metric="acc")
-    arg_params, aux_params = mod.get_params()
+    arg_params, _ = mod.get_params()
 
     # bind the cached decoder and load the trained weights
     ex = tlm.get_decode_symbol(**cfg).simple_bind(

@@ -541,6 +541,24 @@ def _sgd_tree(momentum, rescale, clip):
     return step
 
 
+def _rmsprop_tree(gamma1, eps, rescale, clip):
+    import jax.numpy as jnp
+
+    def step(ws, gs, ss, lrs, wds):
+        new_w, new_s = [], []
+        for w, g, (n,), lr, wd in zip(ws, gs, ss, lrs, wds):
+            g = g.astype(w.dtype) * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            g = g + wd * w
+            n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+            new_w.append(w - lr * g / jnp.sqrt(n + eps))
+            new_s.append((n,))
+        return new_w, new_s
+
+    return step
+
+
 def _adam_tree(beta1, beta2, eps, rescale, clip):
     import jax.numpy as jnp
 
@@ -577,6 +595,8 @@ class FusedUpdater(Updater):
             return _sgd_tree(opt.momentum, opt.rescale_grad, clip)
         if type(opt) is Adam:
             return _adam_tree(opt.beta1, opt.beta2, opt.epsilon, opt.rescale_grad, clip)
+        if type(opt) is RMSProp and not opt.centered and opt.clip_weights is None:
+            return _rmsprop_tree(opt.gamma1, opt.epsilon, opt.rescale_grad, clip)
         return None
 
     def update_all(self, pairs):
@@ -619,6 +639,8 @@ class FusedUpdater(Updater):
                 lr = lr * math.sqrt(1 - opt.beta2 ** t) / (1 - opt.beta1 ** t)
                 mean, var = self.states[index]
                 ss.append((mean.data, var.data))
+            elif type(opt) is RMSProp:
+                ss.append((self.states[index][0].data,))
             elif momentum_sgd:
                 ss.append(self.states[index].data)
             else:
@@ -633,6 +655,8 @@ class FusedUpdater(Updater):
             if type(opt) is Adam:
                 self.states[index][0]._set_data(ns[0])
                 self.states[index][1]._set_data(ns[1])
+            elif type(opt) is RMSProp:
+                self.states[index][0]._set_data(ns[0])
             elif momentum_sgd:
                 self.states[index]._set_data(ns)
 

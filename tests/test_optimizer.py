@@ -146,10 +146,13 @@ def test_fused_updater_matches_per_param():
     from mxnet_tpu.optimizer import Adam, FusedUpdater, SGD, Updater
 
     rng_ = np.random.RandomState(3)
+    from mxnet_tpu.optimizer import RMSProp
+
     for make_opt in (lambda: SGD(learning_rate=0.1, momentum=0.9, wd=1e-3,
                                  rescale_grad=0.5),
                      lambda: SGD(learning_rate=0.1),
-                     lambda: Adam(learning_rate=0.01, wd=1e-3)):
+                     lambda: Adam(learning_rate=0.01, wd=1e-3),
+                     lambda: RMSProp(learning_rate=0.01, gamma1=0.9, wd=1e-3)):
         shapes = [(4, 3), (7,), (2, 2, 2)]
         ws_np = [rng_.rand(*s).astype(np.float32) for s in shapes]
         gs_np = [rng_.randn(*s).astype(np.float32) for s in shapes]
