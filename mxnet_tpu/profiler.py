@@ -111,3 +111,25 @@ def dump_profile():
     (reference: MXDumpProfile → Profiler::DumpProfile, profiler.h:88)."""
     with open(_state["filename"], "w") as f:
         json.dump({"traceEvents": _state["events"], "displayTimeUnit": "ms"}, f)
+
+
+# autostart + at-exit dump (reference: MXNET_PROFILER_AUTOSTART env,
+# docs/how_to/env_var.md:73; profiler dump at exit, src/initialize.cc:39-48)
+def _maybe_autostart():
+    import atexit
+
+    if os.environ.get("MXNET_PROFILER_AUTOSTART", "0").strip().lower() not in (
+            "0", "", "false", "no", "off"):
+        profiler_set_config(
+            mode="all",
+            filename=os.environ.get("MXNET_PROFILER_FILENAME", "profile.json"))
+        profiler_set_state("run")
+
+        def _dump_at_exit():
+            profiler_set_state("stop")
+            dump_profile()
+
+        atexit.register(_dump_at_exit)
+
+
+_maybe_autostart()
