@@ -100,8 +100,12 @@ class _Span:
 
 def record_span(name, category="operator"):
     """Context manager recording one span while the profiler runs; a shared
-    no-op when stopped so the imperative hot path pays ~nothing."""
+    no-op when stopped so the imperative hot path pays ~nothing. Mode
+    "symbolic" records only executor spans (the reference's kOnlySymbolic);
+    "all" adds per-op imperative spans (kAllOperator, profiler.h:63-66)."""
     if not _state["running"]:
+        return _NULL_SPAN
+    if _state["mode"] == "symbolic" and category == "operator":
         return _NULL_SPAN
     return _Span(name, category)
 
@@ -120,9 +124,13 @@ def _maybe_autostart():
 
     if os.environ.get("MXNET_PROFILER_AUTOSTART", "0").strip().lower() not in (
             "0", "", "false", "no", "off"):
+        # default filename is pid-suffixed: launched clusters (tools/launch.py)
+        # propagate the env to every process, and a shared name would leave
+        # only the last exiter's trace
         profiler_set_config(
             mode="all",
-            filename=os.environ.get("MXNET_PROFILER_FILENAME", "profile.json"))
+            filename=os.environ.get("MXNET_PROFILER_FILENAME",
+                                    "profile.%d.json" % os.getpid()))
         profiler_set_state("run")
 
         def _dump_at_exit():
