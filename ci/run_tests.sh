@@ -35,14 +35,21 @@ run_bench() {
   python bench.py
 }
 
+run_tpu() {
+  # the device-consistency sweep (reference: tests/python/gpu/): the whole
+  # operator suite re-executed under the TPU default context. Needs hardware.
+  python -m pytest tests_tpu/ -q
+}
+
 case "$stage" in
   unit) run_unit ;;
   native) run_native ;;
   predict) run_predict ;;
   entry) run_entry ;;
   bench) run_bench ;;
+  tpu) run_tpu ;;
   all) run_native; run_predict; run_entry;
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py ;;
-  *) echo "unknown stage: $stage (unit|native|predict|entry|bench|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|predict|entry|bench|tpu|all)"; exit 2 ;;
 esac

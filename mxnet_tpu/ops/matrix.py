@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import MXNetError, parse_shape
-from .registry import Param, register, register_simple
+from .registry import Param, fp32_precision, register, register_simple
 
 
 # ---- reshape with MXNet's special codes (matrix_op-inl.h ReshapeParam) ------
@@ -136,8 +136,9 @@ def _dot(attrs, lhs, rhs):
     ta, tb = attrs["transpose_a"], attrs["transpose_b"]
     a = lhs.T if ta and lhs.ndim == 2 else (jnp.transpose(lhs) if ta else lhs)
     b = rhs.T if tb and rhs.ndim == 2 else (jnp.transpose(rhs) if tb else rhs)
-    # fp32 accumulation on the MXU for low-precision inputs
-    prec = jax.lax.Precision.DEFAULT
+    # fp32 inputs contract at HIGHEST (TPU's DEFAULT silently drops fp32
+    # matmuls to bf16); low-precision inputs keep the native fast path
+    prec = fp32_precision(a.dtype)
     if a.ndim == 1 and b.ndim == 1:
         return jnp.dot(a, b, precision=prec)
     return jnp.dot(a, b, precision=prec, preferred_element_type=_acc_type(a.dtype))
@@ -154,7 +155,8 @@ def _batch_dot(attrs, lhs, rhs):
     ta, tb = attrs["transpose_a"], attrs["transpose_b"]
     a = jnp.swapaxes(lhs, -1, -2) if ta else lhs
     b = jnp.swapaxes(rhs, -1, -2) if tb else rhs
-    return jnp.matmul(a, b, preferred_element_type=_acc_type(a.dtype))
+    return jnp.matmul(a, b, precision=fp32_precision(a.dtype),
+                      preferred_element_type=_acc_type(a.dtype))
 
 
 register_simple(

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import MXNetError
-from .registry import OpContext, Param, register, register_simple
+from .registry import OpContext, Param, fp32_precision, register, register_simple
 
 
 def _conv_dims(kernel):
@@ -47,7 +47,7 @@ def _fully_connected(octx, attrs, args, auxs):
     # No preferred_element_type: the MXU accumulates bf16 dots in fp32
     # natively, and this JAX version's conv/dot transpose rules reject a
     # widened cotangent dtype under vjp.
-    out = jnp.dot(x, weight.T)
+    out = jnp.dot(x, weight.T, precision=fp32_precision(x.dtype))
     if not attrs["no_bias"]:
         out = out + args[2]
     return [out], []
@@ -125,6 +125,7 @@ def _convolution(octx, attrs, args, auxs):
         rhs_dilation=dilate,
         dimension_numbers=_conv_dn(nd),
         feature_group_count=attrs["num_group"],
+        precision=fp32_precision(data.dtype),
     )
     if not attrs["no_bias"]:
         bias = args[2]
@@ -190,6 +191,7 @@ def _deconvolution(octx, attrs, args, auxs):
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=attrs["num_group"],
+        precision=fp32_precision(data.dtype),
     )
     if not attrs["no_bias"]:
         out = out + args[2].reshape((1, -1) + (1,) * nd)

@@ -309,3 +309,20 @@ def get_op(name):
 
 def list_ops():
     return sorted(_OP_REGISTRY.keys())
+
+
+def fp32_precision(dt):
+    """Matmul/conv precision for a given input dtype: float32 means FLOAT32.
+
+    On TPU, jax's DEFAULT precision computes fp32 contractions in bf16 on the
+    MXU — silently ~3 decimal digits. The reference's fp32 semantics (and any
+    CPU-vs-TPU consistency check) require true fp32, so fp32/fp64 inputs get
+    HIGHEST; bf16 inputs keep DEFAULT (bf16 with fp32 accumulation is the
+    native fast path users opt into via compute_dtype).
+    """
+    import jax
+    import numpy as np
+
+    if np.dtype(dt) in (np.dtype("float32"), np.dtype("float64")):
+        return jax.lax.Precision.HIGHEST
+    return jax.lax.Precision.DEFAULT

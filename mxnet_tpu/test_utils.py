@@ -81,12 +81,25 @@ def find_max_violation(a, b, rtol=None, atol=None):
     return idx, np.max(violation)
 
 
+# device tolerance floor (reference: check_consistency's per-dtype tol matrix,
+# test_utils.py:765 — GPU fp32 gets 1e-3 where CPU gets 1e-5). The TPU test
+# run (tests_tpu/conftest.py) raises the floor: TPU transcendentals round
+# differently from the host libm, and per-test tolerances written for CPU
+# would produce false failures on hardware.
+_TOL_FLOOR = [0.0, 0.0]  # [rtol_floor, atol_floor]
+
+
+def set_tolerance_floor(rtol=0.0, atol=0.0):
+    _TOL_FLOOR[0] = rtol
+    _TOL_FLOOR[1] = atol
+
+
 def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
     """(reference: test_utils.py:129)"""
     a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
     b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
-    rtol = rtol or 1e-5
-    atol = atol or 1e-20
+    rtol = max(rtol or 1e-5, _TOL_FLOOR[0])
+    atol = max(atol or 1e-20, _TOL_FLOOR[1])
     if almost_equal(a, b, rtol, atol):
         return
     index, rel = find_max_violation(a, b, rtol, atol)

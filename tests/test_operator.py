@@ -9,7 +9,7 @@ from mxnet_tpu import ndarray as nd
 from mxnet_tpu import symbol as sym
 from mxnet_tpu.test_utils import (
     assert_almost_equal, check_numeric_gradient, check_symbolic_backward,
-    check_symbolic_forward,
+    check_symbolic_forward, default_context,
 )
 
 rng = np.random.RandomState(1234)
@@ -263,7 +263,7 @@ def test_batchnorm_training_stats():
     gamma = np.ones(3, np.float32)
     beta = np.zeros(3, np.float32)
     bn = sym.BatchNorm(sym.Variable("x"), name="bn", fix_gamma=False, momentum=0.9)
-    ex = bn.simple_bind(ctx=mx.cpu(), data=None, x=(4, 3, 2, 2))
+    ex = bn.simple_bind(ctx=default_context(), data=None, x=(4, 3, 2, 2))
     ex.arg_dict["x"][:] = x
     ex.arg_dict["bn_gamma"][:] = gamma
     ex.arg_dict["bn_beta"][:] = beta
@@ -290,7 +290,7 @@ def test_batchnorm_training_stats():
 def test_dropout():
     x = np.ones((200, 200), np.float32)
     d = sym.Dropout(sym.Variable("x"), p=0.5)
-    ex = d.simple_bind(ctx=mx.cpu(), x=x.shape)
+    ex = d.simple_bind(ctx=default_context(), x=x.shape)
     ex.arg_dict["x"][:] = x
     ex.forward(is_train=True)
     out = ex.outputs[0].asnumpy()
@@ -308,7 +308,7 @@ def test_softmax_output_grad():
     label = np.array([0, 1, 2, 3], np.float32)
     s = sym.SoftmaxOutput(sym.Variable("x"), sym.Variable("label"), name="sm")
     ex = s.bind(
-        mx.cpu(), {"x": nd.array(x), "label": nd.array(label)},
+        default_context(), {"x": nd.array(x), "label": nd.array(label)},
         args_grad={"x": nd.zeros((4, 5))}, grad_req={"x": "write", "label": "null"},
     )
     ex.forward(is_train=True)
@@ -329,7 +329,7 @@ def test_softmax_output_ignore_label():
         sym.Variable("x"), sym.Variable("label"), use_ignore=True, ignore_label=-1
     )
     ex = s.bind(
-        mx.cpu(), {"x": nd.array(x), "label": nd.array(label)},
+        default_context(), {"x": nd.array(x), "label": nd.array(label)},
         args_grad={"x": nd.zeros((4, 5))}, grad_req={"x": "write", "label": "null"},
     )
     ex.forward(is_train=True)
@@ -343,7 +343,7 @@ def test_regression_outputs():
     y = rng.rand(4, 3).astype(np.float32)
     lr = sym.LinearRegressionOutput(sym.Variable("x"), sym.Variable("y"))
     ex = lr.bind(
-        mx.cpu(), {"x": nd.array(x), "y": nd.array(y)},
+        default_context(), {"x": nd.array(x), "y": nd.array(y)},
         args_grad={"x": nd.zeros((4, 3))}, grad_req={"x": "write", "y": "null"},
     )
     ex.forward(is_train=True)
@@ -353,7 +353,7 @@ def test_regression_outputs():
     # logistic
     lo = sym.LogisticRegressionOutput(sym.Variable("x"), sym.Variable("y"))
     ex2 = lo.bind(
-        mx.cpu(), {"x": nd.array(x), "y": nd.array(y)},
+        default_context(), {"x": nd.array(x), "y": nd.array(y)},
         args_grad={"x": nd.zeros((4, 3))}, grad_req={"x": "write", "y": "null"},
     )
     ex2.forward(is_train=True)
@@ -367,12 +367,12 @@ def test_make_loss_blockgrad():
     x = rng.rand(3, 3).astype(np.float32)
     v = sym.Variable("x")
     ml = sym.MakeLoss(sym.square(v))
-    ex = ml.bind(mx.cpu(), {"x": nd.array(x)}, args_grad={"x": nd.zeros((3, 3))})
+    ex = ml.bind(default_context(), {"x": nd.array(x)}, args_grad={"x": nd.zeros((3, 3))})
     ex.forward(is_train=True)
     ex.backward()
     np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), 2 * x, rtol=1e-5)
     bg = sym.BlockGrad(sym.square(v))
-    ex2 = bg.bind(mx.cpu(), {"x": nd.array(x)}, args_grad={"x": nd.zeros((3, 3))})
+    ex2 = bg.bind(default_context(), {"x": nd.array(x)}, args_grad={"x": nd.zeros((3, 3))})
     ex2.forward(is_train=True)
     ex2.backward(nd.ones((3, 3)))
     np.testing.assert_allclose(ex2.grad_dict["x"].asnumpy(), 0)
@@ -386,7 +386,7 @@ def test_embedding_and_take():
     # backward is scatter-add into weight
     og = np.ones((3, 4), np.float32)
     ex = emb.bind(
-        mx.cpu(), {"idx": nd.array(idx), "w": nd.array(w)},
+        default_context(), {"idx": nd.array(idx), "w": nd.array(w)},
         args_grad={"w": nd.zeros((10, 4)), "idx": nd.zeros(3)},
         grad_req={"w": "write", "idx": "null"},
     )
@@ -465,7 +465,7 @@ def test_instance_norm_l2_norm():
 def test_cast():
     x = rng.rand(3, 3).astype(np.float32)
     c = sym.Cast(sym.Variable("x"), dtype="int32")
-    out = c.eval(ctx=mx.cpu(), x=nd.array(x))[0]
+    out = c.eval(ctx=default_context(), x=nd.array(x))[0]
     assert out.dtype == np.int32
 
 
@@ -483,7 +483,7 @@ def test_grad_req_add():
     v = sym.Variable("x")
     s = sym.sum(sym.square(v))
     grad = nd.array(np.ones((3, 3), np.float32))
-    ex = s.bind(mx.cpu(), {"x": nd.array(x)}, args_grad={"x": grad}, grad_req="add")
+    ex = s.bind(default_context(), {"x": nd.array(x)}, args_grad={"x": grad}, grad_req="add")
     ex.forward(is_train=True)
     ex.backward(nd.ones(()))
     np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), 1 + 2 * x, rtol=1e-5)
@@ -563,7 +563,7 @@ def test_identity_with_attr_like_rhs_and_nogradient():
     a = sym.Variable("a")
     b = sym.Variable("b")
     s = sym._identity_with_attr_like_rhs(a, b)
-    ex = s.simple_bind(ctx=mx.cpu(), a=(3, 3), b=(3, 3))
+    ex = s.simple_bind(ctx=default_context(), a=(3, 3), b=(3, 3))
     ex.forward(is_train=True)
     ex.backward(nd.ones((3, 3)))
     np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), np.ones((3, 3)), rtol=1e-6)

@@ -1,0 +1,4 @@
+"""Re-run the entire operator suite under the TPU default context
+(reference: tests/python/gpu/test_operator_gpu.py imports the CPU suite and
+re-executes it on the device — the key portability harness, SURVEY §4)."""
+from test_operator import *  # noqa: F401,F403
