@@ -9,6 +9,7 @@ LeNet MNIST, LSTM LM, DCGAN.
 from .lenet import get_symbol as lenet
 from .googlenet import get_symbol as googlenet
 from .inception_v3 import get_symbol as inception_v3
+from .inception_resnet_v2 import get_symbol as inception_resnet_v2
 from .resnext import get_symbol as resnext
 from . import ssd
 from .mlp import get_symbol as mlp
