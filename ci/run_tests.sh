@@ -37,8 +37,9 @@ run_bench() {
 
 run_tpu() {
   # the device-consistency sweep (reference: tests/python/gpu/): the whole
-  # operator suite re-executed under the TPU default context. Needs hardware.
-  python -m pytest tests_tpu/ -q
+  # operator suite re-executed under the TPU default context. Needs hardware;
+  # REQUIRE_HW makes a missing TPU a hard failure instead of a skip.
+  MXNET_TPU_REQUIRE_HW=1 python -m pytest tests_tpu/ -q
 }
 
 case "$stage" in

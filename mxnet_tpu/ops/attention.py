@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .registry import Param, register
+from .registry import Param, fp32_precision, register
 
 __all__ = ["flash_attention", "attention_reference"]
 
@@ -47,23 +47,28 @@ def _scale(sm_scale, d):
 def attention_reference(q, k, v, causal=False, sm_scale=None):
     """Naive softmax attention — the numeric oracle for tests (O(S^2) memory)."""
     sm_scale = _scale(sm_scale, q.shape[-1])
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
+                   precision=lax.Precision.HIGHEST) * sm_scale
     if causal:
         qi = jnp.arange(q.shape[2])[:, None]
         ki = jnp.arange(k.shape[2])[None, :]
         s = jnp.where(qi >= ki, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                      precision=lax.Precision.HIGHEST).astype(q.dtype)
 
 
 # ------------------------------------------------------------------ block math
-def _block_update(q, k_blk, v_blk, m, l, acc, sm_scale, mask=None):
+def _block_update(q, k_blk, v_blk, m, l, acc, sm_scale, mask=None,
+                  precision=None):
     """One online-softmax update of (m, l, acc) with a KV block.
 
     q: (B,H,Sq,D) f32; k_blk/v_blk: (B,H,Bk,D); m,l: (B,H,Sq); acc: (B,H,Sq,D).
-    mask: optional (Sq, Bk) bool — True = attend.
+    mask: optional (Sq, Bk) bool — True = attend. precision: MXU precision
+    chosen from the ORIGINAL (pre-cast) input dtype, see fp32_precision().
     """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32) * sm_scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32,
+                   precision=precision) * sm_scale
     if mask is not None:
         s = jnp.where(mask[None, None], s, _NEG_INF)
     m_blk = jnp.max(s, axis=-1)
@@ -72,7 +77,8 @@ def _block_update(q, k_blk, v_blk, m, l, acc, sm_scale, mask=None):
     scale = jnp.exp(m - m_new)
     l_new = l * scale + jnp.sum(p, axis=-1)
     acc_new = acc * scale[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v_blk, preferred_element_type=jnp.float32
+        "bhqk,bhkd->bhqd", p, v_blk, preferred_element_type=jnp.float32,
+        precision=precision
     )
     return m_new, l_new, acc_new
 
@@ -84,6 +90,7 @@ def _scan_forward(q, k, v, causal, sm_scale, block_k):
     block_k = min(block_k, sk)
     n_blk = -(-sk // block_k)
     pad = n_blk * block_k - sk
+    prec = fp32_precision(q.dtype)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
@@ -104,7 +111,8 @@ def _scan_forward(q, k, v, causal, sm_scale, block_k):
             mask = mask & (qi[:, None] >= ki[None, :])
         else:
             mask = jnp.broadcast_to(mask, (sq, block_k))
-        m, l, acc = _block_update(qf, k_blk, v_blk, m, l, acc, sm_scale, mask)
+        m, l, acc = _block_update(qf, k_blk, v_blk, m, l, acc, sm_scale, mask,
+                                  precision=prec)
         return (m, l, acc), None
 
     m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
@@ -377,6 +385,7 @@ def _scan_backward(q, k, v, out, lse, g, causal, sm_scale, block_k):
     block_k = min(block_k, sk)
     n_blk = -(-sk // block_k)
     pad = n_blk * block_k - sk
+    prec = fp32_precision(q.dtype)
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     gf = g.astype(jnp.float32)
     of = out.astype(jnp.float32)
@@ -396,14 +405,19 @@ def _scan_backward(q, k, v, out, lse, g, causal, sm_scale, block_k):
             mask = mask & (qi[:, None] >= ki[None, :])
         else:
             mask = jnp.broadcast_to(mask, (sq, block_k))
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk, preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk, preferred_element_type=jnp.float32,
+                       precision=prec) * sm_scale
         s = jnp.where(mask[None, None], s, _NEG_INF)
         p = jnp.exp(s - lse[..., None])  # (B,H,Sq,Bk)
-        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, gf, preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v_blk, preferred_element_type=jnp.float32)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, gf, preferred_element_type=jnp.float32,
+                            precision=prec)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v_blk, preferred_element_type=jnp.float32,
+                        precision=prec)
         ds = p * (dp - delta[..., None]) * sm_scale
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk, preferred_element_type=jnp.float32)
-        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf, preferred_element_type=jnp.float32)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk, preferred_element_type=jnp.float32,
+                             precision=prec)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf, preferred_element_type=jnp.float32,
+                            precision=prec)
         return dq, (dk_blk, dv_blk)
 
     dq0 = jnp.zeros((b, h, sq, d), jnp.float32)
@@ -490,7 +504,8 @@ def _mha_op(octx, attrs, args, auxs):
     bsz, seq, model = x.shape
     heads = attrs["num_heads"]
     hd = model // heads
-    qkv = jnp.einsum("bsm,nm->bsn", x, w_in)  # (B,S,3*model)
+    prec = fp32_precision(x.dtype)
+    qkv = jnp.einsum("bsm,nm->bsn", x, w_in, precision=prec)  # (B,S,3*model)
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def split_heads(t):
@@ -498,7 +513,7 @@ def _mha_op(octx, attrs, args, auxs):
 
     out = flash_attention(split_heads(q), split_heads(k), split_heads(v), attrs["causal"])
     out = out.transpose(0, 2, 1, 3).reshape(bsz, seq, model)
-    return [jnp.einsum("bsm,nm->bsn", out, w_out)], []
+    return [jnp.einsum("bsm,nm->bsn", out, w_out, precision=prec)], []
 
 
 def _mha_infer_shape(attrs, in_shapes, aux_shapes):
@@ -550,7 +565,8 @@ def _cached_mha_op(octx, attrs, args, auxs):
     hd = model // heads
     pos = jnp.clip(position.reshape(()).astype(jnp.int32), 0, max_len - 1)
 
-    qkv = jnp.einsum("bsm,nm->bsn", x, w_in)  # (B, 1, 3*model)
+    prec = fp32_precision(x.dtype)
+    qkv = jnp.einsum("bsm,nm->bsn", x, w_in, precision=prec)  # (B, 1, 3*model)
     q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
 
     def heads_first(t):
@@ -563,13 +579,14 @@ def _cached_mha_op(octx, attrs, args, auxs):
                                          (0, 0, pos, 0))
     # attend q over positions <= t
     s = jnp.einsum("bhqd,bhkd->bhqk", q, new_k,
-                   preferred_element_type=jnp.float32) / np.sqrt(hd)
+                   preferred_element_type=jnp.float32,
+                   precision=prec) / np.sqrt(hd)
     valid = jnp.arange(max_len) <= pos
     s = jnp.where(valid[None, None, None, :], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(new_v.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, new_v)  # (B,H,1,hd)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, new_v, precision=prec)  # (B,H,1,hd)
     out = out.transpose(0, 2, 1, 3).reshape(bsz, 1, model)
-    out = jnp.einsum("bsm,nm->bsn", out, w_out)
+    out = jnp.einsum("bsm,nm->bsn", out, w_out, precision=prec)
     return [out], [new_k, new_v]
 
 

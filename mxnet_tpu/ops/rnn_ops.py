@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import MXNetError
-from .registry import Param, get_op, register
+from .registry import Param, fp32_precision, get_op, register
 
 
 def _gates(mode):
@@ -68,7 +68,7 @@ def _cell_step(mode, state_size):
 
         def step(carry, xw, w_h2h, b_h2h):
             h, c = carry
-            gates = xw + jnp.dot(h, w_h2h.T) + b_h2h
+            gates = xw + jnp.dot(h, w_h2h.T, precision=fp32_precision(h.dtype)) + b_h2h
             i, f, g_, o = jnp.split(gates, 4, axis=-1)
             i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
             g_ = jnp.tanh(g_)
@@ -80,7 +80,7 @@ def _cell_step(mode, state_size):
 
         def step(carry, xw, w_h2h, b_h2h):
             (h,) = carry
-            hw = jnp.dot(h, w_h2h.T) + b_h2h
+            hw = jnp.dot(h, w_h2h.T, precision=fp32_precision(h.dtype)) + b_h2h
             xr, xz, xn = jnp.split(xw, 3, axis=-1)
             hr, hz, hn = jnp.split(hw, 3, axis=-1)
             r = jax.nn.sigmoid(xr + hr)
@@ -94,7 +94,7 @@ def _cell_step(mode, state_size):
 
         def step(carry, xw, w_h2h, b_h2h):
             (h,) = carry
-            h2 = act(xw + jnp.dot(h, w_h2h.T) + b_h2h)
+            h2 = act(xw + jnp.dot(h, w_h2h.T, precision=fp32_precision(h.dtype)) + b_h2h)
             return (h2,), h2
 
     return step
@@ -104,7 +104,8 @@ def _run_layer(x, wp, init, mode, state_size, reverse=False):
     """x: (T, N, I); returns (out (T,N,H), final_carry)."""
     w_i2h, w_h2h, b_i2h, b_h2h = wp
     # hoist the input projection out of the scan: one big MXU matmul over T*N
-    xw = jnp.einsum("tni,hi->tnh", x, w_i2h) + b_i2h
+    xw = jnp.einsum("tni,hi->tnh", x, w_i2h,
+                    precision=fp32_precision(x.dtype)) + b_i2h
     step = _cell_step(mode, state_size)
 
     def body(carry, xw_t):

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .registry import Param, get_op, register, register_simple
+from .registry import Param, fp32_precision, get_op, register, register_simple
 
 
 # ---------------------------------------------------------------- ROIPooling
@@ -144,7 +144,8 @@ def _grid_generator(octx, attrs, args, auxs):
         gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
         ones = jnp.ones_like(gx)
         coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, H*W)
-        out = jnp.einsum("nij,jk->nik", theta, coords).reshape(-1, 2, H, W)
+        out = jnp.einsum("nij,jk->nik", theta, coords,
+                         precision=fp32_precision(x.dtype)).reshape(-1, 2, H, W)
         return [out], []
     # warp: grid = identity + normalized flow
     N, _, H, W = x.shape
@@ -190,7 +191,8 @@ def _spatial_transformer(octx, attrs, args, auxs):
     gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
     ones = jnp.ones_like(gx)
     coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
-    grid = jnp.einsum("nij,jk->nik", theta, coords).reshape(-1, 2, H, W)
+    grid = jnp.einsum("nij,jk->nik", theta, coords,
+                      precision=fp32_precision(loc.dtype)).reshape(-1, 2, H, W)
     out = jax.vmap(lambda img, g: _bilinear_sample(img, g[0], g[1]))(data, grid)
     return [out], []
 

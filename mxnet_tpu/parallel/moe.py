@@ -21,12 +21,15 @@ def moe_ffn_local(x, gate_w, w1, w2, axis, n, capacity_factor=1.25):
     import jax
     import jax.numpy as jnp
 
+    from ..ops.registry import fp32_precision
+
     B, D = x.shape
     E_local = w1.shape[0]
     E = E_local * n
     C = max(int(B * capacity_factor / E), 1)  # capacity per expert per device
+    prec = fp32_precision(x.dtype)
 
-    logits = x @ gate_w  # (B, E)
+    logits = jnp.dot(x, gate_w, precision=prec)  # (B, E)
     probs = jax.nn.softmax(logits, axis=-1)
     expert = jnp.argmax(probs, axis=-1)  # (B,)
     gate = jnp.max(probs, axis=-1)  # (B,)
@@ -42,22 +45,22 @@ def moe_ffn_local(x, gate_w, w1, w2, axis, n, capacity_factor=1.25):
         jnp.clip(pos_tok, 0, C - 1).astype(jnp.int32), C, dtype=x.dtype)
     dispatch = onehot[:, :, None] * slot_oh[:, None, :] * keep[:, None, None].astype(x.dtype)
     # pack tokens: (E, C, D)
-    xe = jnp.einsum("bec,bd->ecd", dispatch, x)
+    xe = jnp.einsum("bec,bd->ecd", dispatch, x, precision=prec)
     # route: split the E axis across devices, gather their contributions;
     # result: (E_local, n*C, D) — my experts' slots from every device
     xe = xe.reshape(n, E_local, C, D)
     xe = jax.lax.all_to_all(xe, axis, split_axis=0, concat_axis=0, tiled=False)
     xe = jnp.moveaxis(xe, 0, 1).reshape(E_local, n * C, D)
     # expert FFN (batched matmul on the MXU)
-    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, w1))
-    ye = jnp.einsum("ech,ehd->ecd", h, w2)  # (E_local, n*C, D)
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, w1, precision=prec))
+    ye = jnp.einsum("ech,ehd->ecd", h, w2, precision=prec)  # (E_local, n*C, D)
     # route back
     ye = jnp.moveaxis(ye.reshape(E_local, n, C, D), 1, 0)
     ye = jax.lax.all_to_all(ye, axis, split_axis=0, concat_axis=0, tiled=False)
     ye = ye.reshape(E, C, D)
     # combine: weight each token's slot output by its gate
     combine = dispatch * gate[:, None, None]  # (B, E, C)
-    return jnp.einsum("bec,ecd->bd", combine, ye)
+    return jnp.einsum("bec,ecd->bd", combine, ye, precision=prec)
 
 
 def moe_ffn(x, gate_w, w1, w2, mesh, axis="ep", capacity_factor=1.25):

@@ -31,6 +31,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import _NEG_INF, _block_update, _scale, flash_attention
+from ..ops.registry import fp32_precision
 
 __all__ = ["ring_attention", "ulysses_attention", "ring_attention_local", "ulysses_attention_local"]
 
@@ -58,6 +59,7 @@ def _ring_fwd_impl(q, k, v, axis, n, causal, sm_scale):
     scale = _scale(sm_scale, d)
     idx = lax.axis_index(axis)
     perm = [(j, (j + 1) % n) for j in range(n)]
+    prec = fp32_precision(q.dtype)
     qf = q.astype(jnp.float32)
     q_pos = idx * s_loc + jnp.arange(s_loc)
 
@@ -67,7 +69,8 @@ def _ring_fwd_impl(q, k, v, axis, n, causal, sm_scale):
         k_pos = src * s_loc + jnp.arange(s_loc)
         mask = (q_pos[:, None] >= k_pos[None, :]) if causal else None
         m, l, acc = _block_update(
-            qf, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32), m, l, acc, scale, mask
+            qf, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32), m, l, acc, scale, mask,
+            precision=prec,
         )
         k_blk = lax.ppermute(k_blk, axis, perm)
         v_blk = lax.ppermute(v_blk, axis, perm)
@@ -94,6 +97,7 @@ def _ring_bwd(axis, n, causal, sm_scale, res, g):
     scale = _scale(sm_scale, d)
     idx = lax.axis_index(axis)
     perm = [(j, (j + 1) % n) for j in range(n)]
+    prec = fp32_precision(q.dtype)
     qf = q.astype(jnp.float32)
     gf = g.astype(jnp.float32)
     delta = jnp.sum(out.astype(jnp.float32) * gf, axis=-1)  # (B,H,S_loc)
@@ -105,15 +109,20 @@ def _ring_bwd(axis, n, causal, sm_scale, res, g):
         k_pos = src * s_loc + jnp.arange(s_loc)
         kf = k_blk.astype(jnp.float32)
         vf = v_blk.astype(jnp.float32)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf, preferred_element_type=jnp.float32) * scale
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf, preferred_element_type=jnp.float32,
+                       precision=prec) * scale
         if causal:
             s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None, None], s, _NEG_INF)
         p = jnp.exp(s - lse[..., None])
-        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, gf, preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf, preferred_element_type=jnp.float32)
+        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, gf, preferred_element_type=jnp.float32,
+                                     precision=prec)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf, preferred_element_type=jnp.float32,
+                        precision=prec)
         ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf, preferred_element_type=jnp.float32)
-        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, qf, preferred_element_type=jnp.float32)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf, preferred_element_type=jnp.float32,
+                             precision=prec)
+        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, qf, preferred_element_type=jnp.float32,
+                                     precision=prec)
         # rotate the block AND its gradient accumulator together: after a full
         # circle both are back on the block's home device
         k_blk = lax.ppermute(k_blk, axis, perm)

@@ -4,7 +4,6 @@ against numpy references)."""
 import numpy as np
 import pytest
 
-import mxnet_tpu as mx
 from mxnet_tpu import ndarray as nd
 from mxnet_tpu import symbol as sym
 from mxnet_tpu.test_utils import (
