@@ -1,0 +1,65 @@
+"""Deep autoencoder (reference: example/autoencoder/autoencoder.py — stacked
+dense encoder/decoder trained end-to-end with an L2 reconstruction loss; the
+reference's layer-wise pretraining stage is folded into one joint fit, which
+modern initializers make unnecessary).
+
+Trains on synthetic digit templates; reports reconstruction MSE and shows the
+encoder compressing 784 -> 32 dims.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def autoencoder_net(dims=(784, 256, 64, 32)):
+    """Encoder 784->...->bottleneck, mirrored decoder, relu between layers
+    (linear last decoder layer), LinearRegressionOutput against the input."""
+    data = mx.sym.Variable("data")
+    x = data
+    for i, d in enumerate(dims[1:]):
+        x = mx.sym.FullyConnected(x, num_hidden=d, name="enc%d" % i)
+        if i < len(dims) - 2:
+            x = mx.sym.Activation(x, act_type="relu")
+    for i, d in enumerate(reversed(dims[:-1])):
+        x = mx.sym.FullyConnected(x, num_hidden=d, name="dec%d" % i)
+        if i < len(dims) - 2:
+            x = mx.sym.Activation(x, act_type="relu")
+    label = mx.sym.Variable("recon_label")
+    return mx.sym.LinearRegressionOutput(x, label=label, name="recon")
+
+
+def synthetic_digits(n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    templates = (rng.rand(10, 784) > 0.7).astype(np.float32)
+    label = rng.randint(0, 10, n)
+    data = templates[label] + 0.1 * rng.randn(n, 784)
+    return data.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--num-epoch", type=int, default=10)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data = synthetic_digits()
+    n_train = 3584
+    train = mx.io.NDArrayIter(data[:n_train], {"recon_label": data[:n_train]},
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(data[n_train:], {"recon_label": data[n_train:]},
+                            args.batch_size)
+
+    mod = mx.mod.Module(autoencoder_net(), label_names=["recon_label"])
+    mod.fit(train, eval_data=val, eval_metric="mse",
+            optimizer="adam", optimizer_params={"learning_rate": 0.001},
+            num_epoch=args.num_epoch,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    logging.info("final reconstruction %s", mod.score(val, mx.metric.create("mse")))
+
+
+if __name__ == "__main__":
+    main()

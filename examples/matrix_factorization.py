@@ -1,0 +1,71 @@
+"""Matrix-factorization recommender (reference: example/recommenders/ —
+demo1-MF notebook + symbol_alexnet-style plain MF: user/item Embeddings,
+elementwise product, LinearRegressionOutput on the rating).
+
+Trains on a synthetic low-rank rating matrix so the script converges anywhere;
+RMSE printed per epoch should fall well below the rating scale's std.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def matrix_fact_net(factor_size, num_users, num_items):
+    user = mx.sym.Variable("user")
+    item = mx.sym.Variable("item")
+    score = mx.sym.Variable("score_label")
+    u = mx.sym.Embedding(user, input_dim=num_users, output_dim=factor_size, name="user_embed")
+    v = mx.sym.Embedding(item, input_dim=num_items, output_dim=factor_size, name="item_embed")
+    pred = mx.sym.sum(u * v, axis=1)
+    return mx.sym.LinearRegressionOutput(pred, label=score, name="score")
+
+
+def synthetic_ratings(num_users, num_items, rank, n, seed=0):
+    rng = np.random.RandomState(seed)
+    pu = rng.randn(num_users, rank) / np.sqrt(rank)
+    qi = rng.randn(num_items, rank) / np.sqrt(rank)
+    users = rng.randint(0, num_users, n).astype(np.float32)
+    items = rng.randint(0, num_items, n).astype(np.float32)
+    scores = np.sum(pu[users.astype(int)] * qi[items.astype(int)], axis=1)
+    scores += 0.05 * rng.randn(n)
+    return users, items, scores.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-users", type=int, default=200)
+    p.add_argument("--num-items", type=int, default=300)
+    p.add_argument("--factor-size", type=int, default=16)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--num-epoch", type=int, default=8)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    users, items, scores = synthetic_ratings(
+        args.num_users, args.num_items, args.factor_size, 20000)
+    n_train = int(len(users) * 0.9)
+    train = mx.io.NDArrayIter(
+        {"user": users[:n_train], "item": items[:n_train]},
+        {"score_label": scores[:n_train]},
+        batch_size=args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(
+        {"user": users[n_train:], "item": items[n_train:]},
+        {"score_label": scores[n_train:]}, batch_size=args.batch_size)
+
+    net = matrix_fact_net(args.factor_size, args.num_users, args.num_items)
+    mod = mx.mod.Module(net, data_names=["user", "item"],
+                        label_names=["score_label"])
+    mod.fit(train, eval_data=val, eval_metric="rmse",
+            optimizer="adam", optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Normal(0.1),
+            num_epoch=args.num_epoch,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    score = mod.score(val, mx.metric.create("rmse"))
+    logging.info("final validation %s", score)
+
+
+if __name__ == "__main__":
+    main()

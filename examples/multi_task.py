@@ -1,0 +1,86 @@
+"""Multi-task training (reference: example/multi-task/example_multi_task.py —
+one shared trunk, two SoftmaxOutput heads grouped with mx.sym.Group, a module
+fed two labels, and a per-head accuracy metric).
+
+Task 1: classify the digit (10-way). Task 2: parity of the digit (2-way).
+Both heads share the trunk, so the losses back-propagate jointly.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def multi_task_net(num_classes=10):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=256)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    fc_digit = mx.sym.FullyConnected(net, name="fc_digit", num_hidden=num_classes)
+    fc_parity = mx.sym.FullyConnected(net, name="fc_parity", num_hidden=2)
+    digit = mx.sym.SoftmaxOutput(fc_digit, name="softmax_digit")
+    parity = mx.sym.SoftmaxOutput(fc_parity, name="softmax_parity")
+    return mx.sym.Group([digit, parity])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-head accuracy (the reference defines the same custom metric)."""
+
+    def __init__(self, num=2):
+        super().__init__("multi-accuracy", num=num)
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = preds[i].asnumpy().argmax(axis=1)
+            label = labels[i].asnumpy().astype(int).ravel()
+            self.sum_metric[i] += (pred == label).sum()
+            self.num_inst[i] += len(label)
+
+    def get(self):
+        return ["task%d-acc" % i for i in range(self.num)], [
+            s / max(n, 1) for s, n in zip(self.sum_metric, self.num_inst)]
+
+
+def synthetic_digits(n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(10, 784) > 0.7
+    label = rng.randint(0, 10, n)
+    data = templates[label] + 0.3 * rng.randn(n, 784)
+    return (data.astype(np.float32), label.astype(np.float32),
+            (label % 2).astype(np.float32))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--num-epoch", type=int, default=5)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data, digit, parity = synthetic_digits()
+    n_train = 3584
+    train = mx.io.NDArrayIter(
+        data[:n_train],
+        {"softmax_digit_label": digit[:n_train],
+         "softmax_parity_label": parity[:n_train]},
+        args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(
+        data[n_train:],
+        {"softmax_digit_label": digit[n_train:],
+         "softmax_parity_label": parity[n_train:]}, args.batch_size)
+
+    mod = mx.mod.Module(
+        multi_task_net(),
+        label_names=["softmax_digit_label", "softmax_parity_label"])
+    mod.fit(train, eval_data=val, eval_metric=MultiAccuracy(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=args.num_epoch,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    metric = MultiAccuracy()
+    logging.info("final validation %s", mod.score(val, metric))
+
+
+if __name__ == "__main__":
+    main()

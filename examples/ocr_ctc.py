@@ -1,0 +1,111 @@
+"""CTC sequence recognition (reference: example/warpctc/{lstm_ocr,toy_ctc}.py
+— captcha digit-string OCR trained with the warp-ctc plugin's CTC loss; here
+the same contract via mx.sym.contrib.CTCLoss / its WarpCTC alias).
+
+Synthetic task: a (seq_len, 16)-column "image" renders a variable-length
+digit string one glyph per region; an LSTM reads columns and CTC aligns
+frame-level predictions to the unsegmented label string (blank=0, labels
+1..10 for digits 0..9, 0-padded — the reference's label convention).
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def ctc_net(seq_len, feat_dim, num_hidden, num_classes):
+    data = mx.sym.Variable("data")            # (batch, seq_len, feat_dim)
+    label = mx.sym.Variable("label")          # (batch, max_label_len)
+    lstm = mx.rnn.LSTMCell(num_hidden=num_hidden, prefix="lstm_")
+    outputs, _ = lstm.unroll(seq_len, inputs=data, merge_outputs=True,
+                             layout="NTC")    # (batch, seq_len, hidden)
+    pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+    pred = mx.sym.FullyConnected(pred, num_hidden=num_classes, name="cls")
+    pred = mx.sym.Reshape(pred, shape=(-1, seq_len, num_classes))
+    pred = mx.sym.transpose(pred, axes=(1, 0, 2))  # CTC wants (T, N, C)
+    loss = mx.sym.WarpCTC(data=pred, label=label, name="ctc")
+    return mx.sym.Group([loss, mx.sym.BlockGrad(pred, name="pred")])
+
+
+def render_batch(rng, n, seq_len, feat_dim, max_len):
+    """Digit-string 'images': glyph = one-hot column band per digit."""
+    data = 0.05 * rng.randn(n, seq_len, feat_dim).astype(np.float32)
+    labels = np.zeros((n, max_len), np.float32)
+    for i in range(n):
+        k = rng.randint(2, max_len + 1)
+        digits = rng.randint(0, 10, k)
+        labels[i, :k] = digits + 1  # CTC labels are 1-based, 0 = blank/pad
+        width = seq_len // k
+        for j, d in enumerate(digits):
+            col = j * width + rng.randint(0, max(width - 2, 1))
+            data[i, col:col + 2, d] += 1.0  # glyph: bump feature row d
+    return data, labels
+
+
+class CTCLossMetric(mx.metric.EvalMetric):
+    """Mean CTC NLL from output 0 (output 1 is the block-grad'd frame preds)."""
+
+    def __init__(self):
+        super().__init__("ctc-loss")
+
+    def update(self, labels, preds):
+        loss = preds[0].asnumpy()
+        self.sum_metric += float(loss.sum())
+        self.num_inst += loss.shape[0]
+
+
+def greedy_decode(pred):
+    """argmax -> collapse repeats -> drop blanks (standard CTC decode)."""
+    seqs = []
+    for frames in pred.transpose(1, 0, 2).argmax(axis=2):
+        out, prev = [], 0
+        for f in frames:
+            if f != prev and f != 0:
+                out.append(int(f) - 1)
+            prev = f
+        seqs.append(out)
+    return seqs
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=24)
+    p.add_argument("--max-label-len", type=int, default=4)
+    p.add_argument("--num-epoch", type=int, default=12)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    feat_dim, num_hidden, num_classes = 16, 64, 11  # blank + 10 digits
+
+    rng = np.random.RandomState(0)
+    data, labels = render_batch(rng, 8192, args.seq_len, feat_dim,
+                                args.max_label_len)
+    train = mx.io.NDArrayIter({"data": data, "label": labels}, None,
+                              args.batch_size, shuffle=True)
+
+    net = ctc_net(args.seq_len, feat_dim, num_hidden, num_classes)
+    mod = mx.mod.Module(net, data_names=["data", "label"], label_names=None)
+    mod.fit(train, eval_metric=CTCLossMetric(),
+            optimizer="adam", optimizer_params={"learning_rate": 0.005},
+            num_epoch=args.num_epoch,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+
+    # exact-match accuracy with greedy decoding on fresh samples
+    test_data, test_labels = render_batch(rng, args.batch_size, args.seq_len,
+                                          feat_dim, args.max_label_len)
+    mod.forward(mx.io.DataBatch([mx.nd.array(test_data),
+                                 mx.nd.array(test_labels)], []),
+                is_train=False)
+    pred = mod.get_outputs()[1].asnumpy()
+    correct = total = 0
+    for seq, lab in zip(greedy_decode(pred), test_labels):
+        want = [int(x) - 1 for x in lab if x > 0]
+        correct += seq == want
+        total += 1
+    logging.info("greedy-decode exact match: %d/%d", correct, total)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Faster-RCNN region-proposal pipeline (reference: example/rcnn/ — the RPN +
+ROI stage built from the contrib Proposal op (proposal.cc) and ROIPooling
+(roi_pooling.cc); full VOC training descoped, this demo exercises the
+detection machinery end-to-end).
+
+A tiny RPN conv head runs over a synthetic feature map with one bright
+square "object"; mx.sym.contrib.Proposal turns scores+deltas into NMS'd ROIs
+and ROIPooling crops features for the (here untrained) second stage. The
+printed top ROI should cover the planted object.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def rpn_net(num_anchors, feature_stride, im_h, im_w):
+    data = mx.sym.Variable("data")           # (N, C, H, W) backbone features
+    im_info = mx.sym.Variable("im_info")     # (N, 3): h, w, scale
+    conv = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                              name="rpn_conv")
+    relu = mx.sym.Activation(conv, act_type="relu")
+    score = mx.sym.Convolution(relu, kernel=(1, 1), num_filter=2 * num_anchors,
+                               name="rpn_cls_score")
+    bbox = mx.sym.Convolution(relu, kernel=(1, 1), num_filter=4 * num_anchors,
+                              name="rpn_bbox_pred")
+    # softmax over (bg, fg) per anchor — reshape to expose the 2-way axis
+    score_r = mx.sym.Reshape(score, shape=(0, 2, -1, 0))
+    prob = mx.sym.SoftmaxActivation(score_r, mode="channel")
+    prob = mx.sym.Reshape(prob, shape=(0, 2 * num_anchors, -1, im_w // feature_stride),
+                          name="rpn_cls_prob")
+    rois = mx.sym.contrib.Proposal(
+        cls_prob=prob, bbox_pred=bbox, im_info=im_info,
+        feature_stride=feature_stride, scales=(4.0,), ratios=(0.5, 1.0, 2.0),
+        rpn_pre_nms_top_n=200, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4, name="proposal")
+    pooled = mx.sym.ROIPooling(data=data, rois=rois, pooled_size=(3, 3),
+                               spatial_scale=1.0 / feature_stride, name="roi_pool")
+    return mx.sym.Group([rois, pooled])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    stride, fh, fw, C = 8, 16, 16, 16
+    im_h, im_w = fh * stride, fw * stride
+    rng = np.random.RandomState(0)
+
+    # synthetic backbone features: background noise + a bright object square
+    feat = 0.1 * rng.randn(1, C, fh, fw).astype(np.float32)
+    oy, ox, osz = 5, 9, 4  # object occupies [oy:oy+osz, ox:ox+osz] in feat cells
+    feat[:, :, oy:oy + osz, ox:ox + osz] += 1.0
+    im_info = np.array([[im_h, im_w, 1.0]], np.float32)
+
+    num_anchors = 3  # one scale (32 px, the demo object's size) x three ratios
+    net = rpn_net(num_anchors, stride, im_h, im_w)
+    mod = mx.mod.Module(net, data_names=["data", "im_info"], label_names=None)
+    mod.bind([("data", feat.shape), ("im_info", im_info.shape)],
+             for_training=False)
+    # hand-crafted RPN weights: score = mean feature activation, so anchors on
+    # the object score high (a trained RPN arrives at the same shape)
+    mod.init_params(initializer=mx.init.Normal(0.01))
+    args_p, auxs_p = mod.get_params()
+    w = np.zeros(args_p["rpn_cls_score_weight"].shape, np.float32)
+    w[num_anchors:, :, 0, 0] = 1.0 / C  # fg channels pool the features
+    w[:num_anchors, :, 0, 0] = -1.0 / C
+    args_p["rpn_cls_score_weight"][:] = w
+    args_p["rpn_cls_score_bias"][:] = 0
+    args_p["rpn_bbox_pred_weight"][:] = 0  # no refinement: keep raw anchors
+    args_p["rpn_bbox_pred_bias"][:] = 0
+    wc = np.zeros(args_p["rpn_conv_weight"].shape, np.float32)
+    for c in range(min(32, C)):
+        wc[c, c % C, 1, 1] = 1.0  # identity-ish 3x3 center tap
+    args_p["rpn_conv_weight"][:] = wc
+    args_p["rpn_conv_bias"][:] = 0
+    mod.set_params(args_p, auxs_p)
+
+    mod.forward(mx.io.DataBatch([mx.nd.array(feat), mx.nd.array(im_info)], []),
+                is_train=False)
+    rois, pooled = [o.asnumpy() for o in mod.get_outputs()]
+    logging.info("proposals (batch_idx, x0, y0, x1, y1):\n%s", rois.round(1))
+    logging.info("roi-pooled features: %s", pooled.shape)
+
+    gt = np.array([ox * stride, oy * stride, (ox + osz) * stride, (oy + osz) * stride])
+
+    def iou(box):
+        x0, y0, x1, y1 = box
+        ix = max(0, min(x1, gt[2]) - max(x0, gt[0])) * max(0, min(y1, gt[3]) - max(y0, gt[1]))
+        union = (x1 - x0) * (y1 - y0) + (gt[2] - gt[0]) * (gt[3] - gt[1]) - ix
+        return ix / union
+
+    ious = [iou(r[1:]) for r in rois]
+    logging.info("proposal IoUs with planted object: top=%.2f best=%.2f",
+                 ious[0], max(ious))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""Stochastic-depth ResNet (reference: example/stochastic-depth/sd_module.py —
+residual blocks are randomly dropped during training (Huang et al. 2016); the
+reference rebuilds module groups per batch, here the drop decision is a
+Bernoulli scale baked into the graph the TPU way: a per-block random gate
+from the framework RNG, applied as `x + gate * block(x)` with the linear-
+decay survival schedule, so one compiled graph serves every batch).
+
+At eval, gates are replaced by their survival probabilities (the paper's
+expectation rule) via the Dropout-style train/eval switch inside the op.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def residual_block(x, num_filter, survival_p, name, stride=(1, 1), dim_match=True):
+    b = mx.sym.BatchNorm(x, name="%s_bn1" % name)
+    b = mx.sym.Activation(b, act_type="relu")
+    b = mx.sym.Convolution(b, num_filter=num_filter, kernel=(3, 3), pad=(1, 1),
+                           stride=stride, name="%s_conv1" % name)
+    b = mx.sym.BatchNorm(b, name="%s_bn2" % name)
+    b = mx.sym.Activation(b, act_type="relu")
+    b = mx.sym.Convolution(b, num_filter=num_filter, kernel=(3, 3), pad=(1, 1),
+                           name="%s_conv2" % name)
+    # stochastic-depth gate: Dropout(keep=p) of a per-sample constant 1 gives
+    # a 0/(1/p) Bernoulli at train time and exactly 1 at eval — multiplying
+    # the branch by p*gate yields the paper's train gate / eval expectation
+    # pair. The gate must be (N,1,1,1): ONE coin per sample drops the whole
+    # block (depth), not individual activations (that would be dropout)
+    ones = mx.sym.ones_like(mx.sym.slice_axis(b, axis=1, begin=0, end=1))
+    ones = mx.sym.Pooling(ones, global_pool=True, pool_type="avg", kernel=(1, 1))
+    gate = mx.sym.Dropout(ones, p=1.0 - survival_p, name="%s_gate" % name)
+    b = mx.sym.broadcast_mul(b, gate * survival_p)
+    if not dim_match:
+        x = mx.sym.Convolution(x, num_filter=num_filter, kernel=(1, 1),
+                               stride=stride, name="%s_proj" % name)
+    return x + b
+
+
+def sd_resnet(num_classes=10, blocks_per_stage=3, p_final=0.8):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                             name="conv0")
+    total = 3 * blocks_per_stage
+    bid = 0
+    for stage, nf in enumerate([16, 32, 64]):
+        for i in range(blocks_per_stage):
+            # linear-decay survival: p_l = 1 - l/L * (1 - p_final)
+            p_l = 1.0 - (bid + 1) / total * (1.0 - p_final)
+            first = i == 0 and stage > 0
+            net = residual_block(net, nf, p_l, "s%d_b%d" % (stage, i),
+                                 stride=(2, 2) if first else (1, 1),
+                                 dim_match=not first)
+            bid += 1
+    net = mx.sym.BatchNorm(net, name="bn_final")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg", kernel=(1, 1))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def synthetic_cifar(n=2048, num_classes=10, seed=0):
+    """Class signal must survive conv+global-avg-pool (which is position-
+    invariant): each class gets a distinct channel tint plus a class-specific
+    texture scale, not just a fixed pixel template."""
+    rng = np.random.RandomState(seed)
+    tint = rng.uniform(-0.5, 0.5, (num_classes, 3, 1, 1)).astype(np.float32)
+    label = rng.randint(0, num_classes, n)
+    data = 0.25 * rng.randn(n, 3, 32, 32).astype(np.float32)
+    data += tint[label]
+    return data.astype(np.float32), label.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--num-epoch", type=int, default=8)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data, label = synthetic_cifar()
+    n_train = 1792
+    train = mx.io.NDArrayIter(data[:n_train], label[:n_train],
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(data[n_train:], label[n_train:], args.batch_size)
+
+    mod = mx.mod.Module(sd_resnet())
+    mod.fit(train, eval_data=val, eval_metric="acc",
+            optimizer="adam", optimizer_params={"learning_rate": 0.002},
+            num_epoch=args.num_epoch,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+    logging.info("final validation %s", mod.score(val, mx.metric.create("acc")))
+
+
+if __name__ == "__main__":
+    main()
