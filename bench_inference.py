@@ -22,13 +22,17 @@ P100_BASELINE = {  # img/s, batch 32, fp32 (docs/how_to/perf.md:140-147)
     "inception-v3": 493.72,
     "resnet-50": 713.17,
     "resnet-152": 294.17,
+    # no published reference number for inception-resnet-v2 (perf.md omits it)
+    "inception-resnet-v2": None,
 }
 
 
 def build(name, batch):
     from mxnet_tpu import models
 
-    shape = (batch, 3, 299, 299) if name == "inception-v3" else (batch, 3, 224, 224)
+    shape = ((batch, 3, 299, 299)
+             if name in ("inception-v3", "inception-resnet-v2")
+             else (batch, 3, 224, 224))
     if name == "alexnet":
         net = models.alexnet(num_classes=1000)
     elif name == "vgg16":
@@ -37,6 +41,8 @@ def build(name, batch):
         net = models.inception_bn(num_classes=1000)
     elif name == "inception-v3":
         net = models.inception_v3(num_classes=1000)
+    elif name == "inception-resnet-v2":
+        net = models.inception_resnet_v2(num_classes=1000)
     elif name == "resnet-50":
         net = models.resnet(num_classes=1000, num_layers=50, image_shape="3,224,224")
     elif name == "resnet-152":
@@ -105,10 +111,11 @@ def main():
     names = only.split(",") if only else list(P100_BASELINE)
     for name in names:
         ips = bench_model(name, batch, steps, dtype)
+        base = P100_BASELINE.get(name)
         print(json.dumps({
             "model": name, "batch": batch, "dtype": dtype_name,
             "imgs_per_sec": round(ips, 2),
-            "vs_p100": round(ips / P100_BASELINE[name], 3),
+            "vs_p100": round(ips / base, 3) if base else None,
         }), flush=True)
 
 

@@ -55,6 +55,7 @@ def main():
 
     mod = mx.mod.Module(autoencoder_net(), label_names=["recon_label"])
     mod.fit(train, eval_data=val, eval_metric="mse",
+            initializer=mx.init.Xavier(),
             optimizer="adam", optimizer_params={"learning_rate": 0.001},
             num_epoch=args.num_epoch,
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))

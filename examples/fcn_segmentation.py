@@ -1,0 +1,96 @@
+"""Fully-convolutional semantic segmentation (reference: example/fcn-xs/ —
+FCN-8s/16s/32s over VGG: conv feature trunk, 1x1 score head, Deconvolution
+(bilinear-initialized) upsampling, Crop back to input geometry, and
+SoftmaxOutput(multi_output=True) per-pixel loss).
+
+Synthetic scenes: background plus colored rectangles of two classes; the
+FCN learns to label every pixel and reports mean pixel accuracy.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def fcn_net(num_classes=3):
+    data = mx.sym.Variable("data")
+    # conv trunk, stride 4 total (two 2x pools)
+    net = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3), pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Convolution(net, num_filter=32, kernel=(3, 3), pad=(1, 1), name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    # 1x1 score head -> deconv x4 upsample -> crop to input -> pixel softmax
+    score = mx.sym.Convolution(net, num_filter=num_classes, kernel=(1, 1), name="score")
+    up = mx.sym.Deconvolution(score, num_filter=num_classes, kernel=(8, 8),
+                              stride=(4, 4), pad=(2, 2), num_group=num_classes,
+                              no_bias=True, name="upsample")
+    up = mx.sym.Crop(up, data, name="crop")
+    return mx.sym.SoftmaxOutput(up, multi_output=True, use_ignore=True,
+                                ignore_label=255, name="softmax")
+
+
+def synthetic_scenes(n, size=32, seed=0):
+    """Background (class 0) + one rectangle each of classes 1 and 2, with
+    class-colored noisy pixels."""
+    rng = np.random.RandomState(seed)
+    data = 0.1 * rng.randn(n, 3, size, size).astype(np.float32)
+    label = np.zeros((n, size, size), np.float32)
+    colors = np.array([[0, 0, 0], [1.0, 0.1, 0.1], [0.1, 0.1, 1.0]], np.float32)
+    for i in range(n):
+        for cls in (1, 2):
+            h, w = rng.randint(6, 16, 2)
+            y, x = rng.randint(0, size - h), rng.randint(0, size - w)
+            label[i, y:y + h, x:x + w] = cls
+            data[i, :, y:y + h, x:x + w] += colors[cls][:, None, None]
+    return data, label
+
+
+def bilinear_init(shape):
+    """Bilinear upsampling kernel (the reference's fcn-xs init_fcnxs.py rule)."""
+    weight = np.zeros(shape, np.float32)
+    kh, kw = shape[2], shape[3]
+    factor = (kh + 1) // 2
+    center = factor - 1 if kh % 2 == 1 else factor - 0.5
+    og = np.ogrid[:kh, :kw]
+    filt = ((1 - abs(og[0] - center) / factor) *
+            (1 - abs(og[1] - center) / factor))
+    for g in range(shape[0]):
+        weight[g, 0] = filt
+    return weight
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-epoch", type=int, default=6)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data, label = synthetic_scenes(2048)
+    n_train = 1792
+    train = mx.io.NDArrayIter(data[:n_train], label[:n_train],
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(data[n_train:], label[n_train:], args.batch_size)
+
+    net = fcn_net()
+    mod = mx.mod.Module(net)
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    # bilinear-init the deconv filter like the reference's init_fcnxs
+    args_p, auxs_p = mod.get_params()
+    args_p["upsample_weight"][:] = bilinear_init(args_p["upsample_weight"].shape)
+    mod.set_params(args_p, auxs_p)
+
+    mod.fit(train, eval_data=val, eval_metric="acc",
+            optimizer="adam", optimizer_params={"learning_rate": 0.002},
+            num_epoch=args.num_epoch,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    logging.info("final pixel accuracy %s", mod.score(val, mx.metric.create("acc")))
+
+
+if __name__ == "__main__":
+    main()

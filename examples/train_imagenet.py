@@ -23,6 +23,7 @@ NETWORKS = {
                                         image_shape=a.image_shape, num_group=a.num_group),
     "inception-bn": lambda a: models.inception_bn(num_classes=a.num_classes),
     "inception-v3": lambda a: models.inception_v3(num_classes=a.num_classes),
+    "inception-resnet-v2": lambda a: models.inception_resnet_v2(num_classes=a.num_classes),
     "googlenet": lambda a: models.googlenet(num_classes=a.num_classes),
     "vgg": lambda a: models.vgg(num_classes=a.num_classes, num_layers=a.num_layers),
     "alexnet": lambda a: models.alexnet(num_classes=a.num_classes),

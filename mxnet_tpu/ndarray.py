@@ -634,6 +634,19 @@ def _make_ndarray_function(op_name):
             # allow e.g. nd.exp(np_array)
             ndargs = [array(a) if isinstance(a, (np.ndarray, list, tuple)) else a for a in args]
             ndargs = [a for a in ndargs if isinstance(a, NDArray)]
+        nd_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
+        if nd_kwargs:
+            # tensor keyword args (reference generated signatures accept e.g.
+            # nd.sample_normal(mu=..., sigma=...)): append in declared order
+            for k in nd_kwargs:
+                kwargs.pop(k)
+            names = list(op.arg_names(kwargs))
+            unknown = [k for k in nd_kwargs if k not in names]
+            if unknown:
+                raise MXNetError(
+                    "op %s got NDArray keyword(s) %s not among its inputs %s"
+                    % (op_name, unknown, names))
+            ndargs = ndargs + [nd_kwargs[n] for n in names if n in nd_kwargs]
         if op.key_var_num_args and op.key_var_num_args not in kwargs:
             kwargs[op.key_var_num_args] = len(ndargs)
         return imperative_invoke(op_name, ndargs, kwargs, out=out)

@@ -178,6 +178,15 @@ def _deconvolution(octx, attrs, args, auxs):
         (dilate[i] * (attrs["kernel"][i] - 1) - pad[i], dilate[i] * (attrs["kernel"][i] - 1) - pad[i] + (attrs["adj"][i] if attrs["adj"] else 0))
         for i in range(nd)
     ]
+    # MXNet deconv weight layout is (C_in, nf/ng, k...) with groups laid out
+    # along C_in; XLA's feature_group_count wants rhs (I=C_in/ng, O=nf) with
+    # groups along O — relayout when grouped (deconvolution-inl.h contract)
+    ng = attrs["num_group"]
+    if ng > 1:
+        cin, nf_pg = weight.shape[0], weight.shape[1]
+        w = weight.reshape((ng, cin // ng, nf_pg) + weight.shape[2:])
+        w = jnp.moveaxis(w, 0, 1)  # (cin_pg, ng, nf_pg, k...)
+        weight = w.reshape((cin // ng, ng * nf_pg) + weight.shape[2:])
     sp = "DHW"[3 - nd :]
     dn = jax.lax.conv_dimension_numbers(
         data.shape, weight.shape, ("NC" + sp, "IO" + sp, "NC" + sp)

@@ -43,7 +43,7 @@ _reg_sampler(
         key, shape, dtype=dt, minval=attrs["low"], maxval=attrs["high"]
     ),
     {"low": Param.float(0.0), "high": Param.float(1.0)},
-    aliases=("random_uniform", "uniform", "_sample_uniform"),
+    aliases=("random_uniform", "uniform"),
 )
 
 _reg_sampler(
@@ -51,7 +51,7 @@ _reg_sampler(
     lambda key, attrs, shape, dt: attrs["loc"]
     + attrs["scale"] * jax.random.normal(key, shape, dtype=dt),
     {"loc": Param.float(0.0), "scale": Param.float(1.0)},
-    aliases=("random_normal", "normal", "_sample_normal"),
+    aliases=("random_normal", "normal"),
 )
 
 _reg_sampler(
@@ -97,6 +97,65 @@ def _neg_binomial(key, k, p, shape):
     k1, k2 = jax.random.split(key)
     lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
     return jax.random.poisson(k2, lam, shape)
+
+
+# ------------------------------------------------------------- multisample
+# One draw-set PER ROW of NDArray distribution parameters (reference:
+# src/operator/tensor/multisample_op.cc — sample_uniform(low=arr, high=arr,
+# shape=S) -> arr.shape + S). vmap over the parameter rows with split keys.
+def _reg_multisampler(name, arg_names, draw):
+    @register(
+        name,
+        arg_names=tuple(arg_names),
+        params={"shape": Param.shape(()), "dtype": Param.dtype(None)},
+        stochastic=True,
+        alias=(name.lstrip("_"),),
+    )
+    def _fwd(octx, attrs, args, auxs, _draw=draw):
+        shape, dt = _shape_dtype(attrs)
+        pshape = args[0].shape
+        flat = [a.reshape(-1).astype(jnp.float32) for a in args]
+        keys = jax.random.split(octx.rng, flat[0].shape[0])
+        out = jax.vmap(lambda k, *ps: _draw(k, ps, shape, dt))(keys, *flat)
+        return [jax.lax.stop_gradient(out.reshape(pshape + tuple(shape)))], []
+
+    def _infer(attrs, in_shapes, aux_shapes, _n=len(arg_names)):
+        p = next((s for s in in_shapes if s is not None), None)
+        if p is None:
+            raise ValueError("%s: parameter shape required" % name)
+        out = tuple(p) + tuple(attrs["shape"] or ())
+        return [tuple(p)] * _n, [out], []
+
+    from .registry import get_op
+
+    get_op(name)._infer_shape = _infer
+    return _fwd
+
+
+_reg_multisampler(
+    "_sample_uniform", ("low", "high"),
+    lambda k, ps, s, dt: jax.random.uniform(k, s, minval=ps[0], maxval=ps[1]).astype(dt or np.float32),
+)
+_reg_multisampler(
+    "_sample_normal", ("mu", "sigma"),
+    lambda k, ps, s, dt: (ps[0] + ps[1] * jax.random.normal(k, s)).astype(dt or np.float32),
+)
+_reg_multisampler(
+    "_sample_gamma", ("alpha", "beta"),
+    lambda k, ps, s, dt: (ps[1] * jax.random.gamma(k, ps[0], s)).astype(dt or np.float32),
+)
+_reg_multisampler(
+    "_sample_exponential", ("lam",),
+    lambda k, ps, s, dt: (jax.random.exponential(k, s) / ps[0]).astype(dt or np.float32),
+)
+_reg_multisampler(
+    "_sample_poisson", ("lam",),
+    lambda k, ps, s, dt: jax.random.poisson(k, ps[0], s).astype(dt or np.float32),
+)
+_reg_multisampler(
+    "_sample_negative_binomial", ("k", "p"),
+    lambda k, ps, s, dt: _neg_binomial(k, ps[0], ps[1], s).astype(dt or np.float32),
+)
 
 
 @register(

@@ -52,13 +52,18 @@ def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.
             for item in inputs:
                 input_node = nodes[item[0]]
                 input_name = input_node["name"]
+                is_data_input = input_node["op"] == "null" and shape is not None and input_name in shape
                 if input_node["op"] != "null" or item[0] in heads:
                     pre_node.append(input_name)
-                    if show_shape:
-                        key = input_name + "_output" if input_node["op"] != "null" else input_name
-                        if key in shape_dict:
-                            shape = shape_dict[key][1:]
-                            pre_filter = pre_filter + int(shape[0]) if shape else pre_filter
+                if show_shape and (input_node["op"] != "null" or item[0] in heads
+                                   or is_data_input):
+                    # data variables named in `shape` count toward the fan-in
+                    # (else a first conv/fc layer reports bias-only params);
+                    # weight/bias variables stay excluded
+                    key = input_name + "_output" if input_node["op"] != "null" else input_name
+                    if key in shape_dict:
+                        in_shape = shape_dict[key][1:]
+                        pre_filter = pre_filter + int(in_shape[0]) if in_shape else pre_filter
         cur_param = 0
         attrs = node.get("attrs", {})
         if op == "Convolution":

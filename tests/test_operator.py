@@ -224,6 +224,34 @@ def test_deconvolution_shape_inverse():
     arg_shapes, _, _ = deconv.infer_shape(x=(1, 3, 8, 8))
 
 
+def test_deconvolution_grouped():
+    # grouped deconv == per-group deconvs concatenated (the num_group=C
+    # bilinear-upsampling pattern from the reference's fcn-xs example)
+    ng, cin_pg, nf_pg = 3, 2, 2
+    cin, nf = ng * cin_pg, ng * nf_pg
+    x = rng.rand(2, cin, 5, 5).astype(np.float32)
+    w = rng.rand(cin, nf_pg, 4, 4).astype(np.float32)
+    deconv = sym.Deconvolution(
+        sym.Variable("x"), sym.Variable("w"), kernel=(4, 4), num_filter=nf,
+        num_group=ng, stride=(2, 2), pad=(1, 1), no_bias=True)
+    ex = deconv.simple_bind(default_context(), x=x.shape, w=w.shape)
+    ex.arg_dict["x"][:] = x
+    ex.arg_dict["w"][:] = w
+    out = ex.forward()[0].asnumpy()
+
+    single = sym.Deconvolution(
+        sym.Variable("x"), sym.Variable("w"), kernel=(4, 4), num_filter=nf_pg,
+        stride=(2, 2), pad=(1, 1), no_bias=True)
+    for g in range(ng):
+        exg = single.simple_bind(default_context(), x=(2, cin_pg, 5, 5),
+                                 w=(cin_pg, nf_pg, 4, 4))
+        exg.arg_dict["x"][:] = x[:, g * cin_pg:(g + 1) * cin_pg]
+        exg.arg_dict["w"][:] = w[g * cin_pg:(g + 1) * cin_pg]
+        ref = exg.forward()[0].asnumpy()
+        assert_almost_equal(out[:, g * nf_pg:(g + 1) * nf_pg], ref,
+                            rtol=1e-4, atol=1e-5)
+
+
 def test_pooling():
     x = rng.rand(1, 2, 6, 6).astype(np.float32)
     v = sym.Variable("x")

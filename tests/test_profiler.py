@@ -1,0 +1,70 @@
+"""Profiler API (reference: tests/python/unittest/test_profiler.py — set
+config, run, execute work, stop, dump, check the chrome-trace JSON)."""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import profiler
+
+
+def test_profile_imperative_and_executor(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    profiler.profiler_set_config(mode="all", filename=fname)
+    profiler.profiler_set_state("run")
+
+    a = nd.array(np.random.rand(16, 16).astype(np.float32))
+    b = nd.array(np.random.rand(16, 16).astype(np.float32))
+    c = nd.dot(a, b)
+    c.wait_to_read()
+
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    ex = y.simple_bind(mx.cpu(), x=(2, 8))
+    ex.forward()
+    ex.outputs[0].wait_to_read()
+
+    profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+
+    with open(fname) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert events, "no spans recorded"
+    names = {e["name"] for e in events}
+    cats = {e["cat"] for e in events}
+    assert any("dot" in n for n in names), names
+    assert "operator" in cats
+    for e in events:  # chrome-trace complete events
+        assert e["ph"] == "X" and "ts" in e and "dur" in e
+
+
+def test_symbolic_mode_filters_imperative_spans(tmp_path):
+    fname = str(tmp_path / "profile_sym.json")
+    profiler.profiler_set_config(mode="symbolic", filename=fname)
+    profiler.profiler_set_state("run")
+    a = nd.array(np.ones((4, 4), np.float32))
+    (a + a).wait_to_read()
+    profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    assert not [e for e in events if e["cat"] == "operator"]
+
+
+def test_profiler_restart_clears_events(tmp_path):
+    fname = str(tmp_path / "profile2.json")
+    profiler.profiler_set_config(mode="all", filename=fname)
+    profiler.profiler_set_state("run")
+    nd.array(np.ones(4, np.float32)).wait_to_read()
+    profiler.profiler_set_state("stop")
+    # second run: events reset, only the new work appears
+    profiler.profiler_set_state("run")
+    x = nd.array(np.ones(4, np.float32))
+    nd.exp(x).wait_to_read()
+    profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    assert any("exp" in e["name"] for e in events)

@@ -1,0 +1,83 @@
+"""RNG samplers (reference: tests/python/unittest/test_random.py — moment
+checks for each distribution family plus seed determinism across the
+imperative and symbolic paths)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+N = (50000,)
+
+
+def setup_function(_):
+    mx.random.seed(7)
+
+
+def test_uniform_moments():
+    x = mx.random.uniform(low=-2.0, high=4.0, shape=N).asnumpy()
+    assert x.min() >= -2.0 and x.max() < 4.0
+    np.testing.assert_allclose(x.mean(), 1.0, atol=0.05)
+    np.testing.assert_allclose(x.std(), 6 / np.sqrt(12), atol=0.05)
+
+
+def test_normal_moments():
+    x = mx.random.normal(loc=3.0, scale=2.0, shape=N).asnumpy()
+    np.testing.assert_allclose(x.mean(), 3.0, atol=0.05)
+    np.testing.assert_allclose(x.std(), 2.0, atol=0.05)
+
+
+def test_gamma_moments():
+    x = nd.random_gamma(alpha=4.0, beta=0.5, shape=N).asnumpy()
+    # mean = k*theta = 4*0.5, var = k*theta^2
+    np.testing.assert_allclose(x.mean(), 2.0, atol=0.05)
+    np.testing.assert_allclose(x.var(), 1.0, atol=0.1)
+
+
+def test_exponential_poisson_negbinomial_moments():
+    x = nd.random_exponential(lam=2.0, shape=N).asnumpy()
+    np.testing.assert_allclose(x.mean(), 0.5, atol=0.02)
+    p = nd.random_poisson(lam=3.0, shape=N).asnumpy()
+    np.testing.assert_allclose(p.mean(), 3.0, atol=0.05)
+    np.testing.assert_allclose(p.var(), 3.0, atol=0.15)
+    # negative binomial: k failures, success prob p -> mean k(1-p)/p
+    b = nd.random_negative_binomial(k=5, p=0.5, shape=N).asnumpy()
+    np.testing.assert_allclose(b.mean(), 5.0, atol=0.15)
+
+
+def test_randint_range_and_spread():
+    x = nd.random_randint(low=0, high=10, shape=N).asnumpy()
+    assert x.min() == 0 and x.max() == 9
+    counts = np.bincount(x.astype(int), minlength=10) / N[0]
+    np.testing.assert_allclose(counts, 0.1, atol=0.01)
+
+
+def test_seed_determinism_imperative():
+    mx.random.seed(123)
+    a = mx.random.uniform(shape=(64,)).asnumpy()
+    mx.random.seed(123)
+    b = mx.random.uniform(shape=(64,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = mx.random.uniform(shape=(64,)).asnumpy()
+    assert not np.array_equal(b, c)  # chain advances
+
+
+def test_seed_determinism_symbolic():
+    s = mx.sym.random_normal(loc=0, scale=1, shape=(32,), name="rn")
+    mx.random.seed(99)
+    ex = s.simple_bind(mx.cpu())
+    a = ex.forward()[0].asnumpy()
+    mx.random.seed(99)
+    ex2 = s.simple_bind(mx.cpu())
+    b = ex2.forward()[0].asnumpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sample_ops_multi_distribution():
+    # _sample_* ops draw one set per distribution parameter row
+    mu = nd.array(np.array([0.0, 10.0], np.float32))
+    sig = nd.array(np.array([1.0, 0.1], np.float32))
+    x = nd.sample_normal(mu=mu, sigma=sig, shape=(20000,)).asnumpy()
+    assert x.shape == (2, 20000)
+    np.testing.assert_allclose(x[0].mean(), 0.0, atol=0.05)
+    np.testing.assert_allclose(x[1].mean(), 10.0, atol=0.05)
+    np.testing.assert_allclose(x[1].std(), 0.1, atol=0.02)

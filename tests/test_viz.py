@@ -1,0 +1,49 @@
+"""Visualization (reference: tests/python/unittest/test_viz.py —
+plot_network renders a graphviz digraph; print_summary walks the graph with
+shapes and parameter counts)."""
+import io
+import contextlib
+
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _small_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="conv")
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.Activation(net, act_type="relu", name="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max", name="pool")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_print_summary_counts_params():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        mx.viz.print_summary(_small_net(), shape={"data": (1, 1, 8, 8)})
+    out = buf.getvalue()
+    assert "conv" in out and "fc" in out
+    # conv: 8*1*3*3+8 = 80; fc: 10*(8*4*4)+10 = 1290 ; total 1386 (+bn 16 trainable)
+    assert "Total params" in out
+    total = int([l for l in out.splitlines() if "Total params" in l][0].split()[-1])
+    assert total == 80 + 1290 + 16
+
+
+def test_plot_network_digraph():
+    pytest.importorskip("graphviz")
+    dot = mx.viz.plot_network(_small_net(), shape={"data": (1, 1, 8, 8)},
+                              save_format="dot")
+    src = dot.source
+    for node in ("conv", "fc", "softmax"):
+        assert node in src
+    # shape labels drawn on edges when shapes are given
+    assert "8x8" in src or "1x8x8" in src
+
+
+def test_plot_network_rejects_non_symbol():
+    pytest.importorskip("graphviz")
+    with pytest.raises(TypeError):
+        mx.viz.plot_network([1, 2, 3])
