@@ -88,6 +88,15 @@ def parse_bool(s):
     return str(s).strip().lower() in ("true", "1", "yes")
 
 
+def env_flag(name, default="0"):
+    """Boolean MXNET_*-style env var: anything but 0/empty/false/no/off is on
+    (the dmlc::GetEnv<bool> convention the reference's ~25 env knobs use)."""
+    import os
+
+    return os.environ.get(name, default).strip().lower() not in (
+        "0", "", "false", "no", "off")
+
+
 def parse_int_or_none(s):
     if s is None or (isinstance(s, str) and s.strip() in ("None", "")):
         return None

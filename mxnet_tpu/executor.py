@@ -318,8 +318,9 @@ class Executor:
         # graph_executor.cc:213-226 — rebuild cheap activations in backward
         # instead of keeping them): jax.checkpoint over the whole forward is
         # the TPU analog; XLA rematerializes instead of storing residuals.
-        do_mirror = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0").strip().lower() not in (
-            "0", "", "false", "no", "off")
+        from .base import env_flag
+
+        do_mirror = env_flag("MXNET_BACKWARD_DO_MIRROR")
 
         def run(args, auxs, out_grads, rng):
             def f(diff_args):

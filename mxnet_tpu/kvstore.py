@@ -223,8 +223,9 @@ class KVStore:
         """Whether this process is restarting into an existing job (reference:
         ps::Postoffice::is_recovery(), used to skip the init barrier on
         restart, kvstore_dist.h:39-42). Set DMLC_PS_RECOVERY=1 on relaunch."""
-        return os.environ.get("DMLC_PS_RECOVERY", "0").strip().lower() not in (
-            "0", "", "false", "no", "off")
+        from .base import env_flag
+
+        return env_flag("DMLC_PS_RECOVERY")
 
     def save_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot save states for distributed training"

@@ -640,7 +640,7 @@ def _make_ndarray_function(op_name):
             # nd.sample_normal(mu=..., sigma=...)): append in declared order
             for k in nd_kwargs:
                 kwargs.pop(k)
-            names = list(op.arg_names(kwargs))
+            names = list(op.arg_names(kwargs)) + list(op.aux_names(kwargs))
             unknown = [k for k in nd_kwargs if k not in names]
             if unknown:
                 raise MXNetError(

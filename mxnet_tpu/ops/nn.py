@@ -405,12 +405,18 @@ def _batch_norm(octx, attrs, args, auxs):
     if attrs["fix_gamma"]:
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
     if octx.is_train and not attrs["use_global_stats"]:
-        # stats stay fp32 end to end even when the graph runs bf16 — the
-        # reduction, the moving-average update, and the rsqrt all happen at
-        # full precision; only the normalization math drops to x's dtype
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=red)
-        var = jnp.var(xf, axis=red)
+        # stats stay fp32 end to end even when the graph runs bf16, via the
+        # numerically exact two-pass mean/var — but with the fp32 converts
+        # INLINE in each reduction chain rather than one shared astype: a
+        # single-consumer convert fuses into its reduce (no materialized
+        # fp32 activation copy — the HBM-bound train step cares), whereas
+        # the shared xf = astype(f32) fed two consumers and stayed
+        # materialized. One-pass E[x^2]-E[x]^2 is NOT safe here: squaring
+        # in bf16 then cancelling collapses variance for channels with
+        # |mean|/std beyond ~20.
+        mean = jnp.mean(x, axis=red, dtype=jnp.float32)
+        centered = x.astype(jnp.float32) - mean.reshape(bshape)
+        var = jnp.mean(jnp.square(centered), axis=red)
         m = attrs["momentum"]
         new_mean = mmean * m + jax.lax.stop_gradient(mean) * (1 - m)
         new_var = mvar * m + jax.lax.stop_gradient(var) * (1 - m)

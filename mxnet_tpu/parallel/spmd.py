@@ -134,6 +134,10 @@ class SPMDTrainer:
 
         compute_dtype = self.compute_dtype
 
+        from ..base import env_flag
+
+        do_mirror = env_flag("MXNET_BACKWARD_DO_MIRROR")
+
         def step(params, auxs, moms, inputs, rng):
             aux_list = [auxs[n] for n in aux_order]
 
@@ -143,6 +147,13 @@ class SPMDTrainer:
                 outs, new_aux = graph_fn(assemble(p, inputs), aux_list, rng, True)
                 new_aux = [a.astype(np.float32) for a in new_aux]
                 return outs, new_aux
+
+            if do_mirror:
+                # activation recompute (MXNET_BACKWARD_DO_MIRROR, same knob as
+                # the Executor path): rematerialize instead of storing
+                # residuals — trades FLOPs for HBM, which can WIN on a
+                # bandwidth-bound step
+                f = jax.checkpoint(f)
 
             outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
             seeds = [

@@ -122,8 +122,9 @@ def dump_profile():
 def _maybe_autostart():
     import atexit
 
-    if os.environ.get("MXNET_PROFILER_AUTOSTART", "0").strip().lower() not in (
-            "0", "", "false", "no", "off"):
+    from .base import env_flag
+
+    if env_flag("MXNET_PROFILER_AUTOSTART"):
         # default filename is pid-suffixed: launched clusters (tools/launch.py)
         # propagate the env to every process, and a shared name would leave
         # only the last exiter's trace

@@ -314,6 +314,49 @@ def test_batchnorm_training_stats():
     np.testing.assert_allclose(ex.outputs[0].asnumpy(), expected_inf, rtol=1e-3, atol=1e-4)
 
 
+def test_batchnorm_bf16_high_mean_variance():
+    # regression: stats must survive |mean|/std >> 1 in bf16 graphs — a
+    # one-pass E[x^2]-E[x]^2 with bf16 squares collapses var to 0 here
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import OpContext, get_op
+
+    rng_ = np.random.RandomState(3)
+    x = (50.0 + 0.1 * rng_.randn(8, 4, 8, 8)).astype(np.float32)
+    op = get_op("BatchNorm")
+    octx = OpContext(is_train=True, rng=None)
+    attrs = {"eps": 1e-3, "momentum": 0.9, "fix_gamma": False,
+             "use_global_stats": False, "output_mean_var": True, "axis": 1,
+             "cudnn_off": False}
+    gamma = jnp.ones(4); beta = jnp.zeros(4)
+    outs, _ = op.forward(octx, attrs,
+                         [jnp.asarray(x, jnp.bfloat16), gamma, beta],
+                         [jnp.zeros(4), jnp.ones(4)])
+    var = np.asarray(outs[2], np.float32)
+    # oracle = fp32 variance of the bf16-QUANTIZED input (at mean 50 the
+    # bf16 grid spacing is 0.25, which itself adds variance — that loss
+    # happens at the input, not in the op)
+    xq = np.asarray(jnp.asarray(x, jnp.bfloat16), np.float32)
+    true_var = xq.var(axis=(0, 2, 3))
+    np.testing.assert_allclose(var, true_var, rtol=0.02)
+    assert (var > 0.001).all()  # the one-pass formula collapsed these to 0
+
+
+def test_op_kwargs_including_aux():
+    # generated nd.* functions accept tensor keyword args for args AND aux
+    # states (reference generated signatures), and reject unknown names
+    x = rng.rand(2, 3, 4, 4).astype(np.float32)
+    out_pos = nd.BatchNorm(nd.array(x), nd.ones((3,)), nd.zeros((3,)),
+                           nd.zeros((3,)), nd.ones((3,)),
+                           fix_gamma=False).asnumpy()
+    out_kw = nd.BatchNorm(data=nd.array(x), gamma=nd.ones((3,)),
+                          beta=nd.zeros((3,)), moving_mean=nd.zeros((3,)),
+                          moving_var=nd.ones((3,)), fix_gamma=False).asnumpy()
+    np.testing.assert_allclose(out_pos, out_kw, rtol=1e-6)
+    with pytest.raises(Exception, match="NDArray keyword"):
+        nd.dot(a=nd.ones((2, 2)), wrong=nd.ones((2, 2)))
+
+
 def test_dropout():
     x = np.ones((200, 200), np.float32)
     d = sym.Dropout(sym.Variable("x"), p=0.5)
