@@ -42,6 +42,47 @@ run_tpu() {
   MXNET_TPU_REQUIRE_HW=1 python -m pytest tests_tpu/ -q
 }
 
+run_examples() {
+  # smoke-run every example at its smallest configuration (reference CI's
+  # tests/python/train + example notebooks axis). Opt-in: ~25 min.
+  local fast=(
+    "train_imagenet.py --num-epochs 1 --num-examples 64 --batch-size 16 --num-classes 10 --num-layers 18"
+    "train_ssd.py --num-epochs 1 --num-examples 32 --batch-size 8"
+    "train_mnist.py --num-epochs 1"
+    "train_cifar10.py --num-epochs 1"
+    "train_lm.py --num-epochs 1 --seq-len 32 --num-layers 1"
+    "lstm_bucketing.py --num-epochs 1"
+    "dcgan.py --num-epochs 1 --steps-per-epoch 4"
+    "adversary_fgsm.py --num-epochs 1"
+    "memcost.py"
+    "profiler_example.py --iters 2"
+    "model_parallel_lstm.py"
+    "matrix_factorization.py --num-epoch 1"
+    "cnn_text_classification.py --num-epoch 1"
+    "nce_loss.py --num-epoch 1"
+    "svm_mnist.py --num-epoch 1"
+    "multi_task.py --num-epoch 1"
+    "bi_lstm_sort.py --num-epoch 1"
+    "autoencoder.py --num-epoch 1"
+    "stochastic_depth.py --num-epoch 1"
+    "ocr_ctc.py --num-epoch 1"
+    "rcnn_proposal.py"
+    "numpy_ops.py --num-epoch 1"
+    "fcn_segmentation.py --num-epoch 1"
+    "generate_text.py --num-epochs 1 --gen-len 4"
+    "dec_clustering.py --pretrain-epochs 2 --refine-iters 5"
+  )
+  local failed=0
+  for inv in "${fast[@]}"; do
+    echo "=== examples/$inv"
+    if ! PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+         python examples/${inv} >/tmp/example_ci.log 2>&1; then
+      echo "FAILED: $inv (tail of log:)"; tail -5 /tmp/example_ci.log; failed=1
+    fi
+  done
+  return $failed
+}
+
 case "$stage" in
   unit) run_unit ;;
   native) run_native ;;
@@ -49,8 +90,9 @@ case "$stage" in
   entry) run_entry ;;
   bench) run_bench ;;
   tpu) run_tpu ;;
+  examples) run_examples ;;
   all) run_native; run_predict; run_entry;
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py ;;
-  *) echo "unknown stage: $stage (unit|native|predict|entry|bench|tpu|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|predict|entry|bench|tpu|examples|all)"; exit 2 ;;
 esac

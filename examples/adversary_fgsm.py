@@ -38,7 +38,7 @@ def main():
     net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
     net = mx.sym.SoftmaxOutput(net, name="softmax")
 
-    mod = mx.mod.Module(net)
+    mod = mx.mod.Module(net, context=mx.context.auto())
     it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
     mod.fit(it, num_epoch=args.num_epochs, optimizer="sgd",
             optimizer_params={"learning_rate": 0.1},

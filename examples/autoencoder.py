@@ -53,7 +53,7 @@ def main():
     val = mx.io.NDArrayIter(data[n_train:], {"recon_label": data[n_train:]},
                             args.batch_size)
 
-    mod = mx.mod.Module(autoencoder_net(), label_names=["recon_label"])
+    mod = mx.mod.Module(autoencoder_net(), label_names=["recon_label"], context=mx.context.auto())
     mod.fit(train, eval_data=val, eval_metric="mse",
             initializer=mx.init.Xavier(),
             optimizer="adam", optimizer_params={"learning_rate": 0.001},

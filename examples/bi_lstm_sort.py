@@ -55,7 +55,7 @@ def main():
     val = mx.io.NDArrayIter(data[n_train:], label[n_train:], args.batch_size)
 
     net = bi_lstm_sort_net(args.seq_len, args.vocab_size)
-    mod = mx.mod.Module(net)
+    mod = mx.mod.Module(net, context=mx.context.auto())
     mod.fit(train, eval_data=val, eval_metric="acc",
             optimizer="adam", optimizer_params={"learning_rate": 0.01},
             num_epoch=args.num_epoch,

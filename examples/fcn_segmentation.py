@@ -77,7 +77,7 @@ def main():
     val = mx.io.NDArrayIter(data[n_train:], label[n_train:], args.batch_size)
 
     net = fcn_net()
-    mod = mx.mod.Module(net)
+    mod = mx.mod.Module(net, context=mx.context.auto())
     mod.bind(train.provide_data, train.provide_label)
     mod.init_params(initializer=mx.init.Xavier())
     # bilinear-init the deconv filter like the reference's init_fcnxs

@@ -57,7 +57,7 @@ def main():
 
     net = matrix_fact_net(args.factor_size, args.num_users, args.num_items)
     mod = mx.mod.Module(net, data_names=["user", "item"],
-                        label_names=["score_label"])
+                        label_names=["score_label"], context=mx.context.auto())
     mod.fit(train, eval_data=val, eval_metric="rmse",
             optimizer="adam", optimizer_params={"learning_rate": 0.01},
             initializer=mx.init.Normal(0.1),

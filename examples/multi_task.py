@@ -72,7 +72,7 @@ def main():
 
     mod = mx.mod.Module(
         multi_task_net(),
-        label_names=["softmax_digit_label", "softmax_parity_label"])
+        label_names=["softmax_digit_label", "softmax_parity_label"], context=mx.context.auto())
     mod.fit(train, eval_data=val, eval_metric=MultiAccuracy(),
             optimizer="sgd",
             optimizer_params={"learning_rate": 0.1, "momentum": 0.9},

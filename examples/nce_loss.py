@@ -84,7 +84,7 @@ def main():
     # label/label_weight enter as DATA (the iterator supplies all three); the
     # loss reads label_weight through the symbol, so no module label binding
     mod = mx.mod.Module(net, data_names=["data", "label", "label_weight"],
-                        label_names=None)
+                        label_names=None, context=mx.context.auto())
     mod.fit(train, eval_metric=NceAccuracy(),
             optimizer="adam", optimizer_params={"learning_rate": 0.01},
             num_epoch=args.num_epoch,

@@ -72,7 +72,7 @@ def main():
     train = mx.io.NDArrayIter(data[:3584], label[:3584], args.batch_size,
                               shuffle=True)
     val = mx.io.NDArrayIter(data[3584:], label[3584:], args.batch_size)
-    mod = mx.mod.Module(net)
+    mod = mx.mod.Module(net, context=mx.context.auto())
     mod.fit(train, eval_data=val, eval_metric="acc",
             optimizer="sgd",
             optimizer_params={"learning_rate": 0.1, "momentum": 0.9},

@@ -86,7 +86,7 @@ def main():
                               args.batch_size, shuffle=True)
 
     net = ctc_net(args.seq_len, feat_dim, num_hidden, num_classes)
-    mod = mx.mod.Module(net, data_names=["data", "label"], label_names=None)
+    mod = mx.mod.Module(net, data_names=["data", "label"], label_names=None, context=mx.context.auto())
     mod.fit(train, eval_metric=CTCLossMetric(),
             optimizer="adam", optimizer_params={"learning_rate": 0.005},
             num_epoch=args.num_epoch,

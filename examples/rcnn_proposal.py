@@ -58,7 +58,7 @@ def main():
 
     num_anchors = 3  # one scale (32 px, the demo object's size) x three ratios
     net = rpn_net(num_anchors, stride, im_h, im_w)
-    mod = mx.mod.Module(net, data_names=["data", "im_info"], label_names=None)
+    mod = mx.mod.Module(net, data_names=["data", "im_info"], label_names=None, context=mx.context.auto())
     mod.bind([("data", feat.shape), ("im_info", im_info.shape)],
              for_training=False)
     # hand-crafted RPN weights: score = mean feature activation, so anchors on

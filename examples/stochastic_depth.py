@@ -88,7 +88,7 @@ def main():
                               args.batch_size, shuffle=True)
     val = mx.io.NDArrayIter(data[n_train:], label[n_train:], args.batch_size)
 
-    mod = mx.mod.Module(sd_resnet())
+    mod = mx.mod.Module(sd_resnet(), context=mx.context.auto())
     mod.fit(train, eval_data=val, eval_metric="acc",
             optimizer="adam", optimizer_params={"learning_rate": 0.002},
             num_epoch=args.num_epoch,

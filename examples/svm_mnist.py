@@ -48,7 +48,7 @@ def main():
     val = mx.io.NDArrayIter(data[n_train:], label[n_train:], args.batch_size,
                             label_name="svm_label")
 
-    mod = mx.mod.Module(svm_net(use_linear=args.l1), label_names=["svm_label"])
+    mod = mx.mod.Module(svm_net(use_linear=args.l1), label_names=["svm_label"], context=mx.context.auto())
     mod.fit(train, eval_data=val, eval_metric="acc",
             optimizer="sgd",
             optimizer_params={"learning_rate": 0.1, "momentum": 0.9},

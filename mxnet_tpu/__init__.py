@@ -46,10 +46,19 @@ from . import profiler
 from . import rtc
 from . import torch_bridge
 from . import torch_bridge as th
+from . import torch_bridge as torch
 from . import parallel
 from . import contrib
 from . import test_utils
 from . import utils
+from . import log
+from . import notebook
+from . import symbol_doc
+from . import ndarray_doc
+from . import kvstore_server
+from . import random as rnd
+from . import image as img
+from . import monitor as mon
 
 # later-MXNet convenience aliases: mx.nd.contrib.<op> / mx.sym.contrib.<op>
 ndarray.contrib = contrib.ndarray

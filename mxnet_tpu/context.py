@@ -121,6 +121,14 @@ def num_tpus():
         return 0
 
 
+def auto(device_id=0):
+    """Best available context: ``tpu(device_id)`` when a chip is visible,
+    else ``cpu(device_id)``. Not in the reference (its scripts take --gpus);
+    the examples use this to pick the accelerator automatically."""
+    return (Context("tpu", device_id) if num_tpus()
+            else Context("cpu", device_id))
+
+
 def num_gpus():
     """Reference-script compatibility alias for :func:`num_tpus`."""
     return num_tpus()

@@ -155,6 +155,13 @@ class KVStore:
                 merged = self._comm.reduce(vs)
             if self._updater is not None:
                 idx = k if isinstance(k, int) else _str_key_int(k)
+                # the update runs on the STORED weight's device: the merged
+                # grad may live on whichever device owned the reduce
+                # (CommDevice load-balances owners, comm.h:333-361), so copy
+                # it over first — the reference's CommDevice does the same
+                # before running updater_ on the store
+                if merged.context != self._store[k].context:
+                    merged = merged.as_in_context(self._store[k].context)
                 self._updater(idx, merged, self._store[k])
             else:
                 self._store[k] = merged.copy()

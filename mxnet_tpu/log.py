@@ -1,0 +1,60 @@
+"""Logging utilities (reference: python/mxnet/log.py — a level-colored,
+caller-located formatter and ``get_logger`` factory used by the example
+scripts)."""
+import logging
+import sys
+import warnings
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+_LABELS = {CRITICAL: "C", ERROR: "E", WARNING: "W", INFO: "I", DEBUG: "D"}
+
+
+class _Formatter(logging.Formatter):
+    """glog-style line: colored level letter + time + pid + location."""
+
+    def __init__(self, colored=True):
+        super().__init__(datefmt="%m%d %H:%M:%S")
+        self._colored = colored
+
+    def format(self, record):
+        label = _LABELS.get(record.levelno, "U")
+        loc = "%(asctime)s %(process)d %(pathname)s:%(funcName)s:%(lineno)d"
+        if self._colored:
+            color = ("\x1b[31m" if record.levelno >= WARNING
+                     else "\x1b[32m" if record.levelno >= INFO else "\x1b[34m")
+            fmt = color + label + loc + "]\x1b[0m %(message)s"
+        else:
+            fmt = label + loc + "] %(message)s"
+        self._style._fmt = fmt
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """A logger with the colored glog-style formatter (colors only when the
+    target is a tty; files always get plain text)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxnet_tpu_init", False):
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        handler.setFormatter(_Formatter(colored=False))
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(_Formatter(colored=sys.stderr.isatty()))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxnet_tpu_init = True
+    return logger
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """Deprecated alias (the reference kept it with a warning)."""
+    warnings.warn("getLogger is deprecated, use get_logger instead.",
+                  DeprecationWarning, stacklevel=2)
+    return get_logger(name, filename, filemode, level)

@@ -86,6 +86,11 @@ def imperative_invoke(op_name, ndargs, attrs, out=None):
     import jax
 
     op = get_op(op_name)
+    # ctx must be read BEFORE canonicalize_attrs, which drops non-op attrs —
+    # losing it mis-tagged creation-op outputs as cpu(0), and the next
+    # in-place write then dragged device-resident params back to host (the
+    # Module-on-TPU path silently trained on CPU because of this)
+    ctx_attr = attrs.pop("ctx", None) if isinstance(attrs, dict) else None
     attrs, _extra = op.canonicalize_attrs(attrs)
     n_expected = len(op.arg_names(attrs))
     aux_names = op.aux_names(attrs)
@@ -101,17 +106,27 @@ def imperative_invoke(op_name, ndargs, attrs, out=None):
         if isinstance(a, NDArray):
             ctx = a.context
             break
+    dev = None
     if ctx is None:
-        ctx = attrs.pop("ctx", None) or current_context()
+        ctx = ctx_attr or current_context()
         dev = ctx.jax_device
         args = [jax.device_put(a, dev) for a in args]
     is_train = _TRAIN_MODE[0]
     rng = None
     if op.stochastic:
-        rng = jax.device_put(_random.next_key(), ctx.jax_device)
+        rng = jax.device_put(_random.next_key(), dev if dev is not None
+                             else ctx.jax_device)
     fn = _get_jitted(op, attrs, len(args), len(auxs), is_train)
     with _profiler.record_span(op_name, "operator"):
-        outs, new_auxs = fn(args, auxs, rng)
+        if dev is not None and not args:
+            # creation op (no committed inputs): pin to the requested
+            # context instead of jax's process default. Ops WITH inputs get
+            # their placement from the committed args — no manager needed
+            # on that hot path.
+            with jax.default_device(dev):
+                outs, new_auxs = fn(args, auxs, rng)
+        else:
+            outs, new_auxs = fn(args, auxs, rng)
     # write updated aux back into the caller's arrays (FMutateInputs semantics)
     for nda, new in zip(ndargs[n_expected:], new_auxs):
         if isinstance(nda, NDArray):
@@ -461,6 +476,117 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
 
 def concatenate(arrays, axis=0, always_copy=True):
     return imperative_invoke("Concat", list(arrays), {"num_args": len(arrays), "dim": axis})
+
+
+# ---- module-level binary helpers (reference: ndarray.py's _ufunc_helper
+# family — each accepts NDArray|scalar on either side) ----------------------
+def _module_binary(lhs, rhs, op, scalar_op, rscalar_op=None):
+    if isinstance(lhs, NDArray):
+        if isinstance(rhs, NDArray):
+            return imperative_invoke(op, [lhs, rhs], {})
+        return imperative_invoke(scalar_op, [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, NDArray):
+        if rscalar_op is None:  # commutative
+            return imperative_invoke(scalar_op, [rhs], {"scalar": float(lhs)})
+        return imperative_invoke(rscalar_op, [rhs], {"scalar": float(lhs)})
+    raise TypeError("at least one operand must be an NDArray")
+
+
+def add(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_add", "_plus_scalar")
+
+
+def subtract(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_sub", "_minus_scalar", "_rminus_scalar")
+
+
+def multiply(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_mul", "_mul_scalar")
+
+
+def divide(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_div", "_div_scalar", "_rdiv_scalar")
+
+
+true_divide = divide
+
+
+def power(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_power", "_power_scalar", "_rpower_scalar")
+
+
+def maximum(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_maximum", "_maximum_scalar")
+
+
+def minimum(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_minimum", "_minimum_scalar")
+
+
+def equal(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_equal", "_equal_scalar")
+
+
+def not_equal(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_not_equal", "_not_equal_scalar")
+
+
+def greater(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_greater", "_greater_scalar", "_lesser_scalar")
+
+
+def greater_equal(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_greater_equal", "_greater_equal_scalar",
+                          "_lesser_equal_scalar")
+
+
+def lesser(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_lesser", "_lesser_scalar", "_greater_scalar")
+
+
+def lesser_equal(lhs, rhs):
+    return _module_binary(lhs, rhs, "broadcast_lesser_equal", "_lesser_equal_scalar",
+                          "_greater_equal_scalar")
+
+
+def moveaxis(tensor, source, destination):
+    """(reference: ndarray.py moveaxis — transpose with one axis moved;
+    numpy axis normalization: negatives count from the end, out-of-range
+    raises)"""
+    nd_ = tensor.ndim
+
+    def _norm(ax, what):
+        if not -nd_ <= ax < nd_:
+            raise ValueError("%s %d out of bounds for %d-d array" % (what, ax, nd_))
+        return ax + nd_ if ax < 0 else ax
+
+    source = _norm(source, "source")
+    destination = _norm(destination, "destination")
+    axes = list(range(nd_))
+    axes.pop(source)
+    axes.insert(destination, source)
+    return imperative_invoke("transpose", [tensor], {"axes": tuple(axes)})
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mean=None):
+    """Decode an image buffer (reference: ndarray.py imdecode wraps the
+    opencv plugin; here it forwards to mx.image.imdecode)."""
+    from . import image as _image
+
+    arr = _image.imdecode(str_img, flag=1 if channels == 3 else 0)
+    arr = imperative_invoke("transpose", [arr], {"axes": (2, 0, 1)})  # HWC->CHW
+    if any(clip_rect):
+        x0, y0, x1, y1 = clip_rect
+        arr = arr[:, y0:y1, x0:x1]
+    if mean is not None:
+        arr = arr - mean
+    if out is not None:
+        if out.ndim == 4:  # batched out: write slot `index` (reference contract)
+            out[index] = arr
+        else:
+            out._set_data(arr.data.astype(out.dtype))
+        return out
+    return arr
 
 
 def waitall():

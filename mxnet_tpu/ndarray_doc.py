@@ -1,0 +1,3 @@
+"""NDArray operator documentation (reference: python/mxnet/ndarray_doc.py —
+see symbol_doc.py; one doc generator serves both namespaces here)."""
+from .op_doc import attach_docs, build_doc  # noqa: F401

@@ -1,0 +1,3 @@
+"""Notebook utilities (reference: python/mxnet/notebook/ — live training
+visualizations for Jupyter)."""
+from . import callback  # noqa: F401

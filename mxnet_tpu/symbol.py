@@ -636,6 +636,34 @@ from . import op_doc as _op_doc  # noqa: E402
 _op_doc.attach_docs(_cur_module, list_ops(), "symbolic")
 
 
+def _module_binary(lhs, rhs, op, scalar_op, rscalar_op=None):
+    """(reference: symbol.py's pow/maximum/minimum/hypot module functions —
+    Symbol|scalar on either side)"""
+    if isinstance(lhs, Symbol):
+        if isinstance(rhs, Symbol):
+            return _create(op, [lhs, rhs], {})
+        return _create(scalar_op, [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, Symbol):
+        return _create(rscalar_op or scalar_op, [rhs], {"scalar": float(lhs)})
+    raise TypeError("at least one operand must be a Symbol")
+
+
+def pow(lhs, rhs):
+    return _module_binary(lhs, rhs, "_power", "_power_scalar", "_rpower_scalar")
+
+
+def maximum(lhs, rhs):
+    return _module_binary(lhs, rhs, "_maximum", "_maximum_scalar")
+
+
+def minimum(lhs, rhs):
+    return _module_binary(lhs, rhs, "_minimum", "_minimum_scalar")
+
+
+def hypot(lhs, rhs):
+    return _module_binary(lhs, rhs, "_hypot", "_hypot_scalar")
+
+
 def zeros(shape, dtype=None, **kwargs):
     return getattr(_cur_module, "_zeros")(shape=shape, dtype=dtype, **kwargs)
 

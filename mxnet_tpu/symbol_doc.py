@@ -1,0 +1,4 @@
+"""Symbol operator documentation (reference: python/mxnet/symbol_doc.py —
+extended docstrings attached to generated symbol functions; here generation
+lives in op_doc.py, re-exported under the reference's module name)."""
+from .op_doc import attach_docs, build_doc  # noqa: F401

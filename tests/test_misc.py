@@ -346,3 +346,54 @@ def test_monitor_and_callbacks():
     param = BatchEndParam(epoch=0, nbatch=50, eval_metric=metric, locals=None)
     Speedometer(batch_size=2, frequent=50)(param)
     log_train_metric(50)(param)
+
+
+def test_log_module():
+    import io as _io
+    import logging
+
+    from mxnet_tpu import log as mxlog
+
+    logger = mxlog.get_logger("mxtest", level=mxlog.DEBUG)
+    stream = _io.StringIO()
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(mxlog._Formatter(colored=False))
+    logger.addHandler(handler)
+    logger.info("hello %d", 7)
+    out = stream.getvalue()
+    assert "hello 7" in out and out.startswith("I")  # glog-style level letter
+    # idempotent: second get_logger must not add duplicate handlers
+    n = len(logger.handlers)
+    assert len(mxlog.get_logger("mxtest").handlers) == n
+
+
+def test_notebook_pandas_logger():
+    import mxnet_tpu as mx
+    from mxnet_tpu.model import BatchEndParam
+    from mxnet_tpu.notebook.callback import PandasLogger
+
+    pl = PandasLogger(batch_size=4, frequent=1)
+    metric = mx.metric.Accuracy()
+    metric.update([nd.array(np.zeros(2))], [nd.array(np.zeros((2, 2)))])
+    param = BatchEndParam(epoch=0, nbatch=1, eval_metric=metric, locals=None)
+    pl.train_cb(param)
+    pl.eval_cb(param)
+    pl.epoch_cb(epoch=0)
+    assert len(pl.train_df) == 1 and "accuracy" in pl.train_df.columns
+    assert len(pl.eval_df) == 1 and len(pl.epoch_df) == 1
+    assert set(pl.callback_args()) == {
+        "batch_end_callback", "eval_batch_end_callback", "epoch_end_callback"}
+
+
+def test_top_level_namespace_parity():
+    # every module the reference's mxnet/__init__.py exposes exists here
+    import mxnet_tpu as mx
+
+    for name in ["base", "contrib", "ndarray", "nd", "name", "sym", "symbol",
+                 "symbol_doc", "ndarray_doc", "io", "recordio", "operator",
+                 "rnd", "random", "optimizer", "model", "notebook",
+                 "initializer", "init", "visualization", "viz", "callback",
+                 "lr_scheduler", "kv", "kvstore_server", "rtc", "AttrScope",
+                 "monitor", "mon", "torch", "th", "profiler", "log", "module",
+                 "mod", "image", "img", "test_utils", "rnn", "metric"]:
+        assert hasattr(mx, name), name

@@ -209,3 +209,47 @@ def test_save_format_byte_compatible_with_reference():
     # our save must emit the identical bytes
     nd.save(path, {"w": nd.array(vals)})
     assert open(path, "rb").read() == blob
+
+
+def test_module_level_binary_helpers():
+    # reference ndarray.py module fns: NDArray|scalar on either side
+    a = nd.array(np.array([1.0, 4.0, 9.0], np.float32))
+    b = nd.array(np.array([2.0, 2.0, 2.0], np.float32))
+    np.testing.assert_allclose(nd.add(a, b).asnumpy(), [3, 6, 11])
+    np.testing.assert_allclose(nd.subtract(10, a).asnumpy(), [9, 6, 1])
+    np.testing.assert_allclose(nd.multiply(a, 2).asnumpy(), [2, 8, 18])
+    np.testing.assert_allclose(nd.divide(18, a).asnumpy(), [18, 4.5, 2])
+    np.testing.assert_allclose(nd.power(a, 0.5).asnumpy(), [1, 2, 3])
+    np.testing.assert_allclose(nd.power(2, b).asnumpy(), [4, 4, 4])
+    np.testing.assert_allclose(nd.maximum(a, 5).asnumpy(), [5, 5, 9])
+    np.testing.assert_allclose(nd.minimum(a, b).asnumpy(), [1, 2, 2])
+    np.testing.assert_allclose(nd.greater(a, 4).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose(nd.greater(4, a).asnumpy(), [1, 0, 0])
+    np.testing.assert_allclose(nd.lesser_equal(a, 4).asnumpy(), [1, 1, 0])
+    np.testing.assert_allclose(nd.equal(a, 4).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose(nd.not_equal(a, 4).asnumpy(), [1, 0, 1])
+
+
+def test_moveaxis():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert nd.moveaxis(x, 0, 2).shape == (3, 4, 2)
+    np.testing.assert_allclose(nd.moveaxis(x, 0, 2).asnumpy(),
+                               np.moveaxis(x.asnumpy(), 0, 2))
+
+
+def test_symbol_module_binary_helpers():
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.test_utils import default_context
+
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = sym.Group([sym.maximum(a, b), sym.minimum(a, 1.5), sym.pow(2, b),
+                     sym.hypot(a, b)])
+    ex = out.simple_bind(default_context(), a=(3,), b=(3,))
+    ex.arg_dict["a"][:] = np.array([1, 2, 3], np.float32)
+    ex.arg_dict["b"][:] = np.array([3, 2, 1], np.float32)
+    res = [o.asnumpy() for o in ex.forward()]
+    np.testing.assert_allclose(res[0], [3, 2, 3])
+    np.testing.assert_allclose(res[1], [1, 1.5, 1.5])
+    np.testing.assert_allclose(res[2], [8, 4, 2])
+    np.testing.assert_allclose(res[3], np.hypot([1, 2, 3], [3, 2, 1]), rtol=1e-6)
