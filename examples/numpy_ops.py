@@ -3,10 +3,12 @@ numpy_softmax,weighted_logistic_regression}.py — implement an op's forward
 AND backward in numpy via CustomOp/CustomOpProp, register it, and train a
 net that uses it like any built-in).
 
-Two ops are shown: a numpy softmax-with-CE-loss head (the reference's
-canonical example) and a weighted logistic head. On TPU the custom op runs
-as a host callback inside the compiled step — the escape hatch for logic XLA
-can't express.
+The numpy softmax-with-CE-loss head (the reference's canonical example) is
+implemented with forward AND backward in numpy. Custom python ops execute on
+the HOST — inside a device graph they become host callbacks, so this example
+keeps the whole model on CPU (the reference's NumpyOp likewise ran CPU-side
+even in GPU models; transports without host-callback support can't run them
+in-device at all).
 """
 import argparse
 import logging
@@ -72,7 +74,11 @@ def main():
     train = mx.io.NDArrayIter(data[:3584], label[:3584], args.batch_size,
                               shuffle=True)
     val = mx.io.NDArrayIter(data[3584:], label[3584:], args.batch_size)
-    mod = mx.mod.Module(net, context=mx.context.auto())
+    # custom python ops run as host callbacks inside the compiled step; on
+    # transports without host-callback support (e.g. tunneled PJRT) the CPU
+    # context keeps the whole graph host-side — the reference's NumpyOp was
+    # likewise CPU-executed even in GPU models
+    mod = mx.mod.Module(net, context=mx.cpu())
     mod.fit(train, eval_data=val, eval_metric="acc",
             optimizer="sgd",
             optimizer_params={"learning_rate": 0.1, "momentum": 0.9},

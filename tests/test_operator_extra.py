@@ -1,0 +1,135 @@
+"""Additional per-op numerics mirroring specific reference test behaviors
+(reference: tests/python/unittest/test_operator.py — test_convolution_grouping
+:int, test_binary_op_duplicate_input, test_index2d/batch_take, log_softmax,
+maximum_minimum mixed grads)."""
+import numpy as np
+
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import (
+    assert_almost_equal, check_numeric_gradient, check_symbolic_forward,
+    default_context,
+)
+
+rng = np.random.RandomState(7)
+
+
+def test_convolution_grouping():
+    # grouped conv == per-group convs concatenated (reference
+    # test_operator.py test_convolution_grouping)
+    ng, cin_pg, nf_pg = 2, 3, 4
+    cin, nf = ng * cin_pg, ng * nf_pg
+    x = rng.rand(2, cin, 7, 7).astype(np.float32)
+    w = rng.rand(nf, cin_pg, 3, 3).astype(np.float32)
+    b = rng.rand(nf).astype(np.float32)
+    conv = sym.Convolution(sym.Variable("x"), sym.Variable("w"), sym.Variable("b"),
+                           kernel=(3, 3), num_filter=nf, num_group=ng)
+    ex = conv.simple_bind(default_context(), x=x.shape, w=w.shape, b=b.shape)
+    ex.arg_dict["x"][:] = x
+    ex.arg_dict["w"][:] = w
+    ex.arg_dict["b"][:] = b
+    out = ex.forward()[0].asnumpy()
+
+    single = sym.Convolution(sym.Variable("x"), sym.Variable("w"), sym.Variable("b"),
+                             kernel=(3, 3), num_filter=nf_pg)
+    for g in range(ng):
+        exg = single.simple_bind(default_context(), x=(2, cin_pg, 7, 7),
+                                 w=(nf_pg, cin_pg, 3, 3), b=(nf_pg,))
+        exg.arg_dict["x"][:] = x[:, g * cin_pg:(g + 1) * cin_pg]
+        exg.arg_dict["w"][:] = w[g * nf_pg:(g + 1) * nf_pg]
+        exg.arg_dict["b"][:] = b[g * nf_pg:(g + 1) * nf_pg]
+        ref = exg.forward()[0].asnumpy()
+        assert_almost_equal(out[:, g * nf_pg:(g + 1) * nf_pg], ref,
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_binary_op_duplicate_input():
+    # d(x*x)/dx must be 2x — both input slots feed the same array
+    # (reference test_binary_op_duplicate_input)
+    x = rng.rand(3, 4).astype(np.float32) + 0.5
+    v = sym.Variable("x")
+    prod = v * v
+    ex = prod.simple_bind(default_context(), x=x.shape, grad_req="write")
+    ex.arg_dict["x"][:] = x
+    out = ex.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(out, x * x, rtol=1e-5)
+    ex.backward(out_grads=nd.array(np.ones_like(x)))
+    assert_almost_equal(ex.grad_dict["x"].asnumpy(), 2 * x, rtol=1e-5)
+
+
+def test_batch_take_index2d():
+    # batch_take / pick with 2-d indices (reference test_index2d)
+    data = rng.rand(5, 7).astype(np.float32)
+    idx = rng.randint(0, 7, 5).astype(np.float32)
+    out = nd.batch_take(nd.array(data), nd.array(idx)).asnumpy()
+    expect = data[np.arange(5), idx.astype(int)]
+    assert_almost_equal(out, expect, rtol=1e-6)
+
+
+def test_log_softmax():
+    x = rng.rand(4, 10).astype(np.float32) * 10
+    v = sym.Variable("x")
+    ls = sym.log_softmax(v)
+    xf = x - x.max(axis=1, keepdims=True)
+    expect = xf - np.log(np.exp(xf).sum(axis=1, keepdims=True))
+    check_symbolic_forward(ls, {"x": x}, [expect], rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(ls, {"x": x}, rtol=0.05, atol=0.05)
+
+
+def test_maximum_minimum_grads():
+    # gradient routes to whichever side won the elementwise comparison
+    # (reference test_maximum_minimum)
+    a = rng.rand(3, 4).astype(np.float32)
+    b = rng.rand(3, 4).astype(np.float32)
+    va, vb = sym.Variable("a"), sym.Variable("b")
+    out = sym.maximum(va, vb) + sym.minimum(va, vb)
+    ex = out.simple_bind(default_context(), a=a.shape, b=b.shape)
+    ex.arg_dict["a"][:] = a
+    ex.arg_dict["b"][:] = b
+    res = ex.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(res, np.maximum(a, b) + np.minimum(a, b), rtol=1e-5)
+    ex.backward(out_grads=nd.array(np.ones_like(a)))
+    # max+min = a+b identically, so both grads are exactly 1
+    assert_almost_equal(ex.grad_dict["a"].asnumpy(), np.ones_like(a), rtol=1e-6)
+    assert_almost_equal(ex.grad_dict["b"].asnumpy(), np.ones_like(b), rtol=1e-6)
+
+
+def test_convolution_dilated_impulse_response():
+    # a centered impulse through a dilated conv reproduces the dilated kernel
+    # footprint (reference test_convolution_dilated_impulse_response)
+    for dil in [(1, 1), (2, 2), (3, 3)]:
+        x = np.zeros((1, 1, 15, 15), np.float32)
+        x[0, 0, 7, 7] = 1.0
+        w = np.ones((1, 1, 3, 3), np.float32)
+        conv = sym.Convolution(sym.Variable("x"), sym.Variable("w"),
+                               kernel=(3, 3), num_filter=1, dilate=dil,
+                               no_bias=True, pad=(dil[0], dil[1]))
+        ex = conv.simple_bind(default_context(), x=x.shape, w=w.shape)
+        ex.arg_dict["x"][:] = x
+        ex.arg_dict["w"][:] = w
+        out = ex.forward()[0].asnumpy()[0, 0]
+        nz = np.transpose(np.nonzero(out))
+        # 9 taps at spacing == dilation, centered on the impulse
+        assert len(nz) == 9
+        assert {tuple(p) for p in nz} == {
+            (7 + dy * dil[0], 7 + dx * dil[1])
+            for dy in (-1, 0, 1) for dx in (-1, 0, 1)}
+
+
+def test_flip_op():
+    x = rng.rand(2, 3, 4).astype(np.float32)
+    for ax in range(3):
+        out = nd.flip(nd.array(x), axis=ax).asnumpy()
+        assert_almost_equal(out, np.flip(x, axis=ax), rtol=1e-6)
+
+
+def test_quantize_dequantize_roundtrip():
+    # contrib quantize -> dequantize round-trips within one quantization step
+    x = rng.uniform(-3, 3, (4, 5)).astype(np.float32)
+    q, qmin, qmax = nd.contrib.quantize(
+        nd.array(x), nd.array(np.array([x.min()], np.float32)),
+        nd.array(np.array([x.max()], np.float32)), out_type="uint8")
+    deq = nd.contrib.dequantize(
+        q, qmin, qmax, out_type="float32").asnumpy()
+    step = (x.max() - x.min()) / 255.0
+    assert np.abs(deq - x).max() <= step + 1e-6

@@ -2,3 +2,4 @@
 (reference: tests/python/gpu/test_operator_gpu.py imports the CPU suite and
 re-executes it on the device — the key portability harness, SURVEY §4)."""
 from test_operator import *  # noqa: F401,F403
+from test_operator_extra import *  # noqa: F401,F403
