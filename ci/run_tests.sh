@@ -44,7 +44,8 @@ run_tpu() {
 
 run_examples() {
   # smoke-run every example at its smallest configuration (reference CI's
-  # tests/python/train + example notebooks axis). Opt-in: ~25 min.
+  # tests/python/train + example notebooks axis). Opt-in: ~50 min on a
+  # tunneled single chip (each script pays a fresh compile).
   local fast=(
     "train_imagenet.py --num-epochs 1 --num-examples 64 --batch-size 16 --num-classes 10 --num-layers 18"
     "train_ssd.py --num-epochs 1 --num-examples 32 --batch-size 8"
