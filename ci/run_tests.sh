@@ -29,6 +29,10 @@ run_predict() {
 run_entry() {
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.entry(); g.dryrun_multichip(8); print('entry ok')"
+  # driver-robustness variant: TPU plugin stays visible (JAX_PLATFORMS unset,
+  # not inherited); dryrun_multichip must force the CPU platform itself
+  env -u JAX_PLATFORMS XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('entry ok (tpu visible)')"
   # docs/operators.md is generated — fail if it drifted from the registry
   python tools/gen_op_docs.py
   git diff --exit-code docs/operators.md
