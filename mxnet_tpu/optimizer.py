@@ -375,7 +375,9 @@ class RMSProp(Optimizer):
             g._set_data(res_g)
             delta._set_data(res_d)
         if self.clip_weights:
-            weight._set_data(nd.clip(weight, -self.clip_weights, self.clip_weights).data)
+            weight._set_data(
+                nd.clip(weight, a_min=-self.clip_weights, a_max=self.clip_weights).data
+            )
 
 
 @register

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..executor import build_graph_fn
 from ..ops.registry import get_op
+from . import fused_opt
 
 __all__ = ["SPMDTrainer"]
 
@@ -32,6 +33,8 @@ class SPMDTrainer:
                  donate=True, compute_dtype=None, input_dtype=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .. import optimizer as opt_mod
 
         self.symbol = symbol
         self.mesh = mesh
@@ -48,11 +51,26 @@ class SPMDTrainer:
         self.arg_shapes = dict(zip(self.arg_names, arg_shapes))
         self.aux_shapes = dict(zip(self.aux_names, aux_shapes))
         self.out_shapes = out_shapes
-        opt_params = dict(optimizer_params or {})
-        self.lr = opt_params.get("learning_rate", 0.01)
-        self.momentum = opt_params.get("momentum", 0.0)
-        self.wd = opt_params.get("wd", 0.0)
-        self.rescale_grad = opt_params.get("rescale_grad", 1.0)
+        # optimizer: string (created with name-keyed mults so lr_mult/wd_mult
+        # and __lr_mult__/__wd_mult__ symbol attrs resolve like the serial
+        # path) or a ready Optimizer instance. The fused rule raises on
+        # unsupported optimizers — never silently trains with different math.
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(
+                optimizer, sym=symbol,
+                param_idx2name={n: n for n in self.param_names},
+                **dict(optimizer_params or {}),
+            )
+        elif isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params:
+                raise ValueError(
+                    "optimizer_params cannot be combined with a ready "
+                    "Optimizer instance; configure the instance directly"
+                )
+        else:
+            raise TypeError("optimizer must be a name or an Optimizer instance")
+        self.optimizer = optimizer
+        self.rule = fused_opt.make_rule(optimizer)
         self.dtype = dtype
         # mixed precision: master params stay `dtype` (fp32); the graph runs in
         # `compute_dtype` (bf16 on TPU — MXU-native) with fp32 accumulation via
@@ -107,13 +125,22 @@ class SPMDTrainer:
             host = nd.zeros(self.aux_shapes[n])
             initializer(n, host)
             auxs[n] = jax.device_put(host.asnumpy().astype(np.float32), self.repl)
-        moms = {
-            n: jax.device_put(
-                np.zeros(self.arg_shapes[n], self.dtype), self.param_shardings[n]
+        states = self.init_opt_state()
+        return params, auxs, states
+
+    def init_opt_state(self):
+        """Fresh optimizer state: dict name -> tuple of slot arrays, each slot
+        sharded like its parameter (so e.g. tp-sharded weights get tp-sharded
+        momenta and the update stays fully local)."""
+        import jax
+
+        return {
+            n: tuple(
+                jax.device_put(s, self.param_shardings[n])
+                for s in self.rule.init_state(self.arg_shapes[n], self.dtype)
             )
             for n in self.param_names
-        } if self.momentum else {}
-        return params, auxs, moms
+        }
 
     def _build_step(self):
         import jax
@@ -129,7 +156,9 @@ class SPMDTrainer:
             return [params[n] if n not in data_set else inputs[n] for n in arg_order]
 
         loss_flags = self._loss_flags
-        lr, momentum, wd, rescale = self.lr, self.momentum, self.wd, self.rescale_grad
+        rule = self.rule
+        base_wd = self.optimizer.wd
+        lr_mult, wd_mult = fused_opt.mults_for(self.optimizer, self.param_names)
         graph_fn = self._graph_fn
 
         compute_dtype = self.compute_dtype
@@ -138,7 +167,7 @@ class SPMDTrainer:
 
         do_mirror = env_flag("MXNET_BACKWARD_DO_MIRROR")
 
-        def step(params, auxs, moms, inputs, rng):
+        def step(params, auxs, states, inputs, rng, lr, t):
             aux_list = [auxs[n] for n in aux_order]
 
             def f(p):
@@ -162,27 +191,26 @@ class SPMDTrainer:
             ]
             grads = vjp_fn(list(seeds))[0]
             new_params = {}
-            new_moms = {}
+            new_states = {}
             for n in params:
-                g = grads[n].astype(params[n].dtype) * rescale + wd * params[n]
-                if momentum:
-                    m = momentum * moms[n] - lr * g
-                    new_moms[n] = m
-                    new_params[n] = params[n] + m
-                else:
-                    new_params[n] = params[n] - lr * g
+                g = grads[n].astype(params[n].dtype)
+                # lr_mult/wd_mult are python floats: they constant-fold into
+                # the trace; lr/t stay dynamic so schedulers never retrace
+                new_params[n], new_states[n] = rule.apply(
+                    params[n], g, states[n], lr * lr_mult[n], base_wd * wd_mult[n], t
+                )
             new_auxs = dict(zip(aux_order, new_aux))
-            return new_params, new_auxs, new_moms, outs
+            return new_params, new_auxs, new_states, outs
 
-        # params, auxs (BN stats), and momenta all move every step — donate all
-        # three so XLA reuses their buffers in place
+        # params, auxs (BN stats), and optimizer slots all move every step —
+        # donate all three so XLA reuses their buffers in place
         donate = (0, 1, 2) if self._donate else ()
         self._step_fn = jax.jit(step, donate_argnums=donate)
         return self._step_fn
 
-    def step(self, params, auxs, moms, inputs_np, rng=None):
+    def step(self, params, auxs, states, inputs_np, rng=None):
         """One fused train step. inputs_np: dict name->np array (global batch).
-        Returns (params, auxs, moms, outputs)."""
+        Returns (params, auxs, states, outputs)."""
         import jax
 
         from .. import random as _random
@@ -192,7 +220,10 @@ class SPMDTrainer:
         inputs = {
             n: jax.device_put(v, self.batch_sharding) for n, v in inputs_np.items()
         }
-        return self._build_step()(params, auxs, moms, inputs, rng)
+        lr, t = fused_opt.host_step_values(self.optimizer, self.param_names)
+        return self._build_step()(
+            params, auxs, states, inputs, rng, np.float32(lr), np.int32(t)
+        )
 
     def eval_step_fn(self):
         """Jitted inference fn(params, auxs, inputs) -> outputs."""

@@ -1,0 +1,198 @@
+"""SPMD fused-step optimizer generality: every supported optimizer driven
+through SPMDTrainer must match the serial per-index Updater path to fp32
+tolerance (the VERDICT-mandated equivalence check; reference contract:
+python/mxnet/optimizer.py:307-753).
+
+Both sides compute gradients from the same graph on the same data, so the only
+thing under test is the update math + lr/wd multiplier resolution + scheduler
+threading.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import lr_scheduler, optimizer as opt_mod
+from mxnet_tpu.parallel import build_mesh
+from mxnet_tpu.parallel.spmd import SPMDTrainer
+
+BATCH, DIM, HID = 8, 6, 5
+STEPS = 3
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=HID, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _data():
+    rng = np.random.RandomState(7)
+    x = rng.rand(BATCH, DIM).astype(np.float32)
+    y = rng.randint(0, HID, (BATCH,)).astype(np.float32)
+    return x, y
+
+
+def _init_weights(param_names, shapes):
+    rng = np.random.RandomState(3)
+    return {n: (rng.rand(*shapes[n]).astype(np.float32) - 0.5) for n in param_names}
+
+
+def _run_serial(opt_name, opt_kwargs, steps=STEPS):
+    """Reference path: executor fwd/bwd + per-index Updater, exactly how
+    Module's non-fused update() drives it."""
+    net = _net()
+    x, y = _data()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(BATCH, DIM), softmax_label=(BATCH,))
+    param_names = [n for n in net.list_arguments() if n not in ("data", "softmax_label")]
+    w0 = _init_weights(param_names, {n: ex.arg_dict[n].shape for n in param_names})
+    for n in param_names:
+        ex.arg_dict[n][:] = w0[n]
+    idx2name = dict(enumerate(param_names))
+    optimizer = opt_mod.create(
+        opt_name, sym=net, param_idx2name=idx2name, **opt_kwargs
+    )
+    updater = opt_mod.get_updater(optimizer)
+    for _ in range(steps):
+        ex.forward(is_train=True, data=x, softmax_label=y)
+        ex.backward()
+        for i, n in enumerate(param_names):
+            updater(i, ex.grad_dict[n], ex.arg_dict[n])
+    return {n: ex.arg_dict[n].asnumpy() for n in param_names}, w0
+
+
+def _run_spmd(opt_name, opt_kwargs, w0, n_dev=2, steps=STEPS):
+    import jax
+
+    net = _net()
+    x, y = _data()
+    mesh = build_mesh({"dp": n_dev}, jax.devices("cpu")[:n_dev])
+    trainer = SPMDTrainer(
+        net, mesh,
+        data_shapes=[("data", (BATCH, DIM))],
+        label_shapes=[("softmax_label", (BATCH,))],
+        optimizer=opt_name, optimizer_params=dict(opt_kwargs),
+    )
+    params = {
+        n: jax.device_put(w0[n], trainer.param_shardings[n])
+        for n in trainer.param_names
+    }
+    states = trainer.init_opt_state()
+    auxs = {}
+    inputs = {"data": x, "softmax_label": y}
+    for _ in range(steps):
+        params, auxs, states, _ = trainer.step(params, auxs, states, inputs)
+    return {n: np.asarray(v) for n, v in params.items()}
+
+
+OPTS = [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01, "rescale_grad": 1.0 / BATCH}),
+    ("sgd", {"learning_rate": 0.1, "rescale_grad": 1.0 / BATCH}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01,
+             "clip_gradient": 0.02, "rescale_grad": 1.0 / BATCH}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01, "rescale_grad": 1.0 / BATCH}),
+    ("adam", {"learning_rate": 0.01, "wd": 0.01, "rescale_grad": 1.0 / BATCH}),
+    ("adagrad", {"learning_rate": 0.1, "wd": 0.01, "rescale_grad": 1.0 / BATCH}),
+    ("rmsprop", {"learning_rate": 0.01, "wd": 0.01, "rescale_grad": 1.0 / BATCH}),
+    ("rmsprop", {"learning_rate": 0.01, "centered": True, "clip_weights": 0.8,
+                 "rescale_grad": 1.0 / BATCH}),
+    ("adadelta", {"wd": 0.01, "rescale_grad": 1.0 / BATCH}),
+    ("ftrl", {"learning_rate": 0.1, "wd": 0.01, "rescale_grad": 1.0 / BATCH}),
+]
+
+
+@pytest.mark.parametrize(
+    "name,kwargs", OPTS,
+    ids=[f"{n}-{i}" for i, (n, _) in enumerate(OPTS)],
+)
+def test_spmd_step_matches_serial_updater(name, kwargs):
+    serial, w0 = _run_serial(name, kwargs)
+    fused = _run_spmd(name, kwargs, w0)
+    for pname in serial:
+        np.testing.assert_allclose(
+            fused[pname], serial[pname], rtol=2e-5, atol=2e-6,
+            err_msg=f"{name} diverged on {pname}",
+        )
+        # and the step actually moved the weights
+        assert np.abs(serial[pname] - w0[pname]).max() > 0
+
+
+def test_spmd_threads_lr_scheduler():
+    """Scheduler is consulted per step (large factor step avoids the serial
+    path's per-index num_update skew, which only matters across a decay
+    boundary mid-step)."""
+    sched = lr_scheduler.FactorScheduler(step=1000, factor=0.5)
+    kwargs = {"learning_rate": 0.1, "momentum": 0.9,
+              "rescale_grad": 1.0 / BATCH, "lr_scheduler": sched}
+    serial, w0 = _run_serial("sgd", dict(kwargs, lr_scheduler=lr_scheduler.FactorScheduler(step=1000, factor=0.5)))
+    fused = _run_spmd("sgd", kwargs, w0)
+    for pname in serial:
+        np.testing.assert_allclose(fused[pname], serial[pname], rtol=2e-5, atol=2e-6)
+
+
+def test_spmd_scheduler_decays_lr():
+    """After enough updates the fused step's effective lr decays (beyond
+    serial-parity, prove the schedule actually applies inside the fused path)."""
+    import jax
+
+    net = _net()
+    x, y = _data()
+    mesh = build_mesh({"dp": 2}, jax.devices("cpu")[:2])
+    sched = lr_scheduler.FactorScheduler(step=2, factor=0.1)
+    trainer = SPMDTrainer(
+        net, mesh,
+        data_shapes=[("data", (BATCH, DIM))],
+        label_shapes=[("softmax_label", (BATCH,))],
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "rescale_grad": 1.0 / BATCH,
+                          "lr_scheduler": sched},
+    )
+    from mxnet_tpu.parallel import fused_opt
+
+    lrs = []
+    for _ in range(5):
+        lr, _t = fused_opt.host_step_values(trainer.optimizer, trainer.param_names)
+        lrs.append(lr)
+    assert lrs[0] == pytest.approx(0.1)
+    assert lrs[-1] < lrs[0] / 5  # decayed at least two factor steps
+
+
+def test_spmd_rejects_unsupported_optimizer():
+    import jax
+
+    net = _net()
+    mesh = build_mesh({"dp": 2}, jax.devices("cpu")[:2])
+    for bad in ("sgld", "dcasgd", "test"):
+        with pytest.raises(ValueError, match="not supported by the fused"):
+            SPMDTrainer(
+                net, mesh,
+                data_shapes=[("data", (BATCH, DIM))],
+                label_shapes=[("softmax_label", (BATCH,))],
+                optimizer=bad,
+            )
+
+
+def test_spmd_respects_wd_mult_attrs():
+    """__wd_mult__/__lr_mult__ symbol attrs resolve in the fused path like the
+    serial one (Optimizer.set_lr_mult/set_wd_mult pull them from the sym)."""
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("fc_weight", attr={"__lr_mult__": "0.5"})
+    fc = mx.sym.FullyConnected(data, weight=w, num_hidden=HID, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+
+    import jax
+
+    mesh = build_mesh({"dp": 2}, jax.devices("cpu")[:2])
+    trainer = SPMDTrainer(
+        net, mesh,
+        data_shapes=[("data", (BATCH, DIM))],
+        label_shapes=[("softmax_label", (BATCH,))],
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "wd": 0.01},
+    )
+    from mxnet_tpu.parallel import fused_opt
+
+    lrm, wdm = fused_opt.mults_for(trainer.optimizer, trainer.param_names)
+    assert lrm["fc_weight"] == pytest.approx(0.5)
+    # bias gets the no-decay default (set_wd_mult: not *_weight/*_gamma -> 0)
+    assert wdm["fc_bias"] == 0.0
+    assert wdm["fc_weight"] == pytest.approx(1.0)
