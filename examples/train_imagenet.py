@@ -85,8 +85,14 @@ def main():
     steps = [int(e) * epoch_size for e in args.lr_step_epochs.split(",") if e.strip()]
     sched = mx.lr_scheduler.MultiFactorScheduler(step=steps, factor=args.lr_factor) if steps else None
 
-    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
-    mod = mx.mod.Module(net, context=ctx)
+    n_tpu = mx.context.num_tpus()
+    ctx = [mx.tpu(i) for i in range(n_tpu)] if n_tpu else mx.cpu()
+    compute_dtype = None
+    if args.dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        compute_dtype = np.dtype(jnp.bfloat16)
+    mod = mx.mod.Module(net, context=ctx, compute_dtype=compute_dtype)
     mod.fit(
         train, eval_data=val, num_epoch=args.num_epochs, kvstore=kv,
         optimizer="sgd",

@@ -1,0 +1,267 @@
+"""The SPMD fused fit path behind Module.
+
+The reference's training loop is per-device executors + gradient push/pull
+through a KVStore (python/mxnet/module/module.py:432-510,561-581,
+executor_group.py:227-319, kvstore comm.h). The TPU-native fast path replaces
+all of that with ONE compiled program per step: forward+backward+optimizer
+update jitted over a device mesh with the batch sharded on a ``dp`` axis —
+XLA's SPMD partitioner inserts the gradient allreduce over ICI and fuses it
+with the update (parallel/spmd.py).
+
+``Module`` routes ``forward_backward``/``update`` here when the configuration
+is expressible as one SPMD program (see ``Module._fused_eligible``); anything
+else — custom grad_req, monitors, input grads, distributed PS — falls back to
+the executor-group path with identical semantics. The fit-loop contract is
+preserved: ``forward`` stages the batch, ``update`` runs the fused step, and
+``get_outputs``/``update_metric`` see this step's pre-update forward outputs,
+exactly like the classic path.
+
+Parameter coherence: device-resident params are the source of truth while the
+fused path is active (``device_dirty``); ``sync_to_module`` writes them back
+into ``Module._arg_params`` and the executor group whenever a classic-path
+consumer (eval forward, get_params, checkpointing) needs them.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..io import DataDesc
+
+__all__ = ["FusedFitPath"]
+
+
+class FusedFitPath:
+    def __init__(self, module):
+        import jax
+
+        from ..parallel import build_mesh
+        from ..parallel.spmd import SPMDTrainer
+
+        self._mod = module
+        devices = [c.jax_device for c in module._context]
+        mesh = build_mesh({"dp": len(devices)}, devices)
+        self._data_shapes = [(d.name, tuple(d.shape)) for d in module._data_shapes]
+        self._label_shapes = [
+            (d.name, tuple(d.shape)) for d in (module._label_shapes or [])
+        ]
+        # raises ValueError on unsupported optimizers -> Module falls back
+        self.trainer = SPMDTrainer(
+            module._symbol, mesh,
+            data_shapes=self._data_shapes,
+            label_shapes=self._label_shapes,
+            optimizer=module._optimizer,
+            compute_dtype=module._compute_dtype,
+        )
+        self._params = None  # device dicts (fp32 masters, sharded)
+        self._auxs = None
+        self._states = None
+        self._host_states = None  # staged serial-format states awaiting upload
+        self._pending = None  # staged inputs for the next step()
+        self._outs = None  # last step's forward outputs (pre-update params)
+        self.device_dirty = False
+
+    # ---- state movement --------------------------------------------------
+    def _ensure_device_state(self):
+        import jax
+
+        if self._params is not None:
+            return
+        mod = self._mod
+        if mod._params_dirty:
+            # executor-group copies are newer (a classic-path update ran)
+            mod._sync_params_from_devices()
+        tr = self.trainer
+        self._params = {
+            n: jax.device_put(
+                mod._arg_params[n].asnumpy().astype(tr.dtype), tr.param_shardings[n]
+            )
+            for n in tr.param_names
+        }
+        self._auxs = {
+            n: jax.device_put(mod._aux_params[n].asnumpy().astype(np.float32), tr.repl)
+            for n in tr.aux_names
+        }
+        if self._host_states is not None:
+            self._states = self._upload_states(self._host_states)
+            self._host_states = None
+        elif self._states is None:
+            self._states = tr.init_opt_state()
+
+    def invalidate(self):
+        """Drop device params/auxs (module-side copies became authoritative,
+        e.g. set_params or a classic-path update). Optimizer state is kept —
+        staged to host so momentum survives the round-trip."""
+        if self._states is not None:
+            self._host_states = self._download_states(self._states)
+        self._params = None
+        self._auxs = None
+        self._states = None
+        self._pending = None
+        self._outs = None
+        self.device_dirty = False
+
+    def drop_batch(self):
+        """Forget any staged batch and cached outputs. Called when a
+        classic-path consumer takes over mid-stream (eval forward, odd-shaped
+        batch) so stale fused outputs are never observed."""
+        self._pending = None
+        self._outs = None
+
+    def sync_to_module(self):
+        """Write device params/auxs back into Module's host dicts + executor
+        group, so classic-path consumers observe the fused updates."""
+        mod = self._mod
+        if not self.device_dirty or self._params is None:
+            return
+        for n, arr in self._params.items():
+            mod._arg_params[n][:] = np.asarray(arr).astype(
+                mod._arg_params[n].dtype, copy=False
+            )
+        for n, arr in self._auxs.items():
+            mod._aux_params[n][:] = np.asarray(arr).astype(
+                mod._aux_params[n].dtype, copy=False
+            )
+        mod._exec_group.set_params(mod._arg_params, mod._aux_params)
+        self.device_dirty = False
+
+    # ---- fit-loop hooks --------------------------------------------------
+    def accepts(self, data_batch):
+        """Fused only when the batch matches the bound shapes (jit would
+        happily retrace, but the trainer was shape-specialized at bind)."""
+        try:
+            shapes = [(n, tuple(a.shape)) for (n, _), a in
+                      zip(self._data_shapes, data_batch.data)]
+            if shapes != self._data_shapes:
+                return False
+            if self._label_shapes:
+                labels = data_batch.label or []
+                lshapes = [(n, tuple(a.shape)) for (n, _), a in
+                           zip(self._label_shapes, labels)]
+                if lshapes != self._label_shapes:
+                    return False
+        except (AttributeError, TypeError):
+            return False
+        return True
+
+    def stage(self, data_batch):
+        self._ensure_device_state()
+        inputs = {}
+        for (name, _), arr in zip(self._data_shapes, data_batch.data):
+            inputs[name] = arr.data if isinstance(arr, nd.NDArray) else np.asarray(arr)
+        for (name, _), arr in zip(self._label_shapes, data_batch.label or []):
+            inputs[name] = arr.data if isinstance(arr, nd.NDArray) else np.asarray(arr)
+        self._pending = inputs
+        self._outs = None
+
+    @property
+    def pending(self):
+        return self._pending is not None
+
+    def step(self):
+        assert self._pending is not None, "no staged batch: call forward first"
+        self._params, self._auxs, self._states, self._outs = self.trainer.step(
+            self._params, self._auxs, self._states, self._pending
+        )
+        self._pending = None
+        self.device_dirty = True
+
+    @property
+    def has_outputs(self):
+        return self._outs is not None or self._pending is not None
+
+    def get_outputs(self):
+        """This step's forward outputs as NDArrays. If the step hasn't run yet
+        (forward without update), evaluate a forward-only program so the
+        classic contract — outputs visible after forward() — holds."""
+        if self._outs is None and self._pending is not None:
+            import jax
+
+            if not hasattr(self, "_eval_fn"):
+                self._eval_fn = self.trainer.eval_step_fn()
+            inputs = {
+                n: jax.device_put(v, self.trainer.batch_sharding)
+                for n, v in self._pending.items()
+            }
+            self._outs = self._eval_fn(self._params, self._auxs, inputs)
+        ctx = self._mod._context[0]
+        return [nd.NDArray(o, ctx=ctx) for o in self._outs]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(list(labels), self.get_outputs())
+
+    # ---- optimizer-state checkpointing ----------------------------------
+    # Interchangeable with Updater.get_states/set_states (optimizer.py):
+    # a pickled {index: numpy state} dict. The classic path keys states by
+    # enumerate(param_names) when updating on the kvstore, and by
+    # i*num_device+k (one replica per device) otherwise (module.py
+    # init_optimizer's idx2name) — saves match the layout the CURRENT config's
+    # classic equivalent would read, and loads accept either layout.
+    def _download_states(self, states):
+        """Canonical {i: serial_state} keyed by enumerate(param_names)."""
+        rule = self.trainer.rule
+        return {
+            i: rule.to_serial(states[n])
+            for i, n in enumerate(self.trainer.param_names)
+        }
+
+    def _upload_states(self, serial):
+        import jax
+
+        tr = self.trainer
+        out = {}
+        for i, n in enumerate(tr.param_names):
+            st = tr.rule.from_serial(serial[i], tr.arg_shapes[n], tr.dtype)
+            out[n] = tuple(
+                jax.device_put(np.asarray(s, tr.dtype), tr.param_shardings[n])
+                for s in st
+            )
+        return out
+
+    def _canonical_states(self):
+        if self._states is not None:
+            return self._download_states(self._states)
+        if self._host_states is not None:
+            return self._host_states
+        return {
+            i: self.trainer.rule.to_serial(
+                self.trainer.rule.init_state(
+                    self.trainer.arg_shapes[i_name], self.trainer.dtype))
+            for i, i_name in enumerate(self.trainer.param_names)
+        }
+
+    def get_states_bytes(self):
+        serial = self._canonical_states()
+        ndev = len(self._mod._context)
+        if ndev > 1 and not self._mod._update_on_kvstore:
+            # classic non-kvstore layout: one replica per device
+            serial = {
+                i * ndev + k: st
+                for i, st in serial.items() for k in range(ndev)
+            }
+        return pickle.dumps(serial)
+
+    def set_states_bytes(self, data):
+        serial = pickle.loads(data)
+        P = len(self.trainer.param_names)
+        if set(serial.keys()) == set(range(P)):
+            canon = serial
+        elif len(serial) % P == 0 and set(serial.keys()) == set(range(len(serial))):
+            stride = len(serial) // P  # per-device replicas: take device 0's
+            canon = {i: serial[i * stride] for i in range(P)}
+        else:
+            raise ValueError(
+                "optimizer states file does not match this module's parameters"
+            )
+        self._host_states = canon
+        if self._params is not None:
+            self._states = self._upload_states(canon)
+            self._host_states = None
+
+
+def batch_axes_standard(descs):
+    """True when every desc's batch axis is 0 (the only layout the dp-sharded
+    fused step expresses)."""
+    return all(DataDesc.get_batch_axis(getattr(d, "layout", None)) == 0 for d in descs)

@@ -72,6 +72,8 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._fused = None  # SPMD fast path (fused_path.py), set by init_optimizer
+        self._monitor_installed = False
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -193,9 +195,21 @@ class Module(BaseModule):
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params)
+        if self._fused is not None:
+            self._fused.invalidate()
 
     def set_params(self, arg_params, aux_params, allow_missing=False, force_init=True):
         """(reference: module.py set_params)"""
+        if (
+            arg_params is self._arg_params and aux_params is self._aux_params
+            and self._fused is not None and not self._fused.device_dirty
+            and not self._params_dirty
+        ):
+            # fit's epoch-end self-sync (get_params -> set_params): host,
+            # executor group, and fused device state are already coherent —
+            # skip the full re-init/invalidate round-trip (it would download
+            # and re-upload every param and optimizer slot for nothing)
+            return
         if not allow_missing:
             self.init_params(
                 initializer=None, arg_params=arg_params, aux_params=aux_params,
@@ -211,6 +225,8 @@ class Module(BaseModule):
         self._exec_group.set_params(arg_params, aux_params)
         self._params_dirty = True
         self.params_initialized = True
+        if self._fused is not None:
+            self._fused.invalidate()
 
     # ---- bind ------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -281,6 +297,7 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
+        kvstore_arg = kvstore  # the user's string/instance, pre-resolution
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params
         )
@@ -319,6 +336,7 @@ class Module(BaseModule):
         self._kvstore = kvstore
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
+        self._fused = self._build_fused_path(kvstore_arg)
         if kvstore:
             # copy initialized local parameters to kvstore
             _initialize_kvstore(
@@ -335,6 +353,71 @@ class Module(BaseModule):
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
+    def _fused_eligible(self, kvstore_arg):
+        """Is this configuration expressible as ONE SPMD program?
+
+        ``kvstore='device'`` (the reference's reduce-on-device mode,
+        kvstore.py:10-19) opts into in-graph allreduce on any platform; on TPU
+        contexts the default local kvstores fuse too — that IS the TPU-native
+        execution model. Everything stateful/introspective (monitors, input
+        grads, custom grad_req, per-device workloads, distributed PS) keeps
+        the executor-group path."""
+        from ..base import env_flag
+        from ..kvstore import KVStore
+
+        if env_flag("MXNET_MODULE_NO_FUSED"):
+            return False
+        if isinstance(kvstore_arg, KVStore):
+            # a ready store participates by its type string (the reference's
+            # common/fit.py passes instances); dist stores are filtered below
+            kvstore_arg = kvstore_arg.type
+        if not isinstance(kvstore_arg, str) and kvstore_arg is not None:
+            return False
+        if self._grad_req != "write" or self.inputs_need_grad:
+            return False
+        if self._state_names or self._fixed_param_names:
+            return False
+        if self._monitor_installed:
+            return False
+        if len(set(self._work_load_list)) > 1:
+            return False
+        from .fused_path import batch_axes_standard
+
+        if not batch_axes_standard(self._data_shapes or []):
+            return False
+        if self._label_shapes and not batch_axes_standard(self._label_shapes):
+            return False
+        devtypes = {c.device_type for c in self._context}
+        if len(devtypes) != 1:
+            return False
+        # contexts must land on DISTINCT jax devices (Context.jax_device wraps
+        # device ids modulo the platform's device count, e.g. cpu(3) on a
+        # 1-CPU process): a mesh with duplicates is not a valid SPMD target
+        try:
+            jax_devs = [c.jax_device for c in self._context]
+        except Exception:
+            return False
+        if len(set(jax_devs)) != len(jax_devs):
+            return False
+        if kvstore_arg is not None and "dist" in kvstore_arg:
+            return False
+        if kvstore_arg in ("device", "local_allreduce_device"):
+            return True
+        return devtypes.pop() == "tpu" and kvstore_arg in (None, "local")
+
+    def _build_fused_path(self, kvstore_arg):
+        if not self._fused_eligible(kvstore_arg):
+            return None
+        try:
+            from .fused_path import FusedFitPath
+
+            return FusedFitPath(self)
+        except ValueError as e:  # unsupported optimizer for the fused rules
+            self.logger.info(
+                "fused SPMD path unavailable (%s); using the executor-group path", e
+            )
+            return None
+
     def borrow_optimizer(self, shared_module):
         """(reference: module.py borrow_optimizer — bucketing modules share one
         optimizer/updater)."""
@@ -348,16 +431,33 @@ class Module(BaseModule):
     # ---- compute ---------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            train = self.for_training if is_train is None else is_train
+            if train and self._fused.accepts(data_batch):
+                # fused fit path: stage only — update() runs the whole
+                # fwd+bwd+update as one SPMD program
+                self._fused.stage(data_batch)
+                return
+            # classic-path consumer (eval, odd-shaped batch): make the
+            # executor group observe the fused updates, and drop any staged
+            # batch/outputs so nothing stale is observed downstream
+            self._fused.sync_to_module()
+            self._fused.drop_batch()
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        if self._fused is not None and self._fused.pending:
+            return  # gradient computation is fused into update()
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
         """(reference: module.py:561-581)"""
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
+        if self._fused is not None and self._fused.pending:
+            self._fused.step()
+            return
         if self._update_on_kvstore:
             _update_params_on_kvstore(
                 self._exec_group.param_arrays, self._exec_group.grad_arrays, self._kvstore
@@ -367,9 +467,14 @@ class Module(BaseModule):
                 self._exec_group.param_arrays, self._exec_group.grad_arrays,
                 updater=self._updater, num_device=len(self._context), kvstore=self._kvstore,
             )
+        if self._fused is not None:
+            # a classic update ran: device-resident fused params are now stale
+            self._fused.invalidate()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused is not None and self._fused.has_outputs:
+            return self._fused.get_outputs()
         return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -377,17 +482,26 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        if self._fused is not None and self._fused.has_outputs:
+            self._fused.update_metric(eval_metric, labels)
+            return
         self._exec_group.update_metric(eval_metric, labels)
 
     def _sync_params_from_devices(self):
         """(reference: module.py _sync_params_from_devices)"""
-        self._exec_group.get_params(self._arg_params, self._aux_params)
+        if self._fused is not None and self._fused.device_dirty:
+            self._fused.sync_to_module()  # also resets device_dirty
+        else:
+            self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
     def save_optimizer_states(self, fname):
         """(reference: module.py save_optimizer_states)"""
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._fused is not None:
+            with open(fname, "wb") as fout:
+                fout.write(self._fused.get_states_bytes())
+        elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
             with open(fname, "wb") as fout:
@@ -396,7 +510,10 @@ class Module(BaseModule):
     def load_optimizer_states(self, fname):
         """(reference: module.py load_optimizer_states)"""
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._fused is not None:
+            with open(fname, "rb") as f:
+                self._fused.set_states_bytes(f.read())
+        elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
             with open(fname, "rb") as f:
@@ -404,6 +521,27 @@ class Module(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        self._monitor_installed = True
+        if self._fused is not None:
+            # monitors need per-executor visibility: leave the fused path,
+            # handing params AND optimizer state to the classic machinery so
+            # momentum/Adam moments and the lr schedule continue seamlessly
+            self._fused.sync_to_module()
+            if self.optimizer_initialized:
+                states = self._fused.get_states_bytes()
+                opt = self._optimizer
+                # fused counts are name-keyed; classic uses int indices.
+                # Re-base so fresh indices resume the schedule where it left.
+                opt.begin_num_update = opt.num_update
+                opt._index_update_count = {}
+                if self._updater is not None:
+                    self._updater.set_states(states)
+                elif self._kvstore is not None:
+                    self.logger.warning(
+                        "install_monitor mid-training with a kvstore-updating "
+                        "config: optimizer state restarts fresh on the kvstore"
+                    )
+            self._fused = None
         self._exec_group.install_monitor(mon)
 
 

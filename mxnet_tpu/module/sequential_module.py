@@ -1,55 +1,73 @@
-"""SequentialModule — chain of modules (reference:
-python/mxnet/module/sequential_module.py)."""
+"""SequentialModule — a chain of modules acting as one.
+
+API parity with the reference (python/mxnet/module/sequential_module.py:
+``add(module, take_labels=..., auto_wiring=...)``, forward threads each
+stage's outputs into the next stage's data, backward threads input grads the
+other way). Implemented around an explicit ``_Stage`` record per link instead
+of parallel meta-dict lists, and forward passes build a fresh DataBatch per
+stage rather than mutating a shallow copy.
+"""
 from __future__ import annotations
 
-import copy
 import logging
+from collections import Counter
+from dataclasses import dataclass
 
+from ..io import DataBatch
 from .base_module import BaseModule
 
 __all__ = ["SequentialModule"]
 
 
+@dataclass
+class _Stage:
+    module: BaseModule
+    take_labels: bool = False  # feed fit's labels to this stage (loss layers)
+    auto_wiring: bool = False  # rename incoming data to this stage's data_names
+
+
 class SequentialModule(BaseModule):
+    # kwarg names accepted by add(); kept as class attrs for API parity
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
-        self._label_shapes = None
+        self._stages: list[_Stage] = []
         self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x) for x in dir(SequentialModule)
-                               if x.startswith("META_")])
+        self._label_shapes = None
 
     def add(self, module, **kwargs):
-        """(reference: sequential_module.py add)"""
-        self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, "Unknown meta '%s', a typo?" % key
-        self._metas.append(kwargs)
+        """Append a stage. Returns self so adds chain."""
+        unknown = set(kwargs) - {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+        if unknown:
+            raise ValueError("Unknown meta %s, a typo?" % sorted(unknown))
+        self._stages.append(
+            _Stage(
+                module,
+                take_labels=bool(kwargs.get(self.META_TAKE_LABELS, False)),
+                auto_wiring=bool(kwargs.get(self.META_AUTO_WIRING, False)),
+            )
+        )
+        # a structural change invalidates everything downstream
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
+    # ---- shape/name views: first stage fronts, last stage exits ----------
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0].module.data_names if self._stages else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1].module.output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0].module.data_shapes
 
     @property
     def label_shapes(self):
@@ -59,148 +77,149 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1].module.output_shapes
 
+    # ---- params ----------------------------------------------------------
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+        args, auxs = {}, {}
+        for stage in self._stages:
+            a, x = stage.module.get_params()
+            args.update(a)
+            auxs.update(x)
+        return args, auxs
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"
-        for module in self._modules:
-            module.init_params(
-                initializer=initializer, arg_params=arg_params, aux_params=aux_params,
-                allow_missing=allow_missing, force_init=force_init,
+        for stage in self._stages:
+            stage.module.init_params(
+                initializer=initializer, arg_params=arg_params,
+                aux_params=aux_params, allow_missing=allow_missing,
+                force_init=force_init,
             )
-
-        def _check_name(known_names, new_names, modules, i):
-            """Make sure the parameter names do not conflict."""
-            for name in new_names:
-                assert not name in known_names, "Duplicated parameter names: " + (
-                    "name '%s' in layer %d (%s) is already " % (name, i, type(modules[i]))
-                ) + (
-                    "used in layer %d (%s)."
-                    % (known_names[name], type(modules[known_names[name]]))
-                )
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        self._assert_unique_param_names()
         self.params_initialized = True
 
+    def _assert_unique_param_names(self):
+        for kind in range(2):  # 0: args, 1: auxs
+            counts = Counter()
+            for stage in self._stages:
+                counts.update(stage.module.get_params()[kind].keys())
+            dups = [n for n, c in counts.items() if c > 1]
+            if dups:
+                raise ValueError(
+                    "parameter names repeat across stages: %s — prefix each "
+                    "stage's symbols to disambiguate" % sorted(dups)
+                )
+
+    # ---- bind: thread shapes through the chain ---------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
-        """(reference: sequential_module.py bind — wires module i's outputs to
-        module i+1's data)"""
         if self.binded and not force_rebind:
             self.logger.warning("Already binded, ignoring bind()")
             return
         if inputs_need_grad:
             assert for_training
         assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty SequentialModule"
+        assert self._stages, "Attempting to bind an empty SequentialModule"
         self.binded = True
-        self._label_shapes = label_shapes
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-            my_inputs_need_grad = bool(
-                inputs_need_grad or (for_training and i_layer > 0)
-            )
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [
-                    (new_name, shape) for (new_name, (_, shape)) in zip(
-                        data_names,
-                        [(x.name, x.shape) if hasattr(x, "name") else x for x in my_data_shapes],
-                    )
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        shapes = list(data_shapes)
+        labels_used = False
+        for i, stage in enumerate(self._stages):
+            if stage.auto_wiring:
+                names = stage.module.data_names
+                assert len(names) == len(shapes)
+                shapes = [
+                    (name, s.shape if hasattr(s, "shape") else s[1])
+                    for name, s in zip(names, shapes)
                 ]
-            module.bind(
-                data_shapes=my_data_shapes, label_shapes=my_label_shapes,
-                for_training=for_training, inputs_need_grad=my_inputs_need_grad,
+            labels_used |= stage.take_labels
+            stage.module.bind(
+                data_shapes=shapes,
+                label_shapes=label_shapes if stage.take_labels else None,
+                for_training=for_training,
+                # interior stages always need input grads to continue the chain
+                inputs_need_grad=inputs_need_grad or (for_training and i > 0),
                 force_rebind=force_rebind, shared_module=None, grad_req=grad_req,
             )
-            my_data_shapes = module.output_shapes
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
+            shapes = stage.module.output_shapes
+        self._data_shapes = list(data_shapes)
+        self._label_shapes = label_shapes if labels_used else None
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.01),), force_init=False):
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        for module in self._modules:
-            module.init_optimizer(
+        for stage in self._stages:
+            stage.module.init_optimizer(
                 kvstore=kvstore, optimizer=optimizer,
                 optimizer_params=optimizer_params, force_init=force_init,
             )
         self.optimizer_initialized = True
 
+    # ---- compute: outputs flow down, grads flow back up ------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        data_batch = copy.copy(data_batch)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
-                break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, "provide_data"):
-                data_names = [x[0] if isinstance(x, tuple) else x.name for x in module.output_shapes]
-                assert len(data_names) == len(data_batch.data)
-                data_batch.provide_data = [
-                    (name, x.shape) for name, x in zip(data_names, data_batch.data)
-                ]
+        batch = data_batch
+        for i, stage in enumerate(self._stages):
+            stage.module.forward(batch, is_train=is_train)
+            if i + 1 == len(self._stages):
+                return
+            outputs = stage.module.get_outputs()
+            names = [
+                s[0] if isinstance(s, tuple) else s.name
+                for s in stage.module.output_shapes
+            ]
+            batch = DataBatch(
+                data=outputs,
+                label=data_batch.label,
+                pad=getattr(data_batch, "pad", None),
+                index=getattr(data_batch, "index", None),
+                provide_data=[(n, o.shape) for n, o in zip(names, outputs)],
+                provide_label=getattr(data_batch, "provide_label", None),
+            )
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(zip(range(len(self._modules)), self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
-                break
-            out_grads = module.get_input_grads()
+        for i in range(len(self._stages) - 1, -1, -1):
+            self._stages[i].module.backward(out_grads=out_grads)
+            if i:
+                out_grads = self._stages[i].module.get_input_grads()
 
     def update(self):
         assert self.binded and self.params_initialized and self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        for stage in self._stages:
+            stage.module.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context=merge_multi_context)
+        return self._stages[-1].module.get_outputs(
+            merge_multi_context=merge_multi_context
+        )
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and self.inputs_need_grad
-        return self._modules[0].get_input_grads(merge_multi_context=merge_multi_context)
+        return self._stages[0].module.get_input_grads(
+            merge_multi_context=merge_multi_context
+        )
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and meta[SequentialModule.META_TAKE_LABELS]:
-                module.update_metric(eval_metric, labels)
+        for stage in self._stages:
+            if stage.take_labels:
+                stage.module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        for stage in self._stages:
+            stage.module.install_monitor(mon)

@@ -283,10 +283,22 @@ def host_step_values(optimizer, param_names):
 
 
 def mults_for(optimizer, param_names):
-    """Static per-parameter (lr_mult, wd_mult) dicts, resolving names the same
-    way Optimizer._get_lr/_get_wd do (direct key, then idx2name indirection)."""
+    """Static per-parameter (lr_mult, wd_mult) dicts, resolving like
+    Optimizer._get_lr/_get_wd: a direct key first (users may register mults
+    by name OR by the integer index that idx2name maps to the name), then the
+    name default of 1.0."""
+    by_name = {}
+    for idx, name in optimizer.idx2name.items():
+        by_name.setdefault(name, idx)
     lrm, wdm = {}, {}
     for n in param_names:
-        lrm[n] = float(optimizer.lr_mult.get(n, 1.0))
-        wdm[n] = float(optimizer.wd_mult.get(n, 1.0))
+        # serial order (_get_lr): the update index key wins, then the name
+        # (which carries the set_lr_mult/set_wd_mult defaults and sym attrs)
+        idx = by_name.get(n, n)
+        lrm[n] = float(
+            optimizer.lr_mult.get(idx, optimizer.lr_mult.get(n, 1.0))
+        )
+        wdm[n] = float(
+            optimizer.wd_mult.get(idx, optimizer.wd_mult.get(n, 1.0))
+        )
     return lrm, wdm

@@ -79,6 +79,12 @@ class SPMDTrainer:
         self.compute_dtype = np.dtype(compute_dtype) if compute_dtype is not None else None
         if input_dtype is not None and self.compute_dtype is None and np.dtype(input_dtype) != np.dtype(dtype):
             self.compute_dtype = np.dtype(input_dtype)
+        # same cast policy as Executor (executor.py:142-146): fp32 inputs run
+        # in compute_dtype except labels/index-like inputs (class ids above
+        # 256 are not exactly representable in bf16)
+        from ..executor import _index_like_inputs
+
+        self._cast_exempt = frozenset(self.label_names) | _index_like_inputs(symbol)
         self._param_rules = [(re.compile(k), v) for k, v in (param_rules or {}).items()]
         self._loss_flags = self._detect_loss_outputs()
 
@@ -162,6 +168,7 @@ class SPMDTrainer:
         graph_fn = self._graph_fn
 
         compute_dtype = self.compute_dtype
+        cast_exempt = self._cast_exempt
 
         from ..base import env_flag
 
@@ -169,6 +176,13 @@ class SPMDTrainer:
 
         def step(params, auxs, states, inputs, rng, lr, t):
             aux_list = [auxs[n] for n in aux_order]
+
+            if compute_dtype is not None:
+                inputs = {
+                    n: v.astype(compute_dtype)
+                    if n not in cast_exempt and v.dtype == np.float32 else v
+                    for n, v in inputs.items()
+                }
 
             def f(p):
                 if compute_dtype is not None:
@@ -233,8 +247,17 @@ class SPMDTrainer:
         aux_order = self.aux_names
         data_set = set(self.data_names + self.label_names)
         graph_fn = self._graph_fn
+        compute_dtype = self.compute_dtype
+        cast_exempt = self._cast_exempt
 
         def fwd(params, auxs, inputs):
+            if compute_dtype is not None:
+                params = {n: v.astype(compute_dtype) for n, v in params.items()}
+                inputs = {
+                    n: v.astype(compute_dtype)
+                    if n not in cast_exempt and v.dtype == np.float32 else v
+                    for n, v in inputs.items()
+                }
             args = [params[n] if n not in data_set else inputs.get(n) for n in arg_order]
             aux_list = [auxs[n] for n in aux_order]
             outs, _ = graph_fn(args, aux_list, None, False)

@@ -1,0 +1,286 @@
+"""Module.fit routed onto the SPMD fused step (module/fused_path.py).
+
+The north-star contract (BASELINE.md): UNCHANGED user code —
+``Module.fit(iter, kvstore='device')`` — must hit the fused SPMD program.
+These tests run it on a multi-device CPU mesh and pin down: engagement,
+numerical equivalence with the classic executor-group path, parameter
+coherence across eval/get_params/checkpoints, optimizer-state interchange,
+and the fallbacks that must NOT engage the fused path.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import NDArrayIter
+
+BATCH, DIM, CLASSES = 16, 12, 6
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    # initializers draw from the global key chain: pin it so accuracy
+    # thresholds are deterministic regardless of suite ordering
+    mx.random.seed(11)
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _iter(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, DIM).astype(np.float32)
+    y = rng.randint(0, CLASSES, (n,)).astype(np.float32)
+    return NDArrayIter(X, y, batch_size=BATCH)
+
+
+def _fit(contexts, kvstore, num_epoch=3, opt="sgd",
+         opt_params=(("learning_rate", 0.5), ("momentum", 0.9)), **kwargs):
+    mod = mx.mod.Module(_net(), context=contexts)
+    mod.fit(
+        _iter(), num_epoch=num_epoch, optimizer=opt,
+        optimizer_params=opt_params, kvstore=kvstore,
+        initializer=mx.init.Xavier(), **kwargs,
+    )
+    return mod
+
+
+def _xavier():
+    return mx.init.Xavier()
+
+
+def test_fit_device_kvstore_engages_fused_path():
+    contexts = [mx.cpu(i) for i in range(4)]
+    mod = mx.mod.Module(_net(), context=contexts)
+    it = _iter()
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            kvstore="device", initializer=_xavier())
+    assert mod._fused is not None, "kvstore='device' must engage the fused path"
+    score = mod.score(_iter(), mx.metric.Accuracy())
+    # 64 random samples memorized by an MLP: well above the 1/6 chance floor
+    assert score[0][1] > 0.4, score
+
+
+def test_fused_matches_classic_numerically():
+    """Same seed, same data: fused multi-device == classic single-device."""
+    it_a, it_b = _iter(), _iter()
+    net_a, net_b = _net(), _net()
+    opt_params = {"learning_rate": 0.3, "momentum": 0.9, "wd": 0.001}
+
+    mod_a = mx.mod.Module(net_a, context=[mx.cpu(i) for i in range(2)])
+    mod_a.fit(it_a, num_epoch=2, optimizer="sgd", optimizer_params=dict(opt_params),
+              kvstore="device", initializer=mx.init.One())
+    assert mod_a._fused is not None
+
+    mod_b = mx.mod.Module(net_b, context=mx.cpu())
+    mod_b.fit(it_b, num_epoch=2, optimizer="sgd", optimizer_params=dict(opt_params),
+              kvstore="local", initializer=mx.init.One())
+    assert mod_b._fused is None, "single CPU ctx + local kvstore stays classic"
+
+    args_a, _ = mod_a.get_params()
+    args_b, _ = mod_b.get_params()
+    for n in args_a:
+        np.testing.assert_allclose(
+            args_a[n].asnumpy(), args_b[n].asnumpy(), rtol=1e-4, atol=1e-5,
+            err_msg=f"fused vs classic diverged on {n}",
+        )
+
+
+def test_fused_adam_trains():
+    mod = _fit([mx.cpu(i) for i in range(2)], "device", opt="adam",
+               opt_params=(("learning_rate", 0.05),), num_epoch=10)
+    assert mod._fused is not None
+    assert mod.score(_iter(), mx.metric.Accuracy())[0][1] > 0.3
+
+
+def test_fused_unsupported_optimizer_falls_back():
+    mod = _fit([mx.cpu(i) for i in range(2)], "device", opt="sgld",
+               opt_params=(("learning_rate", 0.05),), num_epoch=1)
+    assert mod._fused is None, "sgld must fall back to the classic path"
+
+
+def test_fused_checkpoint_and_states_roundtrip(tmp_path):
+    prefix = str(tmp_path / "fused")
+    contexts = [mx.cpu(i) for i in range(2)]
+    mod = _fit(contexts, "device", num_epoch=2)
+    assert mod._fused is not None
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+
+    # resume into another fused module: params + momentum state carry over
+    mod2 = mx.mod.Module.load(prefix, 2, load_optimizer_states=True,
+                              context=contexts)
+    mod2.fit(_iter(), num_epoch=1, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+             kvstore="device")
+    assert mod2._fused is not None
+    assert mod2.score(_iter(), mx.metric.Accuracy())[0][1] > 0.15  # sanity: not degenerate
+
+    # interchange: the classic per-index Updater parses the fused .states file
+    mod3 = mx.mod.Module.load(prefix, 2, load_optimizer_states=True,
+                              context=mx.cpu())
+    mod3.fit(_iter(), num_epoch=1, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+             kvstore="local")
+    assert mod3._fused is None
+    assert mod3.score(_iter(), mx.metric.Accuracy())[0][1] > 0.15  # sanity: not degenerate
+
+
+def test_fused_get_params_midtraining_coherent():
+    contexts = [mx.cpu(i) for i in range(2)]
+    mod = mx.mod.Module(_net(), context=contexts)
+    it = _iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="device", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    assert mod._fused is not None
+    batch = next(iter(it))
+    before = {n: a.asnumpy().copy() for n, a in mod.get_params()[0].items()}
+    mod.forward_backward(batch)
+    mod.update()
+    after, _ = mod.get_params()
+    moved = any(np.abs(after[n].asnumpy() - before[n]).max() > 0 for n in before)
+    assert moved, "get_params must observe fused updates"
+
+
+def test_fused_forward_outputs_before_update():
+    """forward(train) then get_outputs WITHOUT update: classic contract says
+    outputs are visible (computed with current params)."""
+    contexts = [mx.cpu(i) for i in range(2)]
+    mod = mx.mod.Module(_net(), context=contexts)
+    it = _iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="device", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    assert mod._fused is not None
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (BATCH, CLASSES)
+    np.testing.assert_allclose(outs[0].asnumpy().sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_monitor_disables_fused_path():
+    contexts = [mx.cpu(i) for i in range(2)]
+    mod = mx.mod.Module(_net(), context=contexts)
+    it = _iter()
+    mon = mx.mon.Monitor(1, stat_func=lambda x: x)
+    mod.fit(it, num_epoch=1, optimizer="sgd", kvstore="device",
+            initializer=mx.init.Xavier(), monitor=mon)
+    assert mod._fused is None, "monitors need the executor path"
+
+
+def test_env_kill_switch():
+    import os
+
+    os.environ["MXNET_MODULE_NO_FUSED"] = "1"
+    try:
+        mod = _fit([mx.cpu(i) for i in range(2)], "device", num_epoch=1)
+        assert mod._fused is None
+    finally:
+        del os.environ["MXNET_MODULE_NO_FUSED"]
+
+
+def test_eval_after_fused_train_uses_eval_batches():
+    """Regression: classic-path eval forward must not observe the stale fused
+    train outputs (drop_batch on handover)."""
+    contexts = [mx.cpu(i) for i in range(2)]
+    mod = mx.mod.Module(_net(), context=contexts)
+    it = _iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="device", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    train_outs = mod.get_outputs()[0].asnumpy().copy()
+
+    eval_batch = next(iter(_iter(seed=9)))
+    mod.forward(eval_batch, is_train=False)
+    eval_outs = mod.get_outputs()[0].asnumpy()
+    assert np.abs(eval_outs - train_outs).max() > 1e-6, (
+        "eval forward returned the stale fused train outputs"
+    )
+
+
+def test_install_monitor_midtraining_carries_optimizer_state():
+    """Regression: switching to the classic path mid-training hands over
+    momentum and keeps the update count advancing."""
+    contexts = [mx.cpu(i) for i in range(2)]
+    mod = mx.mod.Module(_net(), context=contexts)
+    it = _iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="device", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    batch = next(iter(it))
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    n_before = mod._optimizer.num_update
+    assert n_before > 0
+    # kvstore='device' on 2 devices resolves to update_on_kvstore=True; the
+    # momentum handover targets the updater path — force it for the test
+    mod._update_on_kvstore = False
+    import mxnet_tpu.optimizer as opt_mod
+
+    mod._updater = opt_mod.get_updater(mod._optimizer)
+    mon = mx.mon.Monitor(1, stat_func=lambda x: x)
+    mod.install_monitor(mon)
+    assert mod._fused is None
+    # momentum slots arrived non-zero
+    states = {k: v for k, v in mod._updater.states.items()}
+    assert states and any(
+        np.abs(opt_mod.Updater._to_np(s)).max() > 0 for s in states.values()
+    ), "momentum was not handed over"
+    # classic steps continue advancing the schedule from where fused left off
+    mod.forward_backward(batch)
+    mod.update()
+    assert mod._optimizer.num_update > n_before
+
+
+def test_states_file_stride_layout_loads():
+    """Regression: .states files keyed i*num_device+k (the classic
+    multi-device updater layout) load into the fused path."""
+    import pickle
+
+    contexts = [mx.cpu(i) for i in range(2)]
+    mod = mx.mod.Module(_net(), context=contexts)
+    it = _iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="device", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    fused = mod._fused
+    P = len(fused.trainer.param_names)
+    rng = np.random.RandomState(0)
+    mom = {
+        n: rng.rand(*fused.trainer.arg_shapes[n]).astype(np.float32)
+        for n in fused.trainer.param_names
+    }
+    stride = {
+        i * 2 + k: mom[n]
+        for i, n in enumerate(fused.trainer.param_names) for k in range(2)
+    }
+    fused.set_states_bytes(pickle.dumps(stride))
+    canon = pickle.loads(fused.get_states_bytes())
+    assert set(canon.keys()) == set(range(P))
+    for i, n in enumerate(fused.trainer.param_names):
+        np.testing.assert_allclose(canon[i], mom[n])
+
+
+def test_epoch_end_self_sync_keeps_device_state():
+    """Regression: fit's epoch-end get_params/set_params round-trip must not
+    invalidate the fused device state (it forced a full re-upload per epoch)."""
+    mod = _fit([mx.cpu(i) for i in range(2)], "device", num_epoch=2)
+    assert mod._fused is not None
+    assert mod._fused._params is not None, (
+        "epoch-end self-sync invalidated the fused device state"
+    )
