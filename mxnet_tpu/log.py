@@ -35,12 +35,27 @@ class _Formatter(logging.Formatter):
         return super().format(record)
 
 
-def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+def get_logger(name=None, filename=None, filemode=None, level=None):
     """A logger with the colored glog-style formatter (colors only when the
-    target is a tty; files always get plain text)."""
+    target is a tty; files always get plain text).
+
+    ``level`` defaults to WARNING on first initialization; on an
+    already-initialized logger, only an EXPLICITLY passed level is applied
+    (so a later bare ``get_logger(name)`` never demotes a configured one),
+    and a conflicting ``filename`` is flagged instead of silently ignored."""
     logger = logging.getLogger(name)
     if getattr(logger, "_mxnet_tpu_init", False):
+        if level is not None:
+            logger.setLevel(level)
+        if filename and not any(
+            isinstance(h, logging.FileHandler) for h in logger.handlers
+        ):
+            warnings.warn(
+                "get_logger(%r): logger already initialized without a file; "
+                "filename %r ignored" % (name, filename), stacklevel=2,
+            )
         return logger
+    level = WARNING if level is None else level
     if filename:
         handler = logging.FileHandler(filename, filemode or "a")
         handler.setFormatter(_Formatter(colored=False))

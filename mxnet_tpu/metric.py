@@ -17,6 +17,14 @@ __all__ = [
 ]
 
 
+def _as_numpy(arr):
+    """NDArray-aware host conversion (numpy.asarray on an NDArray recurses
+    through lazy __getitem__ views instead of fetching)."""
+    from . import ndarray as nd
+
+    return arr.asnumpy() if isinstance(arr, nd.NDArray) else numpy.asarray(arr)
+
+
 def check_label_shapes(labels, preds, shape=0):
     if shape == 0:
         label_shape, pred_shape = len(labels), len(preds)
@@ -115,31 +123,130 @@ class CompositeEvalMetric(EvalMetric):
         return (names, results)
 
 
-class Accuracy(EvalMetric):
-    """Classification accuracy (reference: metric.py:322)."""
+class _DeferredCountMetric(EvalMetric):
+    """Base for metrics whose per-batch statistic is an integer count over
+    device arrays (correct predictions, top-k hits).
+
+    TPU-native accumulation: the count is computed by ONE jitted program per
+    batch and added into a device-resident scalar — no host fetch in the hot
+    loop. ``get()`` folds the accumulator into ``sum_metric`` with a single
+    blocking fetch (per epoch in the fit loop). On high-latency transports
+    (the axon tunnel) the per-batch fetch the reference does is >100 ms; this
+    defers it entirely, which is why Module.fit's throughput survives metric
+    updates. Host/numpy preds fall back to the reference's eager path.
+    """
+
+    def __init__(self, name, num=None):
+        super().__init__(name, num=num)
+        self._dev_count = {}  # device-set -> device-resident running count
+        self._count_fns = {}
+
+    def reset(self):
+        super().reset()
+        self._dev_count = {}
+
+    def _flush(self):
+        for acc in self._dev_count.values():
+            self.sum_metric += int(numpy.asarray(acc))
+        self._dev_count = {}
+
+    def get(self):
+        self._flush()
+        return super().get()
+
+    def _accumulate(self, key, build_fn, *arrays):
+        """Run (and cache) the jitted count program, chaining a per-device-set
+        accumulator through a donated argument (executor groups emit outputs
+        committed to different devices; each keeps its own running count)."""
+        import jax
+        import numpy as np
+
+        fn = self._count_fns.get(key)
+        if fn is None:
+            fn = jax.jit(build_fn, donate_argnums=(0,))
+            self._count_fns[key] = fn
+        ref = arrays[0]
+        ref_devs = ref.devices()
+        fixed = [ref]
+        for a in arrays[1:]:
+            if hasattr(a, "devices") and a.devices() != ref_devs:
+                if all(d.platform == "cpu" for d in a.devices()):
+                    # host-side label: a local copy, no accelerator round-trip;
+                    # jit re-places it beside the predictions (async upload)
+                    a = numpy.asarray(a)
+                elif len(ref_devs) == 1:
+                    a = jax.device_put(a, next(iter(ref_devs)))
+                else:
+                    # sharded predictions: replicate the label over the same
+                    # mesh (async) rather than a blocking host fetch
+                    try:
+                        from jax.sharding import (
+                            NamedSharding, PartitionSpec as _P,
+                        )
+
+                        a = jax.device_put(
+                            a, NamedSharding(ref.sharding.mesh, _P())
+                        )
+                    except (AttributeError, TypeError, ValueError):
+                        a = numpy.asarray(a)
+            fixed.append(a)
+        devkey = tuple(sorted(d.id for d in ref_devs))
+        acc = self._dev_count.get(devkey, np.int32(0))
+        self._dev_count[devkey] = fn(acc, *fixed)
+
+
+class Accuracy(_DeferredCountMetric):
+    """Classification accuracy (reference: metric.py:322), accumulated on
+    device (see _DeferredCountMetric)."""
 
     def __init__(self, axis=1, name="accuracy"):
         super().__init__(name)
         self.axis = axis
 
     def update(self, labels, preds):
+        from . import ndarray as nd
+
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
-            pred_np = pred_label.asnumpy()
-            if pred_np.ndim > 1 and pred_np.shape[-1 if self.axis == 1 else self.axis] > 1:
-                if pred_np.ndim == 2:
-                    pred_np = numpy.argmax(pred_np, axis=self.axis)
-                else:
-                    pred_np = numpy.argmax(pred_np, axis=self.axis)
-            pred_np = pred_np.astype("int32").reshape(-1)
-            label_np = label.asnumpy().astype("int32").reshape(-1)
-            check_label_shapes(label_np, pred_np)
-            self.sum_metric += (pred_np == label_np).sum()
-            self.num_inst += len(pred_np)
+            if not isinstance(pred_label, nd.NDArray):
+                self._update_host(label, pred_label)
+                continue
+            # keep labels wherever they live: fetching them per batch would
+            # reintroduce the blocking round-trip this class exists to avoid
+            label_arr = label.data if isinstance(label, nd.NDArray) else numpy.asarray(label)
+            axis = self.axis
+            shape = pred_label.shape
+            need_argmax = len(shape) > 1 and shape[-1 if axis == 1 else axis] > 1
+
+            def count(acc, p, l, _argmax=need_argmax, _axis=axis):
+                import jax.numpy as jnp
+
+                ids = jnp.argmax(p, axis=_axis) if _argmax else p
+                return acc + jnp.sum(
+                    jnp.ravel(ids).astype(jnp.int32)
+                    == jnp.ravel(l).astype(jnp.int32)
+                ).astype(jnp.int32)
+
+            self._accumulate(
+                ("acc", need_argmax, shape, tuple(label_arr.shape)),
+                count, pred_label.data, label_arr,
+            )
+            self.num_inst += int(numpy.prod(label_arr.shape))
+
+    def _update_host(self, label, pred_label):
+        pred_np = numpy.asarray(pred_label)
+        if pred_np.ndim > 1 and pred_np.shape[-1 if self.axis == 1 else self.axis] > 1:
+            pred_np = numpy.argmax(pred_np, axis=self.axis)
+        pred_np = pred_np.astype("int32").reshape(-1)
+        label_np = _as_numpy(label).astype("int32").reshape(-1)
+        check_label_shapes(label_np, pred_np)
+        self.sum_metric += (pred_np == label_np).sum()
+        self.num_inst += len(pred_np)
 
 
-class TopKAccuracy(EvalMetric):
-    """Top-k accuracy (reference: metric.py:387)."""
+class TopKAccuracy(_DeferredCountMetric):
+    """Top-k accuracy (reference: metric.py:387), accumulated on device via
+    lax.top_k (see _DeferredCountMetric)."""
 
     def __init__(self, top_k=1, name="top_k_accuracy"):
         super().__init__(name)
@@ -148,21 +255,55 @@ class TopKAccuracy(EvalMetric):
         self.name += "_%d" % self.top_k
 
     def update(self, labels, preds):
+        from . import ndarray as nd
+
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
             assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_np = numpy.argsort(pred_label.asnumpy().astype("float32"), axis=1)
-            label_np = label.asnumpy().astype("int32")
-            num_samples = pred_np.shape[0]
-            num_dims = len(pred_np.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_np.flat == label_np.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred_np.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (pred_np[:, num_classes - 1 - j].flat == label_np.flat).sum()
-            self.num_inst += num_samples
+            if not isinstance(pred_label, nd.NDArray):
+                self._update_host(label, pred_label)
+                continue
+            label_arr = label.data if isinstance(label, nd.NDArray) else numpy.asarray(label)
+            shape = pred_label.shape
+            if len(shape) == 1:
+                k = 1
+            else:
+                k = min(shape[1], self.top_k)
+
+            def count(acc, p, l, _k=k, _flat=len(shape) == 1):
+                import jax.numpy as jnp
+                from jax import lax
+
+                if _flat:
+                    hits = jnp.ravel(p).astype(jnp.int32) == jnp.ravel(l).astype(jnp.int32)
+                else:
+                    _, top_ids = lax.top_k(p.astype(jnp.float32), _k)
+                    hits = jnp.any(
+                        top_ids.astype(jnp.int32)
+                        == jnp.ravel(l).astype(jnp.int32)[:, None], axis=1,
+                    )
+                return acc + jnp.sum(hits).astype(jnp.int32)
+
+            self._accumulate(
+                ("topk", k, shape, tuple(label_arr.shape)),
+                count, pred_label.data, label_arr,
+            )
+            self.num_inst += int(shape[0])
+
+    def _update_host(self, label, pred_label):
+        pred_np = numpy.argsort(numpy.asarray(pred_label).astype("float32"), axis=1)
+        label_np = _as_numpy(label).astype("int32")
+        num_samples = pred_np.shape[0]
+        if pred_np.ndim == 1:
+            self.sum_metric += (pred_np.flat == label_np.flat).sum()
+        else:
+            num_classes = pred_np.shape[1]
+            top_k = min(num_classes, self.top_k)
+            for j in range(top_k):
+                self.sum_metric += (
+                    pred_np[:, num_classes - 1 - j].flat == label_np.flat
+                ).sum()
+        self.num_inst += num_samples
 
 
 class F1(EvalMetric):

@@ -763,7 +763,9 @@ def _make_ndarray_function(op_name):
         nd_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
         if nd_kwargs:
             # tensor keyword args (reference generated signatures accept e.g.
-            # nd.sample_normal(mu=..., sigma=...)): append in declared order
+            # nd.sample_normal(mu=..., sigma=...)): positional inputs fill the
+            # leading declared slots; keywords must cover exactly the slots
+            # after them — anything else would silently misbind inputs
             for k in nd_kwargs:
                 kwargs.pop(k)
             names = list(op.arg_names(kwargs)) + list(op.aux_names(kwargs))
@@ -772,6 +774,15 @@ def _make_ndarray_function(op_name):
                 raise MXNetError(
                     "op %s got NDArray keyword(s) %s not among its inputs %s"
                     % (op_name, unknown, names))
+            npos = len(ndargs)
+            expected = names[npos:npos + len(nd_kwargs)]
+            if sorted(nd_kwargs, key=names.index) != expected:
+                raise MXNetError(
+                    "op %s: NDArray keyword(s) %s must fill exactly the "
+                    "inputs after the %d positional one(s) (%s); pass inputs "
+                    "either positionally in declared order or by keyword for "
+                    "the trailing slots"
+                    % (op_name, sorted(nd_kwargs, key=names.index), npos, expected))
             ndargs = ndargs + [nd_kwargs[n] for n in names if n in nd_kwargs]
         if op.key_var_num_args and op.key_var_num_args not in kwargs:
             kwargs[op.key_var_num_args] = len(ndargs)

@@ -87,6 +87,13 @@ class SPMDTrainer:
         self._cast_exempt = frozenset(self.label_names) | _index_like_inputs(symbol)
         self._param_rules = [(re.compile(k), v) for k, v in (param_rules or {}).items()]
         self._loss_flags = self._detect_loss_outputs()
+        from ..symbol import _topo_order
+
+        self._stochastic = any(
+            not node.is_variable and getattr(get_op(node.op), "stochastic", False)
+            for node in _topo_order(symbol._entries)
+        )
+        self._rng_cache = None
 
         # shardings
         self._P = P
@@ -230,9 +237,19 @@ class SPMDTrainer:
         from .. import random as _random
 
         if rng is None:
-            rng = _random.next_key()
+            # deterministic graphs get one cached device-resident key: no
+            # per-step host RNG work or upload (each dispatch over a tunneled
+            # transport has real latency)
+            if self._stochastic:
+                rng = _random.next_key()
+            else:
+                if self._rng_cache is None:
+                    self._rng_cache = _random.next_key()
+                rng = self._rng_cache
         inputs = {
-            n: jax.device_put(v, self.batch_sharding) for n, v in inputs_np.items()
+            n: v if getattr(v, "sharding", None) == self.batch_sharding
+            else jax.device_put(v, self.batch_sharding)
+            for n, v in inputs_np.items()
         }
         lr, t = fused_opt.host_step_values(self.optimizer, self.param_names)
         return self._build_step()(

@@ -397,3 +397,27 @@ def test_top_level_namespace_parity():
                  "monitor", "mon", "torch", "th", "profiler", "log", "module",
                  "mod", "image", "img", "test_utils", "rnn", "metric"]:
         assert hasattr(mx, name), name
+
+
+def test_metric_device_host_parity():
+    """Accuracy/TopKAccuracy deferred device accumulation matches the host
+    path, including (N,1)-shaped labels (regression: broadcasting against
+    un-raveled labels over-counted top-k hits) and NDArray labels through the
+    host fallback."""
+    import numpy as np
+
+    rng = np.random.RandomState(3)
+    preds = rng.rand(8, 5).astype(np.float32)
+    for lshape in [(8,), (8, 1)]:
+        labels = rng.randint(0, 5, lshape).astype(np.float32)
+        dev = mx.metric.TopKAccuracy(top_k=3)
+        dev.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+        host = mx.metric.TopKAccuracy(top_k=3)
+        host.update([mx.nd.array(labels)], [preds])  # numpy preds: host path
+        assert dev.get()[1] == host.get()[1]
+
+        dev_a = mx.metric.Accuracy()
+        dev_a.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+        host_a = mx.metric.Accuracy()
+        host_a.update([mx.nd.array(labels)], [preds])
+        assert dev_a.get()[1] == host_a.get()[1]
