@@ -10,12 +10,11 @@ Drives the USER-FACING contract — unchanged ``Module.fit`` with
 ``kvstore='device'``, the exact north-star config (BASELINE.md) — which routes
 onto the fused SPMD train step (module/fused_path.py → parallel/spmd.py): one
 XLA program per step for forward+backward+SGD-momentum update. The data
-iterator yields a host-resident synthetic batch, mirroring the reference's own
-driver (example/image-classification/benchmark_score.py keeps its synthetic
-batch resident); timing comes from a batch_end callback, and completion of
-each epoch window is forced by the metric's host fetch — on tunneled TPU
-transports ``block_until_ready`` can return early, so a host fetch is the only
-reliable barrier.
+iterator yields a DEVICE-resident synthetic batch, mirroring the reference's
+own driver (example/image-classification/benchmark_score.py keeps its
+synthetic batch resident on the GPU); timing comes from explicit barriers in
+a batch_end callback — on tunneled TPU transports ``block_until_ready`` can
+return early, so a host fetch is the only reliable completion fence.
 
 Runs in mixed precision: bf16 conv/matmul compute with fp32 accumulation and
 fp32 master params — the TPU-native equivalent of the reference's fp32

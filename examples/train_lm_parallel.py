@@ -1,0 +1,105 @@
+"""Train a decoder-only transformer LM with explicit TPU parallelism modes.
+
+No reference counterpart (the reference's LM story is example/rnn LSTM
+bucketing; sequence/pipeline/expert parallelism are new TPU design work —
+SURVEY §2.5). Modes (mxnet_tpu/parallel/lm.py):
+
+  --mode sp   sequence parallel: activations sharded over the sequence dim,
+              ring attention over ICI — the long-context configuration
+  --mode pp   pipeline parallel: embedding+block stages over a GPipe
+              microbatch schedule
+  --mode ep   expert parallel: Switch-MoE FFN per block, tokens routed
+              between devices with all_to_all
+
+Runs on any mesh: real TPU chips or a virtual CPU mesh —
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+      python examples/train_lm_parallel.py --mode sp --devices 4
+"""
+import argparse
+import logging
+import time
+
+import numpy as np
+
+
+def synthetic_corpus(vocab, batch, seq, steps, seed=0):
+    """Deterministic token stream with learnable structure (repeated n-grams)."""
+    rng = np.random.RandomState(seed)
+    base = rng.randint(0, vocab, (batch, seq))
+    for i in range(steps):
+        tokens = np.roll(base, i % seq, axis=1).astype(np.int32)
+        labels = np.roll(tokens, -1, axis=1).astype(np.int32)
+        yield tokens, labels
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["sp", "pp", "ep"], default="sp")
+    ap.add_argument("--devices", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=1000)
+    ap.add_argument("--num-layers", type=int, default=4)
+    ap.add_argument("--model-dim", type=int, default=128)
+    ap.add_argument("--num-heads", type=int, default=4)
+    ap.add_argument("--ffn-dim", type=int, default=256)
+    ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--num-experts", type=int, default=8)
+    ap.add_argument("--microbatches", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    args = ap.parse_args()
+
+    import jax
+
+    from mxnet_tpu.parallel import build_mesh
+    from mxnet_tpu.parallel.lm import MoELMTrainer, PPLMTrainer, SPLMTrainer
+
+    devices = jax.devices()
+    if len(devices) < args.devices:
+        # single-accelerator host: fall back to the virtual CPU mesh
+        # (xla_force_host_platform_device_count)
+        devices = jax.devices("cpu")
+    devices = devices[: args.devices]
+    cfg = dict(vocab_size=args.vocab, num_layers=args.num_layers,
+               model_dim=args.model_dim, num_heads=args.num_heads,
+               ffn_dim=args.ffn_dim, seq_len=args.seq_len)
+    opt = dict(optimizer="adam", optimizer_params={"learning_rate": args.lr})
+
+    if args.mode == "sp":
+        mesh = build_mesh({"sp": len(devices)}, devices)
+        trainer = SPLMTrainer(mesh, **cfg, **opt)
+    elif args.mode == "pp":
+        mesh = build_mesh({"pp": len(devices)}, devices)
+        trainer = PPLMTrainer(mesh, **cfg, **opt)
+    else:
+        mesh = build_mesh({"ep": len(devices)}, devices)
+        trainer = MoELMTrainer(mesh, num_experts=args.num_experts, **cfg, **opt)
+
+    params = trainer.init_params(seed=0)
+    opt_state = trainer.init_opt_state(params)
+
+    def batches():
+        if args.mode == "pp":
+            # microbatched input: (M, B/M, T)
+            per = max(args.batch // args.microbatches, 1)
+            for tokens, labels in synthetic_corpus(
+                    args.vocab, per * args.microbatches, args.seq_len, args.steps):
+                yield (tokens.reshape(args.microbatches, per, -1),
+                       labels.reshape(args.microbatches, per, -1))
+        else:
+            yield from synthetic_corpus(args.vocab, args.batch, args.seq_len,
+                                        args.steps)
+
+    tic = time.time()
+    for i, (tokens, labels) in enumerate(batches()):
+        params, opt_state, loss = trainer.step(params, opt_state, tokens, labels)
+        if i % 5 == 0 or i == args.steps - 1:
+            logging.info("step %d  loss %.4f  (%.2fs)", i, float(loss),
+                         time.time() - tic)
+    logging.info("done: %s over %d devices, final loss %.4f",
+                 args.mode, len(devices), float(loss))
+
+
+if __name__ == "__main__":
+    main()

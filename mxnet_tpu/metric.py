@@ -217,6 +217,15 @@ class Accuracy(_DeferredCountMetric):
             axis = self.axis
             shape = pred_label.shape
             need_argmax = len(shape) > 1 and shape[-1 if axis == 1 else axis] > 1
+            n_pred = int(numpy.prod(shape))
+            if need_argmax:
+                n_pred //= shape[-1 if axis == 1 else axis]
+            n_lab = int(numpy.prod(label_arr.shape))
+            if n_lab != n_pred:
+                raise ValueError(
+                    "Shape of labels %d does not match shape of predictions %d"
+                    % (n_lab, n_pred)
+                )
 
             def count(acc, p, l, _argmax=need_argmax, _axis=axis):
                 import jax.numpy as jnp
@@ -265,6 +274,12 @@ class TopKAccuracy(_DeferredCountMetric):
                 continue
             label_arr = label.data if isinstance(label, nd.NDArray) else numpy.asarray(label)
             shape = pred_label.shape
+            n_lab = int(numpy.prod(label_arr.shape))
+            if n_lab != shape[0]:
+                raise ValueError(
+                    "Shape of labels %d does not match shape of predictions %d"
+                    % (n_lab, shape[0])
+                )
             if len(shape) == 1:
                 k = 1
             else:

@@ -405,18 +405,27 @@ def _batch_norm(octx, attrs, args, auxs):
     if attrs["fix_gamma"]:
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
     if octx.is_train and not attrs["use_global_stats"]:
-        # stats stay fp32 end to end even when the graph runs bf16, via the
-        # numerically exact two-pass mean/var — but with the fp32 converts
-        # INLINE in each reduction chain rather than one shared astype: a
-        # single-consumer convert fuses into its reduce (no materialized
-        # fp32 activation copy — the HBM-bound train step cares), whereas
-        # the shared xf = astype(f32) fed two consumers and stayed
-        # materialized. One-pass E[x^2]-E[x]^2 is NOT safe here: squaring
-        # in bf16 then cancelling collapses variance for channels with
-        # |mean|/std beyond ~20.
-        mean = jnp.mean(x, axis=red, dtype=jnp.float32)
-        centered = x.astype(jnp.float32) - mean.reshape(bshape)
-        var = jnp.mean(jnp.square(centered), axis=red)
+        # stats stay fp32 end to end even when the graph runs bf16. Default:
+        # one-pass E[x]/E[x^2] with BOTH reductions accumulating fp32 — the
+        # squares are converted to fp32 INLINE in the reduce chain (fuses, no
+        # materialized fp32 copy), so cancellation only bites beyond
+        # |mean|/std ~ 4000 (fp32 mantissa), far outside trained-BN ranges —
+        # and x is read ONCE per stat pass instead of twice. The bf16 hazard
+        # the two-pass guarded against (squaring in bf16 collapses variance
+        # past |mean|/std ~ 20) does not apply with fp32 accumulation.
+        # MXNET_TPU_BN_TWOPASS=1 restores the exact centered two-pass.
+        from ..base import env_flag
+
+        if env_flag("MXNET_TPU_BN_TWOPASS"):
+            mean = jnp.mean(x, axis=red, dtype=jnp.float32)
+            centered = x.astype(jnp.float32) - mean.reshape(bshape)
+            var = jnp.mean(jnp.square(centered), axis=red)
+        else:
+            # converts stay INLINE in each reduce chain (single consumer ->
+            # fuses; a shared astype would materialize an fp32 copy of x)
+            mean = jnp.mean(x, axis=red, dtype=jnp.float32)
+            ex2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=red)
+            var = jnp.maximum(ex2 - jnp.square(mean), 0.0)
         m = attrs["momentum"]
         new_mean = mmean * m + jax.lax.stop_gradient(mean) * (1 - m)
         new_var = mvar * m + jax.lax.stop_gradient(var) * (1 - m)

@@ -15,16 +15,41 @@ from __future__ import annotations
 __all__ = ["moe_ffn", "moe_ffn_local"]
 
 
-def moe_ffn_local(x, gate_w, w1, w2, axis, n, capacity_factor=1.25):
+def _default_expert_fn(params, xe):
+    """The stock Switch expert body: a 2-layer relu FFN.
+    params: (w1 (D,H), w2 (H,D)); xe: (C', D) one expert's tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.registry import fp32_precision
+
+    w1, w2 = params
+    prec = fp32_precision(xe.dtype)
+    h = jax.nn.relu(jnp.dot(xe, w1, precision=prec))
+    return jnp.dot(h, w2, precision=prec)
+
+
+def moe_ffn_local(x, gate_w, w1, w2, axis, n, capacity_factor=1.25,
+                  expert_fn=None, expert_params=None):
     """Per-device body (inside shard_map). x: (B, D) local tokens;
-    gate_w: (D, E) replicated; w1: (E/n, D, H), w2: (E/n, H, D) local experts."""
+    gate_w: (D, E) replicated; w1: (E/n, D, H), w2: (E/n, H, D) local experts.
+
+    ``expert_fn(params_for_one_expert, tokens (C', D)) -> (C', D)`` replaces
+    the stock 2-layer relu body; ``expert_params`` is a pytree whose leaves
+    have leading axis E/n (this device's experts) — vmapped over experts.
+    When given, w1/w2 are ignored (pass the gate plus your own params)."""
     import jax
     import jax.numpy as jnp
 
     from ..ops.registry import fp32_precision
 
     B, D = x.shape
-    E_local = w1.shape[0]
+    if expert_fn is None:
+        expert_fn = _default_expert_fn
+        expert_params = (w1, w2)
+        E_local = w1.shape[0]
+    else:
+        E_local = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
     E = E_local * n
     C = max(int(B * capacity_factor / E), 1)  # capacity per expert per device
     prec = fp32_precision(x.dtype)
@@ -51,9 +76,9 @@ def moe_ffn_local(x, gate_w, w1, w2, axis, n, capacity_factor=1.25):
     xe = xe.reshape(n, E_local, C, D)
     xe = jax.lax.all_to_all(xe, axis, split_axis=0, concat_axis=0, tiled=False)
     xe = jnp.moveaxis(xe, 0, 1).reshape(E_local, n * C, D)
-    # expert FFN (batched matmul on the MXU)
-    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, w1, precision=prec))
-    ye = jnp.einsum("ech,ehd->ecd", h, w2, precision=prec)  # (E_local, n*C, D)
+    # expert body, vmapped over this device's experts (batched MXU matmuls
+    # for the stock FFN; arbitrary jax for a custom body)
+    ye = jax.vmap(expert_fn)(expert_params, xe)  # (E_local, n*C, D)
     # route back
     ye = jnp.moveaxis(ye.reshape(E_local, n, C, D), 1, 0)
     ye = jax.lax.all_to_all(ye, axis, split_axis=0, concat_axis=0, tiled=False)
@@ -63,18 +88,44 @@ def moe_ffn_local(x, gate_w, w1, w2, axis, n, capacity_factor=1.25):
     return jnp.einsum("bec,ecd->bd", combine, ye, precision=prec)
 
 
-def moe_ffn(x, gate_w, w1, w2, mesh, axis="ep", capacity_factor=1.25):
+def moe_ffn(x, gate_w, w1, w2, mesh, axis="ep", capacity_factor=1.25,
+            expert_fn=None, expert_params=None):
     """Expert-parallel Switch FFN over ``mesh[axis]``.
 
     x: (N, D) tokens sharded over ``axis`` (each device gets N/n);
     gate_w: (D, E) replicated; w1: (E, D, H), w2: (E, H, D) sharded over
     ``axis`` (each device owns E/n experts). Returns (N, D) sharded like x.
+
+    A custom expert body: pass ``expert_fn(params_one_expert, tokens) ->
+    tokens`` plus ``expert_params`` (pytree, leading axis E, sharded over
+    ``axis``); w1/w2 may then be None.
     """
     import jax
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
+    custom = expert_fn is not None
+    if custom and expert_params is None:
+        raise ValueError("expert_fn requires expert_params")
+    if not custom and (w1 is None or w2 is None):
+        raise ValueError("pass w1/w2 for the stock FFN or expert_fn+expert_params")
+
+    if custom:
+        ep_spec = jax.tree_util.tree_map(lambda _: P(axis), expert_params)
+
+        def body(xl, gw, epp):
+            return moe_ffn_local(xl, gw, None, None, axis, n,
+                                 capacity_factor=capacity_factor,
+                                 expert_fn=expert_fn, expert_params=epp)
+
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis), P(), ep_spec),
+            out_specs=P(axis),
+            check_rep=False,
+        )
+        return fn(x, gate_w, expert_params)
 
     def body(xl, gw, w1l, w2l):
         return moe_ffn_local(xl, gw, w1l, w2l, axis, n,
