@@ -38,13 +38,20 @@ from .symbol import _topo_order
 __all__ = ["Executor"]
 
 
-def build_graph_fn(symbol):
+def build_graph_fn(symbol, node_callback=None):
     """Build ``fn(arg_list, aux_list, rng, is_train) -> (outputs, new_auxs)``
     plus the metadata needed to bind arrays (arg names, aux names).
 
     This is the trace target: pure, shape-stable, jit-friendly. Stochastic ops
     get per-node keys folded from the step key so two dropout layers never share
     a mask.
+
+    ``node_callback(name, value)`` — when given, invoked with every
+    non-variable node's visible outputs as they are computed (names
+    ``<node>_output``/``<node>_output<k>``, the reference's per-node monitor
+    contract, graph_executor.cc:761-781). Only meaningful when the function
+    runs EAGERLY (un-jitted): under a jit trace the callback would observe
+    tracers. Used by Executor's monitored forward.
     """
     import jax
 
@@ -83,6 +90,11 @@ def build_graph_fn(symbol):
             octx = OpContext(is_train=is_train, rng=key)
             outs, updated_aux = op.forward(octx, node.attrs, args, auxs)
             vals[id(node)] = list(outs)
+            if node_callback is not None:
+                n_vis = op.num_visible_outputs(node.attrs)
+                for k in range(n_vis):
+                    suffix = "_output" if n_vis == 1 else "_output%d" % k
+                    node_callback(node.name + suffix, outs[k])
             # record aux writebacks (aux inputs are always variables)
             for (inp, _), new in zip(node.inputs[n_args:], updated_aux):
                 if id(inp) in aux_index:
@@ -137,6 +149,7 @@ class Executor:
         self._ctx = ctx
         self._group2ctx = group2ctx  # placement hints; compute is SPMD-scheduled by XLA
         self.monitor_callback = None
+        self._monitor_active = None
         # mixed precision (the TPU-native form of the reference's fp16 symbols,
         # e.g. resnet_fp16.py's per-weight Casts): float32 args are cast to
         # compute_dtype inside the jitted graph — master copies stay fp32, and
@@ -197,7 +210,7 @@ class Executor:
         self._jit_fwd = {}
         self._jit_fwd_bwd = None
         self._is_loss_output = self._detect_loss_outputs()
-        self._monitor_fn = None
+        self._graph_fn_monitored = None  # built lazily on first monitored forward
 
     # ------------------------------------------------------------------
     def _detect_loss_outputs(self):
@@ -244,12 +257,25 @@ class Executor:
                 else:
                     dst[:] = v
         rng = self._next_rng()
+        monitored = self.monitor_callback is not None and (
+            self._monitor_active is None or self._monitor_active()
+        )
         if is_train:
             self._pending = (self._arg_data, self._aux_data, rng)
             self._outputs_cache = None
+            if monitored:
+                # reference-parity monitor mode: an extra eager node-by-node
+                # pass fires the callback on EVERY node output
+                # (graph_executor.cc:761-781). Debug path: per-op dispatches,
+                # no whole-graph fusion — and the deferred fused fwd+bwd
+                # below still runs for backward()
+                self._outputs_cache = self._run_forward_monitored(True, rng)
         else:
             self._pending = None
-            self._outputs_cache = self._run_forward(False, rng)
+            if monitored:
+                self._outputs_cache = self._run_forward_monitored(False, rng)
+            else:
+                self._outputs_cache = self._run_forward(False, rng)
         return self.outputs
 
     def _cast_compute(self, arg_list):
@@ -289,6 +315,30 @@ class Executor:
         if is_train:
             for arr, new in zip(self.aux_arrays, new_aux):
                 arr._set_data(new)
+        return outs
+
+    def _run_forward_monitored(self, is_train, rng):
+        """Eager node-by-node forward that feeds the monitor callback each
+        node's outputs (reference ExecuteMonCallback semantics)."""
+        from . import ndarray as nd
+
+        if self._graph_fn_monitored is None:
+            def emit(name, value):
+                cb = self.monitor_callback
+                if cb is not None:
+                    cb(name, nd.NDArray(value, ctx=self._ctx))
+
+            self._graph_fn_monitored = build_graph_fn(
+                self._symbol, node_callback=emit
+            )[0]
+        with _profiler.record_span(self._profile_name("forward_monitored"),
+                                   "executor"):
+            outs, new_aux = self._graph_fn_monitored(
+                self._cast_compute(self._arg_data), self._aux_data, rng, is_train
+            )
+        if is_train:
+            for arr, new, old in zip(self.aux_arrays, new_aux, self._aux_data):
+                arr._set_data(new.astype(old.dtype))
         return outs
 
     @property
@@ -486,12 +536,17 @@ class Executor:
             compute_dtype=self._compute_dtype, cast_exempt=self._cast_exempt,
         )
 
-    def set_monitor_callback(self, callback):
-        """Install a per-output monitor (reference: MXExecutorSetMonitorCallback →
-        GraphExecutor::ExecuteMonCallback, graph_executor.cc:761-781). Called
-        lazily on outputs after each forward (per-internal-node hooks would
-        break whole-graph fusion; use the profiler for per-op timing)."""
+    def set_monitor_callback(self, callback, is_active=None):
+        """Install a per-NODE monitor (reference: MXExecutorSetMonitorCallback
+        → GraphExecutor::ExecuteMonCallback, graph_executor.cc:761-781).
+
+        While installed AND active, forward runs an extra eager node-by-node
+        pass that feeds every node output to ``callback`` — reference
+        semantics at debug-mode cost (per-op dispatch, no whole-graph
+        fusion). ``is_active`` (optional nullary predicate) lets the caller
+        skip that pass on batches it will not record (Monitor's interval)."""
         self.monitor_callback = callback
+        self._monitor_active = is_active
 
     def debug_str(self):
         return self._symbol.debug_str()

@@ -219,7 +219,7 @@ class Accuracy(_DeferredCountMetric):
             need_argmax = len(shape) > 1 and shape[-1 if axis == 1 else axis] > 1
             n_pred = int(numpy.prod(shape))
             if need_argmax:
-                n_pred //= shape[-1 if axis == 1 else axis]
+                n_pred //= shape[axis]  # the dim argmax removes
             n_lab = int(numpy.prod(label_arr.shape))
             if n_lab != n_pred:
                 raise ValueError(

@@ -100,13 +100,19 @@ def _ln(x, gamma, beta, eps=1e-5):
     return (x - mean) / jnp.sqrt(var + eps) * gamma + beta
 
 
+def _prec(x):
+    from ..ops.registry import fp32_precision
+
+    return fp32_precision(x.dtype)
+
+
 def _qkv(h, w_in, num_heads):
     """(B, T, D) @ (D, 3D) -> three (B, H, T, Dh)."""
     import jax.numpy as jnp
 
     B, T, D = h.shape
     Dh = D // num_heads
-    proj = jnp.einsum("btd,de->bte", h, w_in)
+    proj = jnp.einsum("btd,de->bte", h, w_in, precision=_prec(h))
     q, k, v = jnp.split(proj, 3, axis=-1)
     to_heads = lambda a: a.reshape(B, T, num_heads, Dh).transpose(0, 2, 1, 3)
     return to_heads(q), to_heads(k), to_heads(v)
@@ -123,14 +129,14 @@ def _dense_causal_attention(q, k, v):
     import jax.numpy as jnp
 
     Dh = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(Dh)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, precision=_prec(q)) / np.sqrt(Dh)
     T = q.shape[2]
     mask = jnp.tril(jnp.ones((T, T), bool))
     s = jnp.where(mask, s, -1e30)
     import jax
 
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v, precision=_prec(v))
 
 
 def _block_dense(p, prefix, x, num_heads):
@@ -141,10 +147,10 @@ def _block_dense(p, prefix, x, num_heads):
     h = _ln(x, p[prefix + "ln1_gamma"], p[prefix + "ln1_beta"])
     q, k, v = _qkv(h, p[prefix + "attn_in_weight"], num_heads)
     attn = _merge_heads(_dense_causal_attention(q, k, v))
-    x = x + jnp.einsum("btd,de->bte", attn, p[prefix + "attn_out_weight"])
+    x = x + jnp.einsum("btd,de->bte", attn, p[prefix + "attn_out_weight"], precision=_prec(attn))
     h = _ln(x, p[prefix + "ln2_gamma"], p[prefix + "ln2_beta"])
-    f = jax.nn.relu(jnp.einsum("btd,df->btf", h, p[prefix + "ffn1_weight"]))
-    return x + jnp.einsum("btf,fd->btd", f, p[prefix + "ffn2_weight"])
+    f = jax.nn.relu(jnp.einsum("btd,df->btf", h, p[prefix + "ffn1_weight"], precision=_prec(h)))
+    return x + jnp.einsum("btf,fd->btd", f, p[prefix + "ffn2_weight"], precision=_prec(f))
 
 
 def lm_forward_dense(params, tokens, num_layers, num_heads):
@@ -156,7 +162,7 @@ def lm_forward_dense(params, tokens, num_layers, num_heads):
     for i in range(num_layers):
         x = _block_dense(params, "layer%d_" % i, x, num_heads)
     x = _ln(x, params["final_ln_gamma"], params["final_ln_beta"])
-    return jnp.einsum("btd,dv->btv", x, params["lm_head_weight"])
+    return jnp.einsum("btd,dv->btv", x, params["lm_head_weight"], precision=_prec(x))
 
 
 def _xent(logits, labels):
@@ -242,12 +248,12 @@ class SPLMTrainer(_LMTrainerBase):
             q, k, v = _qkv(h, p[pre + "attn_in_weight"], cfg["num_heads"])
             attn = ring_attention_local(q, k, v, axis, n, causal=True)
             x = x + jnp.einsum("btd,de->bte", _merge_heads(attn),
-                               p[pre + "attn_out_weight"])
+                               p[pre + "attn_out_weight"], precision=_prec(x))
             h = _ln(x, p[pre + "ln2_gamma"], p[pre + "ln2_beta"])
-            f = jax.nn.relu(jnp.einsum("btd,df->btf", h, p[pre + "ffn1_weight"]))
-            x = x + jnp.einsum("btf,fd->btd", f, p[pre + "ffn2_weight"])
+            f = jax.nn.relu(jnp.einsum("btd,df->btf", h, p[pre + "ffn1_weight"], precision=_prec(h)))
+            x = x + jnp.einsum("btf,fd->btd", f, p[pre + "ffn2_weight"], precision=_prec(f))
         x = _ln(x, p["final_ln_gamma"], p["final_ln_beta"])
-        return jnp.einsum("btd,dv->btv", x, p["lm_head_weight"])
+        return jnp.einsum("btd,dv->btv", x, p["lm_head_weight"], precision=_prec(x))
 
     def _build(self):
         import jax
@@ -377,7 +383,7 @@ class PPLMTrainer(_LMTrainerBase):
                     carry_shape=carry, carry_dtype=jnp.float32,
                 )
                 x = _ln(acts, p["final_ln_gamma"], p["final_ln_beta"])
-                logits = jnp.einsum("mbtd,dv->mbtv", x, p["lm_head_weight"])
+                logits = jnp.einsum("mbtd,dv->mbtv", x, p["lm_head_weight"], precision=_prec(x))
                 return _xent(logits, labels_mb)
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -394,7 +400,7 @@ class PPLMTrainer(_LMTrainerBase):
                 carry_shape=carry, carry_dtype=jnp.float32,
             )
             x = _ln(acts, params["final_ln_gamma"], params["final_ln_beta"])
-            return jnp.einsum("mbtd,dv->mbtv", x, params["lm_head_weight"])
+            return jnp.einsum("mbtd,dv->mbtv", x, params["lm_head_weight"], precision=_prec(x))
 
         self._fwd = jax.jit(fwd)
 
@@ -452,7 +458,7 @@ class MoELMTrainer(_LMTrainerBase):
             h = _ln(x, p[pre + "ln1_gamma"], p[pre + "ln1_beta"])
             q, k, v = _qkv(h, p[pre + "attn_in_weight"], cfg["num_heads"])
             attn = _merge_heads(_dense_causal_attention(q, k, v))
-            x = x + jnp.einsum("btd,de->bte", attn, p[pre + "attn_out_weight"])
+            x = x + jnp.einsum("btd,de->bte", attn, p[pre + "attn_out_weight"], precision=_prec(x))
             h = _ln(x, p[pre + "ln2_gamma"], p[pre + "ln2_beta"])
             f = moe_ffn_local(
                 h.reshape(B * T, cfg["model_dim"]),
@@ -462,7 +468,7 @@ class MoELMTrainer(_LMTrainerBase):
             )
             x = x + f.reshape(B, T, cfg["model_dim"])
         x = _ln(x, p["final_ln_gamma"], p["final_ln_beta"])
-        return jnp.einsum("btd,dv->btv", x, p["lm_head_weight"])
+        return jnp.einsum("btd,dv->btv", x, p["lm_head_weight"], precision=_prec(x))
 
     def _build(self):
         import jax

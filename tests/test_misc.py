@@ -421,3 +421,76 @@ def test_metric_device_host_parity():
         host_a = mx.metric.Accuracy()
         host_a.update([mx.nd.array(labels)], [preds])
         assert dev_a.get()[1] == host_a.get()[1]
+
+
+def test_monitor_sees_internal_nodes():
+    """Reference-parity monitor mode (graph_executor.cc:761-781): with a
+    monitor installed, EVERY node's outputs reach the callback — including
+    interior activations that whole-graph fusion normally hides."""
+    import numpy as np
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    it = mx.io.NDArrayIter(
+        np.random.rand(16, 10).astype(np.float32),
+        np.random.randint(0, 4, (16,)).astype(np.float32), batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mon = mx.mon.Monitor(interval=1, pattern=".*")
+    mod.install_monitor(mon)
+    batch = next(iter(it))
+    mon.tic()
+    mod.forward(batch, is_train=True)
+    stats = mon.toc()
+    names = {k for _, k, _ in stats}
+    assert "relu1_output" in names, names   # interior node, pre-loss
+    assert "fc1_output" in names, names
+    assert "softmax_output" in names, names
+
+
+def test_metric_accuracy_4d_axis1():
+    """Regression: segmentation-style (N,C,H,W) preds with axis=1 work on the
+    device path and agree with the host path."""
+    import numpy as np
+
+    preds = np.random.rand(2, 5, 8, 8).astype(np.float32)
+    labels = np.random.randint(0, 5, (2, 8, 8)).astype(np.float32)
+    dev = mx.metric.Accuracy()
+    dev.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    host = mx.metric.Accuracy()
+    host.update([mx.nd.array(labels)], [preds])
+    assert dev.get()[1] == host.get()[1]
+
+
+def test_monitor_no_duplicate_output_rows():
+    """Regression: executor-level node callbacks + Monitor.toc must not
+    double-report the executor outputs."""
+    import numpy as np
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc1"), name="softmax")
+    it = mx.io.NDArrayIter(
+        np.random.rand(8, 6).astype(np.float32),
+        np.random.randint(0, 4, (8,)).astype(np.float32), batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mon = mx.mon.Monitor(interval=1, pattern=".*output")
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(next(iter(it)), is_train=True)
+    names = [k for _, k, _ in mon.toc()]
+    assert names.count("softmax_output") == 1, names
+    # interval gating: the next batch is off-interval -> no monitored pass
+    mon.tic()
+    mod.forward(next(iter(mx.io.NDArrayIter(
+        np.random.rand(8, 6).astype(np.float32),
+        np.random.randint(0, 4, (8,)).astype(np.float32), batch_size=8))),
+        is_train=True)
+    assert isinstance(mon.toc(), list)
