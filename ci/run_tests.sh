@@ -43,10 +43,13 @@ run_bench() {
 }
 
 run_tpu() {
-  # the device-consistency sweep (reference: tests/python/gpu/): the whole
-  # operator suite re-executed under the TPU default context. Needs hardware;
-  # REQUIRE_HW makes a missing TPU a hard failure instead of a skip.
-  MXNET_TPU_REQUIRE_HW=1 python -m pytest tests_tpu/ -q
+  # the device-consistency sweep (reference: tests/python/gpu/): the
+  # operator/module/model/attention/rnn/core suites re-executed under the
+  # TPU default context. Needs hardware; REQUIRE_HW makes a missing TPU a
+  # hard failure instead of a skip. The virtual CPU devices coexist with the
+  # chip so multi-device (mesh/fused-Module) cases run inside the sweep too.
+  MXNET_TPU_REQUIRE_HW=1 XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m pytest tests_tpu/ -q
 }
 
 run_examples() {
@@ -79,6 +82,11 @@ run_examples() {
     "fcn_segmentation.py --num-epoch 1"
     "generate_text.py --num-epochs 1 --gen-len 4"
     "dec_clustering.py --pretrain-epochs 2 --refine-iters 5"
+    "train_lm_parallel.py --mode sp --devices 2 --steps 3 --seq-len 32 --model-dim 32 --ffn-dim 64 --num-layers 2"
+    "reinforcement_learning.py --episodes 10 --max-steps 50"
+    "neural_style.py --steps 5 --size 32"
+    "speech_demo.py --num-epochs 1 --seq-len 20"
+    "kaggle_ndsb.py --num-epochs 1 --size 24"
   )
   local failed=0
   for inv in "${fast[@]}"; do

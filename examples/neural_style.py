@@ -1,0 +1,120 @@
+"""Neural style transfer: optimize an IMAGE against content + Gram-matrix
+style losses from conv features (reference: example/neural-style, which uses
+pretrained VGG-19 weights from the model zoo).
+
+The machinery is identical to the reference — a feature extractor bound with
+``inputs_need_grad`` so gradients flow to the image, Gram matrices for style,
+Adam on the pixels. Without downloadable zoo weights this demo initializes
+the extractor randomly (random conv features still transfer coarse texture
+statistics — Ulyanov et al.'s "texture networks" observation); pass
+``--params model.params`` to use real VGG weights when you have them.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def vgg_features(prefix="vgg"):
+    """Conv stack mirroring VGG-19 relu1_1..relu4_1 taps."""
+    data = mx.sym.Variable("data")
+    taps = []
+    x = data
+    for blk, (filters, convs) in enumerate([(32, 2), (64, 2), (128, 3)]):
+        for c in range(convs):
+            x = mx.sym.Convolution(x, num_filter=filters, kernel=(3, 3),
+                                   pad=(1, 1), name=f"{prefix}_b{blk}c{c}")
+            x = mx.sym.Activation(x, act_type="relu")
+        taps.append(x)
+        x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    return taps
+
+
+def loss_symbol(content_weight, style_weight):
+    taps = vgg_features()
+    content_t = mx.sym.Variable("content_target")
+    loss = content_weight * mx.sym.mean(
+        mx.sym.square(taps[-1] - mx.sym.BlockGrad(content_t)))
+    for i, t in enumerate(taps):
+        st = mx.sym.Variable("style_target%d" % i)
+        f = mx.sym.Reshape(t, shape=(-3, -1))  # (C, H*W): batch dim folded in
+        gram_s = mx.sym.dot(f, f, transpose_b=True)
+        loss = loss + style_weight * mx.sym.mean(
+            mx.sym.square(gram_s - mx.sym.BlockGrad(st)))
+    return mx.sym.MakeLoss(loss), len(taps)
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--content-weight", type=float, default=1.0)
+    ap.add_argument("--style-weight", type=float, default=1e-4)
+    ap.add_argument("--params", default=None,
+                    help="optional pretrained extractor .params file")
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    S = args.size
+    content_img = rng.rand(1, 3, S, S).astype(np.float32)
+    style_img = rng.rand(1, 3, S, S).astype(np.float32)
+
+    sym, n_taps = loss_symbol(args.content_weight, args.style_weight)
+    feat_syms = mx.sym.Group(vgg_features())
+
+    # 1) extract targets from content/style images
+    fex = feat_syms.simple_bind(ctx=mx.cpu(), data=(1, 3, S, S))
+    for name, arr in fex.arg_dict.items():
+        if name != "data":
+            mx.init.Xavier()(name, arr)
+    if args.params:
+        loaded = mx.nd.load(args.params)
+        for k, v in loaded.items():
+            key = k.split(":", 1)[-1]
+            if key in fex.arg_dict:
+                v.copyto(fex.arg_dict[key])
+    fex.forward(is_train=False, data=content_img)
+    content_target = fex.outputs[-1].asnumpy()
+    fex.forward(is_train=False, data=style_img)
+    style_targets = []
+    for out in fex.outputs:
+        f = out.asnumpy().reshape(out.shape[1], -1)
+        style_targets.append(f @ f.T)
+
+    # 2) optimize the image: grads flow to `data` (inputs_need_grad analog:
+    # grad_req on the data argument)
+    ex = sym.simple_bind(
+        ctx=mx.cpu(), grad_req={"data": "write"},
+        data=(1, 3, S, S), content_target=content_target.shape,
+        **{"style_target%d" % i: t.shape for i, t in enumerate(style_targets)},
+    )
+    for name, arr in fex.arg_dict.items():
+        if name != "data" and name in ex.arg_dict:
+            arr.copyto(ex.arg_dict[name])
+    ex.arg_dict["content_target"][:] = content_target
+    for i, t in enumerate(style_targets):
+        ex.arg_dict["style_target%d" % i][:] = t
+
+    img = ex.arg_dict["data"]
+    img[:] = rng.rand(1, 3, S, S).astype(np.float32)
+    opt = mx.optimizer.create("adam", learning_rate=args.lr)
+    updater = mx.optimizer.get_updater(opt)
+    for step in range(args.steps):
+        ex.forward(is_train=True)
+        ex.backward()
+        updater(0, ex.grad_dict["data"], img)
+        img[:] = np.clip(img.asnumpy(), 0.0, 1.0)
+        if step % 10 == 0:
+            logging.info("step %d  loss %.6f", step,
+                         float(ex.outputs[0].asnumpy().ravel()[0]))
+    out = img.asnumpy()[0].transpose(1, 2, 0)
+    np.save("styled.npy", out)
+    logging.info("wrote styled.npy  (range %.3f..%.3f)", out.min(), out.max())
+
+
+if __name__ == "__main__":
+    main()

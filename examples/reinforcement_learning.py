@@ -1,0 +1,122 @@
+"""Policy-gradient reinforcement learning (REINFORCE) on a built-in CartPole.
+
+Reference analog: example/reinforcement-learning (DQN/A3C on Atari via
+external emulators). This build ships a dependency-free physics env so the
+example runs anywhere; the learning machinery is the point: a policy network
+trained with MakeLoss on -log pi(a|s) * G_t, advantages fed through a data
+input (the same label-as-data trick as example/nce-loss).
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class CartPole:
+    """Classic cart-pole dynamics (Barto-Sutton-Anderson), numpy only."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.RandomState(seed)
+        self.reset()
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        return self.s
+
+    def step(self, action):
+        x, x_dot, th, th_dot = self.s
+        force = 10.0 if action == 1 else -10.0
+        cos, sin = np.cos(th), np.sin(th)
+        tmp = (force + 0.05 * th_dot ** 2 * sin) / 1.1
+        th_acc = (9.8 * sin - cos * tmp) / (0.5 * (4.0 / 3.0 - 0.1 * cos ** 2 / 1.1))
+        x_acc = tmp - 0.05 * th_acc * cos / 1.1
+        dt = 0.02
+        self.s = np.array([x + dt * x_dot, x_dot + dt * x_acc,
+                           th + dt * th_dot, th_dot + dt * th_acc], np.float32)
+        done = abs(self.s[0]) > 2.4 or abs(self.s[2]) > 0.209
+        return self.s, 1.0, done
+
+
+def policy_symbol(hidden=32, num_actions=2):
+    s = mx.sym.Variable("state")
+    adv = mx.sym.Variable("advantage")  # per-sample return, stop-gradiented
+    act = mx.sym.Variable("action")
+    h = mx.sym.Activation(mx.sym.FullyConnected(s, num_hidden=hidden), act_type="tanh")
+    logits = mx.sym.FullyConnected(h, num_hidden=num_actions, name="logits")
+    logp = mx.sym.log_softmax(logits)
+    picked = mx.sym.pick(logp, act)  # log pi(a_t | s_t)
+    loss = mx.sym.MakeLoss(
+        -mx.sym.mean(picked * mx.sym.BlockGrad(adv)), name="pg_loss")
+    probs = mx.sym.BlockGrad(mx.sym.softmax(logits), name="probs")
+    return mx.sym.Group([loss, probs])
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=150)
+    ap.add_argument("--gamma", type=float, default=0.99)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--max-steps", type=int, default=200)
+    args = ap.parse_args()
+
+    env = CartPole()
+    net = policy_symbol()
+    # bind at the max episode length once; shorter episodes pad with zero
+    # advantage (zero contribution) so ONE executor shape serves every episode
+    T = args.max_steps
+    ex = net.simple_bind(ctx=mx.cpu(), state=(T, 4), advantage=(T,), action=(T,))
+    for name, arr in ex.arg_dict.items():
+        if name not in ("state", "advantage", "action"):
+            mx.init.Xavier()(name, arr)
+    opt = mx.optimizer.create("adam", learning_rate=args.lr)
+    updater = mx.optimizer.get_updater(opt)
+    rng = np.random.RandomState(1)
+
+    running = None
+    for ep in range(args.episodes):
+        states, actions, rewards = [], [], []
+        s = env.reset()
+        for _ in range(args.max_steps):
+            ex.arg_dict["state"][:] = np.tile(s, (T, 1))
+            ex.forward(is_train=False)
+            p = ex.outputs[1].asnumpy()[0]
+            a = int(rng.rand() < p[1])
+            states.append(s.copy())
+            actions.append(a)
+            s, r, done = env.step(a)
+            rewards.append(r)
+            if done:
+                break
+        # discounted returns, normalized
+        G, g = np.zeros(len(rewards), np.float32), 0.0
+        for t in range(len(rewards) - 1, -1, -1):
+            g = rewards[t] + args.gamma * g
+            G[t] = g
+        G = (G - G.mean()) / (G.std() + 1e-6)
+
+        st = np.zeros((T, 4), np.float32)
+        ad = np.zeros((T,), np.float32)
+        ac = np.zeros((T,), np.float32)
+        n = len(states)
+        st[:n], ad[:n], ac[:n] = np.stack(states), G, np.array(actions)
+        ex.arg_dict["state"][:] = st
+        ex.arg_dict["advantage"][:] = ad
+        ex.arg_dict["action"][:] = ac
+        ex.forward(is_train=True)
+        ex.backward()
+        for i, name in enumerate(net.list_arguments()):
+            if name in ("state", "advantage", "action"):
+                continue
+            updater(i, ex.grad_dict[name], ex.arg_dict[name])
+
+        running = n if running is None else 0.95 * running + 0.05 * n
+        if ep % 10 == 0:
+            logging.info("episode %d  length %d  running %.1f", ep, n, running)
+    logging.info("final running episode length: %.1f (chance ~20)", running)
+
+
+if __name__ == "__main__":
+    main()

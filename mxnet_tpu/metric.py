@@ -216,7 +216,9 @@ class Accuracy(_DeferredCountMetric):
             label_arr = label.data if isinstance(label, nd.NDArray) else numpy.asarray(label)
             axis = self.axis
             shape = pred_label.shape
-            need_argmax = len(shape) > 1 and shape[-1 if axis == 1 else axis] > 1
+            # reference rule (metric.py:334): predictions are argmaxed over
+            # `axis` exactly when their shape differs from the labels'
+            need_argmax = len(shape) > 1 and tuple(shape) != tuple(label_arr.shape)
             n_pred = int(numpy.prod(shape))
             if need_argmax:
                 n_pred //= shape[axis]  # the dim argmax removes
@@ -244,7 +246,8 @@ class Accuracy(_DeferredCountMetric):
 
     def _update_host(self, label, pred_label):
         pred_np = numpy.asarray(pred_label)
-        if pred_np.ndim > 1 and pred_np.shape[-1 if self.axis == 1 else self.axis] > 1:
+        label_shape = numpy.shape(_as_numpy(label))
+        if pred_np.ndim > 1 and pred_np.shape != label_shape:
             pred_np = numpy.argmax(pred_np, axis=self.axis)
         pred_np = pred_np.astype("int32").reshape(-1)
         label_np = _as_numpy(label).astype("int32").reshape(-1)

@@ -487,10 +487,29 @@ def test_monitor_no_duplicate_output_rows():
     mod.forward(next(iter(it)), is_train=True)
     names = [k for _, k, _ in mon.toc()]
     assert names.count("softmax_output") == 1, names
-    # interval gating: the next batch is off-interval -> no monitored pass
-    mon.tic()
-    mod.forward(next(iter(mx.io.NDArrayIter(
-        np.random.rand(8, 6).astype(np.float32),
-        np.random.randint(0, 4, (8,)).astype(np.float32), batch_size=8))),
-        is_train=True)
-    assert isinstance(mon.toc(), list)
+
+
+def test_monitor_interval_gating():
+    """Off-interval batches skip the eager monitored pass entirely (the
+    is_active predicate): no node-output rows are recorded for them."""
+    import numpy as np
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc1"), name="softmax")
+    it = mx.io.NDArrayIter(
+        np.random.rand(16, 6).astype(np.float32),
+        np.random.randint(0, 4, (16,)).astype(np.float32), batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mon = mx.mon.Monitor(interval=2, pattern=".*output")
+    mod.install_monitor(mon)
+    batches = list(it)
+    mon.tic()  # step 0: on-interval
+    mod.forward(batches[0], is_train=True)
+    on_names = [k for _, k, _ in mon.toc()]
+    assert "softmax_output" in on_names, on_names
+    mon.tic()  # step 1: off-interval -> monitored pass must not run
+    mod.forward(batches[1], is_train=True)
+    assert mon.toc() == []

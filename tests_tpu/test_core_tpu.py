@@ -1,0 +1,5 @@
+"""ndarray/autograd/random/optimizer suites under the TPU default context."""
+from test_autograd import *  # noqa: F401,F403
+from test_ndarray import *  # noqa: F401,F403
+from test_optimizer import *  # noqa: F401,F403
+from test_random import *  # noqa: F401,F403

@@ -1,0 +1,3 @@
+"""The registry-wide finite-difference gradient sweep on hardware: numeric
+backward checks for every differentiable op under the TPU context."""
+from test_operator_gradients import *  # noqa: F401,F403

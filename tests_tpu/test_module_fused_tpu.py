@@ -1,0 +1,3 @@
+"""Fused Module.fit path under TPU default context (multi-device cases use
+the virtual CPU mesh the tpu CI stage provides alongside the chip)."""
+from test_module_fused import *  # noqa: F401,F403

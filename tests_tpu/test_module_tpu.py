@@ -1,0 +1,3 @@
+"""Re-run the module suite under the TPU default context (reference:
+tests/python/gpu/test_operator_gpu.py:5-14 imports the whole CPU suite)."""
+from test_module import *  # noqa: F401,F403
