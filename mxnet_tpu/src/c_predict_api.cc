@@ -102,6 +102,11 @@ struct NDList {
 
 MXNET_DLL const char* MXGetLastError() { return g_last_error.c_str(); }
 
+// shared error channel for the other translation units in this .so
+// (c_api_ndarray.cc routes its failures here so c_api.h's single accessor
+// reports them)
+void mxtpu_set_last_error(const std::string& msg) { g_last_error = msg; }
+
 static int CreateImpl(const char* symbol_json_str, const void* param_bytes,
                       int param_size, mx_uint num_input_nodes,
                       const char** input_keys,
