@@ -60,6 +60,7 @@ class FusedFitPath:
         self._states = None
         self._host_states = None  # staged serial-format states awaiting upload
         self._pending = None  # staged inputs for the next step()
+        self.staged_batch = None  # the DataBatch behind _pending (for replay)
         self._outs = None  # last step's forward outputs (pre-update params)
         self.device_dirty = False
 
@@ -108,6 +109,7 @@ class FusedFitPath:
         classic-path consumer takes over mid-stream (eval forward, odd-shaped
         batch) so stale fused outputs are never observed."""
         self._pending = None
+        self.staged_batch = None
         self._outs = None
 
     def sync_to_module(self):
@@ -154,6 +156,7 @@ class FusedFitPath:
         for (name, _), arr in zip(self._label_shapes, data_batch.label or []):
             inputs[name] = arr.data if isinstance(arr, nd.NDArray) else np.asarray(arr)
         self._pending = inputs
+        self.staged_batch = data_batch  # kept for classic-path replay
         self._outs = None
 
     @property
@@ -166,6 +169,7 @@ class FusedFitPath:
             self._params, self._auxs, self._states, self._pending
         )
         self._pending = None
+        self.staged_batch = None
         self.device_dirty = True
 
     @property

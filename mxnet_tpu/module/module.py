@@ -387,6 +387,18 @@ class Module(BaseModule):
             return False
         if self._label_shapes and not batch_axes_standard(self._label_shapes):
             return False
+        # the fused step seeds gradient cotangents into loss OUTPUT entries
+        # only (executor.py's loss-flag seeding); a symbol without a loss
+        # output (e.g. a SequentialModule feature stage trained via
+        # out_grads) would silently train on zero gradients
+        from ..ops.registry import get_op
+
+        has_loss_output = any(
+            not node.is_variable and getattr(get_op(node.op), "is_loss", False)
+            for node, _ in self._symbol._entries
+        )
+        if not has_loss_output:
+            return False
         devtypes = {c.device_type for c in self._context}
         if len(devtypes) != 1:
             return False
@@ -448,7 +460,16 @@ class Module(BaseModule):
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
         if self._fused is not None and self._fused.pending:
-            return  # gradient computation is fused into update()
+            if out_grads is None:
+                return  # gradient computation is fused into update()
+            # explicit cotangents can't be seeded into the fused one-program
+            # step: replay the staged batch through the executor group and
+            # continue on the classic path (update() then sees no pending
+            # fused batch and updates classically)
+            batch = self._fused.staged_batch
+            self._fused.sync_to_module()
+            self._fused.drop_batch()
+            self._exec_group.forward(batch, True)
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):

@@ -23,17 +23,36 @@ def build_mesh(axis_sizes, devices=None):
     import jax
     from jax.sharding import Mesh
 
-    if devices is None:
+    implicit = devices is None
+    if implicit:
         devices = jax.devices()
     names = list(axis_sizes.keys())
-    sizes = list(axis_sizes.values())
-    n = len(devices)
-    if -1 in sizes:
-        known = int(np.prod([s for s in sizes if s != -1])) if len(sizes) > 1 else 1
-        sizes[sizes.index(-1)] = n // known
-    total = int(np.prod(sizes))
-    if total > n:
-        raise ValueError("mesh %s needs %d devices, have %d" % (axis_sizes, total, n))
+
+    def _resolve(n):
+        """Concrete sizes + device count for an n-device pool; -1 takes the
+        rest. Returns (sizes, total) — total 0 or > n means 'does not fit'."""
+        sizes = list(axis_sizes.values())
+        if -1 in sizes:
+            known = int(np.prod([s for s in sizes if s != -1])) if len(sizes) > 1 else 1
+            sizes[sizes.index(-1)] = n // known
+        return sizes, int(np.prod(sizes))
+
+    sizes, total = _resolve(len(devices))
+    if implicit and (total > len(devices) or total == 0):
+        # single-accelerator host asked for a bigger mesh: fall back to the
+        # virtual CPU devices (xla_force_host_platform_device_count), the
+        # same convention as dryrun_multichip and the example drivers
+        try:
+            cpus = jax.devices("cpu")
+        except RuntimeError:
+            cpus = []
+        c_sizes, c_total = _resolve(len(cpus))
+        if 0 < c_total <= len(cpus):
+            devices, sizes, total = cpus, c_sizes, c_total
+    if total == 0 or total > len(devices):
+        raise ValueError(
+            "mesh %s needs %s devices, have %d" % (axis_sizes, total or "more",
+                                                   len(devices)))
     arr = np.asarray(devices[:total]).reshape(sizes)
     return Mesh(arr, names)
 

@@ -53,7 +53,21 @@ def test_flash_backward_matches_reference(causal):
 @pytest.mark.parametrize("causal", [False, True])
 def test_pallas_forward_interpret_matches_reference(causal):
     """The Pallas TPU kernel, run in interpreter mode on CPU, matches the
-    oracle — covers masking/lse layout/causal block-skip without hardware."""
+    oracle — covers masking/lse layout/causal block-skip without hardware.
+    Pinned to the CPU backend: interpret mode is an interpreter-math check,
+    and on an accelerator default platform both sides would otherwise run
+    remotely at device matmul precision."""
+    import jax as _jax
+
+    try:
+        cpu = _jax.devices("cpu")[0]
+    except RuntimeError:
+        pytest.skip("no CPU backend available to interpret on")
+    with _jax.default_device(cpu):
+        _run_pallas_forward_interpret(causal)
+
+
+def _run_pallas_forward_interpret(causal):
     from mxnet_tpu.ops.attention import _pallas_forward, _scan_forward
 
     rng = np.random.default_rng(42)
@@ -165,7 +179,19 @@ def test_mha_symbol_trains():
 def test_pallas_backward_interpret_matches_scan(causal):
     """The Pallas backward kernels (dk/dv and dq), interpreted on CPU, match
     the scan backward — covers masking, ragged tails, and the recompute-from-
-    lse path without hardware."""
+    lse path without hardware. Pinned to the CPU backend (see the forward
+    interpret test)."""
+    import jax as _jax
+
+    try:
+        cpu = _jax.devices("cpu")[0]
+    except RuntimeError:
+        pytest.skip("no CPU backend available to interpret on")
+    with _jax.default_device(cpu):
+        _run_pallas_backward_interpret(causal)
+
+
+def _run_pallas_backward_interpret(causal):
     from mxnet_tpu.ops.attention import (_pallas_backward, _scan_backward,
                                          _scan_forward, _scale)
 

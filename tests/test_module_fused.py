@@ -284,3 +284,34 @@ def test_epoch_end_self_sync_keeps_device_state():
     assert mod._fused._params is not None, (
         "epoch-end self-sync invalidated the fused device state"
     )
+
+
+def test_feature_stage_never_fuses_and_sequential_learns():
+    """Regression: a symbol WITHOUT a loss op (SequentialModule feature
+    stage, trained via out_grads) must not take the fused path — it would
+    silently train on zero gradients — and the whole chain must learn under
+    a fused-eligible configuration."""
+    mx.random.seed(7)
+    rng = np.random.RandomState(0)
+    X = rng.randn(192, 10).astype(np.float32)
+    W = rng.randn(10, 3).astype(np.float32)
+    y = (X @ W).argmax(axis=1).astype(np.float32)
+    train = NDArrayIter(X, y, batch_size=32)
+    net1 = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16, name="fc1"),
+        act_type="relu")
+    net2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3, name="fc2"),
+        name="softmax")
+    smod = mx.mod.SequentialModule()
+    m1 = mx.mod.Module(net1, label_names=None)
+    m2 = mx.mod.Module(net2)
+    smod.add(m1)
+    smod.add(m2, take_labels=True, auto_wiring=True)
+    # kvstore='device' makes single-ctx modules fused-eligible — exactly the
+    # configuration that broke on TPU default contexts
+    smod.fit(train, num_epoch=8, optimizer="sgd", kvstore="device",
+             optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    assert m1._fused is None, "loss-less feature stage must not fuse"
+    acc = smod.score(train, "acc")[0][1]
+    assert acc > 0.8, acc
