@@ -5,55 +5,69 @@ The flagship benchmark model: ResNet-50/ImageNet is BASELINE.md's headline
 number (181.53 img/s train on P100). On TPU the 7x7 stem, 3x3/1x1 bottlenecks
 and global pool all lower to MXU convs; bf16 via the Module/SPMD dtype option.
 """
+import functools
+
 from .. import symbol as sym
 
 
+def _layer_fns(layout):
+    """Layout-aware layer constructors: channel-first (reference default) or
+    NHWC (channel-last; the conv/pool ops take the same layout parameter the
+    reference exposes, convolution-inl.h)."""
+    bn_axis = 3 if layout == "NHWC" else 1
+    conv = functools.partial(sym.Convolution, layout=layout)
+    pool = functools.partial(sym.Pooling, layout=layout)
+    bn = functools.partial(sym.BatchNorm, axis=bn_axis)
+    return conv, pool, bn
+
+
 def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
-                  bn_mom=0.9, workspace=256, memonger=False):
+                  bn_mom=0.9, workspace=256, memonger=False, layout="NCHW"):
     """A pre-activation residual unit (reference: resnet.py residual_unit)."""
+    Conv, _Pool, BN = _layer_fns(layout)
     if bottle_neck:
-        bn1 = sym.BatchNorm(data=data, fix_gamma=False, eps=2e-5, momentum=bn_mom, name=name + "_bn1")
+        bn1 = BN(data=data, fix_gamma=False, eps=2e-5, momentum=bn_mom, name=name + "_bn1")
         act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
-        conv1 = sym.Convolution(
+        conv1 = Conv(
             data=act1, num_filter=int(num_filter * 0.25), kernel=(1, 1), stride=(1, 1),
             pad=(0, 0), no_bias=True, workspace=workspace, name=name + "_conv1",
         )
-        bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, eps=2e-5, momentum=bn_mom, name=name + "_bn2")
+        bn2 = BN(data=conv1, fix_gamma=False, eps=2e-5, momentum=bn_mom, name=name + "_bn2")
         act2 = sym.Activation(data=bn2, act_type="relu", name=name + "_relu2")
-        conv2 = sym.Convolution(
+        conv2 = Conv(
             data=act2, num_filter=int(num_filter * 0.25), kernel=(3, 3), stride=stride,
             pad=(1, 1), no_bias=True, workspace=workspace, name=name + "_conv2",
         )
-        bn3 = sym.BatchNorm(data=conv2, fix_gamma=False, eps=2e-5, momentum=bn_mom, name=name + "_bn3")
+        bn3 = BN(data=conv2, fix_gamma=False, eps=2e-5, momentum=bn_mom, name=name + "_bn3")
         act3 = sym.Activation(data=bn3, act_type="relu", name=name + "_relu3")
-        conv3 = sym.Convolution(
+        conv3 = Conv(
             data=act3, num_filter=num_filter, kernel=(1, 1), stride=(1, 1), pad=(0, 0),
             no_bias=True, workspace=workspace, name=name + "_conv3",
         )
         if dim_match:
             shortcut = data
         else:
-            shortcut = sym.Convolution(
+            shortcut = Conv(
                 data=act1, num_filter=num_filter, kernel=(1, 1), stride=stride,
                 no_bias=True, workspace=workspace, name=name + "_sc",
             )
         return conv3 + shortcut
-    bn1 = sym.BatchNorm(data=data, fix_gamma=False, momentum=bn_mom, eps=2e-5, name=name + "_bn1")
+    bn1 = BN(data=data, fix_gamma=False, momentum=bn_mom, eps=2e-5, name=name + "_bn1")
     act1 = sym.Activation(data=bn1, act_type="relu", name=name + "_relu1")
-    conv1 = sym.Convolution(
+    conv1 = Conv(
         data=act1, num_filter=num_filter, kernel=(3, 3), stride=stride, pad=(1, 1),
         no_bias=True, workspace=workspace, name=name + "_conv1",
     )
-    bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, momentum=bn_mom, eps=2e-5, name=name + "_bn2")
+    bn2 = BN(data=conv1, fix_gamma=False, momentum=bn_mom, eps=2e-5, name=name + "_bn2")
     act2 = sym.Activation(data=bn2, act_type="relu", name=name + "_relu2")
-    conv2 = sym.Convolution(
+    conv2 = Conv(
         data=act2, num_filter=num_filter, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
         no_bias=True, workspace=workspace, name=name + "_conv2",
     )
     if dim_match:
         shortcut = data
     else:
-        shortcut = sym.Convolution(
+        shortcut = Conv(
             data=act1, num_filter=num_filter, kernel=(1, 1), stride=stride,
             no_bias=True, workspace=workspace, name=name + "_sc",
         )
@@ -61,53 +75,62 @@ def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9, workspace=256, memonger=False):
-    """(reference: resnet.py resnet)"""
+           bottle_neck=True, bn_mom=0.9, workspace=256, memonger=False,
+           layout="NCHW"):
+    """(reference: resnet.py resnet; ``layout="NHWC"`` builds the whole graph
+    channel-last — image_shape is then (H, W, C) and so is the data input)"""
+    Conv, Pool, BN = _layer_fns(layout)
     num_unit = len(units)
     assert num_unit == num_stages
     data = sym.Variable(name="data")
     data = sym.identity(data=data, name="id")
-    (nchannel, height, width) = image_shape
+    if layout == "NHWC":
+        (height, width, nchannel) = image_shape
+    else:
+        (nchannel, height, width) = image_shape
     if height <= 32:  # cifar
-        body = sym.Convolution(
+        body = Conv(
             data=data, num_filter=filter_list[0], kernel=(3, 3), stride=(1, 1),
             pad=(1, 1), no_bias=True, name="conv0", workspace=workspace,
         )
     else:  # imagenet
-        body = sym.Convolution(
+        body = Conv(
             data=data, num_filter=filter_list[0], kernel=(7, 7), stride=(2, 2),
             pad=(3, 3), no_bias=True, name="conv0", workspace=workspace,
         )
-        body = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5, momentum=bn_mom, name="bn0")
+        body = BN(data=body, fix_gamma=False, eps=2e-5, momentum=bn_mom, name="bn0")
         body = sym.Activation(data=body, act_type="relu", name="relu0")
-        body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="max")
+        body = Pool(data=body, kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="max")
     for i in range(num_stages):
         body = residual_unit(
             body, filter_list[i + 1],
             (1 if i == 0 else 2, 1 if i == 0 else 2), False,
             name="stage%d_unit%d" % (i + 1, 1), bottle_neck=bottle_neck,
-            workspace=workspace, memonger=memonger,
+            workspace=workspace, memonger=memonger, layout=layout,
         )
         for j in range(units[i] - 1):
             body = residual_unit(
                 body, filter_list[i + 1], (1, 1), True,
                 name="stage%d_unit%d" % (i + 1, j + 2), bottle_neck=bottle_neck,
-                workspace=workspace, memonger=memonger,
+                workspace=workspace, memonger=memonger, layout=layout,
             )
-    bn1 = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5, momentum=bn_mom, name="bn1")
+    bn1 = BN(data=body, fix_gamma=False, eps=2e-5, momentum=bn_mom, name="bn1")
     relu1 = sym.Activation(data=bn1, act_type="relu", name="relu1")
-    pool1 = sym.Pooling(data=relu1, global_pool=True, kernel=(7, 7), pool_type="avg", name="pool1")
+    pool1 = Pool(data=relu1, global_pool=True, kernel=(7, 7), pool_type="avg", name="pool1")
     flat = sym.Flatten(data=pool1)
     fc1 = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
     return sym.SoftmaxOutput(data=fc1, name="softmax")
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
-               conv_workspace=256, **kwargs):
+               conv_workspace=256, layout="NCHW", **kwargs):
     """Depth config table (reference: resnet.py get_symbol)."""
     if isinstance(image_shape, str):
         image_shape = [int(l) for l in image_shape.split(",")]
-    (nchannel, height, width) = image_shape
+    if layout == "NHWC":
+        (height, width, nchannel) = image_shape
+    else:
+        (nchannel, height, width) = image_shape
     # height <= 32 selects the 3-stage cifar depth table ((n-2) % 6 == 0 basic
     # / (n-2) % 9 == 0 >= 164 bottleneck — the reference's rule at its 28-crop
     # scale); imagenet depths (18/34/50/...) apply only above 32
@@ -146,5 +169,5 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
     return resnet(
         units=units, num_stages=num_stages, filter_list=filter_list,
         num_classes=num_classes, image_shape=tuple(image_shape),
-        bottle_neck=bottle_neck, workspace=conv_workspace,
+        bottle_neck=bottle_neck, workspace=conv_workspace, layout=layout,
     )

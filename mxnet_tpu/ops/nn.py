@@ -98,9 +98,29 @@ def _conv_tuples(attrs, nd):
     return stride, dilate, pad
 
 
-def _conv_dn(nd):
-    # NC + spatial for data, OI + spatial for kernel: the reference's fixed NCHW/
-    # NCDHW layout (convolution-inl.h). XLA relayouts internally for the MXU.
+def _conv_layout(attrs, nd):
+    """Resolve the conv layout attr: channel-first reference default, or NHWC
+    (2-d only; the reference exposes the same layout parameter,
+    convolution-inl.h ConvolutionParam::layout)."""
+    layout = attrs.get("layout") or "None"
+    if layout in ("None", ""):
+        return "NC" + "DHW"[3 - nd:]
+    if layout == "NHWC":
+        if nd != 2:
+            raise MXNetError("layout=NHWC is 2-d only")
+        return "NHWC"
+    if layout in ("NCW", "NCHW", "NCDHW"):
+        return layout
+    raise MXNetError("Convolution: unsupported layout %s" % layout)
+
+
+def _conv_dn(nd, layout=None):
+    # channel-first (reference default, convolution-inl.h) or NHWC with OHWI
+    # kernels (the reference's NHWC weight layout)
+    if layout == "NHWC":
+        return jax.lax.conv_dimension_numbers(
+            (1,) * 4, (1,) * 4, ("NHWC", "OHWI", "NHWC")
+        )
     sp = "DHW"[3 - nd :]
     return jax.lax.conv_dimension_numbers(
         (1, 1) + (1,) * nd, (1, 1) + (1,) * nd, ("NC" + sp, "OI" + sp, "NC" + sp)
@@ -117,19 +137,21 @@ def _convolution(octx, attrs, args, auxs):
     data, weight = args[0], args[1]
     nd = _conv_dims(attrs["kernel"])
     stride, dilate, pad = _conv_tuples(attrs, nd)
+    layout = _conv_layout(attrs, nd)
     out = jax.lax.conv_general_dilated(
         data,
         weight,
         window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate,
-        dimension_numbers=_conv_dn(nd),
+        dimension_numbers=_conv_dn(nd, layout),
         feature_group_count=attrs["num_group"],
         precision=fp32_precision(data.dtype),
     )
     if not attrs["no_bias"]:
         bias = args[2]
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = ((1,) * (nd + 1) + (-1,)) if layout == "NHWC" else ((1, -1) + (1,) * nd)
+        out = out + bias.reshape(bshape)
     return [out], []
 
 
@@ -144,12 +166,21 @@ def _conv_infer_shape(attrs, in_shapes, aux_shapes):
     nd = _conv_dims(attrs["kernel"])
     stride, dilate, pad = _conv_tuples(attrs, nd)
     nf, ng = attrs["num_filter"], attrs["num_group"]
-    wshape = (nf, data[1] // ng) + tuple(attrs["kernel"])
-    spatial = tuple(
-        _conv_out_dim(data[2 + i], attrs["kernel"][i], stride[i], pad[i], dilate[i])
-        for i in range(nd)
-    )
-    out = (data[0], nf) + spatial
+    layout = _conv_layout(attrs, nd)
+    if layout == "NHWC":
+        wshape = (nf,) + tuple(attrs["kernel"]) + (data[-1] // ng,)
+        spatial = tuple(
+            _conv_out_dim(data[1 + i], attrs["kernel"][i], stride[i], pad[i], dilate[i])
+            for i in range(nd)
+        )
+        out = (data[0],) + spatial + (nf,)
+    else:
+        wshape = (nf, data[1] // ng) + tuple(attrs["kernel"])
+        spatial = tuple(
+            _conv_out_dim(data[2 + i], attrs["kernel"][i], stride[i], pad[i], dilate[i])
+            for i in range(nd)
+        )
+        out = (data[0], nf) + spatial
     shapes = [tuple(data), wshape] + ([] if attrs["no_bias"] else [(nf,)])
     return shapes, [out], []
 
@@ -169,6 +200,8 @@ _DECONV_PARAMS.update({"adj": Param.shape(()), "target_shape": Param.shape(())})
 )
 def _deconvolution(octx, attrs, args, auxs):
     data, weight = args[0], args[1]
+    if (attrs.get("layout") or "None") not in ("None", "", "NCW", "NCHW", "NCDHW"):
+        raise MXNetError("Deconvolution: only channel-first layouts supported")
     nd = _conv_dims(attrs["kernel"])
     stride, dilate, pad = _conv_tuples(attrs, nd)
     # Gradient-of-conv semantics (the reference implements deconv as conv
@@ -208,6 +241,8 @@ def _deconvolution(octx, attrs, args, auxs):
 
 
 def _deconv_infer_shape(attrs, in_shapes, aux_shapes):
+    if (attrs.get("layout") or "None") not in ("None", "", "NCW", "NCHW", "NCDHW"):
+        raise MXNetError("Deconvolution: only channel-first layouts supported")
     data = in_shapes[0]
     nd = _conv_dims(attrs["kernel"])
     stride, dilate, pad = _conv_tuples(attrs, nd)
@@ -227,6 +262,21 @@ get_op("Deconvolution")._infer_shape = _deconv_infer_shape
 
 
 # ---------------------------------------------------------------- Pooling
+def _pool_layout(attrs, nd):
+    """Same validation contract as _conv_layout: channel-first default,
+    NHWC (2-d only), loud error on anything else."""
+    layout = attrs.get("layout") or "None"
+    if layout in ("None", ""):
+        return "NC" + "DHW"[3 - nd:]
+    if layout == "NHWC":
+        if nd != 2:
+            raise MXNetError("Pooling: layout=NHWC is 2-d only")
+        return "NHWC"
+    if layout in ("NCW", "NCHW", "NCDHW"):
+        return layout
+    raise MXNetError("Pooling: unsupported layout %s" % layout)
+
+
 @register(
     "Pooling",
     arg_names=("data",),
@@ -238,14 +288,17 @@ get_op("Deconvolution")._infer_shape = _deconv_infer_shape
         "pad": Param.shape(()),
         "pooling_convention": Param.str("valid"),
         "cudnn_off": Param.bool(False),
+        "layout": Param.str("None"),
     },
     alias=("Pooling_v1",),
 )
 def _pooling(octx, attrs, args, auxs):
     x = args[0]
     nd = x.ndim - 2
+    nhwc = _pool_layout(attrs, nd) == "NHWC"
+    sp0 = 1 if nhwc else 2  # first spatial dim index
     if attrs["global_pool"]:
-        kernel = x.shape[2:]
+        kernel = x.shape[sp0:sp0 + nd]
         stride = (1,) * nd
         pad = (0,) * nd
     else:
@@ -256,13 +309,18 @@ def _pooling(octx, attrs, args, auxs):
     for i in range(nd):
         extra = 0
         if attrs["pooling_convention"] == "full" and not attrs["global_pool"]:
-            h = x.shape[2 + i]
+            h = x.shape[sp0 + i]
             out_full = -(-(h + 2 * pad[i] - kernel[i]) // stride[i]) + 1  # ceil
             extra = max(0, (out_full - 1) * stride[i] + kernel[i] - h - 2 * pad[i])
         pads.append((pad[i], pad[i] + extra))
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    padding = [(0, 0), (0, 0)] + pads
+    if nhwc:
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        padding = [(0, 0)] + pads + [(0, 0)]
+    else:
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
+        padding = [(0, 0), (0, 0)] + pads
     pt = attrs["pool_type"]
     # NOTE: init must be a concrete scalar (python/np), not a jnp array — the
     # monoid pattern-match that routes to the differentiable reduce_window_max/
@@ -274,11 +332,11 @@ def _pooling(octx, attrs, args, auxs):
         zero = np.array(0, x.dtype).item() if not jnp.issubdtype(x.dtype, jnp.floating) else 0.0
         s = jax.lax.reduce_window(x, zero, jax.lax.add, window, strides, padding)
         if pt == "avg":
-            ones = jnp.ones(x.shape[2:], x.dtype)
+            ones = jnp.ones(x.shape[sp0:sp0 + nd], x.dtype)
             cnt = jax.lax.reduce_window(
                 ones, zero, jax.lax.add, tuple(kernel), tuple(stride), pads
             )
-            s = s / cnt
+            s = s / (cnt[..., None] if nhwc else cnt)
         out = s
     else:
         raise MXNetError("Pooling: unknown pool_type %s" % pt)
@@ -288,19 +346,23 @@ def _pooling(octx, attrs, args, auxs):
 def _pool_infer_shape(attrs, in_shapes, aux_shapes):
     data = in_shapes[0]
     nd = len(data) - 2
+    nhwc = _pool_layout(attrs, nd) == "NHWC"
+    sp0 = 1 if nhwc else 2
     if attrs["global_pool"]:
-        return [tuple(data)], [tuple(data[:2]) + (1,) * nd], []
+        out = ((data[0],) + (1,) * nd + (data[-1],)) if nhwc             else (tuple(data[:2]) + (1,) * nd)
+        return [tuple(data)], [out], []
     kernel = attrs["kernel"]
     stride = attrs["stride"] or (1,) * nd
     pad = attrs["pad"] or (0,) * nd
     sp = []
     for i in range(nd):
         if attrs["pooling_convention"] == "full":
-            o = -(-(data[2 + i] + 2 * pad[i] - kernel[i]) // stride[i]) + 1
+            o = -(-(data[sp0 + i] + 2 * pad[i] - kernel[i]) // stride[i]) + 1
         else:
-            o = (data[2 + i] + 2 * pad[i] - kernel[i]) // stride[i] + 1
+            o = (data[sp0 + i] + 2 * pad[i] - kernel[i]) // stride[i] + 1
         sp.append(o)
-    return [tuple(data)], [tuple(data[:2]) + tuple(sp)], []
+    out = ((data[0],) + tuple(sp) + (data[-1],)) if nhwc         else (tuple(data[:2]) + tuple(sp))
+    return [tuple(data)], [out], []
 
 
 get_op("Pooling")._infer_shape = _pool_infer_shape

@@ -48,6 +48,13 @@ def build_mesh(axis_sizes, devices=None):
             cpus = []
         c_sizes, c_total = _resolve(len(cpus))
         if 0 < c_total <= len(cpus):
+            import logging
+
+            logging.info(
+                "build_mesh: %s does not fit the default platform's %d "
+                "device(s); using %d virtual CPU devices instead",
+                axis_sizes, len(devices), len(cpus),
+            )
             devices, sizes, total = cpus, c_sizes, c_total
     if total == 0 or total > len(devices):
         raise ValueError(

@@ -209,3 +209,33 @@ def test_transformer_kv_cache_decode_matches_full_forward():
         ex_dec.forward(is_train=True)  # aux write-back persists the caches
         np.testing.assert_allclose(ex_dec.outputs[0].asnumpy()[0],
                                    full_probs[t], rtol=2e-4, atol=2e-5)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """layout="NHWC" builds the same network channel-last: identical logits
+    for transposed weights/inputs (conv kernels OIHW->OHWI)."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    B = 2
+    n1 = models.resnet(num_classes=10, num_layers=20, image_shape="3,32,32")
+    n2 = models.resnet(num_classes=10, num_layers=20, image_shape="32,32,3",
+                       layout="NHWC")
+    ex1 = n1.simple_bind(ctx=mx.cpu(), data=(B, 3, 32, 32), softmax_label=(B,))
+    ex2 = n2.simple_bind(ctx=mx.cpu(), data=(B, 32, 32, 3), softmax_label=(B,))
+    for name, a1 in ex1.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        w = rng.rand(*a1.shape).astype(np.float32) * 0.1
+        a1[:] = w
+        ex2.arg_dict[name][:] = np.transpose(w, (0, 2, 3, 1)) if w.ndim == 4 else w
+    for name in ex1.aux_dict:
+        v = rng.rand(*ex1.aux_dict[name].shape).astype(np.float32) + (
+            0.5 if "var" in name else 0.0)
+        ex1.aux_dict[name][:] = v
+        ex2.aux_dict[name][:] = v
+    x = rng.rand(B, 3, 32, 32).astype(np.float32)
+    ex1.forward(is_train=False, data=x)
+    ex2.forward(is_train=False, data=np.transpose(x, (0, 2, 3, 1)))
+    np.testing.assert_allclose(
+        ex1.outputs[0].asnumpy(), ex2.outputs[0].asnumpy(), rtol=1e-4, atol=1e-5)
