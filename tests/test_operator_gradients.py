@@ -12,6 +12,8 @@ Input ranges keep finite differences away from kinks and domain edges (e.g.
 |x| in [0.4, 0.9] for abs/relu-like, (-0.7, 0.7) for arcsin/arctanh,
 [1.5, 3.0] for gamma/arccosh).
 """
+import zlib
+
 import numpy as np
 import pytest
 
@@ -21,6 +23,17 @@ from mxnet_tpu.ops import registry
 from mxnet_tpu.test_utils import check_numeric_gradient
 
 _rng = np.random.RandomState(42)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_rngs():
+    """_build_case reseeds test_utils' projection rng per op; restore it so
+    other suites' draws never depend on which sweep test ran last."""
+    from mxnet_tpu import test_utils as _tu
+
+    saved = _tu._rng
+    yield
+    _tu._rng = saved
 
 
 def _arr(shape, lo, hi):
@@ -291,12 +304,24 @@ def _sweepable():
 
 
 def _build_case(name):
+    # per-op deterministic inputs: the draw must not depend on which other
+    # sweep tests ran first (order-dependent values made failures
+    # unreproducible in isolation). crc32, not hash(): PYTHONHASHSEED salts
+    # the builtin per process, which would defeat reproducibility. The
+    # check's random-projection head draws from test_utils' OWN rng — pin
+    # that too or the projection vector stays order-dependent.
+    global _rng
+    seed = zlib.crc32(name.encode()) % (2**31)
+    _rng = np.random.RandomState(seed)
+    from mxnet_tpu import test_utils as _tu
+
+    _tu._rng = np.random.RandomState(seed ^ 0x5F5E5F)
     op = registry.get_op(name)
     spec = SPECS.get(name)
     if spec is None:
         if name in _GENERIC_BINARY:
-            # lhs/rhs same shape; _Maximum/_minimum need distinct elements,
-            # random draws give that with probability 1
+            # lhs/rhs same shape; min/max-family operands are additionally
+            # pushed apart by _separate_kinks after the draw
             spec = Spec(shapes=None, signed=name not in ("_Power", "_power",
                                                          "broadcast_power"))
         else:
@@ -337,9 +362,31 @@ def _build_case(name):
     return s, location, grad_nodes, spec
 
 
+# ops whose gradient has a kink where two operands tie: guarantee the drawn
+# operands stay separated by >> the finite-difference epsilon
+_KINK_BINARY = {"_Maximum", "_Minimum", "_maximum", "_minimum",
+                "broadcast_maximum", "broadcast_minimum"}
+_KINK_REDUCE = {"max", "min", "max_axis", "min_axis"}
+
+
+def _separate_kinks(name, location, grad_nodes):
+    if name in _KINK_BINARY and len(grad_nodes) == 2:
+        a, b = (location[k] for k in grad_nodes)
+        location[grad_nodes[1]] = (
+            a + np.where(b >= a, 0.2, -0.2).astype(np.float32)
+        )
+    elif name in _KINK_REDUCE:
+        k = grad_nodes[0]
+        arr = location[k]
+        spread = np.linspace(0.2, 0.9, arr.size, dtype=np.float32)
+        _rng.shuffle(spread)
+        location[k] = spread.reshape(arr.shape)
+
+
 @pytest.mark.parametrize("name", _sweepable())
 def test_numeric_gradient(name):
     s, location, grad_nodes, spec = _build_case(name)
+    _separate_kinks(name, location, grad_nodes)
     aux = None
     if spec.aux:
         # auto-created aux variables carry the node-name prefix
