@@ -171,9 +171,12 @@ def main():
     _emit(best)
 
 
-def _raw_step_bench(batch, dtype, steps, layout="NCHW"):
-    """The pre-round-2 methodology: time the raw SPMD step with a resident
-    device batch. Kept as a diagnostic to quantify fit-loop overhead."""
+def build_raw_step(batch, dtype, layout="NCHW"):
+    """Build the exact SPMD training step the benchmark times, with resident
+    device inputs: (step_fn, call_args). call_args is the full 7-tuple
+    (params, auxs, states, inputs, rng_key, lr, t). Shared with
+    tools/conv_bench.py so the per-shape profile is guaranteed to trace the
+    same program the benchmark measures."""
     import jax
 
     import mxnet_tpu as mx
@@ -210,7 +213,16 @@ def _raw_step_bench(batch, dtype, steps, layout="NCHW"):
     rng_key = _random.next_key()
     step_fn = trainer._build_step()
     lr0, t0 = fused_opt.host_step_values(trainer.optimizer, trainer.param_names)
-    lr_t = (np.float32(lr0), np.int32(t0))
+    return step_fn, (params, auxs, states, inputs, rng_key,
+                     np.float32(lr0), np.int32(t0))
+
+
+def _raw_step_bench(batch, dtype, steps, layout="NCHW"):
+    """The pre-round-2 methodology: time the raw SPMD step with a resident
+    device batch. Kept as a diagnostic to quantify fit-loop overhead."""
+    step_fn, call_args = build_raw_step(batch, dtype, layout)
+    params, auxs, states, inputs, rng_key, lr, t = call_args
+    lr_t = (lr, t)
 
     def fetch(outs):
         # host fetch: the only reliable completion barrier over the tunnel

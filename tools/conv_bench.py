@@ -1,0 +1,215 @@
+"""Per-conv-shape device-time profile of the ResNet-50 fused training step.
+
+Runs the real fused SPMD step (same build as bench.py) under a jax.profiler
+device trace, aggregates per-kernel durations over a timed window, and joins
+each fusion kernel with the convolution HLO it contains, producing a
+per-shape table: operand shapes, ms/step, useful GFLOP, achieved TFLOP/s,
+MXU%.  This is the measurement behind docs/perf.md's per-shape conv analysis
+(the round-3 Pallas-vs-XLA study).
+
+Methodology notes:
+- Only IN-STEP kernel times are trustworthy: the module wall time matches the
+  end-to-end bench, and DMA overlap is the real steady-state schedule.
+  Timing an isolated jitted kernel called back-to-back with constant inputs
+  UNDER-REPORTS memory time (cross-call DMA prefetch hides HBM reads of the
+  unchanged operands — measured 46us for a dot whose operand reads alone need
+  ~175us at peak HBM bandwidth).  For isolated A/B, chain iterations inside
+  one jit (tools/kernel_ab.py has the trace helpers).
+- "Useful" FLOPs for lhs-dilated (strided-dgrad) convolutions are the
+  fwd-equivalent count: the textual out*K product divided by
+  prod(lhs_dilation), since the inserted zeros carry no information (XLA's
+  emitter skips them; counting them would show >100% MXU).
+
+Usage:
+    python tools/conv_bench.py [--steps 10] [--batch 32] [--out /tmp/convprof]
+"""
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.kernel_ab import _trace_events, device_kernel_us, is_envelope  # noqa: E402
+
+
+def _run_traced(step_fn, args0, steps, outdir):
+    import jax
+    import numpy as np
+
+    params, auxs, states, inputs, rng_key, lr, t = args0
+    for _ in range(3):
+        params, auxs, states, outs = step_fn(
+            params, auxs, states, inputs, rng_key, lr, t)
+    np.asarray(outs[0]).ravel()[0]
+    with jax.profiler.trace(outdir):
+        for _ in range(steps):
+            params, auxs, states, outs = step_fn(
+                params, auxs, states, inputs, rng_key, lr, t)
+        np.asarray(outs[0]).ravel()[0]  # fence inside the trace
+
+
+def _parse_hlo(hlo_text):
+    """Returns (conv_lines, comp_convs, comp_bodies, fus2comp):
+    conv_lines: conv instruction name -> (hlo line, owning computation) —
+    including convolutions left UNFUSED in the entry computation (their trace
+    kernel is named after the instruction itself, not a fusion);
+    comp_convs: computation -> [conv instruction names];
+    fus2comp: fusion instruction name -> called computation."""
+    conv_lines, comp_convs, comp_bodies = {}, {}, {}
+    fus2comp = {}
+    cur = None
+    for line in hlo_text.splitlines():
+        if line and not line.startswith(" ") and "{" in line:
+            head = line.split("(")[0].strip()
+            if head.startswith("ENTRY "):
+                head = head[len("ENTRY "):]
+            cur = head.lstrip("%")
+            comp_bodies[cur] = []
+        elif cur is not None:
+            comp_bodies[cur].append(line)
+            if " convolution(" in line:
+                m = re.match(r"\s*(?:ROOT )?%([\w.\-]+) = ", line)
+                if m:
+                    conv_lines[m.group(1)] = (line.strip(), cur)
+                    comp_convs.setdefault(cur, []).append(m.group(1))
+        if " fusion(" in line and "calls=" in line:
+            m = re.match(r"\s*(?:ROOT )?%([\w.\-]+) = ", line)
+            c = re.search(r"calls=%([\w.\-]+)", line)
+            if m and c:
+                fus2comp[m.group(1)] = c.group(1)
+    return conv_lines, comp_convs, comp_bodies, fus2comp
+
+
+def _typeof(comp_bodies, comp, name):
+    for l in comp_bodies.get(comp, []):
+        m = re.match(r"\s*(?:ROOT )?%" + re.escape(name) + r" = (\w+)\[([\d,]*)\]", l)
+        if m:
+            return [int(x) for x in m.group(2).split(",") if x]
+    return None
+
+
+def _conv_info(line, comp, comp_bodies):
+    m = re.match(r"\s*(?:ROOT )?%[\w.\-]+ = \w+\[([\d,]+)\]", line)
+    out = [int(x) for x in m.group(1).split(",")]
+    ops = re.search(r"convolution\(%([\w.\-]+), %([\w.\-]+)\)", line)
+    lhs = _typeof(comp_bodies, comp, ops.group(1)) if ops else None
+    rhs = _typeof(comp_bodies, comp, ops.group(2)) if ops else None
+    dl = re.search(r"dim_labels=(\w+)_(\w+)->(\w+)", line)
+    win = re.search(r"window={([^}]*)}", line)
+    winstr = win.group(1) if win else ""
+    lhs_dil = 1
+    ld = re.search(r"lhs_dilate=([\dx]+)", winstr)
+    if ld:
+        for d in ld.group(1).split("x"):
+            lhs_dil *= int(d)
+    flops = None
+    if rhs is not None and dl is not None:
+        # K per output element = prod of non-'o' rhs dims. The rhs
+        # input-feature dim in HLO is ALREADY C_in/feature_group_count, so
+        # no further group division (grouped convs would otherwise be
+        # undercounted by the group factor).
+        k = 1
+        for d, lab in zip(rhs, dl.group(2)):
+            if lab != "o":
+                k *= d
+        oe = 1
+        for d in out:
+            oe *= d
+        # useful FLOPs: fwd-equivalent (skip lhs-dilation zeros)
+        flops = 2 * oe * k // max(lhs_dil, 1)
+    return out, lhs, rhs, flops, winstr
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--layout", default="NCHW")
+    ap.add_argument("--out", default="/tmp/convprof")
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    args = ap.parse_args()
+
+    os.makedirs(args.out, exist_ok=True)
+    import numpy as np
+    import jax.numpy as jnp
+    import bench
+    dtype = (np.dtype(jnp.bfloat16) if args.dtype == "bfloat16"
+             else np.dtype(np.float32))
+    step_fn, call_args = bench.build_raw_step(args.batch, dtype, args.layout)
+    # trace first (populates the jit dispatch cache), THEN extract HLO —
+    # lower().compile() is the AOT path and would otherwise trigger a second
+    # full compile of the ResNet-sized step before the traced run
+    _run_traced(step_fn, call_args, args.steps, args.out)
+    hlo_text = step_fn.lower(*call_args).compile().as_text()
+    with open(os.path.join(args.out, "step.hlo.txt"), "w") as f:
+        f.write(hlo_text)
+    totals = device_kernel_us(_trace_events(args.out))
+    conv_lines, comp_convs, comp_bodies, fus2comp = _parse_hlo(hlo_text)
+
+    steps = args.steps
+    rows, conv_ms, conv_fl, other_ms = [], 0.0, 0, 0.0
+    unparsed = 0
+    for name, us in totals.items():
+        key = name.lstrip("%")
+        if is_envelope(name):
+            continue
+        # a kernel is a conv if it's a fusion whose computation holds conv(s),
+        # or an unfused convolution instruction named directly
+        if key in fus2comp and fus2comp[key] in comp_convs:
+            comp = fus2comp[key]
+            instrs = comp_convs[comp]
+        elif key in conv_lines:
+            comp = conv_lines[key][1]
+            instrs = [key]
+        else:
+            other_ms += us / 1000 / steps
+            continue
+        ms = us / 1000 / steps
+        conv_ms += ms
+        flops = 0
+        lhs = rhs = out = winstr = None
+        for instr in instrs:
+            out, lhs, rhs, fl, winstr = _conv_info(
+                conv_lines[instr][0], comp, comp_bodies)
+            if fl is None:
+                unparsed += 1
+            flops += fl or 0
+        if len(instrs) > 1:
+            winstr = "%s [+%d more convs in fusion]" % (winstr, len(instrs) - 1)
+        conv_fl += flops
+        tf = (flops / 1e12) / (ms / 1e3) if flops else 0.0
+        rows.append((ms, name, lhs, rhs, out, flops / 1e9, tf, winstr or ""))
+    rows.sort(reverse=True)
+    if unparsed:
+        print("WARNING: %d conv instruction(s) had unparseable operand "
+              "shapes; their FLOPs are counted as 0" % unparsed)
+    if not rows:
+        raise SystemExit(
+            "no conv kernels matched the trace — the HLO text format "
+            "likely changed (check step.hlo.txt against _parse_hlo's "
+            "regexes) or the model has no convolutions")
+    print("%-20s %6s %-20s %-16s %-18s %6s %6s %5s  %s" % (
+        "kernel", "ms/st", "lhs", "rhs", "out", "GFLOP", "TFLPs", "MXU%", "window"))
+    for ms, name, lhs, rhs, out, gf, tf, winstr in rows:
+        print("%-20s %6.3f %-20s %-16s %-18s %6.1f %6.1f %5.1f  %s" % (
+            name[:20], ms, str(lhs), str(rhs), str(out), gf, tf,
+            100 * tf / args.peak_tflops, winstr[:40]))
+    avg_mxu = (100 * (conv_fl / 1e12) / (conv_ms / 1e3) / args.peak_tflops
+               if conv_ms else 0.0)
+    print("conv kernels: %.2f ms/step, %.1f useful GFLOP/step, avg MXU %.1f%%"
+          % (conv_ms, conv_fl / 1e9, avg_mxu))
+    module = device_kernel_us(_trace_events(args.out), track="XLA Modules")
+    module_ms = sum(module.values()) / 1000 / steps
+    print("non-conv kernels: %.2f ms/step; module total: %.2f ms/step"
+          % (other_ms, module_ms))
+    with open(os.path.join(args.out, "rows.json"), "w") as f:
+        json.dump([{"kernel": r[1], "ms_per_step": r[0], "lhs": r[2],
+                    "rhs": r[3], "out": r[4], "gflop": r[5], "tflops": r[6],
+                    "window": r[7]} for r in rows], f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
