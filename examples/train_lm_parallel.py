@@ -14,6 +14,12 @@ SURVEY §2.5). Modes (mxnet_tpu/parallel/lm.py):
 Runs on any mesh: real TPU chips or a virtual CPU mesh —
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
       python examples/train_lm_parallel.py --mode sp --devices 4
+
+Two equivalent drivers:
+  default        the raw trainer loop (step/forward surface)
+  --use-module   the unified Module path — ``mx.mod.ParallelLMModule`` +
+                 the standard ``fit()`` loop (one user-facing API across
+                 dense/sp/pp/ep; parity tested in tests/test_parallel_lm.py)
 """
 import argparse
 import logging
@@ -35,7 +41,9 @@ def synthetic_corpus(vocab, batch, seq, steps, seed=0):
 def main():
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["sp", "pp", "ep"], default="sp")
+    ap.add_argument("--mode", choices=["dense", "sp", "pp", "ep"], default="sp")
+    ap.add_argument("--use-module", action="store_true",
+                    help="drive via mx.mod.ParallelLMModule.fit()")
     ap.add_argument("--devices", type=int, default=4)
     ap.add_argument("--vocab", type=int, default=1000)
     ap.add_argument("--num-layers", type=int, default=4)
@@ -53,7 +61,11 @@ def main():
     import jax
 
     from mxnet_tpu.parallel import build_mesh
-    from mxnet_tpu.parallel.lm import MoELMTrainer, PPLMTrainer, SPLMTrainer
+    from mxnet_tpu.parallel.lm import (
+        DenseLMTrainer, MoELMTrainer, PPLMTrainer, SPLMTrainer)
+
+    if args.use_module:
+        return main_module(args)
 
     devices = jax.devices()
     if len(devices) < args.devices:
@@ -66,7 +78,10 @@ def main():
                ffn_dim=args.ffn_dim, seq_len=args.seq_len)
     opt = dict(optimizer="adam", optimizer_params={"learning_rate": args.lr})
 
-    if args.mode == "sp":
+    if args.mode == "dense":
+        mesh = None
+        trainer = DenseLMTrainer(**cfg, **opt)
+    elif args.mode == "sp":
         mesh = build_mesh({"sp": len(devices)}, devices)
         trainer = SPLMTrainer(mesh, **cfg, **opt)
     elif args.mode == "pp":
@@ -80,7 +95,7 @@ def main():
     opt_state = trainer.init_opt_state(params)
 
     def batches():
-        if args.mode == "pp":
+        if args.mode == "pp" and not args.use_module:
             # microbatched input: (M, B/M, T)
             per = max(args.batch // args.microbatches, 1)
             for tokens, labels in synthetic_corpus(
@@ -99,6 +114,50 @@ def main():
                          time.time() - tic)
     logging.info("done: %s over %d devices, final loss %.4f",
                  args.mode, len(devices), float(loss))
+
+
+def main_module(args):
+    """The unified path: same model, same modes, through Module.fit."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import DataBatch, DataDesc
+    from mxnet_tpu import ndarray as nd
+
+    class _Iter:
+        def __init__(self):
+            self.provide_data = [DataDesc("data", (args.batch, args.seq_len))]
+            self.provide_label = [
+                DataDesc("softmax_label", (args.batch, args.seq_len))]
+            self.batch_size = args.batch
+            self.reset()
+
+        def reset(self):
+            self._gen = synthetic_corpus(
+                args.vocab, args.batch, args.seq_len, args.steps)
+
+        def __iter__(self):
+            self.reset()
+            return self
+
+        def __next__(self):
+            tokens, labels = next(self._gen)
+            return DataBatch([nd.array(tokens.astype(np.float32))],
+                             [nd.array(labels.astype(np.float32))], pad=0)
+
+        next = __next__
+
+    mod = mx.mod.ParallelLMModule(
+        vocab_size=args.vocab, num_layers=args.num_layers,
+        model_dim=args.model_dim, num_heads=args.num_heads,
+        ffn_dim=args.ffn_dim, seq_len=args.seq_len, mode=args.mode,
+        num_devices=args.devices, num_experts=args.num_experts,
+        microbatches=args.microbatches)
+    mod.fit(_Iter(), num_epoch=1, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            eval_metric=mx.metric.Perplexity(ignore_label=None),
+            batch_end_callback=[lambda p: logging.info(
+                "batch %d  loss %.4f", p.nbatch, mod.loss or float("nan"))])
+    logging.info("done (module path): %s, final loss %.4f",
+                 args.mode, mod.loss)
 
 
 if __name__ == "__main__":

@@ -147,7 +147,7 @@ class Executor:
 
         self._symbol = symbol
         self._ctx = ctx
-        self._group2ctx = group2ctx  # placement hints; compute is SPMD-scheduled by XLA
+        self._group2ctx = group2ctx
         self.monitor_callback = None
         self._monitor_active = None
         # mixed precision (the TPU-native form of the reference's fp16 symbols,
@@ -212,7 +212,57 @@ class Executor:
         self._is_loss_output = self._detect_loss_outputs()
         self._graph_fn_monitored = None  # built lazily on first monitored forward
 
+        # ---- real group2ctx placement (reference: AssignContext +
+        # PlaceDevice + _CrossDeviceCopy, graph_executor.cc:245-334): when
+        # the ctx groups map onto >=2 distinct devices, the graph is cut
+        # into per-device segments, each params set genuinely lives on its
+        # group's device, and boundary values move over explicit transfers
+        # (ICI between chips). See mxnet_tpu/placed.py.
+        self._placed = None
+        if group2ctx:
+            devs = {c.jax_device for c in group2ctx.values()}
+            devs.add(ctx.jax_device if not isinstance(ctx, (list, tuple))
+                     else ctx[0].jax_device)
+            if len(devs) > 1:
+                from .placed import PlacedGraph
+
+                base_ctx = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
+                cd = self._compute_dtype
+
+                def cast_one(name, a):
+                    if (cd is not None and name not in self._cast_exempt
+                            and a.dtype == np.float32):
+                        return a.astype(cd)
+                    return a
+
+                self._placed = PlacedGraph(
+                    symbol, group2ctx, base_ctx,
+                    self._arg_names, self._aux_names, cast_one)
+                self._place_arrays()
+
     # ------------------------------------------------------------------
+    def _place_arrays(self):
+        """Move each bound array onto its ctx group's device — the user-visible
+        face of model parallelism: ``ex.arg_dict['fc2_weight'].context`` is the
+        group's context, and the buffer is committed there."""
+        import jax
+
+        for i, name in enumerate(self._arg_names):
+            tgt = self._placed.arg_ctx.get(name)
+            if tgt is None:
+                continue
+            for arr in (self.arg_arrays[i], self.grad_arrays[i]):
+                if arr is None:
+                    continue
+                arr._set_data(jax.device_put(arr.data, tgt.jax_device))
+                arr._ctx = tgt
+        for j, name in enumerate(self._aux_names):
+            tgt = self._placed.aux_ctx.get(name)
+            if tgt is not None:
+                self.aux_arrays[j]._set_data(
+                    jax.device_put(self.aux_arrays[j].data, tgt.jax_device))
+                self.aux_arrays[j]._ctx = tgt
+
     def _detect_loss_outputs(self):
         flags = []
         for node, _ in self._symbol._entries:
@@ -295,14 +345,19 @@ class Executor:
 
         fn = self._jit_fwd.get(is_train)
         if fn is None:
+            if self._placed is not None:
+                # segmented multi-device execution (each segment is its own
+                # single-device jit; transfers happen between them)
+                fn = lambda args, auxs, rng, _t=is_train: (  # noqa: E731
+                    self._placed.forward(args, auxs, rng, _t))
+            else:
+                def run(args, auxs, rng):
+                    outs, new_aux = self._graph_fn(self._cast_compute(args), auxs, rng, is_train)
+                    # aux states (BN moving stats) keep their master dtype
+                    new_aux = [na.astype(a.dtype) for na, a in zip(new_aux, auxs)]
+                    return outs, new_aux
 
-            def run(args, auxs, rng):
-                outs, new_aux = self._graph_fn(self._cast_compute(args), auxs, rng, is_train)
-                # aux states (BN moving stats) keep their master dtype
-                new_aux = [na.astype(a.dtype) for na, a in zip(new_aux, auxs)]
-                return outs, new_aux
-
-            fn = jax.jit(run)
+                fn = jax.jit(run)
             self._jit_fwd[is_train] = fn
         return fn
 
@@ -321,6 +376,14 @@ class Executor:
         """Eager node-by-node forward that feeds the monitor callback each
         node's outputs (reference ExecuteMonCallback semantics)."""
         from . import ndarray as nd
+
+        if self._placed is not None:
+            raise MXNetError(
+                "Monitor is not supported on a multi-device group2ctx "
+                "executor: the eager per-node pass cannot mix buffers "
+                "committed to different devices. Remove the monitor or "
+                "bind without group2ctx."
+            )
 
         if self._graph_fn_monitored is None:
             def emit(name, value):
@@ -364,6 +427,14 @@ class Executor:
         if self._jit_fwd_bwd is not None:
             return self._jit_fwd_bwd
         diff_idx = list(self._diff_idx)
+        if self._placed is not None:
+            def placed_run(args, auxs, out_grads, rng):
+                outs, all_grads, new_aux = self._placed.fwd_bwd(
+                    args, auxs, out_grads, rng)
+                return outs, [all_grads[i] for i in diff_idx], new_aux
+
+            self._jit_fwd_bwd = placed_run
+            return placed_run
         # activation recompute (reference: MXNET_BACKWARD_DO_MIRROR,
         # graph_executor.cc:213-226 — rebuild cheap activations in backward
         # instead of keeping them): jax.checkpoint over the whole forward is
@@ -399,6 +470,12 @@ class Executor:
         transports, but the compiler's plan is exact for a static graph."""
         import jax
 
+        if self._placed is not None:
+            raise MXNetError(
+                "memory_analysis is per-program; a multi-device group2ctx "
+                "executor runs one program per device segment. Bind without "
+                "group2ctx to analyze the fused single-device program."
+            )
         # abstract out-grads and a fixed key: lowering only needs shapes, and
         # consuming the training rng stream here would shift later steps'
         # randomness (an observability call must not perturb training)

@@ -191,7 +191,22 @@ class _DeferredCountMetric(EvalMetric):
                         a = numpy.asarray(a)
             fixed.append(a)
         devkey = tuple(sorted(d.id for d in ref_devs))
-        acc = self._dev_count.get(devkey, np.int32(0))
+        acc = self._dev_count.get(devkey)
+        if acc is None:
+            # place the initial zero beside the predictions so the donation
+            # is honored from the first call (a host scalar would emit a
+            # 'donated buffers were not usable' warning into user logs)
+            zero = np.int32(0)
+            if len(ref_devs) == 1:
+                acc = jax.device_put(zero, next(iter(ref_devs)))
+            else:
+                try:
+                    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+                    acc = jax.device_put(
+                        zero, NamedSharding(ref.sharding.mesh, _P()))
+                except (AttributeError, TypeError, ValueError):
+                    acc = zero
         self._dev_count[devkey] = fn(acc, *fixed)
 
 
@@ -309,12 +324,17 @@ class TopKAccuracy(_DeferredCountMetric):
             self.num_inst += int(shape[0])
 
     def _update_host(self, label, pred_label):
-        pred_np = numpy.argsort(numpy.asarray(pred_label).astype("float32"), axis=1)
+        pred_np = numpy.asarray(pred_label).astype("float32")
         label_np = _as_numpy(label).astype("int32")
         num_samples = pred_np.shape[0]
         if pred_np.ndim == 1:
-            self.sum_metric += (pred_np.flat == label_np.flat).sum()
+            # 1-D predictions are class ids — the same semantic as the
+            # device path (argsort with axis=1 would raise here)
+            self.sum_metric += (
+                pred_np.astype("int32").flat == label_np.flat
+            ).sum()
         else:
+            pred_np = numpy.argsort(pred_np, axis=1)
             num_classes = pred_np.shape[1]
             top_k = min(num_classes, self.top_k)
             for j in range(top_k):

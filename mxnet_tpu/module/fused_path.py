@@ -33,16 +33,43 @@ from ..io import DataDesc
 __all__ = ["FusedFitPath"]
 
 
+class _SharedFusedState:
+    """Device-resident training state shared by every FusedFitPath bound to
+    the same parameters — the bucketing case (reference: BucketingModule's
+    shared_module rebinding, bucketing_module.py:18): each bucket gets its own
+    shape-specialized SPMDTrainer/executable, but fp32 master params, aux
+    states and optimizer state are ONE set of (name-keyed, sharded) device
+    arrays, so switching buckets never round-trips through the host."""
+
+    __slots__ = ("mesh", "params", "auxs", "states", "host_states",
+                 "device_dirty")
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self.params = None   # device dicts (fp32 masters, sharded by name)
+        self.auxs = None
+        self.states = None
+        self.host_states = None  # staged serial-format states awaiting upload
+        self.device_dirty = False
+
+
 class FusedFitPath:
-    def __init__(self, module):
+    def __init__(self, module, share_state=None):
         import jax
 
         from ..parallel import build_mesh
         from ..parallel.spmd import SPMDTrainer
 
         self._mod = module
-        devices = [c.jax_device for c in module._context]
-        mesh = build_mesh({"dp": len(devices)}, devices)
+        if share_state is not None:
+            # bucketing: reuse the lender's mesh so shardings are identical
+            # and the shared device arrays feed this trainer without copies
+            self.state = share_state
+            mesh = share_state.mesh
+        else:
+            devices = [c.jax_device for c in module._context]
+            mesh = build_mesh({"dp": len(devices)}, devices)
+            self.state = _SharedFusedState(mesh)
         self._data_shapes = [(d.name, tuple(d.shape)) for d in module._data_shapes]
         self._label_shapes = [
             (d.name, tuple(d.shape)) for d in (module._label_shapes or [])
@@ -55,54 +82,75 @@ class FusedFitPath:
             optimizer=module._optimizer,
             compute_dtype=module._compute_dtype,
         )
-        self._params = None  # device dicts (fp32 masters, sharded)
-        self._auxs = None
-        self._states = None
-        self._host_states = None  # staged serial-format states awaiting upload
         self._pending = None  # staged inputs for the next step()
         self.staged_batch = None  # the DataBatch behind _pending (for replay)
         self._outs = None  # last step's forward outputs (pre-update params)
-        self.device_dirty = False
+
+    @property
+    def device_dirty(self):
+        return self.state.device_dirty
 
     # ---- state movement --------------------------------------------------
     def _ensure_device_state(self):
         import jax
 
-        if self._params is not None:
+        tr = self.trainer
+        st = self.state
+        if st.params is not None:
+            # shared-state bucketing: another bucket may have uploaded first;
+            # top up any params/auxs this bucket's symbol adds
+            missing = [n for n in tr.param_names if n not in st.params]
+            if not missing and all(n in st.auxs for n in tr.aux_names):
+                return
+            mod = self._mod
+            for n in missing:
+                st.params[n] = jax.device_put(
+                    mod._arg_params[n].asnumpy().astype(tr.dtype),
+                    tr.param_shardings[n])
+                st.states[n] = tuple(
+                    jax.device_put(s, tr.param_shardings[n])
+                    for s in tr.rule.init_state(tr.arg_shapes[n], tr.dtype))
+            for n in tr.aux_names:
+                if n not in st.auxs:
+                    st.auxs[n] = jax.device_put(
+                        mod._aux_params[n].asnumpy().astype(np.float32),
+                        tr.repl)
             return
         mod = self._mod
         if mod._params_dirty:
             # executor-group copies are newer (a classic-path update ran)
             mod._sync_params_from_devices()
-        tr = self.trainer
-        self._params = {
+        st.params = {
             n: jax.device_put(
                 mod._arg_params[n].asnumpy().astype(tr.dtype), tr.param_shardings[n]
             )
             for n in tr.param_names
         }
-        self._auxs = {
+        st.auxs = {
             n: jax.device_put(mod._aux_params[n].asnumpy().astype(np.float32), tr.repl)
             for n in tr.aux_names
         }
-        if self._host_states is not None:
-            self._states = self._upload_states(self._host_states)
-            self._host_states = None
-        elif self._states is None:
-            self._states = tr.init_opt_state()
+        if st.host_states is not None:
+            st.states = self._upload_states(st.host_states)
+            st.host_states = None
+        elif st.states is None:
+            st.states = tr.init_opt_state()
 
-    def invalidate(self):
+    def invalidate(self, stage_states=True):
         """Drop device params/auxs (module-side copies became authoritative,
         e.g. set_params or a classic-path update). Optimizer state is kept —
-        staged to host so momentum survives the round-trip."""
-        if self._states is not None:
-            self._host_states = self._download_states(self._states)
-        self._params = None
-        self._auxs = None
-        self._states = None
+        staged to host so momentum survives the round-trip. Pass
+        ``stage_states=False`` when the caller will immediately supply fresh
+        states (the classic-fallback handover) to skip the device→host
+        download."""
+        if stage_states and self.state.states is not None:
+            self.state.host_states = self._download_states(self.state.states)
+        self.state.params = None
+        self.state.auxs = None
+        self.state.states = None
         self._pending = None
         self._outs = None
-        self.device_dirty = False
+        self.state.device_dirty = False
 
     def drop_batch(self):
         """Forget any staged batch and cached outputs. Called when a
@@ -116,18 +164,18 @@ class FusedFitPath:
         """Write device params/auxs back into Module's host dicts + executor
         group, so classic-path consumers observe the fused updates."""
         mod = self._mod
-        if not self.device_dirty or self._params is None:
+        if not self.state.device_dirty or self.state.params is None:
             return
-        for n, arr in self._params.items():
+        for n, arr in self.state.params.items():
             mod._arg_params[n][:] = np.asarray(arr).astype(
                 mod._arg_params[n].dtype, copy=False
             )
-        for n, arr in self._auxs.items():
+        for n, arr in self.state.auxs.items():
             mod._aux_params[n][:] = np.asarray(arr).astype(
                 mod._aux_params[n].dtype, copy=False
             )
         mod._exec_group.set_params(mod._arg_params, mod._aux_params)
-        self.device_dirty = False
+        self.state.device_dirty = False
 
     # ---- fit-loop hooks --------------------------------------------------
     def accepts(self, data_batch):
@@ -165,12 +213,28 @@ class FusedFitPath:
 
     def step(self):
         assert self._pending is not None, "no staged batch: call forward first"
-        self._params, self._auxs, self._states, self._outs = self.trainer.step(
-            self._params, self._auxs, self._states, self._pending
-        )
+        st = self.state
+        tr = self.trainer
+        if (len(st.params) == len(tr.param_names)
+                and len(st.auxs) == len(tr.aux_names)):
+            st.params, st.auxs, st.states, self._outs = tr.step(
+                st.params, st.auxs, st.states, self._pending
+            )
+        else:
+            # shared-state bucketing where this bucket's symbol uses a param
+            # subset: step over the subset, merge back (donation consumed the
+            # passed entries; the merged dict carries the new arrays)
+            sub_p = {n: st.params[n] for n in tr.param_names}
+            sub_a = {n: st.auxs[n] for n in tr.aux_names}
+            sub_s = {n: st.states[n] for n in tr.param_names}
+            new_p, new_a, new_s, self._outs = tr.step(
+                sub_p, sub_a, sub_s, self._pending)
+            st.params.update(new_p)
+            st.auxs.update(new_a)
+            st.states.update(new_s)
         self._pending = None
         self.staged_batch = None
-        self.device_dirty = True
+        st.device_dirty = True
 
     @property
     def has_outputs(self):
@@ -189,7 +253,7 @@ class FusedFitPath:
                 n: jax.device_put(v, self.trainer.batch_sharding)
                 for n, v in self._pending.items()
             }
-            self._outs = self._eval_fn(self._params, self._auxs, inputs)
+            self._outs = self._eval_fn(self.state.params, self.state.auxs, inputs)
         ctx = self._mod._context[0]
         return [nd.NDArray(o, ctx=ctx) for o in self._outs]
 
@@ -204,20 +268,25 @@ class FusedFitPath:
     # init_optimizer's idx2name) — saves match the layout the CURRENT config's
     # classic equivalent would read, and loads accept either layout.
     def _download_states(self, states):
-        """Canonical {i: serial_state} keyed by enumerate(param_names)."""
+        """Internal staging format: NAME-keyed {param_name: serial_state}
+        over every entry in the shared device dict — robust when buckets
+        with differing param sets share the state (a positional format would
+        misassign or drop the other buckets' entries)."""
         rule = self.trainer.rule
-        return {
-            i: rule.to_serial(states[n])
-            for i, n in enumerate(self.trainer.param_names)
-        }
+        return {n: rule.to_serial(s) for n, s in states.items()}
 
-    def _upload_states(self, serial):
+    def _upload_states(self, by_name):
+        """Device states for THIS trainer's params from the name-keyed
+        staging dict; names it lacks start fresh."""
         import jax
 
         tr = self.trainer
         out = {}
-        for i, n in enumerate(tr.param_names):
-            st = tr.rule.from_serial(serial[i], tr.arg_shapes[n], tr.dtype)
+        for n in tr.param_names:
+            if n in by_name:
+                st = tr.rule.from_serial(by_name[n], tr.arg_shapes[n], tr.dtype)
+            else:
+                st = tr.rule.init_state(tr.arg_shapes[n], tr.dtype)
             out[n] = tuple(
                 jax.device_put(np.asarray(s, tr.dtype), tr.param_shardings[n])
                 for s in st
@@ -225,16 +294,22 @@ class FusedFitPath:
         return out
 
     def _canonical_states(self):
-        if self._states is not None:
-            return self._download_states(self._states)
-        if self._host_states is not None:
-            return self._host_states
-        return {
-            i: self.trainer.rule.to_serial(
-                self.trainer.rule.init_state(
-                    self.trainer.arg_shapes[i_name], self.trainer.dtype))
-            for i, i_name in enumerate(self.trainer.param_names)
-        }
+        """EXTERNAL (.states file) format: {i: serial} keyed by this
+        bucket's enumerate(param_names) — the classic Updater interchange
+        contract."""
+        if self.state.states is not None:
+            by_name = self._download_states(self.state.states)
+        elif self.state.host_states is not None:
+            by_name = self.state.host_states
+        else:
+            by_name = {}
+        rule = self.trainer.rule
+        out = {}
+        for i, n in enumerate(self.trainer.param_names):
+            out[i] = by_name.get(n) if by_name.get(n) is not None else \
+                rule.to_serial(rule.init_state(
+                    self.trainer.arg_shapes[n], self.trainer.dtype))
+        return out
 
     def get_states_bytes(self):
         serial = self._canonical_states()
@@ -249,20 +324,24 @@ class FusedFitPath:
 
     def set_states_bytes(self, data):
         serial = pickle.loads(data)
-        P = len(self.trainer.param_names)
+        names = self.trainer.param_names
+        P = len(names)
         if set(serial.keys()) == set(range(P)):
-            canon = serial
+            canon = {names[i]: serial[i] for i in range(P)}
         elif len(serial) % P == 0 and set(serial.keys()) == set(range(len(serial))):
             stride = len(serial) // P  # per-device replicas: take device 0's
-            canon = {i: serial[i * stride] for i in range(P)}
+            canon = {names[i]: serial[i * stride] for i in range(P)}
         else:
             raise ValueError(
                 "optimizer states file does not match this module's parameters"
             )
-        self._host_states = canon
-        if self._params is not None:
-            self._states = self._upload_states(canon)
-            self._host_states = None
+        # merge over any staged entries for params outside this bucket
+        merged = dict(self.state.host_states or {})
+        merged.update(canon)
+        self.state.host_states = merged
+        if self.state.params is not None:
+            self.state.states = self._upload_states(merged)
+            self.state.host_states = None
 
 
 def batch_axes_standard(descs):

@@ -336,6 +336,7 @@ class Module(BaseModule):
         self._kvstore = kvstore
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
+        self._fused_kvstore_arg = kvstore_arg  # for borrow_optimizer sharing
         self._fused = self._build_fused_path(kvstore_arg)
         if kvstore:
             # copy initialized local parameters to kvstore
@@ -353,8 +354,9 @@ class Module(BaseModule):
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
-    def _fused_eligible(self, kvstore_arg):
-        """Is this configuration expressible as ONE SPMD program?
+    def _fused_veto(self, kvstore_arg):
+        """Why this configuration is NOT expressible as ONE SPMD program —
+        None when it is.
 
         ``kvstore='device'`` (the reference's reduce-on-device mode,
         kvstore.py:10-19) opts into in-graph allreduce on any platform; on TPU
@@ -366,27 +368,33 @@ class Module(BaseModule):
         from ..kvstore import KVStore
 
         if env_flag("MXNET_MODULE_NO_FUSED"):
-            return False
+            return "MXNET_MODULE_NO_FUSED=1 (explicit opt-out)"
         if isinstance(kvstore_arg, KVStore):
             # a ready store participates by its type string (the reference's
             # common/fit.py passes instances); dist stores are filtered below
             kvstore_arg = kvstore_arg.type
         if not isinstance(kvstore_arg, str) and kvstore_arg is not None:
-            return False
-        if self._grad_req != "write" or self.inputs_need_grad:
-            return False
-        if self._state_names or self._fixed_param_names:
-            return False
+            return "non-string kvstore object"
+        if self._grad_req != "write":
+            return "grad_req=%r (fused step supports 'write' only)" % (
+                self._grad_req,)
+        if self.inputs_need_grad:
+            return "inputs_need_grad=True"
+        if self._state_names:
+            return "state_names are bound"
+        if self._fixed_param_names:
+            return "fixed_param_names are bound"
         if self._monitor_installed:
-            return False
+            return "a Monitor is installed (per-node hooks need the " \
+                   "executor path)"
         if len(set(self._work_load_list)) > 1:
-            return False
+            return "non-uniform work_load_list"
         from .fused_path import batch_axes_standard
 
-        if not batch_axes_standard(self._data_shapes or []):
-            return False
-        if self._label_shapes and not batch_axes_standard(self._label_shapes):
-            return False
+        if not batch_axes_standard(self._data_shapes or []) or (
+                self._label_shapes
+                and not batch_axes_standard(self._label_shapes)):
+            return "a data/label layout has a non-leading batch axis"
         # the fused step seeds gradient cotangents into loss OUTPUT entries
         # only (executor.py's loss-flag seeding); a symbol without a loss
         # output (e.g. a SequentialModule feature stage trained via
@@ -398,32 +406,59 @@ class Module(BaseModule):
             for node, _ in self._symbol._entries
         )
         if not has_loss_output:
-            return False
+            return "symbol has no loss output (trained via out_grads)"
         devtypes = {c.device_type for c in self._context}
         if len(devtypes) != 1:
-            return False
+            return "mixed device types in context list"
         # contexts must land on DISTINCT jax devices (Context.jax_device wraps
         # device ids modulo the platform's device count, e.g. cpu(3) on a
         # 1-CPU process): a mesh with duplicates is not a valid SPMD target
         try:
             jax_devs = [c.jax_device for c in self._context]
         except Exception:
-            return False
+            return "contexts do not resolve to jax devices"
         if len(set(jax_devs)) != len(jax_devs):
-            return False
+            return "contexts resolve to duplicate devices (no SPMD mesh)"
         if kvstore_arg is not None and "dist" in kvstore_arg:
-            return False
+            return "distributed kvstore %r (PS push/pull uses the " \
+                   "executor path)" % (kvstore_arg,)
         if kvstore_arg in ("device", "local_allreduce_device"):
-            return True
-        return devtypes.pop() == "tpu" and kvstore_arg in (None, "local")
+            return None
+        if devtypes.pop() == "tpu" and kvstore_arg in (None, "local"):
+            return None
+        return "kvstore=%r on non-TPU contexts (pass kvstore='device' to " \
+               "opt in)" % (kvstore_arg,)
 
-    def _build_fused_path(self, kvstore_arg):
-        if not self._fused_eligible(kvstore_arg):
+    def _fused_eligible(self, kvstore_arg):
+        return self._fused_veto(kvstore_arg) is None
+
+    def _build_fused_path(self, kvstore_arg, share_state=None):
+        veto = self._fused_veto(kvstore_arg)
+        if veto is not None:
+            # demotions must be LOUD when the user plausibly expected the
+            # fast path: TPU contexts, or an explicit kvstore='device'.
+            # (cpu+local classic is the expected default — stay quiet.)
+            from ..kvstore import KVStore
+
+            kv_str = (kvstore_arg.type if isinstance(kvstore_arg, KVStore)
+                      else kvstore_arg)
+            wanted_fast = (
+                (isinstance(kv_str, str)
+                 and (kv_str in ("device", "local_allreduce_device")
+                      or "dist" in kv_str))
+                or any(c.device_type == "tpu" for c in self._context))
+            if wanted_fast and "MXNET_MODULE_NO_FUSED" not in veto:
+                self.logger.warning(
+                    "Module.fit is NOT using the fused SPMD fast path: %s. "
+                    "Training runs on the executor-group path (roughly an "
+                    "order of magnitude slower on TPU). Set "
+                    "MXNET_MODULE_NO_FUSED=1 to silence this warning if "
+                    "the classic path is intended.", veto)
             return None
         try:
             from .fused_path import FusedFitPath
 
-            return FusedFitPath(self)
+            return FusedFitPath(self, share_state=share_state)
         except ValueError as e:  # unsupported optimizer for the fused rules
             self.logger.info(
                 "fused SPMD path unavailable (%s); using the executor-group path", e
@@ -432,12 +467,24 @@ class Module(BaseModule):
 
     def borrow_optimizer(self, shared_module):
         """(reference: module.py borrow_optimizer — bucketing modules share one
-        optimizer/updater)."""
+        optimizer/updater).
+
+        When the lender trains on the fused SPMD path, the borrower gets its
+        own shape-specialized fused path SHARING the lender's device state
+        (fp32 masters, aux, optimizer state) — so every bucket of a
+        BucketingModule runs the one-program-per-step fast path and bucket
+        switches stay on-device."""
         assert shared_module.optimizer_initialized
         self._optimizer = shared_module._optimizer
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        self._fused_kvstore_arg = getattr(
+            shared_module, "_fused_kvstore_arg", None)
+        if shared_module._fused is not None:
+            self._fused = self._build_fused_path(
+                self._fused_kvstore_arg,
+                share_state=shared_module._fused.state)
         self.optimizer_initialized = True
 
     # ---- compute ---------------------------------------------------------
@@ -479,6 +526,25 @@ class Module(BaseModule):
         if self._fused is not None and self._fused.pending:
             self._fused.step()
             return
+        handover = (self._fused is not None
+                    and (self._fused.state.states is not None
+                         or self._fused.state.host_states is not None))
+        if handover:
+            # a classic fallback update mid-fused-training (odd-shaped batch,
+            # backward(out_grads)): seed the Updater with the fused optimizer
+            # state so this step keeps its momentum/Adam moments and the
+            # right bias-correction t, instead of silently updating from a
+            # fresh state (the install_monitor handover, both directions)
+            if self._updater is not None:
+                opt = self._optimizer
+                opt.begin_num_update = opt.num_update
+                opt._index_update_count = {}
+                self._updater.set_states(self._fused.get_states_bytes())
+            elif self._kvstore is not None:
+                self.logger.warning(
+                    "classic fallback update with a kvstore-updating config: "
+                    "this step's optimizer state starts fresh on the kvstore"
+                )
         if self._update_on_kvstore:
             _update_params_on_kvstore(
                 self._exec_group.param_arrays, self._exec_group.grad_arrays, self._kvstore
@@ -489,8 +555,15 @@ class Module(BaseModule):
                 updater=self._updater, num_device=len(self._context), kvstore=self._kvstore,
             )
         if self._fused is not None:
-            # a classic update ran: device-resident fused params are now stale
-            self._fused.invalidate()
+            # a classic update ran: device-resident fused params are now
+            # stale — drop them...
+            replacing = handover and self._updater is not None
+            self._fused.invalidate(stage_states=not replacing)
+            if replacing:
+                # ...and carry the classic step's state delta back so fused
+                # training resumes from the updated moments, not the staged
+                # pre-fallback ones
+                self._fused.set_states_bytes(self._updater.get_states())
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

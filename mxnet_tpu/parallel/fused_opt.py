@@ -271,7 +271,15 @@ def host_step_values(optimizer, param_names):
 
     Returns (base_lr, t) to feed the traced step as dynamic scalars. Keeps
     ``optimizer.num_update``/``_index_update_count`` consistent so schedulers
-    and serial-path interchange (checkpoint resume) behave identically."""
+    and serial-path interchange (checkpoint resume) behave identically.
+
+    ONE-STEP BOUNDARY SKEW vs the serial Updater: this evaluates the lr
+    scheduler once per fused step (every parameter sees the same lr), while
+    the serial path evaluates it per parameter index as ``num_update``
+    advances — so on the exact step a decay boundary is crossed, the two
+    paths can apply different lrs to a subset of parameters. The
+    'numerically interchangeable' claim is scoped to all other steps
+    (tests/test_spmd_optimizers.py documents the boundary case)."""
     if optimizer.lr_scheduler is not None:
         lr = optimizer.lr_scheduler(optimizer.num_update)
     else:

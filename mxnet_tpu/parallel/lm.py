@@ -31,8 +31,8 @@ import numpy as np
 
 from . import fused_opt
 
-__all__ = ["SPLMTrainer", "PPLMTrainer", "MoELMTrainer", "init_lm_params",
-           "lm_param_names", "lm_forward_dense"]
+__all__ = ["DenseLMTrainer", "SPLMTrainer", "PPLMTrainer", "MoELMTrainer",
+           "init_lm_params", "lm_param_names", "lm_forward_dense"]
 
 
 # ---------------------------------------------------------------- params
@@ -204,6 +204,54 @@ class _LMTrainerBase:
     def _host_lr_t(self, params):
         lr, t = fused_opt.host_step_values(self.optimizer, list(params))
         return np.float32(lr), np.int32(t)
+
+
+# ------------------------------------------------------------------ dense
+class DenseLMTrainer(_LMTrainerBase):
+    """Single-program dense LM trainer — the same step/forward surface as the
+    parallel trainers with no mesh, so ``ParallelLMModule(mode='dense')``
+    gives the baseline every parallel mode is parity-tested against."""
+
+    def __init__(self, mesh=None, vocab_size=0, num_layers=0, model_dim=0,
+                 num_heads=0, ffn_dim=0, seq_len=0, optimizer="sgd",
+                 optimizer_params=None, **_):
+        super().__init__(optimizer, optimizer_params)
+        self.mesh = mesh  # unused; accepted for constructor symmetry
+        self.cfg = dict(vocab_size=vocab_size, num_layers=num_layers,
+                        model_dim=model_dim, num_heads=num_heads,
+                        ffn_dim=ffn_dim, seq_len=seq_len)
+        self._step = None
+        self._fwd = None
+
+    def init_params(self, seed=0):
+        return init_lm_params(seed, **self.cfg)
+
+    def _build(self):
+        import jax
+
+        L, H = self.cfg["num_layers"], self.cfg["num_heads"]
+
+        def step(params, opt_state, tokens, labels, lr, t):
+            def loss_fn(p):
+                return _xent(lm_forward_dense(p, tokens, L, H), labels)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            params, opt_state = self._apply_updates(params, grads, opt_state, lr, t)
+            return params, opt_state, loss
+
+        self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._fwd = jax.jit(lambda p, tok: lm_forward_dense(p, tok, L, H))
+
+    def step(self, params, opt_state, tokens, labels):
+        if self._step is None:
+            self._build()
+        lr, t = self._host_lr_t(params)
+        return self._step(params, opt_state, tokens, labels, lr, t)
+
+    def forward(self, params, tokens):
+        if self._fwd is None:
+            self._build()
+        return self._fwd(params, tokens)
 
 
 # ------------------------------------------------------------------- sp

@@ -1,0 +1,342 @@
+"""Real model-parallel placement for ``group2ctx`` (reference:
+``AssignContext`` + ``nnvm::pass::PlaceDevice`` inserting ``_CrossDeviceCopy``
+nodes, src/executor/graph_executor.cc:245-334, and the engine's async overlap
+of the resulting per-device subgraphs).
+
+TPU-native design: one jitted XLA program cannot host operands committed to
+different devices, so — exactly like the reference's graph partitioner — the
+symbol's topological order is cut into maximal same-device SEGMENTS. Each
+segment compiles to its own single-device executable (params for a ctx group
+genuinely live on that group's device); values crossing a segment boundary
+are moved with an explicit ``jax.device_put`` — the ``_CrossDeviceCopy``
+analog, riding ICI between real TPU chips and host copies between virtual CPU
+devices. jax's async dispatch overlaps independent segments the way the
+reference's dependency engine overlapped its per-device subgraphs.
+
+Backward composes per-segment ``jax.vjp`` executables in reverse topological
+order, transferring cotangents back across the same boundaries. Each
+segment's backward recomputes its forward inside the vjp (residuals are not
+kept across program boundaries) — the memory-lean choice for the
+model-too-big-for-one-chip configurations this mode exists for; stochastic
+ops fold the same per-node key in both passes, so dropout masks agree.
+
+Used by :class:`mxnet_tpu.executor.Executor` when ``bind(group2ctx=...)``
+maps ctx groups onto at least two distinct devices.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ops.registry import OpContext, get_op
+from .symbol import _topo_order
+
+from jax.dtypes import float0 as _float0
+
+__all__ = ["PlacedGraph"]
+
+
+class _Segment:
+    __slots__ = ("device", "ctx", "nodes", "in_keys", "out_keys",
+                 "stoch_offsets", "fwd_jit", "bwd_jit")
+
+    def __init__(self, device, ctx):
+        self.device = device
+        self.ctx = ctx
+        self.nodes = []
+        self.in_keys = []       # value keys consumed from outside
+        self.out_keys = []      # value keys produced here and needed later
+        self.stoch_offsets = {}  # id(node) -> global stochastic index
+        self.fwd_jit = None
+        self.bwd_jit = None
+
+
+class PlacedGraph:
+    """Per-device segmented execution of a bound symbol.
+
+    Value keys: ``(id(node), k)`` for every node/variable output entry.
+    """
+
+    def __init__(self, symbol, group2ctx, default_ctx, arg_names, aux_names,
+                 cast_compute):
+        self._symbol = symbol
+        self._cast_compute = cast_compute  # fn(name, array) -> array
+        self.transfer_count = 0  # cross-device copies per step (observability)
+
+        order = _topo_order(symbol._entries)
+        arg_vars, aux_vars = symbol._arg_aux_split()
+        self._arg_index = {}
+        self._aux_index = {}
+        for node in order:
+            if node.is_variable:
+                if id(node) in aux_vars:
+                    self._aux_index[id(node)] = len(self._aux_index)
+                else:
+                    self._arg_index[id(node)] = len(self._arg_index)
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+
+        # ---- device assignment (reference AssignContext semantics:
+        # unmapped groups and group-less nodes fall to the default ctx) ----
+        def node_ctx(node):
+            g = node.list_attr().get("ctx_group")
+            if g and group2ctx and g in group2ctx:
+                return group2ctx[g]
+            return default_ctx
+
+        compute_nodes = [n for n in order if not n.is_variable]
+        node_dev = {id(n): node_ctx(n) for n in compute_nodes}
+
+        # variables live where their first consumer computes
+        self.var_ctx = {}
+        for node in compute_nodes:
+            for inp, _ in node.inputs:
+                if inp.is_variable and id(inp) not in self.var_ctx:
+                    self.var_ctx[id(inp)] = node_dev[id(node)]
+        for node in order:  # unconsumed variables: default
+            if node.is_variable:
+                self.var_ctx.setdefault(id(node), default_ctx)
+
+        self.arg_ctx = {self._arg_names[i]: self.var_ctx[nid]
+                        for nid, i in self._arg_index.items()}
+        self.aux_ctx = {self._aux_names[j]: self.var_ctx[nid]
+                        for nid, j in self._aux_index.items()}
+
+        # ---- cut maximal same-device segments in topo order ----
+        self.segments = []
+        cur = None
+        stoch_i = 0
+        for node in compute_nodes:
+            ctx = node_dev[id(node)]
+            dev = ctx.jax_device
+            if cur is None or cur.device is not dev:
+                cur = _Segment(dev, ctx)
+                self.segments.append(cur)
+            cur.nodes.append(node)
+            op = get_op(node.op)
+            if op.stochastic:
+                cur.stoch_offsets[id(node)] = stoch_i
+                stoch_i += 1
+
+        # ---- dataflow: which keys cross segment boundaries ----
+        produced_in = {}
+        for s, seg in enumerate(self.segments):
+            for node in seg.nodes:
+                produced_in[id(node)] = s
+
+        out_entries = [(id(n), k) for n, k in symbol._entries]
+        needed = {}  # key -> set of consumer segment ids (or 'out')
+        for s, seg in enumerate(self.segments):
+            for node in seg.nodes:
+                for inp, k in node.inputs:
+                    key = (id(inp), k)
+                    src = produced_in.get(id(inp))  # None for variables
+                    if src is None or src != s:
+                        needed.setdefault(key, set()).add(s)
+        for key in out_entries:
+            if produced_in.get(key[0]) is not None:
+                needed.setdefault(key, set()).add("out")
+
+        for s, seg in enumerate(self.segments):
+            node_ids = {id(n) for n in seg.nodes}
+            ins, outs = [], []
+            seen_in = set()
+            for node in seg.nodes:
+                for inp, k in node.inputs:
+                    key = (id(inp), k)
+                    if id(inp) not in node_ids and key not in seen_in:
+                        seen_in.add(key)
+                        ins.append(key)
+            for node in seg.nodes:
+                for key, consumers in needed.items():
+                    if key[0] == id(node) and (consumers - {s}):
+                        outs.append(key)
+            # aux writebacks produced by this segment
+            seg.in_keys = ins
+            seg.out_keys = outs
+        self._out_entries = out_entries
+
+        # aux updates: map aux var id -> producing segment (aux inputs are
+        # consumed and rewritten by the same node, e.g. BatchNorm stats)
+        self._aux_producer = {}
+        for s, seg in enumerate(self.segments):
+            for node in seg.nodes:
+                op = get_op(node.op)
+                n_args = len(op.arg_names(node.attrs))
+                for inp, _ in node.inputs[n_args:]:
+                    if id(inp) in self._aux_index:
+                        self._aux_producer[id(inp)] = s
+
+    # ------------------------------------------------------------------
+    def _make_seg_fwd(self, seg, is_train):
+        """Pure fn: (in_vals, rng) -> (boundary outs, new_aux_for_this_seg)."""
+        import jax
+
+        in_keys = list(seg.in_keys)
+        out_keys = list(seg.out_keys)
+        aux_ids = sorted({nid for nid in self._aux_producer
+                          if self._aux_producer[nid] == self.segments.index(seg)},
+                         key=lambda nid: self._aux_index[nid])
+
+        def seg_fn(in_vals, rng):
+            vals = {}
+            for key, v in zip(in_keys, in_vals):
+                vals[key] = v
+            new_aux = {}
+            for node in seg.nodes:
+                op = get_op(node.op)
+                n_args = len(op.arg_names(node.attrs))
+                ins = [vals[(id(inp), k)] for inp, k in node.inputs]
+                args, auxs = ins[:n_args], ins[n_args:]
+                key_rng = None
+                if op.stochastic and rng is not None:
+                    key_rng = jax.random.fold_in(
+                        rng, seg.stoch_offsets[id(node)])
+                octx = OpContext(is_train=is_train, rng=key_rng)
+                outs, updated_aux = op.forward(octx, node.attrs, args, auxs)
+                for k, o in enumerate(outs):
+                    vals[(id(node), k)] = o
+                for (inp, _), new in zip(node.inputs[n_args:], updated_aux):
+                    if id(inp) in self._aux_index:
+                        new_aux[id(inp)] = new
+            return ([vals[k] for k in out_keys],
+                    [new_aux[nid] for nid in aux_ids])
+
+        return seg_fn, aux_ids
+
+    def _seg_fwd_jit(self, seg, is_train):
+        import jax
+
+        cache = seg.fwd_jit or {}
+        if is_train not in cache:
+            seg_fn, aux_ids = self._make_seg_fwd(seg, is_train)
+            cache[is_train] = (jax.jit(seg_fn), aux_ids, seg_fn)
+            seg.fwd_jit = cache
+        return cache[is_train]
+
+    def _seg_bwd_jit(self, seg):
+        import jax
+
+        if seg.bwd_jit is None:
+            seg_fn, aux_ids = self._make_seg_fwd(seg, True)
+
+            def bwd(in_vals, out_cts, rng):
+                def f(iv):
+                    outs, new_aux = seg_fn(iv, rng)
+                    return outs, new_aux
+
+                outs, vjp_fn, new_aux = jax.vjp(f, list(in_vals), has_aux=True)
+                in_cts = vjp_fn(list(out_cts))[0]
+                return outs, in_cts, new_aux
+
+            seg.bwd_jit = (jax.jit(bwd), aux_ids)
+        return seg.bwd_jit
+
+    # ------------------------------------------------------------------
+    def _transfer(self, value, device, count=True):
+        import jax
+
+        devs = value.devices() if hasattr(value, "devices") else None
+        if devs is not None and devs == {device}:
+            return value
+        if count:  # rng-key moves are bookkeeping, not graph-edge copies
+            self.transfer_count += 1
+        return jax.device_put(value, device)
+
+    def _seed_env(self, args, auxs):
+        """Initial value env from bound arrays (cast to compute dtype here,
+        as the single-jit path does inside its program)."""
+        env = {}
+        for nid, i in self._arg_index.items():
+            env[(nid, 0)] = self._cast_compute(self._arg_names[i], args[i])
+        for nid, j in self._aux_index.items():
+            env[(nid, 0)] = auxs[j]
+        return env
+
+    def forward(self, args, auxs, rng, is_train):
+        """Mirrors the single-jit forward contract: returns (outputs,
+        new_aux_list) with aux dtypes preserved."""
+        env = self._seed_env(args, auxs)
+        new_aux_env = {}
+        for seg in self.segments:
+            jit_fn, aux_ids, _ = self._seg_fwd_jit(seg, is_train)
+            ins = [self._transfer(env[k], seg.device) for k in seg.in_keys]
+            seg_rng = (self._transfer(rng, seg.device, count=False)
+                       if rng is not None else None)
+            outs, new_aux = jit_fn(ins, seg_rng)
+            env.update(zip(seg.out_keys, outs))
+            new_aux_env.update(zip(aux_ids, new_aux))
+        outputs = [env[k] for k in self._out_entries]
+        new_auxs = []
+        for nid, j in sorted(self._aux_index.items(), key=lambda kv: kv[1]):
+            new = new_aux_env.get(nid)
+            old = auxs[j]
+            new_auxs.append(old if new is None else new.astype(old.dtype))
+        return outputs, new_auxs
+
+    def fwd_bwd(self, args, auxs, out_grads, rng):
+        """Mirrors Executor._build_fwd_bwd's contract:
+        (outputs, grads_for_all_args_in_arg_order, new_auxs). Gradients are
+        returned for every arg (the executor filters by grad_req)."""
+        import jax.numpy as jnp
+
+        env = self._seed_env(args, auxs)
+        new_aux_env = {}
+        seg_inputs = []  # per segment: the transferred input values
+        for seg in self.segments:
+            _, aux_ids, _ = self._seg_fwd_jit(seg, True)
+            ins = [self._transfer(env[k], seg.device) for k in seg.in_keys]
+            seg_inputs.append(ins)
+            jit_fn = self._seg_fwd_jit(seg, True)[0]
+            seg_rng = (self._transfer(rng, seg.device, count=False)
+                       if rng is not None else None)
+            outs, new_aux = jit_fn(ins, seg_rng)
+            env.update(zip(seg.out_keys, outs))
+            new_aux_env.update(zip(aux_ids, new_aux))
+        outputs = [env[k] for k in self._out_entries]
+
+        # cotangent env, seeded by the head gradients
+        cts = {}
+
+        def add_ct(key, g):
+            cur = cts.get(key)
+            cts[key] = g if cur is None else cur + self._transfer(
+                g, next(iter(cur.devices())))
+
+        for key, og in zip(self._out_entries, out_grads):
+            # seed every head gradient — including outputs that are plain
+            # VARIABLES (passthrough): their cotangent IS the arg grad, and
+            # it never appears in a segment's out_keys
+            add_ct(key, og)
+
+        for si in range(len(self.segments) - 1, -1, -1):
+            seg = self.segments[si]
+            bwd_fn, aux_ids = self._seg_bwd_jit(seg)
+            out_cts = []
+            for k, out_key in enumerate(seg.out_keys):
+                g = cts.get(out_key)
+                if g is None:
+                    ref = env[out_key]
+                    g = jnp.zeros(ref.shape, ref.dtype)
+                out_cts.append(self._transfer(g, seg.device))
+            seg_rng = (self._transfer(rng, seg.device, count=False)
+                       if rng is not None else None)
+            _, in_cts, _ = bwd_fn(seg_inputs[si], out_cts, seg_rng)
+            for in_key, g in zip(seg.in_keys, in_cts):
+                if g is None or (hasattr(g, "dtype")
+                                 and g.dtype == _float0):
+                    continue
+                add_ct(in_key, g)
+
+        grads = []
+        for nid, i in sorted(self._arg_index.items(), key=lambda kv: kv[1]):
+            g = cts.get((nid, 0))
+            if g is None:
+                a = args[i]
+                g = jnp.zeros(a.shape, a.dtype)
+            grads.append(g)
+        new_auxs = []
+        for nid, j in sorted(self._aux_index.items(), key=lambda kv: kv[1]):
+            new = new_aux_env.get(nid)
+            old = auxs[j]
+            new_auxs.append(old if new is None else new.astype(old.dtype))
+        return outputs, grads, new_auxs

@@ -513,3 +513,21 @@ def test_monitor_interval_gating():
     mon.tic()  # step 1: off-interval -> monitored pass must not run
     mod.forward(batches[1], is_train=True)
     assert mon.toc() == []
+
+
+def test_topk_1d_preds_same_semantics_host_and_device():
+    """ADVICE r2: 1-D predictions are class ids in BOTH the device path and
+    the host fallback (the host path used to raise on axis=1 argsort)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import ndarray as nd
+
+    preds = np.array([1.0, 3.0, 2.0, 0.0], np.float32)
+    labels = np.array([1.0, 3.0, 0.0, 0.0], np.float32)
+
+    m_dev = mx.metric.TopKAccuracy(top_k=2)
+    m_dev.update([nd.array(labels)], [nd.array(preds)])
+    m_host = mx.metric.TopKAccuracy(top_k=2)
+    m_host.update([labels], [preds])
+    assert m_dev.get()[1] == m_host.get()[1] == 0.75
