@@ -23,7 +23,7 @@ run_native() {
 
 run_predict() {
   make -C mxnet_tpu/src c_predict
-  python -m pytest tests/test_c_predict.py -x -q
+  python -m pytest tests/test_c_predict.py tests/test_c_train.py -x -q
 }
 
 run_entry() {

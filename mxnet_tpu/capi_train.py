@@ -1,0 +1,131 @@
+"""Python side of the TRAINING C API slice (src/c_api_train.cc).
+
+The reference exposes ~120 C functions over its C++ engine
+(include/mxnet/c_api.h); the predict subset already ships in
+libmxtpu_predict.so. This module backs the training subset — Symbol from
+JSON, simple_bind, forward/backward, argument/gradient/output access, and a
+fused SGD update — so a pure C/C++ client can run a whole training loop
+(compiled client test: tests/test_c_train.py).
+
+Every ``_c_*`` function takes/returns only C-friendly types (str, bytes,
+int, float, opaque PyObject handles) — the C shim marshals nothing else.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = []
+
+
+def _c_symbol_from_json(json_str):
+    from .symbol import load_json
+
+    return load_json(json_str)
+
+
+def _c_symbol_to_json(sym):
+    return sym.tojson()
+
+
+def _c_symbol_arguments(sym):
+    return list(sym.list_arguments())
+
+
+class _CExecutor:
+    """Bound training executor + the host-side mirrors the C client reads."""
+
+    def __init__(self, sym, dev_type, dev_id, shapes, grad_req):
+        from . import context
+
+        ctx = context.Context(dev_type, dev_id)
+        self.executor = sym.simple_bind(ctx, grad_req=grad_req, **shapes)
+        # the names the C client binds shapes for ARE its data/label inputs:
+        # updates must never touch them
+        self.input_names = frozenset(shapes)
+        self.outputs = []
+
+    def arg(self, name):
+        arr = self.executor.arg_dict.get(name)
+        if arr is None:
+            raise KeyError("no argument named %r" % (name,))
+        return arr
+
+
+def _c_simple_bind(sym, dev_type, dev_id, shape_keys, shape_data, grad_req):
+    """shape_keys: list of names; shape_data: flat list-of-lists of ints."""
+    shapes = {k: tuple(int(d) for d in s)
+              for k, s in zip(shape_keys, shape_data)}
+    return _CExecutor(sym, dev_type, int(dev_id), shapes, grad_req)
+
+
+def _c_set_arg(cexec, name, data_bytes):
+    arr = cexec.arg(name)
+    flat = np.frombuffer(data_bytes, dtype=np.float32)
+    if flat.size != int(np.prod(arr.shape)):
+        raise ValueError(
+            "size mismatch for %s: got %d floats, need %d"
+            % (name, flat.size, int(np.prod(arr.shape))))
+    arr[:] = flat.reshape(arr.shape).astype(arr.dtype)
+
+
+def _c_get_array(cexec, which, name_or_index):
+    """bytes of (arg|grad|output|aux) as float32."""
+    if which == "arg":
+        arr = cexec.arg(name_or_index)
+    elif which == "grad":
+        arr = cexec.executor.grad_dict.get(name_or_index)
+        if arr is None:
+            raise KeyError("no gradient for %r" % (name_or_index,))
+    elif which == "aux":
+        arr = cexec.executor.aux_dict[name_or_index]
+    else:
+        arr = cexec.outputs[int(name_or_index)]
+    return np.ascontiguousarray(
+        arr.asnumpy().astype(np.float32)).tobytes()
+
+
+def _c_get_shape(cexec, which, name_or_index):
+    if which == "output":
+        return list(cexec.outputs[int(name_or_index)].shape)
+    if which == "grad":
+        return list(cexec.executor.grad_dict[name_or_index].shape)
+    return list(cexec.arg(name_or_index).shape)
+
+
+def _c_num_outputs(cexec):
+    return len(cexec.executor._symbol.list_outputs())
+
+
+def _c_forward(cexec, is_train):
+    cexec.outputs = cexec.executor.forward(is_train=bool(is_train))
+
+
+def _c_backward(cexec):
+    cexec.executor.backward()
+
+
+def _c_sgd_update(cexec, lr, wd):
+    """w -= lr * (grad + wd * w) over every PARAMETER with a gradient — the
+    minimal in-framework update so a C client need not round-trip params.
+    The client's bound inputs (data/labels) also carry gradients under
+    grad_req='write' but must never be updated. (Full optimizers remain the
+    Python/Module surface's job.)"""
+    ex = cexec.executor
+    for name, grad in ex.grad_dict.items():
+        if grad is None or name in cexec.input_names:
+            continue
+        w = ex.arg_dict[name]
+        w[:] = w - lr * (grad + wd * w)
+
+
+def _c_init_xavier(cexec, seed):
+    """Xavier-initialize every weight, zero biases — convenience so the C
+    client does not need an RNG."""
+    from . import initializer as init_mod
+    from . import random as rnd
+
+    rnd.seed(int(seed))
+    init = init_mod.Xavier()
+    for name, arr in cexec.executor.arg_dict.items():
+        if name.endswith(("_weight", "_bias", "_gamma", "_beta")):
+            init(name, arr)

@@ -1,23 +1,20 @@
-"""LeNet (reference: example/image-classification/symbols/lenet.py)."""
+"""LeNet-5 (LeCun et al.), table-driven. Hyperparameters match the reference
+zoo (example/image-classification/symbols/lenet.py) for checkpoint
+interchange; all layers are unnamed there, so only structure matters."""
 from .. import symbol as sym
+
+# (filters, kernel) per conv stage; each is conv -> tanh -> 2x2/2 max-pool
+_CONV_STAGES = ((20, (5, 5)), (50, (5, 5)))
+_FC_HIDDEN = 500
 
 
 def get_symbol(num_classes=10, **kwargs):
-    data = sym.Variable("data")
-    # first conv
-    conv1 = sym.Convolution(data=data, kernel=(5, 5), num_filter=20)
-    tanh1 = sym.Activation(data=conv1, act_type="tanh")
-    pool1 = sym.Pooling(data=tanh1, pool_type="max", kernel=(2, 2), stride=(2, 2))
-    # second conv
-    conv2 = sym.Convolution(data=pool1, kernel=(5, 5), num_filter=50)
-    tanh2 = sym.Activation(data=conv2, act_type="tanh")
-    pool2 = sym.Pooling(data=tanh2, pool_type="max", kernel=(2, 2), stride=(2, 2))
-    # first fullc
-    flatten = sym.Flatten(data=pool2)
-    fc1 = sym.FullyConnected(data=flatten, num_hidden=500)
-    tanh3 = sym.Activation(data=fc1, act_type="tanh")
-    # second fullc
-    fc2 = sym.FullyConnected(data=tanh3, num_hidden=num_classes)
-    # loss
-    lenet = sym.SoftmaxOutput(data=fc2, name="softmax")
-    return lenet
+    x = sym.Variable("data")
+    for filters, kernel in _CONV_STAGES:
+        x = sym.Convolution(x, kernel=kernel, num_filter=filters)
+        x = sym.Activation(x, act_type="tanh")
+        x = sym.Pooling(x, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    x = sym.FullyConnected(sym.Flatten(x), num_hidden=_FC_HIDDEN)
+    x = sym.Activation(x, act_type="tanh")
+    x = sym.FullyConnected(x, num_hidden=num_classes)
+    return sym.SoftmaxOutput(x, name="softmax")

@@ -1,14 +1,16 @@
-"""MLP (reference: example/image-classification/symbols/mlp.py)."""
+"""Two-hidden-layer MLP, table-driven. Layer names (fc1/fc2/fc3, relu1/relu2)
+match the reference zoo (example/image-classification/symbols/mlp.py) for
+checkpoint interchange."""
 from .. import symbol as sym
+
+_HIDDEN = (128, 64)
 
 
 def get_symbol(num_classes=10, **kwargs):
-    data = sym.Variable("data")
-    data = sym.Flatten(data=data)
-    fc1 = sym.FullyConnected(data=data, name="fc1", num_hidden=128)
-    act1 = sym.Activation(data=fc1, name="relu1", act_type="relu")
-    fc2 = sym.FullyConnected(data=act1, name="fc2", num_hidden=64)
-    act2 = sym.Activation(data=fc2, name="relu2", act_type="relu")
-    fc3 = sym.FullyConnected(data=act2, name="fc3", num_hidden=num_classes)
-    mlp = sym.SoftmaxOutput(data=fc3, name="softmax")
-    return mlp
+    x = sym.Flatten(sym.Variable("data"))
+    for i, width in enumerate(_HIDDEN, start=1):
+        x = sym.FullyConnected(x, name="fc%d" % i, num_hidden=width)
+        x = sym.Activation(x, name="relu%d" % i, act_type="relu")
+    x = sym.FullyConnected(x, name="fc%d" % (len(_HIDDEN) + 1),
+                           num_hidden=num_classes)
+    return sym.SoftmaxOutput(x, name="softmax")

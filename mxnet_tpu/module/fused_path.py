@@ -120,6 +120,13 @@ class FusedFitPath:
         if mod._params_dirty:
             # executor-group copies are newer (a classic-path update ran)
             mod._sync_params_from_devices()
+        if self._dist_kv() is not None and mod._update_on_kvstore and \
+                mod.optimizer_initialized:
+            # distributed: _initialize_kvstore pulled the server's weights
+            # into the EXEC GROUP arrays (rank0's init wins) — refresh the
+            # host dicts from there so every worker starts from the same
+            # server state, not its rank-local init
+            mod._exec_group.get_params(mod._arg_params, mod._aux_params)
         st.params = {
             n: jax.device_put(
                 mod._arg_params[n].asnumpy().astype(tr.dtype), tr.param_shardings[n]
@@ -211,10 +218,71 @@ class FusedFitPath:
     def pending(self):
         return self._pending is not None
 
+    def _dist_kv(self):
+        """The parameter-server store when this module trains distributed
+        (hybrid mode: fused local compute, PS at the host boundary)."""
+        kv = self._mod._kvstore
+        if kv is not None and "dist" in getattr(kv, "type", ""):
+            return kv
+        return None
+
+    def _step_dist(self, kv):
+        """Hybrid dist_sync step (SURVEY §7 stage 6; reference seam
+        kvstore_dist.h:88-133): ONE fused program computes forward+backward+
+        local-mesh allreduce; gradients go to the PS with the classic
+        integer-key protocol (BSP: the server merges all workers before
+        answering); then either the pulled server-updated WEIGHTS re-enter
+        the device params (update_on_kvstore — server optimizer, exactly the
+        classic semantics) or the pulled SUMMED gradients feed a fused
+        apply-update program (worker optimizer)."""
+        import jax
+
+        st, tr = self.state, self.trainer
+        grads, new_auxs, outs = tr.grad_step(
+            {n: st.params[n] for n in tr.param_names},
+            {n: st.auxs[n] for n in tr.aux_names},
+            self._pending)
+        st.auxs.update(new_auxs)
+        self._outs = outs
+        # classic key protocol: integer index in exec-group param order
+        names = self._mod._exec_group.param_names
+        update_on_kv = self._mod._update_on_kvstore
+        pulled = {}
+        for idx, name in enumerate(names):
+            if name not in grads:
+                continue
+            kv.push(idx, nd.NDArray(grads[name]), priority=-idx)
+            out_arr = nd.zeros(tuple(grads[name].shape), dtype=np.float32)
+            kv.pull(idx, out=out_arr, priority=-idx)
+            pulled[name] = out_arr
+        if update_on_kv:
+            # server applied its optimizer: pulled values are the new weights
+            for name, arr in pulled.items():
+                st.params[name] = jax.device_put(
+                    arr.asnumpy().astype(tr.dtype), tr.param_shardings[name])
+        else:
+            # pulled values are the globally summed grads: fused local update
+            gdev = {
+                name: jax.device_put(
+                    arr.asnumpy().astype(tr.dtype), tr.param_shardings[name])
+                for name, arr in pulled.items()
+            }
+            new_p, new_s = tr.apply_grads(
+                {n: st.params[n] for n in tr.param_names},
+                {n: st.states[n] for n in tr.param_names}, gdev)
+            st.params.update(new_p)
+            st.states.update(new_s)
+        self._pending = None
+        self.staged_batch = None
+        st.device_dirty = True
+
     def step(self):
         assert self._pending is not None, "no staged batch: call forward first"
         st = self.state
         tr = self.trainer
+        kv = self._dist_kv()
+        if kv is not None:
+            return self._step_dist(kv)
         if (len(st.params) == len(tr.param_names)
                 and len(st.auxs) == len(tr.aux_names)):
             st.params, st.auxs, st.states, self._outs = tr.step(

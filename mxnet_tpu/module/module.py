@@ -419,12 +419,21 @@ class Module(BaseModule):
             return "contexts do not resolve to jax devices"
         if len(set(jax_devs)) != len(jax_devs):
             return "contexts resolve to duplicate devices (no SPMD mesh)"
+        devtype = devtypes.pop()
         if kvstore_arg is not None and "dist" in kvstore_arg:
-            return "distributed kvstore %r (PS push/pull uses the " \
-                   "executor path)" % (kvstore_arg,)
+            # hybrid mode (fused_path._step_dist): fused local compute, PS at
+            # the host boundary. 'device' in the type is the explicit opt-in
+            # (the reference's dist_sync_device: reduce-on-device + PS);
+            # plain dist types fuse on TPU contexts where fused IS the
+            # native execution model.
+            if "device" in kvstore_arg or devtype == "tpu":
+                return None
+            return "distributed kvstore %r on non-TPU contexts (pass " \
+                   "kvstore='dist_sync_device' to opt into the hybrid " \
+                   "fused step)" % (kvstore_arg,)
         if kvstore_arg in ("device", "local_allreduce_device"):
             return None
-        if devtypes.pop() == "tpu" and kvstore_arg in (None, "local"):
+        if devtype == "tpu" and kvstore_arg in (None, "local"):
             return None
         return "kvstore=%r on non-TPU contexts (pass kvstore='device' to " \
                "opt in)" % (kvstore_arg,)

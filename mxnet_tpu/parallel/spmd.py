@@ -155,72 +155,75 @@ class SPMDTrainer:
             for n in self.param_names
         }
 
-    def _build_step(self):
+    def _make_grads(self, params, auxs, inputs, rng):
+        """Traced fwd+bwd core shared by _build_step and _build_grad_step:
+        (grads in param dtype, new_aux dict, outputs). Handles compute-dtype
+        casting, the MXNET_BACKWARD_DO_MIRROR rematerialization knob, and
+        loss-flag cotangent seeding in ONE place so the single-program and
+        hybrid-dist paths can never diverge."""
         import jax
         import jax.numpy as jnp
 
-        if self._step_fn is not None:
-            return self._step_fn
+        from ..base import env_flag
+
         arg_order = self.arg_names
         aux_order = self.aux_names
         data_set = set(self.data_names + self.label_names)
-
-        def assemble(params, inputs):
-            return [params[n] if n not in data_set else inputs[n] for n in arg_order]
-
-        loss_flags = self._loss_flags
-        rule = self.rule
-        base_wd = self.optimizer.wd
-        lr_mult, wd_mult = fused_opt.mults_for(self.optimizer, self.param_names)
         graph_fn = self._graph_fn
-
         compute_dtype = self.compute_dtype
         cast_exempt = self._cast_exempt
 
-        from ..base import env_flag
+        aux_list = [auxs[n] for n in aux_order]
+        if compute_dtype is not None:
+            inputs = {
+                n: v.astype(compute_dtype)
+                if n not in cast_exempt and v.dtype == np.float32 else v
+                for n, v in inputs.items()
+            }
 
-        do_mirror = env_flag("MXNET_BACKWARD_DO_MIRROR")
+        def f(p):
+            if compute_dtype is not None:
+                p = {n: v.astype(compute_dtype) for n, v in p.items()}
+            outs, new_aux = graph_fn(
+                [p[n] if n not in data_set else inputs[n] for n in arg_order],
+                aux_list, rng, True)
+            return outs, [a.astype(np.float32) for a in new_aux]
+
+        if env_flag("MXNET_BACKWARD_DO_MIRROR"):
+            # activation recompute (same knob as the Executor path):
+            # rematerialize instead of storing residuals — trades FLOPs for
+            # HBM, which can WIN on a bandwidth-bound step
+            f = jax.checkpoint(f)
+
+        outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
+        seeds = [
+            jnp.full(o.shape, 1.0 if fl else 0.0, o.dtype)
+            for o, fl in zip(outs, self._loss_flags)
+        ]
+        grads = vjp_fn(list(seeds))[0]
+        grads = {n: g.astype(params[n].dtype) for n, g in grads.items()}
+        return grads, dict(zip(aux_order, new_aux)), outs
+
+    def _build_step(self):
+        import jax
+
+        if self._step_fn is not None:
+            return self._step_fn
+        rule = self.rule
+        base_wd = self.optimizer.wd
+        lr_mult, wd_mult = fused_opt.mults_for(self.optimizer, self.param_names)
 
         def step(params, auxs, states, inputs, rng, lr, t):
-            aux_list = [auxs[n] for n in aux_order]
-
-            if compute_dtype is not None:
-                inputs = {
-                    n: v.astype(compute_dtype)
-                    if n not in cast_exempt and v.dtype == np.float32 else v
-                    for n, v in inputs.items()
-                }
-
-            def f(p):
-                if compute_dtype is not None:
-                    p = {n: v.astype(compute_dtype) for n, v in p.items()}
-                outs, new_aux = graph_fn(assemble(p, inputs), aux_list, rng, True)
-                new_aux = [a.astype(np.float32) for a in new_aux]
-                return outs, new_aux
-
-            if do_mirror:
-                # activation recompute (MXNET_BACKWARD_DO_MIRROR, same knob as
-                # the Executor path): rematerialize instead of storing
-                # residuals — trades FLOPs for HBM, which can WIN on a
-                # bandwidth-bound step
-                f = jax.checkpoint(f)
-
-            outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
-            seeds = [
-                jnp.full(o.shape, 1.0 if fl else 0.0, o.dtype)
-                for o, fl in zip(outs, loss_flags)
-            ]
-            grads = vjp_fn(list(seeds))[0]
+            grads, new_auxs, outs = self._make_grads(params, auxs, inputs, rng)
             new_params = {}
             new_states = {}
             for n in params:
-                g = grads[n].astype(params[n].dtype)
                 # lr_mult/wd_mult are python floats: they constant-fold into
                 # the trace; lr/t stay dynamic so schedulers never retrace
                 new_params[n], new_states[n] = rule.apply(
-                    params[n], g, states[n], lr * lr_mult[n], base_wd * wd_mult[n], t
+                    params[n], grads[n], states[n],
+                    lr * lr_mult[n], base_wd * wd_mult[n], t
                 )
-            new_auxs = dict(zip(aux_order, new_aux))
             return new_params, new_auxs, new_states, outs
 
         # params, auxs (BN stats), and optimizer slots all move every step —
@@ -255,6 +258,75 @@ class SPMDTrainer:
         return self._build_step()(
             params, auxs, states, inputs, rng, np.float32(lr), np.int32(t)
         )
+
+    # ---- hybrid distributed (gradient / apply split) ---------------------
+    # The dist_sync fused mode (SURVEY §7 stage 6): the worker runs
+    # forward+backward+local-mesh allreduce as ONE program producing global
+    # gradients, the parameter-server boundary happens on the host
+    # (push/pull, BSP preserved), and — when the optimizer runs worker-side —
+    # a second fused program applies the pulled gradients.
+    def _build_grad_step(self):
+        import jax
+
+        if getattr(self, "_grad_fn", None) is not None:
+            return self._grad_fn
+
+        def gstep(params, auxs, inputs, rng):
+            return self._make_grads(params, auxs, inputs, rng)
+
+        # auxs move every step; params do NOT (apply comes later) — donate
+        # only the aux argument (and only when donation is enabled at all)
+        self._grad_fn = jax.jit(
+            gstep, donate_argnums=(1,) if self._donate else ())
+        return self._grad_fn
+
+    def grad_step(self, params, auxs, inputs_np, rng=None):
+        """fwd+bwd only: (global grads, new auxs, outputs)."""
+        import jax
+
+        from .. import random as _random
+
+        if rng is None:
+            if self._stochastic:
+                rng = _random.next_key()
+            else:
+                if self._rng_cache is None:
+                    self._rng_cache = _random.next_key()
+                rng = self._rng_cache
+        inputs = {
+            n: v if getattr(v, "sharding", None) == self.batch_sharding
+            else jax.device_put(v, self.batch_sharding)
+            for n, v in inputs_np.items()
+        }
+        return self._build_grad_step()(params, auxs, inputs, rng)
+
+    def _build_apply_step(self):
+        import jax
+
+        if getattr(self, "_apply_fn", None) is not None:
+            return self._apply_fn
+        rule = self.rule
+        base_wd = self.optimizer.wd
+        lr_mult, wd_mult = fused_opt.mults_for(self.optimizer, self.param_names)
+
+        def apply(params, states, grads, lr, t):
+            new_p, new_s = {}, {}
+            for n in params:
+                new_p[n], new_s[n] = rule.apply(
+                    params[n], grads[n], states[n],
+                    lr * lr_mult[n], base_wd * wd_mult[n], t)
+            return new_p, new_s
+
+        self._apply_fn = jax.jit(
+            apply, donate_argnums=(0, 1) if self._donate else ())
+        return self._apply_fn
+
+    def apply_grads(self, params, states, grads):
+        """Optimizer update with externally supplied (e.g. PS-aggregated)
+        gradients. Advances the schedule exactly like step()."""
+        lr, t = fused_opt.host_step_values(self.optimizer, self.param_names)
+        return self._build_apply_step()(
+            params, states, grads, np.float32(lr), np.int32(t))
 
     def eval_step_fn(self):
         """Jitted inference fn(params, auxs, inputs) -> outputs."""

@@ -1,0 +1,333 @@
+// Training-side C API slice (reference: include/mxnet/c_api.h — the Symbol /
+// Executor function families: MXSymbolCreateFromJSON, MXExecutorForward,
+// MXExecutorBackward, ...). The predict subset lives in c_predict_api.cc;
+// this file adds enough surface for a pure C/C++ client to run a full
+// training loop: symbol-from-JSON -> simple_bind -> set args -> forward ->
+// backward -> read grads/outputs -> in-framework SGD update.
+//
+// Same embedding design as the predict shim: CPython is initialized lazily,
+// every entry point holds the GIL, and the heavy lifting happens in
+// mxnet_tpu.capi_train (whose executor is the XLA-compiled one — the compute
+// path is identical to the Python surface's). Compiled client test:
+// tests/test_c_train.py.
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#define MXNET_DLL extern "C" __attribute__((visibility("default")))
+
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef unsigned int mx_uint;
+
+// GIL/env scaffolding shared with the predict shim (defined there when both
+// files link into one library).
+extern thread_local std::string g_last_error_train;
+thread_local std::string g_last_error_train;
+
+namespace {
+
+struct GilT {
+  GilT() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+#if PY_VERSION_HEX < 0x03090000
+      PyEval_InitThreads();
+#endif
+      PyEval_SaveThread();
+    }
+    st = PyGILState_Ensure();
+  }
+  ~GilT() { PyGILState_Release(st); }
+  PyGILState_STATE st;
+};
+
+void set_err() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error_train = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* msg = PyUnicode_AsUTF8(s);
+      if (msg) g_last_error_train = msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+PyObject* train_module() {
+  static PyObject* mod = nullptr;  // borrowed forever once imported
+  if (!mod) {
+    mod = PyImport_ImportModule("mxnet_tpu.capi_train");
+    if (!mod) set_err();
+  }
+  return mod;
+}
+
+struct CSym {
+  PyObject* obj;
+};
+struct CExec {
+  PyObject* obj;
+  // stable storage for string lists returned to C
+  std::vector<std::string> names;
+  std::vector<const char*> name_ptrs;
+  std::vector<mx_uint> shape;
+  std::vector<char> blob;
+};
+
+int fail() { return -1; }
+
+}  // namespace
+
+MXNET_DLL const char* MXTrainGetLastError() {
+  return g_last_error_train.c_str();
+}
+
+MXNET_DLL int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  GilT gil;
+  PyObject* mod = train_module();
+  if (!mod) return fail();
+  PyObject* res = PyObject_CallMethod(mod, "_c_symbol_from_json", "s", json);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = new CSym{res};
+  return 0;
+}
+
+MXNET_DLL int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_symbol_to_json", "O", s->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  thread_local std::string json;
+  json = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out_json = json.c_str();
+  return 0;
+}
+
+MXNET_DLL int MXSymbolFree(SymbolHandle sym) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  Py_XDECREF(s->obj);
+  delete s;
+  return 0;
+}
+
+// simple_bind: shapes as CSR (keys + flat dims + row offsets), the
+// reference's shape-argument convention (c_api.h MXExecutorSimpleBind).
+MXNET_DLL int MXExecutorSimpleBindLite(SymbolHandle sym, const char* dev_type,
+                                       int dev_id, mx_uint num_args,
+                                       const char** keys,
+                                       const mx_uint* arg_shape_data,
+                                       const mx_uint* arg_shape_idx,
+                                       const char* grad_req,
+                                       ExecutorHandle* out) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  PyObject* key_list = PyList_New(num_args);
+  PyObject* shape_list = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SetItem(key_list, i, PyUnicode_FromString(keys[i]));
+    mx_uint lo = arg_shape_idx[i], hi = arg_shape_idx[i + 1];
+    PyObject* dims = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(dims, j - lo, PyLong_FromUnsignedLong(arg_shape_data[j]));
+    PyList_SetItem(shape_list, i, dims);
+  }
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_simple_bind", "OsiOOs", s->obj,
+                          dev_type, dev_id, key_list, shape_list, grad_req);
+  Py_DECREF(key_list);
+  Py_DECREF(shape_list);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = new CExec{res, {}, {}, {}, {}};
+  return 0;
+}
+
+MXNET_DLL int MXExecutorFree(ExecutorHandle h) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  Py_XDECREF(e->obj);
+  delete e;
+  return 0;
+}
+
+MXNET_DLL int MXSymbolListArguments(SymbolHandle sym, mx_uint* out_size,
+                                    const char*** out_array) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_symbol_arguments", "O", s->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  thread_local std::vector<std::string> names;
+  thread_local std::vector<const char*> ptrs;
+  names.clear();
+  ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i)
+    names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(res, i)));
+  Py_DECREF(res);
+  for (auto& n : names) ptrs.push_back(n.c_str());
+  *out_size = static_cast<mx_uint>(names.size());
+  *out_array = ptrs.data();
+  return 0;
+}
+
+MXNET_DLL int MXExecutorSetArg(ExecutorHandle h, const char* name,
+                               const float* data, mx_uint size) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* blob = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), size * sizeof(float));
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_set_arg", "OsO",
+                                      e->obj, name, blob);
+  Py_DECREF(blob);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+
+int get_array(CExec* e, const char* which, PyObject* key, const float** out,
+              mx_uint* out_size) {
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_get_array", "OsO",
+                                      e->obj, which, key);
+  Py_DECREF(key);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    Py_DECREF(res);
+    set_err();
+    return fail();
+  }
+  e->blob.assign(buf, buf + len);
+  Py_DECREF(res);
+  *out = reinterpret_cast<const float*>(e->blob.data());
+  *out_size = static_cast<mx_uint>(len / sizeof(float));
+  return 0;
+}
+
+}  // namespace
+
+MXNET_DLL int MXExecutorGetArg(ExecutorHandle h, const char* name,
+                               const float** out, mx_uint* out_size) {
+  GilT gil;
+  return get_array(static_cast<CExec*>(h), "arg", PyUnicode_FromString(name),
+                   out, out_size);
+}
+
+MXNET_DLL int MXExecutorGetGrad(ExecutorHandle h, const char* name,
+                                const float** out, mx_uint* out_size) {
+  GilT gil;
+  return get_array(static_cast<CExec*>(h), "grad", PyUnicode_FromString(name),
+                   out, out_size);
+}
+
+MXNET_DLL int MXExecutorGetOutput(ExecutorHandle h, mx_uint index,
+                                  const float** out, mx_uint* out_size) {
+  GilT gil;
+  return get_array(static_cast<CExec*>(h), "output", PyLong_FromLong(index),
+                   out, out_size);
+}
+
+MXNET_DLL int MXExecutorOutputShape(ExecutorHandle h, mx_uint index,
+                                    const mx_uint** out_shape,
+                                    mx_uint* out_dim) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_get_shape", "OsI",
+                                      e->obj, "output", index);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  e->shape.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i)
+    e->shape.push_back(
+        static_cast<mx_uint>(PyLong_AsLong(PyList_GetItem(res, i))));
+  Py_DECREF(res);
+  *out_shape = e->shape.data();
+  *out_dim = static_cast<mx_uint>(e->shape.size());
+  return 0;
+}
+
+MXNET_DLL int MXExecutorForward(ExecutorHandle h, int is_train) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_forward", "Oi",
+                                      e->obj, is_train);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXExecutorBackward(ExecutorHandle h, mx_uint, void**) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_backward", "O", e->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXExecutorSGDUpdate(ExecutorHandle h, float lr, float wd) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_sgd_update", "Off",
+                                      e->obj, static_cast<double>(lr),
+                                      static_cast<double>(wd));
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXExecutorInitXavier(ExecutorHandle h, int seed) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_init_xavier", "Oi",
+                                      e->obj, seed);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}

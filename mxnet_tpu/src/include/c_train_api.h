@@ -1,0 +1,62 @@
+/* Training-side C API slice (src/c_api_train.cc) — the Symbol/Executor
+ * function families from the reference's include/mxnet/c_api.h, enough for a
+ * pure C/C++ client to run a complete training loop against the XLA-compiled
+ * executor. Exported by libmxtpu_predict.so (build: make c_predict).
+ *
+ * All float buffers are float32, row-major, caller-owned. Pointers returned
+ * through out-params stay valid until the next call on the same handle
+ * (thread-local for the Symbol string lists). On error every function
+ * returns -1; MXTrainGetLastError() describes the failure.
+ */
+#ifndef MXTPU_C_TRAIN_API_H_
+#define MXTPU_C_TRAIN_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef unsigned int mx_uint;
+
+const char* MXTrainGetLastError(void);
+
+/* ---- Symbol ---- */
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
+int MXSymbolListArguments(SymbolHandle sym, mx_uint* out_size,
+                          const char*** out_array);
+int MXSymbolFree(SymbolHandle sym);
+
+/* ---- Executor ----
+ * Shapes are CSR-packed like the reference's simple_bind: keys[i] names an
+ * input whose dims are arg_shape_data[arg_shape_idx[i] .. arg_shape_idx[i+1]).
+ * grad_req: "write" | "add" | "null". dev_type: "cpu" | "tpu" | "gpu". */
+int MXExecutorSimpleBindLite(SymbolHandle sym, const char* dev_type,
+                             int dev_id, mx_uint num_args, const char** keys,
+                             const mx_uint* arg_shape_data,
+                             const mx_uint* arg_shape_idx,
+                             const char* grad_req, ExecutorHandle* out);
+int MXExecutorInitXavier(ExecutorHandle exec, int seed);
+int MXExecutorSetArg(ExecutorHandle exec, const char* name, const float* data,
+                     mx_uint size);
+int MXExecutorGetArg(ExecutorHandle exec, const char* name, const float** out,
+                     mx_uint* out_size);
+int MXExecutorGetGrad(ExecutorHandle exec, const char* name,
+                      const float** out, mx_uint* out_size);
+int MXExecutorGetOutput(ExecutorHandle exec, mx_uint index, const float** out,
+                        mx_uint* out_size);
+int MXExecutorOutputShape(ExecutorHandle exec, mx_uint index,
+                          const mx_uint** out_shape, mx_uint* out_dim);
+int MXExecutorForward(ExecutorHandle exec, int is_train);
+/* head_grads unsupported in the slice: pass (0, NULL); loss outputs seed 1 */
+int MXExecutorBackward(ExecutorHandle exec, mx_uint num_head_grads,
+                       void** head_grads);
+/* w -= lr * (grad + wd * w) for every argument with a gradient */
+int MXExecutorSGDUpdate(ExecutorHandle exec, float lr, float wd);
+int MXExecutorFree(ExecutorHandle exec);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXTPU_C_TRAIN_API_H_ */

@@ -1,235 +1,250 @@
-"""Network visualization (reference: python/mxnet/visualization.py —
-print_summary, plot_network via graphviz)."""
+"""Network visualization: ``print_summary`` and ``plot_network``.
+
+Same user-facing API as the reference (python/mxnet/visualization.py), built
+differently: both functions render from one shared graph view
+(:func:`_graph_view`) computed off the Symbol's own node objects, and
+parameter counts come from the ACTUAL inferred shapes of each node's
+weight-like arguments — exact for every op (grouped convolutions, no-bias
+layers, custom ops with learnable inputs), where per-op arithmetic formulas
+under- or over-count.
+"""
 from __future__ import annotations
 
-import json
-
-from .symbol import Symbol
+from .symbol import Symbol, _topo_order
 
 __all__ = ["print_summary", "plot_network"]
 
+# variable-name suffixes that mean "learnable/auxiliary tensor, not data"
+# (states and data-like inputs are NOT here: their shapes are batch-sized
+# and must not count as parameters)
+_WEIGHT_SUFFIXES = (
+    "_weight", "_bias", "_gamma", "_beta", "_moving_mean", "_moving_var",
+)
 
-def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
-    """Print a table summary of the network (reference: visualization.py
-    print_summary)."""
+
+def _is_weight_name(name):
+    return name.endswith(_WEIGHT_SUFFIXES)
+
+
+class _NodeInfo:
+    __slots__ = ("name", "op", "attrs", "preds", "out_shape", "param_count",
+                 "is_output")
+
+    def __init__(self, name, op, attrs):
+        self.name = name
+        self.op = op
+        self.attrs = attrs
+        self.preds = []        # visible predecessor names (non-weight)
+        self.out_shape = None  # first-output shape minus batch, or None
+        self.param_count = 0
+        self.is_output = False
+
+
+def _graph_view(symbol, shape=None):
+    """List of _NodeInfo in topological order: compute nodes plus any
+    variables that appear as graph outputs or data inputs.
+
+    With ``shape`` (dict of input name -> shape), output shapes are inferred
+    through ``get_internals`` and parameter counts are the summed sizes of
+    each node's weight-like variable inputs — read from the inferred ARG
+    shapes, so they are exact whatever the op's internal arithmetic is.
+    """
     if not isinstance(symbol, Symbol):
         raise TypeError("symbol must be Symbol")
-    show_shape = False
+    shape_of_output = {}
+    shape_of_arg = {}
     if shape is not None:
-        show_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
+        internals = symbol.get_internals()
+        arg_shapes, out_shapes, _ = internals.infer_shape(**shape)
         if out_shapes is None:
             raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
-    conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
-    heads = {x[0] for x in conf["heads"]}
-    if positions[-1] <= 1:
-        positions = [int(line_length * p) for p in positions]
-    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+        shape_of_output = dict(zip(internals.list_outputs(), out_shapes))
+        shape_of_arg = dict(zip(internals.list_arguments(), arg_shapes or []))
 
-    def print_row(fields, positions):
-        line = ""
-        for i, field in enumerate(fields):
-            line += str(field)
-            line = line[: positions[i]]
-            line += " " * (positions[i] - len(line))
-        print(line)
-
-    print("_" * line_length)
-    print_row(to_display, positions)
-    print("=" * line_length)
-
-    total_params = [0]
-
-    def print_layer_summary(node, out_shape):
-        op = node["op"]
-        pre_node = []
-        pre_filter = 0
-        if op != "null":
-            inputs = node["inputs"]
-            for item in inputs:
-                input_node = nodes[item[0]]
-                input_name = input_node["name"]
-                is_data_input = input_node["op"] == "null" and shape is not None and input_name in shape
-                if input_node["op"] != "null" or item[0] in heads:
-                    pre_node.append(input_name)
-                if show_shape and (input_node["op"] != "null" or item[0] in heads
-                                   or is_data_input):
-                    # data variables named in `shape` count toward the fan-in
-                    # (else a first conv/fc layer reports bias-only params);
-                    # weight/bias variables stay excluded
-                    key = input_name + "_output" if input_node["op"] != "null" else input_name
-                    if key in shape_dict:
-                        in_shape = shape_dict[key][1:]
-                        pre_filter = pre_filter + int(in_shape[0]) if in_shape else pre_filter
-        cur_param = 0
-        attrs = node.get("attrs", {})
-        if op == "Convolution":
-            from .base import parse_shape, parse_bool
-
-            kernel = parse_shape(attrs["kernel"])
-            num_filter = int(attrs["num_filter"])
-            cur_param = pre_filter * num_filter
-            for k in kernel:
-                cur_param *= k
-            if not parse_bool(attrs.get("no_bias", "False")):
-                cur_param += num_filter
-        elif op == "FullyConnected":
-            from .base import parse_bool
-
-            num_hidden = int(attrs["num_hidden"])
-            cur_param = pre_filter * num_hidden
-            if not parse_bool(attrs.get("no_bias", "False")):
-                cur_param += num_hidden
-        elif op == "BatchNorm":
-            key = node["name"] + "_output"
-            if show_shape and key in shape_dict:
-                num_filter = shape_dict[key][1]
-                cur_param = int(num_filter) * 2
-        if not pre_node:
-            first_connection = ""
-        else:
-            first_connection = pre_node[0]
-        fields = [
-            node["name"] + "(" + op + ")",
-            "x".join([str(x) for x in out_shape]),
-            cur_param,
-            first_connection,
-        ]
-        print_row(fields, positions)
-        if len(pre_node) > 1:
-            for i in range(1, len(pre_node)):
-                fields = ["", "", "", pre_node[i]]
-                print_row(fields, positions)
-        return cur_param
-
-    for i, node in enumerate(nodes):
-        out_shape = []
-        op = node["op"]
-        if op == "null" and i > 0:
+    order = _topo_order(symbol._entries)
+    output_ids = {id(n) for n, _ in symbol._entries}
+    infos = []
+    for node in order:
+        # weight-like variables fold into their consumer's param count;
+        # every other variable (data, labels, states) is a visible node
+        if node.is_variable and not (
+                id(node) in output_ids or not _is_weight_name(node.name)):
             continue
-        if op != "null" or i in heads:
-            if show_shape:
-                key = node["name"] + "_output" if op != "null" else node["name"]
-                if key in shape_dict:
-                    out_shape = shape_dict[key][1:]
-        total_params[0] += print_layer_summary(node, out_shape)
-        if i == len(nodes) - 1:
-            print("=" * line_length)
+        info = _NodeInfo(node.name, node.op or "null", dict(node.attrs or {}))
+        info.is_output = id(node) in output_ids
+        if not node.is_variable:
+            for inp, _k in node.inputs:
+                if inp.is_variable:
+                    if _is_weight_name(inp.name):
+                        info.param_count += _size_of(
+                            shape_of_arg.get(inp.name))
+                    else:
+                        info.preds.append(inp.name)
+                else:
+                    info.preds.append(inp.name)
+            key = node.name + "_output"
         else:
-            print("_" * line_length)
-    print("Total params: %s" % total_params[0])
-    print("_" * line_length)
+            key = node.name
+        s = shape_of_output.get(key)
+        info.out_shape = tuple(s[1:]) if s else None
+        infos.append(info)
+    return infos
+
+
+def _size_of(shape):
+    if not shape:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+# ------------------------------------------------------------------ summary
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a layer table: name(type), output shape, #params, connections.
+
+    ``positions`` are column right-edges, as fractions of ``line_length``
+    (or absolute columns if > 1) — the reference's signature.
+    """
+    cols = [int(line_length * p) if p <= 1 else int(p) for p in positions]
+    infos = _graph_view(symbol, shape)
+
+    def emit(fields):
+        line = []
+        start = 0
+        for text, edge in zip(fields, cols):
+            cell = str(text)[: edge - start]
+            line.append(cell + " " * (edge - start - len(cell)))
+            start = edge
+        print("".join(line))
+
+    rule, double = "_" * line_length, "=" * line_length
+    print(rule)
+    emit(["Layer (type)", "Output Shape", "Param #", "Previous Layer"])
+    print(double)
+    total = 0
+    for i, info in enumerate(infos):
+        out = "x".join(str(d) for d in info.out_shape) if info.out_shape else ""
+        first = info.preds[0] if info.preds else ""
+        emit(["%s(%s)" % (info.name, info.op), out, info.param_count, first])
+        for extra in info.preds[1:]:
+            emit(["", "", "", extra])
+        total += info.param_count
+        print(double if i == len(infos) - 1 else rule)
+    print("Total params: %s" % total)
+    print(rule)
+
+
+# ------------------------------------------------------------------ plotting
+# op -> (palette color index, label function). Anything unlisted gets the
+# default color with its op name as the label.
+def _label_conv(a):
+    k = a.get("kernel", "")
+    s = a.get("stride", "") or "(1,1)"
+    return "Convolution\n%s/%s, %s" % (_fmt_shape(k), _fmt_shape(s),
+                                       a.get("num_filter", ""))
+
+
+def _label_pool(a):
+    return "Pooling\n%s, %s/%s" % (
+        a.get("pool_type", "max"), _fmt_shape(a.get("kernel", "")),
+        _fmt_shape(a.get("stride", "") or "(1,1)"))
+
+
+def _fmt_shape(text):
+    from .base import parse_shape
+
+    try:
+        dims = parse_shape(str(text))
+    except Exception:  # noqa: BLE001 — attr not shape-like: show verbatim
+        return str(text)
+    return "x".join(str(d) for d in dims or ())
+
+
+_PALETTE = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
+            "#fdb462", "#b3de69", "#fccde5")
+
+_STYLE = {
+    "null": (0, None),
+    "Convolution": (1, _label_conv),
+    "Deconvolution": (1, _label_conv),
+    "FullyConnected": (1, lambda a: "FullyConnected\n%s" % a.get("num_hidden", "")),
+    "Activation": (2, lambda a: "Activation\n%s" % a.get("act_type", "")),
+    "LeakyReLU": (2, lambda a: "LeakyReLU\n%s" % a.get("act_type", "")),
+    "BatchNorm": (3, None),
+    "Pooling": (4, _label_pool),
+    "Concat": (5, None),
+    "Flatten": (5, None),
+    "Reshape": (5, None),
+    "Softmax": (6, None),
+    "SoftmaxOutput": (6, None),
+    "SoftmaxActivation": (6, None),
+}
+_DEFAULT_STYLE = (7, None)
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                  node_attrs=None, hide_weights=True):
-    """Build a graphviz digraph of the network (reference: visualization.py
-    plot_network). Requires the `graphviz` python package."""
+    """Graphviz digraph of the network (edges drawn data-flow 'back' style,
+    shape labels on edges when ``shape`` is given). Requires graphviz."""
     try:
         from graphviz import Digraph
     except ImportError:
         raise ImportError("Draw network requires graphviz library")
-    if not isinstance(symbol, Symbol):
-        raise TypeError("symbol must be a Symbol")
-    draw_shape = False
-    shape_dict = {}
-    if shape is not None:
-        draw_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
-        if out_shapes is None:
-            raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
-    conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
-    node_attr = {
-        "shape": "box", "fixedsize": "true", "width": "1.3",
-        "height": "0.8034", "style": "filled",
-    }
+    # weight variables are folded away by the default view; the
+    # hide_weights=False variant re-includes them (one shape inference
+    # either way)
+    infos = (_graph_view_all_vars(symbol, shape) if not hide_weights
+             else _graph_view(symbol, shape))
+    known = {i.name for i in infos}
+
+    base_attrs = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                  "height": "0.8034", "style": "filled"}
     if node_attrs:
-        node_attr.update(node_attrs)
+        base_attrs.update(node_attrs)
     dot = Digraph(name=title)
-    cm = (
-        "#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
-        "#fdb462", "#b3de69", "#fccde5",
-    )
 
-    def looks_like_weight(name):
-        if name.endswith("_weight") or name.endswith("_bias") or \
-           name.endswith("_beta") or name.endswith("_gamma") or \
-           name.endswith("_moving_var") or name.endswith("_moving_mean"):
-            return True
-        return False
-
-    hidden_nodes = set()
-    for node in nodes:
-        op = node["op"]
-        name = node["name"]
-        attrs = {"shape": "box", "fixedsize": "false", "style": "filled"}
-        label = name
-        if op == "null":
-            if looks_like_weight(name):
-                if hide_weights:
-                    hidden_nodes.add(name)
-                continue
+    shapes_by_name = {i.name: i.out_shape for i in infos}
+    for info in infos:
+        color_i, labeler = _STYLE.get(info.op, _DEFAULT_STYLE)
+        attrs = {"shape": "box", "fixedsize": "false", "style": "filled",
+                 "fillcolor": _PALETTE[color_i]}
+        if info.op == "null":
             attrs["shape"] = "oval"
-            attrs["fillcolor"] = cm[0]
-            label = name
-        elif op == "Convolution":
-            from .base import parse_shape
-
-            nattrs = node.get("attrs", {})
-            label = "Convolution\n%s/%s, %s" % (
-                "x".join(map(str, parse_shape(nattrs["kernel"]))),
-                "x".join(map(str, parse_shape(nattrs.get("stride", "(1,1)")) or (1, 1))),
-                nattrs["num_filter"],
-            )
-            attrs["fillcolor"] = cm[1]
-        elif op == "FullyConnected":
-            label = "FullyConnected\n%s" % node.get("attrs", {}).get("num_hidden", "")
-            attrs["fillcolor"] = cm[1]
-        elif op == "BatchNorm":
-            attrs["fillcolor"] = cm[3]
-        elif op == "Activation" or op == "LeakyReLU":
-            label = "%s\n%s" % (op, node.get("attrs", {}).get("act_type", ""))
-            attrs["fillcolor"] = cm[2]
-        elif op == "Pooling":
-            from .base import parse_shape
-
-            nattrs = node.get("attrs", {})
-            label = "Pooling\n%s, %s/%s" % (
-                nattrs.get("pool_type", "max"),
-                "x".join(map(str, parse_shape(nattrs.get("kernel", "()")) or ())),
-                "x".join(map(str, parse_shape(nattrs.get("stride", "(1,1)")) or (1, 1))),
-            )
-            attrs["fillcolor"] = cm[4]
-        elif op in ("Concat", "Flatten", "Reshape"):
-            attrs["fillcolor"] = cm[5]
-        elif op == "Softmax" or op == "SoftmaxOutput":
-            attrs["fillcolor"] = cm[6]
+            label = info.name
         else:
-            attrs["fillcolor"] = cm[7]
-        dot.node(name=name, label=label, **attrs)
-    for node in nodes:
-        op = node["op"]
-        name = node["name"]
-        if op == "null":
+            label = labeler(info.attrs) if labeler else info.op
+        dot.node(name=info.name, label=label, **attrs)
+    for info in infos:
+        if info.op == "null":
             continue
-        inputs = node["inputs"]
-        for item in inputs:
-            input_node = nodes[item[0]]
-            input_name = input_node["name"]
-            if input_name not in hidden_nodes:
-                attrs = {"dir": "back", "arrowtail": "open"}
-                if draw_shape:
-                    key = (
-                        input_name + "_output" if input_node["op"] != "null" else input_name
-                    )
-                    if key in shape_dict:
-                        shape = shape_dict[key][1:]
-                        attrs["label"] = "x".join([str(x) for x in shape])
-                dot.edge(tail_name=name, head_name=input_name, **attrs)
+        for pred in info.preds:
+            if pred not in known:
+                continue
+            edge_attrs = {"dir": "back", "arrowtail": "open"}
+            ps = shapes_by_name.get(pred)
+            if shape is not None and ps:
+                edge_attrs["label"] = "x".join(str(d) for d in ps)
+            dot.edge(tail_name=info.name, head_name=pred, **edge_attrs)
     return dot
+
+
+def _graph_view_all_vars(symbol, shape):
+    """Variant of _graph_view that keeps weight variables visible (used by
+    plot_network(hide_weights=False)) and routes them into preds."""
+    infos = _graph_view(symbol, shape)
+    by_name = {i.name: i for i in infos}
+    order = _topo_order(symbol._entries)
+    out = []
+    for node in order:
+        if node.is_variable and node.name not in by_name:
+            vi = _NodeInfo(node.name, "null", dict(node.attrs or {}))
+            out.append(vi)
+        elif node.name in by_name:
+            info = by_name[node.name]
+            if not node.is_variable:
+                info.preds = [inp.name for inp, _ in node.inputs]
+            out.append(info)
+    return out

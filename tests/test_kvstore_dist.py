@@ -237,3 +237,98 @@ def test_local_kvstore_liveness_api():
     kv = mx.kv.create("local")
     assert kv.get_num_dead_node() == 0
     assert kv.is_recovery in (True, False)
+
+
+WORKER_FIT_FUSED = r"""
+import os
+import numpy as np
+import mxnet_tpu as mx
+
+rng = np.random.RandomState(42)  # same data on both workers
+X = rng.randn(128, 10).astype(np.float32)
+w_true = rng.randn(10, 1).astype(np.float32)
+y = (X @ w_true > 0).astype(np.float32).reshape(-1)
+
+kv = mx.kv.create("dist_sync_device")
+rank, nw = kv.rank, kv.num_workers
+Xs, ys = X[rank::nw], y[rank::nw]
+it = mx.io.NDArrayIter(Xs, ys, batch_size=16, shuffle=False)
+
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+ctx = [mx.cpu(0), mx.cpu(1)]  # 2 virtual CPU devices (XLA_FLAGS in the env)
+mod = mx.mod.Module(net, context=ctx)
+mod.fit(it, num_epoch=8, kvstore=kv, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2.0),
+        eval_metric="acc", force_init=True)
+if os.environ.get("EXPECT_FUSED"):
+    assert mod._fused is not None, "hybrid dist step must engage"
+    assert mod._fused.trainer._grad_fn is not None, \
+        "the fused grad program must have run"
+else:
+    assert mod._fused is None
+score = mod.score(it, mx.metric.Accuracy())[0][1]
+arg, _ = mod.get_params()
+sig = float(sum(float(np.abs(v.asnumpy()).sum()) for v in arg.values()))
+os.write(1, ("FIT_SCORE %d %s %s\n" % (rank, score, round(sig, 4))).encode())
+kv.barrier()
+if rank == 0:
+    kv._stop_servers()
+print("WORKER_OK", rank)
+"""
+
+
+def _run_fit_cluster(script, extra_env=None, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("DMLC_ROLE", None)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "-s", "1", "--port", str(_free_port()),
+           sys.executable, "-c", script]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        out, err = proc.communicate()
+        raise AssertionError("cluster hung: %s %s" % (out, err))
+    assert proc.returncode == 0, (out, err)
+    scores, sigs = {}, {}
+    for l in out.splitlines():
+        if l.startswith("FIT_SCORE"):
+            _, rank, score, sig = l.split()
+            scores[rank] = float(score)
+            sigs[rank] = float(sig)
+    assert len(scores) == 2, (out, err)
+    return scores, sigs
+
+
+@needs_native
+def test_dist_sync_device_fused_module_fit():
+    """Hybrid distributed fused step (round-3): kvstore='dist_sync_device'
+    runs forward+backward+local-allreduce as ONE fused program per worker
+    with PS push/pull at the host boundary — every worker must engage the
+    fused path, keep BSP (identical params across workers), and match the
+    classic dist path's numbers."""
+    scores_f, sigs_f = _run_fit_cluster(
+        WORKER_FIT_FUSED, extra_env={"EXPECT_FUSED": "1"})
+    # BSP: identical global updates on both workers
+    assert abs(sigs_f["0"] - sigs_f["1"]) < 1e-3, sigs_f
+    assert min(scores_f.values()) > 0.75, scores_f
+
+    # numerics match the classic dist path (same seeds, same data order)
+    scores_c, sigs_c = _run_fit_cluster(
+        WORKER_FIT_FUSED, extra_env={"MXNET_MODULE_NO_FUSED": "1"})
+    assert abs(sigs_f["0"] - sigs_c["0"]) < 5e-3, (sigs_f, sigs_c)
+    assert min(scores_c.values()) > 0.75, scores_c
