@@ -31,6 +31,46 @@ def _c_symbol_arguments(sym):
     return list(sym.list_arguments())
 
 
+def _c_symbol_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def _c_symbol_aux_states(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def _c_variable(name):
+    from . import symbol
+
+    return symbol.Variable(name)
+
+
+def _c_create_symbol(op_name, name, param_keys, param_vals,
+                     input_keys, input_syms):
+    """Atomic-symbol creation + composition in one call (the reference splits
+    this into MXSymbolCreateAtomicSymbol + MXSymbolCompose; the cpp-package's
+    Operator::CreateSymbol always performs both back-to-back, so the C slice
+    exposes the fused form). All params arrive as strings — the op's
+    Parameter schema parses them, exactly as the JSON loader does."""
+    from . import symbol
+    from .base import MXNetError
+    from .ops.registry import list_ops
+
+    if op_name not in list_ops():
+        raise MXNetError("no operator named %r" % (op_name,))
+    fn = getattr(symbol, op_name)
+    kwargs = dict(zip(param_keys, param_vals))
+    if name:
+        kwargs["name"] = name
+    args = []
+    for k, s in zip(input_keys, input_syms):
+        if k:
+            kwargs[k] = s
+        else:
+            args.append(s)
+    return fn(*args, **kwargs)
+
+
 class _CExecutor:
     """Bound training executor + the host-side mirrors the C client reads."""
 
@@ -104,6 +144,62 @@ def _c_backward(cexec):
     cexec.executor.backward()
 
 
+def _c_momentum_update(cexec, lr, wd, momentum):
+    """SGD with momentum over every parameter with a gradient (velocity
+    state lives on the executor, device-resident): v = mom*v - lr*(grad +
+    wd*w); w += v — the reference's sgd_mom_update rule
+    (src/operator/optimizer_op-inl.h SGDMomUpdate)."""
+    ex = cexec.executor
+    if not hasattr(cexec, "mom"):
+        cexec.mom = {}
+    for name, grad in ex.grad_dict.items():
+        if grad is None or name in cexec.input_names:
+            continue
+        w = ex.arg_dict[name]
+        v = cexec.mom.get(name)
+        if v is None:
+            from . import ndarray as nd
+
+            v = nd.zeros(w.shape, ctx=w.context, dtype=w.dtype)
+            cexec.mom[name] = v
+        v[:] = momentum * v - lr * (grad + wd * w)
+        w[:] = w + v
+
+
+def _c_save_params(cexec, path):
+    """Write the executor's parameters (+aux) in the reference checkpoint
+    format — `arg:`/`aux:` prefixed NDArray dict (model.py save_checkpoint),
+    so C-trained weights load directly into Python Module/FeedForward and
+    the reference itself."""
+    from . import ndarray as nd
+
+    ex = cexec.executor
+    save_dict = {
+        "arg:%s" % k: v for k, v in ex.arg_dict.items()
+        if k not in cexec.input_names
+    }
+    save_dict.update({"aux:%s" % k: v for k, v in ex.aux_dict.items()})
+    nd.save(path, save_dict)
+
+
+def _c_load_params(cexec, path):
+    from . import ndarray as nd
+
+    ex = cexec.executor
+    loaded = nd.load(path)
+    n = 0
+    for k, v in loaded.items():
+        tag, _, name = k.partition(":")
+        if tag == "arg" and name in ex.arg_dict \
+                and name not in cexec.input_names:
+            ex.arg_dict[name][:] = v
+            n += 1
+        elif tag == "aux" and name in ex.aux_dict:
+            ex.aux_dict[name][:] = v
+            n += 1
+    return n
+
+
 def _c_sgd_update(cexec, lr, wd):
     """w -= lr * (grad + wd * w) over every PARAMETER with a gradient — the
     minimal in-framework update so a C client need not round-trip params.
@@ -116,6 +212,69 @@ def _c_sgd_update(cexec, lr, wd):
             continue
         w = ex.arg_dict[name]
         w[:] = w - lr * (grad + wd * w)
+
+
+# ---- KVStore (reference: c_api.h MXKVStoreCreate/Init/Push/Pull family) ----
+
+class _CKVStore:
+    """KVStore handle + the host mirrors the C client reads. Values cross
+    the boundary as float32 blobs; device placement/aggregation is the
+    Python KVStore's job (same compute path as the Python surface)."""
+
+    def __init__(self, kv_type):
+        from .kvstore import create
+
+        self.kv = create(kv_type)
+        self.shapes = {}
+
+
+def _c_kv_create(kv_type):
+    return _CKVStore(kv_type)
+
+
+def _c_kv_type(ckv):
+    return ckv.kv.type
+
+
+def _c_kv_rank(ckv):
+    return int(ckv.kv.rank)
+
+
+def _c_kv_num_workers(ckv):
+    return int(ckv.kv.num_workers)
+
+
+def _kv_array(ckv, key, data_bytes, shape):
+    from . import ndarray as nd
+
+    flat = np.frombuffer(data_bytes, dtype=np.float32)
+    shape = tuple(int(d) for d in shape)
+    if flat.size != int(np.prod(shape)):
+        raise ValueError("key %s: got %d floats for shape %s"
+                         % (key, flat.size, shape))
+    ckv.shapes[int(key)] = shape
+    return nd.array(flat.reshape(shape))
+
+
+def _c_kv_init(ckv, key, data_bytes, shape):
+    ckv.kv.init(int(key), _kv_array(ckv, key, data_bytes, shape))
+
+
+def _c_kv_push(ckv, key, data_bytes, shape):
+    ckv.kv.push(int(key), _kv_array(ckv, key, data_bytes, shape))
+
+
+def _c_kv_pull(ckv, key):
+    from . import ndarray as nd
+
+    shape = ckv.shapes.get(int(key))
+    if shape is None:
+        raise KeyError("key %s was never initialized through this handle"
+                       % (key,))
+    out = nd.zeros(shape)
+    ckv.kv.pull(int(key), out=out)
+    return np.ascontiguousarray(
+        out.asnumpy().astype(np.float32)).tobytes()
 
 
 def _c_init_xavier(cexec, seed):

@@ -16,11 +16,11 @@
 #include <string>
 #include <vector>
 
-#define MXNET_DLL extern "C" __attribute__((visibility("default")))
+// the public header declares every exported signature — including it makes
+// the compiler verify each MXNET_DLL definition against its declaration
+#include "include/c_train_api.h"
 
-typedef void* SymbolHandle;
-typedef void* ExecutorHandle;
-typedef unsigned int mx_uint;
+#define MXNET_DLL extern "C" __attribute__((visibility("default")))
 
 // GIL/env scaffolding shared with the predict shim (defined there when both
 // files link into one library).
@@ -84,6 +84,46 @@ struct CExec {
 };
 
 int fail() { return -1; }
+
+// marshal a python list-of-str result into thread-local C string tables
+int list_strings(PyObject* res, mx_uint* out_size, const char*** out_array) {
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  thread_local std::vector<std::string> names;
+  thread_local std::vector<const char*> ptrs;
+  names.clear();
+  ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i)
+    names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(res, i)));
+  Py_DECREF(res);
+  for (auto& n : names) ptrs.push_back(n.c_str());
+  *out_size = static_cast<mx_uint>(names.size());
+  *out_array = ptrs.data();
+  return 0;
+}
+
+// unpack a python bytes result into `blob` and expose it as a float32 view
+int bytes_to_floats(PyObject* res, std::vector<char>* blob, const float** out,
+                    mx_uint* out_size) {
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    Py_DECREF(res);
+    set_err();
+    return fail();
+  }
+  blob->assign(buf, buf + len);
+  Py_DECREF(res);
+  *out = reinterpret_cast<const float*>(blob->data());
+  *out_size = static_cast<mx_uint>(len / sizeof(float));
+  return 0;
+}
 
 }  // namespace
 
@@ -174,23 +214,9 @@ MXNET_DLL int MXSymbolListArguments(SymbolHandle sym, mx_uint* out_size,
                                     const char*** out_array) {
   GilT gil;
   auto* s = static_cast<CSym*>(sym);
-  PyObject* res =
-      PyObject_CallMethod(train_module(), "_c_symbol_arguments", "O", s->obj);
-  if (!res) {
-    set_err();
-    return fail();
-  }
-  thread_local std::vector<std::string> names;
-  thread_local std::vector<const char*> ptrs;
-  names.clear();
-  ptrs.clear();
-  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i)
-    names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(res, i)));
-  Py_DECREF(res);
-  for (auto& n : names) ptrs.push_back(n.c_str());
-  *out_size = static_cast<mx_uint>(names.size());
-  *out_array = ptrs.data();
-  return 0;
+  return list_strings(
+      PyObject_CallMethod(train_module(), "_c_symbol_arguments", "O", s->obj),
+      out_size, out_array);
 }
 
 MXNET_DLL int MXExecutorSetArg(ExecutorHandle h, const char* name,
@@ -217,22 +243,7 @@ int get_array(CExec* e, const char* which, PyObject* key, const float** out,
   PyObject* res = PyObject_CallMethod(train_module(), "_c_get_array", "OsO",
                                       e->obj, which, key);
   Py_DECREF(key);
-  if (!res) {
-    set_err();
-    return fail();
-  }
-  char* buf = nullptr;
-  Py_ssize_t len = 0;
-  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
-    Py_DECREF(res);
-    set_err();
-    return fail();
-  }
-  e->blob.assign(buf, buf + len);
-  Py_DECREF(res);
-  *out = reinterpret_cast<const float*>(e->blob.data());
-  *out_size = static_cast<mx_uint>(len / sizeof(float));
-  return 0;
+  return bytes_to_floats(res, &e->blob, out, out_size);
 }
 
 }  // namespace
@@ -317,6 +328,251 @@ MXNET_DLL int MXExecutorSGDUpdate(ExecutorHandle h, float lr, float wd) {
   }
   Py_DECREF(res);
   return 0;
+}
+
+// ---- symbol construction (cpp-package surface) ---------------------------
+// The reference separates MXSymbolCreateAtomicSymbol + MXSymbolCompose;
+// cpp-package's Operator::CreateSymbol always runs both back-to-back, so
+// this slice exposes the fused form. Params are strings (the op's Parameter
+// schema parses them — same as the reference's C convention).
+
+MXNET_DLL int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  GilT gil;
+  PyObject* mod = train_module();
+  if (!mod) return fail();
+  PyObject* res = PyObject_CallMethod(mod, "_c_variable", "s", name);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = new CSym{res};
+  return 0;
+}
+
+MXNET_DLL int MXSymbolCreateFromOperator(
+    const char* op_name, const char* name, mx_uint num_param,
+    const char** param_keys, const char** param_vals, mx_uint num_inputs,
+    const char** input_keys /* "" = positional */, SymbolHandle* inputs,
+    SymbolHandle* out) {
+  GilT gil;
+  PyObject* mod = train_module();
+  if (!mod) return fail();
+  PyObject* pkeys = PyList_New(num_param);
+  PyObject* pvals = PyList_New(num_param);
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SetItem(pvals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* ikeys = PyList_New(num_inputs);
+  PyObject* isyms = PyList_New(num_inputs);
+  for (mx_uint i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(ikeys, i, PyUnicode_FromString(
+        input_keys ? input_keys[i] : ""));
+    PyObject* o = static_cast<CSym*>(inputs[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(isyms, i, o);
+  }
+  PyObject* res = PyObject_CallMethod(mod, "_c_create_symbol", "ssOOOO",
+                                      op_name, name ? name : "", pkeys, pvals,
+                                      ikeys, isyms);
+  Py_DECREF(pkeys);
+  Py_DECREF(pvals);
+  Py_DECREF(ikeys);
+  Py_DECREF(isyms);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = new CSym{res};
+  return 0;
+}
+
+MXNET_DLL int MXSymbolListOutputs(SymbolHandle sym, mx_uint* out_size,
+                                  const char*** out_array) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  return list_strings(
+      PyObject_CallMethod(train_module(), "_c_symbol_outputs", "O", s->obj),
+      out_size, out_array);
+}
+
+MXNET_DLL int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint* out_size,
+                                          const char*** out_array) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  return list_strings(
+      PyObject_CallMethod(train_module(), "_c_symbol_aux_states", "O",
+                          s->obj),
+      out_size, out_array);
+}
+
+MXNET_DLL int MXExecutorNumOutputs(ExecutorHandle h, mx_uint* out) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_num_outputs", "O", e->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = static_cast<mx_uint>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXExecutorGetAux(ExecutorHandle h, const char* name,
+                               const float** out, mx_uint* out_size) {
+  GilT gil;
+  return get_array(static_cast<CExec*>(h), "aux", PyUnicode_FromString(name),
+                   out, out_size);
+}
+
+MXNET_DLL int MXExecutorMomentumUpdate(ExecutorHandle h, float lr, float wd,
+                                       float momentum) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* res = PyObject_CallMethod(
+      train_module(), "_c_momentum_update", "Offf", e->obj,
+      static_cast<double>(lr), static_cast<double>(wd),
+      static_cast<double>(momentum));
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXExecutorSaveParams(ExecutorHandle h, const char* path) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_save_params", "Os",
+                                      e->obj, path);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXExecutorLoadParams(ExecutorHandle h, const char* path,
+                                   mx_uint* out_num_loaded) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_load_params", "Os",
+                                      e->obj, path);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  if (out_num_loaded)
+    *out_num_loaded = static_cast<mx_uint>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---- KVStore (reference: c_api.h MXKVStoreCreate/Init/Push/Pull family) --
+
+struct CKV {
+  PyObject* obj;
+  std::vector<char> blob;
+};
+
+MXNET_DLL int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  GilT gil;
+  PyObject* mod = train_module();
+  if (!mod) return fail();
+  PyObject* res = PyObject_CallMethod(mod, "_c_kv_create", "s", type);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = new CKV{res, {}};
+  return 0;
+}
+
+MXNET_DLL int MXKVStoreFree(KVStoreHandle h) {
+  GilT gil;
+  auto* kv = static_cast<CKV*>(h);
+  Py_XDECREF(kv->obj);
+  delete kv;
+  return 0;
+}
+
+MXNET_DLL int MXKVStoreGetRank(KVStoreHandle h, int* out) {
+  GilT gil;
+  auto* kv = static_cast<CKV*>(h);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_kv_rank", "O", kv->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXKVStoreGetGroupSize(KVStoreHandle h, int* out) {
+  GilT gil;
+  auto* kv = static_cast<CKV*>(h);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_kv_num_workers", "O", kv->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+
+int kv_send(CKV* kv, const char* method, int key, const float* data,
+            const mx_uint* shape, mx_uint ndim) {
+  size_t n = 1;
+  PyObject* dims = PyList_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i) {
+    n *= shape[i];
+    PyList_SetItem(dims, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject* blob = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), n * sizeof(float));
+  PyObject* res = PyObject_CallMethod(train_module(), method, "OiOO", kv->obj,
+                                      key, blob, dims);
+  Py_DECREF(blob);
+  Py_DECREF(dims);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+MXNET_DLL int MXKVStoreInit(KVStoreHandle h, int key, const float* data,
+                            const mx_uint* shape, mx_uint ndim) {
+  GilT gil;
+  return kv_send(static_cast<CKV*>(h), "_c_kv_init", key, data, shape, ndim);
+}
+
+MXNET_DLL int MXKVStorePush(KVStoreHandle h, int key, const float* data,
+                            const mx_uint* shape, mx_uint ndim) {
+  GilT gil;
+  return kv_send(static_cast<CKV*>(h), "_c_kv_push", key, data, shape, ndim);
+}
+
+MXNET_DLL int MXKVStorePull(KVStoreHandle h, int key, const float** out,
+                            mx_uint* out_size) {
+  GilT gil;
+  auto* kv = static_cast<CKV*>(h);
+  return bytes_to_floats(
+      PyObject_CallMethod(train_module(), "_c_kv_pull", "Oi", kv->obj, key),
+      &kv->blob, out, out_size);
 }
 
 MXNET_DLL int MXExecutorInitXavier(ExecutorHandle h, int seed) {

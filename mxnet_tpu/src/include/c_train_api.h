@@ -26,7 +26,25 @@ int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
 int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
 int MXSymbolListArguments(SymbolHandle sym, mx_uint* out_size,
                           const char*** out_array);
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint* out_size,
+                        const char*** out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint* out_size,
+                                const char*** out_array);
 int MXSymbolFree(SymbolHandle sym);
+
+/* Symbol construction from C (the cpp-package surface). The reference
+ * splits atomic-symbol creation and composition (MXSymbolCreateAtomicSymbol
+ * + MXSymbolCompose); cpp-package's Operator::CreateSymbol always runs both
+ * back-to-back, so this slice exposes the fused form. Every operator
+ * parameter is passed as a string and parsed by the op's schema. input_keys
+ * entries may be "" (positional input) or the operator's input name; name
+ * may be NULL/"" for an auto-generated node name. */
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+int MXSymbolCreateFromOperator(const char* op_name, const char* name,
+                               mx_uint num_param, const char** param_keys,
+                               const char** param_vals, mx_uint num_inputs,
+                               const char** input_keys, SymbolHandle* inputs,
+                               SymbolHandle* out);
 
 /* ---- Executor ----
  * Shapes are CSR-packed like the reference's simple_bind: keys[i] names an
@@ -54,7 +72,34 @@ int MXExecutorBackward(ExecutorHandle exec, mx_uint num_head_grads,
                        void** head_grads);
 /* w -= lr * (grad + wd * w) for every argument with a gradient */
 int MXExecutorSGDUpdate(ExecutorHandle exec, float lr, float wd);
+/* v = momentum*v - lr*(grad + wd*w); w += v (device-resident velocity) */
+int MXExecutorMomentumUpdate(ExecutorHandle exec, float lr, float wd,
+                             float momentum);
+int MXExecutorNumOutputs(ExecutorHandle exec, mx_uint* out);
+int MXExecutorGetAux(ExecutorHandle exec, const char* name, const float** out,
+                     mx_uint* out_size);
+/* Reference checkpoint format (`arg:`/`aux:`-prefixed NDArray dict) — files
+ * interchange with Python Module/FeedForward and the reference itself. */
+int MXExecutorSaveParams(ExecutorHandle exec, const char* path);
+int MXExecutorLoadParams(ExecutorHandle exec, const char* path,
+                         mx_uint* out_num_loaded);
 int MXExecutorFree(ExecutorHandle exec);
+
+/* ---- KVStore (reference: c_api.h MXKVStoreCreate/Init/Push/Pull) ----
+ * Values cross the boundary as float32 buffers; aggregation runs on the
+ * framework's KVStore (same compute path as the Python surface). Pull
+ * pointers stay valid until the next pull on the same handle. */
+typedef void* KVStoreHandle;
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreFree(KVStoreHandle kv);
+int MXKVStoreGetRank(KVStoreHandle kv, int* out);
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int* out);
+int MXKVStoreInit(KVStoreHandle kv, int key, const float* data,
+                  const mx_uint* shape, mx_uint ndim);
+int MXKVStorePush(KVStoreHandle kv, int key, const float* data,
+                  const mx_uint* shape, mx_uint ndim);
+int MXKVStorePull(KVStoreHandle kv, int key, const float** out,
+                  mx_uint* out_size);
 
 #ifdef __cplusplus
 }
