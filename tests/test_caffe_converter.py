@@ -1,0 +1,275 @@
+"""caffe_converter tests (tools/caffe_converter.py — the analog of the
+reference's tools/caffe_converter/: prototxt text-format parsing, caffemodel
+wire-format decoding, layer mapping, BN/Scale folding).
+
+The caffemodel decoder is tested against a local protobuf wire-format
+ENCODER written here from the spec — the two implementations share nothing,
+so agreement means both match the format.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+from tools.caffe_converter import (convert_model, convert_symbol,
+                                   parse_prototxt, read_caffemodel)
+
+
+# ---- minimal wire-format encoder (test-local, independent of the tool) ----
+
+def _varint(n):
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _ld(field, payload):
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _string(field, s):
+    return _ld(field, s.encode())
+
+
+def _packed_floats(field, values):
+    return _ld(field, struct.pack("<%df" % len(values), *values))
+
+
+def _blob(arr):
+    """BlobProto: shape (field 7, BlobShape dims field 1) + packed data (5)."""
+    shape_payload = b"".join(_tag(1, 0) + _varint(d) for d in arr.shape)
+    return _ld(7, shape_payload) + _packed_floats(5, arr.reshape(-1).tolist())
+
+
+def _layer_v2(name, ltype, blobs=()):
+    payload = _string(1, name) + _string(2, ltype)
+    for b in blobs:
+        payload += _ld(7, _blob(b))
+    return _ld(100, payload)
+
+
+def _layer_v1(name, type_enum, blobs=()):
+    payload = _string(4, name) + _tag(5, 0) + _varint(type_enum)
+    for b in blobs:
+        payload += _ld(6, _blob(b))
+    return _ld(2, payload)
+
+
+# ---- prototxt parser ------------------------------------------------------
+
+def test_parse_prototxt_nesting_and_types():
+    net = parse_prototxt("""
+    name: "tiny"   # a comment
+    input: "data"
+    input_dim: 1 input_dim: 3 input_dim: 8 input_dim: 8
+    layer {
+      name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+      convolution_param { num_output: 4 kernel_size: 3 pad: 1 bias_term: false }
+    }
+    layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+    """)
+    assert net["name"] == ["tiny"]
+    assert net["input_dim"] == [1, 3, 8, 8]
+    assert len(net["layer"]) == 2
+    conv = net["layer"][0]
+    assert conv["type"] == ["Convolution"]
+    p = conv["convolution_param"][0]
+    assert p["num_output"] == [4] and p["bias_term"] == [False]
+
+
+LENET_DEPLOY = """
+name: "LeNet"
+input: "data"
+input_dim: 2 input_dim: 1 input_dim: 28 input_dim: 28
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 20 kernel_size: 5 stride: 1 } }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 50 } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 } }
+layer { name: "prob" type: "Softmax" bottom: "ip2" top: "prob" }
+"""
+
+
+def test_convert_lenet_symbol_binds_and_runs():
+    import mxnet_tpu as mx
+
+    sym, input_name, input_dim = convert_symbol(LENET_DEPLOY)
+    assert input_name == "data"
+    assert input_dim == [2, 1, 28, 28]
+    args = sym.list_arguments()
+    for expect in ("conv1_weight", "conv1_bias", "ip1_weight", "ip2_weight"):
+        assert expect in args, args
+    ex = sym.simple_bind(mx.cpu(), data=(2, 1, 28, 28),
+                         prob_label=(2,), grad_req="null")
+    out = ex.forward(is_train=False)
+    assert out[0].shape == (2, 10)
+    probs = out[0].asnumpy()
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_v1_enum_prototxt():
+    sym, _, _ = convert_symbol("""
+    name: "v1net"
+    input: "data"
+    input_dim: 1 input_dim: 2 input_dim: 4 input_dim: 4
+    layers { name: "c" type: CONVOLUTION bottom: "data" top: "c"
+      convolution_param { num_output: 2 kernel_size: 1 } }
+    layers { name: "t" type: TANH bottom: "c" top: "t" }
+    """)
+    assert "c_weight" in sym.list_arguments()
+
+
+HEADER = """
+input: "data"
+input_dim: 1 input_dim: 2 input_dim: 4 input_dim: 4
+"""
+
+
+def test_rejection_paths():
+    # standalone Scale (learned weights would silently vanish)
+    with pytest.raises(ValueError, match="standalone Scale"):
+        convert_symbol(HEADER + """
+        layer { name: "s" type: "Scale" bottom: "data" top: "s" }
+        """)
+    # stochastic pooling has no analog
+    with pytest.raises(ValueError, match="pooling mode"):
+        convert_symbol(HEADER + """
+        layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+          pooling_param { pool: STOCHASTIC kernel_size: 2 stride: 2 } }
+        """)
+    # Eltwise coeff list must match the input count
+    with pytest.raises(ValueError, match="coeffs for"):
+        convert_symbol(HEADER + """
+        layer { name: "e" type: "Eltwise" bottom: "data" bottom: "data"
+          eltwise_param { operation: SUM coeff: 2.0 } }
+        """)
+    # malformed prototxt must raise, never truncate-parse
+    with pytest.raises(ValueError, match="tokenize|dangling|without"):
+        parse_prototxt('layer { name: "a" : }')
+    with pytest.raises(ValueError, match="unterminated"):
+        parse_prototxt('name: "abc')
+
+
+def test_legacy_fc_weight_reshaped(tmp_path):
+    # old-format blob: no BlobShape, 4-D num/channels/height/width dims
+    w = np.arange(12, dtype=np.float32)
+    payload = b""
+    for field, dim in ((1, 1), (2, 1), (3, 3), (4, 4)):
+        payload += _tag(field, 0) + _varint(dim)
+    payload += _packed_floats(5, w.tolist())
+    model = _ld(2, _string(4, "ip1") + _tag(5, 0) + _varint(14)
+                + _ld(6, payload))
+    path = tmp_path / "legacy.caffemodel"
+    path.write_bytes(model)
+    proto = """
+    input: "data"
+    input_dim: 1 input_dim: 4
+    layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+      inner_product_param { num_output: 3 } }
+    layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+    """
+    _, arg_params, _ = convert_model(proto, str(path))
+    assert arg_params["ip1_weight"].shape == (3, 4)
+    np.testing.assert_array_equal(arg_params["ip1_weight"].reshape(-1), w)
+
+
+def test_unknown_layer_raises():
+    with pytest.raises(ValueError, match="not supported"):
+        convert_symbol("""
+        input: "data"
+        input_dim: 1 input_dim: 1 input_dim: 4 input_dim: 4
+        layer { name: "x" type: "FrobnicateLayer" bottom: "data" top: "x" }
+        """)
+
+
+# ---- caffemodel decoding + model conversion -------------------------------
+
+def test_read_caffemodel_v2_and_v1(tmp_path):
+    w = np.arange(8, dtype=np.float32).reshape(2, 1, 2, 2)
+    b = np.array([0.5, -0.5], dtype=np.float32)
+    blob_file = tmp_path / "net.caffemodel"
+    blob_file.write_bytes(
+        _string(1, "tiny")
+        + _layer_v2("conv1", "Convolution", [w, b])
+        + _layer_v1("ip1", 14, [np.ones((3, 4), np.float32)]))
+    layers = read_caffemodel(str(blob_file))
+    by_name = {l["name"]: l for l in layers}
+    assert by_name["conv1"]["type"] == "Convolution"
+    np.testing.assert_array_equal(by_name["conv1"]["blobs"][0], w)
+    np.testing.assert_array_equal(by_name["conv1"]["blobs"][1], b)
+    assert by_name["ip1"]["type"] == "InnerProduct"
+    assert by_name["ip1"]["blobs"][0].shape == (3, 4)
+
+
+BN_NET = """
+name: "bnnet"
+input: "data"
+input_dim: 2 input_dim: 2 input_dim: 4 input_dim: 4
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "bn1" type: "BatchNorm" bottom: "conv1" top: "bn1"
+  batch_norm_param { eps: 0.001 } }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1"
+  scale_param { bias_term: true } }
+layer { name: "relu1" type: "ReLU" bottom: "bn1" top: "bn1" }
+layer { name: "prob" type: "Softmax" bottom: "bn1" top: "prob" }
+"""
+
+
+def test_convert_model_folds_bn_scale(tmp_path):
+    import mxnet_tpu as mx
+
+    w = np.random.RandomState(0).randn(2, 2, 1, 1).astype(np.float32)
+    bias = np.array([0.1, 0.2], dtype=np.float32)
+    mean_acc = np.array([2.0, 4.0], dtype=np.float32)
+    var_acc = np.array([8.0, 2.0], dtype=np.float32)
+    sf = np.array([2.0], dtype=np.float32)  # caffe's unnormalized stats
+    gamma = np.array([1.5, 0.5], dtype=np.float32)
+    beta = np.array([-1.0, 1.0], dtype=np.float32)
+    model = (_layer_v2("conv1", "Convolution", [w, bias])
+             + _layer_v2("bn1", "BatchNorm", [mean_acc, var_acc, sf])
+             + _layer_v2("scale1", "Scale", [gamma, beta]))
+    path = tmp_path / "bn.caffemodel"
+    path.write_bytes(model)
+
+    sym, arg_params, aux_params = convert_model(BN_NET, str(path))
+    np.testing.assert_array_equal(arg_params["conv1_weight"], w)
+    np.testing.assert_array_equal(arg_params["bn1_gamma"], gamma)
+    np.testing.assert_array_equal(arg_params["bn1_beta"], beta)
+    # stats normalized by the scale factor
+    np.testing.assert_allclose(aux_params["bn1_moving_mean"], mean_acc / 2.0)
+    np.testing.assert_allclose(aux_params["bn1_moving_var"], var_acc / 2.0)
+
+    # the converted net runs with the converted weights and matches numpy
+    ex = sym.simple_bind(mx.cpu(), data=(2, 2, 4, 4), prob_label=(2,),
+                         grad_req="null")
+    for k, v in arg_params.items():
+        ex.arg_dict[k][:] = v
+    for k, v in aux_params.items():
+        ex.aux_dict[k][:] = v
+    x = np.random.RandomState(1).randn(2, 2, 4, 4).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    out = ex.forward(is_train=False)[0].asnumpy()
+
+    conv = np.einsum("bchw,oc->bohw", x, w[:, :, 0, 0]) \
+        + bias[None, :, None, None]
+    mean, var = mean_acc / 2.0, var_acc / 2.0
+    bn = (conv - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + 1e-3)
+    bn = bn * gamma[None, :, None, None] + beta[None, :, None, None]
+    relu = np.maximum(bn, 0)
+    e = np.exp(relu - relu.max(axis=1, keepdims=True))
+    expect = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
