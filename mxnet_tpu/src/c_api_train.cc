@@ -27,11 +27,14 @@
 extern thread_local std::string g_last_error_train;
 thread_local std::string g_last_error_train;
 
+void mxtpu_promote_libpython();  // c_predict_api.cc (libpython RTLD_GLOBAL)
+
 namespace {
 
 struct GilT {
   GilT() {
     if (!Py_IsInitialized()) {
+      mxtpu_promote_libpython();
       Py_InitializeEx(0);
 #if PY_VERSION_HEX < 0x03090000
       PyEval_InitThreads();

@@ -10,7 +10,9 @@
 // Threading: every entry point takes the GIL via PyGILState_Ensure, so the
 // library is safe to call from any thread after MXPredInit/first use.
 #include <Python.h>
+#include <dlfcn.h>
 
+#include <cstdio>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -22,6 +24,27 @@ typedef void* NDListHandle;
 typedef unsigned int mx_uint;
 typedef float mx_float;
 
+// Promote libpython's symbols to global visibility before initializing the
+// embedded interpreter. When this library is dlopen'd RTLD_LOCAL by an FFI
+// host (perl XSLoader, ruby, node), python extension modules (numpy, jaxlib)
+// loaded later by the embedded interpreter cannot resolve Py* symbols
+// otherwise. No-op when the host already links libpython (python itself,
+// directly-linked C clients).
+void mxtpu_promote_libpython() {
+  static const char* patterns[] = {
+      "libpython%d.%d.so",      // -dev symlink
+      "libpython%d.%d.so.1.0",  // runtime soname (no -dev installed)
+      "libpython%d.%dm.so",     // pre-3.8 'm' ABI
+  };
+  char name[64];
+  for (const char* pat : patterns) {
+    std::snprintf(name, sizeof(name), pat, PY_MAJOR_VERSION,
+                  PY_MINOR_VERSION);
+    if (dlopen(name, RTLD_NOW | RTLD_GLOBAL)) return;
+  }
+  // best-effort: hosts that already link libpython don't need any of these
+}
+
 namespace {
 
 thread_local std::string g_last_error;
@@ -29,6 +52,7 @@ thread_local std::string g_last_error;
 struct PyEnv {
   PyEnv() {
     if (!Py_IsInitialized()) {
+      mxtpu_promote_libpython();
       Py_InitializeEx(0);
       owns = true;
 #if PY_VERSION_HEX < 0x03090000

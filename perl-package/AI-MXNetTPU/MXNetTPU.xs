@@ -1,0 +1,393 @@
+/* XS glue for AI::MXNetTPU — the Perl binding over the framework's C
+ * training API (mxnet_tpu/src/include/c_train_api.h, exported by
+ * libmxtpu_predict.so).
+ *
+ * The analog of the reference's perl-package (AI-MXNet over
+ * AI-MXNetCAPI's SWIG wrappers); here the glue is hand-written XS over the
+ * much smaller TPU-native C surface. Handles cross into Perl as IVs;
+ * every failing C call croaks with MXTrainGetLastError().
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "c_train_api.h"
+
+static void* check_ptr(IV h) {
+  if (!h) croak("AI::MXNetTPU: null handle");
+  return INT2PTR(void*, h);
+}
+
+#define CROAK_ON(expr) \
+  if ((expr) != 0) croak("AI::MXNetTPU: %s", MXTrainGetLastError())
+
+/* AV* of numbers -> malloc'd float vector (caller frees) */
+static float* av_to_floats(pTHX_ AV* av, mx_uint* out_n) {
+  mx_uint n = (mx_uint)(av_len(av) + 1);
+  float* buf = (float*)malloc(n * sizeof(float));
+  mx_uint i;
+  for (i = 0; i < n; ++i) {
+    SV** el = av_fetch(av, i, 0);
+    buf[i] = el ? (float)SvNV(*el) : 0.0f;
+  }
+  *out_n = n;
+  return buf;
+}
+
+static AV* floats_to_av(pTHX_ const float* data, mx_uint n) {
+  AV* av = newAV();
+  mx_uint i;
+  if (n) av_extend(av, n - 1);
+  for (i = 0; i < n; ++i) av_push(av, newSVnv(data[i]));
+  return av;
+}
+
+/* shape AV -> malloc'd mx_uint vector; croaks unless product == expect */
+static mx_uint* av_to_shape(pTHX_ AV* sav, mx_uint expect, mx_uint* out_nd) {
+  mx_uint nd = (mx_uint)(av_len(sav) + 1), i, prod = 1;
+  mx_uint* shape = (mx_uint*)malloc(nd * sizeof(mx_uint));
+  for (i = 0; i < nd; ++i) {
+    SV** el = av_fetch(sav, i, 0);
+    shape[i] = el ? (mx_uint)SvUV(*el) : 0;
+    prod *= shape[i];
+  }
+  if (prod != expect) {
+    free(shape);
+    croak("AI::MXNetTPU: %u values for shape of %u elements", expect, prod);
+  }
+  *out_nd = nd;
+  return shape;
+}
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+const char*
+last_error()
+  CODE:
+    RETVAL = MXTrainGetLastError();
+  OUTPUT:
+    RETVAL
+
+IV
+symbol_from_json(json)
+    const char* json
+  CODE:
+    SymbolHandle h = NULL;
+    CROAK_ON(MXSymbolCreateFromJSON(json, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+const char*
+symbol_to_json(sym)
+    IV sym
+  CODE:
+    const char* out = NULL;
+    CROAK_ON(MXSymbolSaveToJSON(check_ptr(sym), &out));
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+IV
+symbol_variable(name)
+    const char* name
+  CODE:
+    SymbolHandle h = NULL;
+    CROAK_ON(MXSymbolCreateVariable(name, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+IV
+symbol_create(op_name, name, params_hv, input_keys_av, inputs_av)
+    const char* op_name
+    const char* name
+    SV* params_hv
+    SV* input_keys_av
+    SV* inputs_av
+  CODE:
+    HV* params = (HV*)SvRV(params_hv);
+    AV* ikeys = (AV*)SvRV(input_keys_av);
+    AV* isyms = (AV*)SvRV(inputs_av);
+    mx_uint num_param = (mx_uint)HvUSEDKEYS(params);
+    mx_uint num_inputs = (mx_uint)(av_len(isyms) + 1);
+    const char** pkeys = (const char**)malloc(num_param * sizeof(char*));
+    const char** pvals = (const char**)malloc(num_param * sizeof(char*));
+    const char** inkeys = (const char**)malloc(num_inputs * sizeof(char*));
+    SymbolHandle* ins =
+        (SymbolHandle*)malloc(num_inputs * sizeof(SymbolHandle));
+    SymbolHandle out = NULL;
+    HE* he;
+    mx_uint i = 0;
+    int rc;
+    hv_iterinit(params);
+    while ((he = hv_iternext(params)) != NULL) {
+      I32 klen;
+      pkeys[i] = hv_iterkey(he, &klen);
+      pvals[i] = SvPV_nolen(hv_iterval(params, he));
+      ++i;
+    }
+    for (i = 0; i < num_inputs; ++i) {
+      SV** k = av_fetch(ikeys, i, 0);
+      SV** s = av_fetch(isyms, i, 0);
+      inkeys[i] = k ? SvPV_nolen(*k) : "";
+      ins[i] = s ? INT2PTR(SymbolHandle, SvIV(*s)) : NULL;
+    }
+    rc = MXSymbolCreateFromOperator(op_name, name, num_param, pkeys, pvals,
+                                    num_inputs, inkeys, ins, &out);
+    free(pkeys); free(pvals); free(inkeys); free(ins);
+    if (rc != 0) croak("AI::MXNetTPU: %s", MXTrainGetLastError());
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+void
+symbol_list_arguments(sym)
+    IV sym
+  PPCODE:
+    mx_uint n = 0, i;
+    const char** names = NULL;
+    CROAK_ON(MXSymbolListArguments(check_ptr(sym), &n, &names));
+    EXTEND(SP, n);
+    for (i = 0; i < n; ++i) PUSHs(sv_2mortal(newSVpv(names[i], 0)));
+
+void
+symbol_free(sym)
+    IV sym
+  CODE:
+    MXSymbolFree(check_ptr(sym));
+
+IV
+simple_bind(sym, dev_type, dev_id, shapes_hv, grad_req)
+    IV sym
+    const char* dev_type
+    int dev_id
+    SV* shapes_hv
+    const char* grad_req
+  CODE:
+    HV* shapes = (HV*)SvRV(shapes_hv);
+    mx_uint num_args = (mx_uint)HvUSEDKEYS(shapes);
+    const char** keys = (const char**)malloc(num_args * sizeof(char*));
+    mx_uint* idx = (mx_uint*)malloc((num_args + 1) * sizeof(mx_uint));
+    mx_uint cap = 16, used = 0;
+    mx_uint* dims = (mx_uint*)malloc(cap * sizeof(mx_uint));
+    ExecutorHandle out = NULL;
+    HE* he;
+    mx_uint i = 0;
+    int rc;
+    idx[0] = 0;
+    hv_iterinit(shapes);
+    while ((he = hv_iternext(shapes)) != NULL) {
+      I32 klen;
+      AV* dim_av = (AV*)SvRV(hv_iterval(shapes, he));
+      mx_uint nd = (mx_uint)(av_len(dim_av) + 1), j;
+      keys[i] = hv_iterkey(he, &klen);
+      while (used + nd > cap) {
+        cap *= 2;
+        dims = (mx_uint*)realloc(dims, cap * sizeof(mx_uint));
+      }
+      for (j = 0; j < nd; ++j) {
+        SV** el = av_fetch(dim_av, j, 0);
+        dims[used++] = el ? (mx_uint)SvUV(*el) : 0;
+      }
+      idx[++i] = used;
+    }
+    rc = MXExecutorSimpleBindLite(check_ptr(sym), dev_type, dev_id, num_args,
+                                 keys, dims, idx, grad_req, &out);
+    free(keys); free(idx); free(dims);
+    if (rc != 0) croak("AI::MXNetTPU: %s", MXTrainGetLastError());
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+void
+executor_free(h)
+    IV h
+  CODE:
+    MXExecutorFree(check_ptr(h));
+
+void
+init_xavier(h, seed)
+    IV h
+    int seed
+  CODE:
+    CROAK_ON(MXExecutorInitXavier(check_ptr(h), seed));
+
+void
+set_arg(h, name, values_av)
+    IV h
+    const char* name
+    SV* values_av
+  CODE:
+    mx_uint n = 0;
+    float* buf = av_to_floats(aTHX_ (AV*)SvRV(values_av), &n);
+    int rc = MXExecutorSetArg(check_ptr(h), name, buf, n);
+    free(buf);
+    if (rc != 0) croak("AI::MXNetTPU: %s", MXTrainGetLastError());
+
+SV*
+get_arg(h, name)
+    IV h
+    const char* name
+  CODE:
+    const float* out = NULL;
+    mx_uint n = 0;
+    CROAK_ON(MXExecutorGetArg(check_ptr(h), name, &out, &n));
+    RETVAL = newRV_noinc((SV*)floats_to_av(aTHX_ out, n));
+  OUTPUT:
+    RETVAL
+
+SV*
+get_grad(h, name)
+    IV h
+    const char* name
+  CODE:
+    const float* out = NULL;
+    mx_uint n = 0;
+    CROAK_ON(MXExecutorGetGrad(check_ptr(h), name, &out, &n));
+    RETVAL = newRV_noinc((SV*)floats_to_av(aTHX_ out, n));
+  OUTPUT:
+    RETVAL
+
+SV*
+get_output(h, index)
+    IV h
+    unsigned int index
+  CODE:
+    const float* out = NULL;
+    mx_uint n = 0;
+    CROAK_ON(MXExecutorGetOutput(check_ptr(h), index, &out, &n));
+    RETVAL = newRV_noinc((SV*)floats_to_av(aTHX_ out, n));
+  OUTPUT:
+    RETVAL
+
+void
+forward(h, is_train)
+    IV h
+    int is_train
+  CODE:
+    CROAK_ON(MXExecutorForward(check_ptr(h), is_train));
+
+void
+backward(h)
+    IV h
+  CODE:
+    CROAK_ON(MXExecutorBackward(check_ptr(h), 0, NULL));
+
+void
+sgd_update(h, lr, wd)
+    IV h
+    float lr
+    float wd
+  CODE:
+    CROAK_ON(MXExecutorSGDUpdate(check_ptr(h), lr, wd));
+
+void
+momentum_update(h, lr, wd, momentum)
+    IV h
+    float lr
+    float wd
+    float momentum
+  CODE:
+    CROAK_ON(MXExecutorMomentumUpdate(check_ptr(h), lr, wd, momentum));
+
+void
+save_params(h, path)
+    IV h
+    const char* path
+  CODE:
+    CROAK_ON(MXExecutorSaveParams(check_ptr(h), path));
+
+unsigned int
+load_params(h, path)
+    IV h
+    const char* path
+  CODE:
+    mx_uint n = 0;
+    CROAK_ON(MXExecutorLoadParams(check_ptr(h), path, &n));
+    RETVAL = n;
+  OUTPUT:
+    RETVAL
+
+IV
+kv_create(type)
+    const char* type
+  CODE:
+    KVStoreHandle h = NULL;
+    CROAK_ON(MXKVStoreCreate(type, &h));
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+kv_free(h)
+    IV h
+  CODE:
+    MXKVStoreFree(check_ptr(h));
+
+int
+kv_rank(h)
+    IV h
+  CODE:
+    int r = 0;
+    CROAK_ON(MXKVStoreGetRank(check_ptr(h), &r));
+    RETVAL = r;
+  OUTPUT:
+    RETVAL
+
+int
+kv_group_size(h)
+    IV h
+  CODE:
+    int n = 0;
+    CROAK_ON(MXKVStoreGetGroupSize(check_ptr(h), &n));
+    RETVAL = n;
+  OUTPUT:
+    RETVAL
+
+void
+kv_init(h, key, values_av, shape_av)
+    IV h
+    int key
+    SV* values_av
+    SV* shape_av
+  CODE:
+    AV* vav = (AV*)SvRV(values_av);
+    mx_uint n = 0, nd = 0;
+    mx_uint* shape = av_to_shape(aTHX_ (AV*)SvRV(shape_av),
+                                 (mx_uint)(av_len(vav) + 1), &nd);
+    float* buf = av_to_floats(aTHX_ vav, &n);
+    int rc = MXKVStoreInit(check_ptr(h), key, buf, shape, nd);
+    free(buf); free(shape);
+    if (rc != 0) croak("AI::MXNetTPU: %s", MXTrainGetLastError());
+
+void
+kv_push(h, key, values_av, shape_av)
+    IV h
+    int key
+    SV* values_av
+    SV* shape_av
+  CODE:
+    AV* vav = (AV*)SvRV(values_av);
+    mx_uint n = 0, nd = 0;
+    mx_uint* shape = av_to_shape(aTHX_ (AV*)SvRV(shape_av),
+                                 (mx_uint)(av_len(vav) + 1), &nd);
+    float* buf = av_to_floats(aTHX_ vav, &n);
+    int rc = MXKVStorePush(check_ptr(h), key, buf, shape, nd);
+    free(buf); free(shape);
+    if (rc != 0) croak("AI::MXNetTPU: %s", MXTrainGetLastError());
+
+SV*
+kv_pull(h, key)
+    IV h
+    int key
+  CODE:
+    const float* out = NULL;
+    mx_uint n = 0;
+    CROAK_ON(MXKVStorePull(check_ptr(h), key, &out, &n));
+    RETVAL = newRV_noinc((SV*)floats_to_av(aTHX_ out, n));
+  OUTPUT:
+    RETVAL

@@ -1,0 +1,38 @@
+package AI::MXNetTPU;
+
+# Perl binding for the TPU-native framework (the analog of the reference's
+# perl-package / AI::MXNet, reference: perl-package/AI-MXNet/lib/AI/MXNet.pm).
+#
+# The XS layer (MXNetTPU.xs) wraps the C training API exported by
+# libmxtpu_predict.so (mxnet_tpu/src/include/c_train_api.h); the compute
+# behind it is the framework's XLA-compiled executor — identical numerics to
+# the Python surface. High-level classes:
+#
+#   my $data = AI::MXNetTPU::Symbol->Variable("data");
+#   my $fc   = AI::MXNetTPU::Symbol->create(
+#                  "FullyConnected", name => "fc1",
+#                  params => { num_hidden => 64 }, inputs => [$data]);
+#   my $exec = $net->simple_bind("cpu", 0,
+#                  { data => [32, 10], softmax_label => [32] });
+#   $exec->init_xavier(7);
+#   $exec->set_arg("data", \@batch);
+#   $exec->forward(1); $exec->backward;
+#   $exec->momentum_update(0.05, 1e-4, 0.9);
+#   $exec->save_params("model-0001.params");   # loads in Python Module
+#
+# Build: perl Makefile.PL && make   (needs `make c_predict` in
+# mxnet_tpu/src first; driven by tests/test_perl_binding.py).
+
+use strict;
+use warnings;
+
+our $VERSION = '0.10.1';
+
+require XSLoader;
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+use AI::MXNetTPU::Symbol;
+use AI::MXNetTPU::Executor;
+use AI::MXNetTPU::KVStore;
+
+1;

@@ -1,0 +1,45 @@
+package AI::MXNetTPU::Executor;
+
+# Bound executor (reference: perl-package AI::MXNet::Executor). Forward/
+# backward/update run the framework's XLA-compiled graph; float data crosses
+# as Perl array refs.
+
+use strict;
+use warnings;
+
+sub _wrap {
+    my ($class, $handle) = @_;
+    return bless { handle => $handle }, $class;
+}
+
+sub init_xavier { AI::MXNetTPU::init_xavier($_[0]{handle}, $_[1]) }
+sub set_arg     { AI::MXNetTPU::set_arg($_[0]{handle}, $_[1], $_[2]) }
+sub get_arg     { AI::MXNetTPU::get_arg($_[0]{handle}, $_[1]) }
+sub get_grad    { AI::MXNetTPU::get_grad($_[0]{handle}, $_[1]) }
+sub get_output  { AI::MXNetTPU::get_output($_[0]{handle}, $_[1] // 0) }
+sub forward     { AI::MXNetTPU::forward($_[0]{handle}, $_[1] // 0) }
+sub backward    { AI::MXNetTPU::backward($_[0]{handle}) }
+
+sub sgd_update {
+    my ($self, $lr, $wd) = @_;
+    AI::MXNetTPU::sgd_update($self->{handle}, $lr, $wd // 0);
+}
+
+sub momentum_update {
+    my ($self, $lr, $wd, $momentum) = @_;
+    AI::MXNetTPU::momentum_update(
+        $self->{handle}, $lr, $wd // 0, $momentum // 0.9);
+}
+
+# reference checkpoint format (arg:/aux: NDArray dict) — interchanges with
+# the Python Module and the reference itself
+sub save_params { AI::MXNetTPU::save_params($_[0]{handle}, $_[1]) }
+sub load_params { AI::MXNetTPU::load_params($_[0]{handle}, $_[1]) }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::executor_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

@@ -1,0 +1,27 @@
+package AI::MXNetTPU::KVStore;
+
+# KVStore handle (reference: perl-package AI::MXNet::KVStore over the
+# MXKVStore* C functions).
+
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $type) = @_;
+    return bless { handle => AI::MXNetTPU::kv_create($type // "local") },
+        $class;
+}
+
+sub rank       { AI::MXNetTPU::kv_rank($_[0]{handle}) }
+sub group_size { AI::MXNetTPU::kv_group_size($_[0]{handle}) }
+sub init { AI::MXNetTPU::kv_init($_[0]{handle}, $_[1], $_[2], $_[3]) }
+sub push { AI::MXNetTPU::kv_push($_[0]{handle}, $_[1], $_[2], $_[3]) }
+sub pull { AI::MXNetTPU::kv_pull($_[0]{handle}, $_[1]) }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::kv_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

@@ -1,0 +1,60 @@
+package AI::MXNetTPU::Symbol;
+
+# Symbol graph handle (reference: perl-package/AI-MXNet Symbol class over
+# the C symbol API). Composition goes through AI::MXNetTPU::symbol_create,
+# the fused CreateAtomicSymbol+Compose C entry point.
+
+use strict;
+use warnings;
+use AI::MXNetTPU::Executor;
+
+sub _wrap {
+    my ($class, $handle) = @_;
+    return bless { handle => $handle }, $class;
+}
+
+sub Variable {
+    my ($class, $name) = @_;
+    return $class->_wrap(AI::MXNetTPU::symbol_variable($name));
+}
+
+sub load_json {
+    my ($class, $json) = @_;
+    return $class->_wrap(AI::MXNetTPU::symbol_from_json($json));
+}
+
+# AI::MXNetTPU::Symbol->create($op, name => ..., params => {...},
+#                              inputs => [...], input_keys => [...])
+sub create {
+    my ($class, $op, %args) = @_;
+    my $params = $args{params} // {};
+    my $inputs = $args{inputs} // [];
+    my $keys   = $args{input_keys} // [("") x scalar(@$inputs)];
+    my %str_params = map { $_ => "" . $params->{$_} } keys %$params;
+    my @handles = map { $_->{handle} } @$inputs;
+    my $h = AI::MXNetTPU::symbol_create(
+        $op, $args{name} // "", \%str_params, $keys, \@handles);
+    return $class->_wrap($h);
+}
+
+sub tojson { AI::MXNetTPU::symbol_to_json($_[0]{handle}) }
+
+sub list_arguments {
+    my @names = AI::MXNetTPU::symbol_list_arguments($_[0]{handle});
+    return \@names;
+}
+
+sub simple_bind {
+    my ($self, $dev_type, $dev_id, $shapes, $grad_req) = @_;
+    my $h = AI::MXNetTPU::simple_bind(
+        $self->{handle}, $dev_type, $dev_id, $shapes, $grad_req // "write");
+    return AI::MXNetTPU::Executor->_wrap($h);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::symbol_free($self->{handle}) if $self->{handle};
+    $self->{handle} = 0;
+}
+
+1;

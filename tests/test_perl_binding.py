@@ -1,0 +1,80 @@
+"""Perl binding tests: build AI::MXNetTPU (perl-package/, XS over the C
+training API) and run its Perl test suite, then load the Perl-trained
+checkpoint into the Python Module — the same cross-language interchange the
+reference's perl-package provides (reference: perl-package/AI-MXNet).
+"""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "perl-package", "AI-MXNetTPU")
+SRC = os.path.join(ROOT, "mxnet_tpu", "src")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("perl") is None or shutil.which("g++") is None,
+    reason="no perl or C++ toolchain")
+
+
+def _build(tmp_path_factory):
+    r = subprocess.run(["make", "c_predict"], cwd=SRC, capture_output=True,
+                       text=True)
+    if r.returncode != 0:
+        pytest.skip("shim build failed: %s" % r.stderr[-300:])
+    r = subprocess.run(["perl", "Makefile.PL"], cwd=PKG, capture_output=True,
+                       text=True)
+    if r.returncode != 0:
+        pytest.skip("Makefile.PL failed (missing perl dev?): %s"
+                    % (r.stderr or r.stdout)[-300:])
+    r = subprocess.run(["make"], cwd=PKG, capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+
+
+@pytest.fixture(scope="module")
+def perl_run(tmp_path_factory):
+    _build(tmp_path_factory)
+    out_dir = str(tmp_path_factory.mktemp("perl_out"))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_PERL_OUT"] = out_dir
+    r = subprocess.run(["perl", os.path.join("t", "train.t")], cwd=PKG,
+                       capture_output=True, text=True, env=env, timeout=600)
+    return r, out_dir
+
+
+def test_perl_suite_passes(perl_run):
+    r, _ = perl_run
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "perl-trained accuracy" in r.stdout
+    assert "push/pull round-trip" in r.stdout
+
+
+def test_python_loads_perl_checkpoint(perl_run):
+    r, out_dir = perl_run
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    import mxnet_tpu as mx
+
+    sym = mx.sym.load(os.path.join(out_dir, "perlnet-symbol.json"))
+    loaded = mx.nd.load(os.path.join(out_dir, "perlnet-0001.params"))
+    arg_params = {k[4:]: v for k, v in loaded.items() if k.startswith("arg:")}
+    assert set(arg_params) == {"fc1_weight", "fc1_bias",
+                               "fc2_weight", "fc2_bias"}
+
+    # score the planted-signal task with the Perl-trained weights
+    ex = sym.simple_bind(mx.cpu(), data=(32, 8), softmax_label=(32,),
+                         grad_req="null")
+    for k, v in arg_params.items():
+        ex.arg_dict[k][:] = v
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (32, 8)).astype(np.float32)
+    Y = (rng.uniform(size=32) > 0.5).astype(np.float32)
+    X[Y > 0.5, :4] += 0.8
+    X[Y < 0.5, 4:] += 0.8
+    ex.arg_dict["data"][:] = X
+    out = ex.forward(is_train=False)[0].asnumpy()
+    acc = ((out[:, 1] > out[:, 0]).astype(np.float32) == Y).mean()
+    assert acc > 0.85, acc

@@ -258,7 +258,7 @@ def decode_message(buf, schema):
             out = decode_message(payload, kind[1])
         else:
             raise ValueError("bad schema kind %r" % (kind,))
-        if kind == "packed_f32" and name in msg:
+        if kind in ("packed_f32", "packed_f64") and name in msg:
             msg[name] = [np.concatenate([msg[name][0], out])]
         elif kind == "packed_varint":
             # flatten: packed payloads and repeated unpacked varints both
@@ -469,6 +469,11 @@ def _convert_layer(mx, ltype, l, name, bottoms):
         return mx.sym.LeakyReLU(s, act_type="prelu", name=name)
     if ltype == "LRN":
         p = _one(l, "lrn_param", {})
+        region = _one(p, "norm_region", "ACROSS_CHANNELS")
+        if region not in ("ACROSS_CHANNELS", 0):
+            raise ValueError(
+                "LRN %r: norm_region %r not supported (across-channel only)"
+                % (name, region))
         return mx.sym.LRN(s, alpha=float(_one(p, "alpha", 1.0)),
                           beta=float(_one(p, "beta", 0.75)),
                           knorm=float(_one(p, "k", 1.0)),
@@ -536,7 +541,18 @@ def _convert_layer(mx, ltype, l, name, bottoms):
         dims = tuple(int(d) for d in _all(shape_msg, "dim"))
         return mx.sym.Reshape(s, shape=dims, name=name)
     if ltype == "Crop":
-        return mx.sym.Crop(*bottoms, num_args=len(bottoms), name=name)
+        p = _one(l, "crop_param", {})
+        axis = int(_one(p, "axis", 2))
+        offsets = [int(o) for o in _all(p, "offset")]
+        if axis != 2:
+            raise ValueError(
+                "Crop %r: axis=%d not supported (only spatial axis 2)"
+                % (name, axis))
+        if len(offsets) == 1:
+            offsets = offsets * 2  # caffe: one offset applies to all axes
+        return mx.sym.Crop(*bottoms, num_args=len(bottoms),
+                           offset=tuple(offsets) if offsets else (0, 0),
+                           name=name)
     if ltype == "AbsVal":
         return mx.sym.abs(s, name=name)
     if ltype == "Power":
