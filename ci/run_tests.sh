@@ -87,6 +87,9 @@ run_examples() {
     "neural_style.py --steps 5 --size 32"
     "speech_demo.py --num-epochs 1 --seq-len 20"
     "kaggle_ndsb.py --num-epochs 1 --size 24"
+    "caffe_import.py --num-epoch 1"
+    "bayesian_sgld.py --num-epoch 25 --burn-in 10"
+    "torch_interop.py --steps 60"
   )
   local failed=0
   for inv in "${fast[@]}"; do
@@ -96,6 +99,15 @@ run_examples() {
       echo "FAILED: $inv (tail of log:)"; tail -5 /tmp/example_ci.log; failed=1
     fi
   done
+  # the C++ training example (cpp-package surface; needs the native lib)
+  echo "=== examples/cpp/lenet"
+  if ! (make -C examples/cpp >/tmp/example_ci.log 2>&1 \
+        && cd examples/cpp \
+        && PYTHONPATH="$OLDPWD${PYTHONPATH:+:$PYTHONPATH}" JAX_PLATFORMS=cpu \
+           ./lenet >>/tmp/example_ci.log 2>&1); then
+    echo "FAILED: cpp/lenet (tail of log:)"; tail -5 /tmp/example_ci.log
+    failed=1
+  fi
   return $failed
 }
 

@@ -1,0 +1,105 @@
+"""Import a Caffe network and train it here (reference: example/caffe +
+tools/caffe_converter — convert_symbol/convert_model workflows).
+
+`tools/caffe_converter.py` turns a deploy prototxt into a Symbol (and a
+.caffemodel into params) with no caffe installation. This example converts
+a built-in CaffeNet-style prototxt, binds the result through the normal
+Module API, and trains it on synthetic data — the "bring your Caffe
+architecture to TPU" path. Point --prototxt (and optionally --caffemodel)
+at real files to convert your own:
+
+    python examples/caffe_import.py --prototxt deploy.prototxt \
+        --caffemodel weights.caffemodel --prefix converted
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from tools.caffe_converter import convert_model, convert_symbol
+
+DEMO_PROTOTXT = """
+name: "CaffeNetTiny"
+input: "data"
+input_dim: 32 input_dim: 3 input_dim: 28 input_dim: 28
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 16 kernel_size: 5 stride: 1 pad: 2 } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "norm1" type: "LRN" bottom: "pool1" top: "norm1"
+  lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 } }
+layer { name: "conv2" type: "Convolution" bottom: "norm1" top: "conv2"
+  convolution_param { num_output: 32 kernel_size: 3 pad: 1 group: 2 } }
+layer { name: "relu2" type: "ReLU" bottom: "conv2" top: "conv2" }
+layer { name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
+  pooling_param { pool: AVE kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool2" top: "ip1"
+  inner_product_param { num_output: 64 } }
+layer { name: "relu3" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "drop1" type: "Dropout" bottom: "ip1" top: "ip1"
+  dropout_param { dropout_ratio: 0.25 } }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 } }
+layer { name: "prob" type: "SoftmaxWithLoss" bottom: "ip2" top: "prob" }
+"""
+
+
+def synthetic(n=2048, num_classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    templates = rng.randn(num_classes, 3, 28, 28).astype(np.float32)
+    label = rng.randint(0, num_classes, n)
+    data = templates[label] + 0.8 * rng.randn(n, 3, 28, 28).astype(np.float32)
+    return data.astype(np.float32), label.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--prototxt", help="your deploy prototxt (default: demo)")
+    p.add_argument("--caffemodel", help="optional caffe weights to convert")
+    p.add_argument("--prefix", default="caffe_imported")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-epoch", type=int, default=3)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    text = open(args.prototxt).read() if args.prototxt else DEMO_PROTOTXT
+    if args.caffemodel:
+        sym, arg_params, aux_params = convert_model(text, args.caffemodel)
+        arg_params = {k: mx.nd.array(v) for k, v in arg_params.items()}
+        aux_params = {k: mx.nd.array(v) for k, v in aux_params.items()}
+    else:
+        sym, _, input_dim = convert_symbol(text)
+        arg_params = aux_params = None
+        logging.info("converted symbol: input_dim=%s args=%s", input_dim,
+                     sym.list_arguments())
+
+    data, label = synthetic()
+    # the converted loss layer is named by its caffe layer ("prob")
+    label_name = sym.list_arguments()[-1]
+    train = mx.io.NDArrayIter(data[:1792], label[:1792], args.batch_size,
+                              shuffle=True, label_name=label_name)
+    val = mx.io.NDArrayIter(data[1792:], label[1792:], args.batch_size,
+                            label_name=label_name)
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(sym, label_names=(label_name,), context=ctx)
+    mod.fit(train, eval_data=val,
+            arg_params=arg_params, aux_params=aux_params,
+            allow_missing=arg_params is not None,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc", num_epoch=args.num_epoch,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    mod.save_checkpoint(args.prefix, args.num_epoch)
+    logging.info("saved %s-symbol.json / %s-%04d.params", args.prefix,
+                 args.prefix, args.num_epoch)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+// C++ training example over the cpp-package header (reference:
+// cpp-package/example/lenet.cpp — build a LeNet-style net in C++, train it,
+// checkpoint it).
+//
+// Build + run (after `make -C ../../mxnet_tpu/src c_predict`):
+//   make          # see Makefile in this directory
+//   PYTHONPATH=../.. ./lenet
+//
+// The checkpoint this writes (lenet-0001.params) loads directly into the
+// Python Module (mx.mod.Module.load / set_params) and vice versa.
+#include <cmath>
+#include <cstdio>
+#include <fstream>
+#include <vector>
+
+#include "mxnet_cpp.hpp"
+
+namespace mx = mxnet::cpp;
+
+int main() {
+  auto data = mx::Symbol::Variable("data");
+  auto conv1 = mx::Operator("Convolution")
+                   .SetParam("kernel", "(5,5)")
+                   .SetParam("num_filter", 8)
+                   .SetInput("data", data)
+                   .CreateSymbol("conv1");
+  auto tanh1 = mx::Operator("Activation")
+                   .SetParam("act_type", "tanh")
+                   .AddInput(conv1)
+                   .CreateSymbol("tanh1");
+  auto pool1 = mx::Operator("Pooling")
+                   .SetParam("kernel", "(2,2)")
+                   .SetParam("stride", "(2,2)")
+                   .SetParam("pool_type", "max")
+                   .AddInput(tanh1)
+                   .CreateSymbol("pool1");
+  auto flat = mx::Operator("Flatten").AddInput(pool1).CreateSymbol("flat");
+  auto fc1 = mx::Operator("FullyConnected")
+                 .SetParam("num_hidden", 64)
+                 .AddInput(flat)
+                 .CreateSymbol("fc1");
+  auto relu1 = mx::Operator("Activation")
+                   .SetParam("act_type", "relu")
+                   .AddInput(fc1)
+                   .CreateSymbol("relu1");
+  auto fc2 = mx::Operator("FullyConnected")
+                 .SetParam("num_hidden", 10)
+                 .AddInput(relu1)
+                 .CreateSymbol("fc2");
+  auto net =
+      mx::Operator("SoftmaxOutput").AddInput(fc2).CreateSymbol("softmax");
+
+  const mx_uint B = 64, H = 16, W = 16, C = 10;
+  auto exec = net.SimpleBind(
+      mx::Context::cpu(),  // Context::tpu() when a chip is visible
+      {{"data", {B, 1, H, W}}, {"softmax_label", {B}}});
+  exec.InitXavier(42);
+
+  mx::Optimizer opt("sgd");
+  opt.SetParam("lr", 0.05f)
+      .SetParam("momentum", 0.9f)
+      .SetParam("wd", 1e-4f)
+      .SetParam("rescale_grad", 1.0f / B);  // loss grads are batch-summed
+
+  // synthetic per-class template digits (train_mnist.py's generator idea)
+  unsigned state = 7;
+  auto rnd = [&]() {
+    state = state * 1664525u + 1013904223u;
+    return (state >> 9) / 4194304.0f - 1.0f;
+  };
+  std::vector<float> templates(C * H * W);
+  for (auto& v : templates) v = rnd() > 0.4f ? 1.0f : 0.0f;
+
+  std::vector<float> X(B * H * W), Y(B);
+  const int STEPS = 120;
+  int correct = 0, total = 0;
+  for (int step = 0; step < STEPS; ++step) {
+    for (mx_uint b = 0; b < B; ++b) {
+      int cls = static_cast<int>((rnd() * 0.5f + 0.5f) * C) % C;
+      Y[b] = static_cast<float>(cls);
+      for (mx_uint i = 0; i < H * W; ++i)
+        X[b * H * W + i] = templates[cls * H * W + i] + 0.3f * rnd();
+    }
+    exec.SetArg("data", X);
+    exec.SetArg("softmax_label", Y);
+    exec.Forward(true);
+    if (step >= STEPS - 10) {
+      auto out = exec.GetOutput(0);
+      for (mx_uint b = 0; b < B; ++b) {
+        int arg = 0;
+        for (mx_uint c = 1; c < C; ++c)
+          if (out[b * C + c] > out[b * C + arg]) arg = static_cast<int>(c);
+        correct += (arg == static_cast<int>(Y[b]));
+        ++total;
+      }
+    }
+    exec.Backward();
+    opt.Update(exec);
+  }
+  std::printf("train accuracy (last 10 batches): %.3f\n",
+              static_cast<double>(correct) / total);
+
+  std::ofstream("lenet-symbol.json") << net.ToJSON();
+  exec.SaveParams("lenet-0001.params");
+  std::printf("saved lenet-symbol.json / lenet-0001.params\n");
+  return 0;
+}

@@ -144,11 +144,13 @@ def _c_backward(cexec):
     cexec.executor.backward()
 
 
-def _c_momentum_update(cexec, lr, wd, momentum):
+def _c_momentum_update(cexec, lr, wd, momentum, rescale=1.0):
     """SGD with momentum over every parameter with a gradient (velocity
-    state lives on the executor, device-resident): v = mom*v - lr*(grad +
-    wd*w); w += v — the reference's sgd_mom_update rule
-    (src/operator/optimizer_op-inl.h SGDMomUpdate)."""
+    state lives on the executor, device-resident): v = mom*v -
+    lr*(rescale*grad + wd*w); w += v — the reference's sgd_mom_update rule
+    (src/operator/optimizer_op-inl.h SGDMomUpdate). ``rescale`` is the
+    reference optimizer's rescale_grad — loss-output gradients are
+    batch-summed, so pass 1/batch_size for batch-mean training."""
     ex = cexec.executor
     if not hasattr(cexec, "mom"):
         cexec.mom = {}
@@ -162,7 +164,7 @@ def _c_momentum_update(cexec, lr, wd, momentum):
 
             v = nd.zeros(w.shape, ctx=w.context, dtype=w.dtype)
             cexec.mom[name] = v
-        v[:] = momentum * v - lr * (grad + wd * w)
+        v[:] = momentum * v - lr * (rescale * grad + wd * w)
         w[:] = w + v
 
 
@@ -200,18 +202,20 @@ def _c_load_params(cexec, path):
     return n
 
 
-def _c_sgd_update(cexec, lr, wd):
-    """w -= lr * (grad + wd * w) over every PARAMETER with a gradient — the
-    minimal in-framework update so a C client need not round-trip params.
-    The client's bound inputs (data/labels) also carry gradients under
-    grad_req='write' but must never be updated. (Full optimizers remain the
+def _c_sgd_update(cexec, lr, wd, rescale=1.0):
+    """w -= lr * (rescale*grad + wd*w) over every PARAMETER with a gradient
+    — the minimal in-framework update so a C client need not round-trip
+    params. The client's bound inputs (data/labels) also carry gradients
+    under grad_req='write' but must never be updated. ``rescale`` is the
+    reference optimizer's rescale_grad (pass 1/batch_size for batch-mean
+    training; loss gradients are batch-summed). (Full optimizers remain the
     Python/Module surface's job.)"""
     ex = cexec.executor
     for name, grad in ex.grad_dict.items():
         if grad is None or name in cexec.input_names:
             continue
         w = ex.arg_dict[name]
-        w[:] = w - lr * (grad + wd * w)
+        w[:] = w - lr * (rescale * grad + wd * w)
 
 
 # ---- KVStore (reference: c_api.h MXKVStoreCreate/Init/Push/Pull family) ----

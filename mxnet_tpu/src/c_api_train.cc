@@ -319,12 +319,14 @@ MXNET_DLL int MXExecutorBackward(ExecutorHandle h, mx_uint, void**) {
   return 0;
 }
 
-MXNET_DLL int MXExecutorSGDUpdate(ExecutorHandle h, float lr, float wd) {
+MXNET_DLL int MXExecutorSGDUpdate(ExecutorHandle h, float lr, float wd,
+                                  float rescale_grad) {
   GilT gil;
   auto* e = static_cast<CExec*>(h);
-  PyObject* res = PyObject_CallMethod(train_module(), "_c_sgd_update", "Off",
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_sgd_update", "Offf",
                                       e->obj, static_cast<double>(lr),
-                                      static_cast<double>(wd));
+                                      static_cast<double>(wd),
+                                      static_cast<double>(rescale_grad));
   if (!res) {
     set_err();
     return fail();
@@ -431,13 +433,13 @@ MXNET_DLL int MXExecutorGetAux(ExecutorHandle h, const char* name,
 }
 
 MXNET_DLL int MXExecutorMomentumUpdate(ExecutorHandle h, float lr, float wd,
-                                       float momentum) {
+                                       float momentum, float rescale_grad) {
   GilT gil;
   auto* e = static_cast<CExec*>(h);
   PyObject* res = PyObject_CallMethod(
-      train_module(), "_c_momentum_update", "Offf", e->obj,
+      train_module(), "_c_momentum_update", "Offff", e->obj,
       static_cast<double>(lr), static_cast<double>(wd),
-      static_cast<double>(momentum));
+      static_cast<double>(momentum), static_cast<double>(rescale_grad));
   if (!res) {
     set_err();
     return fail();

@@ -70,11 +70,14 @@ int MXExecutorForward(ExecutorHandle exec, int is_train);
 /* head_grads unsupported in the slice: pass (0, NULL); loss outputs seed 1 */
 int MXExecutorBackward(ExecutorHandle exec, mx_uint num_head_grads,
                        void** head_grads);
-/* w -= lr * (grad + wd * w) for every argument with a gradient */
-int MXExecutorSGDUpdate(ExecutorHandle exec, float lr, float wd);
-/* v = momentum*v - lr*(grad + wd*w); w += v (device-resident velocity) */
+/* w -= lr * (rescale_grad*grad + wd*w) for every argument with a gradient.
+ * Loss-output gradients are batch-SUMMED (reference semantics); pass
+ * rescale_grad = 1/batch_size for batch-mean training, 1.0 for raw sums. */
+int MXExecutorSGDUpdate(ExecutorHandle exec, float lr, float wd,
+                        float rescale_grad);
+/* v = momentum*v - lr*(rescale_grad*grad + wd*w); w += v */
 int MXExecutorMomentumUpdate(ExecutorHandle exec, float lr, float wd,
-                             float momentum);
+                             float momentum, float rescale_grad);
 int MXExecutorNumOutputs(ExecutorHandle exec, mx_uint* out);
 int MXExecutorGetAux(ExecutorHandle exec, const char* name, const float** out,
                      mx_uint* out_size);

@@ -209,11 +209,14 @@ class Executor {
     Check(MXExecutorNumOutputs(get(), &n), "NumOutputs");
     return n;
   }
-  void SGDUpdate(float lr, float wd = 0.f) {
-    Check(MXExecutorSGDUpdate(get(), lr, wd), "SGDUpdate");
+  // rescale_grad: loss gradients are batch-summed — pass 1/batch_size for
+  // batch-mean training (the reference optimizer's rescale_grad knob)
+  void SGDUpdate(float lr, float wd = 0.f, float rescale_grad = 1.f) {
+    Check(MXExecutorSGDUpdate(get(), lr, wd, rescale_grad), "SGDUpdate");
   }
-  void MomentumUpdate(float lr, float wd = 0.f, float momentum = 0.9f) {
-    Check(MXExecutorMomentumUpdate(get(), lr, wd, momentum),
+  void MomentumUpdate(float lr, float wd = 0.f, float momentum = 0.9f,
+                      float rescale_grad = 1.f) {
+    Check(MXExecutorMomentumUpdate(get(), lr, wd, momentum, rescale_grad),
           "MomentumUpdate");
   }
   void SaveParams(const std::string& path) const {
@@ -273,17 +276,20 @@ class Optimizer {
     if (key == "lr" || key == "learning_rate") lr_ = value;
     else if (key == "wd") wd_ = value;
     else if (key == "momentum") momentum_ = value;
+    else if (key == "rescale_grad") rescale_ = value;
     else throw std::runtime_error("unknown optimizer param " + key);
     return *this;
   }
   void Update(Executor& exec) {
-    if (momentum_ != 0.f) exec.MomentumUpdate(lr_, wd_, momentum_);
-    else exec.SGDUpdate(lr_, wd_);
+    if (momentum_ != 0.f)
+      exec.MomentumUpdate(lr_, wd_, momentum_, rescale_);
+    else
+      exec.SGDUpdate(lr_, wd_, rescale_);
   }
 
  private:
   std::string type_;
-  float lr_ = 0.01f, wd_ = 0.f, momentum_ = 0.f;
+  float lr_ = 0.01f, wd_ = 0.f, momentum_ = 0.f, rescale_ = 1.f;
 };
 
 class KVStore {

@@ -168,6 +168,7 @@ simple_bind(sym, dev_type, dev_id, shapes_hv, grad_req)
     SV* shapes_hv
     const char* grad_req
   CODE:
+    void* sh = check_ptr(sym);  /* validate before allocating (croak leaks) */
     HV* shapes = (HV*)SvRV(shapes_hv);
     mx_uint num_args = (mx_uint)HvUSEDKEYS(shapes);
     const char** keys = (const char**)malloc(num_args * sizeof(char*));
@@ -195,7 +196,7 @@ simple_bind(sym, dev_type, dev_id, shapes_hv, grad_req)
       }
       idx[++i] = used;
     }
-    rc = MXExecutorSimpleBindLite(check_ptr(sym), dev_type, dev_id, num_args,
+    rc = MXExecutorSimpleBindLite(sh, dev_type, dev_id, num_args,
                                  keys, dims, idx, grad_req, &out);
     free(keys); free(idx); free(dims);
     if (rc != 0) croak("AI::MXNetTPU: %s", MXTrainGetLastError());
@@ -222,9 +223,10 @@ set_arg(h, name, values_av)
     const char* name
     SV* values_av
   CODE:
+    void* eh = check_ptr(h);  /* validate before allocating (croak leaks) */
     mx_uint n = 0;
     float* buf = av_to_floats(aTHX_ (AV*)SvRV(values_av), &n);
-    int rc = MXExecutorSetArg(check_ptr(h), name, buf, n);
+    int rc = MXExecutorSetArg(eh, name, buf, n);
     free(buf);
     if (rc != 0) croak("AI::MXNetTPU: %s", MXTrainGetLastError());
 
@@ -278,21 +280,24 @@ backward(h)
     CROAK_ON(MXExecutorBackward(check_ptr(h), 0, NULL));
 
 void
-sgd_update(h, lr, wd)
+sgd_update(h, lr, wd, rescale_grad)
     IV h
     float lr
     float wd
+    float rescale_grad
   CODE:
-    CROAK_ON(MXExecutorSGDUpdate(check_ptr(h), lr, wd));
+    CROAK_ON(MXExecutorSGDUpdate(check_ptr(h), lr, wd, rescale_grad));
 
 void
-momentum_update(h, lr, wd, momentum)
+momentum_update(h, lr, wd, momentum, rescale_grad)
     IV h
     float lr
     float wd
     float momentum
+    float rescale_grad
   CODE:
-    CROAK_ON(MXExecutorMomentumUpdate(check_ptr(h), lr, wd, momentum));
+    CROAK_ON(MXExecutorMomentumUpdate(check_ptr(h), lr, wd, momentum,
+                                      rescale_grad));
 
 void
 save_params(h, path)
@@ -355,12 +360,13 @@ kv_init(h, key, values_av, shape_av)
     SV* values_av
     SV* shape_av
   CODE:
+    void* kh = check_ptr(h);  /* validate before allocating (croak leaks) */
     AV* vav = (AV*)SvRV(values_av);
     mx_uint n = 0, nd = 0;
     mx_uint* shape = av_to_shape(aTHX_ (AV*)SvRV(shape_av),
                                  (mx_uint)(av_len(vav) + 1), &nd);
     float* buf = av_to_floats(aTHX_ vav, &n);
-    int rc = MXKVStoreInit(check_ptr(h), key, buf, shape, nd);
+    int rc = MXKVStoreInit(kh, key, buf, shape, nd);
     free(buf); free(shape);
     if (rc != 0) croak("AI::MXNetTPU: %s", MXTrainGetLastError());
 
@@ -371,12 +377,13 @@ kv_push(h, key, values_av, shape_av)
     SV* values_av
     SV* shape_av
   CODE:
+    void* kh = check_ptr(h);  /* validate before allocating (croak leaks) */
     AV* vav = (AV*)SvRV(values_av);
     mx_uint n = 0, nd = 0;
     mx_uint* shape = av_to_shape(aTHX_ (AV*)SvRV(shape_av),
                                  (mx_uint)(av_len(vav) + 1), &nd);
     float* buf = av_to_floats(aTHX_ vav, &n);
-    int rc = MXKVStorePush(check_ptr(h), key, buf, shape, nd);
+    int rc = MXKVStorePush(kh, key, buf, shape, nd);
     free(buf); free(shape);
     if (rc != 0) croak("AI::MXNetTPU: %s", MXTrainGetLastError());
 

@@ -20,15 +20,17 @@ sub get_output  { AI::MXNetTPU::get_output($_[0]{handle}, $_[1] // 0) }
 sub forward     { AI::MXNetTPU::forward($_[0]{handle}, $_[1] // 0) }
 sub backward    { AI::MXNetTPU::backward($_[0]{handle}) }
 
+# rescale_grad: loss gradients are batch-summed (reference semantics) —
+# pass 1/batch_size for batch-mean training
 sub sgd_update {
-    my ($self, $lr, $wd) = @_;
-    AI::MXNetTPU::sgd_update($self->{handle}, $lr, $wd // 0);
+    my ($self, $lr, $wd, $rescale) = @_;
+    AI::MXNetTPU::sgd_update($self->{handle}, $lr, $wd // 0, $rescale // 1);
 }
 
 sub momentum_update {
-    my ($self, $lr, $wd, $momentum) = @_;
+    my ($self, $lr, $wd, $momentum, $rescale) = @_;
     AI::MXNetTPU::momentum_update(
-        $self->{handle}, $lr, $wd // 0, $momentum // 0.9);
+        $self->{handle}, $lr, $wd // 0, $momentum // 0.9, $rescale // 1);
 }
 
 # reference checkpoint format (arg:/aux: NDArray dict) — interchanges with

@@ -106,7 +106,7 @@ int main(int argc, char** argv) {
       }
     }
     CHECK0(MXExecutorBackward(exec, 0, nullptr));
-    CHECK0(MXExecutorSGDUpdate(exec, 0.1f, 0.0f));
+    CHECK0(MXExecutorSGDUpdate(exec, 0.1f, 0.0f, 1.0f));
   }
   double acc = static_cast<double>(correct) / total;
   std::printf("ACC %.4f\n", acc);
