@@ -218,6 +218,96 @@ def _c_sgd_update(cexec, lr, wd, rescale=1.0):
         w[:] = w - lr * (rescale * grad + wd * w)
 
 
+# ---- DataIter (reference: c_api.h MXListDataIters/MXDataIterCreateIter/
+# Next/GetData/GetLabel/GetPadNum family) ------------------------------------
+
+_C_ITER_NAMES = ("MNISTIter", "CSVIter", "ImageRecordIter",
+                 "ImageDetRecordIter")
+
+
+def _c_iter_list():
+    return list(_C_ITER_NAMES)
+
+
+def _parse_iter_param(v):
+    """C clients pass every param as a string (the reference's convention);
+    parse shapes/numbers/bools, fall back to the raw string. A value naming
+    an existing path stays a string even if it LOOKS like a literal (a CSV
+    file named '123' must not become the int 123)."""
+    import ast
+    import os
+
+    s = v.strip()
+    if os.path.exists(s):
+        return s
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+class _CDataIter:
+    def __init__(self, name, params):
+        from . import io, io_image
+
+        if name not in _C_ITER_NAMES:
+            raise KeyError(
+                "no data iterator named %r (have: %s)"
+                % (name, ", ".join(_C_ITER_NAMES)))
+        cls = getattr(io, name, None) or getattr(io_image, name)
+        self.it = cls(**{k: _parse_iter_param(v) for k, v in params.items()})
+        self.batch = None
+
+    def next(self):
+        try:
+            self.batch = self.it.next()
+            return True
+        except StopIteration:
+            self.batch = None
+            return False
+
+    def _current(self, which):
+        if self.batch is None:
+            raise RuntimeError("no current batch (call Next first)")
+        arrs = self.batch.data if which == "data" else self.batch.label
+        return arrs[0]
+
+    def _array(self, which):
+        return np.ascontiguousarray(
+            self._current(which).asnumpy().astype(np.float32))
+
+
+def _c_iter_create(name, param_keys, param_vals):
+    return _CDataIter(name, dict(zip(param_keys, param_vals)))
+
+
+def _c_iter_next(cit):
+    return 1 if cit.next() else 0
+
+
+def _c_iter_reset(cit):
+    cit.it.reset()
+    cit.batch = None
+
+
+def _c_iter_get(cit, which):
+    return cit._array(which).tobytes()
+
+
+def _c_iter_shape(cit, which):
+    # shape only — no batch materialization/host copy
+    return [int(d) for d in cit._current(which).shape]
+
+
+def _c_iter_pad(cit):
+    if cit.batch is None:
+        raise RuntimeError("no current batch (call Next first)")
+    return int(cit.batch.pad or 0)
+
+
 # ---- KVStore (reference: c_api.h MXKVStoreCreate/Init/Push/Pull family) ----
 
 class _CKVStore:

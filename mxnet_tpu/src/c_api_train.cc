@@ -477,6 +477,143 @@ MXNET_DLL int MXExecutorLoadParams(ExecutorHandle h, const char* path,
   return 0;
 }
 
+// ---- DataIter (reference: c_api.h MXListDataIters/MXDataIterCreateIter/
+// MXDataIterNext/GetData/GetLabel/GetPadNum) -------------------------------
+
+struct CIter {
+  PyObject* obj;
+  std::vector<char> blob;
+  std::vector<mx_uint> shape;
+};
+
+MXNET_DLL int MXListDataIters(mx_uint* out_size, const char*** out_array) {
+  GilT gil;
+  return list_strings(
+      PyObject_CallMethod(train_module(), "_c_iter_list", NULL), out_size,
+      out_array);
+}
+
+MXNET_DLL int MXDataIterCreate(const char* name, mx_uint num_param,
+                               const char** keys, const char** vals,
+                               DataIterHandle* out) {
+  GilT gil;
+  PyObject* mod = train_module();
+  if (!mod) return fail();
+  PyObject* pkeys = PyList_New(num_param);
+  PyObject* pvals = PyList_New(num_param);
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(pvals, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* res = PyObject_CallMethod(mod, "_c_iter_create", "sOO", name,
+                                      pkeys, pvals);
+  Py_DECREF(pkeys);
+  Py_DECREF(pvals);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = new CIter{res, {}, {}};
+  return 0;
+}
+
+MXNET_DLL int MXDataIterFree(DataIterHandle h) {
+  GilT gil;
+  auto* it = static_cast<CIter*>(h);
+  Py_XDECREF(it->obj);
+  delete it;
+  return 0;
+}
+
+MXNET_DLL int MXDataIterNext(DataIterHandle h, int* out) {
+  GilT gil;
+  auto* it = static_cast<CIter*>(h);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_iter_next", "O", it->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXDataIterBeforeFirst(DataIterHandle h) {
+  GilT gil;
+  auto* it = static_cast<CIter*>(h);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_iter_reset", "O", it->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+
+int iter_fetch(CIter* it, const char* which, const float** out,
+               mx_uint* out_size) {
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_iter_get", "Os",
+                                      it->obj, which);
+  return bytes_to_floats(res, &it->blob, out, out_size);
+}
+
+int iter_shape(CIter* it, const char* which, const mx_uint** out_shape,
+               mx_uint* out_dim) {
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_iter_shape", "Os",
+                                      it->obj, which);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  it->shape.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i)
+    it->shape.push_back(
+        static_cast<mx_uint>(PyLong_AsLong(PyList_GetItem(res, i))));
+  Py_DECREF(res);
+  *out_shape = it->shape.data();
+  *out_dim = static_cast<mx_uint>(it->shape.size());
+  return 0;
+}
+
+}  // namespace
+
+MXNET_DLL int MXDataIterGetData(DataIterHandle h, const float** out,
+                                mx_uint* out_size) {
+  GilT gil;
+  return iter_fetch(static_cast<CIter*>(h), "data", out, out_size);
+}
+
+MXNET_DLL int MXDataIterGetLabel(DataIterHandle h, const float** out,
+                                 mx_uint* out_size) {
+  GilT gil;
+  return iter_fetch(static_cast<CIter*>(h), "label", out, out_size);
+}
+
+MXNET_DLL int MXDataIterGetDataShape(DataIterHandle h,
+                                     const mx_uint** out_shape,
+                                     mx_uint* out_dim) {
+  GilT gil;
+  return iter_shape(static_cast<CIter*>(h), "data", out_shape, out_dim);
+}
+
+MXNET_DLL int MXDataIterGetPadNum(DataIterHandle h, int* out) {
+  GilT gil;
+  auto* it = static_cast<CIter*>(h);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_iter_pad", "O", it->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
 // ---- KVStore (reference: c_api.h MXKVStoreCreate/Init/Push/Pull family) --
 
 struct CKV {

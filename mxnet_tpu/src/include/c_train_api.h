@@ -88,6 +88,26 @@ int MXExecutorLoadParams(ExecutorHandle exec, const char* path,
                          mx_uint* out_num_loaded);
 int MXExecutorFree(ExecutorHandle exec);
 
+/* ---- DataIter (reference: c_api.h MXListDataIters / MXDataIterCreateIter /
+ * Next / BeforeFirst / GetData / GetLabel / GetDataShape / GetPadNum) ----
+ * Params are strings, parsed by the iterator's schema (shapes like
+ * "(1,28,28)", numbers, booleans, paths). Data crosses as float32; pull
+ * pointers stay valid until the next fetch on the same handle. */
+typedef void* DataIterHandle;
+int MXListDataIters(mx_uint* out_size, const char*** out_array);
+int MXDataIterCreate(const char* name, mx_uint num_param, const char** keys,
+                     const char** vals, DataIterHandle* out);
+int MXDataIterFree(DataIterHandle iter);
+int MXDataIterNext(DataIterHandle iter, int* out);
+int MXDataIterBeforeFirst(DataIterHandle iter);
+int MXDataIterGetData(DataIterHandle iter, const float** out,
+                      mx_uint* out_size);
+int MXDataIterGetLabel(DataIterHandle iter, const float** out,
+                       mx_uint* out_size);
+int MXDataIterGetDataShape(DataIterHandle iter, const mx_uint** out_shape,
+                           mx_uint* out_dim);
+int MXDataIterGetPadNum(DataIterHandle iter, int* out);
+
 /* ---- KVStore (reference: c_api.h MXKVStoreCreate/Init/Push/Pull) ----
  * Values cross the boundary as float32 buffers; aggregation runs on the
  * framework's KVStore (same compute path as the Python surface). Pull

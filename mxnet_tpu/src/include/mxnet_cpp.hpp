@@ -292,6 +292,61 @@ class Optimizer {
   float lr_ = 0.01f, wd_ = 0.f, momentum_ = 0.f, rescale_ = 1.f;
 };
 
+// Data iterator over the framework's IO pipeline (reference: cpp-package
+// io.h MXDataIter — param-driven creation, Next/GetData/GetLabel loop).
+class DataIter {
+ public:
+  DataIter(const std::string& name,
+           const std::map<std::string, std::string>& params) {
+    std::vector<const char*> k, v;
+    for (auto& kv : params) {
+      k.push_back(kv.first.c_str());
+      v.push_back(kv.second.c_str());
+    }
+    DataIterHandle h = nullptr;
+    Check(MXDataIterCreate(name.c_str(), static_cast<mx_uint>(k.size()),
+                           k.data(), v.data(), &h),
+          name.c_str());
+    h_ = std::shared_ptr<void>(h, [](DataIterHandle p) {
+      if (p) MXDataIterFree(p);
+    });
+  }
+  bool Next() {
+    int has = 0;
+    Check(MXDataIterNext(h_.get(), &has), "DataIterNext");
+    return has != 0;
+  }
+  void BeforeFirst() {
+    Check(MXDataIterBeforeFirst(h_.get()), "BeforeFirst");
+  }
+  std::vector<float> GetData() {
+    const float* p = nullptr;
+    mx_uint n = 0;
+    Check(MXDataIterGetData(h_.get(), &p, &n), "GetData");
+    return std::vector<float>(p, p + n);
+  }
+  std::vector<float> GetLabel() {
+    const float* p = nullptr;
+    mx_uint n = 0;
+    Check(MXDataIterGetLabel(h_.get(), &p, &n), "GetLabel");
+    return std::vector<float>(p, p + n);
+  }
+  std::vector<mx_uint> GetDataShape() {
+    const mx_uint* shape = nullptr;
+    mx_uint ndim = 0;
+    Check(MXDataIterGetDataShape(h_.get(), &shape, &ndim), "GetDataShape");
+    return std::vector<mx_uint>(shape, shape + ndim);
+  }
+  int GetPadNum() {
+    int pad = 0;
+    Check(MXDataIterGetPadNum(h_.get(), &pad), "GetPadNum");
+    return pad;
+  }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
 class KVStore {
  public:
   explicit KVStore(const std::string& type = "local") {

@@ -264,6 +264,74 @@ def test_cpp_package_trains_and_interchanges(tmp_path):
     assert acc > 0.85, acc
 
 
+DATAITER_CPP = r"""
+#include <cmath>
+#include <cstdio>
+#include <vector>
+
+#include "mxnet_cpp.hpp"
+
+namespace mx = mxnet::cpp;
+
+int main(int argc, char** argv) {
+  if (argc < 3) return 2;
+  // list the registered iterators
+  mx_uint n = 0;
+  const char** names = nullptr;
+  if (MXListDataIters(&n, &names) != 0) return 3;
+  bool has_csv = false;
+  for (mx_uint i = 0; i < n; ++i)
+    if (std::string(names[i]) == "CSVIter") has_csv = true;
+  if (!has_csv) return 4;
+
+  mx::DataIter it("CSVIter", {{"data_csv", argv[1]},
+                              {"label_csv", argv[2]},
+                              {"data_shape", "(4,)"},
+                              {"batch_size", "3"},
+                              {"round_batch", "true"}});
+  int batches = 0, last_pad = -1;
+  double first_sum = -1;
+  while (it.Next()) {
+    auto data = it.GetData();
+    auto label = it.GetLabel();
+    auto shape = it.GetDataShape();
+    if (shape.size() != 2 || shape[0] != 3 || shape[1] != 4) return 5;
+    if (label.size() != 3) return 6;
+    if (batches == 0) {
+      first_sum = 0;
+      for (float v : data) first_sum += v;
+    }
+    last_pad = it.GetPadNum();
+    ++batches;
+  }
+  // 8 rows / batch 3 -> 3 batches, last padded by 1
+  std::printf("BATCHES %d PAD %d\n", batches, last_pad);
+  it.BeforeFirst();
+  it.Next();
+  double again = 0;
+  for (float v : it.GetData()) again += v;
+  if (std::fabs(again - first_sum) > 1e-4) return 7;
+  std::printf("RESET-OK\n");
+  return batches == 3 ? 0 : 8;
+}
+"""
+
+
+@needs_toolchain
+def test_cpp_dataiter_csv(tmp_path):
+    rows = np.arange(32, dtype=np.float32).reshape(8, 4)
+    labels = np.arange(8, dtype=np.float32)
+    data_csv = tmp_path / "data.csv"
+    label_csv = tmp_path / "label.csv"
+    np.savetxt(data_csv, rows, delimiter=",", fmt="%.1f")
+    np.savetxt(label_csv, labels, delimiter=",", fmt="%.1f")
+    exe = _compile(tmp_path, "cpp_dataiter", DATAITER_CPP)
+    r = _run(exe, [str(data_csv), str(label_csv)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "BATCHES 3 PAD 1" in r.stdout
+    assert "RESET-OK" in r.stdout
+
+
 @needs_toolchain
 def test_cpp_kvstore(tmp_path):
     exe = _compile(tmp_path, "cpp_kvstore", KVSTORE_CPP)
