@@ -11,6 +11,8 @@
 #ifndef MXTPU_C_TRAIN_API_H_
 #define MXTPU_C_TRAIN_API_H_
 
+#include <stddef.h>
+
 #ifdef __cplusplus
 extern "C" {
 #endif
@@ -123,6 +125,24 @@ int MXKVStorePush(KVStoreHandle kv, int key, const float* data,
                   const mx_uint* shape, mx_uint ndim);
 int MXKVStorePull(KVStoreHandle kv, int key, const float** out,
                   mx_uint* out_size);
+
+/* ---- RecordIO (reference: c_api.h MXRecordIOWriterCreate/WriteRecord/
+ * Tell, MXRecordIOReaderCreate/ReadRecord/Seek) ----
+ * Pure C++ (c_api_recordio.cc) — the reference wire format, byte-
+ * interchanging with recordio.py, the native sharded reader, and the
+ * reference itself. ReadRecord returns 0 with *out_buf=NULL at EOF; the
+ * pointer stays valid until the next read on the same handle. */
+typedef void* RecordIOHandle;
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOWriterFree(RecordIOHandle h);
+int MXRecordIOWriterWriteRecord(RecordIOHandle h, const char* buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle h, size_t* pos);
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOReaderFree(RecordIOHandle h);
+int MXRecordIOReaderReadRecord(RecordIOHandle h, const char** out_buf,
+                               size_t* out_size);
+int MXRecordIOReaderSeek(RecordIOHandle h, size_t pos);
 
 #ifdef __cplusplus
 }

@@ -16,6 +16,8 @@
 // (or any C caller) one record at a time.
 
 #include <cstdint>
+
+#include "include/recordio_wire.h"
 #include <cstdio>
 #include <cstring>
 #include <condition_variable>
@@ -32,7 +34,7 @@ void mxt_free(void* p, size_t nbytes);
 
 namespace mxt {
 
-static const uint32_t kMagic = 0xced7230a;
+using mxt_wire::kMagic;
 
 struct Record {
   char* data;
@@ -120,7 +122,7 @@ class RecReader {
       size_t off = out->size();
       out->resize(off + len);
       if (len && fread(&(*out)[off], 1, len, f_) != len) return false;
-      size_t pad = (4 - len % 4) % 4;
+      size_t pad = mxt_wire::pad_of(len);
       if (pad) fseek(f_, pad, SEEK_CUR);
       if (cflag == 0 || cflag == 2) return true;
     }

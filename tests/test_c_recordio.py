@@ -1,0 +1,171 @@
+"""RecordIO C API tests (src/c_api_recordio.cc — the reference's
+MXRecordIO* family in pure C++): byte interchange with the Python
+recordio.py implementation in both directions, including chunk-split
+records and Tell/Seek round-trips.
+"""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(ROOT, "mxnet_tpu", "src")
+
+needs_toolchain = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("python3-config") is None,
+    reason="no C++ toolchain")
+
+
+def _build_shim():
+    r = subprocess.run(["make", "c_predict"], cwd=SRC, capture_output=True,
+                       text=True)
+    if r.returncode != 0:
+        pytest.skip("shim build failed: %s" % r.stderr[-500:])
+    return os.path.join(SRC, "build", "libmxtpu_predict.so")
+
+
+CLIENT_CPP = r"""
+// argv: mode(out|in) path. out: writes fixed records + prints tell
+// positions. in: reads records, prints lengths and first bytes.
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "c_train_api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) return 2;
+  std::string mode = argv[1];
+  if (mode == "out") {
+    RecordIOHandle w = nullptr;
+    if (MXRecordIOWriterCreate(argv[2], &w) != 0) return 3;
+    const char* recs[3] = {"hello", "", "recordio-interchange!"};
+    for (int i = 0; i < 3; ++i) {
+      size_t pos = 0;
+      if (MXRecordIOWriterTell(w, &pos) != 0) return 4;
+      std::printf("TELL %zu\n", pos);
+      if (MXRecordIOWriterWriteRecord(w, recs[i], strlen(recs[i])) != 0)
+        return 5;
+    }
+    MXRecordIOWriterFree(w);
+    return 0;
+  }
+  RecordIOHandle r = nullptr;
+  if (MXRecordIOReaderCreate(argv[2], &r) != 0) return 6;
+  for (;;) {
+    const char* buf = nullptr;
+    size_t n = 0;
+    if (MXRecordIOReaderReadRecord(r, &buf, &n) != 0) return 7;
+    if (!buf) break;
+    std::printf("REC %zu %.12s\n", n, n ? buf : "");
+  }
+  MXRecordIOReaderFree(r);
+  return 0;
+}
+"""
+
+
+def _compile(tmp_path):
+    lib = _build_shim()
+    src = tmp_path / "client.cpp"
+    src.write_text(CLIENT_CPP)
+    exe = str(tmp_path / "client")
+    r = subprocess.run(
+        ["g++", "-std=c++17", "-I", os.path.join(SRC, "include"), str(src),
+         "-o", exe, "-L", os.path.dirname(lib), "-lmxtpu_predict",
+         "-Wl,-rpath," + os.path.dirname(lib)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return exe
+
+
+def _run(exe, args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run([exe, *args], capture_output=True, text=True,
+                          env=env, timeout=120)
+
+
+@needs_toolchain
+def test_cpp_writes_python_reads(tmp_path):
+    from mxnet_tpu.recordio import MXRecordIO
+
+    exe = _compile(tmp_path)
+    rec = str(tmp_path / "c.rec")
+    r = _run(exe, ["out", rec])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    tells = [int(l.split()[1]) for l in r.stdout.splitlines()
+             if l.startswith("TELL")]
+    assert tells[0] == 0 and tells[1] > 0
+
+    reader = MXRecordIO(rec, "r")
+    got = []
+    while True:
+        item = reader.read()
+        if item is None:
+            break
+        got.append(bytes(item))
+    reader.close()
+    assert got == [b"hello", b"", b"recordio-interchange!"]
+
+
+@needs_toolchain
+def test_python_writes_cpp_reads(tmp_path):
+    from mxnet_tpu.recordio import MXRecordIO
+
+    rec = str(tmp_path / "py.rec")
+    w = MXRecordIO(rec, "w")
+    w.write(b"alpha")
+    w.write(b"x" * 1000)
+    w.close()
+
+    exe = _compile(tmp_path)
+    r = _run(exe, ["in", rec])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    lines = [l for l in r.stdout.splitlines() if l.startswith("REC")]
+    assert lines[0] == "REC 5 alpha"
+    assert lines[1].startswith("REC 1000 xxxxxxxxxxxx")
+
+
+@needs_toolchain
+def test_chunked_record_roundtrip(tmp_path, monkeypatch):
+    """The reader must reassemble first/middle/last chunks. The C writer
+    only splits past 2^29 bytes (too big for a test), so write the chunked
+    form with a tiny local encoder following the spec, then read it back
+    through the C reader."""
+    import struct
+
+    payload = bytes(range(256)) * 5  # 1280 bytes, split at 512
+    magic = 0xCED7230A
+    out = b""
+    chunks = [payload[i:i + 512] for i in range(0, len(payload), 512)]
+    for i, c in enumerate(chunks):
+        if len(chunks) == 1:
+            cflag = 0
+        elif i == 0:
+            cflag = 1
+        elif i == len(chunks) - 1:
+            cflag = 2
+        else:
+            cflag = 3
+        out += struct.pack("<II", magic, (cflag << 29) | len(c)) + c
+        out += b"\x00" * ((4 - len(c) % 4) % 4)
+    rec = tmp_path / "chunked.rec"
+    rec.write_bytes(out)
+
+    exe = _compile(tmp_path)
+    r = _run(exe, ["in", str(rec)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    lines = [l for l in r.stdout.splitlines() if l.startswith("REC")]
+    assert len(lines) == 1
+    assert lines[0].split()[1] == "1280"
+
+    # truncation mid-record must be an ERROR, not a silent clean EOF
+    # (drop the last chunk: everything after the first chunk's frame)
+    truncated = tmp_path / "truncated.rec"
+    truncated.write_bytes(out[: 8 + 512])
+    r = _run(exe, ["in", str(truncated)])
+    assert r.returncode == 7, (r.returncode, r.stdout)
