@@ -218,6 +218,54 @@ def _c_sgd_update(cexec, lr, wd, rescale=1.0):
         w[:] = w - lr * (rescale * grad + wd * w)
 
 
+# ---- Profiler (reference: c_api.h MXSetProfilerConfig/MXSetProfilerState/
+# MXDumpProfile) -------------------------------------------------------------
+
+def _c_profiler_set_config(mode, filename):
+    from . import profiler
+
+    profiler.profiler_set_config(mode=mode, filename=filename)
+
+
+def _c_profiler_set_state(state):
+    from . import profiler
+
+    # the reference's C form takes 0/1; accept both that and the strings
+    if state in (0, 1):
+        state = "run" if state else "stop"
+    profiler.profiler_set_state(state)
+
+
+def _c_dump_profile():
+    from . import profiler
+
+    profiler.dump_profile()
+
+
+# ---- Rtc (reference: c_api.h MXRtcCreate/MXRtcPush/MXRtcFree) --------------
+
+def _c_rtc_create(name, input_names, output_names, kernel):
+    from .rtc import Rtc
+
+    # the C boundary carries names only; arrays bind at push time
+    return Rtc(name, [(n, None) for n in input_names],
+               [(n, None) for n in output_names], kernel)
+
+
+def _c_rtc_push(rtc, input_blobs, input_shapes, output_shapes):
+    """inputs as float32 bytes + shapes; returns list of output bytes."""
+    from . import ndarray as nd
+
+    ins = []
+    for blob, shape in zip(input_blobs, input_shapes):
+        flat = np.frombuffer(blob, dtype=np.float32)
+        ins.append(nd.array(flat.reshape([int(d) for d in shape])))
+    outs = [nd.zeros(tuple(int(d) for d in s)) for s in output_shapes]
+    rtc.push(ins, outs)
+    return [np.ascontiguousarray(o.asnumpy().astype(np.float32)).tobytes()
+            for o in outs]
+
+
 # ---- DataIter (reference: c_api.h MXListDataIters/MXDataIterCreateIter/
 # Next/GetData/GetLabel/GetPadNum family) ------------------------------------
 

@@ -20,7 +20,8 @@
 
 #define MXNET_DLL extern "C" __attribute__((visibility("default")))
 
-void mxtpu_set_last_error(const std::string& msg);  // c_predict_api.cc
+void mxtpu_set_last_error(const std::string& msg);   // c_predict_api.cc
+void mxtpu_set_train_error(const std::string& msg);  // c_api_train.cc
 
 namespace {
 
@@ -34,7 +35,10 @@ struct RecIO {
 };
 
 int fail(const char* msg) {
+  // both error channels: the header documents MXTrainGetLastError, and the
+  // predict shim's MXGetLastError is the reference's canonical accessor
   mxtpu_set_last_error(msg);
+  mxtpu_set_train_error(msg);
   return -1;
 }
 
@@ -114,8 +118,10 @@ MXNET_DLL int MXRecordIOReaderReadRecord(RecordIOHandle h,
   bool mid_record = false;
   for (;;) {
     uint32_t hdr[2];
-    size_t got = std::fread(hdr, 4, 2, r->f);
-    if (got != 2) {
+    // byte-granular read so a 1-7-byte trailing fragment is distinguishable
+    // from a cleanly absent header
+    size_t got = std::fread(hdr, 1, 8, r->f);
+    if (got != 8) {
       // clean EOF only at a record boundary with a fully-absent header;
       // a partial header or EOF between chunks is data loss, not EOF
       if (got == 0 && !mid_record && std::feof(r->f)) {

@@ -29,6 +29,12 @@ thread_local std::string g_last_error_train;
 
 void mxtpu_promote_libpython();  // c_predict_api.cc (libpython RTLD_GLOBAL)
 
+// pure-C++ API files (c_api_recordio.cc) report through the train-error
+// channel this header documents, without touching Python
+void mxtpu_set_train_error(const std::string& msg) {
+  g_last_error_train = msg;
+}
+
 namespace {
 
 struct GilT {
@@ -164,6 +170,7 @@ MXNET_DLL int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json) {
 }
 
 MXNET_DLL int MXSymbolFree(SymbolHandle sym) {
+  if (!sym) return 0;
   GilT gil;
   auto* s = static_cast<CSym*>(sym);
   Py_XDECREF(s->obj);
@@ -206,6 +213,7 @@ MXNET_DLL int MXExecutorSimpleBindLite(SymbolHandle sym, const char* dev_type,
 }
 
 MXNET_DLL int MXExecutorFree(ExecutorHandle h) {
+  if (!h) return 0;
   GilT gil;
   auto* e = static_cast<CExec*>(h);
   Py_XDECREF(e->obj);
@@ -477,6 +485,152 @@ MXNET_DLL int MXExecutorLoadParams(ExecutorHandle h, const char* path,
   return 0;
 }
 
+// ---- Profiler (reference: c_api.h MXSetProfilerConfig/State/MXDumpProfile)
+
+MXNET_DLL int MXSetProfilerConfig(const char* mode, const char* filename) {
+  GilT gil;
+  PyObject* res = PyObject_CallMethod(
+      train_module(), "_c_profiler_set_config", "ss", mode, filename);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXSetProfilerState(int state) {
+  GilT gil;
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_profiler_set_state",
+                                      "i", state);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXDumpProfile() {
+  GilT gil;
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_dump_profile", NULL);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---- Rtc (reference: c_api.h MXRtcCreate/MXRtcPush/MXRtcFree) ------------
+
+struct CRtc {
+  PyObject* obj;
+  std::vector<std::vector<char>> out_blobs;
+};
+
+MXNET_DLL int MXRtcCreate(const char* name, mx_uint num_input,
+                          mx_uint num_output, const char** input_names,
+                          const char** output_names, const char* kernel,
+                          RtcHandle* out) {
+  GilT gil;
+  PyObject* mod = train_module();
+  if (!mod) return fail();
+  PyObject* ins = PyList_New(num_input);
+  PyObject* outs = PyList_New(num_output);
+  for (mx_uint i = 0; i < num_input; ++i)
+    PyList_SetItem(ins, i, PyUnicode_FromString(input_names[i]));
+  for (mx_uint i = 0; i < num_output; ++i)
+    PyList_SetItem(outs, i, PyUnicode_FromString(output_names[i]));
+  PyObject* res = PyObject_CallMethod(mod, "_c_rtc_create", "sOOs", name,
+                                      ins, outs, kernel);
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = new CRtc{res, {}};
+  return 0;
+}
+
+MXNET_DLL int MXRtcFree(RtcHandle h) {
+  if (!h) return 0;
+  GilT gil;
+  auto* r = static_cast<CRtc*>(h);
+  Py_XDECREF(r->obj);
+  delete r;
+  return 0;
+}
+
+// inputs/outputs as float32 buffers with CSR-packed shapes (the
+// simple_bind convention); output buffers are returned through out_blobs
+// and stay valid until the next push on the same handle
+MXNET_DLL int MXRtcPush(RtcHandle h, mx_uint num_input,
+                        const float** input_data,
+                        const mx_uint* input_shape_data,
+                        const mx_uint* input_shape_idx, mx_uint num_output,
+                        const mx_uint* output_shape_data,
+                        const mx_uint* output_shape_idx,
+                        const float** out_data, mx_uint* out_sizes) {
+  GilT gil;
+  auto* r = static_cast<CRtc*>(h);
+  PyObject* blobs = PyList_New(num_input);
+  PyObject* ishapes = PyList_New(num_input);
+  for (mx_uint i = 0; i < num_input; ++i) {
+    mx_uint lo = input_shape_idx[i], hi = input_shape_idx[i + 1];
+    size_t n = 1;
+    PyObject* dims = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      n *= input_shape_data[j];
+      PyList_SetItem(dims, j - lo,
+                     PyLong_FromUnsignedLong(input_shape_data[j]));
+    }
+    PyList_SetItem(ishapes, i, dims);
+    PyList_SetItem(blobs, i,
+                   PyBytes_FromStringAndSize(
+                       reinterpret_cast<const char*>(input_data[i]),
+                       n * sizeof(float)));
+  }
+  PyObject* oshapes = PyList_New(num_output);
+  for (mx_uint i = 0; i < num_output; ++i) {
+    mx_uint lo = output_shape_idx[i], hi = output_shape_idx[i + 1];
+    PyObject* dims = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(dims, j - lo,
+                     PyLong_FromUnsignedLong(output_shape_data[j]));
+    PyList_SetItem(oshapes, i, dims);
+  }
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_rtc_push", "OOOO",
+                                      r->obj, blobs, ishapes, oshapes);
+  Py_DECREF(blobs);
+  Py_DECREF(ishapes);
+  Py_DECREF(oshapes);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  r->out_blobs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(PyList_GetItem(res, i), &buf, &len) != 0) {
+      Py_DECREF(res);
+      set_err();
+      return fail();
+    }
+    r->out_blobs.emplace_back(buf, buf + len);
+  }
+  Py_DECREF(res);
+  for (mx_uint i = 0; i < num_output && i < r->out_blobs.size(); ++i) {
+    out_data[i] = reinterpret_cast<const float*>(r->out_blobs[i].data());
+    out_sizes[i] =
+        static_cast<mx_uint>(r->out_blobs[i].size() / sizeof(float));
+  }
+  return 0;
+}
+
 // ---- DataIter (reference: c_api.h MXListDataIters/MXDataIterCreateIter/
 // MXDataIterNext/GetData/GetLabel/GetPadNum) -------------------------------
 
@@ -518,6 +672,7 @@ MXNET_DLL int MXDataIterCreate(const char* name, mx_uint num_param,
 }
 
 MXNET_DLL int MXDataIterFree(DataIterHandle h) {
+  if (!h) return 0;
   GilT gil;
   auto* it = static_cast<CIter*>(h);
   Py_XDECREF(it->obj);
@@ -635,6 +790,7 @@ MXNET_DLL int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
 }
 
 MXNET_DLL int MXKVStoreFree(KVStoreHandle h) {
+  if (!h) return 0;
   GilT gil;
   auto* kv = static_cast<CKV*>(h);
   Py_XDECREF(kv->obj);

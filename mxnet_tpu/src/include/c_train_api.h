@@ -126,6 +126,29 @@ int MXKVStorePush(KVStoreHandle kv, int key, const float* data,
 int MXKVStorePull(KVStoreHandle kv, int key, const float** out,
                   mx_uint* out_size);
 
+/* ---- Profiler (reference: c_api.h MXSetProfilerConfig/State/DumpProfile)
+ * mode: "symbolic" | "all"; state: 0 stop, 1 run. Dump writes the
+ * chrome-trace JSON configured by MXSetProfilerConfig. */
+int MXSetProfilerConfig(const char* mode, const char* filename);
+int MXSetProfilerState(int state);
+int MXDumpProfile(void);
+
+/* ---- Rtc (reference: c_api.h MXRtcCreate/Push/Free) ----
+ * Runtime-compiled kernels: the kernel body is the framework's rtc dialect
+ * (jax/jnp/lax/pallas in scope; reference used CUDA source). Buffers are
+ * float32; shapes CSR-packed like simple_bind. Output pointers stay valid
+ * until the next push on the same handle. */
+typedef void* RtcHandle;
+int MXRtcCreate(const char* name, mx_uint num_input, mx_uint num_output,
+                const char** input_names, const char** output_names,
+                const char* kernel, RtcHandle* out);
+int MXRtcPush(RtcHandle h, mx_uint num_input, const float** input_data,
+              const mx_uint* input_shape_data, const mx_uint* input_shape_idx,
+              mx_uint num_output, const mx_uint* output_shape_data,
+              const mx_uint* output_shape_idx, const float** out_data,
+              mx_uint* out_sizes);
+int MXRtcFree(RtcHandle h);
+
 /* ---- RecordIO (reference: c_api.h MXRecordIOWriterCreate/WriteRecord/
  * Tell, MXRecordIOReaderCreate/ReadRecord/Seek) ----
  * Pure C++ (c_api_recordio.cc) — the reference wire format, byte-

@@ -169,3 +169,10 @@ def test_chunked_record_roundtrip(tmp_path, monkeypatch):
     truncated.write_bytes(out[: 8 + 512])
     r = _run(exe, ["in", str(truncated)])
     assert r.returncode == 7, (r.returncode, r.stdout)
+
+    # a 1-3 byte header fragment after a valid record is ALSO data loss
+    # (sub-item freads would report it as got==0, i.e. clean EOF)
+    frag = tmp_path / "fragment.rec"
+    frag.write_bytes(out + b"\x0a\x23\xd7")
+    r = _run(exe, ["in", str(frag)])
+    assert r.returncode == 7, (r.returncode, r.stdout)
