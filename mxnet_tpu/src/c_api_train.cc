@@ -104,8 +104,20 @@ int list_strings(PyObject* res, mx_uint* out_size, const char*** out_array) {
   thread_local std::vector<const char*> ptrs;
   names.clear();
   ptrs.clear();
-  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i)
-    names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(res, i)));
+  if (!PyList_Check(res)) {
+    Py_DECREF(res);
+    mxtpu_set_train_error("list_strings: helper did not return a list");
+    return fail();
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(res, i));
+    if (!s) {
+      Py_DECREF(res);
+      set_err();
+      return fail();
+    }
+    names.emplace_back(s);
+  }
   Py_DECREF(res);
   for (auto& n : names) ptrs.push_back(n.c_str());
   *out_size = static_cast<mx_uint>(names.size());
@@ -612,6 +624,13 @@ MXNET_DLL int MXRtcPush(RtcHandle h, mx_uint num_input,
     return fail();
   }
   r->out_blobs.clear();
+  if (!PyList_Check(res) ||
+      PyList_Size(res) != static_cast<Py_ssize_t>(num_output)) {
+    Py_DECREF(res);
+    mxtpu_set_train_error(
+        "MXRtcPush: kernel returned wrong number of output blobs");
+    return fail();
+  }
   for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
     char* buf = nullptr;
     Py_ssize_t len = 0;
@@ -620,10 +639,20 @@ MXNET_DLL int MXRtcPush(RtcHandle h, mx_uint num_input,
       set_err();
       return fail();
     }
+    size_t expect = sizeof(float);
+    for (mx_uint j = output_shape_idx[i]; j < output_shape_idx[i + 1]; ++j)
+      expect *= output_shape_data[j];
+    if (static_cast<size_t>(len) != expect) {
+      Py_DECREF(res);
+      mxtpu_set_train_error(
+          "MXRtcPush: output blob byte length does not match its declared "
+          "shape");
+      return fail();
+    }
     r->out_blobs.emplace_back(buf, buf + len);
   }
   Py_DECREF(res);
-  for (mx_uint i = 0; i < num_output && i < r->out_blobs.size(); ++i) {
+  for (mx_uint i = 0; i < num_output; ++i) {
     out_data[i] = reinterpret_cast<const float*>(r->out_blobs[i].data());
     out_sizes[i] =
         static_cast<mx_uint>(r->out_blobs[i].size() / sizeof(float));

@@ -273,3 +273,35 @@ def test_convert_model_folds_bn_scale(tmp_path):
     e = np.exp(relu - relu.max(axis=1, keepdims=True))
     expect = e / e.sum(axis=1, keepdims=True)
     np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_bn_relu_scale_not_folded_through_activation():
+    # caffe applies Scale AFTER the ReLU here; folding it into the BatchNorm
+    # would move the affine before the activation — must refuse, not mis-fold
+    with pytest.raises(ValueError, match="standalone Scale"):
+        convert_symbol(HEADER + """
+        layer { name: "bn" type: "BatchNorm" bottom: "data" top: "bn" }
+        layer { name: "r" type: "ReLU" bottom: "bn" top: "r" }
+        layer { name: "s" type: "Scale" bottom: "r" top: "s" }
+        """)
+
+
+def test_bn_scale_folds_through_inference_identity_layers():
+    # Dropout is identity at deploy time: BN -> Dropout -> Scale still folds
+    sym, _, _ = convert_symbol(HEADER + """
+    layer { name: "bn" type: "BatchNorm" bottom: "data" top: "bn" }
+    layer { name: "d" type: "Dropout" bottom: "bn" top: "d" }
+    layer { name: "s" type: "Scale" bottom: "d" top: "s" }
+    """)
+    args = set(sym.list_arguments())
+    assert "bn_gamma" in args and "bn_beta" in args
+
+
+def test_multi_input_layer_missing_bottom_raises():
+    # a Concat whose branch was never produced must raise, not silently
+    # shrink its input list
+    with pytest.raises(ValueError, match="silently-wrong"):
+        convert_symbol(HEADER + """
+        layer { name: "c" type: "Concat" bottom: "data" bottom: "ghost"
+          top: "c" }
+        """)

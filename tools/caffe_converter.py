@@ -334,19 +334,38 @@ def _get_layers(net):
 
 def _bn_scale_map(layers):
     """Scale-layer name -> the BatchNorm layer it folds into (caffe couples
-    BatchNorm [stats] + Scale [affine]; in-place ReLU/Dropout/Split between
-    them do not break the pairing)."""
+    BatchNorm [stats] + Scale [affine]).
+
+    Pairing is by dataflow, not prototxt order: the Scale's bottom must be
+    the tensor the BatchNorm produced, threaded only through layers that are
+    identity at inference (Split, deploy-time Dropout). An intervening ReLU
+    (or any other real op) breaks the pairing — folding the affine through
+    it would change semantics (caffe applies Scale after the activation)."""
     m = {}
-    prev_bn = None
+    bn_tensors = {}  # tensor name -> BatchNorm layer whose raw output it is
     for ltype, l in layers:
+        name = _one(l, "name", "")
+        bottoms, tops = _all(l, "bottom"), _all(l, "top")
+        if ltype == "BatchNorm":
+            for t in (tops or [name]):
+                bn_tensors[t] = name
+            continue
         if ltype == "Scale":
-            if prev_bn is not None:
-                m[_one(l, "name", "")] = prev_bn
-            prev_bn = None
-        elif ltype == "BatchNorm":
-            prev_bn = _one(l, "name", "")
-        elif ltype not in ("Split", "ReLU", "Dropout"):
-            prev_bn = None
+            if bottoms and bottoms[0] in bn_tensors:
+                # pop: a BN output can absorb at most one affine
+                m[name] = bn_tensors.pop(bottoms[0])
+            continue
+        if ltype in ("Split", "Dropout") and bottoms \
+                and bottoms[0] in bn_tensors:
+            # identity at inference: every top is still the BN's raw output
+            bn = bn_tensors[bottoms[0]]
+            for t in tops:
+                bn_tensors[t] = bn
+            continue
+        # a real op: any tensor it writes (in-place included) is no longer
+        # a raw BN output
+        for t in tops:
+            bn_tensors.pop(t, None)
     return m
 
 
@@ -399,7 +418,22 @@ def _build_symbol(net, layers):
         if ltype in _DATA_LAYER_TYPES:
             continue
         name = _one(l, "name", "")
-        bottoms = [blobs[b] for b in _all(l, "bottom") if b in blobs]
+        declared = _all(l, "bottom")
+        missing = [b for b in declared if b not in blobs]
+        # the only bottom a layer may legitimately shed is a loss/eval
+        # layer's label, fed by a TEST-phase data layer we skipped — and the
+        # label is never the first bottom. Anything else (a typo'd bottom, a
+        # skipped branch of Concat/Eltwise) would silently detach part of
+        # the network.
+        sheddable = "Loss" in ltype or ltype == "Accuracy"
+        bad = [b for b in missing
+               if not (sheddable and declared and b != declared[0])]
+        if bad:
+            raise ValueError(
+                "%s layer %r: bottom(s) %r are not produced by any converted "
+                "layer — refusing to build a silently-wrong network"
+                % (ltype, name, bad))
+        bottoms = [blobs[b] for b in declared if b in blobs]
         tops = _all(l, "top") or [name]
         if ltype == "Scale" and name not in scale_to_bn:
             raise ValueError(
