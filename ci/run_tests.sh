@@ -26,6 +26,12 @@ run_predict() {
   python -m pytest tests/test_c_predict.py tests/test_c_train.py -x -q
 }
 
+run_predict_native() {
+  # Python-free deployment: .mxa AOT export + PJRT C API runtime
+  make -C mxnet_tpu/src c_predict_native
+  python -m pytest tests/test_predict_native.py -x -q
+}
+
 run_entry() {
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.entry(); g.dryrun_multichip(8); print('entry ok')"
@@ -115,12 +121,13 @@ case "$stage" in
   unit) run_unit ;;
   native) run_native ;;
   predict) run_predict ;;
+  predict_native) run_predict_native ;;
   entry) run_entry ;;
   bench) run_bench ;;
   tpu) run_tpu ;;
   examples) run_examples ;;
-  all) run_native; run_predict; run_entry;
+  all) run_native; run_predict; run_predict_native; run_entry;
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
-                --ignore=tests/test_c_predict.py ;;
-  *) echo "unknown stage: $stage (unit|native|predict|entry|bench|tpu|examples|all)"; exit 2 ;;
+                --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py ;;
+  *) echo "unknown stage: $stage (unit|native|predict|predict_native|entry|bench|tpu|examples|all)"; exit 2 ;;
 esac

@@ -66,6 +66,8 @@ symbol.contrib = contrib.symbol
 
 from . import engine
 from . import operator
+from . import export_artifact
+from .export_artifact import export_predict_artifact
 
 # Custom registers into the op registry after symbol/ndarray generated their
 # functions at import — generate its wrappers explicitly

@@ -1,0 +1,243 @@
+"""Python-free deployment artifacts (the amalgamation analog).
+
+Reference: ``amalgamation/README.md:1-13`` + ``src/c_api/c_predict_api.cc:1``
+— the reference's predict stack exists so models run where the framework
+does not (single-file build, JNI/mobile targets). The TPU-native equivalent
+is an ahead-of-time *export*: the bound graph is lowered to StableHLO with
+``jax.export`` and written into a single ``.mxa`` container together with
+the parameters (reference ``.params`` wire format) and a JSON manifest.
+``libmxtpu_predict_native.so`` (src/c_predict_pjrt.cc) then loads the
+artifact through any PJRT plugin (``libtpu.so`` on TPU hosts) with **no
+Python anywhere in the process** — the deployment substrate the reference's
+amalgamation provided.
+
+Container layout (little-endian)::
+
+    8 bytes   magic "MXTPUAR1"
+    u64 n     manifest length   | n bytes of JSON (see below)
+    u64 n     program length    | n bytes of StableHLO portable bytecode
+    u64 n     params length     | n bytes of NDArray-dict save format
+                                  (magic 0x112; keys "arg:NAME"/"aux:NAME")
+
+The exported StableHLO function's flat argument order is
+``inputs... , args..., auxs...`` exactly as listed in the manifest; outputs
+follow ``symbol.list_outputs()``.
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .executor import build_graph_fn
+
+MAGIC = b"MXTPUAR1"
+
+__all__ = ["export_predict_artifact", "load_artifact_manifest", "MAGIC"]
+
+
+def _shape_of(x):
+    return tuple(int(d) for d in x.shape)
+
+
+def export_predict_artifact(symbol, arg_params, aux_params, input_shapes,
+                            path, platform="tpu", dtype="float32",
+                            matmul_precision="highest"):
+    """AOT-export ``symbol``'s inference forward into a ``.mxa`` file.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        The network. Outputs follow ``symbol.list_outputs()``.
+    arg_params, aux_params : dict[str, NDArray | np.ndarray]
+        Trained parameters (``Module.get_params()`` /
+        ``model.load_checkpoint`` shapes).
+    input_shapes : dict[str, tuple]
+        Shapes for the data inputs (e.g. ``{"data": (1, 3, 224, 224)}``).
+        Label inputs of loss heads are auto-inferred and marked
+        ``"kind": "label"`` in the manifest; the native runtime feeds them
+        zeros unless the client sets them.
+    path : str
+        Output file. Convention: ``model.mxa``.
+    platform : str
+        Lowering platform for ``jax.export`` (``"tpu"`` or ``"cpu"``). The
+        plain conv/matmul StableHLO this framework emits is
+        platform-neutral; the tag only gates jax's own runtime check.
+    matmul_precision : str
+        jax matmul precision baked into the module. ``"highest"`` keeps
+        fp32 accuracy on the MXU (3-pass bf16) so native outputs match the
+        Python executor tightly; use ``"default"`` for speed.
+    """
+    import jax
+
+    graph_fn, arg_names, aux_names = build_graph_fn(symbol)
+
+    arg_params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+                  for k, v in (arg_params or {}).items()}
+    aux_params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+                  for k, v in (aux_params or {}).items()}
+
+    input_names = [n for n in arg_names if n not in arg_params]
+    param_names = [n for n in arg_names if n in arg_params]
+    missing_aux = [n for n in aux_names if n not in aux_params]
+    if missing_aux:
+        raise MXNetError("missing aux params: %s" % missing_aux)
+
+    # resolve shapes: caller gives data shapes, label heads are inferred
+    # (reference MXPredCreate also takes only data shapes)
+    shapes = {n: tuple(s) for n, s in input_shapes.items()}
+    unknown = [n for n in input_names if n not in shapes]
+    kinds = {n: "data" for n in shapes}
+    # only label-named inputs may be auto-inferred and zero-fed (reference
+    # convention: loss heads call theirs <name>_label / `label`). Anything
+    # else without a shape is almost certainly a parameter missing from
+    # arg_params — exporting it as a zero input would be silently wrong.
+    inferable = [n for n in unknown
+                 if n == "label" or n.endswith("_label")]
+    not_label = [n for n in unknown if n not in inferable]
+    if not_label:
+        raise MXNetError(
+            "arguments %s have neither a value in arg_params nor a shape in "
+            "input_shapes; if they are network inputs pass their shapes, if "
+            "they are parameters add them to arg_params" % not_label)
+    if inferable:
+        inferred, _, _ = symbol.infer_shape_partial(**shapes)
+        for n, shp in zip(arg_names, inferred):
+            if n in inferable and shp is not None and 0 not in tuple(shp):
+                shapes[n] = tuple(shp)
+                kinds[n] = "label"
+        unknown = [n for n in input_names if n not in shapes]
+        if unknown:
+            raise MXNetError("cannot infer shapes for inputs %s" % unknown)
+    bad = [n for n in input_shapes if n not in input_names]
+    if bad:
+        raise MXNetError("input_shapes for non-input names %s (bound params?)"
+                         % bad)
+
+    np_dtype = np.dtype(dtype)
+    n_in, n_arg = len(input_names), len(param_names)
+
+    def fwd(*flat):
+        inputs = dict(zip(input_names, flat[:n_in]))
+        params = dict(zip(param_names, flat[n_in:n_in + n_arg]))
+        auxs = list(flat[n_in + n_arg:])
+        arg_list = [inputs[n] if n in inputs else params[n]
+                    for n in arg_names]
+        outs, _ = graph_fn(arg_list, auxs, None, False)
+        return tuple(outs)
+
+    in_specs = ([jax.ShapeDtypeStruct(shapes[n], np_dtype)
+                 for n in input_names]
+                + [jax.ShapeDtypeStruct(_shape_of(arg_params[n]),
+                                        arg_params[n].dtype)
+                   for n in param_names]
+                + [jax.ShapeDtypeStruct(_shape_of(aux_params[n]),
+                                        aux_params[n].dtype)
+                   for n in aux_names])
+
+    with jax.default_matmul_precision(matmul_precision):
+        exported = jax.export.export(jax.jit(fwd), platforms=[platform])(
+            *in_specs)
+    # Re-serialize the StableHLO at the MAXIMUM backward-compatibility
+    # target (oldest VHLO version) instead of jax.export's 12-week window:
+    # a deployment artifact must load into whatever PJRT plugin the serving
+    # host ships, and plugins lag the StableHLO producer by far more than
+    # 12 weeks (measured: rsqrt_v2 from the 12-week target crashes a
+    # c49-compat tunnel plugin at execute; the MAX-downgraded module runs).
+    program = _serialize_max_compat(exported)
+
+    # jax.export dead-code-eliminates unused module arguments (e.g. a
+    # fix_gamma BatchNorm's gamma, an inference-ignored label): the
+    # executable takes only module_kept_var_idx. The manifest records the
+    # kept flag so the native runtime passes exactly the surviving args.
+    kept = set(exported.module_kept_var_idx)
+    flat_names = (input_names
+                  + ["arg:" + n for n in param_names]
+                  + ["aux:" + n for n in aux_names])
+    kept_params = [n for i, n in enumerate(flat_names)
+                   if i in kept and i >= n_in]
+
+    out_names = symbol.list_outputs()
+    out_avals = exported.out_avals
+    manifest = {
+        "version": 1,
+        "platform": platform,
+        "matmul_precision": matmul_precision,
+        "inputs": [{"name": n, "shape": list(shapes[n]),
+                    "dtype": str(np_dtype), "kind": kinds.get(n, "data"),
+                    "kept": input_names.index(n) in kept}
+                   for n in input_names],
+        "params": kept_params,
+        "outputs": [{"name": n, "shape": [int(d) for d in a.shape],
+                     "dtype": str(np.dtype(a.dtype))}
+                    for n, a in zip(out_names, out_avals)],
+    }
+
+    blob = io.BytesIO()
+    params_dict = {}
+    for key in kept_params:  # DCE'd params stay out of the artifact too
+        kind, _, n = key.partition(":")
+        src = arg_params if kind == "arg" else aux_params
+        params_dict[key] = nd.array(np.asarray(src[n]))
+    _save_params_to(blob, params_dict)
+
+    mjs = json.dumps(manifest, indent=1).encode()
+    pbytes = blob.getvalue()
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<Q", len(mjs)))
+        f.write(mjs)
+        f.write(struct.pack("<Q", len(program)))
+        f.write(program)
+        f.write(struct.pack("<Q", len(pbytes)))
+        f.write(pbytes)
+    return manifest
+
+
+def _serialize_max_compat(exported):
+    """Downgrade the exported module's VHLO serialization to the oldest
+    compatible version. Falls back to jax.export's own serialization if the
+    version-targeting API is unavailable."""
+    try:
+        import jaxlib.mlir.dialects.stablehlo as hlo
+        from jax._src.lib import xla_client
+        target = hlo.get_version_from_compatibility_requirement(
+            hlo.StablehloCompatibilityRequirement.MAX)
+        return xla_client._xla.mlir.serialize_portable_artifact(
+            exported.mlir_module(), target, False)
+    except Exception:
+        return exported.mlir_module_serialized
+
+
+def _save_params_to(fileobj, params_dict):
+    """nd.save writes to a path; route it through a temp file into a stream
+    (the save format is the interchange contract, so reuse it exactly)."""
+    import os
+    import tempfile
+    fd, tmp = tempfile.mkstemp(suffix=".params")
+    os.close(fd)
+    try:
+        nd.save(tmp, params_dict)
+        with open(tmp, "rb") as f:
+            fileobj.write(f.read())
+    finally:
+        os.unlink(tmp)
+
+
+def load_artifact_manifest(path):
+    """Read back the manifest (and section sizes) of a ``.mxa`` file —
+    the Python-side mirror of the native loader, used by tests to assert
+    both sides parse the same container."""
+    with open(path, "rb") as f:
+        if f.read(8) != MAGIC:
+            raise MXNetError("not an .mxa artifact: %s" % path)
+        (mlen,) = struct.unpack("<Q", f.read(8))
+        manifest = json.loads(f.read(mlen).decode())
+        (plen,) = struct.unpack("<Q", f.read(8))
+        f.seek(plen, 1)
+        (qlen,) = struct.unpack("<Q", f.read(8))
+        return manifest, plen, qlen

@@ -20,6 +20,8 @@
 #include <string>
 #include <vector>
 
+#include "include/ndarray_wire.h"
+
 #define MXNET_DLL extern "C" __attribute__((visibility("default")))
 
 typedef void* NDArrayHandle;
@@ -100,41 +102,17 @@ bool read_exact(FILE* f, void* dst, size_t n) {
 }
 
 CArray* read_one(FILE* f, std::string* err) {
-  uint32_t magic = 0, ndim = 0;
-  if (!read_exact(f, &magic, 4)) { *err = "truncated NDArray blob"; return nullptr; }
-  if (magic == kNDArrayMagic) {
-    if (!read_exact(f, &ndim, 4)) { *err = "truncated NDArray blob"; return nullptr; }
-  } else {
-    ndim = magic;  // legacy pre-V1 layout: first word is ndim
-  }
-  if (ndim > 64) { *err = "implausible ndim"; return nullptr; }
+  // shared wire-format reader (include/ndarray_wire.h); this API speaks the
+  // strict reference format, so TPU-extension dtype flags are rejected
+  mxt_ndwire::NdRecord rec;
+  auto rd = [f](void* dst, size_t n) { return read_exact(f, dst, n); };
+  if (!mxt_ndwire::read_ndarray_record(rd, &rec, err, kNumDTypes))
+    return nullptr;
   auto arr = new CArray();
-  arr->shape.resize(ndim);
-  for (uint32_t i = 0; i < ndim; ++i) {
-    uint32_t s;
-    if (!read_exact(f, &s, 4)) { *err = "truncated shape"; delete arr; return nullptr; }
-    if (s > (1u << 31)) { *err = "implausible shape"; delete arr; return nullptr; }
-    arr->shape[i] = s;
-  }
-  if (ndim == 0) { arr->none = true; return arr; }
-  int32_t devctx[2], flag;
-  if (!read_exact(f, devctx, 8) || !read_exact(f, &flag, 4)) {
-    *err = "truncated header"; delete arr; return nullptr;
-  }
-  if (flag < 0 || flag >= kNumDTypes) {
-    *err = "unknown dtype flag"; delete arr; return nullptr;
-  }
-  arr->dtype = flag;
-  bool ok;
-  size_t n = nelem_checked(arr->shape, &ok);
-  size_t bytes = n * kDTypeSize[flag];
-  if (!ok || bytes > (size_t(1) << 40)) {
-    *err = "implausible size"; delete arr; return nullptr;
-  }
-  arr->data.resize(bytes);
-  if (!read_exact(f, arr->data.data(), bytes)) {
-    *err = "truncated data"; delete arr; return nullptr;
-  }
+  arr->none = rec.none;
+  arr->dtype = rec.dtype;
+  arr->shape.assign(rec.shape.begin(), rec.shape.end());
+  arr->data = std::move(rec.data);
   return arr;
 }
 

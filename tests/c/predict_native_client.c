@@ -1,0 +1,81 @@
+/* Pure-C predict client over libmxtpu_predict_native.so (no Python in this
+ * process).  Usage:
+ *   predict_native_client <model.mxa> <input_name> <in.f32> <out.f32>
+ * Reads the artifact + a raw float32 input blob, runs forward on the PJRT
+ * device, writes output 0 as raw float32.  Exercises MXPredCreate (bytes
+ * path + shape validation), SetInput, Forward, GetOutputShape, GetOutput. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void* PredictorHandle;
+
+extern const char* MXGetLastError(void);
+extern int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                        int param_size, int dev_type, int dev_id,
+                        mx_uint num_input_nodes, const char** input_keys,
+                        const mx_uint* input_shape_indptr,
+                        const mx_uint* input_shape_data, PredictorHandle* out);
+extern int MXPredSetInput(PredictorHandle h, const char* key,
+                          const mx_float* data, mx_uint size);
+extern int MXPredForward(PredictorHandle h);
+extern int MXPredGetOutputShape(PredictorHandle h, mx_uint index,
+                                mx_uint** shape_data, mx_uint* shape_ndim);
+extern int MXPredGetOutput(PredictorHandle h, mx_uint index, mx_float* data,
+                           mx_uint size);
+extern int MXPredFree(PredictorHandle h);
+
+static void* slurp(const char* path, long* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "open %s failed\n", path); exit(2); }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  void* buf = malloc(*size);
+  if (fread(buf, 1, *size, f) != (size_t)*size) { exit(2); }
+  fclose(f);
+  return buf;
+}
+
+#define CHECK(call)                                                   \
+  do {                                                                \
+    if ((call) != 0) {                                                \
+      fprintf(stderr, "FAIL %s: %s\n", #call, MXGetLastError());      \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc != 5) { fprintf(stderr, "usage: %s model.mxa input_name in.f32 out.f32\n", argv[0]); return 2; }
+  long art_size = 0, in_size = 0;
+  void* art = slurp(argv[1], &art_size);
+  float* input = (float*)slurp(argv[3], &in_size);
+  mx_uint n_in = (mx_uint)(in_size / sizeof(float));
+
+  PredictorHandle pred = NULL;
+  /* create without caller shapes (artifact shapes win) */
+  CHECK(MXPredCreate(NULL, art, (int)art_size, /*dev_type=*/6, 0, 0, NULL,
+                     NULL, NULL, &pred));
+
+  CHECK(MXPredSetInput(pred, argv[2], input, n_in));
+  CHECK(MXPredForward(pred));
+
+  mx_uint* shape = NULL;
+  mx_uint ndim = 0;
+  CHECK(MXPredGetOutputShape(pred, 0, &shape, &ndim));
+  mx_uint n_out = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n_out *= shape[i];
+  printf("output0 ndim=%u n=%u\n", ndim, n_out);
+
+  float* out = (float*)malloc(n_out * sizeof(float));
+  CHECK(MXPredGetOutput(pred, 0, out, n_out));
+
+  FILE* f = fopen(argv[4], "wb");
+  fwrite(out, sizeof(float), n_out, f);
+  fclose(f);
+  CHECK(MXPredFree(pred));
+  printf("OK\n");
+  return 0;
+}
