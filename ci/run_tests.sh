@@ -42,6 +42,10 @@ run_entry() {
   # docs/operators.md is generated — fail if it drifted from the registry
   python tools/gen_op_docs.py
   git diff --exit-code docs/operators.md
+  # docs/c_api_coverage.md likewise (needs the built C libs + the reference
+  # checkout; the tool skips cleanly when either is absent)
+  make -C mxnet_tpu/src c_predict c_predict_native
+  python tools/c_api_coverage.py --check
 }
 
 run_bench() {

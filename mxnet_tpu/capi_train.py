@@ -430,3 +430,226 @@ def _c_init_xavier(cexec, seed):
     for name, arr in cexec.executor.arg_dict.items():
         if name.endswith(("_weight", "_bias", "_gamma", "_beta")):
             init(name, arr)
+
+
+# ---- round 4: C API long tail (reference c_api.h:518 MXImperativeInvoke,
+# :854 MXSymbolInferShape, :1087 MXExecutorSetMonitorCallback + op listing
+# for MXSymbolListAtomicSymbolCreators) -------------------------------------
+
+def _c_list_all_ops():
+    """Registered op names (reference: MXListAllOpNames / the creator list
+    behind MXSymbolListAtomicSymbolCreators)."""
+    from .ops.registry import list_ops
+
+    return sorted(list_ops())
+
+
+def _c_imperative_invoke(op_name, blobs, shapes, dtypes, param_keys,
+                         param_vals):
+    """Run one op imperatively on host blobs (reference: MXImperativeInvoke,
+    c_api_ndarray.cc:324). Returns (out_blobs, out_shapes, out_dtypes)."""
+    from . import ndarray as nd
+    from .base import _DTYPE_MX_TO_NP, _DTYPE_NP_TO_MX
+
+    arrs = []
+    for b, s, t in zip(blobs, shapes, dtypes):
+        dt = np.dtype(_DTYPE_MX_TO_NP[int(t)])
+        arr = np.frombuffer(bytes(b), dtype=dt).reshape(
+            [int(x) for x in s])
+        arrs.append(nd.array(arr, dtype=dt))
+    attrs = {k: v for k, v in zip(param_keys, param_vals)}
+    res = nd.imperative_invoke(op_name, arrs, attrs)
+    if not isinstance(res, (list, tuple)):
+        res = [res]
+    out_blobs, out_shapes, out_dtypes = [], [], []
+    for r in res:
+        a = r.asnumpy()
+        out_blobs.append(np.ascontiguousarray(a).tobytes())
+        out_shapes.append([int(x) for x in a.shape])
+        out_dtypes.append(int(_DTYPE_NP_TO_MX[np.dtype(a.dtype)]))
+    return out_blobs, out_shapes, out_dtypes
+
+
+def _c_infer_shape(sym, keys, shape_data, partial):
+    """(reference: MXSymbolInferShape / MXSymbolInferShapePartial,
+    c_api.h:854). ``keys`` empty -> positional over list_arguments order.
+    Returns (arg_shapes, out_shapes, aux_shapes, complete); unknown shapes
+    come back as []."""
+    arg_names = list(sym.list_arguments())
+    if not keys:
+        keys = arg_names[:len(shape_data)]
+    kwargs = {k: tuple(int(x) for x in s)
+              for k, s in zip(keys, shape_data) if len(s)}
+    if partial:
+        arg_shapes, out_shapes, aux_shapes = sym.infer_shape_partial(**kwargs)
+    else:
+        arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**kwargs)
+
+    def clean(lst):
+        return [[int(x) for x in s] if s is not None else []
+                for s in (lst or [])]
+
+    def known(lst):
+        return lst is not None and all(
+            s is not None and 0 not in tuple(s) for s in lst)
+
+    complete = int(known(arg_shapes) and known(out_shapes)
+                   and (aux_shapes is None or known(aux_shapes)))
+    return clean(arg_shapes), clean(out_shapes), clean(aux_shapes), complete
+
+
+def _c_forward_monitored(cexec, is_train):
+    """Forward with the per-node monitor active (reference:
+    MXExecutorSetMonitorCallback -> GraphExecutor::ExecuteMonCallback,
+    graph_executor.cc:761-781). Returns [(name, f32_bytes, shape), ...] in
+    execution order; the C shim replays them into the client's callback."""
+    ex = cexec.executor
+    collected = []
+
+    def cb(name, arr):
+        a = np.ascontiguousarray(arr.asnumpy().astype(np.float32))
+        collected.append((name, a.tobytes(), [int(x) for x in a.shape]))
+
+    prev_cb = ex.monitor_callback
+    prev_active = ex._monitor_active
+    ex.set_monitor_callback(cb)
+    try:
+        cexec.outputs = ex.forward(is_train=bool(is_train))
+    finally:
+        ex.monitor_callback = prev_cb
+        ex._monitor_active = prev_active
+    return collected
+
+
+def _c_random_seed(seed):
+    from . import random as rnd
+
+    rnd.seed(int(seed))
+
+
+def _c_symbol_from_file(path):
+    from .symbol import load
+
+    return load(path)
+
+
+def _c_symbol_save_file(sym, path):
+    sym.save(path)
+
+
+def _c_symbol_copy(sym):
+    from .symbol import load_json
+
+    return load_json(sym.tojson())
+
+
+def _c_symbol_name(sym):
+    return sym.name or ""
+
+
+def _c_symbol_print(sym):
+    return sym.debug_str()
+
+
+def _c_symbol_group(syms):
+    from .symbol import Group
+
+    return Group(list(syms))
+
+
+def _c_symbol_internals(sym):
+    return sym.get_internals()
+
+
+def _c_symbol_get_output(sym, index):
+    return sym[int(index)]
+
+
+def _c_symbol_attr(sym, key):
+    v = sym.attr(key)
+    return ("", 0) if v is None else (str(v), 1)
+
+
+def _c_symbol_set_attr(sym, key, value):
+    sym._set_attr(**{key: value})
+
+
+def _c_symbol_list_attr(sym, recursive):
+    d = sym.list_attr(recursive=bool(recursive)) if recursive in (0, 1) \
+        else sym.list_attr()
+    keys, vals = [], []
+    for k, v in sorted(d.items()):
+        keys.append(str(k))
+        vals.append(str(v))
+    return keys, vals
+
+
+def _c_infer_type(sym, keys, dtypes):
+    """(reference: MXSymbolInferType c_api.h:888) — int mshadow flags."""
+    from .base import _DTYPE_MX_TO_NP, _DTYPE_NP_TO_MX
+
+    kwargs = {k: np.dtype(_DTYPE_MX_TO_NP[int(t)])
+              for k, t in zip(keys, dtypes)}
+    arg_types, out_types, aux_types = sym.infer_type(**kwargs)
+
+    def flags(lst):
+        return [int(_DTYPE_NP_TO_MX[np.dtype(t)]) if t is not None else -1
+                for t in (lst or [])]
+
+    complete = int(all(t is not None for t in (arg_types or [])))
+    return flags(arg_types), flags(out_types), flags(aux_types), complete
+
+
+def _c_atomic_symbol_info(op_name):
+    """(reference: MXSymbolGetAtomicSymbolInfo c_api.h:644) — name, doc,
+    arg names/types/descriptions from the op registry's Param schema."""
+    from .ops.registry import get_op
+
+    op = get_op(op_name)
+    doc = getattr(op, "doc", "") or ""
+    keys, types, descs = [], [], []
+    params = getattr(op, "params", None) or {}
+    for k, spec in sorted(params.items()):
+        keys.append(str(k))
+        kind = getattr(spec, "kind", "value")
+        if getattr(spec, "required", False):
+            types.append("%s, required" % kind)
+        else:
+            types.append("%s, optional, default=%r"
+                         % (kind, getattr(spec, "default", None)))
+        descs.append("")
+    return str(doc), keys, types, descs
+
+
+def _c_kv_barrier(ckv):
+    ckv.kv.barrier()
+
+
+
+
+def _c_symbol_children(sym):
+    from .base import MXNetError
+
+    c = sym.get_children()
+    if c is None:
+        raise MXNetError("symbol has no children (a Variable)")
+    return c
+
+
+def _c_kv_send_command(ckv, head, body):
+    ckv.kv._send_command_to_servers(int(head), body)
+
+
+def _c_kv_num_dead_node(ckv, node_id):
+    return int(ckv.kv.get_num_dead_node(int(node_id)))
+
+
+def _c_exec_outputs(cexec):
+    """All output blobs at once (reference: MXExecutorOutputs c_api.h:1010)
+    -> [(f32_bytes, shape), ...]."""
+    outs = cexec.executor.outputs
+    ret = []
+    for o in outs:
+        a = np.ascontiguousarray(o.asnumpy().astype(np.float32))
+        ret.append((a.tobytes(), [int(x) for x in a.shape]))
+    return ret

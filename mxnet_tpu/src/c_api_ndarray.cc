@@ -20,6 +20,7 @@
 #include <string>
 #include <vector>
 
+#include "include/c_array.h"
 #include "include/ndarray_wire.h"
 
 #define MXNET_DLL extern "C" __attribute__((visibility("default")))
@@ -40,15 +41,6 @@ constexpr uint32_t kNDArrayMagic = 0xF993FAC8;
 const int kDTypeSize[] = {4 /*f32*/, 8 /*f64*/, 2 /*f16*/, 1 /*u8*/,
                           4 /*i32*/, 1 /*i8*/, 8 /*i64*/};
 constexpr int kNumDTypes = 7;
-
-struct CArray {
-  std::vector<mx_uint> shape;
-  std::vector<uint8_t> data;
-  int dtype = 0;   // mshadow flag
-  int dev_type = 1;  // cpu
-  int dev_id = 0;
-  bool none = false;  // MXNDArrayCreateNone / delay_alloc placeholder
-};
 
 // per-process storage for Load's returned name/handle tables (the reference
 // keeps equivalent ret_ vectors in its thread-local API registry)
@@ -281,5 +273,131 @@ MXNET_DLL int MXNDArrayLoad(const char* fname, mx_uint* out_size,
   *out_arr = g_load_result.handles.data();
   *out_name_size = static_cast<mx_uint>(g_load_result.names.size());
   *out_names = g_load_result.name_ptrs.data();
+  return 0;
+}
+
+// ---- views + raw-bytes serialization (reference c_api.h: MXNDArraySlice
+// :395, MXNDArrayAt :407, MXNDArrayReshape :418, MXNDArraySaveRawBytes
+// :291, MXNDArrayLoadFromRawBytes :271). Host arrays: views are copies
+// (the reference's chunk-sharing is a device-memory concern; the C-client
+// contract — shapes and values — is identical). -----------------------------
+
+MXNET_DLL int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                             mx_uint slice_end, NDArrayHandle* out) {
+  auto* a = static_cast<CArray*>(handle);
+  if (a->shape.empty()) return fail("cannot slice a scalar");
+  if (slice_begin > slice_end || slice_end > a->shape[0])
+    return fail("invalid slice range");
+  if (a->data.size() != nelem(a->shape) * kDTypeSize[a->dtype])
+    return fail("cannot slice an unmaterialized (delay_alloc) array");
+  size_t row = kDTypeSize[a->dtype];
+  for (size_t i = 1; i < a->shape.size(); ++i) row *= a->shape[i];
+  auto* r = new CArray();
+  r->dtype = a->dtype;
+  r->dev_type = a->dev_type;
+  r->dev_id = a->dev_id;
+  r->shape = a->shape;
+  r->shape[0] = slice_end - slice_begin;
+  r->data.assign(a->data.begin() + slice_begin * row,
+                 a->data.begin() + slice_end * row);
+  *out = r;
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayAt(NDArrayHandle handle, mx_uint idx,
+                          NDArrayHandle* out) {
+  auto* a = static_cast<CArray*>(handle);
+  if (a->shape.empty() || idx >= a->shape[0]) return fail("index out of range");
+  NDArrayHandle sliced = nullptr;
+  int rc = MXNDArraySlice(handle, idx, idx + 1, &sliced);
+  if (rc != 0) return rc;
+  auto* r = static_cast<CArray*>(sliced);
+  r->shape.erase(r->shape.begin());  // drop the leading dim
+  *out = r;
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
+                               NDArrayHandle* out) {
+  auto* a = static_cast<CArray*>(handle);
+  std::vector<mx_uint> shape;
+  long known = 1;
+  int infer = -1;
+  for (int i = 0; i < ndim; ++i) {
+    if (dims[i] == -1) {
+      if (infer >= 0) return fail("at most one -1 dim in reshape");
+      infer = i;
+      shape.push_back(0);
+    } else {
+      shape.push_back(static_cast<mx_uint>(dims[i]));
+      known *= dims[i];
+    }
+  }
+  long total = static_cast<long>(nelem(a->shape));
+  if (infer >= 0) {
+    if (known == 0 || total % known != 0)
+      return fail("cannot infer -1 dim in reshape");
+    shape[infer] = static_cast<mx_uint>(total / known);
+    known *= shape[infer];
+  }
+  if (known != total) return fail("reshape changes element count");
+  auto* r = new CArray();
+  r->dtype = a->dtype;
+  r->dev_type = a->dev_type;
+  r->dev_id = a->dev_id;
+  r->shape = shape;
+  r->data = a->data;
+  *out = r;
+  return 0;
+}
+
+MXNET_DLL int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
+                                    const char** out_buf) {
+  auto* a = static_cast<CArray*>(handle);
+  thread_local std::vector<char> buf;
+  buf.clear();
+  auto put = [&](const void* p, size_t n) {
+    const char* c = static_cast<const char*>(p);
+    buf.insert(buf.end(), c, c + n);
+  };
+  uint32_t ndim = a->none ? 0 : static_cast<uint32_t>(a->shape.size());
+  put(&kNDArrayMagic, 4);
+  put(&ndim, 4);
+  if (ndim) {
+    for (mx_uint s : a->shape) {
+      uint32_t v = s;
+      put(&v, 4);
+    }
+    int32_t ctx[2] = {1, 0};
+    put(ctx, 8);
+    int32_t flag = a->dtype;
+    put(&flag, 4);
+    put(a->data.data(), a->data.size());
+  }
+  *out_size = buf.size();
+  *out_buf = buf.data();
+  return 0;
+}
+
+MXNET_DLL int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                                        NDArrayHandle* out) {
+  const char* p = static_cast<const char*>(buf);
+  const char* end = p + size;
+  auto rd = [&p, end](void* dst, size_t n) {
+    if (static_cast<size_t>(end - p) < n) return false;
+    std::memcpy(dst, p, n);
+    p += n;
+    return true;
+  };
+  mxt_ndwire::NdRecord rec;
+  std::string err;
+  if (!mxt_ndwire::read_ndarray_record(rd, &rec, &err, kNumDTypes))
+    return fail("LoadFromRawBytes: " + err);
+  auto* r = new CArray();
+  r->none = rec.none;
+  r->dtype = rec.dtype;
+  r->shape.assign(rec.shape.begin(), rec.shape.end());
+  r->data = std::move(rec.data);
+  *out = r;
   return 0;
 }

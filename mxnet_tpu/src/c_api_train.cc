@@ -18,6 +18,7 @@
 
 // the public header declares every exported signature — including it makes
 // the compiler verify each MXNET_DLL definition against its declaration
+#include "include/c_array.h"
 #include "include/c_train_api.h"
 
 #define MXNET_DLL extern "C" __attribute__((visibility("default")))
@@ -84,12 +85,17 @@ struct CSym {
   PyObject* obj;
 };
 struct CExec {
-  PyObject* obj;
+  PyObject* obj = nullptr;
   // stable storage for string lists returned to C
   std::vector<std::string> names;
   std::vector<const char*> name_ptrs;
   std::vector<mx_uint> shape;
   std::vector<char> blob;
+  // per-node monitor (MXExecutorSetMonitorCallback): replayed after each
+  // monitored forward; mon_arrays hold the handles until the next forward
+  ExecutorMonitorCallback mon_cb = nullptr;
+  void* mon_ctx = nullptr;
+  std::vector<void*> mon_arrays;
 };
 
 int fail() { return -1; }
@@ -220,7 +226,9 @@ MXNET_DLL int MXExecutorSimpleBindLite(SymbolHandle sym, const char* dev_type,
     set_err();
     return fail();
   }
-  *out = new CExec{res, {}, {}, {}, {}};
+  auto* ce = new CExec();
+  ce->obj = res;
+  *out = ce;
   return 0;
 }
 
@@ -228,6 +236,7 @@ MXNET_DLL int MXExecutorFree(ExecutorHandle h) {
   if (!h) return 0;
   GilT gil;
   auto* e = static_cast<CExec*>(h);
+  for (void* a : e->mon_arrays) delete static_cast<CArray*>(a);
   Py_XDECREF(e->obj);
   delete e;
   return 0;
@@ -316,6 +325,53 @@ MXNET_DLL int MXExecutorOutputShape(ExecutorHandle h, mx_uint index,
 MXNET_DLL int MXExecutorForward(ExecutorHandle h, int is_train) {
   GilT gil;
   auto* e = static_cast<CExec*>(h);
+  if (e->mon_cb) {
+    // monitored pass (reference ExecuteMonCallback): collect per-node
+    // outputs python-side, then replay into the client's callback
+    PyObject* res = PyObject_CallMethod(
+        train_module(), "_c_forward_monitored", "Oi", e->obj, is_train);
+    if (!res) {
+      set_err();
+      return fail();
+    }
+    for (void* a : e->mon_arrays) delete static_cast<CArray*>(a);
+    e->mon_arrays.clear();
+    if (!PyList_Check(res)) {
+      Py_DECREF(res);
+      mxtpu_set_train_error("_c_forward_monitored: expected a list");
+      return fail();
+    }
+    struct Entry {
+      std::string name;
+      CArray* arr;
+    };
+    std::vector<Entry> entries;
+    for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+      PyObject* tup = PyList_GetItem(res, i);
+      const char* nm = nullptr;
+      PyObject* blob = nullptr;
+      PyObject* shp = nullptr;
+      if (!PyArg_ParseTuple(tup, "sSO", &nm, &blob, &shp)) {
+        Py_DECREF(res);
+        set_err();
+        return fail();
+      }
+      auto* arr = new CArray();
+      arr->dtype = 0;
+      for (Py_ssize_t j = 0; j < PyList_Size(shp); ++j)
+        arr->shape.push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyList_GetItem(shp, j))));
+      char* buf = nullptr;
+      Py_ssize_t len = 0;
+      PyBytes_AsStringAndSize(blob, &buf, &len);
+      arr->data.assign(buf, buf + len);
+      e->mon_arrays.push_back(arr);
+      entries.push_back({nm, arr});
+    }
+    Py_DECREF(res);
+    for (const auto& en : entries) e->mon_cb(en.name.c_str(), en.arr, e->mon_ctx);
+    return 0;
+  }
   PyObject* res = PyObject_CallMethod(train_module(), "_c_forward", "Oi",
                                       e->obj, is_train);
   if (!res) {
@@ -323,6 +379,19 @@ MXNET_DLL int MXExecutorForward(ExecutorHandle h, int is_train) {
     return fail();
   }
   Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXExecutorSetMonitorCallback(ExecutorHandle h,
+                                           ExecutorMonitorCallback callback,
+                                           void* callback_handle) {
+  auto* e = static_cast<CExec*>(h);
+  if (!e) {
+    mxtpu_set_train_error("null executor handle");
+    return fail();
+  }
+  e->mon_cb = callback;
+  e->mon_ctx = callback_handle;
   return 0;
 }
 
@@ -911,6 +980,767 @@ MXNET_DLL int MXExecutorInitXavier(ExecutorHandle h, int seed) {
     set_err();
     return fail();
   }
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---- Imperative invoke + introspection (reference: c_api.h
+// MXImperativeInvoke :518, MXListAllOpNames :594,
+// MXSymbolListAtomicSymbolCreators :604, MXSymbolInferShape :854) ----------
+
+namespace {
+
+// creator handles are stable pointers into a process-wide op-name table
+// (the reference's AtomicSymbolCreator is likewise an opaque registry entry)
+std::vector<std::string>& op_name_table() {
+  static std::vector<std::string>* t = nullptr;
+  if (!t) {
+    t = new std::vector<std::string>();
+    PyObject* res =
+        PyObject_CallMethod(train_module(), "_c_list_all_ops", NULL);
+    if (res && PyList_Check(res)) {
+      for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+        const char* s = PyUnicode_AsUTF8(PyList_GetItem(res, i));
+        if (s) t->push_back(s);
+      }
+    }
+    Py_XDECREF(res);
+    if (!res) PyErr_Clear();
+  }
+  return *t;
+}
+
+}  // namespace
+
+MXNET_DLL int MXListAllOpNames(mx_uint* out_size, const char*** out_array) {
+  GilT gil;
+  auto& tbl = op_name_table();
+  thread_local std::vector<const char*> ptrs;
+  ptrs.clear();
+  for (const auto& s : tbl) ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(ptrs.size());
+  *out_array = ptrs.data();
+  return 0;
+}
+
+MXNET_DLL int MXSymbolListAtomicSymbolCreators(mx_uint* out_size,
+                                               AtomicSymbolCreator** out_array) {
+  GilT gil;
+  auto& tbl = op_name_table();
+  thread_local std::vector<AtomicSymbolCreator> creators;
+  creators.clear();
+  for (auto& s : tbl)
+    creators.push_back(const_cast<std::string*>(&s));
+  *out_size = static_cast<mx_uint>(creators.size());
+  *out_array = creators.data();
+  return 0;
+}
+
+MXNET_DLL int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                          const char** name) {
+  if (!creator) {
+    mxtpu_set_train_error("null creator");
+    return fail();
+  }
+  *name = static_cast<std::string*>(creator)->c_str();
+  return 0;
+}
+
+MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                                 NDArrayHandle* inputs, int* num_outputs,
+                                 NDArrayHandle** outputs, int num_params,
+                                 const char** param_keys,
+                                 const char** param_vals) {
+  GilT gil;
+  if (!creator) {
+    mxtpu_set_train_error("null creator");
+    return fail();
+  }
+  const std::string& op_name = *static_cast<std::string*>(creator);
+  PyObject* blobs = PyList_New(num_inputs);
+  PyObject* shapes = PyList_New(num_inputs);
+  PyObject* dtypes = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    auto* a = static_cast<CArray*>(inputs[i]);
+    PyList_SetItem(blobs, i,
+                   PyBytes_FromStringAndSize(
+                       reinterpret_cast<const char*>(a->data.data()),
+                       a->data.size()));
+    PyObject* dims = PyList_New(a->shape.size());
+    for (size_t j = 0; j < a->shape.size(); ++j)
+      PyList_SetItem(dims, j, PyLong_FromUnsignedLong(a->shape[j]));
+    PyList_SetItem(shapes, i, dims);
+    PyList_SetItem(dtypes, i, PyLong_FromLong(a->dtype));
+  }
+  PyObject* pkeys = PyList_New(num_params);
+  PyObject* pvals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SetItem(pvals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* res = PyObject_CallMethod(
+      train_module(), "_c_imperative_invoke", "sOOOOO", op_name.c_str(),
+      blobs, shapes, dtypes, pkeys, pvals);
+  Py_DECREF(blobs);
+  Py_DECREF(shapes);
+  Py_DECREF(dtypes);
+  Py_DECREF(pkeys);
+  Py_DECREF(pvals);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  PyObject *oblobs = nullptr, *oshapes = nullptr, *odtypes = nullptr;
+  if (!PyArg_ParseTuple(res, "OOO", &oblobs, &oshapes, &odtypes)) {
+    Py_DECREF(res);
+    set_err();
+    return fail();
+  }
+  Py_ssize_t n_out = PyList_Size(oblobs);
+  bool caller_provided = (*num_outputs > 0 && *outputs != nullptr);
+  if (caller_provided && *num_outputs != static_cast<int>(n_out)) {
+    Py_DECREF(res);
+    mxtpu_set_train_error("MXImperativeInvoke: wrong number of provided "
+                          "output handles");
+    return fail();
+  }
+  thread_local std::vector<NDArrayHandle> out_handles;
+  if (!caller_provided) out_handles.clear();
+  auto drop_allocated = [&]() {
+    if (caller_provided) return;
+    for (NDArrayHandle h2 : out_handles) delete static_cast<CArray*>(h2);
+    out_handles.clear();
+  };
+  for (Py_ssize_t i = 0; i < n_out; ++i) {
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(PyList_GetItem(oblobs, i), &buf, &len) != 0) {
+      Py_DECREF(res);
+      drop_allocated();
+      set_err();
+      return fail();
+    }
+    CArray* arr = caller_provided
+                      ? static_cast<CArray*>((*outputs)[i])
+                      : new CArray();
+    arr->shape.clear();
+    PyObject* shp = PyList_GetItem(oshapes, i);
+    for (Py_ssize_t j = 0; j < PyList_Size(shp); ++j)
+      arr->shape.push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyList_GetItem(shp, j))));
+    arr->dtype =
+        static_cast<int>(PyLong_AsLong(PyList_GetItem(odtypes, i)));
+    arr->data.assign(buf, buf + len);
+    arr->none = false;
+    if (!caller_provided) out_handles.push_back(arr);
+  }
+  Py_DECREF(res);
+  if (!caller_provided) {
+    *num_outputs = static_cast<int>(n_out);
+    *outputs = out_handles.data();
+  }
+  return 0;
+}
+
+namespace {
+
+// thread-local result tables for the three InferShape shape lists
+struct ShapeTable {
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<mx_uint> ndims;
+  std::vector<const mx_uint*> ptrs;
+  void load(PyObject* list) {
+    shapes.clear();
+    ndims.clear();
+    ptrs.clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(list); ++i) {
+      PyObject* s = PyList_GetItem(list, i);
+      std::vector<mx_uint> dims;
+      for (Py_ssize_t j = 0; j < PyList_Size(s); ++j)
+        dims.push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyList_GetItem(s, j))));
+      shapes.push_back(std::move(dims));
+    }
+    for (auto& s : shapes) {
+      ndims.push_back(static_cast<mx_uint>(s.size()));
+      ptrs.push_back(s.data());
+    }
+  }
+};
+
+int infer_shape_impl(SymbolHandle sym, mx_uint num_args, const char** keys,
+                     const mx_uint* arg_ind_ptr,
+                     const mx_uint* arg_shape_data, mx_uint* in_shape_size,
+                     const mx_uint** in_shape_ndim,
+                     const mx_uint*** in_shape_data, mx_uint* out_shape_size,
+                     const mx_uint** out_shape_ndim,
+                     const mx_uint*** out_shape_data, mx_uint* aux_shape_size,
+                     const mx_uint** aux_shape_ndim,
+                     const mx_uint*** aux_shape_data, int* complete,
+                     int partial) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  PyObject* key_list = PyList_New(num_args);
+  PyObject* shape_list = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SetItem(key_list, i,
+                   PyUnicode_FromString(keys ? keys[i] : ""));
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject* dims = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(dims, j - lo, PyLong_FromUnsignedLong(arg_shape_data[j]));
+    PyList_SetItem(shape_list, i, dims);
+  }
+  if (!keys) {
+    // positional form: helper maps onto list_arguments order
+    Py_DECREF(key_list);
+    key_list = PyList_New(0);
+  }
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_infer_shape", "OOOi", s->obj,
+                          key_list, shape_list, partial);
+  Py_DECREF(key_list);
+  Py_DECREF(shape_list);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  PyObject *in_l = nullptr, *out_l = nullptr, *aux_l = nullptr;
+  int comp = 0;
+  if (!PyArg_ParseTuple(res, "OOOi", &in_l, &out_l, &aux_l, &comp)) {
+    Py_DECREF(res);
+    set_err();
+    return fail();
+  }
+  thread_local ShapeTable t_in, t_out, t_aux;
+  t_in.load(in_l);
+  t_out.load(out_l);
+  t_aux.load(aux_l);
+  Py_DECREF(res);
+  *in_shape_size = static_cast<mx_uint>(t_in.shapes.size());
+  *in_shape_ndim = t_in.ndims.data();
+  *in_shape_data = t_in.ptrs.data();
+  *out_shape_size = static_cast<mx_uint>(t_out.shapes.size());
+  *out_shape_ndim = t_out.ndims.data();
+  *out_shape_data = t_out.ptrs.data();
+  *aux_shape_size = static_cast<mx_uint>(t_aux.shapes.size());
+  *aux_shape_ndim = t_aux.ndims.data();
+  *aux_shape_data = t_aux.ptrs.data();
+  *complete = comp;
+  return 0;
+}
+
+}  // namespace
+
+MXNET_DLL int MXSymbolInferShape(
+    SymbolHandle sym, mx_uint num_args, const char** keys,
+    const mx_uint* arg_ind_ptr, const mx_uint* arg_shape_data,
+    mx_uint* in_shape_size, const mx_uint** in_shape_ndim,
+    const mx_uint*** in_shape_data, mx_uint* out_shape_size,
+    const mx_uint** out_shape_ndim, const mx_uint*** out_shape_data,
+    mx_uint* aux_shape_size, const mx_uint** aux_shape_ndim,
+    const mx_uint*** aux_shape_data, int* complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete, 0);
+}
+
+MXNET_DLL int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char** keys,
+    const mx_uint* arg_ind_ptr, const mx_uint* arg_shape_data,
+    mx_uint* in_shape_size, const mx_uint** in_shape_ndim,
+    const mx_uint*** in_shape_data, mx_uint* out_shape_size,
+    const mx_uint** out_shape_ndim, const mx_uint*** out_shape_data,
+    mx_uint* aux_shape_size, const mx_uint** aux_shape_ndim,
+    const mx_uint*** aux_shape_data, int* complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete, 1);
+}
+
+MXNET_DLL int MXRandomSeed(int seed) {
+  GilT gil;
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_random_seed", "i", seed);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXNotifyShutdown(void) {
+  // engine drain point in the reference; XLA dispatch is synchronized per
+  // call here, so nothing is pending
+  return 0;
+}
+
+// ---- Symbol long tail (reference c_api.h: CreateFromFile :722, SaveToFile
+// :745, Copy :760, Print :768, GetName :776, CreateGroup :713, GetInternals
+// :795, GetOutput :811, GetAttr :784, SetAttr :800, ListAttr :816,
+// GetAtomicSymbolInfo :644, InferType :888) --------------------------------
+
+namespace {
+
+int sym_from_call(PyObject* res, SymbolHandle* out) {
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *out = new CSym{res};
+  return 0;
+}
+
+int str_from_call(PyObject* res, const char** out) {
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  thread_local std::string ret;
+  const char* s = PyUnicode_AsUTF8(res);
+  if (!s) {
+    Py_DECREF(res);
+    set_err();
+    return fail();
+  }
+  ret = s;
+  Py_DECREF(res);
+  *out = ret.c_str();
+  return 0;
+}
+
+}  // namespace
+
+MXNET_DLL int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  GilT gil;
+  return sym_from_call(
+      PyObject_CallMethod(train_module(), "_c_symbol_from_file", "s", fname),
+      out);
+}
+
+MXNET_DLL int MXSymbolSaveToFile(SymbolHandle sym, const char* fname) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_symbol_save_file",
+                                      "Os", s->obj, fname);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXSymbolCopy(SymbolHandle sym, SymbolHandle* out) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  return sym_from_call(
+      PyObject_CallMethod(train_module(), "_c_symbol_copy", "O", s->obj), out);
+}
+
+MXNET_DLL int MXSymbolPrint(SymbolHandle sym, const char** out_str) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  return str_from_call(
+      PyObject_CallMethod(train_module(), "_c_symbol_print", "O", s->obj),
+      out_str);
+}
+
+MXNET_DLL int MXSymbolGetName(SymbolHandle sym, const char** out,
+                              int* success) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  int rc = str_from_call(
+      PyObject_CallMethod(train_module(), "_c_symbol_name", "O", s->obj), out);
+  if (rc == 0 && success) *success = (**out != '\0');
+  return rc;
+}
+
+MXNET_DLL int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle* symbols,
+                                  SymbolHandle* out) {
+  GilT gil;
+  PyObject* lst = PyList_New(num_symbols);
+  for (mx_uint i = 0; i < num_symbols; ++i) {
+    PyObject* o = static_cast<CSym*>(symbols[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(lst, i, o);
+  }
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_symbol_group", "O", lst);
+  Py_DECREF(lst);
+  return sym_from_call(res, out);
+}
+
+MXNET_DLL int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle* out) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  return sym_from_call(
+      PyObject_CallMethod(train_module(), "_c_symbol_internals", "O", s->obj),
+      out);
+}
+
+MXNET_DLL int MXSymbolGetOutput(SymbolHandle sym, mx_uint index,
+                                SymbolHandle* out) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  return sym_from_call(
+      PyObject_CallMethod(train_module(), "_c_symbol_get_output", "OI",
+                          s->obj, index),
+      out);
+}
+
+MXNET_DLL int MXSymbolGetAttr(SymbolHandle sym, const char* key,
+                              const char** out, int* success) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_symbol_attr", "Os",
+                                      s->obj, key);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  const char* val = nullptr;
+  int found = 0;
+  if (!PyArg_ParseTuple(res, "si", &val, &found)) {
+    Py_DECREF(res);
+    set_err();
+    return fail();
+  }
+  thread_local std::string ret;
+  ret = val;
+  Py_DECREF(res);
+  *out = ret.c_str();
+  *success = found;
+  return 0;
+}
+
+MXNET_DLL int MXSymbolSetAttr(SymbolHandle sym, const char* key,
+                              const char* value) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_symbol_set_attr",
+                                      "Oss", s->obj, key, value);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+
+int list_attr_impl(SymbolHandle sym, int recursive, mx_uint* out_size,
+                   const char*** out) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  PyObject* res = PyObject_CallMethod(
+      train_module(), "_c_symbol_list_attr", "Oi", s->obj, recursive);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  PyObject *keys = nullptr, *vals = nullptr;
+  if (!PyArg_ParseTuple(res, "OO", &keys, &vals)) {
+    Py_DECREF(res);
+    set_err();
+    return fail();
+  }
+  // reference layout: flat [key0, val0, key1, val1, ...]
+  thread_local std::vector<std::string> kv;
+  thread_local std::vector<const char*> ptrs;
+  kv.clear();
+  ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(keys); ++i) {
+    const char* k = PyUnicode_AsUTF8(PyList_GetItem(keys, i));
+    const char* v = PyUnicode_AsUTF8(PyList_GetItem(vals, i));
+    if (!k || !v) {
+      Py_DECREF(res);
+      set_err();
+      return fail();
+    }
+    kv.emplace_back(k);
+    kv.emplace_back(v);
+  }
+  Py_DECREF(res);
+  for (auto& x : kv) ptrs.push_back(x.c_str());
+  *out_size = static_cast<mx_uint>(kv.size() / 2);
+  *out = ptrs.data();
+  return 0;
+}
+
+}  // namespace
+
+MXNET_DLL int MXSymbolListAttr(SymbolHandle sym, mx_uint* out_size,
+                               const char*** out) {
+  return list_attr_impl(sym, 1, out_size, out);
+}
+
+MXNET_DLL int MXSymbolListAttrShallow(SymbolHandle sym, mx_uint* out_size,
+                                      const char*** out) {
+  return list_attr_impl(sym, 0, out_size, out);
+}
+
+MXNET_DLL int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char** name, const char** description,
+    mx_uint* num_args, const char*** arg_names, const char*** arg_type_infos,
+    const char*** arg_descriptions, const char** key_var_num_args) {
+  GilT gil;
+  if (!creator) {
+    mxtpu_set_train_error("null creator");
+    return fail();
+  }
+  const std::string& op = *static_cast<std::string*>(creator);
+  PyObject* res = PyObject_CallMethod(
+      train_module(), "_c_atomic_symbol_info", "s", op.c_str());
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  PyObject *doc = nullptr, *keys = nullptr, *types = nullptr, *descs = nullptr;
+  if (!PyArg_ParseTuple(res, "OOOO", &doc, &keys, &types, &descs)) {
+    Py_DECREF(res);
+    set_err();
+    return fail();
+  }
+  thread_local std::string t_name, t_doc, t_kvna;
+  thread_local std::vector<std::string> t_strs;
+  thread_local std::vector<const char*> t_keys, t_types, t_descs;
+  t_name = op;
+  t_doc = PyUnicode_AsUTF8(doc) ? PyUnicode_AsUTF8(doc) : "";
+  t_kvna = "";
+  t_strs.clear();
+  t_keys.clear();
+  t_types.clear();
+  t_descs.clear();
+  Py_ssize_t n = PyList_Size(keys);
+  // reserve so c_str() pointers stay stable while filling
+  t_strs.reserve(3 * n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    t_strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(keys, i)));
+    t_keys.push_back(t_strs.back().c_str());
+    t_strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(types, i)));
+    t_types.push_back(t_strs.back().c_str());
+    t_strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(descs, i)));
+    t_descs.push_back(t_strs.back().c_str());
+  }
+  Py_DECREF(res);
+  *name = t_name.c_str();
+  *description = t_doc.c_str();
+  *num_args = static_cast<mx_uint>(n);
+  *arg_names = t_keys.data();
+  *arg_type_infos = t_types.data();
+  *arg_descriptions = t_descs.data();
+  if (key_var_num_args) *key_var_num_args = t_kvna.c_str();
+  return 0;
+}
+
+MXNET_DLL int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                                const char** keys, const int* arg_type_data,
+                                mx_uint* in_type_size, const int** in_type_data,
+                                mx_uint* out_type_size,
+                                const int** out_type_data,
+                                mx_uint* aux_type_size,
+                                const int** aux_type_data, int* complete) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  PyObject* key_list = PyList_New(num_args);
+  PyObject* type_list = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SetItem(key_list, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(type_list, i, PyLong_FromLong(arg_type_data[i]));
+  }
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_infer_type", "OOO",
+                                      s->obj, key_list, type_list);
+  Py_DECREF(key_list);
+  Py_DECREF(type_list);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  PyObject *in_l = nullptr, *out_l = nullptr, *aux_l = nullptr;
+  int comp = 0;
+  if (!PyArg_ParseTuple(res, "OOOi", &in_l, &out_l, &aux_l, &comp)) {
+    Py_DECREF(res);
+    set_err();
+    return fail();
+  }
+  thread_local std::vector<int> t_in, t_out, t_aux;
+  auto load = [](PyObject* l, std::vector<int>* v) {
+    v->clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(l); ++i)
+      v->push_back(static_cast<int>(PyLong_AsLong(PyList_GetItem(l, i))));
+  };
+  load(in_l, &t_in);
+  load(out_l, &t_out);
+  load(aux_l, &t_aux);
+  Py_DECREF(res);
+  *in_type_size = static_cast<mx_uint>(t_in.size());
+  *in_type_data = t_in.data();
+  *out_type_size = static_cast<mx_uint>(t_out.size());
+  *out_type_data = t_out.data();
+  *aux_type_size = static_cast<mx_uint>(t_aux.size());
+  *aux_type_data = t_aux.data();
+  *complete = comp;
+  return 0;
+}
+
+MXNET_DLL int MXExecutorPrint(ExecutorHandle h, const char** out_str) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* sym = PyObject_GetAttrString(e->obj, "executor");
+  PyObject* res = nullptr;
+  if (sym) {
+    PyObject* dbg = PyObject_CallMethod(sym, "debug_str", NULL);
+    Py_DECREF(sym);
+    res = dbg;
+  }
+  return str_from_call(res, out_str);
+}
+
+// ---- KVStore long tail (reference c_api.h: GetType :1239, role predicates
+// :1288-1304, Barrier :1312) -----------------------------------------------
+
+MXNET_DLL int MXKVStoreGetType(KVStoreHandle h, const char** out) {
+  GilT gil;
+  auto* kv = static_cast<CKV*>(h);
+  return str_from_call(
+      PyObject_CallMethod(train_module(), "_c_kv_type", "O", kv->obj), out);
+}
+
+MXNET_DLL int MXKVStoreIsWorkerNode(int* ret) {
+  const char* role = getenv("DMLC_ROLE");
+  *ret = (!role || strcmp(role, "worker") == 0) ? 1 : 0;
+  return 0;
+}
+
+MXNET_DLL int MXKVStoreIsServerNode(int* ret) {
+  const char* role = getenv("DMLC_ROLE");
+  *ret = (role && strcmp(role, "server") == 0) ? 1 : 0;
+  return 0;
+}
+
+MXNET_DLL int MXKVStoreIsSchedulerNode(int* ret) {
+  const char* role = getenv("DMLC_ROLE");
+  *ret = (role && strcmp(role, "scheduler") == 0) ? 1 : 0;
+  return 0;
+}
+
+MXNET_DLL int MXKVStoreBarrier(KVStoreHandle h) {
+  GilT gil;
+  auto* kv = static_cast<CKV*>(h);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_kv_barrier", "O", kv->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---- final long-tail wrappers (reference c_api.h: GetChildren :803,
+// ExecutorOutputs :1010, DataIterCreateIter :1120, InitPSEnv :1227,
+// SendCommmandToServers :1341, GetNumDeadNode :1354) -----------------------
+
+MXNET_DLL int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle* out) {
+  GilT gil;
+  auto* s = static_cast<CSym*>(sym);
+  return sym_from_call(
+      PyObject_CallMethod(train_module(), "_c_symbol_children", "O", s->obj),
+      out);
+}
+
+// exact reference name for the iterator factory (this library's
+// MXDataIterCreate is the same function with the same signature)
+MXNET_DLL int MXDataIterCreateIter(const char* handle, mx_uint num_param,
+                                   const char** keys, const char** vals,
+                                   DataIterHandle* out) {
+  return MXDataIterCreate(handle, num_param, keys, vals, out);
+}
+
+MXNET_DLL int MXExecutorOutputs(ExecutorHandle h, mx_uint* out_size,
+                                NDArrayHandle** out) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* res =
+      PyObject_CallMethod(train_module(), "_c_exec_outputs", "O", e->obj);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  if (!PyList_Check(res)) {
+    Py_DECREF(res);
+    mxtpu_set_train_error("_c_exec_outputs: expected a list");
+    return fail();
+  }
+  thread_local std::vector<NDArrayHandle> handles;
+  // handles returned here are caller-freed (MXNDArrayFree), matching
+  // MXImperativeInvoke's allocation contract
+  handles.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+    PyObject* tup = PyList_GetItem(res, i);
+    PyObject* blob = nullptr;
+    PyObject* shp = nullptr;
+    if (!PyArg_ParseTuple(tup, "SO", &blob, &shp)) {
+      Py_DECREF(res);
+      set_err();
+      return fail();
+    }
+    auto* arr = new CArray();
+    arr->dtype = 0;
+    for (Py_ssize_t j = 0; j < PyList_Size(shp); ++j)
+      arr->shape.push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyList_GetItem(shp, j))));
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    PyBytes_AsStringAndSize(blob, &buf, &len);
+    arr->data.assign(buf, buf + len);
+    handles.push_back(arr);
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(handles.size());
+  *out = handles.data();
+  return 0;
+}
+
+MXNET_DLL int MXInitPSEnv(mx_uint num_vars, const char** keys,
+                          const char** vals) {
+  for (mx_uint i = 0; i < num_vars; ++i) setenv(keys[i], vals[i], 1);
+  return 0;
+}
+
+MXNET_DLL int MXKVStoreSendCommmandToServers(KVStoreHandle h, int cmd_head,
+                                             const char* cmd_body) {
+  GilT gil;
+  auto* kv = static_cast<CKV*>(h);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_kv_send_command",
+                                      "Ois", kv->obj, cmd_head, cmd_body);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXKVStoreGetNumDeadNode(KVStoreHandle h, int node_id,
+                                      int* number, int timeout_sec) {
+  GilT gil;
+  (void)timeout_sec;
+  auto* kv = static_cast<CKV*>(h);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_kv_num_dead_node",
+                                      "Oi", kv->obj, node_id);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  *number = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   return 0;
 }

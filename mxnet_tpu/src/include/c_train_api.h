@@ -19,6 +19,7 @@ extern "C" {
 
 typedef void* SymbolHandle;
 typedef void* ExecutorHandle;
+typedef void* KVStoreHandle;
 typedef unsigned int mx_uint;
 
 const char* MXTrainGetLastError(void);
@@ -90,6 +91,96 @@ int MXExecutorLoadParams(ExecutorHandle exec, const char* path,
                          mx_uint* out_num_loaded);
 int MXExecutorFree(ExecutorHandle exec);
 
+/* ---- Imperative + introspection (reference: c_api.h MXImperativeInvoke
+ * :518, MXListAllOpNames :594, MXSymbolListAtomicSymbolCreators :604,
+ * MXSymbolInferShape :854). NDArrayHandle is the host-array handle from
+ * c_predict_api.h's NDList family (same CArray type across the .so). ---- */
+typedef void* NDArrayHandle;
+typedef void* AtomicSymbolCreator;
+
+int MXListAllOpNames(mx_uint* out_size, const char*** out_array);
+int MXSymbolListAtomicSymbolCreators(mx_uint* out_size,
+                                     AtomicSymbolCreator** out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name);
+/* Run one op on host arrays. Inputs are NDArrayHandles (MXNDArrayCreateEx +
+ * SyncCopyFromCPU). On entry *num_outputs==0 and *outputs==NULL: the
+ * library allocates output handles (caller frees each via MXNDArrayFree;
+ * the handle array itself is thread-local). With caller-provided outputs,
+ * results are copied into them (shapes must match). */
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals);
+/* Shape inference (reference signature, CSR shape args like simple_bind;
+ * keys==NULL means positional). Unknown shapes come back with ndim 0;
+ * *complete is 1 when every shape is fully known. Returned tables are
+ * thread-local, valid until the next InferShape call on any symbol. */
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char** keys,
+                       const mx_uint* arg_ind_ptr,
+                       const mx_uint* arg_shape_data, mx_uint* in_shape_size,
+                       const mx_uint** in_shape_ndim,
+                       const mx_uint*** in_shape_data, mx_uint* out_shape_size,
+                       const mx_uint** out_shape_ndim,
+                       const mx_uint*** out_shape_data, mx_uint* aux_shape_size,
+                       const mx_uint** aux_shape_ndim,
+                       const mx_uint*** aux_shape_data, int* complete);
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char** keys,
+    const mx_uint* arg_ind_ptr, const mx_uint* arg_shape_data,
+    mx_uint* in_shape_size, const mx_uint** in_shape_ndim,
+    const mx_uint*** in_shape_data, mx_uint* out_shape_size,
+    const mx_uint** out_shape_ndim, const mx_uint*** out_shape_data,
+    mx_uint* aux_shape_size, const mx_uint** aux_shape_ndim,
+    const mx_uint*** aux_shape_data, int* complete);
+/* Per-node monitor (reference: MXExecutorSetMonitorCallback c_api.h:1087 ->
+ * GraphExecutor::ExecuteMonCallback). While installed, every
+ * MXExecutorForward runs the eager monitored pass and invokes `callback`
+ * once per node output with a float32 host NDArrayHandle (owned by the
+ * executor, valid until the next forward). NULL callback uninstalls. */
+typedef void (*ExecutorMonitorCallback)(const char* name, NDArrayHandle arr,
+                                        void* callback_handle);
+int MXExecutorSetMonitorCallback(ExecutorHandle exec,
+                                 ExecutorMonitorCallback callback,
+                                 void* callback_handle);
+int MXRandomSeed(int seed);
+int MXNotifyShutdown(void);
+
+/* Symbol long tail (reference c_api.h :644-:920) */
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out);
+int MXSymbolSaveToFile(SymbolHandle sym, const char* fname);
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle* out);
+int MXSymbolPrint(SymbolHandle sym, const char** out_str);
+int MXSymbolGetName(SymbolHandle sym, const char** out, int* success);
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle* symbols,
+                        SymbolHandle* out);
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle* out);
+int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle* out);
+int MXSymbolGetAttr(SymbolHandle sym, const char* key, const char** out,
+                    int* success);
+int MXSymbolSetAttr(SymbolHandle sym, const char* key, const char* value);
+/* flat [key0, val0, key1, val1, ...] like the reference */
+int MXSymbolListAttr(SymbolHandle sym, mx_uint* out_size, const char*** out);
+int MXSymbolListAttrShallow(SymbolHandle sym, mx_uint* out_size,
+                            const char*** out);
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator, const char** name,
+                                const char** description, mx_uint* num_args,
+                                const char*** arg_names,
+                                const char*** arg_type_infos,
+                                const char*** arg_descriptions,
+                                const char** key_var_num_args);
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char** keys,
+                      const int* arg_type_data, mx_uint* in_type_size,
+                      const int** in_type_data, mx_uint* out_type_size,
+                      const int** out_type_data, mx_uint* aux_type_size,
+                      const int** aux_type_data, int* complete);
+int MXExecutorPrint(ExecutorHandle exec, const char** out_str);
+int MXKVStoreGetType(KVStoreHandle kv, const char** out);
+int MXKVStoreIsWorkerNode(int* ret);
+int MXKVStoreIsServerNode(int* ret);
+int MXKVStoreIsSchedulerNode(int* ret);
+int MXKVStoreBarrier(KVStoreHandle kv);
+
 /* ---- DataIter (reference: c_api.h MXListDataIters / MXDataIterCreateIter /
  * Next / BeforeFirst / GetData / GetLabel / GetDataShape / GetPadNum) ----
  * Params are strings, parsed by the iterator's schema (shapes like
@@ -114,7 +205,6 @@ int MXDataIterGetPadNum(DataIterHandle iter, int* out);
  * Values cross the boundary as float32 buffers; aggregation runs on the
  * framework's KVStore (same compute path as the Python surface). Pull
  * pointers stay valid until the next pull on the same handle. */
-typedef void* KVStoreHandle;
 int MXKVStoreCreate(const char* type, KVStoreHandle* out);
 int MXKVStoreFree(KVStoreHandle kv);
 int MXKVStoreGetRank(KVStoreHandle kv, int* out);

@@ -1,0 +1,62 @@
+"""Round-4 C API long tail, exercised by a compiled pure-C client
+(tests/c/api_longtail_client.c): MXImperativeInvoke (reference
+c_api.h:518), MXSymbolInferShape (:854), MXExecutorSetMonitorCallback
+(:1087), NDArray views + raw-bytes serialization (:271-418), and creator
+introspection (:604-644). Plus the coverage-manifest drift gate
+(tools/c_api_coverage.py, VERDICT round-3 item 7).
+"""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(ROOT, "mxnet_tpu", "src")
+
+needs_toolchain = pytest.mark.skipif(shutil.which("gcc") is None,
+                                     reason="no C toolchain")
+
+
+def _build_shim():
+    r = subprocess.run(["make", "c_predict"], cwd=SRC, capture_output=True,
+                       text=True)
+    if r.returncode != 0:
+        pytest.fail("shim build failed: %s" % r.stderr[-500:])
+    return os.path.join(SRC, "build", "libmxtpu_predict.so")
+
+
+@needs_toolchain
+def test_c_client_long_tail(tmp_path):
+    lib = _build_shim()
+    exe = str(tmp_path / "longtail")
+    r = subprocess.run(
+        ["gcc", "-O2", "-o", exe,
+         os.path.join(ROOT, "tests", "c", "api_longtail_client.c"),
+         "-L", os.path.dirname(lib), "-lmxtpu_predict",
+         "-Wl,-rpath," + os.path.dirname(lib), "-lm"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert r.stdout.startswith("OK"), r.stdout
+
+
+def test_coverage_manifest_current():
+    """docs/c_api_coverage.md must match the built libraries + reference
+    headers (skips when the reference checkout is absent)."""
+    if not os.path.exists("/root/reference/include/mxnet/c_api.h"):
+        pytest.skip("reference not available")
+    _build_shim()
+    r = subprocess.run(["make", "c_predict_native"], cwd=SRC,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-500:]
+    r = subprocess.run(
+        ["python", os.path.join(ROOT, "tools", "c_api_coverage.py"),
+         "--check"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
