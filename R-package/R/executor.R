@@ -1,0 +1,72 @@
+# Executor binding + execution (reference: R-package/R/executor.R —
+# mx.simple.bind / mx.exec.forward / mx.exec.backward over the C API).
+
+#' Bind a symbol into an executor. Shapes are passed for the DATA/LABEL
+#' inputs; parameter shapes are inferred (the C side runs simple_bind).
+#'   ex <- mx.simple.bind(sym, ctx = "cpu", grad.req = "write",
+#'                        data = c(32, 10), softmax_label = c(32))
+mx.simple.bind <- function(symbol, ctx = "cpu", dev.id = 0,
+                           grad.req = "write", ...) {
+  shapes <- list(...)
+  handle <- .Call("RMX_simple_bind", symbol$handle, ctx,
+                  as.integer(dev.id), names(shapes),
+                  lapply(shapes, as.integer), grad.req)
+  structure(list(handle = handle, symbol = symbol,
+                 input.names = names(shapes)),
+            class = "MXExecutor")
+}
+
+#' Write an input/parameter value (row-major; R arrays are column-major, so
+#' multi-dim values must already be flattened row-major — mx.nd.flatten).
+mx.exec.set.arg <- function(exec, name, value) {
+  invisible(.Call("RMX_set_arg", exec$handle, name, as.double(value)))
+}
+
+mx.exec.get.arg <- function(exec, name) .Call("RMX_get_arg", exec$handle, name)
+mx.exec.get.grad <- function(exec, name) .Call("RMX_get_grad", exec$handle, name)
+mx.exec.get.aux <- function(exec, name) .Call("RMX_get_aux", exec$handle, name)
+
+mx.exec.forward <- function(exec, is.train = TRUE) {
+  invisible(.Call("RMX_forward", exec$handle, as.integer(is.train)))
+}
+
+mx.exec.backward <- function(exec) {
+  invisible(.Call("RMX_backward", exec$handle))
+}
+
+mx.exec.num.outputs <- function(exec) .Call("RMX_num_outputs", exec$handle)
+
+#' Output i (0-based, matching the C API), as a numeric vector plus its
+#' row-major shape attribute.
+mx.exec.get.output <- function(exec, index = 0) {
+  v <- .Call("RMX_get_output", exec$handle, as.integer(index))
+  attr(v, "mx.shape") <- .Call("RMX_output_shape", exec$handle,
+                               as.integer(index))
+  v
+}
+
+#' In-framework updates (reference optimizer semantics: loss gradients are
+#' batch-summed; pass rescale = 1/batch.size for batch-mean training).
+mx.exec.sgd.update <- function(exec, lr, wd = 0, rescale = 1) {
+  invisible(.Call("RMX_sgd_update", exec$handle, lr, wd, rescale))
+}
+
+mx.exec.momentum.update <- function(exec, lr, wd = 0, momentum = 0.9,
+                                    rescale = 1) {
+  invisible(.Call("RMX_momentum_update", exec$handle, lr, wd, momentum,
+                  rescale))
+}
+
+mx.exec.init.xavier <- function(exec, seed = 0) {
+  invisible(.Call("RMX_init_xavier", exec$handle, as.integer(seed)))
+}
+
+#' Checkpoint interchange: the reference `arg:`/`aux:` NDArray-dict format —
+#' files load into python Module/FeedForward and the reference itself.
+mx.exec.save.params <- function(exec, path) {
+  invisible(.Call("RMX_save_params", exec$handle, path))
+}
+
+mx.exec.load.params <- function(exec, path) {
+  .Call("RMX_load_params", exec$handle, path)
+}

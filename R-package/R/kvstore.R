@@ -1,0 +1,24 @@
+# KVStore (reference: R-package/R/kvstore.R — mx.kv.create over the C API).
+
+mx.kv.create <- function(type = "local") {
+  structure(list(handle = .Call("RMX_kv_create", type)), class = "MXKVStore")
+}
+
+mx.kv.rank <- function(kv) .Call("RMX_kv_rank", kv$handle)
+mx.kv.num.workers <- function(kv) .Call("RMX_kv_num_workers", kv$handle)
+
+mx.kv.init <- function(kv, key, value, shape) {
+  invisible(.Call("RMX_kv_init", kv$handle, as.integer(key),
+                  as.double(value), as.integer(shape)))
+}
+
+mx.kv.push <- function(kv, key, value, shape) {
+  invisible(.Call("RMX_kv_push", kv$handle, as.integer(key),
+                  as.double(value), as.integer(shape)))
+}
+
+mx.kv.pull <- function(kv, key) .Call("RMX_kv_pull", kv$handle,
+                                      as.integer(key))
+
+mx.set.seed <- function(seed) invisible(.Call("RMX_random_seed",
+                                              as.integer(seed)))

@@ -1,0 +1,117 @@
+# FeedForward-shaped estimator (reference: R-package/R/model.R —
+# mx.model.FeedForward.create: bind, init, epoch loop of
+# forward/backward/update, checkpoint save/load).
+
+#' Train a feed-forward network.
+#'
+#' @param symbol the network (its last op a loss head, e.g. SoftmaxOutput)
+#' @param X numeric matrix, one ROW per example (converted row-major)
+#' @param y numeric label vector
+#' @param batch.size,num.round,learning.rate,momentum,wd usual knobs
+#' @return an MXFeedForwardModel (symbol + bound executor)
+mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
+                                        num.round = 10, learning.rate = 0.1,
+                                        momentum = 0.9, wd = 0,
+                                        initializer.seed = 0,
+                                        verbose = FALSE) {
+  n <- nrow(X)
+  if (n %% batch.size != 0)
+    stop("batch.size must divide nrow(X) (pad your data)")
+  data.name <- "data"
+  label.name <- grep("label", arguments(symbol), value = TRUE)[1]
+  shapes <- list(c(batch.size, ncol(X)), c(batch.size))
+  names(shapes) <- c(data.name, label.name)
+  exec <- do.call(mx.simple.bind,
+                  c(list(symbol = symbol, ctx = "cpu", grad.req = "write"),
+                    shapes))
+  mx.exec.init.xavier(exec, initializer.seed)
+  n.batch <- n / batch.size
+  for (round in seq_len(num.round)) {
+    for (b in seq_len(n.batch)) {
+      rows <- ((b - 1) * batch.size + 1):(b * batch.size)
+      # t() flattens row-major for the C API's row-major contract
+      mx.exec.set.arg(exec, data.name, as.double(t(X[rows, , drop = FALSE])))
+      mx.exec.set.arg(exec, label.name, as.double(y[rows]))
+      mx.exec.forward(exec, is.train = TRUE)
+      mx.exec.backward(exec)
+      mx.exec.momentum.update(exec, lr = learning.rate, wd = wd,
+                              momentum = momentum,
+                              rescale = 1 / batch.size)
+    }
+    if (verbose)
+      cat(sprintf("round %d: train.acc=%.4f\n", round,
+                  mx.model.accuracy(exec, X, y, batch.size, data.name,
+                                    label.name)))
+  }
+  structure(list(symbol = symbol, exec = exec, batch.size = batch.size,
+                 data.name = data.name, label.name = label.name),
+            class = "MXFeedForwardModel")
+}
+
+mx.model.accuracy <- function(exec, X, y, batch.size, data.name = "data",
+                              label.name = "softmax_label") {
+  n <- nrow(X)
+  if (n %% batch.size != 0)
+    stop("nrow(X) must be a multiple of batch.size (the bound executor has",
+         " a fixed batch); pad or subset your data")
+  correct <- 0
+  for (b in seq_len(n / batch.size)) {
+    rows <- ((b - 1) * batch.size + 1):(b * batch.size)
+    mx.exec.set.arg(exec, data.name, as.double(t(X[rows, , drop = FALSE])))
+    mx.exec.forward(exec, is.train = FALSE)
+    out <- mx.exec.get.output(exec, 0)
+    shp <- attr(out, "mx.shape")
+    probs <- matrix(out, nrow = shp[1], ncol = shp[2], byrow = TRUE)
+    pred <- max.col(probs) - 1
+    correct <- correct + sum(pred == y[rows])
+  }
+  correct / n
+}
+
+#' Predict class probabilities for X (row-major batches).
+predict.MXFeedForwardModel <- function(object, X, ...) {
+  exec <- object$exec
+  bs <- object$batch.size
+  n <- nrow(X)
+  out.all <- NULL
+  for (b in seq_len(ceiling(n / bs))) {
+    rows <- ((b - 1) * bs + 1):min(b * bs, n)
+    pad <- bs - length(rows)
+    Xb <- X[c(rows, rep(rows[length(rows)], pad)), , drop = FALSE]
+    mx.exec.set.arg(exec, object$data.name, as.double(t(Xb)))
+    mx.exec.forward(exec, is.train = FALSE)
+    out <- mx.exec.get.output(exec, 0)
+    shp <- attr(out, "mx.shape")
+    probs <- matrix(out, nrow = shp[1], ncol = shp[2], byrow = TRUE)
+    if (is.null(out.all))  # allocate once, now that ncol is known
+      out.all <- matrix(0, nrow = n, ncol = shp[2])
+    out.all[rows, ] <- probs[seq_along(rows), , drop = FALSE]
+  }
+  out.all
+}
+
+#' Save `prefix-symbol.json` + `prefix-%04d.params` (reference
+#' model.save_checkpoint format — interchange with python and the
+#' reference).
+mx.model.save <- function(model, prefix, iteration = 1) {
+  mx.symbol.save(model$symbol, sprintf("%s-symbol.json", prefix))
+  mx.exec.save.params(model$exec, sprintf("%s-%04d.params", prefix,
+                                          iteration))
+  invisible(NULL)
+}
+
+#' Load a checkpoint back into a bound model (shapes from `input.shapes`,
+#' a named list like the bind call's).
+mx.model.load <- function(prefix, iteration, input.shapes) {
+  symbol <- mx.symbol.load(sprintf("%s-symbol.json", prefix))
+  exec <- do.call(mx.simple.bind,
+                  c(list(symbol = symbol, ctx = "cpu", grad.req = "null"),
+                    input.shapes))
+  mx.exec.load.params(exec, sprintf("%s-%04d.params", prefix, iteration))
+  data.name <- names(input.shapes)[1]
+  label.name <- names(input.shapes)[2]
+  structure(list(symbol = symbol, exec = exec,
+                 batch.size = input.shapes[[1]][1],
+                 data.name = data.name, label.name = label.name),
+            class = "MXFeedForwardModel")
+}

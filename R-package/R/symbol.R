@@ -1,0 +1,83 @@
+# Symbol construction (reference: R-package/R/symbol.R — generated
+# mx.symbol.* constructors over the C atomic-symbol registry; here every op
+# flows through one generic creator, RMX_symbol_create ->
+# MXSymbolCreateFromOperator, so the whole registry is reachable).
+
+#' Create a placeholder variable.
+mx.symbol.Variable <- function(name) {
+  structure(list(handle = .Call("RMX_symbol_variable", name)),
+            class = "MXSymbol")
+}
+
+#' Generic operator constructor: mx.symbol.create("FullyConnected",
+#' data = sym, num_hidden = 10, name = "fc1"). Symbol-valued arguments
+#' become graph inputs; everything else is stringified into the op's
+#' parameter schema (the C API convention).
+mx.symbol.create <- function(op, ..., name = "") {
+  args <- list(...)
+  pkeys <- character(0); pvals <- character(0)
+  ikeys <- character(0); isyms <- list()
+  arg_names <- names(args)
+  if (is.null(arg_names)) arg_names <- rep("", length(args))
+  for (i in seq_along(args)) {
+    a <- args[[i]]
+    if (inherits(a, "MXSymbol")) {
+      ikeys <- c(ikeys, arg_names[i])
+      isyms <- c(isyms, list(a$handle))
+    } else {
+      pkeys <- c(pkeys, arg_names[i])
+      pvals <- c(pvals, mx.internal.param.str(a))
+    }
+  }
+  structure(list(handle = .Call("RMX_symbol_create", op, name, pkeys, pvals,
+                                ikeys, isyms)),
+            class = "MXSymbol")
+}
+
+# shape/tuple params print as "(a, b)" like the python/reference string form
+mx.internal.param.str <- function(v) {
+  if (length(v) > 1) paste0("(", paste(v, collapse = ", "), ")")
+  else as.character(v)
+}
+
+# named wrappers for the common layers (reference generates these; the
+# generic creator reaches every other registered op)
+mx.symbol.FullyConnected <- function(...) mx.symbol.create("FullyConnected", ...)
+mx.symbol.Activation <- function(...) mx.symbol.create("Activation", ...)
+mx.symbol.Convolution <- function(...) mx.symbol.create("Convolution", ...)
+mx.symbol.Pooling <- function(...) mx.symbol.create("Pooling", ...)
+mx.symbol.Flatten <- function(...) mx.symbol.create("Flatten", ...)
+mx.symbol.SoftmaxOutput <- function(...) mx.symbol.create("SoftmaxOutput", ...)
+mx.symbol.BatchNorm <- function(...) mx.symbol.create("BatchNorm", ...)
+mx.symbol.Dropout <- function(...) mx.symbol.create("Dropout", ...)
+mx.symbol.LinearRegressionOutput <-
+  function(...) mx.symbol.create("LinearRegressionOutput", ...)
+
+mx.symbol.load.json <- function(json) {
+  structure(list(handle = .Call("RMX_symbol_from_json", json)),
+            class = "MXSymbol")
+}
+
+mx.symbol.load <- function(file) {
+  mx.symbol.load.json(paste(readLines(file, warn = FALSE), collapse = "\n"))
+}
+
+mx.symbol.save <- function(symbol, file) {
+  writeLines(.Call("RMX_symbol_to_json", symbol$handle), file)
+}
+
+mx.symbol.tojson <- function(symbol) .Call("RMX_symbol_to_json", symbol$handle)
+
+arguments <- function(symbol) .Call("RMX_symbol_arguments", symbol$handle)
+
+mx.symbol.infer.shape <- function(symbol, ...) {
+  shapes <- list(...)
+  keys <- names(shapes)
+  res <- .Call("RMX_symbol_infer_shape", symbol$handle, keys,
+               lapply(shapes, as.integer))
+  args <- arguments(symbol)
+  arg.shapes <- res[[1]]
+  if (length(arg.shapes) == length(args)) names(arg.shapes) <- args
+  list(arg.shapes = arg.shapes, out.shapes = res[[2]],
+       aux.shapes = res[[3]], complete = res[[4]] == 1L)
+}

@@ -1,0 +1,449 @@
+/* R .Call shim over the C training API (reference: R-package/src/ —
+ * Rcpp glue over include/mxnet/c_api.h; this build uses plain .Call so the
+ * package needs no Rcpp, mirroring the Perl XS binding's
+ * no-extra-deps approach, perl-package/AI-MXNetTPU/MXNetTPU.xs).
+ *
+ * Build: R CMD SHLIB against libmxtpu_predict.so (src/Makevars). Every
+ * handle crosses into R as an external pointer with a finalizer; all float
+ * buffers marshal through R numeric (double) vectors and convert at the
+ * boundary (the C API is float32).
+ *
+ * Symbol construction reaches the WHOLE op registry through
+ * RMX_symbol_create (MXSymbolCreateFromOperator) — R-side op wrappers are
+ * thin name bindings, the same design as the reference's generated
+ * mx.symbol.* (R-package/R/symbol.R). */
+#include <string.h>
+
+#include <R.h>
+#include <Rinternals.h>
+
+#include "c_train_api.h"
+
+/* ---- error helper ---- */
+static void check(int rc, const char* what) {
+  if (rc != 0) Rf_error("%s: %s", what, MXTrainGetLastError());
+}
+
+/* ---- external pointer plumbing ---- */
+static void sym_finalizer(SEXP p) {
+  SymbolHandle h = R_ExternalPtrAddr(p);
+  if (h) {
+    MXSymbolFree(h);
+    R_ClearExternalPtr(p);
+  }
+}
+
+static void exec_finalizer(SEXP p) {
+  ExecutorHandle h = R_ExternalPtrAddr(p);
+  if (h) {
+    MXExecutorFree(h);
+    R_ClearExternalPtr(p);
+  }
+}
+
+static void kv_finalizer(SEXP p) {
+  KVStoreHandle h = R_ExternalPtrAddr(p);
+  if (h) {
+    MXKVStoreFree(h);
+    R_ClearExternalPtr(p);
+  }
+}
+
+static SEXP wrap_ptr(void* h, void (*fin)(SEXP)) {
+  SEXP p = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(p, fin, TRUE);
+  UNPROTECT(1);
+  return p;
+}
+
+static void* unwrap(SEXP p, const char* what) {
+  void* h = R_ExternalPtrAddr(p);
+  if (!h) Rf_error("%s: handle already freed", what);
+  return h;
+}
+
+/* ---- Symbol ---- */
+SEXP RMX_symbol_from_json(SEXP json) {
+  SymbolHandle h = NULL;
+  check(MXSymbolCreateFromJSON(CHAR(STRING_ELT(json, 0)), &h),
+        "MXSymbolCreateFromJSON");
+  return wrap_ptr(h, sym_finalizer);
+}
+
+SEXP RMX_symbol_to_json(SEXP sym) {
+  const char* out = NULL;
+  check(MXSymbolSaveToJSON(unwrap(sym, "symbol"), &out), "MXSymbolSaveToJSON");
+  return Rf_mkString(out);
+}
+
+SEXP RMX_symbol_variable(SEXP name) {
+  SymbolHandle h = NULL;
+  check(MXSymbolCreateVariable(CHAR(STRING_ELT(name, 0)), &h),
+        "MXSymbolCreateVariable");
+  return wrap_ptr(h, sym_finalizer);
+}
+
+SEXP RMX_symbol_create(SEXP op, SEXP name, SEXP param_keys, SEXP param_vals,
+                       SEXP input_keys, SEXP inputs) {
+  int np = LENGTH(param_keys);
+  int ni = LENGTH(inputs);
+  const char** pk = (const char**)R_alloc(np, sizeof(char*));
+  const char** pv = (const char**)R_alloc(np, sizeof(char*));
+  const char** ik = (const char**)R_alloc(ni, sizeof(char*));
+  SymbolHandle* ih = (SymbolHandle*)R_alloc(ni, sizeof(SymbolHandle));
+  for (int i = 0; i < np; ++i) {
+    pk[i] = CHAR(STRING_ELT(param_keys, i));
+    pv[i] = CHAR(STRING_ELT(param_vals, i));
+  }
+  for (int i = 0; i < ni; ++i) {
+    ik[i] = CHAR(STRING_ELT(input_keys, i));
+    ih[i] = unwrap(VECTOR_ELT(inputs, i), "input symbol");
+  }
+  SymbolHandle h = NULL;
+  check(MXSymbolCreateFromOperator(CHAR(STRING_ELT(op, 0)),
+                                   CHAR(STRING_ELT(name, 0)), np, pk, pv, ni,
+                                   ik, ih, &h),
+        "MXSymbolCreateFromOperator");
+  return wrap_ptr(h, sym_finalizer);
+}
+
+static SEXP strings_out(mx_uint n, const char** arr) {
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (mx_uint i = 0; i < n; ++i)
+    SET_STRING_ELT(out, i, Rf_mkChar(arr[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP RMX_symbol_arguments(SEXP sym) {
+  mx_uint n = 0;
+  const char** arr = NULL;
+  check(MXSymbolListArguments(unwrap(sym, "symbol"), &n, &arr),
+        "MXSymbolListArguments");
+  return strings_out(n, arr);
+}
+
+SEXP RMX_symbol_outputs(SEXP sym) {
+  mx_uint n = 0;
+  const char** arr = NULL;
+  check(MXSymbolListOutputs(unwrap(sym, "symbol"), &n, &arr),
+        "MXSymbolListOutputs");
+  return strings_out(n, arr);
+}
+
+SEXP RMX_symbol_aux_states(SEXP sym) {
+  mx_uint n = 0;
+  const char** arr = NULL;
+  check(MXSymbolListAuxiliaryStates(unwrap(sym, "symbol"), &n, &arr),
+        "MXSymbolListAuxiliaryStates");
+  return strings_out(n, arr);
+}
+
+/* shapes: named list of integer vectors -> CSR tables */
+static void csr_shapes(SEXP keys, SEXP shapes, const char*** out_keys,
+                       mx_uint** out_data, mx_uint** out_idx, mx_uint* n) {
+  int nk = LENGTH(keys);
+  mx_uint total = 0;
+  for (int i = 0; i < nk; ++i) total += LENGTH(VECTOR_ELT(shapes, i));
+  const char** k = (const char**)R_alloc(nk, sizeof(char*));
+  mx_uint* data = (mx_uint*)R_alloc(total, sizeof(mx_uint));
+  mx_uint* idx = (mx_uint*)R_alloc(nk + 1, sizeof(mx_uint));
+  idx[0] = 0;
+  mx_uint pos = 0;
+  for (int i = 0; i < nk; ++i) {
+    k[i] = CHAR(STRING_ELT(keys, i));
+    SEXP s = VECTOR_ELT(shapes, i);
+    for (int j = 0; j < LENGTH(s); ++j)
+      data[pos++] = (mx_uint)INTEGER(s)[j];
+    idx[i + 1] = pos;
+  }
+  *out_keys = k;
+  *out_data = data;
+  *out_idx = idx;
+  *n = (mx_uint)nk;
+}
+
+SEXP RMX_symbol_infer_shape(SEXP sym, SEXP keys, SEXP shapes) {
+  const char** k;
+  mx_uint *data, *idx, nk;
+  csr_shapes(keys, shapes, &k, &data, &idx, &nk);
+  mx_uint in_sz, out_sz, aux_sz;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_d, **out_d, **aux_d;
+  int complete = 0;
+  check(MXSymbolInferShape(unwrap(sym, "symbol"), nk, k, idx, data, &in_sz,
+                           &in_nd, &in_d, &out_sz, &out_nd, &out_d, &aux_sz,
+                           &aux_nd, &aux_d, &complete),
+        "MXSymbolInferShape");
+  SEXP ret = PROTECT(Rf_allocVector(VECSXP, 4));
+  const mx_uint* sizes[3] = {&in_sz, &out_sz, &aux_sz};
+  const mx_uint* nds[3] = {in_nd, out_nd, aux_nd};
+  const mx_uint** ds[3] = {in_d, out_d, aux_d};
+  for (int t = 0; t < 3; ++t) {
+    SEXP lst = PROTECT(Rf_allocVector(VECSXP, *sizes[t]));
+    for (mx_uint i = 0; i < *sizes[t]; ++i) {
+      SEXP v = PROTECT(Rf_allocVector(INTSXP, nds[t][i]));
+      for (mx_uint j = 0; j < nds[t][i]; ++j)
+        INTEGER(v)[j] = (int)ds[t][i][j];
+      SET_VECTOR_ELT(lst, i, v);
+      UNPROTECT(1);
+    }
+    SET_VECTOR_ELT(ret, t, lst);
+    UNPROTECT(1);
+  }
+  SET_VECTOR_ELT(ret, 3, Rf_ScalarInteger(complete));
+  UNPROTECT(1);
+  return ret;
+}
+
+/* ---- Executor ---- */
+SEXP RMX_simple_bind(SEXP sym, SEXP dev_type, SEXP dev_id, SEXP keys,
+                     SEXP shapes, SEXP grad_req) {
+  const char** k;
+  mx_uint *data, *idx, nk;
+  csr_shapes(keys, shapes, &k, &data, &idx, &nk);
+  ExecutorHandle h = NULL;
+  check(MXExecutorSimpleBindLite(unwrap(sym, "symbol"),
+                                 CHAR(STRING_ELT(dev_type, 0)),
+                                 Rf_asInteger(dev_id), nk, k, data, idx,
+                                 CHAR(STRING_ELT(grad_req, 0)), &h),
+        "MXExecutorSimpleBindLite");
+  return wrap_ptr(h, exec_finalizer);
+}
+
+SEXP RMX_set_arg(SEXP ex, SEXP name, SEXP value) {
+  int n = LENGTH(value);
+  float* buf = (float*)R_alloc(n, sizeof(float));
+  const double* src = REAL(value);
+  for (int i = 0; i < n; ++i) buf[i] = (float)src[i];
+  check(MXExecutorSetArg(unwrap(ex, "executor"), CHAR(STRING_ELT(name, 0)),
+                         buf, (mx_uint)n),
+        "MXExecutorSetArg");
+  return R_NilValue;
+}
+
+static SEXP floats_out(const float* data, mx_uint n) {
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  for (mx_uint i = 0; i < n; ++i) REAL(out)[i] = (double)data[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP RMX_get_arg(SEXP ex, SEXP name) {
+  const float* out = NULL;
+  mx_uint n = 0;
+  check(MXExecutorGetArg(unwrap(ex, "executor"), CHAR(STRING_ELT(name, 0)),
+                         &out, &n),
+        "MXExecutorGetArg");
+  return floats_out(out, n);
+}
+
+SEXP RMX_get_grad(SEXP ex, SEXP name) {
+  const float* out = NULL;
+  mx_uint n = 0;
+  check(MXExecutorGetGrad(unwrap(ex, "executor"), CHAR(STRING_ELT(name, 0)),
+                          &out, &n),
+        "MXExecutorGetGrad");
+  return floats_out(out, n);
+}
+
+SEXP RMX_get_aux(SEXP ex, SEXP name) {
+  const float* out = NULL;
+  mx_uint n = 0;
+  check(MXExecutorGetAux(unwrap(ex, "executor"), CHAR(STRING_ELT(name, 0)),
+                         &out, &n),
+        "MXExecutorGetAux");
+  return floats_out(out, n);
+}
+
+SEXP RMX_get_output(SEXP ex, SEXP index) {
+  const float* out = NULL;
+  mx_uint n = 0;
+  check(MXExecutorGetOutput(unwrap(ex, "executor"), Rf_asInteger(index), &out,
+                            &n),
+        "MXExecutorGetOutput");
+  return floats_out(out, n);
+}
+
+SEXP RMX_output_shape(SEXP ex, SEXP index) {
+  const mx_uint* shape = NULL;
+  mx_uint ndim = 0;
+  check(MXExecutorOutputShape(unwrap(ex, "executor"), Rf_asInteger(index),
+                              &shape, &ndim),
+        "MXExecutorOutputShape");
+  SEXP out = PROTECT(Rf_allocVector(INTSXP, ndim));
+  for (mx_uint i = 0; i < ndim; ++i) INTEGER(out)[i] = (int)shape[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP RMX_num_outputs(SEXP ex) {
+  mx_uint n = 0;
+  check(MXExecutorNumOutputs(unwrap(ex, "executor"), &n),
+        "MXExecutorNumOutputs");
+  return Rf_ScalarInteger((int)n);
+}
+
+SEXP RMX_forward(SEXP ex, SEXP is_train) {
+  check(MXExecutorForward(unwrap(ex, "executor"), Rf_asInteger(is_train)),
+        "MXExecutorForward");
+  return R_NilValue;
+}
+
+SEXP RMX_backward(SEXP ex) {
+  check(MXExecutorBackward(unwrap(ex, "executor"), 0, NULL),
+        "MXExecutorBackward");
+  return R_NilValue;
+}
+
+SEXP RMX_sgd_update(SEXP ex, SEXP lr, SEXP wd, SEXP rescale) {
+  check(MXExecutorSGDUpdate(unwrap(ex, "executor"), (float)Rf_asReal(lr),
+                            (float)Rf_asReal(wd), (float)Rf_asReal(rescale)),
+        "MXExecutorSGDUpdate");
+  return R_NilValue;
+}
+
+SEXP RMX_momentum_update(SEXP ex, SEXP lr, SEXP wd, SEXP momentum,
+                         SEXP rescale) {
+  check(MXExecutorMomentumUpdate(unwrap(ex, "executor"), (float)Rf_asReal(lr),
+                                 (float)Rf_asReal(wd),
+                                 (float)Rf_asReal(momentum),
+                                 (float)Rf_asReal(rescale)),
+        "MXExecutorMomentumUpdate");
+  return R_NilValue;
+}
+
+SEXP RMX_init_xavier(SEXP ex, SEXP seed) {
+  check(MXExecutorInitXavier(unwrap(ex, "executor"), Rf_asInteger(seed)),
+        "MXExecutorInitXavier");
+  return R_NilValue;
+}
+
+SEXP RMX_save_params(SEXP ex, SEXP path) {
+  check(MXExecutorSaveParams(unwrap(ex, "executor"),
+                             CHAR(STRING_ELT(path, 0))),
+        "MXExecutorSaveParams");
+  return R_NilValue;
+}
+
+SEXP RMX_load_params(SEXP ex, SEXP path) {
+  mx_uint n = 0;
+  check(MXExecutorLoadParams(unwrap(ex, "executor"),
+                             CHAR(STRING_ELT(path, 0)), &n),
+        "MXExecutorLoadParams");
+  return Rf_ScalarInteger((int)n);
+}
+
+/* ---- KVStore ---- */
+SEXP RMX_kv_create(SEXP type) {
+  KVStoreHandle h = NULL;
+  check(MXKVStoreCreate(CHAR(STRING_ELT(type, 0)), &h), "MXKVStoreCreate");
+  return wrap_ptr(h, kv_finalizer);
+}
+
+SEXP RMX_kv_rank(SEXP kv) {
+  int rank = 0;
+  check(MXKVStoreGetRank(unwrap(kv, "kvstore"), &rank), "MXKVStoreGetRank");
+  return Rf_ScalarInteger(rank);
+}
+
+SEXP RMX_kv_num_workers(SEXP kv) {
+  int n = 0;
+  check(MXKVStoreGetGroupSize(unwrap(kv, "kvstore"), &n),
+        "MXKVStoreGetGroupSize");
+  return Rf_ScalarInteger(n);
+}
+
+/* shared marshal for init/push: double value vector + int shape vector ->
+ * float buffer + mx_uint dims, with the length checked against the shape
+ * (the C API trusts the shape; a mismatch would over-read the buffer) */
+static void kv_marshal(SEXP value, SEXP shape, float** out_buf,
+                       mx_uint** out_shp, mx_uint* out_ndim) {
+  int n = LENGTH(value);
+  long expect = 1;
+  for (int i = 0; i < LENGTH(shape); ++i) expect *= INTEGER(shape)[i];
+  if (expect != n)
+    Rf_error("value length %d does not match shape (product %ld)", n, expect);
+  float* buf = (float*)R_alloc(n, sizeof(float));
+  for (int i = 0; i < n; ++i) buf[i] = (float)REAL(value)[i];
+  mx_uint* shp = (mx_uint*)R_alloc(LENGTH(shape), sizeof(mx_uint));
+  for (int i = 0; i < LENGTH(shape); ++i) shp[i] = (mx_uint)INTEGER(shape)[i];
+  *out_buf = buf;
+  *out_shp = shp;
+  *out_ndim = (mx_uint)LENGTH(shape);
+}
+
+SEXP RMX_kv_init(SEXP kv, SEXP key, SEXP value, SEXP shape) {
+  float* buf;
+  mx_uint *shp, ndim;
+  kv_marshal(value, shape, &buf, &shp, &ndim);
+  check(MXKVStoreInit(unwrap(kv, "kvstore"), Rf_asInteger(key), buf, shp,
+                      ndim),
+        "MXKVStoreInit");
+  return R_NilValue;
+}
+
+SEXP RMX_kv_push(SEXP kv, SEXP key, SEXP value, SEXP shape) {
+  float* buf;
+  mx_uint *shp, ndim;
+  kv_marshal(value, shape, &buf, &shp, &ndim);
+  check(MXKVStorePush(unwrap(kv, "kvstore"), Rf_asInteger(key), buf, shp,
+                      ndim),
+        "MXKVStorePush");
+  return R_NilValue;
+}
+
+SEXP RMX_kv_pull(SEXP kv, SEXP key) {
+  const float* out = NULL;
+  mx_uint n = 0;
+  check(MXKVStorePull(unwrap(kv, "kvstore"), Rf_asInteger(key), &out, &n),
+        "MXKVStorePull");
+  return floats_out(out, n);
+}
+
+SEXP RMX_random_seed(SEXP seed) {
+  check(MXRandomSeed(Rf_asInteger(seed)), "MXRandomSeed");
+  return R_NilValue;
+}
+
+/* ---- registration ---- */
+#include <R_ext/Rdynload.h>
+
+#define ENTRY(name, nargs) {#name, (DL_FUNC)&name, nargs}
+static const R_CallMethodDef call_methods[] = {
+    ENTRY(RMX_symbol_from_json, 1),
+    ENTRY(RMX_symbol_to_json, 1),
+    ENTRY(RMX_symbol_variable, 1),
+    ENTRY(RMX_symbol_create, 6),
+    ENTRY(RMX_symbol_arguments, 1),
+    ENTRY(RMX_symbol_outputs, 1),
+    ENTRY(RMX_symbol_aux_states, 1),
+    ENTRY(RMX_symbol_infer_shape, 3),
+    ENTRY(RMX_simple_bind, 6),
+    ENTRY(RMX_set_arg, 3),
+    ENTRY(RMX_get_arg, 2),
+    ENTRY(RMX_get_grad, 2),
+    ENTRY(RMX_get_aux, 2),
+    ENTRY(RMX_get_output, 2),
+    ENTRY(RMX_output_shape, 2),
+    ENTRY(RMX_num_outputs, 1),
+    ENTRY(RMX_forward, 2),
+    ENTRY(RMX_backward, 1),
+    ENTRY(RMX_sgd_update, 4),
+    ENTRY(RMX_momentum_update, 5),
+    ENTRY(RMX_init_xavier, 2),
+    ENTRY(RMX_save_params, 2),
+    ENTRY(RMX_load_params, 2),
+    ENTRY(RMX_kv_create, 1),
+    ENTRY(RMX_kv_rank, 1),
+    ENTRY(RMX_kv_num_workers, 1),
+    ENTRY(RMX_kv_init, 4),
+    ENTRY(RMX_kv_push, 4),
+    ENTRY(RMX_kv_pull, 2),
+    ENTRY(RMX_random_seed, 1),
+    {NULL, NULL, 0}};
+
+void R_init_mxnetTPU(DllInfo* dll) {
+  R_registerRoutines(dll, NULL, call_methods, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}

@@ -1,0 +1,164 @@
+/* Executes the R binding's C shim (R-package/src/mxnet_tpu_r.c) against
+ * the stub R API (tests/c/r_stub/): builds the same MLP the R test builds,
+ * trains it through the RMX_* entry points, and requires >90% accuracy —
+ * so the shim's marshaling (CSR shapes, float conversion, handle wrapping)
+ * is EXECUTED even though no R interpreter exists here. The compile unit
+ * is the real shim file, included directly. */
+#include "../../R-package/src/mxnet_tpu_r.c"
+
+#include <math.h>
+
+static SEXP str1(const char* s) { return Rf_mkString(s); }
+
+static SEXP strvec(int n, const char** v) {
+  SEXP s = Rf_allocVector(STRSXP, n);
+  for (int i = 0; i < n; ++i) SET_STRING_ELT(s, i, Rf_mkChar(v[i]));
+  return s;
+}
+
+static SEXP intvec(int n, const int* v) {
+  SEXP s = Rf_allocVector(INTSXP, n);
+  for (int i = 0; i < n; ++i) INTEGER(s)[i] = v[i];
+  return s;
+}
+
+static SEXP realvec(int n, const double* v) {
+  SEXP s = Rf_allocVector(REALSXP, n);
+  for (int i = 0; i < n; ++i) REAL(s)[i] = v[i];
+  return s;
+}
+
+static SEXP vecsxp1(SEXP a) {
+  SEXP s = Rf_allocVector(VECSXP, 1);
+  SET_VECTOR_ELT(s, 0, a);
+  return s;
+}
+
+static SEXP make_op(const char* op, const char* name, const char* pkey,
+                    const char* pval, SEXP input) {
+  const char* ik[1] = {"data"};
+  SEXP pkeys = pkey ? strvec(1, &pkey) : strvec(0, NULL);
+  SEXP pvals = pval ? strvec(1, &pval) : strvec(0, NULL);
+  return RMX_symbol_create(str1(op), str1(name), pkeys, pvals,
+                           strvec(1, ik), vecsxp1(input));
+}
+
+int main(void) {
+  /* net: data -> fc1(16) -> relu -> fc2(2) -> softmax */
+  SEXP data = RMX_symbol_variable(str1("data"));
+  SEXP fc1 = make_op("FullyConnected", "fc1", "num_hidden", "16", data);
+  SEXP act = make_op("Activation", "act", "act_type", "relu", fc1);
+  SEXP fc2 = make_op("FullyConnected", "fc2", "num_hidden", "2", act);
+  SEXP net = make_op("SoftmaxOutput", "softmax", NULL, NULL, fc2);
+
+  /* infer shape sanity: fc1_weight must come back (16, 10) */
+  {
+    const char* k[1] = {"data"};
+    int d[2] = {32, 10};
+    SEXP res = RMX_symbol_infer_shape(net, strvec(1, k),
+                                      vecsxp1(intvec(2, d)));
+    if (Rf_asInteger(VECTOR_ELT(res, 3)) != 1) {
+      fprintf(stderr, "infer_shape incomplete\n");
+      return 1;
+    }
+    SEXP args = RMX_symbol_arguments(net);
+    SEXP in_shapes = VECTOR_ELT(res, 0);
+    int ok = 0;
+    for (int i = 0; i < LENGTH(args); ++i) {
+      if (strcmp(CHAR(STRING_ELT(args, i)), "fc1_weight") == 0) {
+        SEXP s = VECTOR_ELT(in_shapes, i);
+        ok = LENGTH(s) == 2 && INTEGER(s)[0] == 16 && INTEGER(s)[1] == 10;
+      }
+    }
+    if (!ok) { fprintf(stderr, "fc1_weight shape wrong\n"); return 1; }
+  }
+
+  /* json round trip through the shim */
+  {
+    SEXP json = RMX_symbol_to_json(net);
+    SEXP back = RMX_symbol_from_json(json);
+    SEXP outs = RMX_symbol_outputs(back);
+    if (LENGTH(outs) != 1 ||
+        strcmp(CHAR(STRING_ELT(outs, 0)), "softmax_output") != 0) {
+      fprintf(stderr, "json roundtrip outputs wrong\n");
+      return 1;
+    }
+  }
+
+  /* bind: batch 32, 10 features */
+  enum { N = 256, P = 10, BS = 32 };
+  const char* bind_keys[2] = {"data", "softmax_label"};
+  int dshape[2] = {BS, P};
+  int lshape[1] = {BS};
+  SEXP shapes = Rf_allocVector(VECSXP, 2);
+  SET_VECTOR_ELT(shapes, 0, intvec(2, dshape));
+  SET_VECTOR_ELT(shapes, 1, intvec(1, lshape));
+  SEXP ex = RMX_simple_bind(net, str1("cpu"), Rf_ScalarInteger(0),
+                            strvec(2, bind_keys), shapes, str1("write"));
+  RMX_init_xavier(ex, Rf_ScalarInteger(7));
+
+  /* linearly separable data (xorshift PRNG, self-contained) */
+  static double X[N * P], Y[N];
+  unsigned long long state = 88172645463325252ull;
+  for (int i = 0; i < N * P; ++i) {
+    state ^= state << 13;
+    state ^= state >> 7;
+    state ^= state << 17;
+    X[i] = ((double)(state % 20000) / 10000.0) - 1.0;
+  }
+  for (int i = 0; i < N; ++i)
+    Y[i] = (X[i * P] + 0.5 * X[i * P + 1] > 0) ? 1.0 : 0.0;
+
+  /* train: 15 epochs of momentum SGD through the shim */
+  for (int epoch = 0; epoch < 15; ++epoch) {
+    for (int b = 0; b < N / BS; ++b) {
+      RMX_set_arg(ex, str1("data"), realvec(BS * P, X + b * BS * P));
+      RMX_set_arg(ex, str1("softmax_label"), realvec(BS, Y + b * BS));
+      RMX_forward(ex, Rf_ScalarInteger(1));
+      RMX_backward(ex);
+      SEXP lr = realvec(1, (double[]){0.2});
+      SEXP wd = realvec(1, (double[]){0.0});
+      SEXP mom = realvec(1, (double[]){0.9});
+      SEXP rescale = realvec(1, (double[]){1.0 / BS});
+      RMX_momentum_update(ex, lr, wd, mom, rescale);
+    }
+  }
+
+  /* accuracy */
+  int correct = 0;
+  for (int b = 0; b < N / BS; ++b) {
+    RMX_set_arg(ex, str1("data"), realvec(BS * P, X + b * BS * P));
+    RMX_forward(ex, Rf_ScalarInteger(0));
+    SEXP out = RMX_get_output(ex, Rf_ScalarInteger(0));
+    for (int i = 0; i < BS; ++i) {
+      int pred = REAL(out)[i * 2 + 1] > REAL(out)[i * 2] ? 1 : 0;
+      if (pred == (int)Y[b * BS + i]) ++correct;
+    }
+  }
+  double acc = (double)correct / N;
+  printf("R_SHIM_SMOKE acc=%.4f\n", acc);
+  if (acc <= 0.90) { fprintf(stderr, "accuracy too low\n"); return 1; }
+
+  /* checkpoint through the shim, reload, predictions must match */
+  RMX_save_params(ex, str1("/tmp/r_shim_smoke.params"));
+  SEXP ex2 = RMX_simple_bind(net, str1("cpu"), Rf_ScalarInteger(0),
+                             strvec(2, bind_keys), shapes, str1("null"));
+  SEXP n_loaded = RMX_load_params(ex2, str1("/tmp/r_shim_smoke.params"));
+  if (Rf_asInteger(n_loaded) < 4) {
+    fprintf(stderr, "too few params reloaded\n");
+    return 1;
+  }
+  RMX_set_arg(ex2, str1("data"), realvec(BS * P, X));
+  RMX_forward(ex2, Rf_ScalarInteger(0));
+  RMX_set_arg(ex, str1("data"), realvec(BS * P, X));
+  RMX_forward(ex, Rf_ScalarInteger(0));
+  SEXP o1 = RMX_get_output(ex, Rf_ScalarInteger(0));
+  SEXP o2 = RMX_get_output(ex2, Rf_ScalarInteger(0));
+  for (int i = 0; i < LENGTH(o1); ++i)
+    if (fabs(REAL(o1)[i] - REAL(o2)[i]) > 1e-6) {
+      fprintf(stderr, "reload mismatch\n");
+      return 1;
+    }
+  printf("OK\n");
+  return 0;
+}

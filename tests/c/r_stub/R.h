@@ -1,0 +1,4 @@
+#ifndef MXTPU_R_STUB_R_H_
+#define MXTPU_R_STUB_R_H_
+#include "Rinternals.h"
+#endif

@@ -1,0 +1,21 @@
+#ifndef MXTPU_R_STUB_RDYNLOAD_H_
+#define MXTPU_R_STUB_RDYNLOAD_H_
+
+typedef void* DL_FUNC;
+typedef struct {
+  const char* name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+typedef struct RStubDllInfo DllInfo;
+
+static void R_registerRoutines(DllInfo* dll, const void* c,
+                               const R_CallMethodDef* call, const void* f,
+                               const void* ext) {
+  (void)dll; (void)c; (void)call; (void)f; (void)ext;
+}
+static void R_useDynamicSymbols(DllInfo* dll, Rboolean v) {
+  (void)dll; (void)v;
+}
+
+#endif

@@ -1,0 +1,126 @@
+/* Minimal R C-API stub for smoke-testing R-package/src/mxnet_tpu_r.c
+ * WITHOUT an R installation (no R runtime ships in this environment —
+ * docs/bindings.md). Implements just the SEXP surface the shim uses, with
+ * R-compatible semantics for those calls: vectors carry length + typed
+ * payload, strings are interned char*, external pointers hold an address,
+ * Rf_error prints and exits non-zero. NOT a general R; the real contract
+ * is exercised by tests/test_r_binding.py when Rscript exists. */
+#ifndef MXTPU_R_STUB_INTERNALS_H_
+#define MXTPU_R_STUB_INTERNALS_H_
+
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct RStubObj* SEXP;
+
+enum { STUB_NIL, STUB_STR, STUB_INT, STUB_REAL, STUB_VEC, STUB_CHAR,
+       STUB_EXTPTR };
+#define STRSXP STUB_STR
+#define INTSXP STUB_INT
+#define REALSXP STUB_REAL
+#define VECSXP STUB_VEC
+
+typedef int Rboolean;
+#ifndef TRUE
+#define TRUE 1
+#define FALSE 0
+#endif
+
+struct RStubObj {
+  int type;
+  int len;
+  double* real;
+  int* ints;
+  SEXP* vec;      /* STRSXP: CHARSXP elements; VECSXP: any */
+  char* chars;    /* STUB_CHAR payload */
+  void* ptr;      /* external pointer address */
+};
+
+static SEXP R_NilValue_impl(void) {
+  static struct RStubObj nil = {STUB_NIL, 0, 0, 0, 0, 0, 0};
+  return &nil;
+}
+#define R_NilValue (R_NilValue_impl())
+
+static SEXP stub_new(int type, int len) {
+  SEXP s = (SEXP)calloc(1, sizeof(struct RStubObj));
+  s->type = type;
+  s->len = len;
+  if (type == STUB_REAL) s->real = (double*)calloc(len ? len : 1, 8);
+  if (type == STUB_INT) s->ints = (int*)calloc(len ? len : 1, 4);
+  if (type == STUB_STR || type == STUB_VEC)
+    s->vec = (SEXP*)calloc(len ? len : 1, sizeof(SEXP));
+  return s;
+}
+
+static SEXP Rf_allocVector(int type, int len) { return stub_new(type, len); }
+static int LENGTH(SEXP s) { return s->len; }
+static double* REAL(SEXP s) { return s->real; }
+static int* INTEGER(SEXP s) { return s->ints; }
+static SEXP VECTOR_ELT(SEXP s, int i) { return s->vec[i]; }
+static void SET_VECTOR_ELT(SEXP s, int i, SEXP v) { s->vec[i] = v; }
+static SEXP STRING_ELT(SEXP s, int i) { return s->vec[i]; }
+static void SET_STRING_ELT(SEXP s, int i, SEXP c) { s->vec[i] = c; }
+static const char* CHAR(SEXP c) { return c->chars; }
+
+static SEXP Rf_mkChar(const char* s) {
+  SEXP c = stub_new(STUB_CHAR, (int)strlen(s));
+  c->chars = strdup(s);
+  return c;
+}
+
+static SEXP Rf_mkString(const char* s) {
+  SEXP v = stub_new(STUB_STR, 1);
+  v->vec[0] = Rf_mkChar(s);
+  return v;
+}
+
+static SEXP Rf_ScalarInteger(int v) {
+  SEXP s = stub_new(STUB_INT, 1);
+  s->ints[0] = v;
+  return s;
+}
+
+static int Rf_asInteger(SEXP s) {
+  return s->type == STUB_REAL ? (int)s->real[0] : s->ints[0];
+}
+static double Rf_asReal(SEXP s) {
+  return s->type == STUB_INT ? (double)s->ints[0] : s->real[0];
+}
+
+static void Rf_error(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "R stub error: ");
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+  exit(1);
+}
+
+/* GC-protection: the stub never collects */
+#define PROTECT(x) (x)
+#define UNPROTECT(n) ((void)(n))
+
+static char* R_alloc(size_t n, int size) {
+  return (char*)calloc(n ? n : 1, (size_t)size);
+}
+
+/* external pointers */
+static SEXP R_MakeExternalPtr(void* p, SEXP tag, SEXP prot) {
+  (void)tag;
+  (void)prot;
+  SEXP s = stub_new(STUB_EXTPTR, 0);
+  s->ptr = p;
+  return s;
+}
+static void* R_ExternalPtrAddr(SEXP s) { return s->ptr; }
+static void R_ClearExternalPtr(SEXP s) { s->ptr = 0; }
+typedef void (*R_CFinalizer_t)(SEXP);
+static void R_RegisterCFinalizerEx(SEXP s, R_CFinalizer_t fin, Rboolean onexit) {
+  (void)s; (void)fin; (void)onexit;  /* stub: no GC, no finalization */
+}
+
+#endif  /* MXTPU_R_STUB_INTERNALS_H_ */
