@@ -1,12 +1,8 @@
-# Package init: load the shim (which links libmxtpu_predict.so).
-# The shared library embeds CPython for the compute path. The Makevars
-# bakes an rpath to mxnet_tpu/src/build; for a relocated install put that
-# directory on LD_LIBRARY_PATH before starting R (reference:
-# R-package/R/zzz.R loads libmxnet).
-.onLoad <- function(libname, pkgname) {
-  library.dynam("mxnetTPU", pkgname, libname)
-}
-
+# Package init (reference: R-package/R/zzz.R loads libmxnet). The DLL load
+# itself happens via NAMESPACE's useDynLib(mxnetTPU, .registration = TRUE);
+# nothing else to do here. The shim links libmxtpu_predict.so with a baked
+# rpath to mxnet_tpu/src/build; for a relocated install put that directory
+# on LD_LIBRARY_PATH before starting R.
 .onUnload <- function(libpath) {
   library.dynam.unload("mxnetTPU", libpath)
 }

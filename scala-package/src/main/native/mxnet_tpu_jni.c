@@ -1,0 +1,383 @@
+/* JNI shim over the C training API (reference: scala-package/native/ —
+ * JNI glue over include/mxnet/c_api.h consumed by
+ * scala-package/core/.../LibInfo.scala's @native methods).
+ *
+ * Handles cross into the JVM as jlong (the reference does the same);
+ * float buffers marshal through jfloatArray. Errors throw
+ * java.lang.RuntimeException carrying MXTrainGetLastError().
+ *
+ * Build (JDK hosts):
+ *   cc -shared -fPIC -I$JAVA_HOME/include -I$JAVA_HOME/include/linux \
+ *      -I../../../mxnet_tpu/src/include mxnet_tpu_jni.c \
+ *      -L../../../mxnet_tpu/src/build -lmxtpu_predict -o libmxnettpu_jni.so
+ * CI smoke (no JDK here): the same file compiles against the stub JNI env
+ * (tests/c/jni_stub/jni.h) and trains end to end —
+ * tests/test_scala_binding.py. */
+#include <stdlib.h>
+#include <string.h>
+
+#include <jni.h>
+
+#include "c_train_api.h"
+
+static void throw_err(JNIEnv* env, const char* what) {
+  jclass cls = (*env)->FindClass(env, "java/lang/RuntimeException");
+  char buf[1024];
+  snprintf(buf, sizeof buf, "%s: %s", what, MXTrainGetLastError());
+  (*env)->ThrowNew(env, cls, buf);
+}
+
+#define CHECK_OR(env, call, what, retval)        \
+  do {                                           \
+    if ((call) != 0) {                           \
+      throw_err(env, what);                      \
+      return retval;                             \
+    }                                            \
+  } while (0)
+
+/* ---- Symbol ---- */
+JNIEXPORT jlong JNICALL Java_ml_mxnettpu_LibMXNetTPU_symbolFromJson(
+    JNIEnv* env, jclass cls, jstring json) {
+  (void)cls;
+  const char* s = (*env)->GetStringUTFChars(env, json, 0);
+  SymbolHandle h = NULL;
+  int rc = MXSymbolCreateFromJSON(s, &h);
+  (*env)->ReleaseStringUTFChars(env, json, s);
+  CHECK_OR(env, rc, "MXSymbolCreateFromJSON", 0);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jstring JNICALL Java_ml_mxnettpu_LibMXNetTPU_symbolToJson(
+    JNIEnv* env, jclass cls, jlong sym) {
+  (void)cls;
+  const char* out = NULL;
+  CHECK_OR(env, MXSymbolSaveToJSON((SymbolHandle)(intptr_t)sym, &out),
+           "MXSymbolSaveToJSON", NULL);
+  return (*env)->NewStringUTF(env, out);
+}
+
+JNIEXPORT jlong JNICALL Java_ml_mxnettpu_LibMXNetTPU_symbolVariable(
+    JNIEnv* env, jclass cls, jstring name) {
+  (void)cls;
+  const char* s = (*env)->GetStringUTFChars(env, name, 0);
+  SymbolHandle h = NULL;
+  int rc = MXSymbolCreateVariable(s, &h);
+  (*env)->ReleaseStringUTFChars(env, name, s);
+  CHECK_OR(env, rc, "MXSymbolCreateVariable", 0);
+  return (jlong)(intptr_t)h;
+}
+
+/* strings: caller must release_strings() after use. The element refs are
+ * kept so Release pairs with the same local ref, then deleted — JNI only
+ * guarantees 16 live local refs, and argument lists exceed that. */
+typedef struct {
+  const char** utf;
+  jstring* refs;
+  int n;
+} StrList;
+
+static StrList get_strings(JNIEnv* env, jobjectArray arr) {
+  StrList l;
+  l.n = (*env)->GetArrayLength(env, arr);
+  l.utf = (const char**)malloc((l.n ? l.n : 1) * sizeof(char*));
+  l.refs = (jstring*)malloc((l.n ? l.n : 1) * sizeof(jstring));
+  for (int i = 0; i < l.n; ++i) {
+    l.refs[i] = (jstring)(*env)->GetObjectArrayElement(env, arr, i);
+    l.utf[i] = (*env)->GetStringUTFChars(env, l.refs[i], 0);
+  }
+  return l;
+}
+
+static void release_strings(JNIEnv* env, StrList* l) {
+  for (int i = 0; i < l->n; ++i) {
+    (*env)->ReleaseStringUTFChars(env, l->refs[i], l->utf[i]);
+    (*env)->DeleteLocalRef(env, l->refs[i]);
+  }
+  free((void*)l->utf);
+  free(l->refs);
+}
+
+JNIEXPORT jlong JNICALL Java_ml_mxnettpu_LibMXNetTPU_symbolCreate(
+    JNIEnv* env, jclass cls, jstring op, jstring name, jobjectArray pkeys,
+    jobjectArray pvals, jobjectArray ikeys, jlongArray inputs) {
+  (void)cls;
+  StrList pk = get_strings(env, pkeys);
+  StrList pv = get_strings(env, pvals);
+  StrList ik = get_strings(env, ikeys);
+  jlong* ih = (*env)->GetLongArrayElements(env, inputs, 0);
+  int n_in = (*env)->GetArrayLength(env, inputs);
+  SymbolHandle* handles =
+      (SymbolHandle*)malloc((n_in ? n_in : 1) * sizeof(SymbolHandle));
+  for (int i = 0; i < n_in; ++i)
+    handles[i] = (SymbolHandle)(intptr_t)ih[i];
+  int arity_ok = pk.n == pv.n && ik.n == n_in;
+  const char* op_s = (*env)->GetStringUTFChars(env, op, 0);
+  const char* name_s = (*env)->GetStringUTFChars(env, name, 0);
+  SymbolHandle h = NULL;
+  int rc = arity_ok ? MXSymbolCreateFromOperator(op_s, name_s, pk.n, pk.utf,
+                                                 pv.utf, ik.n, ik.utf,
+                                                 handles, &h)
+                    : -1;
+  (*env)->ReleaseStringUTFChars(env, op, op_s);
+  (*env)->ReleaseStringUTFChars(env, name, name_s);
+  release_strings(env, &pk);
+  release_strings(env, &pv);
+  release_strings(env, &ik);
+  (*env)->ReleaseLongArrayElements(env, inputs, ih, 0);
+  free(handles);
+  if (!arity_ok) {
+    jclass exc = (*env)->FindClass(env, "java/lang/RuntimeException");
+    (*env)->ThrowNew(env, exc,
+                     "symbolCreate: paramKeys/paramVals or inputKeys/inputs "
+                     "lengths differ");
+    return 0;
+  }
+  CHECK_OR(env, rc, "MXSymbolCreateFromOperator", 0);
+  return (jlong)(intptr_t)h;
+}
+
+static jobjectArray strings_to_java(JNIEnv* env, mx_uint n,
+                                    const char** arr) {
+  jclass str_cls = (*env)->FindClass(env, "java/lang/String");
+  jobjectArray out = (*env)->NewObjectArray(env, (jsize)n, str_cls, NULL);
+  for (mx_uint i = 0; i < n; ++i) {
+    jstring s = (*env)->NewStringUTF(env, arr[i]);
+    (*env)->SetObjectArrayElement(env, out, (jsize)i, s);
+    (*env)->DeleteLocalRef(env, s);  /* stay under the 16-local-ref floor */
+  }
+  return out;
+}
+
+JNIEXPORT jobjectArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_symbolArguments(
+    JNIEnv* env, jclass cls, jlong sym) {
+  (void)cls;
+  mx_uint n = 0;
+  const char** arr = NULL;
+  CHECK_OR(env, MXSymbolListArguments((SymbolHandle)(intptr_t)sym, &n, &arr),
+           "MXSymbolListArguments", NULL);
+  return strings_to_java(env, n, arr);
+}
+
+JNIEXPORT jobjectArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_symbolOutputs(
+    JNIEnv* env, jclass cls, jlong sym) {
+  (void)cls;
+  mx_uint n = 0;
+  const char** arr = NULL;
+  CHECK_OR(env, MXSymbolListOutputs((SymbolHandle)(intptr_t)sym, &n, &arr),
+           "MXSymbolListOutputs", NULL);
+  return strings_to_java(env, n, arr);
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_symbolFree(
+    JNIEnv* env, jclass cls, jlong sym) {
+  (void)env;
+  (void)cls;
+  MXSymbolFree((SymbolHandle)(intptr_t)sym);
+}
+
+/* ---- Executor ---- */
+JNIEXPORT jlong JNICALL Java_ml_mxnettpu_LibMXNetTPU_simpleBind(
+    JNIEnv* env, jclass cls, jlong sym, jstring dev, jint devId,
+    jobjectArray keys, jintArray shapeData, jintArray shapeIdx,
+    jstring gradReq) {
+  (void)cls;
+  StrList k = get_strings(env, keys);
+  int nk = k.n;
+  jint* data = (*env)->GetIntArrayElements(env, shapeData, 0);
+  jint* idx = (*env)->GetIntArrayElements(env, shapeIdx, 0);
+  int n_data = (*env)->GetArrayLength(env, shapeData);
+  mx_uint* d =
+      (mx_uint*)malloc((n_data ? n_data : 1) * sizeof(mx_uint));
+  mx_uint* ix = (mx_uint*)malloc((nk + 1) * sizeof(mx_uint));
+  for (int i = 0; i < n_data; ++i) d[i] = (mx_uint)data[i];
+  for (int i = 0; i <= nk; ++i) ix[i] = (mx_uint)idx[i];
+  const char* dev_s = (*env)->GetStringUTFChars(env, dev, 0);
+  const char* req_s = (*env)->GetStringUTFChars(env, gradReq, 0);
+  ExecutorHandle h = NULL;
+  int rc = MXExecutorSimpleBindLite((SymbolHandle)(intptr_t)sym, dev_s, devId,
+                                    (mx_uint)nk, k.utf, d, ix, req_s, &h);
+  (*env)->ReleaseStringUTFChars(env, dev, dev_s);
+  (*env)->ReleaseStringUTFChars(env, gradReq, req_s);
+  release_strings(env, &k);
+  (*env)->ReleaseIntArrayElements(env, shapeData, data, 0);
+  (*env)->ReleaseIntArrayElements(env, shapeIdx, idx, 0);
+  free(d);
+  free(ix);
+  CHECK_OR(env, rc, "MXExecutorSimpleBindLite", 0);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_setArg(
+    JNIEnv* env, jclass cls, jlong ex, jstring name, jfloatArray value) {
+  (void)cls;
+  jfloat* v = (*env)->GetFloatArrayElements(env, value, 0);
+  int n = (*env)->GetArrayLength(env, value);
+  const char* name_s = (*env)->GetStringUTFChars(env, name, 0);
+  int rc = MXExecutorSetArg((ExecutorHandle)(intptr_t)ex, name_s, v,
+                            (mx_uint)n);
+  (*env)->ReleaseStringUTFChars(env, name, name_s);
+  (*env)->ReleaseFloatArrayElements(env, value, v, 0);
+  CHECK_OR(env, rc, "MXExecutorSetArg", );
+}
+
+static jfloatArray floats_to_java(JNIEnv* env, const float* data, mx_uint n) {
+  jfloatArray out = (*env)->NewFloatArray(env, (jsize)n);
+  (*env)->SetFloatArrayRegion(env, out, 0, (jsize)n, data);
+  return out;
+}
+
+JNIEXPORT jfloatArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_getArg(
+    JNIEnv* env, jclass cls, jlong ex, jstring name) {
+  (void)cls;
+  const char* name_s = (*env)->GetStringUTFChars(env, name, 0);
+  const float* out = NULL;
+  mx_uint n = 0;
+  int rc = MXExecutorGetArg((ExecutorHandle)(intptr_t)ex, name_s, &out, &n);
+  (*env)->ReleaseStringUTFChars(env, name, name_s);
+  CHECK_OR(env, rc, "MXExecutorGetArg", NULL);
+  return floats_to_java(env, out, n);
+}
+
+JNIEXPORT jfloatArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_getGrad(
+    JNIEnv* env, jclass cls, jlong ex, jstring name) {
+  (void)cls;
+  const char* name_s = (*env)->GetStringUTFChars(env, name, 0);
+  const float* out = NULL;
+  mx_uint n = 0;
+  int rc = MXExecutorGetGrad((ExecutorHandle)(intptr_t)ex, name_s, &out, &n);
+  (*env)->ReleaseStringUTFChars(env, name, name_s);
+  CHECK_OR(env, rc, "MXExecutorGetGrad", NULL);
+  return floats_to_java(env, out, n);
+}
+
+JNIEXPORT jfloatArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_getOutput(
+    JNIEnv* env, jclass cls, jlong ex, jint index) {
+  (void)cls;
+  const float* out = NULL;
+  mx_uint n = 0;
+  CHECK_OR(env,
+           MXExecutorGetOutput((ExecutorHandle)(intptr_t)ex, (mx_uint)index,
+                               &out, &n),
+           "MXExecutorGetOutput", NULL);
+  return floats_to_java(env, out, n);
+}
+
+JNIEXPORT jintArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_outputShape(
+    JNIEnv* env, jclass cls, jlong ex, jint index) {
+  (void)cls;
+  const mx_uint* shape = NULL;
+  mx_uint ndim = 0;
+  CHECK_OR(env,
+           MXExecutorOutputShape((ExecutorHandle)(intptr_t)ex,
+                                 (mx_uint)index, &shape, &ndim),
+           "MXExecutorOutputShape", NULL);
+  jintArray out = (*env)->NewIntArray(env, (jsize)ndim);
+  jint* tmp = (jint*)malloc((ndim ? ndim : 1) * sizeof(jint));
+  for (mx_uint i = 0; i < ndim; ++i) tmp[i] = (jint)shape[i];
+  (*env)->SetIntArrayRegion(env, out, 0, (jsize)ndim, tmp);
+  free(tmp);
+  return out;
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_forward(
+    JNIEnv* env, jclass cls, jlong ex, jint isTrain) {
+  (void)cls;
+  CHECK_OR(env, MXExecutorForward((ExecutorHandle)(intptr_t)ex, isTrain),
+           "MXExecutorForward", );
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_backward(
+    JNIEnv* env, jclass cls, jlong ex) {
+  (void)cls;
+  CHECK_OR(env, MXExecutorBackward((ExecutorHandle)(intptr_t)ex, 0, NULL),
+           "MXExecutorBackward", );
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_momentumUpdate(
+    JNIEnv* env, jclass cls, jlong ex, jfloat lr, jfloat wd, jfloat momentum,
+    jfloat rescale) {
+  (void)cls;
+  CHECK_OR(env,
+           MXExecutorMomentumUpdate((ExecutorHandle)(intptr_t)ex, lr, wd,
+                                    momentum, rescale),
+           "MXExecutorMomentumUpdate", );
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_sgdUpdate(
+    JNIEnv* env, jclass cls, jlong ex, jfloat lr, jfloat wd, jfloat rescale) {
+  (void)cls;
+  CHECK_OR(env,
+           MXExecutorSGDUpdate((ExecutorHandle)(intptr_t)ex, lr, wd, rescale),
+           "MXExecutorSGDUpdate", );
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_initXavier(
+    JNIEnv* env, jclass cls, jlong ex, jint seed) {
+  (void)cls;
+  CHECK_OR(env, MXExecutorInitXavier((ExecutorHandle)(intptr_t)ex, seed),
+           "MXExecutorInitXavier", );
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_saveParams(
+    JNIEnv* env, jclass cls, jlong ex, jstring path) {
+  (void)cls;
+  const char* p = (*env)->GetStringUTFChars(env, path, 0);
+  int rc = MXExecutorSaveParams((ExecutorHandle)(intptr_t)ex, p);
+  (*env)->ReleaseStringUTFChars(env, path, p);
+  CHECK_OR(env, rc, "MXExecutorSaveParams", );
+}
+
+JNIEXPORT jint JNICALL Java_ml_mxnettpu_LibMXNetTPU_loadParams(
+    JNIEnv* env, jclass cls, jlong ex, jstring path) {
+  (void)cls;
+  const char* p = (*env)->GetStringUTFChars(env, path, 0);
+  mx_uint n = 0;
+  int rc = MXExecutorLoadParams((ExecutorHandle)(intptr_t)ex, p, &n);
+  (*env)->ReleaseStringUTFChars(env, path, p);
+  CHECK_OR(env, rc, "MXExecutorLoadParams", 0);
+  return (jint)n;
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_executorFree(
+    JNIEnv* env, jclass cls, jlong ex) {
+  (void)env;
+  (void)cls;
+  MXExecutorFree((ExecutorHandle)(intptr_t)ex);
+}
+
+/* ---- KVStore ---- */
+JNIEXPORT jlong JNICALL Java_ml_mxnettpu_LibMXNetTPU_kvCreate(
+    JNIEnv* env, jclass cls, jstring type) {
+  (void)cls;
+  const char* t = (*env)->GetStringUTFChars(env, type, 0);
+  KVStoreHandle h = NULL;
+  int rc = MXKVStoreCreate(t, &h);
+  (*env)->ReleaseStringUTFChars(env, type, t);
+  CHECK_OR(env, rc, "MXKVStoreCreate", 0);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jint JNICALL Java_ml_mxnettpu_LibMXNetTPU_kvRank(
+    JNIEnv* env, jclass cls, jlong kv) {
+  (void)cls;
+  int rank = 0;
+  CHECK_OR(env, MXKVStoreGetRank((KVStoreHandle)(intptr_t)kv, &rank),
+           "MXKVStoreGetRank", 0);
+  return rank;
+}
+
+JNIEXPORT jint JNICALL Java_ml_mxnettpu_LibMXNetTPU_kvNumWorkers(
+    JNIEnv* env, jclass cls, jlong kv) {
+  (void)cls;
+  int n = 0;
+  CHECK_OR(env, MXKVStoreGetGroupSize((KVStoreHandle)(intptr_t)kv, &n),
+           "MXKVStoreGetGroupSize", 0);
+  return n;
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_kvFree(
+    JNIEnv* env, jclass cls, jlong kv) {
+  (void)env;
+  (void)cls;
+  MXKVStoreFree((KVStoreHandle)(intptr_t)kv);
+}

@@ -1,0 +1,62 @@
+package ml.mxnettpu
+
+/** Minimal FeedForward estimator (reference:
+  * scala-package/core/src/main/scala/ml/dmlc/mxnet/FeedForward.scala —
+  * bind, init, epoch loop of forward/backward/update, checkpointing in
+  * the reference `prefix-symbol.json` + `prefix-%04d.params` format).
+  *
+  * X is row-major (nExamples x nFeatures flattened), y the label vector.
+  */
+class FeedForward(val symbol: Symbol, val batchSize: Int,
+                  val numFeatures: Int) {
+  val labelName: String =
+    symbol.arguments.find(_.contains("label")).getOrElse("softmax_label")
+  val exec: Executor = symbol.simpleBind(
+    ctx = "cpu", gradReq = "write",
+    shapes = Seq("data" -> Array(batchSize, numFeatures),
+                 labelName -> Array(batchSize)))
+
+  def fit(x: Array[Float], y: Array[Float], numRound: Int = 10,
+          learningRate: Float = 0.1f, momentum: Float = 0.9f,
+          wd: Float = 0f, seed: Int = 0): Unit = {
+    val n = y.length
+    require(n % batchSize == 0, "batchSize must divide the example count")
+    exec.initXavier(seed)
+    val nBatch = n / batchSize
+    for (_ <- 0 until numRound; b <- 0 until nBatch) {
+      exec.setArg("data", x.slice(b * batchSize * numFeatures,
+                                  (b + 1) * batchSize * numFeatures))
+      exec.setArg(labelName, y.slice(b * batchSize, (b + 1) * batchSize))
+      exec.forward(isTrain = true)
+      exec.backward()
+      exec.momentumUpdate(learningRate, wd, momentum, 1f / batchSize)
+    }
+  }
+
+  def accuracy(x: Array[Float], y: Array[Float]): Double = {
+    val n = y.length
+    require(n % batchSize == 0, "batchSize must divide the example count")
+    var correct = 0
+    for (b <- 0 until n / batchSize) {
+      exec.setArg("data", x.slice(b * batchSize * numFeatures,
+                                  (b + 1) * batchSize * numFeatures))
+      exec.forward(isTrain = false)
+      val out = exec.output(0)
+      val shape = exec.outputShape(0)
+      val nClass = shape(1)
+      for (i <- 0 until batchSize) {
+        val row = out.slice(i * nClass, (i + 1) * nClass)
+        val pred = row.indexOf(row.max)
+        if (pred == y(b * batchSize + i).toInt) correct += 1
+      }
+    }
+    correct.toDouble / n
+  }
+
+  /** Reference checkpoint format — interchanges with the Python Module. */
+  def saveCheckpoint(prefix: String, iteration: Int = 1): Unit = {
+    val w = new java.io.PrintWriter(s"$prefix-symbol.json")
+    try w.write(symbol.toJson) finally w.close()
+    exec.saveParams(f"$prefix-$iteration%04d.params")
+  }
+}

@@ -1,0 +1,50 @@
+package ml.mxnettpu
+
+/** Native method table over libmxnettpu_jni.so (reference:
+  * scala-package/core/src/main/scala/ml/dmlc/mxnet/LibInfo.scala — the
+  * @native surface every higher-level class calls). Handles are jlong
+  * (opaque C pointers); errors surface as RuntimeException carrying
+  * MXTrainGetLastError().
+  */
+object LibMXNetTPU {
+  System.loadLibrary("mxnettpu_jni")
+
+  // Symbol
+  @native def symbolFromJson(json: String): Long
+  @native def symbolToJson(sym: Long): String
+  @native def symbolVariable(name: String): Long
+  @native def symbolCreate(op: String, name: String,
+                           paramKeys: Array[String],
+                           paramVals: Array[String],
+                           inputKeys: Array[String],
+                           inputs: Array[Long]): Long
+  @native def symbolArguments(sym: Long): Array[String]
+  @native def symbolOutputs(sym: Long): Array[String]
+  @native def symbolFree(sym: Long): Unit
+
+  // Executor
+  @native def simpleBind(sym: Long, dev: String, devId: Int,
+                         keys: Array[String], shapeData: Array[Int],
+                         shapeIdx: Array[Int], gradReq: String): Long
+  @native def setArg(ex: Long, name: String, value: Array[Float]): Unit
+  @native def getArg(ex: Long, name: String): Array[Float]
+  @native def getGrad(ex: Long, name: String): Array[Float]
+  @native def getOutput(ex: Long, index: Int): Array[Float]
+  @native def outputShape(ex: Long, index: Int): Array[Int]
+  @native def forward(ex: Long, isTrain: Int): Unit
+  @native def backward(ex: Long): Unit
+  @native def sgdUpdate(ex: Long, lr: Float, wd: Float,
+                        rescale: Float): Unit
+  @native def momentumUpdate(ex: Long, lr: Float, wd: Float, momentum: Float,
+                             rescale: Float): Unit
+  @native def initXavier(ex: Long, seed: Int): Unit
+  @native def saveParams(ex: Long, path: String): Unit
+  @native def loadParams(ex: Long, path: String): Int
+  @native def executorFree(ex: Long): Unit
+
+  // KVStore
+  @native def kvCreate(kvType: String): Long
+  @native def kvRank(kv: Long): Int
+  @native def kvNumWorkers(kv: Long): Int
+  @native def kvFree(kv: Long): Unit
+}

@@ -1,0 +1,65 @@
+package ml.mxnettpu
+
+/** Symbolic graph node (reference:
+  * scala-package/core/src/main/scala/ml/dmlc/mxnet/Symbol.scala). Every
+  * registered operator is reachable through Symbol.create — the generated
+  * per-op wrappers of the reference collapse to thin named forwarders.
+  */
+class Symbol private[mxnettpu] (private[mxnettpu] val handle: Long) {
+  def toJson: String = LibMXNetTPU.symbolToJson(handle)
+  def arguments: Array[String] = LibMXNetTPU.symbolArguments(handle)
+  def outputs: Array[String] = LibMXNetTPU.symbolOutputs(handle)
+  def dispose(): Unit = LibMXNetTPU.symbolFree(handle)
+
+  def simpleBind(ctx: String = "cpu", devId: Int = 0,
+                 gradReq: String = "write",
+                 shapes: Seq[(String, Array[Int])]): Executor = {
+    val keys = shapes.map(_._1).toArray
+    val data = shapes.flatMap(_._2).toArray
+    val idx = shapes.scanLeft(0)(_ + _._2.length).toArray
+    new Executor(
+      LibMXNetTPU.simpleBind(handle, ctx, devId, keys, data, idx, gradReq))
+  }
+}
+
+object Symbol {
+  def Variable(name: String): Symbol =
+    new Symbol(LibMXNetTPU.symbolVariable(name))
+
+  def fromJson(json: String): Symbol =
+    new Symbol(LibMXNetTPU.symbolFromJson(json))
+
+  /** Generic operator constructor: symbol inputs in `inputs` (key "" =
+    * positional), everything in `params` stringified into the op schema.
+    */
+  def create(op: String, name: String = "",
+             inputs: Seq[(String, Symbol)] = Nil,
+             params: Seq[(String, Any)] = Nil): Symbol = {
+    val pk = params.map(_._1).toArray
+    val pv = params.map { case (_, v) => paramStr(v) }.toArray
+    val ik = inputs.map(_._1).toArray
+    val ih = inputs.map(_._2.handle).toArray
+    new Symbol(LibMXNetTPU.symbolCreate(op, name, pk, pv, ik, ih))
+  }
+
+  private def paramStr(v: Any): String = v match {
+    case arr: Array[_] => arr.mkString("(", ", ", ")")
+    case seq: Seq[_] => seq.mkString("(", ", ", ")")
+    case other => other.toString
+  }
+
+  // named forwarders for the common layers
+  def FullyConnected(data: Symbol, numHidden: Int, name: String = ""): Symbol =
+    create("FullyConnected", name, Seq("data" -> data),
+           Seq("num_hidden" -> numHidden))
+  def Activation(data: Symbol, actType: String, name: String = ""): Symbol =
+    create("Activation", name, Seq("data" -> data), Seq("act_type" -> actType))
+  def SoftmaxOutput(data: Symbol, name: String = ""): Symbol =
+    create("SoftmaxOutput", name, Seq("data" -> data))
+  def Convolution(data: Symbol, numFilter: Int, kernel: Array[Int],
+                  name: String = ""): Symbol =
+    create("Convolution", name, Seq("data" -> data),
+           Seq("num_filter" -> numFilter, "kernel" -> kernel))
+  def Flatten(data: Symbol, name: String = ""): Symbol =
+    create("Flatten", name, Seq("data" -> data))
+}

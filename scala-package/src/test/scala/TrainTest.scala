@@ -1,0 +1,36 @@
+import ml.mxnettpu._
+
+/** End-to-end JVM test (runs under the JDK tier of
+  * tests/test_scala_binding.py): trains an MLP on linearly separable data
+  * to >90% and writes a reference-format checkpoint that the Python
+  * Module loads. Mirrors the reference scala-package's train tests.
+  */
+object TrainTest {
+  def main(args: Array[String]): Unit = {
+    val workdir = if (args.nonEmpty) args(0) else "/tmp"
+    val n = 256
+    val p = 10
+    val rng = new scala.util.Random(42)
+    val x = Array.fill(n * p)(rng.nextGaussian().toFloat)
+    val y = Array.tabulate(n) { i =>
+      if (x(i * p) + 0.5f * x(i * p + 1) > 0) 1f else 0f
+    }
+
+    val data = Symbol.Variable("data")
+    val net = Symbol.SoftmaxOutput(
+      Symbol.FullyConnected(
+        Symbol.Activation(
+          Symbol.FullyConnected(data, numHidden = 16, name = "fc1"),
+          actType = "relu"),
+        numHidden = 2, name = "fc2"),
+      name = "softmax")
+
+    val model = new FeedForward(net, batchSize = 32, numFeatures = p)
+    model.fit(x, y, numRound = 15, learningRate = 0.2f)
+    val acc = model.accuracy(x, y)
+    println(f"train accuracy: $acc%.4f")
+    require(acc > 0.90, s"accuracy too low: $acc")
+    model.saveCheckpoint(s"$workdir/scala_mlp", 1)
+    println("SCALA_BINDING_OK " + acc)
+  }
+}

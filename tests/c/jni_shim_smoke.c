@@ -1,0 +1,303 @@
+/* Executes scala-package's JNI shim against the stub JNI env
+ * (tests/c/jni_stub/): drives the same flow FeedForward.fit runs —
+ * symbol build, bind, train to >90%, checkpoint save/reload — so the
+ * shim's marshaling (UTF strings, long/int/float arrays, exceptions) is
+ * EXECUTED without a JVM. Includes the real shim translation unit. */
+#include "../../scala-package/src/main/native/mxnet_tpu_jni.c"
+
+#include <math.h>
+
+/* ---- stub JNI env implementation ---- */
+
+static struct StubObj* new_obj(void) {
+  return (struct StubObj*)calloc(1, sizeof(struct StubObj));
+}
+
+static const char* S_GetStringUTFChars(JNIEnv* env, jstring s, void* b) {
+  (void)env; (void)b;
+  return s->utf;
+}
+static void S_ReleaseStringUTFChars(JNIEnv* env, jstring s, const char* c) {
+  (void)env; (void)s; (void)c;
+}
+static jstring S_NewStringUTF(JNIEnv* env, const char* c) {
+  (void)env;
+  jstring s = new_obj();
+  s->utf = strdup(c);
+  s->len = (int)strlen(c);
+  return s;
+}
+static jsize S_GetArrayLength(JNIEnv* env, jarray a) {
+  (void)env;
+  return a->len;
+}
+static jobject S_GetObjectArrayElement(JNIEnv* env, jobjectArray a, jsize i) {
+  (void)env;
+  return a->objs[i];
+}
+static void S_SetObjectArrayElement(JNIEnv* env, jobjectArray a, jsize i,
+                                    jobject v) {
+  (void)env;
+  a->objs[i] = v;
+}
+static jobjectArray S_NewObjectArray(JNIEnv* env, jsize n, jclass cls,
+                                     jobject init) {
+  (void)env; (void)cls; (void)init;
+  jobjectArray a = new_obj();
+  a->len = n;
+  a->objs = (jobject*)calloc(n ? n : 1, sizeof(jobject));
+  return a;
+}
+static jlong* S_GetLongArrayElements(JNIEnv* env, jlongArray a, void* b) {
+  (void)env; (void)b;
+  return a->longs;
+}
+static void S_ReleaseLongArrayElements(JNIEnv* env, jlongArray a, jlong* p,
+                                       jint mode) {
+  (void)env; (void)a; (void)p; (void)mode;
+}
+static jint* S_GetIntArrayElements(JNIEnv* env, jintArray a, void* b) {
+  (void)env; (void)b;
+  return a->ints;
+}
+static void S_ReleaseIntArrayElements(JNIEnv* env, jintArray a, jint* p,
+                                      jint mode) {
+  (void)env; (void)a; (void)p; (void)mode;
+}
+static jfloat* S_GetFloatArrayElements(JNIEnv* env, jfloatArray a, void* b) {
+  (void)env; (void)b;
+  return a->floats;
+}
+static void S_ReleaseFloatArrayElements(JNIEnv* env, jfloatArray a, jfloat* p,
+                                        jint mode) {
+  (void)env; (void)a; (void)p; (void)mode;
+}
+static jfloatArray S_NewFloatArray(JNIEnv* env, jsize n) {
+  (void)env;
+  jfloatArray a = new_obj();
+  a->len = n;
+  a->floats = (jfloat*)calloc(n ? n : 1, sizeof(jfloat));
+  return a;
+}
+static void S_SetFloatArrayRegion(JNIEnv* env, jfloatArray a, jsize start,
+                                  jsize n, const jfloat* src) {
+  (void)env;
+  memcpy(a->floats + start, src, n * sizeof(jfloat));
+}
+static jintArray S_NewIntArray(JNIEnv* env, jsize n) {
+  (void)env;
+  jintArray a = new_obj();
+  a->len = n;
+  a->ints = (jint*)calloc(n ? n : 1, sizeof(jint));
+  return a;
+}
+static void S_SetIntArrayRegion(JNIEnv* env, jintArray a, jsize start,
+                                jsize n, const jint* src) {
+  (void)env;
+  memcpy(a->ints + start, src, n * sizeof(jint));
+}
+static jclass S_FindClass(JNIEnv* env, const char* name) {
+  (void)env;
+  jclass c = new_obj();
+  c->utf = strdup(name);
+  return c;
+}
+static void S_DeleteLocalRef(JNIEnv* env, jobject obj) {
+  (void)env; (void)obj;  /* stub: no local-ref table */
+}
+static jint S_ThrowNew(JNIEnv* env, jclass cls, const char* msg) {
+  struct JNINativeInterface_* tbl = (struct JNINativeInterface_*)*env;
+  tbl->exception_pending = 1;
+  snprintf(tbl->exception_msg, sizeof tbl->exception_msg, "%s: %s",
+           cls && cls->utf ? cls->utf : "?", msg ? msg : "");
+  return 0;
+}
+
+static struct JNINativeInterface_ g_table = {
+    0, {0},
+    S_GetStringUTFChars, S_ReleaseStringUTFChars, S_NewStringUTF,
+    S_GetArrayLength, S_GetObjectArrayElement, S_SetObjectArrayElement,
+    S_NewObjectArray, S_GetLongArrayElements, S_ReleaseLongArrayElements,
+    S_GetIntArrayElements, S_ReleaseIntArrayElements,
+    S_GetFloatArrayElements, S_ReleaseFloatArrayElements, S_NewFloatArray,
+    S_SetFloatArrayRegion, S_NewIntArray, S_SetIntArrayRegion, S_FindClass,
+    S_ThrowNew, S_DeleteLocalRef};
+static const struct JNINativeInterface_* g_env = &g_table;
+static JNIEnv* ENV = &g_env;
+
+#define CHECK_EXC()                                                     \
+  do {                                                                  \
+    if (g_table.exception_pending) {                                    \
+      fprintf(stderr, "JNI exception: %s\n", g_table.exception_msg);    \
+      return 1;                                                         \
+    }                                                                   \
+  } while (0)
+
+static jstring js(const char* s) { return S_NewStringUTF(ENV, s); }
+
+static jobjectArray jstrs(int n, const char** v) {
+  jobjectArray a = S_NewObjectArray(ENV, n, NULL, NULL);
+  for (int i = 0; i < n; ++i) a->objs[i] = js(v[i]);
+  return a;
+}
+
+static jlongArray jlongs(int n, const jlong* v) {
+  jlongArray a = new_obj();
+  a->len = n;
+  a->longs = (jlong*)calloc(n ? n : 1, sizeof(jlong));
+  memcpy(a->longs, v, n * sizeof(jlong));
+  return a;
+}
+
+static jintArray jints(int n, const jint* v) {
+  jintArray a = new_obj();
+  a->len = n;
+  a->ints = (jint*)calloc(n ? n : 1, sizeof(jint));
+  memcpy(a->ints, v, n * sizeof(jint));
+  return a;
+}
+
+static jfloatArray jfloats(int n, const float* v) {
+  jfloatArray a = new_obj();
+  a->len = n;
+  a->floats = (jfloat*)calloc(n ? n : 1, sizeof(jfloat));
+  memcpy(a->floats, v, n * sizeof(jfloat));
+  return a;
+}
+
+static jlong make_op1(const char* op, const char* name, const char* pkey,
+                      const char* pval, jlong input) {
+  const char* ik[1] = {"data"};
+  int np = pkey ? 1 : 0;
+  jlong h = Java_ml_mxnettpu_LibMXNetTPU_symbolCreate(
+      ENV, NULL, js(op), js(name), jstrs(np, &pkey), jstrs(np, &pval),
+      jstrs(1, ik), jlongs(1, &input));
+  return h;
+}
+
+int main(int argc, char** argv) {
+  const char* workdir = argc > 1 ? argv[1] : "/tmp";
+  char ckpt[512];
+  snprintf(ckpt, sizeof ckpt, "%s/jni_shim_smoke.params", workdir);
+  /* data -> fc1(16) -> relu -> fc2(2) -> softmax */
+  jlong data = Java_ml_mxnettpu_LibMXNetTPU_symbolVariable(ENV, NULL,
+                                                           js("data"));
+  CHECK_EXC();
+  jlong fc1 = make_op1("FullyConnected", "fc1", "num_hidden", "16", data);
+  CHECK_EXC();
+  jlong act = make_op1("Activation", "act", "act_type", "relu", fc1);
+  CHECK_EXC();
+  jlong fc2 = make_op1("FullyConnected", "fc2", "num_hidden", "2", act);
+  CHECK_EXC();
+  jlong net = make_op1("SoftmaxOutput", "softmax", NULL, NULL, fc2);
+  CHECK_EXC();
+
+  /* json round-trip */
+  jstring json = Java_ml_mxnettpu_LibMXNetTPU_symbolToJson(ENV, NULL, net);
+  CHECK_EXC();
+  jlong net2 = Java_ml_mxnettpu_LibMXNetTPU_symbolFromJson(ENV, NULL, json);
+  CHECK_EXC();
+  jobjectArray outs = Java_ml_mxnettpu_LibMXNetTPU_symbolOutputs(ENV, NULL,
+                                                                net2);
+  CHECK_EXC();
+  if (outs->len != 1 || strcmp(outs->objs[0]->utf, "softmax_output") != 0) {
+    fprintf(stderr, "json roundtrip outputs wrong\n");
+    return 1;
+  }
+
+  /* error path: bad op name must throw, not crash */
+  g_table.exception_pending = 0;
+  Java_ml_mxnettpu_LibMXNetTPU_symbolCreate(
+      ENV, NULL, js("NoSuchOp"), js("x"), jstrs(0, NULL), jstrs(0, NULL),
+      jstrs(0, NULL), jlongs(0, NULL));
+  if (!g_table.exception_pending) {
+    fprintf(stderr, "bad op did not throw\n");
+    return 1;
+  }
+  g_table.exception_pending = 0;
+
+  /* bind */
+  enum { N = 256, P = 10, BS = 32 };
+  const char* keys[2] = {"data", "softmax_label"};
+  jint shape_data[3] = {BS, P, BS};
+  jint shape_idx[3] = {0, 2, 3};
+  jlong ex = Java_ml_mxnettpu_LibMXNetTPU_simpleBind(
+      ENV, NULL, net, js("cpu"), 0, jstrs(2, keys), jints(3, shape_data),
+      jints(3, shape_idx), js("write"));
+  CHECK_EXC();
+  Java_ml_mxnettpu_LibMXNetTPU_initXavier(ENV, NULL, ex, 7);
+  CHECK_EXC();
+
+  /* linearly separable data */
+  static float X[N * P], Y[N];
+  unsigned long long state = 88172645463325252ull;
+  for (int i = 0; i < N * P; ++i) {
+    state ^= state << 13;
+    state ^= state >> 7;
+    state ^= state << 17;
+    X[i] = ((float)(state % 20000) / 10000.0f) - 1.0f;
+  }
+  for (int i = 0; i < N; ++i)
+    Y[i] = (X[i * P] + 0.5f * X[i * P + 1] > 0) ? 1.0f : 0.0f;
+
+  for (int epoch = 0; epoch < 15; ++epoch) {
+    for (int b = 0; b < N / BS; ++b) {
+      Java_ml_mxnettpu_LibMXNetTPU_setArg(ENV, NULL, ex, js("data"),
+                                          jfloats(BS * P, X + b * BS * P));
+      Java_ml_mxnettpu_LibMXNetTPU_setArg(ENV, NULL, ex, js("softmax_label"),
+                                          jfloats(BS, Y + b * BS));
+      Java_ml_mxnettpu_LibMXNetTPU_forward(ENV, NULL, ex, 1);
+      Java_ml_mxnettpu_LibMXNetTPU_backward(ENV, NULL, ex);
+      Java_ml_mxnettpu_LibMXNetTPU_momentumUpdate(ENV, NULL, ex, 0.2f, 0.0f,
+                                                  0.9f, 1.0f / BS);
+      CHECK_EXC();
+    }
+  }
+
+  int correct = 0;
+  for (int b = 0; b < N / BS; ++b) {
+    Java_ml_mxnettpu_LibMXNetTPU_setArg(ENV, NULL, ex, js("data"),
+                                        jfloats(BS * P, X + b * BS * P));
+    Java_ml_mxnettpu_LibMXNetTPU_forward(ENV, NULL, ex, 0);
+    jfloatArray out = Java_ml_mxnettpu_LibMXNetTPU_getOutput(ENV, NULL, ex, 0);
+    CHECK_EXC();
+    for (int i = 0; i < BS; ++i) {
+      int pred = out->floats[i * 2 + 1] > out->floats[i * 2] ? 1 : 0;
+      if (pred == (int)Y[b * BS + i]) ++correct;
+    }
+  }
+  double acc = (double)correct / N;
+  printf("JNI_SHIM_SMOKE acc=%.4f\n", acc);
+  if (acc <= 0.90) { fprintf(stderr, "accuracy too low\n"); return 1; }
+
+  /* checkpoint through the shim, reload into a fresh bind */
+  Java_ml_mxnettpu_LibMXNetTPU_saveParams(ENV, NULL, ex,
+                                          js(ckpt));
+  CHECK_EXC();
+  jlong ex2 = Java_ml_mxnettpu_LibMXNetTPU_simpleBind(
+      ENV, NULL, net, js("cpu"), 0, jstrs(2, keys), jints(3, shape_data),
+      jints(3, shape_idx), js("null"));
+  jint n_loaded = Java_ml_mxnettpu_LibMXNetTPU_loadParams(
+      ENV, NULL, ex2, js(ckpt));
+  CHECK_EXC();
+  if (n_loaded < 4) { fprintf(stderr, "too few params reloaded\n"); return 1; }
+  Java_ml_mxnettpu_LibMXNetTPU_setArg(ENV, NULL, ex2, js("data"),
+                                      jfloats(BS * P, X));
+  Java_ml_mxnettpu_LibMXNetTPU_forward(ENV, NULL, ex2, 0);
+  Java_ml_mxnettpu_LibMXNetTPU_setArg(ENV, NULL, ex, js("data"),
+                                      jfloats(BS * P, X));
+  Java_ml_mxnettpu_LibMXNetTPU_forward(ENV, NULL, ex, 0);
+  jfloatArray o1 = Java_ml_mxnettpu_LibMXNetTPU_getOutput(ENV, NULL, ex, 0);
+  jfloatArray o2 = Java_ml_mxnettpu_LibMXNetTPU_getOutput(ENV, NULL, ex2, 0);
+  CHECK_EXC();
+  for (int i = 0; i < o1->len; ++i)
+    if (fabsf(o1->floats[i] - o2->floats[i]) > 1e-6f) {
+      fprintf(stderr, "reload mismatch\n");
+      return 1;
+    }
+  Java_ml_mxnettpu_LibMXNetTPU_executorFree(ENV, NULL, ex);
+  Java_ml_mxnettpu_LibMXNetTPU_executorFree(ENV, NULL, ex2);
+  Java_ml_mxnettpu_LibMXNetTPU_symbolFree(ENV, NULL, net);
+  printf("OK\n");
+  return 0;
+}

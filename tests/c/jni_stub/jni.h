@@ -1,0 +1,74 @@
+/* Minimal JNI stub for smoke-testing scala-package's JNI shim WITHOUT a
+ * JDK (none ships in this environment — docs/bindings.md). Reproduces the
+ * real jni.h calling convention — JNIEnv is a pointer to a table of
+ * function pointers invoked as (*env)->Fn(env, ...) — for exactly the
+ * subset the shim uses. Arrays are heap objects with length + typed
+ * payload; exceptions print and mark a flag the driver checks. NOT a JVM;
+ * the real contract runs under tests/test_scala_binding.py's JDK tier. */
+#ifndef MXTPU_JNI_STUB_H_
+#define MXTPU_JNI_STUB_H_
+
+#include <stddef.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define JNIEXPORT
+#define JNICALL
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef float jfloat;
+typedef jint jsize;
+
+typedef struct StubObj* jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jobject jobjectArray;
+typedef jobject jlongArray;
+typedef jobject jintArray;
+typedef jobject jfloatArray;
+typedef jobject jthrowable;
+
+struct StubObj {
+  int len;
+  char* utf;        /* strings */
+  jlong* longs;
+  jint* ints;
+  jfloat* floats;
+  jobject* objs;
+};
+
+struct JNINativeInterface_;
+typedef const struct JNINativeInterface_* JNIEnv;
+
+struct JNINativeInterface_ {
+  int exception_pending;  /* stub-side state, not in real JNI */
+  char exception_msg[1024];
+
+  const char* (*GetStringUTFChars)(JNIEnv*, jstring, void*);
+  void (*ReleaseStringUTFChars)(JNIEnv*, jstring, const char*);
+  jstring (*NewStringUTF)(JNIEnv*, const char*);
+  jsize (*GetArrayLength)(JNIEnv*, jarray);
+  jobject (*GetObjectArrayElement)(JNIEnv*, jobjectArray, jsize);
+  void (*SetObjectArrayElement)(JNIEnv*, jobjectArray, jsize, jobject);
+  jobjectArray (*NewObjectArray)(JNIEnv*, jsize, jclass, jobject);
+  jlong* (*GetLongArrayElements)(JNIEnv*, jlongArray, void*);
+  void (*ReleaseLongArrayElements)(JNIEnv*, jlongArray, jlong*, jint);
+  jint* (*GetIntArrayElements)(JNIEnv*, jintArray, void*);
+  void (*ReleaseIntArrayElements)(JNIEnv*, jintArray, jint*, jint);
+  jfloat* (*GetFloatArrayElements)(JNIEnv*, jfloatArray, void*);
+  void (*ReleaseFloatArrayElements)(JNIEnv*, jfloatArray, jfloat*, jint);
+  jfloatArray (*NewFloatArray)(JNIEnv*, jsize);
+  void (*SetFloatArrayRegion)(JNIEnv*, jfloatArray, jsize, jsize,
+                              const jfloat*);
+  jintArray (*NewIntArray)(JNIEnv*, jsize);
+  void (*SetIntArrayRegion)(JNIEnv*, jintArray, jsize, jsize, const jint*);
+  jclass (*FindClass)(JNIEnv*, const char*);
+  jint (*ThrowNew)(JNIEnv*, jclass, const char*);
+  void (*DeleteLocalRef)(JNIEnv*, jobject);
+};
+
+#endif /* MXTPU_JNI_STUB_H_ */

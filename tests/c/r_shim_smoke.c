@@ -43,7 +43,10 @@ static SEXP make_op(const char* op, const char* name, const char* pkey,
                            strvec(1, ik), vecsxp1(input));
 }
 
-int main(void) {
+int main(int argc, char** argv) {
+  const char* workdir = argc > 1 ? argv[1] : "/tmp";
+  char ckpt[512];
+  snprintf(ckpt, sizeof ckpt, "%s/r_shim_smoke.params", workdir);
   /* net: data -> fc1(16) -> relu -> fc2(2) -> softmax */
   SEXP data = RMX_symbol_variable(str1("data"));
   SEXP fc1 = make_op("FullyConnected", "fc1", "num_hidden", "16", data);
@@ -140,10 +143,10 @@ int main(void) {
   if (acc <= 0.90) { fprintf(stderr, "accuracy too low\n"); return 1; }
 
   /* checkpoint through the shim, reload, predictions must match */
-  RMX_save_params(ex, str1("/tmp/r_shim_smoke.params"));
+  RMX_save_params(ex, str1(ckpt));
   SEXP ex2 = RMX_simple_bind(net, str1("cpu"), Rf_ScalarInteger(0),
                              strvec(2, bind_keys), shapes, str1("null"));
-  SEXP n_loaded = RMX_load_params(ex2, str1("/tmp/r_shim_smoke.params"));
+  SEXP n_loaded = RMX_load_params(ex2, str1(ckpt));
   if (Rf_asInteger(n_loaded) < 4) {
     fprintf(stderr, "too few params reloaded\n");
     return 1;

@@ -176,7 +176,11 @@ def test_r_shim_smoke_trains_without_r(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
-    r = subprocess.run([exe], capture_output=True, text=True, env=env,
-                       timeout=600)
+    r = subprocess.run([exe, str(tmp_path)], capture_output=True, text=True,
+                       env=env, timeout=600)
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert "OK" in r.stdout, r.stdout
+    # interchange: the shim-written checkpoint parses in Python
+    import mxnet_tpu as mx
+    params = mx.nd.load(str(tmp_path / "r_shim_smoke.params"))
+    assert "arg:fc1_weight" in params
