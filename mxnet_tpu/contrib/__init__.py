@@ -3,3 +3,4 @@ contrib ops)."""
 from . import autograd  # noqa: F401
 from . import ndarray  # noqa: F401
 from . import symbol  # noqa: F401
+from . import caffe

@@ -1,0 +1,80 @@
+"""Caffe runtime layers (reference: plugin/caffe/caffe_op-inl.h,
+caffe_loss-inl.h — CaffeOp/CaffeLoss let a network embed layers written
+as caffe prototxt and run them INSIDE the framework, weights included).
+
+The reference plugin links the actual caffe library and calls its
+Forward/Backward. No caffe exists in this environment (or on TPU hosts),
+so the TPU-native equivalent runs the layer through the caffe-converter's
+layer mapping instead: the prototxt snippet expands AT SYMBOL-BUILD TIME
+into the equivalent native subgraph, its weights become ordinary named
+arguments (initialized/updated/checkpointed like any other), and backward
+comes from autodiff. Semantics match the converter's (the same mapping
+that is numerically validated against numpy in
+tests/test_caffe_converter.py); anything the converter rejects, CaffeOp
+rejects too — loudly.
+
+    conv = mx.contrib.caffe.CaffeOp(
+        data,
+        prototxt='layer { type: "Convolution" '
+                 'convolution_param { num_output: 8 kernel_size: 3 } }',
+        name="c1")
+
+`prototxt` may contain several layers; they chain in order (bottoms
+default to the previous layer's output, like the plugin feeding blobs
+through). `CaffeLoss` is CaffeOp whose final layer is a loss head.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["CaffeOp", "CaffeLoss"]
+
+
+def _converter():
+    try:
+        from tools import caffe_converter
+    except ImportError as e:
+        raise MXNetError(
+            "CaffeOp needs tools/caffe_converter.py (repo checkout on "
+            "sys.path); it is a repo tool, not part of the installed "
+            "package: %s" % (e,))
+    return caffe_converter
+
+
+def CaffeOp(*data, prototxt="layer{}", name=None):
+    """Expand a caffe prototxt snippet into the equivalent native subgraph.
+
+    Parameters
+    ----------
+    *data : Symbol
+        Inputs, bound to the first layer's bottoms positionally (the
+        plugin's ``num_data`` blobs).
+    prototxt : str
+        One or more ``layer { ... }`` blocks (deploy-style). TRAIN/TEST
+        data layers are not allowed — inputs come from ``*data``.
+    name : str
+        Prefix for the expanded layers' parameter names (so two CaffeOps
+        with the same prototxt do not collide). Defaults to the layer
+        names inside the prototxt.
+    """
+    import mxnet_tpu as mx
+
+    if not data:
+        raise MXNetError("CaffeOp needs at least one input symbol")
+    try:
+        return _converter().expand_layers(mx, prototxt, list(data),
+                                          name_prefix=name)
+    except ValueError as e:
+        raise MXNetError("CaffeOp: %s" % (e,))
+
+
+def CaffeLoss(*data, prototxt="layer{}", name=None, grad_scale=1.0):
+    """CaffeOp whose snippet ends in a loss head (reference
+    caffe_loss-inl.h). ``grad_scale`` matches the plugin's parameter; the
+    mapped loss ops take it via their own ``grad_scale`` where supported.
+    """
+    if grad_scale != 1.0:
+        raise MXNetError(
+            "CaffeLoss grad_scale: set grad_scale on the mapped loss op "
+            "via the prototxt's loss_weight instead (converter mapping)")
+    return CaffeOp(*data, prototxt=prototxt, name=name)

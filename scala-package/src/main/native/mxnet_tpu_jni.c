@@ -13,6 +13,7 @@
  * CI smoke (no JDK here): the same file compiles against the stub JNI env
  * (tests/c/jni_stub/jni.h) and trains end to end —
  * tests/test_scala_binding.py. */
+#include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 

@@ -6,23 +6,23 @@ package ml.mxnettpu
   */
 class Executor private[mxnettpu] (private[mxnettpu] val handle: Long) {
   def setArg(name: String, value: Array[Float]): Unit =
-    LibMXNetTPU.setArg(handle, name, value)
-  def getArg(name: String): Array[Float] = LibMXNetTPU.getArg(handle, name)
-  def getGrad(name: String): Array[Float] = LibMXNetTPU.getGrad(handle, name)
+    LibMXNetTPU.lib.setArg(handle, name, value)
+  def getArg(name: String): Array[Float] = LibMXNetTPU.lib.getArg(handle, name)
+  def getGrad(name: String): Array[Float] = LibMXNetTPU.lib.getGrad(handle, name)
   def forward(isTrain: Boolean = false): Unit =
-    LibMXNetTPU.forward(handle, if (isTrain) 1 else 0)
-  def backward(): Unit = LibMXNetTPU.backward(handle)
+    LibMXNetTPU.lib.forward(handle, if (isTrain) 1 else 0)
+  def backward(): Unit = LibMXNetTPU.lib.backward(handle)
   def output(index: Int = 0): Array[Float] =
-    LibMXNetTPU.getOutput(handle, index)
+    LibMXNetTPU.lib.getOutput(handle, index)
   def outputShape(index: Int = 0): Array[Int] =
-    LibMXNetTPU.outputShape(handle, index)
+    LibMXNetTPU.lib.outputShape(handle, index)
   def sgdUpdate(lr: Float, wd: Float = 0f, rescale: Float = 1f): Unit =
-    LibMXNetTPU.sgdUpdate(handle, lr, wd, rescale)
+    LibMXNetTPU.lib.sgdUpdate(handle, lr, wd, rescale)
   def momentumUpdate(lr: Float, wd: Float = 0f, momentum: Float = 0.9f,
                      rescale: Float = 1f): Unit =
-    LibMXNetTPU.momentumUpdate(handle, lr, wd, momentum, rescale)
-  def initXavier(seed: Int = 0): Unit = LibMXNetTPU.initXavier(handle, seed)
-  def saveParams(path: String): Unit = LibMXNetTPU.saveParams(handle, path)
-  def loadParams(path: String): Int = LibMXNetTPU.loadParams(handle, path)
-  def dispose(): Unit = LibMXNetTPU.executorFree(handle)
+    LibMXNetTPU.lib.momentumUpdate(handle, lr, wd, momentum, rescale)
+  def initXavier(seed: Int = 0): Unit = LibMXNetTPU.lib.initXavier(handle, seed)
+  def saveParams(path: String): Unit = LibMXNetTPU.lib.saveParams(handle, path)
+  def loadParams(path: String): Int = LibMXNetTPU.lib.loadParams(handle, path)
+  def dispose(): Unit = LibMXNetTPU.lib.executorFree(handle)
 }

@@ -5,9 +5,14 @@ package ml.mxnettpu
   * @native surface every higher-level class calls). Handles are jlong
   * (opaque C pointers); errors surface as RuntimeException carrying
   * MXTrainGetLastError().
+  *
+  * Instance natives on a plain class: an `object`'s @native methods live
+  * on the mirror class `LibMXNetTPU$` and would mangle to
+  * `Java_ml_mxnettpu_LibMXNetTPU_00024_*`; the class form keeps the
+  * unmangled `Java_ml_mxnettpu_LibMXNetTPU_*` names the shim exports —
+  * the same reason the reference used `class LibInfo`.
   */
-object LibMXNetTPU {
-  System.loadLibrary("mxnettpu_jni")
+class LibMXNetTPU {
 
   // Symbol
   @native def symbolFromJson(json: String): Long
@@ -47,4 +52,9 @@ object LibMXNetTPU {
   @native def kvRank(kv: Long): Int
   @native def kvNumWorkers(kv: Long): Int
   @native def kvFree(kv: Long): Unit
+}
+
+object LibMXNetTPU {
+  System.loadLibrary("mxnettpu_jni")
+  private[mxnettpu] val lib = new LibMXNetTPU
 }

@@ -6,10 +6,10 @@ package ml.mxnettpu
   * per-op wrappers of the reference collapse to thin named forwarders.
   */
 class Symbol private[mxnettpu] (private[mxnettpu] val handle: Long) {
-  def toJson: String = LibMXNetTPU.symbolToJson(handle)
-  def arguments: Array[String] = LibMXNetTPU.symbolArguments(handle)
-  def outputs: Array[String] = LibMXNetTPU.symbolOutputs(handle)
-  def dispose(): Unit = LibMXNetTPU.symbolFree(handle)
+  def toJson: String = LibMXNetTPU.lib.symbolToJson(handle)
+  def arguments: Array[String] = LibMXNetTPU.lib.symbolArguments(handle)
+  def outputs: Array[String] = LibMXNetTPU.lib.symbolOutputs(handle)
+  def dispose(): Unit = LibMXNetTPU.lib.symbolFree(handle)
 
   def simpleBind(ctx: String = "cpu", devId: Int = 0,
                  gradReq: String = "write",
@@ -18,16 +18,16 @@ class Symbol private[mxnettpu] (private[mxnettpu] val handle: Long) {
     val data = shapes.flatMap(_._2).toArray
     val idx = shapes.scanLeft(0)(_ + _._2.length).toArray
     new Executor(
-      LibMXNetTPU.simpleBind(handle, ctx, devId, keys, data, idx, gradReq))
+      LibMXNetTPU.lib.simpleBind(handle, ctx, devId, keys, data, idx, gradReq))
   }
 }
 
 object Symbol {
   def Variable(name: String): Symbol =
-    new Symbol(LibMXNetTPU.symbolVariable(name))
+    new Symbol(LibMXNetTPU.lib.symbolVariable(name))
 
   def fromJson(json: String): Symbol =
-    new Symbol(LibMXNetTPU.symbolFromJson(json))
+    new Symbol(LibMXNetTPU.lib.symbolFromJson(json))
 
   /** Generic operator constructor: symbol inputs in `inputs` (key "" =
     * positional), everything in `params` stringified into the op schema.
@@ -39,7 +39,7 @@ object Symbol {
     val pv = params.map { case (_, v) => paramStr(v) }.toArray
     val ik = inputs.map(_._1).toArray
     val ih = inputs.map(_._2.handle).toArray
-    new Symbol(LibMXNetTPU.symbolCreate(op, name, pk, pv, ik, ih))
+    new Symbol(LibMXNetTPU.lib.symbolCreate(op, name, pk, pv, ik, ih))
   }
 
   private def paramStr(v: Any): String = v match {

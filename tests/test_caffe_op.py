@@ -1,0 +1,90 @@
+"""CaffeOp/CaffeLoss runtime layers (mxnet_tpu/contrib/caffe.py — the
+analog of the reference's plugin/caffe CaffeOp/CaffeLoss: prototxt-defined
+layers running inside the framework, trainable weights included)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.caffe import CaffeOp, CaffeLoss
+
+
+def test_caffe_op_conv_forward_matches_numpy():
+    data = mx.sym.Variable("data")
+    net = CaffeOp(data, prototxt="""
+    layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+      convolution_param { num_output: 4 kernel_size: 1 } }
+    layer { name: "r1" type: "ReLU" bottom: "c1" top: "r1" }
+    """, name="cf")
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 5, 5), grad_req="write")
+    rs = np.random.RandomState(0)
+    w = rs.randn(4, 3, 1, 1).astype(np.float32)
+    b = rs.randn(4).astype(np.float32)
+    x = rs.randn(2, 3, 5, 5).astype(np.float32)
+    # weights are ordinary named arguments, prefixed by the op name
+    ex.arg_dict["cf_c1_weight"][:] = w
+    ex.arg_dict["cf_c1_bias"][:] = b
+    ex.arg_dict["data"][:] = x
+    out = ex.forward(is_train=False)[0].asnumpy()
+    expect = np.maximum(
+        np.einsum("bchw,oc->bohw", x, w[:, :, 0, 0])
+        + b[None, :, None, None], 0)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_caffe_op_trains_inside_module():
+    """A CaffeOp-defined trunk trains through autodiff like a native one
+    (the plugin's whole point: caffe layers inside fit())."""
+    data = mx.sym.Variable("data")
+    trunk = CaffeOp(data, prototxt="""
+    layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+      inner_product_param { num_output: 16 } }
+    layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "relu1" }
+    """, name="cf")
+    net = mx.sym.FullyConnected(trunk, num_hidden=2, name="out")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    rs = np.random.RandomState(3)
+    X = rs.randn(128, 10).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    mod = mx.mod.Module(net)
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=16), num_epoch=10,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+            initializer=mx.init.Xavier(), eval_metric="acc",
+            force_init=True)
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=16),
+                      mx.metric.Accuracy())[0][1]
+    assert score > 0.9, score
+    # the caffe-defined weight exists and was trained
+    arg, _ = mod.get_params()
+    assert "cf_ip1_weight" in arg
+    assert float(np.abs(arg["cf_ip1_weight"].asnumpy()).sum()) > 0
+
+
+def test_caffe_loss_head():
+    data = mx.sym.Variable("data")
+    net = CaffeLoss(data, prototxt="""
+    layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+      inner_product_param { num_output: 3 } }
+    layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+      bottom: "label" }
+    """, name="cl")
+    assert "softmax" in net.list_outputs()[0] or "loss" in net.list_outputs()[0]
+    ex = net.simple_bind(mx.cpu(), data=(4, 6), cl_loss_label=(4,),
+                         grad_req="null") if "cl_loss_label" in net.list_arguments() else \
+        net.simple_bind(mx.cpu(), data=(4, 6), grad_req="null")
+    out = ex.forward(is_train=False)[0].asnumpy()
+    assert out.shape[0] == 4
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_caffe_op_rejections():
+    data = mx.sym.Variable("data")
+    with pytest.raises(mx.MXNetError, match="data layers"):
+        CaffeOp(data, prototxt='layer { name: "d" type: "Data" }')
+    with pytest.raises(mx.MXNetError, match="no input or earlier layer"):
+        CaffeOp(data, prototxt="""
+        layer { name: "e" type: "Eltwise" bottom: "data" bottom: "ghost"
+          top: "e" }
+        """)
+    with pytest.raises(mx.MXNetError, match="at least one input"):
+        CaffeOp(prototxt='layer { name: "r" type: "ReLU" bottom: "x" }')

@@ -1,0 +1,357 @@
+#!/usr/bin/env python
+"""Measured evidence for the BASELINE.json config matrix (VERDICT round-3
+item 4): SSD training throughput + overfit mAP, DCGAN training stability +
+throughput, LSTM-LM perplexity-to-floor + fused-path scaling table.
+
+Run on the TPU (each config also runs on CPU for CI smoke):
+
+    python tools/baseline_matrix.py ssd|dcgan|lstm|all [--quick]
+
+Emits one JSON line per measurement (the bench.py convention) and a
+markdown block to paste into docs/perf.md. Reference counterparts:
+example/ssd/train.py + evaluate.py, example/gan/dcgan.py,
+example/rnn/lstm_bucketing.py.
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _ctx():
+    return mx.tpu() if mx.context.num_tpus() else mx.cpu()
+
+
+def emit(metric, value, unit, extra=None):
+    rec = {"metric": metric, "value": round(float(value), 3), "unit": unit}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+# ---------------------------------------------------------------- SSD ----
+def synth_det_data(n, num_classes, seed=0, size=300):
+    """Images with 1-3 axis-aligned colored rectangles; labels are the
+    boxes. Classes are color-coded so the task is genuinely learnable."""
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, 3, size, size), np.float32)
+    Y = -np.ones((n, 8, 5), np.float32)
+    for i in range(n):
+        X[i] += rng.rand(3, 1, 1) * 0.1  # background tint
+        for j in range(rng.randint(1, 4)):
+            cls = rng.randint(0, num_classes)
+            x0, y0 = rng.rand(2) * 0.55 + 0.05
+            w, h = 0.15 + rng.rand(2) * 0.25
+            x1, y1 = min(x0 + w, 0.98), min(y0 + h, 0.98)
+            px0, py0, px1, py1 = (np.array([x0, y0, x1, y1]) * size).astype(int)
+            # class encoded in channel intensity pattern
+            X[i, cls % 3, py0:py1, px0:px1] = 0.5 + 0.5 * ((cls // 3) % 2)
+            X[i, (cls + 1) % 3, py0:py1, px0:px1] = 0.25
+            Y[i, j] = [cls, x0, y0, x1, y1]
+    return X, Y
+
+
+def voc_ap(dets, gts, iou_thresh=0.5):
+    """Single-class VOC-style AP. dets: [(img, score, box)], gts:
+    {img: [box,...]} with box = (x0,y0,x1,y1)."""
+    npos = sum(len(v) for v in gts.values())
+    if npos == 0:
+        return float("nan")
+    dets = sorted(dets, key=lambda d: -d[1])
+    taken = {k: np.zeros(len(v), bool) for k, v in gts.items()}
+    tp = np.zeros(len(dets))
+    fp = np.zeros(len(dets))
+    for i, (img, _, box) in enumerate(dets):
+        best, best_j = 0.0, -1
+        for j, gt in enumerate(gts.get(img, [])):
+            ix0, iy0 = max(box[0], gt[0]), max(box[1], gt[1])
+            ix1, iy1 = min(box[2], gt[2]), min(box[3], gt[3])
+            iw, ih = max(ix1 - ix0, 0), max(iy1 - iy0, 0)
+            inter = iw * ih
+            union = ((box[2] - box[0]) * (box[3] - box[1])
+                     + (gt[2] - gt[0]) * (gt[3] - gt[1]) - inter)
+            iou = inter / union if union > 0 else 0
+            if iou > best:
+                best, best_j = iou, j
+        if best >= iou_thresh and not taken[img][best_j]:
+            tp[i] = 1
+            taken[img][best_j] = True
+        else:
+            fp[i] = 1
+    rec = np.cumsum(tp) / npos
+    prec = np.cumsum(tp) / np.maximum(np.cumsum(tp) + np.cumsum(fp), 1e-9)
+    ap = 0.0
+    for t in np.arange(0, 1.01, 0.1):  # 11-point
+        p = prec[rec >= t].max() if (rec >= t).any() else 0
+        ap += p / 11
+    return float(ap)
+
+
+def run_ssd(quick=False):
+    from mxnet_tpu.models import ssd
+
+    num_classes = 4
+    batch = 8 if quick else 32
+    n = 4 * batch
+    epochs = 2 if quick else 30
+    ctx = _ctx()
+    X, Y = synth_det_data(n, num_classes)
+    it = mx.io.NDArrayIter({"data": X}, {"label": Y}, batch,
+                           label_name="label")
+
+    net = ssd.get_symbol_train(num_classes=num_classes)
+    mod = mx.mod.Module(net, label_names=["label"], context=ctx)
+
+    # throughput: time post-warmup epochs of fit
+    times = []
+
+    def batch_cb(param):
+        times.append(time.perf_counter())
+
+    t0 = time.perf_counter()
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.002, "momentum": 0.9,
+                              "wd": 5e-4},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=[batch_cb], force_init=True)
+    # drop the first epoch (compile) from the rate
+    per_epoch = len(times) // epochs
+    steady = times[per_epoch:]
+    if len(steady) >= 2:
+        rate = batch * (len(steady) - 1) / (steady[-1] - steady[0])
+    else:
+        rate = batch * len(times) / (time.perf_counter() - t0)
+    emit("ssd300_train_imgs_per_sec", rate, "img/s",
+         {"batch": batch, "device": str(ctx)})
+
+    # mAP through MultiBoxDetection on the training set (overfit check)
+    det_net = ssd.get_symbol(num_classes=num_classes)
+    det = mx.mod.Module(det_net, label_names=None, context=ctx)
+    det.bind(data_shapes=[("data", (batch, 3, 300, 300))],
+             for_training=False)
+    arg, aux = mod.get_params()
+    det.set_params(arg, aux, allow_missing=True, allow_extra=True)
+    dets_per_cls = {c: [] for c in range(num_classes)}
+    gts_per_cls = {c: {} for c in range(num_classes)}
+    it.reset()
+    img_id = 0
+    for b in it:
+        det.forward(b, is_train=False)
+        out = det.get_outputs()[0].asnumpy()  # (batch, n_anchors, 6)
+        for i in range(batch):
+            for cls, score, x0, y0, x1, y1 in out[i]:
+                if cls >= 0 and score > 0.1:
+                    dets_per_cls[int(cls)].append(
+                        (img_id + i, float(score), (x0, y0, x1, y1)))
+            for row in Y[(img_id + i) % n]:
+                if row[0] >= 0:
+                    gts_per_cls[int(row[0])].setdefault(
+                        img_id + i, []).append(tuple(row[1:5]))
+        img_id += batch
+    aps = [voc_ap(dets_per_cls[c], gts_per_cls[c]) for c in range(num_classes)
+           if gts_per_cls[c]]
+    mean_ap = float(np.nanmean(aps))
+    emit("ssd300_overfit_mAP@0.5", mean_ap, "mAP",
+         {"classes": num_classes, "epochs": epochs})
+    return rate, mean_ap
+
+
+# -------------------------------------------------------------- DCGAN ----
+def run_dcgan(quick=False):
+    from mxnet_tpu.models import make_discriminator, make_generator
+
+    batch = 16 if quick else 64
+    z_dim = 100
+    steps = 10 if quick else 200
+    ctx = _ctx()
+    gen = make_generator(ngf=32, nc=1)
+    dis = make_discriminator(ndf=32)
+
+    gen_mod = mx.mod.Module(gen, data_names=("rand",), label_names=None,
+                            context=ctx)
+    gen_mod.bind(data_shapes=[("rand", (batch, z_dim, 1, 1))],
+                 inputs_need_grad=True)
+    gen_mod.init_params(initializer=mx.init.Normal(0.02))
+    gen_mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": 2e-4,
+                                             "beta1": 0.5})
+    dis_mod = mx.mod.Module(dis, data_names=("data",),
+                            label_names=("label",), context=ctx)
+    dis_mod.bind(data_shapes=[("data", (batch, 1, 64, 64))],
+                 label_shapes=[("label", (batch,))], inputs_need_grad=True)
+    dis_mod.init_params(initializer=mx.init.Normal(0.02))
+    dis_mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": 2e-4,
+                                             "beta1": 0.5})
+
+    # "real" data: blobs with structure (offline MNIST stand-in)
+    rng = np.random.RandomState(0)
+
+    def real_batch():
+        x = np.zeros((batch, 1, 64, 64), np.float32)
+        for i in range(batch):
+            cx, cy = rng.randint(16, 48, 2)
+            r = rng.randint(6, 16)
+            yy, xx = np.mgrid[:64, :64]
+            x[i, 0] = (((xx - cx) ** 2 + (yy - cy) ** 2) < r * r) * 1.0
+        return x * 2 - 1
+
+    def ce(prob, label):
+        p = prob[np.arange(len(label)), label.astype(int)]
+        return float(-np.log(np.maximum(p, 1e-8)).mean())
+
+    d_losses, g_losses = [], []
+    t_start = None
+    ones = mx.nd.ones((batch,), ctx=ctx)
+    zeros = mx.nd.zeros((batch,), ctx=ctx)
+    for step in range(steps):
+        if step == 2:
+            t_start = time.perf_counter()  # after compiles
+        z = mx.nd.array(rng.randn(batch, z_dim, 1, 1), ctx=ctx)
+        gen_mod.forward(mx.io.DataBatch(data=[z], label=[]), is_train=True)
+        fake = gen_mod.get_outputs()[0]
+        real = mx.nd.array(real_batch(), ctx=ctx)
+
+        # D on real
+        dis_mod.forward(mx.io.DataBatch(data=[real], label=[ones]),
+                        is_train=True)
+        d_real = dis_mod.get_outputs()[0].asnumpy()
+        dis_mod.backward()
+        grads_real = [[g.copy() if g is not None else None for g in gl]
+                      for gl in dis_mod._exec_group.grad_arrays]
+        # D on fake
+        dis_mod.forward(mx.io.DataBatch(data=[fake], label=[zeros]),
+                        is_train=True)
+        d_fake = dis_mod.get_outputs()[0].asnumpy()
+        dis_mod.backward()
+        for gl, rl in zip(dis_mod._exec_group.grad_arrays, grads_real):
+            for g, r in zip(gl, rl):
+                if g is not None:
+                    g += r
+        dis_mod.update()
+        d_losses.append(0.5 * (ce(d_real, np.ones(batch))
+                               + ce(d_fake, np.zeros(batch))))
+
+        # G step: D(fake) toward "real"
+        dis_mod.forward(mx.io.DataBatch(data=[fake], label=[ones]),
+                        is_train=True)
+        g_losses.append(ce(dis_mod.get_outputs()[0].asnumpy(),
+                           np.ones(batch)))
+        dis_mod.backward()
+        gen_mod.backward([dis_mod.get_input_grads()[0]])
+        gen_mod.update()
+    dt = time.perf_counter() - t_start
+    rate = batch * (steps - 2) / dt
+    emit("dcgan_train_imgs_per_sec", rate, "img/s",
+         {"batch": batch, "device": str(_ctx())})
+    third = max(len(d_losses) // 3, 1)
+    emit("dcgan_d_loss_final_third", float(np.mean(d_losses[-third:])),
+         "ce", {"first_third": round(float(np.mean(d_losses[:third])), 3)})
+    emit("dcgan_g_loss_final_third", float(np.mean(g_losses[-third:])),
+         "ce", {"first_third": round(float(np.mean(g_losses[:third])), 3)})
+    # stability: no NaNs, D not collapsed to 0 (G dead) or ln2-forever
+    assert np.isfinite(d_losses).all() and np.isfinite(g_losses).all()
+    return rate, d_losses, g_losses
+
+
+# ------------------------------------------------------------ LSTM-LM ----
+def run_lstm(quick=False, batch=32, buckets=(8, 16, 24, 32)):
+    sys.path.insert(0, "examples")
+    from lstm_bucketing import stdlib_corpus
+
+    sent, vocab = stdlib_corpus(vocab_size=5000,
+                                max_sentences=2000 if quick else 8000)
+    it = mx.rnn.BucketSentenceIter(sent, batch, buckets=list(buckets))
+    num_hidden, num_embed = 128, 128
+    cell = mx.rnn.SequentialRNNCell()
+    for i in range(2):
+        cell.add(mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                 prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=len(vocab),
+                                 output_dim=num_embed, name="embed")
+        outputs, _ = cell.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=len(vocab),
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=_ctx())
+    epochs = 2 if quick else 12
+    ppl_per_epoch = []
+    tok_rates = []
+    for epoch in range(epochs):
+        it.reset()
+        metric = mx.metric.Perplexity(ignore_label=0)
+        if epoch == 0:
+            mod.fit(it, num_epoch=1, optimizer="adam",
+                    optimizer_params={"learning_rate": 1e-3},
+                    initializer=mx.init.Xavier(), eval_metric=metric,
+                    force_init=True)
+        else:
+            t0 = time.perf_counter()
+            n_tok = 0
+            it.reset()
+            metric.reset()
+            for b in it:
+                mod.forward(b, is_train=True)
+                mod.update_metric(metric, b.label)
+                mod.backward()
+                mod.update()
+                n_tok += b.data[0].shape[0] * b.data[0].shape[1]
+            tok_rates.append(n_tok / (time.perf_counter() - t0))
+        ppl_per_epoch.append(float(metric.get()[1]))
+    emit("lstm_lm_perplexity_floor", ppl_per_epoch[-1], "ppl",
+         {"epoch1": round(ppl_per_epoch[0], 1),
+          "trajectory": [round(p, 1) for p in ppl_per_epoch]})
+    if tok_rates:
+        emit("lstm_lm_tokens_per_sec", float(np.median(tok_rates)), "tok/s",
+             {"batch": batch, "buckets": list(buckets)})
+    return ppl_per_epoch, tok_rates
+
+
+def run_lstm_scaling(quick=False):
+    """Fused-path win-threshold characterization: tokens/sec vs batch size
+    and bucket count (VERDICT: 'scaling table so the fused path's win
+    threshold is characterized rather than asserted')."""
+    rows = []
+    combos = [(32, (16, 32)), (128, (16, 32)), (512, (16, 32)),
+              (128, (8, 16, 24, 32))]
+    if quick:
+        combos = combos[:2]
+    for batch, buckets in combos:
+        _, rates = run_lstm(quick=True, batch=batch, buckets=buckets)
+        rows.append((batch, len(buckets),
+                     float(np.median(rates)) if rates else float("nan")))
+        emit("lstm_scaling_tokens_per_sec", rows[-1][2], "tok/s",
+             {"batch": batch, "n_buckets": len(buckets)})
+    return rows
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config", choices=["ssd", "dcgan", "lstm",
+                                       "lstm_scaling", "all"])
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny sizes for CI smoke")
+    a = ap.parse_args()
+    if a.config in ("ssd", "all"):
+        run_ssd(a.quick)
+    if a.config in ("dcgan", "all"):
+        run_dcgan(a.quick)
+    if a.config in ("lstm", "all"):
+        run_lstm(a.quick)
+    if a.config in ("lstm_scaling", "all"):
+        run_lstm_scaling(a.quick)

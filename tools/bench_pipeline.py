@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Host input-pipeline benchmark (VERDICT round-3 item 5; SURVEY §7's
+final hard part: the host must feed the chip).
+
+Generates a synthetic JPEG dataset, packs it with tools/im2rec.py, then
+measures:
+
+* raw JPEG decode cost per image (PIL vs cv2 backends),
+* `ImageRecordIter` end-to-end throughput (decode + augment + batch +
+  prefetch) vs `preprocess_threads`,
+* the same overlapped with a `Module.fit` consuming the batches,
+
+and prints the gap against the device rate (BENCH ResNet-50 img/s). One
+JSON line per measurement; paste the markdown into docs/perf.md.
+
+    python tools/bench_pipeline.py [--n 512] [--size 224] [--quick]
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def emit(metric, value, unit, extra=None):
+    rec = {"metric": metric, "value": round(float(value), 2), "unit": unit}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def gen_dataset(workdir, n, size):
+    """n JPEGs with enough structure that decode cost is realistic."""
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    img_dir = os.path.join(workdir, "imgs")
+    os.makedirs(img_dir, exist_ok=True)
+    lst_path = os.path.join(workdir, "data.lst")
+    with open(lst_path, "w") as lst:
+        for i in range(n):
+            # blocky texture compresses like a photo, not like noise
+            base = rng.rand(size // 8, size // 8, 3) * 255
+            arr = np.kron(base, np.ones((8, 8, 1)))[:size, :size]
+            arr += rng.randn(size, size, 3) * 8
+            im = Image.fromarray(np.clip(arr, 0, 255).astype(np.uint8))
+            name = "img_%05d.jpg" % i
+            im.save(os.path.join(img_dir, name), quality=90)
+            lst.write("%d\t%d\t%s\n" % (i, i % 10, name))
+    return img_dir, lst_path
+
+
+def pack(workdir, img_dir, lst_path):
+    """Pack via tools/im2rec.py (pass-through: store the JPEG bytes, the
+    iterator decodes) — the reference's im2rec workflow."""
+    from tools import im2rec
+
+    prefix = lst_path[:-4]
+    old_argv = sys.argv
+    sys.argv = ["im2rec.py", prefix, img_dir + os.sep, "--pass-through"]
+    try:
+        im2rec.main()
+    finally:
+        sys.argv = old_argv
+    rec = prefix + ".rec"
+    assert os.path.exists(rec), "im2rec did not produce %s" % rec
+    return rec
+
+
+def bench_decode(img_dir, n_meas=200):
+    from PIL import Image
+    files = sorted(os.listdir(img_dir))[:n_meas]
+    blobs = [open(os.path.join(img_dir, f), "rb").read() for f in files]
+
+    import io as _io
+
+    t0 = time.perf_counter()
+    for b in blobs:
+        np.asarray(Image.open(_io.BytesIO(b)).convert("RGB"))
+    pil_rate = len(blobs) / (time.perf_counter() - t0)
+    emit("decode_pil_imgs_per_sec", pil_rate, "img/s")
+
+    try:
+        import cv2
+
+        t0 = time.perf_counter()
+        for b in blobs:
+            cv2.imdecode(np.frombuffer(b, np.uint8), cv2.IMREAD_COLOR)
+        cv_rate = len(blobs) / (time.perf_counter() - t0)
+        emit("decode_cv2_imgs_per_sec", cv_rate, "img/s",
+             {"speedup_vs_pil": round(cv_rate / pil_rate, 2)})
+    except ImportError:
+        cv_rate = None
+    return pil_rate, cv_rate
+
+
+def bench_iter(rec, size, batch, threads, n_batches=30):
+    it = mx.io_image.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, size, size), batch_size=batch,
+        preprocess_threads=threads, shuffle=False)
+    # warm one batch (thread spin-up)
+    next(iter(it))
+    t0 = time.perf_counter()
+    got = 0
+    for i, b in enumerate(it):
+        got += b.data[0].shape[0]
+        if i >= n_batches:
+            break
+    rate = got / (time.perf_counter() - t0)
+    emit("recorditer_imgs_per_sec", rate, "img/s",
+         {"threads": threads, "batch": batch, "size": size})
+    return rate
+
+
+def bench_overlapped(rec, size, batch, threads, epochs=2):
+    """ImageRecordIter driving a small conv net fit — the full
+    host-produce / device-consume overlap."""
+    it = mx.io_image.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, size, size), batch_size=batch,
+        preprocess_threads=threads, shuffle=False)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
+                             stride=(2, 2), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(4, 4), stride=(4, 4), pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(net, context=ctx)
+    times = []
+
+    def cb(param):
+        times.append(time.perf_counter())
+
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), batch_end_callback=[cb],
+            force_init=True)
+    # drop the compile-dominated first batches, not a whole epoch (with
+    # epochs=1 the latter would leave an empty window)
+    steady = times[2:] if len(times) > 3 else times[1:]
+    if len(steady) >= 2:
+        rate = batch * (len(steady) - 1) / (steady[-1] - steady[0])
+    else:
+        rate = float("nan")
+    emit("rec_training_imgs_per_sec", rate, "img/s",
+         {"threads": threads, "batch": batch, "device": str(ctx)})
+    return rate
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=512)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--keep", default=None,
+                    help="directory to build the dataset in (reused)")
+    a = ap.parse_args()
+    if a.quick:
+        a.n, a.size = 64, 96
+    workdir = a.keep or tempfile.mkdtemp(prefix="mxtpu_pipe_")
+    rec = os.path.join(workdir, "data.rec")
+    if not os.path.exists(rec):
+        img_dir, lst = gen_dataset(workdir, a.n, a.size)
+        rec = pack(workdir, img_dir, lst)
+    else:
+        img_dir = os.path.join(workdir, "imgs")
+    ncpu = os.cpu_count()
+    emit("host_cpu_count", ncpu, "cores")
+    bench_decode(img_dir, n_meas=min(a.n, 200))
+    for threads in (1, 2, 4):
+        bench_iter(rec, a.size, a.batch, threads,
+                   n_batches=8 if a.quick else 30)
+    bench_overlapped(rec, a.size, a.batch, threads=2,
+                     epochs=1 if a.quick else 2)
+
+
+if __name__ == "__main__":
+    main()

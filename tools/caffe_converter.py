@@ -369,6 +369,66 @@ def _bn_scale_map(layers):
     return m
 
 
+def expand_layers(mx, prototxt_text, inputs, name_prefix=None):
+    """PUBLIC: expand a prototxt snippet into a native subgraph fed by
+    existing symbols — the engine behind ``mx.contrib.caffe.CaffeOp`` (the
+    runtime analog of the reference's plugin/caffe). ``inputs`` bind to the
+    first layer's bottoms positionally; later layers chain by blob name.
+    Raises on data layers, unknown ops, and unresolved bottoms — the same
+    no-silently-wrong-network rules as the offline converter."""
+    if not inputs:
+        raise ValueError("expand_layers needs at least one input symbol")
+    net = parse_prototxt(prototxt_text)
+    layers = _get_layers(net)
+    if not layers:
+        raise ValueError("prototxt contains no layers")
+    for ltype, _ in layers:
+        if ltype in _DATA_LAYER_TYPES:
+            raise ValueError(
+                "data layers are not allowed here — pass inputs as symbols")
+
+    scale_to_bn = _bn_scale_map(layers)
+    blobs = {}
+    first_bottoms = _all(layers[0][1], "bottom") or ["data"]
+    for i, sym in enumerate(inputs):
+        key = first_bottoms[i] if i < len(first_bottoms) else "_in%d" % i
+        blobs[key] = sym
+
+    out = None
+    prev_top = first_bottoms[0] if first_bottoms else None
+    for idx, (ltype, l) in enumerate(layers):
+        lname = _one(l, "name", "") or "%s_l%d" % (name_prefix or "caffe",
+                                                   idx)
+        if name_prefix:
+            lname = "%s_%s" % (name_prefix, lname)
+        declared = _all(l, "bottom")
+        if not declared and prev_top is not None:
+            declared = [prev_top]
+        missing = [b for b in declared if b not in blobs]
+        sheddable = "Loss" in ltype or ltype == "Accuracy"
+        bad = [b for b in missing
+               if not (sheddable and declared and b != declared[0])]
+        if bad:
+            raise ValueError(
+                "layer %r consumes blob(s) %r that no input or earlier "
+                "layer produces" % (lname, bad))
+        bottoms = [blobs[b] for b in declared if b in blobs]
+        if ltype == "Scale" and _one(l, "name", "") not in scale_to_bn:
+            raise ValueError(
+                "standalone Scale layer %r is not supported" % (lname,))
+        converted = _convert_layer(mx, ltype, l, lname, bottoms)
+        if converted is None:  # folded (Scale into BN) or eval-only layer
+            continue
+        out = converted
+        tops = _all(l, "top") or [_one(l, "name", "")]
+        for t in tops:
+            blobs[t] = out
+        prev_top = tops[0]
+    if out is None:
+        raise ValueError("no layer produced an output")
+    return out
+
+
 def convert_symbol(prototxt_text):
     """Convert a deploy prototxt to a Symbol.
 
