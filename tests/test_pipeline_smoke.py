@@ -1,0 +1,56 @@
+"""End-to-end rec-file training smoke (VERDICT round-3 item 5's CI piece):
+synthetic JPEGs -> tools/im2rec.py pack -> ImageRecordIter decode/augment/
+batch -> Module.fit. The throughput study lives in tools/bench_pipeline.py
++ docs/perf.md; this test pins the correctness of the full path.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytest.importorskip("PIL")
+
+
+def test_jpeg_to_rec_to_fit(tmp_path):
+    import mxnet_tpu as mx
+    sys.path.insert(0, ROOT)
+    from tools.bench_pipeline import gen_dataset, pack
+
+    n, size, batch = 64, 32, 16
+    img_dir, lst = gen_dataset(str(tmp_path), n, size)
+    rec = pack(str(tmp_path), img_dir, lst)
+    assert os.path.exists(rec) and os.path.exists(rec[:-4] + ".idx")
+
+    it = mx.io_image.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, size, size), batch_size=batch,
+        preprocess_threads=2, shuffle=True)
+    # one full pass: batches have the declared shape and live pixel range
+    seen = 0
+    for b in it:
+        arr = b.data[0].asnumpy()
+        assert arr.shape == (batch, 3, size, size)
+        assert arr.max() > 1.0  # raw 0..255 pixels (no silent normalize)
+        seen += batch - b.pad
+    assert seen == n
+    it.reset()
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                             stride=(2, 2), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), eval_metric="acc",
+            force_init=True)
+    # the labels cycle i%10 over random textures — no learnable signal;
+    # the assertion is that the full pipeline trains without error and
+    # produces finite params
+    arg, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in arg.values())

@@ -182,7 +182,7 @@ def main():
         bench_iter(rec, a.size, a.batch, threads,
                    n_batches=8 if a.quick else 30)
     bench_overlapped(rec, a.size, a.batch, threads=2,
-                     epochs=1 if a.quick else 2)
+                     epochs=3 if a.quick else 2)
 
 
 if __name__ == "__main__":
