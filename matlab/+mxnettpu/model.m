@@ -1,0 +1,217 @@
+classdef model < handle
+%MODEL MXNet-TPU model: load a checkpoint and run forward for prediction.
+%
+% TPU-native rebuild of the reference MATLAB binding
+% (reference: matlab/+mxnet/model.m — same classdef surface, load/forward
+% semantics, and col-major<->row-major conversion contract, implemented
+% over c_predict_api.h).  Two runtimes serve the same C surface:
+%
+%   libmxtpu_predict.so         symbol.json + .params checkpoints
+%                               (embedded runtime; forward is one cached
+%                               XLA executable)
+%   libmxtpu_predict_native.so  Python-free .mxa AOT artifacts
+%                               (PJRT runtime; use for deployment hosts
+%                               with no Python installed)
+%
+% Both are driven through the identical calllib sequence, so this class
+% only decides which library callmxtpu() loads (see load_artifact).
+%
+% Example:
+%   m = mxnettpu.model;
+%   m.load('output/lenet', 10);        % lenet-symbol.json + lenet-0010.params
+%   scores = m.forward(img);           % img is H x W [x C [x N]], col-major
+%
+%   m2 = mxnettpu.model;
+%   m2.load_artifact('lenet.mxa');     % Python-free deployment artifact
+%   scores = m2.forward(img, 'tpu', 0);
+
+properties
+  % symbol definition in json text ('' in artifact mode)
+  symbol
+  % raw parameter bytes: the .params file, or the whole .mxa artifact
+  params
+  % print predictor (re)creation messages when nonzero
+  verbose
+end
+
+properties (Access = private)
+  % opaque PredictorHandle (0 when unbound)
+  predictor
+  % nonzero when params holds an .mxa artifact for the native runtime
+  artifact
+  % signature of the bind the current predictor was created for; a
+  % forward() whose input size / device / requested outputs differ
+  % rebinds (the runtime compiles per shape, like Executor.reshape)
+  bindsig
+end
+
+methods
+  function obj = model()
+    obj.predictor = libpointer('voidPtr', 0);
+    obj.symbol = '';
+    obj.params = uint8([]);
+    obj.verbose = 1;
+    obj.artifact = 0;
+    obj.bindsig = '';
+  end
+
+  function delete(obj)
+    obj.unbind();
+  end
+
+  function load(obj, prefix, epoch)
+  %LOAD read a <prefix>-symbol.json + <prefix>-%04d.params checkpoint
+  % (the format Module.save_checkpoint / model.save_checkpoint writes;
+  % byte-compatible with the reference).
+    obj.symbol = fileread([prefix '-symbol.json']);
+    fid = fopen(sprintf('%s-%04d.params', prefix, epoch), 'rb');
+    assert(fid > 0, 'cannot open %s-%04d.params', prefix, epoch);
+    obj.params = fread(fid, inf, '*uint8');
+    fclose(fid);
+    obj.unbind();      % free through the runtime that created the handle
+    obj.artifact = 0;
+  end
+
+  function load_artifact(obj, path)
+  %LOAD_ARTIFACT read a .mxa AOT artifact (mxnet_tpu.export_predict_artifact)
+  % and route forward through the Python-free native runtime.
+    fid = fopen(path, 'rb');
+    assert(fid > 0, 'cannot open %s', path);
+    obj.params = fread(fid, inf, '*uint8');
+    fclose(fid);
+    obj.symbol = '';
+    obj.unbind();      % free through the runtime that created the handle
+    obj.artifact = 1;
+  end
+
+  function json = parse_symbol(obj)
+  %PARSE_SYMBOL decode the symbol json into a MATLAB struct
+    assert(~isempty(obj.symbol), 'no symbol loaded (artifact mode?)');
+    json = parse_json(obj.symbol);
+  end
+
+  function outputs = forward(obj, input, varargin)
+  %FORWARD run prediction on one input batch.
+  %
+  %   out = m.forward(x)                 default device
+  %   out = m.forward(x, 'tpu', 0)       explicit device ('cpu' works too;
+  %                                      'gpu' accepted for reference
+  %                                      script compatibility)
+  %   out = m.forward(x, {'conv4','fc'}) also fetch internal layer outputs
+  %
+  % x is indexed MATLAB-style (col-major, e.g. H x W x C x N); it is
+  % transposed to the row-major N x C x H x W order the runtime expects,
+  % and outputs are transposed back.
+    dev_type = 1; dev_id = 0; out_layers = {};
+    k = 1;
+    while k <= numel(varargin)
+      a = varargin{k};
+      if ischar(a) && any(strcmp(a, {'cpu', 'tpu', 'gpu'}))
+        assert(k < numel(varargin) && isnumeric(varargin{k+1}), ...
+               'device name must be followed by a device id');
+        if ~strcmp(a, 'cpu'), dev_type = 2; end
+        dev_id = varargin{k+1};
+        k = k + 2;
+      elseif ischar(a)
+        out_layers{end+1} = a; %#ok<AGROW>
+        k = k + 1;
+      elseif iscell(a)
+        out_layers = a;
+        k = k + 1;
+      else
+        error('unrecognized forward() argument #%d', k + 1);
+      end
+    end
+    assert(~isempty(obj.params), 'call load()/load_artifact() first');
+
+    siz = size(input);
+    assert(numel(siz) >= 2, 'input must be at least 2-D');
+    % to_c_order() swaps the first two MATLAB dims before flattening, so
+    % the row-major shape the runtime sees is the reverse of the PERMUTED
+    % size, left-padded to 4-D: (H,W,C,N) col-major -> (N,C,H,W) row-major.
+    % (The reference reversed the unpermuted size — matlab/+mxnet/model.m
+    % — which silently swaps H/W for non-square inputs; fixed here.)
+    psiz = siz;
+    psiz([1 2]) = siz([2 1]);
+    cshape = [ones(1, max(0, 4 - numel(psiz))), psiz(end:-1:1)];
+    nshape = numel(cshape);             % >4-D inputs keep their full rank
+
+    sig = mat2str([cshape, dev_type, dev_id]);
+    for i = 1:numel(out_layers), sig = [sig '|' out_layers{i}]; end %#ok<AGROW>
+    if ~strcmp(sig, obj.bindsig)
+      obj.unbind();
+    end
+
+    if obj.predictor.Value == 0
+      if obj.verbose
+        fprintf('mxnettpu: binding predictor for input [%s]\n', ...
+                num2str(cshape));
+      end
+      callmxtpu(obj.artifact, 'MXPredCreatePartialOut', obj.symbol, ...
+                libpointer('voidPtr', obj.params), ...
+                int32(numel(obj.params)), ...
+                int32(dev_type), int32(dev_id), ...
+                uint32(1), {'data'}, ...
+                uint32([0, nshape]), uint32(cshape), ...
+                uint32(numel(out_layers)), out_layers, ...
+                obj.predictor);
+      obj.bindsig = sig;
+    end
+
+    callmxtpu(obj.artifact, 'MXPredSetInput', obj.predictor, 'data', ...
+              single(obj.to_c_order(input)), uint32(numel(input)));
+    callmxtpu(obj.artifact, 'MXPredForward', obj.predictor);
+
+    n_out = max(1, numel(out_layers));
+    if n_out == 1
+      outputs = obj.fetch_output(0);
+    else
+      outputs = cell(n_out, 1);
+      for i = 1:n_out
+        outputs{i} = obj.fetch_output(i - 1);
+      end
+    end
+  end
+end
+
+methods (Access = private)
+  function unbind(obj)
+    if obj.predictor.Value ~= 0
+      callmxtpu(obj.artifact, 'MXPredFree', obj.predictor);
+      obj.predictor = libpointer('voidPtr', 0);
+    end
+    obj.bindsig = '';
+  end
+
+  function y = to_c_order(obj, x) %#ok<INUSL>
+  % flatten a col-major array so index order matches the C-order shape
+  % reverse(size(x)): swapping the first two dims then reading down
+  % columns enumerates elements in row-major order of the reversed shape
+    nd = max(2, ndims(x));
+    y = permute(x, [2 1 3:nd]);
+    y = y(:);
+  end
+
+  function out = fetch_output(obj, index)
+    pdim = libpointer('uint32Ptr', 0);
+    pshape = libpointer('uint32PtrPtr', zeros(8, 1, 'uint32'));
+    callmxtpu(obj.artifact, 'MXPredGetOutputShape', obj.predictor, ...
+              uint32(index), pshape, pdim);
+    nd = double(pdim.Value);
+    assert(nd >= 1 && nd <= 8, 'unsupported output rank %d', nd);
+    setdatatype(pshape.Value, 'uint32Ptr', nd);
+    cshape = double(pshape.Value(1:nd))';
+    msiz = cshape(end:-1:1);            % back to MATLAB (col-major) order
+    if numel(msiz) == 1, msiz = [msiz 1]; end
+
+    buf = libpointer('singlePtr', zeros(msiz, 'single'));
+    callmxtpu(obj.artifact, 'MXPredGetOutput', obj.predictor, ...
+              uint32(index), buf, uint32(prod(msiz)));
+    out = reshape(buf.Value, msiz);
+    if numel(msiz) > 2
+      out = permute(out, [2 1 3:numel(msiz)]);
+    end
+  end
+end
+
+end

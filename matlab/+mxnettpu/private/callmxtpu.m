@@ -1,0 +1,42 @@
+function callmxtpu(artifact, func, varargin)
+%CALLMXTPU load the right predict runtime and call one C API function.
+%
+% artifact == 0 -> libmxtpu_predict.so        (symbol.json + .params)
+% artifact ~= 0 -> libmxtpu_predict_native.so (Python-free .mxa runtime)
+%
+% Both implement c_predict_api.h, so the calllib sequence is identical
+% (reference: matlab/+mxnet/private/callmxnet.m over libmxnet).
+% MXNETTPU_LIB_DIR overrides the build directory the libraries are
+% loaded from (default: <repo>/mxnet_tpu/src/build).
+
+if artifact
+  lib = 'libmxtpu_predict_native';
+else
+  lib = 'libmxtpu_predict';
+end
+
+if ~libisloaded(lib)
+  libdir = getenv('MXNETTPU_LIB_DIR');
+  if isempty(libdir)
+    here = fileparts(mfilename('fullpath'));
+    libdir = fullfile(here, '..', '..', '..', 'mxnet_tpu', 'src', 'build');
+  end
+  header = fullfile(fileparts(libdir), 'include', 'c_predict_api.h');
+  sofile = fullfile(libdir, [lib '.so']);
+  target = 'c_predict';
+  if artifact, target = 'c_predict_native'; end
+  assert(exist(sofile, 'file') == 2, ...
+         'missing %s — run `make -C mxnet_tpu/src %s` first', sofile, target);
+  assert(exist(header, 'file') == 2, 'missing header %s', header);
+  [err, warn] = loadlibrary(sofile, header, 'alias', lib);
+  assert(isempty(err), 'loadlibrary failed');
+  if ~isempty(warn), disp(warn); end
+end
+
+assert(ischar(func));
+ret = calllib(lib, func, varargin{:});
+if ret ~= 0
+  msg = calllib(lib, 'MXGetLastError');
+  error('mxnettpu:capi', '%s failed: %s', func, msg);
+end
+end

@@ -1,0 +1,132 @@
+function value = parse_json(text)
+%PARSE_JSON decode a JSON string into MATLAB values.
+%
+% Objects -> struct (keys sanitized to valid field names), arrays -> cell,
+% numbers -> double, strings -> char, true/false -> logical, null -> [].
+% Covers the full grammar produced by Symbol.tojson (reference analog:
+% matlab/+mxnet/private/parse_json.m; this is an independent
+% recursive-descent implementation, octave-compatible).
+
+pos = 1;
+text = char(text(:)');
+[value, pos] = parse_value(text, skip_ws(text, pos));
+pos = skip_ws(text, pos);
+assert(pos > numel(text), 'trailing characters at position %d', pos);
+end
+
+function p = skip_ws(s, p)
+while p <= numel(s) && any(s(p) == sprintf(' \t\r\n'))
+  p = p + 1;
+end
+end
+
+function [v, p] = parse_value(s, p)
+assert(p <= numel(s), 'unexpected end of json');
+c = s(p);
+if c == '{'
+  [v, p] = parse_object(s, p);
+elseif c == '['
+  [v, p] = parse_array(s, p);
+elseif c == '"'
+  [v, p] = parse_string(s, p);
+elseif c == 't'
+  assert(strncmp(s(p:end), 'true', 4)); v = true; p = p + 4;
+elseif c == 'f'
+  assert(strncmp(s(p:end), 'false', 5)); v = false; p = p + 5;
+elseif c == 'n'
+  assert(strncmp(s(p:end), 'null', 4)); v = []; p = p + 4;
+else
+  [v, p] = parse_number(s, p);
+end
+end
+
+function [obj, p] = parse_object(s, p)
+obj = struct();
+p = skip_ws(s, p + 1);                  % consume '{'
+if s(p) == '}'
+  p = p + 1;
+  return
+end
+while true
+  [key, p] = parse_string(s, p);
+  p = skip_ws(s, p);
+  assert(s(p) == ':', 'expected : at %d', p);
+  [val, p] = parse_value(s, skip_ws(s, p + 1));
+  obj.(fieldname(key)) = val;
+  p = skip_ws(s, p);
+  if s(p) == ','
+    p = skip_ws(s, p + 1);
+  else
+    assert(s(p) == '}', 'expected , or } at %d', p);
+    p = p + 1;
+    return
+  end
+end
+end
+
+function [arr, p] = parse_array(s, p)
+arr = {};
+p = skip_ws(s, p + 1);                  % consume '['
+if s(p) == ']'
+  p = p + 1;
+  return
+end
+while true
+  [val, p] = parse_value(s, p);
+  arr{end+1} = val; %#ok<AGROW>
+  p = skip_ws(s, p);
+  if s(p) == ','
+    p = skip_ws(s, p + 1);
+  else
+    assert(s(p) == ']', 'expected , or ] at %d', p);
+    p = p + 1;
+    return
+  end
+end
+end
+
+function [str, p] = parse_string(s, p)
+assert(s(p) == '"', 'expected string at %d', p);
+p = p + 1;
+out = '';
+while s(p) ~= '"'
+  if s(p) == '\'
+    p = p + 1;
+    e = s(p);
+    switch e
+      case 'n', out(end+1) = sprintf('\n'); %#ok<AGROW>
+      case 't', out(end+1) = sprintf('\t'); %#ok<AGROW>
+      case 'r', out(end+1) = sprintf('\r'); %#ok<AGROW>
+      case 'b', out(end+1) = char(8);  %#ok<AGROW>
+      case 'f', out(end+1) = char(12); %#ok<AGROW>
+      case 'u'
+        out(end+1) = char(hex2dec(s(p+1:p+4))); %#ok<AGROW>
+        p = p + 4;
+      otherwise, out(end+1) = e; %#ok<AGROW>  % \" \\ \/
+    end
+  else
+    out(end+1) = s(p); %#ok<AGROW>
+  end
+  p = p + 1;
+end
+p = p + 1;                              % consume closing '"'
+str = out;
+end
+
+function [num, p] = parse_number(s, p)
+q = p;
+while q <= numel(s) && any(s(q) == '+-0123456789.eE')
+  q = q + 1;
+end
+num = str2double(s(p:q-1));
+assert(~isnan(num) || strcmp(s(p:q-1), 'NaN'), 'bad number at %d', p);
+p = q;
+end
+
+function f = fieldname(key)
+% sanitize a JSON key into a MATLAB struct field name
+f = regexprep(key, '[^A-Za-z0-9_]', '_');
+if isempty(f) || ~isletter(f(1))
+  f = ['x_' f];
+end
+end

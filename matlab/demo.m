@@ -1,0 +1,33 @@
+%% MXNet-TPU MATLAB demo (reference analog: matlab/demo.m)
+%
+% Loads a LeNet checkpoint trained by examples/train_mnist.py and
+% classifies MNIST-shaped digits.  Produce the checkpoint first:
+%
+%   python examples/train_mnist.py --network lenet --prefix output/lenet
+%
+% Then from this directory:
+%
+%   >> demo
+
+clear model
+model = mxnettpu.model;
+model.load('output/lenet', 10);
+
+% a batch of 4 blank 28x28 digits (H x W x C x N, col-major)
+x = zeros(28, 28, 1, 4, 'single');
+
+scores = model.forward(x);           % 10 x 4: class scores per column
+[~, pred] = max(scores);
+fprintf('predicted classes: %s\n', num2str(pred - 1));
+
+% fetch an internal layer too
+outs = model.forward(x, {'pooling1_output', 'softmax_output'});
+fprintf('pooling1 output has %d elements\n', numel(outs{1}));
+
+%% Python-free deployment: same API over a .mxa artifact
+%
+%   python -c "import mxnet_tpu as mx; mx.export_predict_artifact(...)"
+%
+% model2 = mxnettpu.model;
+% model2.load_artifact('output/lenet.mxa');
+% scores2 = model2.forward(x, 'tpu', 0);

@@ -1,0 +1,35 @@
+%% parse_json unit test — runnable under Octave or MATLAB with no
+% native library (reference analog: matlab/tests/; exercised by
+% tests/test_matlab_binding.py when an interpreter is available).
+% Prints PARSE_JSON_OK on success.
+
+here = fileparts(mfilename('fullpath'));
+cd(fullfile(here, '..', '+mxnettpu', 'private'));  % private fns callable from cwd
+
+j = ['{"nodes": [{"op": "null", "name": "data", "inputs": []}, ' ...
+     '{"op": "FullyConnected", "name": "fc1", ' ...
+     '"attr": {"num_hidden": "10"}, "inputs": [[0, 0, 0]]}], ' ...
+     '"arg_nodes": [0], "heads": [[1, 0, 0]], ' ...
+     '"esc": "a\"b\\c\nd", "pi": 3.25, "neg": -2e-2, ' ...
+     '"flags": [true, false, null]}'];
+
+v = parse_json(j);
+
+assert(numel(v.nodes) == 2);
+assert(strcmp(v.nodes{1}.op, 'null'));
+assert(strcmp(v.nodes{2}.name, 'fc1'));
+assert(strcmp(v.nodes{2}.attr.num_hidden, '10'));
+assert(isempty(v.nodes{1}.inputs));
+assert(isequal(v.nodes{2}.inputs{1}, {0, 0, 0}));
+assert(v.arg_nodes{1} == 0);
+assert(strcmp(v.esc, sprintf('a"b\\c\nd')));
+assert(abs(v.pi - 3.25) < 1e-12);
+assert(abs(v.neg + 0.02) < 1e-12);
+assert(v.flags{1} == true && v.flags{2} == false && isempty(v.flags{3}));
+
+% whitespace + nested empties
+v2 = parse_json(sprintf(' {\n\t"a" : [ ] , "b" : { } , "c" : [ 1 ,2 ]}  '));
+assert(isempty(v2.a) && isempty(fieldnames(v2.b)));
+assert(v2.c{2} == 2);
+
+disp('PARSE_JSON_OK');

@@ -1,0 +1,35 @@
+%% prediction test — needs MATLAB (loadlibrary/calllib are not available
+% in Octave), a built libmxtpu_predict.so, and a checkpoint + fixtures
+% produced by tests/test_matlab_binding.py:
+%
+%   MXNETTPU_FIXDIR/
+%     net-symbol.json, net-0001.params   checkpoint (Python-trained)
+%     input.csv                          flattened col-major input batch
+%     insize.csv                         MATLAB size vector of the input
+%     expected.csv                       flattened expected scores
+%
+% Prints PREDICTION_OK on success (reference analog:
+% matlab/tests/test_prediction.m, which compared error rate on MNIST).
+
+here = fileparts(mfilename('fullpath'));
+addpath(fullfile(here, '..'));
+
+fixdir = getenv('MXNETTPU_FIXDIR');
+assert(~isempty(fixdir), 'set MXNETTPU_FIXDIR');
+
+insize = dlmread(fullfile(fixdir, 'insize.csv'));
+x = single(reshape(dlmread(fullfile(fixdir, 'input.csv')), insize));
+expected = dlmread(fullfile(fixdir, 'expected.csv'));
+
+m = mxnettpu.model;
+m.load(fullfile(fixdir, 'net'), 1);
+scores = m.forward(x);
+
+assert(max(abs(scores(:) - expected(:))) < 1e-4, ...
+       'forward mismatch vs python executor');
+
+% symbol introspection
+sym = m.parse_symbol();
+assert(numel(sym.nodes) >= 2);
+
+disp('PREDICTION_OK');
