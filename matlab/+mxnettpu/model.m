@@ -99,6 +99,10 @@ methods
   %                                      script compatibility)
   %   out = m.forward(x, {'conv4','fc'}) also fetch internal layer outputs
   %
+  % With zero or one requested layer the result is a numeric array; with
+  % two or more it is a cell array — the reference binding's contract
+  % (matlab/+mxnet/model.m), kept for script compatibility.
+  %
   % x is indexed MATLAB-style (col-major, e.g. H x W x C x N); it is
   % transposed to the row-major N x C x H x W order the runtime expects,
   % and outputs are transposed back.

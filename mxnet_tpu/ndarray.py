@@ -803,3 +803,17 @@ from . import op_doc as _op_doc  # noqa: E402
 _op_doc.attach_docs(_cur_module, list_ops(), "imperative")
     # public names: strip no leading underscore ops only
 transpose = getattr(_cur_module, "transpose")
+
+
+def __getattr__(name):
+    # ops registered after import (registry.register / register_simple)
+    # resolve lazily, so custom registrations get the same generated
+    # namespace treatment as built-ins
+    from .ops.registry import has_op
+
+    if not name.startswith("__") and has_op(name):
+        fn = _make_ndarray_function(name)
+        setattr(_cur_module, name, fn)
+        _op_doc.attach_docs(_cur_module, [name], "imperative")
+        return fn
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -307,6 +307,10 @@ def get_op(name):
         raise MXNetError("Operator '%s' is not registered" % name) from None
 
 
+def has_op(name):
+    return name in _OP_REGISTRY
+
+
 def list_ops():
     return sorted(_OP_REGISTRY.keys())
 

@@ -636,6 +636,18 @@ from . import op_doc as _op_doc  # noqa: E402
 _op_doc.attach_docs(_cur_module, list_ops(), "symbolic")
 
 
+def __getattr__(name):
+    # ops registered after import resolve lazily (see ndarray.__getattr__)
+    from .ops.registry import has_op
+
+    if not name.startswith("__") and has_op(name):
+        fn = _make_symbol_function(name)
+        setattr(_cur_module, name, fn)
+        _op_doc.attach_docs(_cur_module, [name], "symbolic")
+        return fn
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
 def _module_binary(lhs, rhs, op, scalar_op, rscalar_op=None):
     """(reference: symbol.py's pow/maximum/minimum/hypot module functions —
     Symbol|scalar on either side)"""
