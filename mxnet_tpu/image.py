@@ -27,18 +27,19 @@ __all__ = [
 ]
 
 
-def imdecode(buf, to_rgb=True, flag=1, **kwargs):
-    """Decode an image byte buffer to an NDArray (HWC).
+def _to_np(src):
+    """numpy view of an image (NDArray or array-like), no copy when possible."""
+    return src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
 
-    (reference: image.py imdecode → cv2.imdecode op src/io/image_io.cc)
 
-    Backend: cv2 when importable (the reference's own decoder — ~4× faster
-    than PIL and releases the GIL, so ImageRecordIter's decode threads
-    scale; measured in docs/perf.md), else PIL.
-    ``MXNET_IMAGE_DECODE_BACKEND=pil`` forces the PIL path.
+def imdecode_np(buf, to_rgb=True, flag=1):
+    """Decode an image byte buffer to a numpy HWC uint8 array.
+
+    The numpy core of :func:`imdecode` — ImageRecordIter's decode workers
+    use this directly so the per-image path never touches device arrays
+    (each ``nd.array`` is a device placement; measured in docs/perf.md
+    §pipeline).
     """
-    import os
-
     if isinstance(buf, nd.NDArray):
         buf = buf.asnumpy().tobytes()
     elif isinstance(buf, np.ndarray):
@@ -57,7 +58,7 @@ def imdecode(buf, to_rgb=True, flag=1, **kwargs):
                     arr = arr[:, :, None]
                 elif to_rgb:
                     arr = cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
-                return nd.array(np.ascontiguousarray(arr), dtype=np.uint8)
+                return np.ascontiguousarray(arr)
     from PIL import Image
 
     img = Image.open(_io.BytesIO(buf))
@@ -69,20 +70,54 @@ def imdecode(buf, to_rgb=True, flag=1, **kwargs):
         arr = np.asarray(img)
         if not to_rgb:
             arr = arr[:, :, ::-1]
-    return nd.array(arr.astype(np.uint8), dtype=np.uint8)
+    return arr.astype(np.uint8)
 
 
-def imresize(src, w, h, interp=2):
-    """Resize to exactly (w, h) (reference: cv2.resize wrapper)."""
+def imdecode(buf, to_rgb=True, flag=1, **kwargs):
+    """Decode an image byte buffer to an NDArray (HWC).
+
+    (reference: image.py imdecode → cv2.imdecode op src/io/image_io.cc)
+
+    Backend: cv2 when importable (the reference's own decoder — ~4× faster
+    than PIL and releases the GIL, so ImageRecordIter's decode threads
+    scale; measured in docs/perf.md), else PIL.
+    ``MXNET_IMAGE_DECODE_BACKEND=pil`` forces the PIL path.
+    """
+    return nd.array(imdecode_np(buf, to_rgb=to_rgb, flag=flag),
+                    dtype=np.uint8)
+
+
+def imresize_np(arr, w, h, interp=2):
+    """Resize a numpy HWC image to exactly (w, h).
+
+    cv2 backend when importable (interp uses cv2's interpolation codes,
+    the reference's convention: 0 nearest, 1 bilinear, 2 bicubic...);
+    PIL fallback maps any nonzero interp to bilinear.
+    """
+    arr = np.asarray(arr)
+    squeeze = arr.ndim == 3 and arr.shape[2] == 1
+    if os.environ.get("MXNET_IMAGE_DECODE_BACKEND", "").lower() != "pil":
+        try:
+            import cv2
+        except ImportError:
+            cv2 = None
+        if cv2 is not None:
+            out = cv2.resize(arr.squeeze(-1) if squeeze else arr, (w, h),
+                             interpolation=int(interp))
+            return out[:, :, None] if squeeze else out
     from PIL import Image
 
-    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
-    squeeze = arr.shape[2] == 1
     im = Image.fromarray(arr.squeeze(-1) if squeeze else arr.astype(np.uint8))
     im = im.resize((w, h), Image.BILINEAR if interp else Image.NEAREST)
     out = np.asarray(im)
     if squeeze:
         out = out[:, :, None]
+    return out
+
+
+def imresize(src, w, h, interp=2):
+    """Resize to exactly (w, h) (reference: cv2.resize wrapper)."""
+    out = imresize_np(_to_np(src), w, h, interp)
     return nd.array(out.astype(np.uint8), dtype=np.uint8)
 
 
@@ -97,51 +132,71 @@ def scale_down(src_size, size):
     return int(w), int(h)
 
 
-def resize_short(src, size, interp=2):
-    """Resize so the shorter edge == size (reference: image.py resize_short)."""
-    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+def resize_short_np(arr, size, interp=2):
+    """numpy core of :func:`resize_short`."""
     h, w = arr.shape[:2]
     if h > w:
         new_w, new_h = size, size * h // w
     else:
         new_w, new_h = size * w // h, size
-    return imresize(src, new_w, new_h, interp)
+    return imresize_np(arr, new_w, new_h, interp)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge == size (reference: image.py resize_short)."""
+    return nd.array(resize_short_np(_to_np(src), size, interp).astype(np.uint8),
+                    dtype=np.uint8)
+
+
+def fixed_crop_np(arr, x0, y0, w, h, size=None, interp=2):
+    """numpy core of :func:`fixed_crop`."""
+    out = arr[y0 : y0 + h, x0 : x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize_np(out, size[0], size[1], interp)
+    return out
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
     """(reference: image.py fixed_crop)"""
-    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
-    out = arr[y0 : y0 + h, x0 : x0 + w]
-    if size is not None and (w, h) != size:
-        return imresize(nd.array(out, dtype=np.uint8), size[0], size[1], interp)
-    return nd.array(out, dtype=np.uint8)
+    out = fixed_crop_np(_to_np(src), x0, y0, w, h, size, interp)
+    return nd.array(np.ascontiguousarray(out), dtype=np.uint8)
 
 
-def random_crop(src, size, interp=2):
-    """(reference: image.py random_crop)"""
-    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+def random_crop_np(arr, size, interp=2):
+    """numpy core of :func:`random_crop`."""
     h, w = arr.shape[:2]
     new_w, new_h = scale_down((w, h), size)
     x0 = pyrandom.randint(0, w - new_w)
     y0 = pyrandom.randint(0, h - new_h)
-    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
-    return out, (x0, y0, new_w, new_h)
+    return fixed_crop_np(arr, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
 
 
-def center_crop(src, size, interp=2):
-    """(reference: image.py center_crop)"""
-    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+def random_crop(src, size, interp=2):
+    """(reference: image.py random_crop)"""
+    out, rect = random_crop_np(_to_np(src), size, interp)
+    return nd.array(np.ascontiguousarray(out), dtype=np.uint8), rect
+
+
+def center_crop_np(arr, size, interp=2):
+    """numpy core of :func:`center_crop`."""
     h, w = arr.shape[:2]
     new_w, new_h = scale_down((w, h), size)
     x0 = (w - new_w) // 2
     y0 = (h - new_h) // 2
-    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
-    return out, (x0, y0, new_w, new_h)
+    return fixed_crop_np(arr, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
 
 
-def random_size_crop(src, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0), interp=2):
-    """Random area+aspect crop (reference: image.py random_size_crop)."""
-    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+def center_crop(src, size, interp=2):
+    """(reference: image.py center_crop)"""
+    out, rect = center_crop_np(_to_np(src), size, interp)
+    return nd.array(np.ascontiguousarray(out), dtype=np.uint8), rect
+
+
+def random_size_crop_np(arr, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0),
+                        interp=2):
+    """numpy core of :func:`random_size_crop`."""
     h, w = arr.shape[:2]
     area = w * h
     for _ in range(10):
@@ -152,74 +207,110 @@ def random_size_crop(src, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0), interp=
         if new_w <= w and new_h <= h:
             x0 = pyrandom.randint(0, w - new_w)
             y0 = pyrandom.randint(0, h - new_h)
-            return fixed_crop(src, x0, y0, new_w, new_h, size, interp), (x0, y0, new_w, new_h)
-    return center_crop(src, size, interp)
+            return (fixed_crop_np(arr, x0, y0, new_w, new_h, size, interp),
+                    (x0, y0, new_w, new_h))
+    return center_crop_np(arr, size, interp)
+
+
+def random_size_crop(src, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0), interp=2):
+    """Random area+aspect crop (reference: image.py random_size_crop)."""
+    out, rect = random_size_crop_np(_to_np(src), size, min_area, ratio, interp)
+    return nd.array(np.ascontiguousarray(out), dtype=np.uint8), rect
+
+
+def color_normalize_np(arr, mean, std=None):
+    """numpy core of :func:`color_normalize`."""
+    arr = np.asarray(arr, np.float32) - np.asarray(mean, np.float32)
+    if std is not None:
+        arr = arr / np.asarray(std, np.float32)
+    return arr
 
 
 def color_normalize(src, mean, std=None):
     """(reference: image.py color_normalize)"""
-    arr = src.asnumpy().astype(np.float32) if isinstance(src, nd.NDArray) else np.asarray(src, np.float32)
-    mean = np.asarray(mean, np.float32)
-    arr = arr - mean
-    if std is not None:
-        arr = arr / np.asarray(std, np.float32)
-    return nd.array(arr)
+    return nd.array(color_normalize_np(_to_np(src), mean, std))
 
 
 # ---- augmenters (reference: image.py CreateAugmenter :404) ----------------
 class Augmenter:
-    def __call__(self, src):
+    """Base augmenter. Standard augmenters implement ``apply_np`` (numpy
+    HWC in/out) and inherit this NDArray-boundary ``__call__``;
+    ImageRecordIter's decode workers chain ``apply_np`` directly so the
+    per-image hot path never creates device arrays (docs/perf.md
+    §pipeline). Custom augmenters may override ``__call__`` alone — the
+    iterator falls back to the NDArray chain when any augmenter lacks
+    ``apply_np``."""
+
+    _out_dtype = np.uint8
+
+    def apply_np(self, arr):
         raise NotImplementedError
+
+    def __call__(self, src):
+        out = self.apply_np(_to_np(src))
+        if self._out_dtype is None:           # float output (Cast/Normalize)
+            return nd.array(out)
+        return nd.array(np.ascontiguousarray(out), dtype=self._out_dtype)
 
 
 class ResizeAug(Augmenter):
     def __init__(self, size, interp=2):
         self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return resize_short(src, self.size, self.interp)
+    def apply_np(self, arr):
+        return resize_short_np(arr, self.size, self.interp)
 
 
 class ForceResizeAug(Augmenter):
     def __init__(self, size, interp=2):
         self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return imresize(src, self.size[0], self.size[1], self.interp)
+    def apply_np(self, arr):
+        return imresize_np(arr, self.size[0], self.size[1], self.interp)
 
 
 class RandomCropAug(Augmenter):
     def __init__(self, size, interp=2):
         self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return random_crop(src, self.size, self.interp)[0]
+    def apply_np(self, arr):
+        return random_crop_np(arr, self.size, self.interp)[0]
 
 
 class CenterCropAug(Augmenter):
     def __init__(self, size, interp=2):
         self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return center_crop(src, self.size, self.interp)[0]
+    def apply_np(self, arr):
+        return center_crop_np(arr, self.size, self.interp)[0]
 
 
 class RandomSizedCropAug(Augmenter):
     def __init__(self, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0), interp=2):
         self.size, self.min_area, self.ratio, self.interp = size, min_area, ratio, interp
 
-    def __call__(self, src):
-        return random_size_crop(src, self.size, self.min_area, self.ratio, self.interp)[0]
+    def apply_np(self, arr):
+        return random_size_crop_np(arr, self.size, self.min_area, self.ratio,
+                                   self.interp)[0]
 
 
 class HorizontalFlipAug(Augmenter):
     def __init__(self, p=0.5):
         self.p = p
 
-    def __call__(self, src):
+    @staticmethod
+    def _flip(arr):
+        return arr[:, ::-1]
+
+    def apply_np(self, arr):
         if pyrandom.random() < self.p:
-            arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
-            return nd.array(arr[:, ::-1].copy(), dtype=np.uint8)
+            return self._flip(arr)
+        return arr
+
+    def __call__(self, src):
+        # preserve the no-op identity (the flipless branch returns src as-is)
+        if pyrandom.random() < self.p:
+            return nd.array(self._flip(_to_np(src)).copy(), dtype=np.uint8)
         return src
 
 
@@ -227,35 +318,35 @@ class BrightnessJitterAug(Augmenter):
     def __init__(self, brightness):
         self.brightness = brightness
 
-    def __call__(self, src):
+    def apply_np(self, arr):
         alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
-        arr = src.asnumpy().astype(np.float32) * alpha
-        return nd.array(np.clip(arr, 0, 255).astype(np.uint8), dtype=np.uint8)
+        return np.clip(np.asarray(arr, np.float32) * alpha,
+                       0, 255).astype(np.uint8)
 
 
 class ContrastJitterAug(Augmenter):
     def __init__(self, contrast):
         self.contrast = contrast
 
-    def __call__(self, src):
+    def apply_np(self, arr):
         alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
-        arr = src.asnumpy().astype(np.float32)
+        arr = np.asarray(arr, np.float32)
         gray = arr.mean()
-        arr = arr * alpha + gray * (1 - alpha)
-        return nd.array(np.clip(arr, 0, 255).astype(np.uint8), dtype=np.uint8)
+        return np.clip(arr * alpha + gray * (1 - alpha),
+                       0, 255).astype(np.uint8)
 
 
 class SaturationJitterAug(Augmenter):
     def __init__(self, saturation):
         self.saturation = saturation
 
-    def __call__(self, src):
+    def apply_np(self, arr):
         alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
-        arr = src.asnumpy().astype(np.float32)
+        arr = np.asarray(arr, np.float32)
         coef = np.array([0.299, 0.587, 0.114], np.float32)
         gray = (arr * coef).sum(axis=2, keepdims=True)
-        arr = arr * alpha + gray * (1 - alpha)
-        return nd.array(np.clip(arr, 0, 255).astype(np.uint8), dtype=np.uint8)
+        return np.clip(arr * alpha + gray * (1 - alpha),
+                       0, 255).astype(np.uint8)
 
 
 class LightingAug(Augmenter):
@@ -266,31 +357,34 @@ class LightingAug(Augmenter):
         self.eigval = np.asarray(eigval, np.float32)
         self.eigvec = np.asarray(eigvec, np.float32)
 
-    def __call__(self, src):
+    def apply_np(self, arr):
         alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
         rgb = np.dot(self.eigvec * alpha, self.eigval)
-        arr = src.asnumpy().astype(np.float32) + rgb
-        return nd.array(np.clip(arr, 0, 255).astype(np.uint8), dtype=np.uint8)
+        return np.clip(np.asarray(arr, np.float32) + rgb,
+                       0, 255).astype(np.uint8)
 
 
 class ColorNormalizeAug(Augmenter):
+    _out_dtype = None
+
     def __init__(self, mean, std):
         self.mean = None if mean is None else np.asarray(mean, np.float32)
         self.std = None if std is None else np.asarray(std, np.float32)
 
-    def __call__(self, src):
-        arr = src.asnumpy().astype(np.float32)
+    def apply_np(self, arr):
+        arr = np.asarray(arr, np.float32)
         if self.mean is not None:
             arr = arr - self.mean
         if self.std is not None:
             arr = arr / self.std
-        return nd.array(arr)
+        return arr
 
 
 class CastAug(Augmenter):
-    def __call__(self, src):
-        arr = src.asnumpy().astype(np.float32)
-        return nd.array(arr)
+    _out_dtype = None
+
+    def apply_np(self, arr):
+        return np.asarray(arr, np.float32)
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,

@@ -14,19 +14,34 @@ part_index/num_parts contract of dmlc::InputSplit.
 """
 from __future__ import annotations
 
+import atexit
 import logging
 import queue
 import threading
+import weakref
 
 import numpy as np
 
 from . import ndarray as nd
 from .base import MXNetError
-from .image import CreateAugmenter, imdecode
+from .image import CreateAugmenter, imdecode, imdecode_np
 from .io import DataBatch, DataDesc, DataIter
 from . import recordio
 
 __all__ = ["ImageRecordIter", "ImageDetRecordIter"]
+
+# iterators with live pipeline threads; closed at interpreter exit (see
+# ImageRecordIter.close for why daemon-thread teardown is not enough)
+_LIVE_ITERS = weakref.WeakSet()
+
+
+@atexit.register
+def _close_live_iters():
+    for it in list(_LIVE_ITERS):
+        try:
+            it.close()
+        except Exception:  # noqa: BLE001 — interpreter is going down
+            pass
 
 
 class ImageRecordIter(DataIter):
@@ -104,6 +119,7 @@ class ImageRecordIter(DataIter):
             rec.close()
 
     def _start_pipeline(self):
+        _LIVE_ITERS.add(self)
         self._raw_q = queue.Queue(maxsize=self.preprocess_threads * 8)
         self._out_q = queue.Queue(maxsize=self.prefetch_buffer)
         self._stop = threading.Event()
@@ -113,26 +129,69 @@ class ImageRecordIter(DataIter):
                 for seq, s in enumerate(self._record_stream()):
                     if self._stop.is_set():
                         return
-                    self._raw_q.put((seq, s))
+                    if not _put(self._raw_q, (seq, s)):
+                        return
             finally:
                 for _ in range(self.preprocess_threads):
-                    self._raw_q.put(None)
+                    _put(self._raw_q, None)
+
+        # numpy fast path: when every augmenter exposes a real apply_np the
+        # whole per-image pipeline stays on host numpy — no device placements
+        # per image (each nd.array is one; the NDArray chain measured ~4x
+        # slower, docs/perf.md §pipeline). Custom augmenters that only
+        # implement __call__ (including Augmenter subclasses that never
+        # override the base apply_np) fall back to the NDArray chain.
+        from .image import Augmenter as _AugBase
+
+        def _has_np(a):
+            fn = getattr(type(a), "apply_np", None)
+            return fn is not None and fn is not _AugBase.apply_np
+
+        use_np = all(_has_np(a) for a in self.auglist)
+
+        def _get(q):
+            # bounded wait so close()/reset() can never strand a thread
+            # blocked in get() after the sentinels were drained
+            while not self._stop.is_set():
+                try:
+                    return q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            return None
+
+        def _put(q, item):
+            # bounded wait so a full queue can't wedge a producer whose
+            # consumer already stopped; returns False once stop is set
+            # (sentinel lost, but every consumer loop also exits on stop)
+            while not self._stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 while not self._stop.is_set():
-                    item = self._raw_q.get()
+                    item = _get(self._raw_q)
                     if item is None:
                         return
                     seq, s = item
                     try:
                         header, img = recordio.unpack(s)
-                        data = imdecode(img)
-                        for aug in self.auglist:
-                            data = aug(data)
-                        arr = data.asnumpy().transpose(2, 0, 1)  # HWC -> CHW
+                        if use_np:
+                            data = imdecode_np(img)
+                            for aug in self.auglist:
+                                data = aug.apply_np(data)
+                        else:
+                            data = imdecode(img)
+                            for aug in self.auglist:
+                                data = aug(data)
+                            data = data.asnumpy()
+                        arr = np.asarray(data).transpose(2, 0, 1)  # HWC->CHW
                         label = np.asarray(header.label).reshape(-1)
-                        self._decoded_q.put((seq, arr, label))
+                        _put(self._decoded_q, (seq, arr, label))
                     except Exception as e:  # noqa: BLE001 — corrupt record:
                         # skip, but still claim the seq so reassembly can't
                         # stall; count + log so systematic failures (every
@@ -143,11 +202,11 @@ class ImageRecordIter(DataIter):
                             logging.warning(
                                 "ImageRecordIter: skipping record %d (%s: %s); "
                                 "%d skipped so far", seq, type(e).__name__, e, n + 1)
-                        self._decoded_q.put((seq, None, None))
+                        _put(self._decoded_q, (seq, None, None))
             finally:
                 # sentinel posts even if the thread dies, so the batcher's
                 # done_workers count always completes
-                self._decoded_q.put(None)
+                _put(self._decoded_q, None)
 
         def batcher():
             import heapq
@@ -178,7 +237,7 @@ class ImageRecordIter(DataIter):
                 buf_label[i, : len(label[: self.label_width])] = label[: self.label_width]
                 i += 1
                 if i == self.batch_size:
-                    self._out_q.put((buf_data.copy(), buf_label.copy(), 0))
+                    _put(self._out_q, (buf_data.copy(), buf_label.copy(), 0))
                     i = 0
                 return i
 
@@ -187,7 +246,7 @@ class ImageRecordIter(DataIter):
             # shard in host RAM (one slow/huge record must not OOM the host)
             pending_cap = max(64, self.batch_size * 4, self.preprocess_threads * 16)
             while done_workers < self.preprocess_threads:
-                item = self._decoded_q.get()
+                item = _get(self._decoded_q)
                 if item is None:
                     done_workers += 1
                     continue
@@ -225,7 +284,7 @@ class ImageRecordIter(DataIter):
                 for j in range(i, self.batch_size):
                     buf_data[j] = buf_data[j - i]
                     buf_label[j] = buf_label[j - i]
-                self._out_q.put((buf_data.copy(), buf_label.copy(), pad))
+                _put(self._out_q, (buf_data.copy(), buf_label.copy(), pad))
             self._out_q.put(None)
 
         self._decoded_q = queue.Queue(maxsize=self.preprocess_threads * 8)
@@ -237,7 +296,17 @@ class ImageRecordIter(DataIter):
         for t in self._threads:
             t.start()
 
-    def reset(self):
+    def close(self):
+        """Stop the pipeline threads and release the reader.
+
+        Called automatically at interpreter exit (atexit below): a daemon
+        thread killed mid-``pthread_cond_wait`` inside the native reader
+        aborts the process ('FATAL: exception not rethrown' — pthread_exit's
+        forced unwind crossing noexcept C++ frames), so live iterators must
+        wind down BEFORE CPython tears daemon threads down.
+        """
+        if not hasattr(self, "_stop"):
+            return
         self._stop.set()
         # drain queues so threads can exit
         for q in (self._raw_q, self._decoded_q, self._out_q):
@@ -248,6 +317,15 @@ class ImageRecordIter(DataIter):
                 pass
         for t in self._threads:
             t.join(timeout=5)
+        # end-of-stream marker so next() after close() raises StopIteration
+        # instead of blocking on an empty queue forever
+        try:
+            self._out_q.put_nowait(None)
+        except queue.Full:
+            pass
+
+    def reset(self):
+        self.close()
         self._epoch += 1
         self._start_pipeline()
 

@@ -54,3 +54,48 @@ def test_jpeg_to_rec_to_fit(tmp_path):
     # produces finite params
     arg, _ = mod.get_params()
     assert all(np.isfinite(v.asnumpy()).all() for v in arg.values())
+
+
+def test_close_then_next_raises_and_custom_aug_fallback(tmp_path):
+    """Round-4 pipeline hardening: (a) close() is terminal — next() raises
+    StopIteration instead of blocking; (b) a custom augmenter that only
+    implements __call__ (no apply_np override) routes the workers onto the
+    NDArray chain and still produces correct batches."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.image import Augmenter
+    sys.path.insert(0, ROOT)
+    from tools.bench_pipeline import gen_dataset, pack
+
+    n, size = 16, 24
+    img_dir, lst = gen_dataset(str(tmp_path), n, size)
+    rec = pack(str(tmp_path), img_dir, lst)
+
+    # (a) close -> StopIteration
+    it = mx.io_image.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, size, size), batch_size=4,
+        preprocess_threads=2)
+    next(iter(it))
+    it.close()
+    with pytest.raises(StopIteration):
+        it.next()
+
+    # (b) __call__-only augmenter disables the numpy fast path but works
+    class Invert(Augmenter):          # overrides __call__ only
+        def __call__(self, src):
+            import mxnet_tpu as mx
+            return mx.nd.array(255.0 - src.asnumpy())
+
+    it2 = mx.io_image.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, size, size), batch_size=4,
+        preprocess_threads=1)
+    plain = next(iter(it2)).data[0].asnumpy()
+    it2.close()
+
+    it3 = mx.io_image.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, size, size), batch_size=4,
+        preprocess_threads=1)
+    it3.auglist.append(Invert())
+    it3.reset()                        # restart workers with the new auglist
+    inverted = next(iter(it3)).data[0].asnumpy()
+    it3.close()
+    np.testing.assert_allclose(inverted, 255.0 - plain, atol=1e-4)

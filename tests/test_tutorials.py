@@ -21,7 +21,9 @@ FENCE = re.compile(r"^```(\S*)[ \t]*(\S*)[ \t]*$")
 
 def _python_blocks(path):
     blocks, cur, lang, norun = [], None, None, False
-    for line in open(path):
+    with open(path) as f:
+        lines = f.readlines()
+    for line in lines:
         m = FENCE.match(line.rstrip("\n"))
         if m and cur is None:
             lang, norun = m.group(1), m.group(2) == "norun"
