@@ -253,6 +253,31 @@ class Augmenter:
         return nd.array(np.ascontiguousarray(out), dtype=self._out_dtype)
 
 
+def supports_np(aug):
+    """True when ``aug``'s numpy fast path (``apply_np``) is safe to use
+    in place of ``__call__``.
+
+    Walks the MRO from the most-derived class: a class that customizes
+    ``__call__`` without (re)defining ``apply_np`` in the same class makes
+    the fast path unsafe — the custom ``__call__`` must run (this is the
+    fallback the Augmenter docstring promises, and it covers subclasses of
+    concrete augmenters too). A class defining ``apply_np`` at or above the
+    first ``__call__`` override opts in (e.g. HorizontalFlipAug defines
+    both together).  Both iterators (ImageRecordIter workers and
+    ImageIter.next) use this single predicate.
+    """
+    for klass in type(aug).__mro__:
+        if klass is Augmenter:
+            return False              # reached base: no real apply_np
+        owns_call = "__call__" in vars(klass)
+        owns_np = "apply_np" in vars(klass)
+        if owns_np:
+            return True
+        if owns_call:
+            return False              # custom __call__ shadows the fast path
+    return False
+
+
 class ResizeAug(Augmenter):
     def __init__(self, size, interp=2):
         self.size, self.interp = size, interp
@@ -537,14 +562,23 @@ class ImageIter(DataIter):
         c, h, w = self.data_shape
         batch_data = np.zeros((batch_size, h, w, c), np.float32)
         batch_label = np.zeros((batch_size, self.label_width), np.float32)
+        # same numpy fast path as ImageRecordIter's workers (one shared
+        # eligibility rule: supports_np)
+        use_np = all(supports_np(a) for a in self.auglist)
         i = 0
         try:
             while i < batch_size:
                 label, s = self.next_sample()
-                data = imdecode(s)
-                for aug in self.auglist:
-                    data = aug(data)
-                arr = data.asnumpy()
+                if use_np:
+                    arr = imdecode_np(s)
+                    for aug in self.auglist:
+                        arr = aug.apply_np(arr)
+                    arr = np.asarray(arr)
+                else:
+                    data = imdecode(s)
+                    for aug in self.auglist:
+                        data = aug(data)
+                    arr = data.asnumpy()
                 batch_data[i] = arr
                 lab = np.asarray(label).reshape(-1)
                 batch_label[i] = lab[: self.label_width]

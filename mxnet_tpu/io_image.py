@@ -138,16 +138,12 @@ class ImageRecordIter(DataIter):
         # numpy fast path: when every augmenter exposes a real apply_np the
         # whole per-image pipeline stays on host numpy — no device placements
         # per image (each nd.array is one; the NDArray chain measured ~4x
-        # slower, docs/perf.md §pipeline). Custom augmenters that only
-        # implement __call__ (including Augmenter subclasses that never
-        # override the base apply_np) fall back to the NDArray chain.
-        from .image import Augmenter as _AugBase
+        # slower, docs/perf.md §pipeline). Augmenters that customize
+        # __call__ without a matching apply_np fall back to the NDArray
+        # chain (shared eligibility rule: image.supports_np).
+        from .image import supports_np
 
-        def _has_np(a):
-            fn = getattr(type(a), "apply_np", None)
-            return fn is not None and fn is not _AugBase.apply_np
-
-        use_np = all(_has_np(a) for a in self.auglist)
+        use_np = all(supports_np(a) for a in self.auglist)
 
         def _get(q):
             # bounded wait so close()/reset() can never strand a thread
@@ -285,7 +281,9 @@ class ImageRecordIter(DataIter):
                     buf_data[j] = buf_data[j - i]
                     buf_label[j] = buf_label[j - i]
                 _put(self._out_q, (buf_data.copy(), buf_label.copy(), pad))
-            self._out_q.put(None)
+            # stop-aware: a full queue at close() must not wedge the batcher
+            # past close()'s join and leak the thread
+            _put(self._out_q, None)
 
         self._decoded_q = queue.Queue(maxsize=self.preprocess_threads * 8)
         self._threads = [threading.Thread(target=reader, daemon=True)]
@@ -308,20 +306,35 @@ class ImageRecordIter(DataIter):
         if not hasattr(self, "_stop"):
             return
         self._stop.set()
-        # drain queues so threads can exit
-        for q in (self._raw_q, self._decoded_q, self._out_q):
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-        for t in self._threads:
-            t.join(timeout=5)
-        # end-of-stream marker so next() after close() raises StopIteration
-        # instead of blocking on an empty queue forever
+        # drain + join until every thread is dead: a producer blocked inside
+        # a bounded put can deposit one more item after a single drain pass,
+        # so keep draining until the threads have actually exited (they all
+        # re-check _stop within 0.1s once unblocked)
+        import time as _time
+
+        deadline = _time.time() + 10
+        alive = list(self._threads)
+        while alive and _time.time() < deadline:
+            for q in (self._raw_q, self._decoded_q, self._out_q):
+                try:
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
+            for t in alive:
+                t.join(timeout=0.2)
+            alive = [t for t in alive if t.is_alive()]
+        # final drain, then the end-of-stream marker so next() after close()
+        # raises StopIteration instead of blocking (and never sees a stale
+        # batch ahead of the marker)
+        try:
+            while True:
+                self._out_q.get_nowait()
+        except queue.Empty:
+            pass
         try:
             self._out_q.put_nowait(None)
-        except queue.Full:
+        except queue.Full:  # unreachable: queue just drained, threads dead
             pass
 
     def reset(self):

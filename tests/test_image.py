@@ -127,3 +127,41 @@ def test_im2rec_roundtrip(tmp_path):
     )
     b = next(iter(it))
     assert b.data[0].shape == (3, 3, 24, 24)
+
+
+def test_supports_np_eligibility():
+    """supports_np: the one predicate both iterators use for the numpy
+    fast path. Subclassing a concrete augmenter and overriding only
+    __call__ must disable the fast path (the custom __call__ wins)."""
+    from mxnet_tpu.image import (Augmenter, CenterCropAug, HorizontalFlipAug,
+                                 supports_np)
+
+    assert supports_np(CenterCropAug((4, 4)))
+    assert supports_np(HorizontalFlipAug(0.5))       # defines both together
+
+    class CallOnly(Augmenter):
+        def __call__(self, src):
+            return src
+
+    assert not supports_np(CallOnly())
+
+    class CallOverConcrete(CenterCropAug):           # inherits apply_np
+        def __call__(self, src):
+            return src
+
+    assert not supports_np(CallOverConcrete((4, 4)))
+
+    class NpOverConcrete(CenterCropAug):             # re-opts in
+        def __call__(self, src):
+            return src
+        def apply_np(self, arr):
+            return arr
+
+    assert supports_np(NpOverConcrete((4, 4)))
+    assert not supports_np(Augmenter())
+
+    class DuckCallOnly:
+        def __call__(self, src):
+            return src
+
+    assert not supports_np(DuckCallOnly())

@@ -99,3 +99,28 @@ def test_close_then_next_raises_and_custom_aug_fallback(tmp_path):
     inverted = next(iter(it3)).data[0].asnumpy()
     it3.close()
     np.testing.assert_allclose(inverted, 255.0 - plain, atol=1e-4)
+
+
+def test_close_with_full_prefetch_queue(tmp_path):
+    """close() while the batcher is blocked on a full prefetch queue: the
+    close-is-terminal contract must hold (no stale batch before the marker)
+    and all pipeline threads must actually exit."""
+    import time
+    import mxnet_tpu as mx
+    sys.path.insert(0, ROOT)
+    from tools.bench_pipeline import gen_dataset, pack
+
+    n, size = 32, 16
+    img_dir, lst = gen_dataset(str(tmp_path), n, size)
+    rec = pack(str(tmp_path), img_dir, lst)
+
+    it = mx.io_image.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, size, size), batch_size=4,
+        preprocess_threads=2, prefetch_buffer=1)
+    time.sleep(0.5)               # let the pipeline fill the 1-slot queue
+    t0 = time.time()
+    it.close()
+    assert time.time() - t0 < 8, "close() stalled on a blocked producer"
+    with pytest.raises(StopIteration):
+        it.next()
+    assert not any(t.is_alive() for t in it._threads), "leaked threads"
