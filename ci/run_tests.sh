@@ -89,6 +89,12 @@ run_entry() {
   # docs/operators.md is generated — fail if it drifted from the registry
   python tools/gen_op_docs.py
   git diff --exit-code docs/operators.md
+  # docs/api_python.md is generated — fail if it drifted from the code
+  # (ls-files guards against the file being untracked, where git diff
+  # would silently pass)
+  git ls-files --error-unmatch docs/api_python.md >/dev/null
+  python tools/gen_api_docs.py
+  git diff --exit-code docs/api_python.md
   # docs/c_api_coverage.md likewise (needs the built C libs + the reference
   # checkout; the tool skips cleanly when either is absent)
   make -C mxnet_tpu/src c_predict c_predict_native
