@@ -27,7 +27,16 @@ run_unit() {
   for a in "$@"; do
     case "$a" in --ignore=*) ignores+=("${a#--ignore=}") ;; esac
   done
-  mapfile -t files < <(ls -S tests/test_*.py)
+  # deal known-slow-but-small files first (file size is the duration proxy
+  # for everything else; these are slow compiles in tiny files, one per
+  # file so they land on different shards)
+  local slow_first="tests/test_models_deep.py tests/test_models_deep2.py"
+  for f in $slow_first; do
+    [ -f "$f" ] || { echo "slow_first file missing: $f" >&2; return 1; }
+  done
+  mapfile -t files < <(
+    printf '%s\n' $slow_first
+    ls -S tests/test_*.py | grep -vxF "$(printf '%s\n' $slow_first)")
   local groups=()
   for i in $(seq 0 $((shards - 1))); do groups[i]=""; done
   local gi=0 skip f

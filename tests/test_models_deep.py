@@ -1,0 +1,15 @@
+"""Deep-model one-step tests split from test_models.py: the slowest
+compiles in the unit suite (~5 min on the 1-core CI box) get one file
+each so the shard dealer places them on separate shards
+(ci/run_tests.sh slow_first list)."""
+import numpy as np
+
+from mxnet_tpu import models
+
+from test_models import _one_step
+
+
+def test_resnet18_cifar():
+    net = models.resnet(num_classes=10, num_layers=20, image_shape="3,28,28")
+    out = _one_step(net, (2, 3, 28, 28), (2,))
+    assert out.shape == (2, 10)

@@ -1,0 +1,21 @@
+"""Deep-model one-step test split from test_models.py — see
+test_models_deep.py for why these live one-per-file (shard balance)."""
+import numpy as np
+
+from mxnet_tpu import models
+
+from test_models import _one_step
+
+def test_inception_resnet_v2_shapes():
+    net = models.inception_resnet_v2(num_classes=1000)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes[0] == (1, 1000)
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    n_params = sum(int(np.prod(s)) for n, s in d.items()
+                   if n not in ("data", "softmax_label"))
+    assert 50e6 < n_params < 60e6  # ~55M params in Inception-ResNet-v2
+
+    # a skinny config (one residual block per stage) trains one step
+    small = models.inception_resnet_v2(num_classes=10, blocks=(1, 1, 1))
+    out = _one_step(small, (1, 3, 299, 299), (1,))
+    assert out.shape == (1, 10)
