@@ -51,9 +51,19 @@ class MultiBoxMetric(mx.metric.EvalMetric):
 def get_iter(args, kv):
     rec = os.path.join(args.data_dir, "train.rec")
     if os.path.exists(rec):
+        # SSD training augmentation (reference: example/ssd train settings
+        # over image_det_aug_default.cc): constrained crop samplers at the
+        # paper's IoU floors, 0.5 mirror, up-to-4x zoom-out pad
         return mx.io_image.ImageDetRecordIter(
             path_imgrec=rec, data_shape=(3, 300, 300), batch_size=args.batch_size,
             mean_r=123.68, mean_g=116.779, mean_b=103.939,
+            rand_mirror_prob=0.5,
+            rand_pad_prob=0.5, max_pad_scale=4.0, fill_value=123,
+            rand_crop_prob=0.833, num_crop_sampler=5,
+            min_crop_scales=0.3, max_crop_scales=1.0,
+            min_crop_aspect_ratios=0.5, max_crop_aspect_ratios=2.0,
+            min_crop_overlaps=(0.1, 0.3, 0.5, 0.7, 0.9),
+            max_crop_overlaps=1.0, max_crop_trials=50,
             part_index=kv.rank, num_parts=max(kv.num_workers, 1))
     rng = np.random.RandomState(0)
     n = args.num_examples

@@ -44,6 +44,17 @@ def _close_live_iters():
             pass
 
 
+def _mean_std(mean_r, mean_g, mean_b, std_r, std_g, std_b):
+    """The reference's mean_*/std_* kwargs -> (mean, std) arrays or None."""
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b], np.float32)
+    std = None
+    if std_r or std_g or std_b:
+        std = np.array([std_r or 1, std_g or 1, std_b or 1], np.float32)
+    return mean, std
+
+
 class ImageRecordIter(DataIter):
     _label_pad = 0.0
 
@@ -63,14 +74,9 @@ class ImageRecordIter(DataIter):
         self.data_shape = tuple(int(x) for x in data_shape)
         self.label_width = label_width
         self.batch_size = batch_size
-        mean = None
-        if mean_r or mean_g or mean_b:
-            mean = np.array([mean_r, mean_g, mean_b], np.float32)
-        std = None
-        if std_r or std_g or std_b:
-            std = np.array([std_r or 1, std_g or 1, std_b or 1], np.float32)
-        self.auglist = CreateAugmenter(
-            self.data_shape, resize=resize, rand_crop=rand_crop,
+        mean, std = _mean_std(mean_r, mean_g, mean_b, std_r, std_g, std_b)
+        self.auglist = self._build_auglist(
+            resize=resize, rand_crop=rand_crop,
             rand_resize=rand_resize, rand_mirror=rand_mirror, mean=mean, std=std,
             brightness=brightness or max_random_illumination / 255.0,
             contrast=contrast or max_random_contrast,
@@ -92,6 +98,28 @@ class ImageRecordIter(DataIter):
         self._epoch = 0
         self._skipped = 0  # corrupt/undecodable records dropped (logged)
         self._start_pipeline()
+
+    def _build_auglist(self, **kwargs):
+        """Classification augmenter list (ImageDetRecordIter overrides to
+        skip this — its pipeline is the box-aware det_auglist)."""
+        return CreateAugmenter(self.data_shape, **kwargs)
+
+    def _process_record(self, s, use_np, rng=None):
+        """One record -> (CHW float array, flat label row). Runs on a decode
+        worker thread (``rng``: that worker's seeded random.Random);
+        ImageDetRecordIter overrides with the box-aware pipeline."""
+        header, img = recordio.unpack(s)
+        if use_np:
+            data = imdecode_np(img)
+            for aug in self.auglist:
+                data = aug.apply_np(data)
+        else:
+            data = imdecode(img)
+            for aug in self.auglist:
+                data = aug(data)
+            data = data.asnumpy()
+        arr = np.asarray(data).transpose(2, 0, 1)  # HWC -> CHW
+        return arr, np.asarray(header.label).reshape(-1)
 
     # ---- pipeline --------------------------------------------------------
     def _record_stream(self):
@@ -167,7 +195,16 @@ class ImageRecordIter(DataIter):
                     continue
             return False
 
-        def worker():
+        def worker(wid):
+            # per-worker deterministic augmentation stream: single-threaded
+            # decode reproduces exactly for a given seed; with more threads
+            # the streams stay deterministic but record->thread assignment
+            # is scheduling-dependent (reference OMP pool has the same
+            # property)
+            import random as _random
+
+            # int-tuple hash is run-stable (PYTHONHASHSEED only perturbs str)
+            rng = _random.Random(hash((self.seed, self._epoch, wid)))
             try:
                 while not self._stop.is_set():
                     item = _get(self._raw_q)
@@ -175,18 +212,7 @@ class ImageRecordIter(DataIter):
                         return
                     seq, s = item
                     try:
-                        header, img = recordio.unpack(s)
-                        if use_np:
-                            data = imdecode_np(img)
-                            for aug in self.auglist:
-                                data = aug.apply_np(data)
-                        else:
-                            data = imdecode(img)
-                            for aug in self.auglist:
-                                data = aug(data)
-                            data = data.asnumpy()
-                        arr = np.asarray(data).transpose(2, 0, 1)  # HWC->CHW
-                        label = np.asarray(header.label).reshape(-1)
+                        arr, label = self._process_record(s, use_np, rng)
                         _put(self._decoded_q, (seq, arr, label))
                     except Exception as e:  # noqa: BLE001 — corrupt record:
                         # skip, but still claim the seq so reassembly can't
@@ -288,7 +314,8 @@ class ImageRecordIter(DataIter):
         self._decoded_q = queue.Queue(maxsize=self.preprocess_threads * 8)
         self._threads = [threading.Thread(target=reader, daemon=True)]
         self._threads += [
-            threading.Thread(target=worker, daemon=True) for _ in range(self.preprocess_threads)
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(self.preprocess_threads)
         ]
         self._threads.append(threading.Thread(target=batcher, daemon=True))
         for t in self._threads:
@@ -355,47 +382,84 @@ class ImageRecordIter(DataIter):
 
 
 class ImageDetRecordIter(ImageRecordIter):
-    """Detection variant: variable-object box labels per record
-    (reference: src/io/iter_image_det_recordio.cc, the SSD pipeline;
-    box-aware augmenters image_det_aug_default.cc).
+    """Detection variant: variable-object box labels per record, augmented
+    box-aware in the decode workers (reference:
+    src/io/iter_image_det_recordio.cc + image_det_aug_default.cc — the SSD
+    pipeline: color jitter → mirror → random pad → constrained random crop
+    → force resize, with boxes transformed alongside the pixels; augmenter
+    params keep the reference's names/defaults, see
+    ``image_det.CreateDetAugmenter``).
 
-    Record label layout (reference det recordio contract): a flat float list,
-    optionally prefixed with [header_width, object_width]; objects are rows of
-    ``object_width`` floats ``[class, x0, y0, x1, y1, ...]`` with corner
-    coordinates normalized to [0, 1]. Batches emit ``(batch, max_objects,
-    object_width)`` padded with -1 rows — the shape MultiBoxTarget consumes.
-    Horizontal flips mirror the boxes; crop-style augmenters are disabled
-    because they would invalidate the boxes (the reference uses the dedicated
-    det augmenter for that).
+    Record label layout (reference det recordio contract): a flat float
+    list, optionally prefixed with [header_width, object_width]; objects
+    are rows of ``object_width`` floats ``[class, x0, y0, x1, y1, ...]``
+    with corner coordinates normalized to [0, 1]. Batches emit
+    ``(batch, max_objects, object_width)`` padded with -1 rows — the shape
+    MultiBoxTarget consumes.
     """
 
     _label_pad = -1.0
 
-    # widest [header_width, object_width] prefix we strip (reference det
-    # recordio headers are 2 floats; pad generously so truncation in the
-    # batcher can never eat a trailing object)
-    _MAX_HEADER = 16
-
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=-1,
-                 max_objects=32, object_width=5, rand_mirror=False, **kwargs):
+                 max_objects=32, object_width=5,
+                 rand_mirror=False, rand_mirror_prob=None,
+                 resize=0, rand_crop_prob=0.0,
+                 min_crop_scales=(0.0,), max_crop_scales=(1.0,),
+                 min_crop_aspect_ratios=(1.0,), max_crop_aspect_ratios=(1.0,),
+                 min_crop_overlaps=(0.0,), max_crop_overlaps=(1.0,),
+                 min_crop_sample_coverages=(0.0,),
+                 max_crop_sample_coverages=(1.0,),
+                 min_crop_object_coverages=(0.0,),
+                 max_crop_object_coverages=(1.0,),
+                 num_crop_sampler=1, crop_emit_mode="center",
+                 emit_overlap_thresh=0.3, max_crop_trials=(25,),
+                 rand_pad_prob=0.0, max_pad_scale=1.0, fill_value=127,
+                 inter_method=1,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=0.0, std_g=0.0, std_b=0.0,
+                 brightness=0.0, contrast=0.0, saturation=0.0, **kwargs):
+        from .image_det import CreateDetAugmenter
+
         self.object_width = int(object_width)
         # honor the reference's label_pad_width-style knob: a positive
         # label_width fixes the padded label length and implies max_objects
         self.max_objects = (int(label_width) // self.object_width
                             if int(label_width) > 0 else int(max_objects))
-        self._det_rand_mirror = bool(rand_mirror)
+        mean, std = _mean_std(mean_r, mean_g, mean_b, std_r, std_g, std_b)
+        if rand_mirror_prob is None:
+            rand_mirror_prob = 0.5 if rand_mirror else 0.0
+        self.det_auglist = CreateDetAugmenter(
+            data_shape, resize=resize, rand_crop_prob=rand_crop_prob,
+            min_crop_scales=min_crop_scales, max_crop_scales=max_crop_scales,
+            min_crop_aspect_ratios=min_crop_aspect_ratios,
+            max_crop_aspect_ratios=max_crop_aspect_ratios,
+            min_crop_overlaps=min_crop_overlaps,
+            max_crop_overlaps=max_crop_overlaps,
+            min_crop_sample_coverages=min_crop_sample_coverages,
+            max_crop_sample_coverages=max_crop_sample_coverages,
+            min_crop_object_coverages=min_crop_object_coverages,
+            max_crop_object_coverages=max_crop_object_coverages,
+            num_crop_sampler=num_crop_sampler,
+            crop_emit_mode=crop_emit_mode,
+            emit_overlap_thresh=emit_overlap_thresh,
+            max_crop_trials=max_crop_trials,
+            rand_pad_prob=rand_pad_prob, max_pad_scale=max_pad_scale,
+            rand_mirror_prob=rand_mirror_prob, fill_value=fill_value,
+            inter_method=inter_method, brightness=brightness,
+            contrast=contrast, saturation=saturation, mean=mean, std=std)
         kwargs.pop("rand_crop", None)
         kwargs.pop("rand_resize", None)
         super().__init__(
             path_imgrec, data_shape, batch_size,
-            label_width=self.max_objects * self.object_width + self._MAX_HEADER,
+            label_width=self.max_objects * self.object_width,
             rand_mirror=False, **kwargs)
         label_name = self.provide_label[0].name
         self.provide_label = [DataDesc(
             label_name, (batch_size, self.max_objects, self.object_width))]
-        self._rng = np.random.RandomState(kwargs.get("seed", 0) or 0)
 
-    def _parse_det_label(self, flat):
+    def _parse_det_boxes(self, flat):
+        """Flat record label -> (n, object_width) float32 rows, header
+        stripped; missing trailing per-object fields stay -1."""
         flat = np.asarray(flat, np.float32).reshape(-1)
         ow = self.object_width
         if flat.size >= 2 and float(flat[0]).is_integer() and 2 <= flat[0] <= 16:
@@ -404,27 +468,36 @@ class ImageDetRecordIter(ImageRecordIter):
                 ow = int(flat[1])
                 flat = flat[hdr:]
         n = flat.size // ow
-        out = -np.ones((self.max_objects, self.object_width), np.float32)
-        boxes = flat[: n * ow].reshape(n, ow)[: self.max_objects, : self.object_width]
-        # a record may carry narrower objects than configured; missing trailing
-        # fields stay -1
-        out[: boxes.shape[0], : boxes.shape[1]] = boxes
+        rows = flat[: n * ow].reshape(n, ow)[:, : self.object_width]
+        out = -np.ones((n, self.object_width), np.float32)
+        out[:, : rows.shape[1]] = rows
         return out
+
+    def _build_auglist(self, **kwargs):
+        return []  # detection uses det_auglist; see _process_record
+
+    def _process_record(self, s, use_np, rng=None):
+        import random as _random
+
+        header, img = recordio.unpack(s)
+        boxes = self._parse_det_boxes(np.asarray(header.label))
+        arr = imdecode_np(img)
+        rng = rng or _random
+        for aug in self.det_auglist:
+            arr, boxes = aug.apply_np(arr, boxes, rng)
+        arr = np.ascontiguousarray(np.asarray(arr).transpose(2, 0, 1))
+        padded = -np.ones((self.max_objects, self.object_width), np.float32)
+        n = min(boxes.shape[0], self.max_objects)
+        padded[:n] = boxes[:n]
+        return arr, padded.reshape(-1)
 
     def next(self):
         item = self._out_q.get()
         if item is None:
             raise StopIteration
         data, label, pad = item
-        boxes = np.stack([self._parse_det_label(row) for row in label])
-        if self._det_rand_mirror:
-            for i in range(data.shape[0]):
-                if self._rng.rand() < 0.5:
-                    data[i] = data[i, :, :, ::-1]
-                    valid = boxes[i, :, 0] >= 0
-                    x0 = boxes[i, valid, 1].copy()
-                    boxes[i, valid, 1] = 1.0 - boxes[i, valid, 3]
-                    boxes[i, valid, 3] = 1.0 - x0
+        boxes = label.reshape(label.shape[0], self.max_objects,
+                              self.object_width)
         return DataBatch(
             [nd.array(data)], [nd.array(boxes)], pad=pad,
             provide_data=self.provide_data, provide_label=self.provide_label,

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import parse_shape
+from ..base import MXNetError, parse_shape
 from .registry import Param, get_op, register, register_simple
 
 
@@ -51,6 +51,11 @@ def _multibox_prior(octx, attrs, args, auxs):
     w=h=size/2 for the size set; w=s0*sqrt(r)/2, h=s0/(2*sqrt(r)) for ratios)."""
     x = args[0]
     H, W = x.shape[2], x.shape[3]
+    if H < 1 or W < 1:
+        raise MXNetError(
+            "MultiBoxPrior: input feature map has zero spatial size %dx%d — "
+            "the input image is too small for this network's downsampling "
+            "(SSD-300 needs ~300px inputs)" % (H, W))
     sizes = jnp.asarray(attrs["sizes"], jnp.float32)
     ratios = jnp.asarray(attrs["ratios"], jnp.float32)
     step_y, step_x = attrs["steps"]

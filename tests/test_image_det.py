@@ -1,0 +1,229 @@
+"""Detection augmenter tests (reference behavior:
+src/io/image_det_aug_default.cc — TryCrop/TryPad/TryMirror projection
+geometry, crop sampler constraints, emit modes; exercised end-to-end
+through ImageDetRecordIter like iter_image_det_recordio.cc)."""
+import io as _io
+import random as pyrandom
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image_det import (CreateDetAugmenter, DetForceResizeAug,
+                                 DetHorizontalFlipAug, DetRandomCropAug,
+                                 DetRandomPadAug, _project)
+
+pytest.importorskip("PIL")
+
+
+def _boxes(*rows):
+    return np.asarray(rows, np.float32)
+
+
+def _img(h=40, w=60, c=3):
+    rng = np.random.RandomState(0)
+    return (rng.rand(h, w, c) * 255).astype(np.uint8)
+
+
+def test_project_geometry():
+    b = _boxes([1, 0.2, 0.2, 0.6, 0.6])
+    # crop the left half: x scales by 2, y unchanged
+    out = _project(b, (0.0, 0.0, 0.5, 1.0))
+    np.testing.assert_allclose(out[0], [1, 0.4, 0.2, 1.0, 0.6], atol=1e-6)
+    # pad to a 2x canvas anchored at (-0.5, -0.5): coords shift+halve
+    out = _project(b, (-0.5, -0.5, 2.0, 2.0))
+    np.testing.assert_allclose(out[0], [1, 0.35, 0.35, 0.55, 0.55],
+                               atol=1e-6)
+
+
+def test_mirror_flips_boxes_and_pixels():
+    pyrandom.seed(0)
+    aug = DetHorizontalFlipAug(p=1.0)
+    arr, boxes = aug.apply_np(_img(), _boxes([2, 0.1, 0.2, 0.4, 0.9]))
+    np.testing.assert_allclose(boxes[0], [2, 0.6, 0.2, 0.9, 0.9], atol=1e-6)
+    np.testing.assert_array_equal(arr, _img()[:, ::-1])
+
+
+def test_pad_expands_canvas_and_projects_boxes():
+    pyrandom.seed(3)
+    aug = DetRandomPadAug(p=1.0, max_pad_scale=3.0, fill_value=99)
+    src = _img(20, 20)
+    arr, boxes = aug.apply_np(src, _boxes([1, 0.0, 0.0, 1.0, 1.0]))
+    assert arr.shape[0] > 20 and arr.shape[1] > 20
+    # the original pixels sit somewhere inside; everything else is fill
+    assert (arr == 99).any()
+    b = boxes[0]
+    assert 0.0 <= b[1] < b[3] <= 1.0 and 0.0 <= b[2] < b[4] <= 1.0
+    # box area shrank by the pad scale squared
+    scale = arr.shape[0] / 20.0
+    area = (b[3] - b[1]) * (b[4] - b[2])
+    np.testing.assert_allclose(area, 1.0 / scale ** 2, rtol=0.2)
+
+
+def test_crop_center_emit_drops_outside_objects():
+    pyrandom.seed(1)
+    # sampler restricted to ~half-size crops; object B sits in a corner
+    aug = DetRandomCropAug(
+        p=1.0, min_scales=[0.4], max_scales=[0.5],
+        min_aspect_ratios=[0.9], max_aspect_ratios=[1.1],
+        min_overlaps=[0.0], max_overlaps=[1.0],
+        min_sample_coverages=[0.0], max_sample_coverages=[1.0],
+        min_object_coverages=[0.0], max_object_coverages=[1.0],
+        max_trials=[50], emit_mode="center")
+    src = _img(64, 64)
+    for _ in range(10):
+        arr, boxes = aug.apply_np(
+            src, _boxes([1, 0.3, 0.3, 0.7, 0.7], [2, 0.0, 0.0, 0.05, 0.05]))
+        assert boxes.shape[0] >= 1
+        # every surviving box is valid and inside [0,1]
+        assert (boxes[:, 3] > boxes[:, 1]).all()
+        assert (boxes[:, 4] > boxes[:, 2]).all()
+        assert (boxes[:, 1:] >= 0).all() and (boxes[:, 1:] <= 1).all()
+        # the crop really happened
+        assert arr.shape[0] < 64 and arr.shape[1] < 64
+
+
+def test_crop_object_coverage_constraint_respected():
+    pyrandom.seed(2)
+    # demand near-total object coverage: the surviving object must keep
+    # ~its full area inside the crop
+    aug = DetRandomCropAug(
+        p=1.0, min_scales=[0.5], max_scales=[0.9],
+        min_aspect_ratios=[0.8], max_aspect_ratios=[1.25],
+        min_overlaps=[0.0], max_overlaps=[1.0],
+        min_sample_coverages=[0.0], max_sample_coverages=[1.0],
+        min_object_coverages=[0.99], max_object_coverages=[1.0],
+        max_trials=[100], emit_mode="center")
+    src = _img(64, 64)
+    b0 = _boxes([1, 0.45, 0.45, 0.55, 0.55])
+    hits = 0
+    for _ in range(10):
+        arr, boxes = aug.apply_np(src, b0)
+        if arr.shape[:2] == (64, 64):
+            continue  # all trials failed: original kept (allowed)
+        hits += 1
+        # full coverage => projected box keeps its aspect/area exactly
+        # (no clipping): w_new * crop_w == 0.1 etc.
+        ch, cw = arr.shape[:2]
+        w_abs = (boxes[0, 3] - boxes[0, 1]) * cw / 64.0
+        h_abs = (boxes[0, 4] - boxes[0, 2]) * ch / 64.0
+        np.testing.assert_allclose([w_abs, h_abs], [0.1, 0.1], atol=0.04)
+    assert hits > 0, "constrained sampler never produced a crop"
+
+
+def test_crop_keeps_original_when_unsatisfiable():
+    pyrandom.seed(4)
+    # min IoU 0.95 against a tiny object with tiny crops — unsatisfiable
+    aug = DetRandomCropAug(
+        p=1.0, min_scales=[0.1], max_scales=[0.2],
+        min_aspect_ratios=[1.0], max_aspect_ratios=[1.0],
+        min_overlaps=[0.95], max_overlaps=[1.0],
+        min_sample_coverages=[0.0], max_sample_coverages=[1.0],
+        min_object_coverages=[0.0], max_object_coverages=[1.0],
+        max_trials=[10], emit_mode="center")
+    src = _img(32, 32)
+    arr, boxes = aug.apply_np(src, _boxes([1, 0.0, 0.0, 0.1, 0.1]))
+    assert arr.shape[:2] == (32, 32)
+    np.testing.assert_allclose(boxes, _boxes([1, 0.0, 0.0, 0.1, 0.1]))
+
+
+def test_create_det_augmenter_order_and_output():
+    pyrandom.seed(0)
+    augs = CreateDetAugmenter(
+        (3, 24, 24), resize=32, rand_crop_prob=1.0,
+        min_crop_scales=0.5, max_crop_scales=0.9,
+        min_crop_aspect_ratios=0.8, max_crop_aspect_ratios=1.25,
+        rand_pad_prob=1.0, max_pad_scale=1.5, rand_mirror_prob=0.5,
+        brightness=0.1, mean=np.array([1.0, 2.0, 3.0], np.float32))
+    names = [type(a).__name__ for a in augs]
+    assert names.index("DetHorizontalFlipAug") < names.index("DetRandomPadAug")
+    assert names.index("DetRandomPadAug") < names.index("DetRandomCropAug")
+    assert names[-2] == "DetForceResizeAug" or names[-3] == "DetForceResizeAug"
+    arr, boxes = _img(40, 50), _boxes([1, 0.2, 0.2, 0.8, 0.8])
+    for a in augs:
+        arr, boxes = a.apply_np(arr, boxes)
+    assert arr.shape[:2] == (24, 24)          # forced to data_shape
+    assert arr.dtype == np.float32            # cast + normalized
+
+
+def test_single_scalar_params_broadcast_to_samplers():
+    augs = CreateDetAugmenter(
+        (3, 16, 16), rand_crop_prob=1.0, num_crop_sampler=3,
+        min_crop_scales=0.3, max_crop_scales=(0.5, 0.7, 0.9),
+        min_crop_aspect_ratios=0.5, max_crop_aspect_ratios=2.0)
+    crop = [a for a in augs if type(a).__name__ == "DetRandomCropAug"][0]
+    assert len(crop.samplers) == 3
+
+
+def _write_det_rec(path, n, label_fn, size=48):
+    from PIL import Image
+
+    rec = recordio.MXRecordIO(str(path), "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = Image.fromarray((rng.rand(size, size, 3) * 255).astype(np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG")
+        rec.write(recordio.pack(
+            recordio.IRHeader(0, label_fn(i), i, 0), buf.getvalue()))
+    rec.close()
+
+
+def test_det_record_iter_with_ssd_augmentation(tmp_path):
+    """End-to-end: the SSD augmentation config (crop samplers + pad +
+    mirror) through ImageDetRecordIter — batches keep the contract
+    (shape, -1 padding, valid normalized boxes) under aggressive
+    augmentation."""
+    path = tmp_path / "det.rec"
+    _write_det_rec(path, 8, lambda i: [2, 5, 1, 0.2, 0.2, 0.8, 0.8,
+                                       2, 0.1, 0.1, 0.3, 0.3])
+    it = mx.io_image.ImageDetRecordIter(
+        str(path), (3, 32, 32), batch_size=4, max_objects=4,
+        rand_mirror_prob=0.5, rand_pad_prob=0.5, max_pad_scale=1.5,
+        rand_crop_prob=0.9, num_crop_sampler=2,
+        min_crop_scales=(0.3, 0.5), max_crop_scales=(0.9, 1.0),
+        min_crop_aspect_ratios=0.75, max_crop_aspect_ratios=1.33,
+        min_crop_overlaps=(0.1, 0.3),
+        preprocess_threads=2, seed=5)
+    total = 0
+    for b in it:
+        data = b.data[0].asnumpy()
+        lab = b.label[0].asnumpy()
+        assert data.shape == (4, 3, 32, 32)
+        assert lab.shape == (4, 4, 5)
+        for row in lab.reshape(-1, 5):
+            if row[0] < 0:
+                continue  # padding
+            assert row[3] > row[1] and row[4] > row[2]
+            assert (row[1:] >= 0).all() and (row[1:] <= 1).all()
+        # at least one real object per image survives augmentation
+        assert ((lab[:, :, 0] >= 0).sum(axis=1) >= 1).all()
+        total += 4 - b.pad
+    assert total == 8
+    it.close()
+
+
+def test_det_augmentation_reproducible_single_thread(tmp_path):
+    """Same seed + preprocess_threads=1 => identical augmented batches
+    (the per-worker rng stream; reference seeds its per-thread engines)."""
+    path = tmp_path / "det.rec"
+    _write_det_rec(path, 6, lambda i: [2, 5, 1, 0.2, 0.2, 0.8, 0.8])
+
+    def run():
+        it = mx.io_image.ImageDetRecordIter(
+            str(path), (3, 24, 24), batch_size=3, max_objects=2,
+            rand_mirror_prob=0.5, rand_pad_prob=0.5, max_pad_scale=2.0,
+            rand_crop_prob=0.8, min_crop_scales=0.4, max_crop_scales=0.9,
+            min_crop_aspect_ratios=0.8, max_crop_aspect_ratios=1.25,
+            preprocess_threads=1, seed=11)
+        out = [(b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy())
+               for b in it]
+        it.close()
+        return out
+
+    a, b = run(), run()
+    assert len(a) == len(b) == 2
+    for (da, la), (db, lb) in zip(a, b):
+        np.testing.assert_array_equal(da, db)
+        np.testing.assert_array_equal(la, lb)
