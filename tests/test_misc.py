@@ -531,3 +531,96 @@ def test_topk_1d_preds_same_semantics_host_and_device():
     m_host = mx.metric.TopKAccuracy(top_k=2)
     m_host.update([labels], [preds])
     assert m_dev.get()[1] == m_host.get()[1] == 0.75
+
+
+def test_map_metric():
+    """MApMetric (reference: example/ssd/evaluate/eval_metric.py): perfect
+    detections give AP 1, missed objects lower recall, false positives
+    lower precision, -1 rows are padding, difficult gts are excluded."""
+    import numpy as np
+
+    def run(gt_rows, det_rows, **kw):
+        m = mx.metric.MApMetric(**kw)
+        labels = [mx.nd.array(np.asarray([gt_rows], np.float32))]
+        preds = [mx.nd.array(np.asarray([det_rows], np.float32))]
+        m.update(labels, preds)
+        return m
+
+    gt = [[0, 0.1, 0.1, 0.4, 0.4, 0], [1, 0.5, 0.5, 0.9, 0.9, 0],
+          [-1, -1, -1, -1, -1, -1]]
+    perfect = [[0, 0.9, 0.1, 0.1, 0.4, 0.4], [1, 0.8, 0.5, 0.5, 0.9, 0.9],
+               [-1, 0, 0, 0, 0, 0]]
+    np.testing.assert_allclose(run(gt, perfect).get()[1], 1.0)
+    np.testing.assert_allclose(run(gt, perfect, voc07=False).get()[1], 1.0)
+
+    # one class missed entirely: its AP is 0, mAP 0.5
+    one = [[0, 0.9, 0.1, 0.1, 0.4, 0.4], [-1, 0, 0, 0, 0, 0]]
+    np.testing.assert_allclose(run(gt, one).get()[1], 0.5)
+
+    # an extra low-score false positive after the tp: AP(voc07) stays 1
+    # for that class (precision at every recall floor still 1)
+    fp = perfect + [[0, 0.1, 0.6, 0.6, 0.8, 0.8]]
+    np.testing.assert_allclose(run(gt, fp).get()[1], 1.0)
+
+    # wrong location (IoU < 0.5): pure false positive
+    wrong = [[0, 0.9, 0.6, 0.6, 0.9, 0.9], [-1, 0, 0, 0, 0, 0]]
+    np.testing.assert_allclose(run(gt, wrong).get()[1], 0.0)
+
+    # difficult gt (col 5): not counted, matching det ignored
+    gt_diff = [[0, 0.1, 0.1, 0.4, 0.4, 1], [0, 0.5, 0.5, 0.9, 0.9, 0]]
+    det2 = [[0, 0.9, 0.1, 0.1, 0.4, 0.4], [0, 0.8, 0.5, 0.5, 0.9, 0.9]]
+    m = run(gt_diff, det2)
+    np.testing.assert_allclose(m.get()[1], 1.0)  # only the non-difficult gt
+
+    # class_names: per-class APs + mAP
+    m = run(gt, one, class_names=["cat", "dog"])
+    names, vals = m.get()
+    assert names == ["cat", "dog", "mAP"]
+    np.testing.assert_allclose(vals, [1.0, 0.0, 0.5], atol=1e-9)
+
+    # duplicate detection of one gt: second is a false positive
+    dup = [[0, 0.9, 0.1, 0.1, 0.4, 0.4], [0, 0.8, 0.1, 0.1, 0.4, 0.4]]
+    m = run([[0, 0.1, 0.1, 0.4, 0.4, 0]], dup, voc07=False)
+    # recall hits 1 at precision 1, then precision drops: all-points AP = 1.0
+    np.testing.assert_allclose(m.get()[1], 1.0)
+    # but with two gts and one double-counted det, recall caps at 0.5
+    m = run([[0, 0.1, 0.1, 0.4, 0.4, 0], [0, 0.5, 0.5, 0.9, 0.9, 0]],
+            dup, voc07=False)
+    np.testing.assert_allclose(m.get()[1], 0.5)
+
+
+def test_map_metric_voc_protocol_details():
+    """VOC matching details: a duplicate detection of a taken gt is a FP
+    even when another same-class gt overlaps; use_difficult=True counts
+    difficult gts as positives."""
+    import numpy as np
+
+    def run(gt_rows, det_rows, **kw):
+        m = mx.metric.MApMetric(**kw)
+        m.update([mx.nd.array(np.asarray([gt_rows], np.float32))],
+                 [mx.nd.array(np.asarray([det_rows], np.float32))])
+        return m
+
+    # overlapping gts A=[0.1,0.1,0.5,0.5], B=[0.15,0.15,0.55,0.55]; both
+    # dets sit exactly on A (IoU 1.0 with A, ~0.64 with B): det2's best gt
+    # is the TAKEN A -> FP, it must NOT fall through to B
+    gt = [[0, 0.1, 0.1, 0.5, 0.5, 0], [0, 0.15, 0.15, 0.55, 0.55, 0]]
+    dup = [[0, 0.9, 0.1, 0.1, 0.5, 0.5], [0, 0.8, 0.1, 0.1, 0.5, 0.5]]
+    m = run(gt, dup, voc07=False)
+    # recall caps at 0.5 (B never matched): all-points AP = 0.5
+    np.testing.assert_allclose(m.get()[1], 0.5)
+
+    # use_difficult=True: the difficult gt counts in npos and its match
+    # is a true positive
+    gt_diff = [[0, 0.1, 0.1, 0.4, 0.4, 1]]
+    det = [[0, 0.9, 0.1, 0.1, 0.4, 0.4]]
+    np.testing.assert_allclose(
+        run(gt_diff, det, use_difficult=True).get()[1], 1.0)
+    # and with use_difficult=False the class has no positives: NaN
+    assert np.isnan(run(gt_diff, det).get()[1])
+
+    # score_thresh filters low-confidence rows before matching
+    noisy = det + [[0, 0.05, 0.6, 0.6, 0.9, 0.9]]
+    m = run([[0, 0.1, 0.1, 0.4, 0.4, 0]], noisy, score_thresh=0.1,
+            voc07=False)
+    np.testing.assert_allclose(m.get()[1], 1.0)

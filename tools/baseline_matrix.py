@@ -60,41 +60,6 @@ def synth_det_data(n, num_classes, seed=0, size=300):
     return X, Y
 
 
-def voc_ap(dets, gts, iou_thresh=0.5):
-    """Single-class VOC-style AP. dets: [(img, score, box)], gts:
-    {img: [box,...]} with box = (x0,y0,x1,y1)."""
-    npos = sum(len(v) for v in gts.values())
-    if npos == 0:
-        return float("nan")
-    dets = sorted(dets, key=lambda d: -d[1])
-    taken = {k: np.zeros(len(v), bool) for k, v in gts.items()}
-    tp = np.zeros(len(dets))
-    fp = np.zeros(len(dets))
-    for i, (img, _, box) in enumerate(dets):
-        best, best_j = 0.0, -1
-        for j, gt in enumerate(gts.get(img, [])):
-            ix0, iy0 = max(box[0], gt[0]), max(box[1], gt[1])
-            ix1, iy1 = min(box[2], gt[2]), min(box[3], gt[3])
-            iw, ih = max(ix1 - ix0, 0), max(iy1 - iy0, 0)
-            inter = iw * ih
-            union = ((box[2] - box[0]) * (box[3] - box[1])
-                     + (gt[2] - gt[0]) * (gt[3] - gt[1]) - inter)
-            iou = inter / union if union > 0 else 0
-            if iou > best:
-                best, best_j = iou, j
-        if best >= iou_thresh and not taken[img][best_j]:
-            tp[i] = 1
-            taken[img][best_j] = True
-        else:
-            fp[i] = 1
-    rec = np.cumsum(tp) / npos
-    prec = np.cumsum(tp) / np.maximum(np.cumsum(tp) + np.cumsum(fp), 1e-9)
-    ap = 0.0
-    for t in np.arange(0, 1.01, 0.1):  # 11-point
-        p = prec[rec >= t].max() if (rec >= t).any() else 0
-        ap += p / 11
-    return float(ap)
-
 
 def run_ssd(quick=False):
     from mxnet_tpu.models import ssd
@@ -136,33 +101,21 @@ def run_ssd(quick=False):
     emit("ssd300_train_imgs_per_sec", rate, "img/s",
          {"batch": batch, "device": str(ctx)})
 
-    # mAP through MultiBoxDetection on the training set (overfit check)
+    # mAP through MultiBoxDetection on the training set (overfit check),
+    # scored by the framework metric (mx.metric.MApMetric, 11-point VOC07)
     det_net = ssd.get_symbol(num_classes=num_classes)
     det = mx.mod.Module(det_net, label_names=None, context=ctx)
     det.bind(data_shapes=[("data", (batch, 3, 300, 300))],
              for_training=False)
     arg, aux = mod.get_params()
     det.set_params(arg, aux, allow_missing=True)
-    dets_per_cls = {c: [] for c in range(num_classes)}
-    gts_per_cls = {c: {} for c in range(num_classes)}
+    metric = mx.metric.MApMetric(ovp_thresh=0.5, voc07=True,
+                                 score_thresh=0.1)
     it.reset()
-    img_id = 0
     for b in it:
         det.forward(b, is_train=False)
-        out = det.get_outputs()[0].asnumpy()  # (batch, n_anchors, 6)
-        for i in range(batch):
-            for cls, score, x0, y0, x1, y1 in out[i]:
-                if cls >= 0 and score > 0.1:
-                    dets_per_cls[int(cls)].append(
-                        (img_id + i, float(score), (x0, y0, x1, y1)))
-            for row in Y[(img_id + i) % n]:
-                if row[0] >= 0:
-                    gts_per_cls[int(row[0])].setdefault(
-                        img_id + i, []).append(tuple(row[1:5]))
-        img_id += batch
-    aps = [voc_ap(dets_per_cls[c], gts_per_cls[c]) for c in range(num_classes)
-           if gts_per_cls[c]]
-    mean_ap = float(np.nanmean(aps))
+        metric.update(b.label, det.get_outputs())
+    mean_ap = metric.get()[1]
     emit("ssd300_overfit_mAP@0.5", mean_ap, "mAP",
          {"classes": num_classes, "epochs": epochs})
     return rate, mean_ap
