@@ -79,6 +79,22 @@ def get_iter(args, kv):
                              shuffle=True, label_name="label")
 
 
+def get_eval_iter(args, kv):
+    """Augmentation-free pass over the same data for the --evaluate leg
+    (scoring distorted images against cropped-away boxes would make mAP
+    non-reproducible)."""
+    rec = os.path.join(args.data_dir, "train.rec")
+    if os.path.exists(rec):
+        return mx.io_image.ImageDetRecordIter(
+            path_imgrec=rec, data_shape=(3, 300, 300),
+            batch_size=args.batch_size,
+            mean_r=123.68, mean_g=116.779, mean_b=103.939,
+            part_index=kv.rank, num_parts=max(kv.num_workers, 1))
+    it = get_iter(args, kv)   # synthetic NDArrayIter is augmentation-free
+    it.reset()
+    return it
+
+
 def main():
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
@@ -90,6 +106,10 @@ def main():
     ap.add_argument("--kv-store", default="device")
     ap.add_argument("--data-dir", default="voc/")
     ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--evaluate", action="store_true",
+                    help="after training, score mAP@0.5 through "
+                         "MultiBoxDetection (reference: example/ssd/"
+                         "evaluate.py + eval_metric.py)")
     args = ap.parse_args()
 
     kv = mx.kv.create(args.kv_store)
@@ -105,6 +125,22 @@ def main():
             batch_end_callback=[mx.callback.Speedometer(args.batch_size, 5)],
             epoch_end_callback=([mx.callback.do_checkpoint(args.model_prefix)]
                                 if args.model_prefix else []))
+
+    if args.evaluate:
+        det = mx.mod.Module(ssd.get_symbol(num_classes=args.num_classes),
+                            label_names=None, context=ctx)
+        det.bind(data_shapes=[("data",
+                               (args.batch_size, 3, 300, 300))],
+                 for_training=False)
+        det.set_params(*mod.get_params(), allow_missing=True)
+        metric = mx.metric.MApMetric(ovp_thresh=0.5, score_thresh=0.1)
+        eval_it = get_eval_iter(args, kv)      # augmentation-free pass
+        for b in eval_it:
+            det.forward(b, is_train=False)
+            keep = args.batch_size - b.pad     # padded rows repeat images
+            metric.update([b.label[0][:keep]],
+                          [o[:keep] for o in det.get_outputs()])
+        logging.info("Train-set-mAP@0.5=%f", metric.get()[1])
 
 
 if __name__ == "__main__":

@@ -715,7 +715,7 @@ class MApMetric(EvalMetric):
                     k = int(iou.argmax())
                     best_iou, best_j = float(iou[k]), int(cand[k])
                 rec = self._records.setdefault(c, [])
-                if best_iou >= self.ovp_thresh:
+                if best_j >= 0 and best_iou >= self.ovp_thresh:
                     if difficult[best_j]:
                         continue  # matched a difficult gt: ignore entirely
                     if taken[best_j]:
@@ -739,9 +739,11 @@ class MApMetric(EvalMetric):
         precision = tp / n if len(rec) else numpy.zeros(0)
         if self.voc07:
             ap = 0.0
-            for t in numpy.arange(0.0, 1.01, 0.1):
-                p = precision[recall >= t].max() if (recall >= t).any() else 0.0
-                ap += p / 11.0
+            for k in range(11):
+                # t - 1e-9: recall==k/10 computed as tp/npos must not miss
+                # its own threshold to float error
+                hit = recall >= (k / 10.0 - 1e-9)
+                ap += (precision[hit].max() if hit.any() else 0.0) / 11.0
             return float(ap)
         # all-points: integrate the precision envelope over recall
         mrec = numpy.concatenate([[0.0], recall, [1.0]])

@@ -624,3 +624,33 @@ def test_map_metric_voc_protocol_details():
     m = run([[0, 0.1, 0.1, 0.4, 0.4, 0]], noisy, score_thresh=0.1,
             voc07=False)
     np.testing.assert_allclose(m.get()[1], 1.0)
+
+
+def test_map_metric_edge_guards():
+    """ovp_thresh=0 with no same-class gt (or no gt at all) must record a
+    clean false positive, not index difficult[-1]."""
+    import numpy as np
+
+    m = mx.metric.MApMetric(ovp_thresh=0.0)
+    # image with zero gt rows but one detection
+    m.update([mx.nd.array(-np.ones((1, 2, 6), np.float32))],
+             [mx.nd.array(np.asarray(
+                 [[[0, 0.9, 0.1, 0.1, 0.4, 0.4]]], np.float32))])
+    # detection of a class absent from this image's gts
+    m.update([mx.nd.array(np.asarray(
+                 [[[1, 0.1, 0.1, 0.4, 0.4, 0]]], np.float32))],
+             [mx.nd.array(np.asarray(
+                 [[[0, 0.9, 0.1, 0.1, 0.4, 0.4]]], np.float32))])
+    name, val = m.get()
+    # class 1 has one gt, zero matches: AP 0; class 0 is FP-only (nan)
+    np.testing.assert_allclose(val, 0.0)
+
+    # 11-point threshold at exact recall boundaries: 3 TP of 10 gts at
+    # precision 1 -> AP = 4 thresholds (0,.1,.2,.3) * 1/11
+    m2 = mx.metric.MApMetric(voc07=True)
+    gt = [[0, x / 20, 0.1, x / 20 + 0.04, 0.2, 0] for x in range(10)]
+    det = [[0, 0.9 - 0.01 * x, x / 20, 0.1, x / 20 + 0.04, 0.2]
+           for x in range(3)]
+    m2.update([mx.nd.array(np.asarray([gt], np.float32))],
+              [mx.nd.array(np.asarray([det], np.float32))])
+    np.testing.assert_allclose(m2.get()[1], 4.0 / 11.0, rtol=1e-6)
