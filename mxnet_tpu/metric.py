@@ -794,6 +794,7 @@ def create(metric, **kwargs):
         "f1": F1, "mae": MAE, "mse": MSE, "rmse": RMSE,
         "top_k_accuracy": TopKAccuracy, "topkaccuracy": TopKAccuracy,
         "perplexity": Perplexity, "loss": Loss, "torch": Torch, "caffe": Caffe,
+        "map": MApMetric, "mapmetric": MApMetric,
     }
     try:
         return metrics[metric.lower()](**kwargs)
