@@ -32,6 +32,7 @@ from . import callback
 from . import monitor
 from . import io
 from . import io_image
+from . import image_det
 from . import recordio
 from . import kvstore as kv
 from .kvstore import KVStore, create as _kv_create
