@@ -38,6 +38,7 @@ from . import kvstore as kv
 from .kvstore import KVStore, create as _kv_create
 from . import module
 from . import module as mod
+from . import executor_manager
 from . import model
 from .model import FeedForward
 from . import rnn

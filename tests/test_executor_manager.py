@@ -1,0 +1,87 @@
+"""Pre-Module DP helper (reference: python/mxnet/executor_manager.py —
+the FeedForward-era training loop: slice batch across devices, forward/
+backward per executor, apply an updater over param/grad arrays, copy_to
+to gather — model.py:99-116 _update_params)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.executor_manager import (DataParallelExecutorManager,
+                                        _split_input_slice)
+
+
+def _mlp():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_split_input_slice():
+    s = _split_input_slice(10, [1, 1])
+    assert [(x.start, x.stop) for x in s] == [(0, 5), (5, 10)]
+    s = _split_input_slice(12, [1, 2])
+    assert s[0].stop - s[0].start == 4 and s[-1].stop == 12
+
+
+def test_manager_trains_across_two_devices():
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 8).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+
+    ctx = [mx.cpu(0), mx.cpu(1)]
+    mgr = DataParallelExecutorManager(_mlp(), ctx, it)
+
+    # init params the FeedForward way, push to all devices
+    arg_shapes, _, aux_shapes = _mlp().infer_shape(data=(32, 8))
+    arg_names = _mlp().list_arguments()
+    arg_params = {}
+    init = mx.init.Xavier()
+    for name, shape in zip(arg_names, arg_shapes):
+        if name in mgr.param_names:
+            arr = mx.nd.zeros(shape)
+            init(mx.init.InitDesc(name), arr)
+            arg_params[name] = arr
+    mgr.set_params(arg_params, {})
+
+    updater = mx.optimizer.get_updater(
+        mx.optimizer.create("sgd", learning_rate=0.5, momentum=0.9,
+                            rescale_grad=1.0 / 32))
+
+    metric = mx.metric.create("acc")
+    for epoch in range(6):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mgr.load_data_batch(batch)
+            mgr.forward(is_train=True)
+            mgr.backward()
+            # reference _update_params: per-device updater over the lists
+            for idx, (weights, grads) in enumerate(
+                    zip(mgr.param_arrays, mgr.grad_arrays)):
+                for k, (w, g) in enumerate(zip(weights, grads)):
+                    updater(idx * len(ctx) + k, g, w)
+            mgr.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.9, metric.get()
+
+    # copy_to gathers the (averaged) params; a fresh Module scores the same
+    out_args = {n: mx.nd.zeros(a[0].shape) for n, a in
+                zip(mgr.param_names, mgr.param_arrays)}
+    mgr.copy_to(out_args, {})
+    mod = mx.mod.Module(_mlp())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.set_params(out_args, {})
+    assert mod.score(it, "acc")[0][1] > 0.9
+
+
+def test_manager_rejects_bucketing_and_bad_workload():
+    it = mx.io.NDArrayIter(np.zeros((8, 4), np.float32),
+                           np.zeros(8, np.float32), batch_size=4)
+    with pytest.raises(mx.base.MXNetError):
+        DataParallelExecutorManager(_mlp(), [mx.cpu()], it,
+                                    sym_gen=lambda k: _mlp())
+    with pytest.raises(mx.base.MXNetError):
+        DataParallelExecutorManager(_mlp(), [mx.cpu(0), mx.cpu(1)], it,
+                                    work_load_list=[1])
