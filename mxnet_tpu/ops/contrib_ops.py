@@ -277,7 +277,7 @@ def _multibox_detection(octx, attrs, args, auxs):
     anchors = anchors.reshape(-1, 4)
     bg = attrs["background_id"]
 
-    def per_batch(cp, lp):
+    def per_batch_pre(cp, lp):
         # class with max prob excluding background
         cls_only = jnp.concatenate([cp[:bg], cp[bg + 1 :]], axis=0) if C > 1 else cp
         ids = jnp.argmax(cls_only, axis=0)
@@ -286,6 +286,10 @@ def _multibox_detection(octx, attrs, args, auxs):
         valid = score > attrs["threshold"]
         boxes = _decode_loc(anchors, lp.reshape(-1, 4), attrs["variances"], attrs["clip"])
         score = jnp.where(valid, score, -jnp.inf)
+        return boxes, score, ids
+
+    def per_batch_nms(args3):
+        boxes, score, ids = args3
         b, s, c, keep = _nms_loop(
             boxes, score, ids, attrs["nms_threshold"], attrs["force_suppress"], attrs["nms_topk"]
         )
@@ -300,7 +304,15 @@ def _multibox_detection(octx, attrs, args, auxs):
         )
         return row
 
-    out = jax.vmap(per_batch)(cls_prob, loc_pred.reshape(N, -1))
+    # Decode/argmax vectorize over the batch; the sequential NMS stage runs
+    # in bounded-width chunks instead of one batch-wide vmap. A batch-wide
+    # vmapped NMS fused with the decode stage hits a TPU backend fault
+    # (worker kernel crash) at SSD-300 scale from N=16 up — measured on v5e,
+    # deterministic, N<=8 is clean — and chunking also bounds the loop
+    # body's working set for any batch size. Chunk width 4 measured equal to
+    # the full vmap's steady-state rate (docs/perf.md §ssd).
+    pre = jax.vmap(per_batch_pre)(cls_prob, loc_pred.reshape(N, -1))
+    out = jax.lax.map(per_batch_nms, pre, batch_size=min(4, N))
     return [jax.lax.stop_gradient(out)], []
 
 

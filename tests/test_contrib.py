@@ -85,6 +85,26 @@ def test_multibox_detection_nms():
     assert out[1][0] == -1.0  # suppressed
 
 
+def test_multibox_detection_batch_chunk_consistency():
+    # the NMS stage runs in lax.map chunks of 4 (TPU backend-fault guard,
+    # ops/contrib_ops.py): batched output must equal per-sample runs, incl.
+    # at a non-multiple-of-chunk batch size
+    rng = np.random.RandomState(7)
+    N, C, A = 6, 4, 64
+    cls_prob = nd.array(rng.rand(N, C, A).astype(np.float32))
+    loc_pred = nd.array((rng.randn(N, A * 4) * 0.1).astype(np.float32))
+    anchors = nd.array(rng.rand(1, A, 4).astype(np.float32))
+    full = mx.contrib.ndarray.MultiBoxDetection(
+        cls_prob, loc_pred, anchors, nms_threshold=0.45, nms_topk=20
+    ).asnumpy()
+    for i in range(N):
+        one = mx.contrib.ndarray.MultiBoxDetection(
+            cls_prob[i : i + 1], loc_pred[i : i + 1], anchors,
+            nms_threshold=0.45, nms_topk=20,
+        ).asnumpy()
+        np.testing.assert_allclose(full[i], one[0], atol=1e-5)
+
+
 def test_ctc_loss_simple():
     # single sequence, alphabet {blank=0, 1}: T=2 emissions of label [1]
     T, N, C = 2, 1, 3
