@@ -84,8 +84,9 @@ run_predict() {
 
 run_predict_native() {
   # Python-free deployment: .mxa AOT export + PJRT C API runtime
+  # (predict AND train artifacts — the C client trains without Python)
   make -C mxnet_tpu/src c_predict_native
-  python -m pytest tests/test_predict_native.py -x -q
+  python -m pytest tests/test_predict_native.py tests/test_train_native.py -x -q
 }
 
 run_entry() {
@@ -194,6 +195,7 @@ case "$stage" in
   examples) run_examples ;;
   all) run_native; run_predict; run_predict_native; run_entry;
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
-                --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py ;;
+                --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
+                --ignore=tests/test_train_native.py ;;
   *) echo "unknown stage: $stage (unit|native|predict|predict_native|entry|bench|tpu|examples|all)"; exit 2 ;;
 esac

@@ -69,7 +69,7 @@ symbol.contrib = contrib.symbol
 from . import engine
 from . import operator
 from . import export_artifact
-from .export_artifact import export_predict_artifact
+from .export_artifact import export_predict_artifact, export_train_artifact
 
 # Custom registers into the op registry after symbol/ndarray generated their
 # functions at import — generate its wrappers explicitly

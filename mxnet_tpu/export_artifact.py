@@ -37,7 +37,8 @@ from .executor import build_graph_fn
 
 MAGIC = b"MXTPUAR1"
 
-__all__ = ["export_predict_artifact", "load_artifact_manifest", "MAGIC"]
+__all__ = ["export_predict_artifact", "export_train_artifact",
+           "load_artifact_manifest", "MAGIC"]
 
 
 def _shape_of(x):
@@ -183,6 +184,231 @@ def export_predict_artifact(symbol, arg_params, aux_params, input_shapes,
         kind, _, n = key.partition(":")
         src = arg_params if kind == "arg" else aux_params
         params_dict[key] = nd.array(np.asarray(src[n]))
+    _save_params_to(blob, params_dict)
+
+    mjs = json.dumps(manifest, indent=1).encode()
+    pbytes = blob.getvalue()
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<Q", len(mjs)))
+        f.write(mjs)
+        f.write(struct.pack("<Q", len(program)))
+        f.write(program)
+        f.write(struct.pack("<Q", len(pbytes)))
+        f.write(pbytes)
+    return manifest
+
+
+def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
+                          optimizer_params=None, initializer=None,
+                          arg_params=None, aux_params=None, platform="tpu",
+                          matmul_precision="highest", seed=0):
+    """AOT-export a full TRAINING step into a ``.mxa`` file (kind="train").
+
+    Goes beyond the reference's deployment stack: its amalgamation/predict
+    API was inference-only (``c_predict_api.h``) — here the whole fused
+    step (forward + backward + optimizer update, the same trace
+    ``Module.fit`` runs on the fused path) is lowered with ``jax.export``
+    so a C client can TRAIN through the PJRT C API with no Python in the
+    process. See ``src/c_predict_pjrt.cc`` (MXTrainNative*) for the native
+    runtime and ``docs/deployment.md`` for the workflow.
+
+    The exported function's flat signature (role-tagged in the manifest)::
+
+        step(params..., states..., auxs..., inputs..., lr, t)
+          -> (new_params..., new_states..., new_auxs..., outputs...)
+
+    ``lr`` is an f32 scalar the client controls per step (scheduling stays
+    host-side, like the classic path); ``t`` is the 1-based update counter
+    (Adam bias correction etc.); param/state/aux buffers are donated, so a
+    PJRT runtime carries them in place between steps. Initial params come
+    from ``arg_params``/``aux_params`` or ``initializer`` (default Xavier),
+    and ship in the artifact's params section (keys ``arg:``/``aux:``/
+    ``state:<name>:<slot>``) together with the loss-output flags the client
+    can use for readout.
+
+    Stochastic graphs (Dropout etc.) derive their per-step rng key inside
+    the program from ``t`` and the baked ``seed`` — deterministic replay,
+    nothing extra for the C client to feed.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from . import initializer as init_mod
+    from .parallel import build_mesh
+    from .parallel.spmd import SPMDTrainer
+
+    # label-head shape inference, same contract as the predict export
+    shapes = {n: tuple(s) for n, s in input_shapes.items()}
+    arg_names = symbol.list_arguments()
+    known = set(shapes) | set(arg_params or {})
+    unknown = [n for n in arg_names if n not in known]
+    label_like = [n for n in unknown if n == "label" or n.endswith("_label")]
+    if label_like:
+        inferred, _, _ = symbol.infer_shape_partial(**shapes)
+        for n, shp in zip(arg_names, inferred):
+            if n in label_like and shp is not None and 0 not in tuple(shp):
+                shapes[n] = tuple(shp)
+
+    data_shapes = [(n, s) for n, s in shapes.items() if n not in label_like]
+    label_shapes = [(n, shapes[n]) for n in label_like if n in shapes]
+
+    mesh = build_mesh({"dp": 1}, list(jax.devices("cpu"))[:1])
+    trainer = SPMDTrainer(symbol, mesh, data_shapes=data_shapes,
+                          label_shapes=label_shapes, optimizer=optimizer,
+                          optimizer_params=optimizer_params, donate=False)
+
+    # ---- initial values (host-side numpy; nothing touches a device) ------
+    from . import ndarray as nd
+
+    if initializer is None:
+        initializer = init_mod.Xavier()
+    arg_params = dict(arg_params or {})
+    aux_params = dict(aux_params or {})
+    params0, states0, auxs0 = {}, {}, {}
+    for n in trainer.param_names:
+        if n in arg_params:
+            v = arg_params[n]
+            v = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+        else:
+            host = nd.zeros(trainer.arg_shapes[n])
+            initializer(n, host)
+            v = host.asnumpy()
+        params0[n] = v.astype(np.float32)
+        states0[n] = trainer.rule.init_state(trainer.arg_shapes[n],
+                                             np.float32)
+    for n in trainer.aux_names:
+        if n in aux_params:
+            v = aux_params[n]
+            v = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+        else:
+            host = nd.zeros(trainer.aux_shapes[n])
+            initializer(n, host)
+            v = host.asnumpy()
+        auxs0[n] = v.astype(np.float32)
+
+    # ---- the flat step ---------------------------------------------------
+    rule = trainer.rule
+    base_wd = trainer.optimizer.wd
+    from .parallel import fused_opt as _fo
+
+    lr_mult, wd_mult = _fo.mults_for(trainer.optimizer, trainer.param_names)
+    pnames, anames = trainer.param_names, trainer.aux_names
+    nslot = rule.nslot
+    stochastic = trainer._stochastic
+
+    def flat_step(*flat):
+        i = 0
+        params = {n: flat[i + k] for k, n in enumerate(pnames)}
+        i += len(pnames)
+        states = {}
+        for n in pnames:
+            states[n] = tuple(flat[i:i + nslot])
+            i += nslot
+        auxs = {n: flat[i + k] for k, n in enumerate(anames)}
+        i += len(anames)
+        inputs = {}
+        for n, _ in data_shapes + label_shapes:
+            inputs[n] = flat[i]
+            i += 1
+        lr, t = flat[i], flat[i + 1]
+        rng = jax.random.PRNGKey(jnp.uint32(seed) + t.astype(jnp.uint32)) \
+            if stochastic else None
+        grads, new_auxs, outs = trainer._make_grads(params, auxs, inputs, rng)
+        out_flat = []
+        new_states = []
+        for n in pnames:
+            p, s = rule.apply(params[n], grads[n], states[n],
+                              lr * lr_mult[n], base_wd * wd_mult[n], t)
+            out_flat.append(p)
+            new_states.extend(s)
+        out_flat.extend(new_states)
+        out_flat.extend(new_auxs[n] for n in anames)
+        out_flat.extend(outs)
+        return tuple(out_flat)
+
+    n_params, n_auxs = len(pnames), len(anames)
+    n_states = n_params * nslot
+    n_inputs = len(data_shapes) + len(label_shapes)
+    donate = tuple(range(n_params + n_states + n_auxs))
+
+    f32 = np.dtype(np.float32)
+    in_specs = (
+        [jax.ShapeDtypeStruct(trainer.arg_shapes[n], f32) for n in pnames]
+        + [jax.ShapeDtypeStruct(trainer.arg_shapes[n], f32)
+           for n in pnames for _ in range(nslot)]
+        + [jax.ShapeDtypeStruct(trainer.aux_shapes[n], f32) for n in anames]
+        + [jax.ShapeDtypeStruct(shapes[n], f32) for n, _ in data_shapes]
+        + [jax.ShapeDtypeStruct(shapes[n], f32) for n, _ in label_shapes]
+        + [jax.ShapeDtypeStruct((), f32), jax.ShapeDtypeStruct((), np.int32)]
+    )
+
+    with jax.default_matmul_precision(matmul_precision):
+        exported = jax.export.export(
+            jax.jit(flat_step, donate_argnums=donate),
+            platforms=[platform])(*in_specs)
+    program = _serialize_max_compat(exported)
+    kept = set(exported.module_kept_var_idx)
+
+    # ---- manifest --------------------------------------------------------
+    args_desc = []
+
+    def arg_row(name, role, shape, idx):
+        args_desc.append({
+            "name": name, "role": role, "shape": [int(d) for d in shape],
+            "dtype": "int32" if role == "t" else "float32",
+            "kept": idx in kept, "donated": idx in set(donate)})
+
+    idx = 0
+    for n in pnames:
+        arg_row(n, "param", trainer.arg_shapes[n], idx); idx += 1
+    for n in pnames:
+        for k in range(nslot):
+            arg_row("%s:%d" % (n, k), "state", trainer.arg_shapes[n], idx)
+            idx += 1
+    for n in anames:
+        arg_row(n, "aux", trainer.aux_shapes[n], idx); idx += 1
+    for n, _ in data_shapes:
+        arg_row(n, "data", shapes[n], idx); idx += 1
+    for n, _ in label_shapes:
+        arg_row(n, "label", shapes[n], idx); idx += 1
+    arg_row("lr", "lr", (), idx); idx += 1
+    arg_row("t", "t", (), idx); idx += 1
+
+    out_names = symbol.list_outputs()
+    outs_desc = (
+        [{"name": n, "role": "param"} for n in pnames]
+        + [{"name": "%s:%d" % (n, k), "role": "state"}
+           for n in pnames for k in range(nslot)]
+        + [{"name": n, "role": "aux"} for n in anames]
+        + [{"name": n, "role": "out"} for n in out_names])
+    for d, a in zip(outs_desc, exported.out_avals):
+        d["shape"] = [int(x) for x in a.shape]
+        d["dtype"] = str(np.dtype(a.dtype))
+
+    manifest = {
+        "version": 2,
+        "kind": "train",
+        "platform": platform,
+        "matmul_precision": matmul_precision,
+        "optimizer": type(trainer.optimizer).__name__,
+        "nslot": nslot,
+        "t0": 1,
+        "seed": int(seed),
+        "loss_outputs": [bool(f) for f in trainer._loss_flags],
+        "args": args_desc,
+        "outputs": outs_desc,
+    }
+
+    blob = io.BytesIO()
+    params_dict = {}
+    for n in pnames:
+        params_dict["arg:" + n] = nd.array(params0[n])
+        for k in range(nslot):
+            params_dict["state:%s:%d" % (n, k)] = nd.array(
+                np.asarray(states0[n][k], np.float32))
+    for n in anames:
+        params_dict["aux:" + n] = nd.array(auxs0[n])
     _save_params_to(blob, params_dict)
 
     mjs = json.dumps(manifest, indent=1).encode()

@@ -46,6 +46,41 @@ int MXNDListGet(NDListHandle handle, mx_uint index, const char** out_key,
                 mx_uint* out_ndim);
 int MXNDListFree(NDListHandle handle);
 
+/* ---- Python-free TRAINING over PJRT (beyond the reference: its predict
+ * stack was inference-only). Loads a kind="train" .mxa artifact exported by
+ * mxnet_tpu.export_train_artifact — one AOT-compiled program per step:
+ * forward + backward + optimizer update, param/optimizer/aux buffers
+ * carried device-resident between steps. The C client feeds data/label
+ * inputs, drives the learning rate, reads loss outputs, and saves the
+ * trained parameters in the reference .params format (loadable by
+ * mx.model.load_checkpoint / MXNDListCreate). */
+typedef void* TrainNativeHandle;
+
+int MXTrainNativeCreateFromFile(const char* artifact_path,
+                                TrainNativeHandle* out);
+/* data/label inputs the client must feed (role: "data" | "label") */
+int MXTrainNativeNumInputs(TrainNativeHandle h, mx_uint* out);
+int MXTrainNativeInputInfo(TrainNativeHandle h, mx_uint index,
+                           const char** name, const char** role,
+                           const mx_uint** shape, mx_uint* ndim);
+int MXTrainNativeSetInput(TrainNativeHandle h, const char* name,
+                          const mx_float* data, mx_uint size);
+/* one optimization step at learning rate lr (forward+backward+update);
+ * the internal update counter t advances automatically */
+int MXTrainNativeStep(TrainNativeHandle h, mx_float lr);
+/* graph outputs of the LAST step (losses etc.; is_loss mirrors the
+ * exported loss flags) */
+int MXTrainNativeNumOutputs(TrainNativeHandle h, mx_uint* out);
+int MXTrainNativeOutputInfo(TrainNativeHandle h, mx_uint index,
+                            const char** name, int* is_loss,
+                            const mx_uint** shape, mx_uint* ndim);
+int MXTrainNativeGetOutput(TrainNativeHandle h, mx_uint index, mx_float* data,
+                           mx_uint size);
+/* write current params+auxs as a reference-format .params file
+ * ("arg:"/"aux:" keys) */
+int MXTrainNativeSaveParams(TrainNativeHandle h, const char* path);
+int MXTrainNativeFree(TrainNativeHandle h);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,167 @@
+/* Pure-C TRAINING client over libmxtpu_predict_native.so — no Python in
+ * this process.  The reference's deployment stack stops at inference
+ * (c_predict_api.h + amalgamation); this drives a full optimization loop
+ * through a kind="train" .mxa artifact on the PJRT device.
+ *
+ * Usage:
+ *   train_native_client <model.mxa> <data.f32> <labels.f32> <batch_rows>
+ *                       <steps> <lr> <out.params> <loss.txt>
+ *
+ * data.f32 holds N examples row-major; labels.f32 holds N label rows.  The
+ * client cycles fixed-size batches from them (epoch order), runs <steps>
+ * MXTrainNativeStep calls at <lr>, prints the first loss-flagged output's
+ * mean every 50 steps into loss.txt (first and last always), and saves the
+ * trained parameters in the reference .params format. */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void* TrainNativeHandle;
+
+extern const char* MXGetLastError(void);
+extern int MXTrainNativeCreateFromFile(const char* path,
+                                       TrainNativeHandle* out);
+extern int MXTrainNativeNumInputs(TrainNativeHandle h, mx_uint* out);
+extern int MXTrainNativeInputInfo(TrainNativeHandle h, mx_uint i,
+                                  const char** name, const char** role,
+                                  const mx_uint** shape, mx_uint* ndim);
+extern int MXTrainNativeSetInput(TrainNativeHandle h, const char* name,
+                                 const mx_float* data, mx_uint size);
+extern int MXTrainNativeStep(TrainNativeHandle h, mx_float lr);
+extern int MXTrainNativeNumOutputs(TrainNativeHandle h, mx_uint* out);
+extern int MXTrainNativeOutputInfo(TrainNativeHandle h, mx_uint i,
+                                   const char** name, int* is_loss,
+                                   const mx_uint** shape, mx_uint* ndim);
+extern int MXTrainNativeGetOutput(TrainNativeHandle h, mx_uint i,
+                                  mx_float* data, mx_uint size);
+extern int MXTrainNativeSaveParams(TrainNativeHandle h, const char* path);
+extern int MXTrainNativeFree(TrainNativeHandle h);
+
+static float* slurp_f32(const char* path, long* n_floats) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "open %s failed\n", path); exit(2); }
+  fseek(f, 0, SEEK_END);
+  long bytes = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  float* buf = (float*)malloc(bytes);
+  if (fread(buf, 1, bytes, f) != (size_t)bytes) exit(2);
+  fclose(f);
+  *n_floats = bytes / (long)sizeof(float);
+  return buf;
+}
+
+#define CHECK(call)                                              \
+  do {                                                           \
+    if ((call) != 0) {                                           \
+      fprintf(stderr, "FAIL %s: %s\n", #call, MXGetLastError()); \
+      return 1;                                                  \
+    }                                                            \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc != 9) {
+    fprintf(stderr,
+            "usage: %s model.mxa data.f32 labels.f32 batch_rows steps lr "
+            "out.params loss.txt\n",
+            argv[0]);
+    return 2;
+  }
+  long n_data = 0, n_label = 0;
+  float* data = slurp_f32(argv[2], &n_data);
+  float* labels = slurp_f32(argv[3], &n_label);
+  long batch_rows = atol(argv[4]);
+  long steps = atol(argv[5]);
+  float lr = (float)atof(argv[6]);
+
+  TrainNativeHandle tr = NULL;
+  CHECK(MXTrainNativeCreateFromFile(argv[1], &tr));
+
+  /* input specs: one "data"-role and one "label"-role input expected */
+  mx_uint n_in = 0;
+  CHECK(MXTrainNativeNumInputs(tr, &n_in));
+  const char* data_name = NULL;
+  const char* label_name = NULL;
+  mx_uint data_elems = 0, label_elems = 0;
+  for (mx_uint i = 0; i < n_in; ++i) {
+    const char* name;
+    const char* role;
+    const mx_uint* shape;
+    mx_uint ndim;
+    CHECK(MXTrainNativeInputInfo(tr, i, &name, &role, &shape, &ndim));
+    mx_uint n = 1;
+    for (mx_uint d = 0; d < ndim; ++d) n *= shape[d];
+    printf("input %s role=%s elems=%u\n", name, role, n);
+    if (strcmp(role, "data") == 0) { data_name = name; data_elems = n; }
+    if (strcmp(role, "label") == 0) { label_name = name; label_elems = n; }
+  }
+  if (!data_name) { fprintf(stderr, "no data input\n"); return 1; }
+
+  /* loss output index */
+  mx_uint n_out = 0;
+  CHECK(MXTrainNativeNumOutputs(tr, &n_out));
+  int loss_idx = -1;
+  mx_uint loss_elems = 0;
+  for (mx_uint i = 0; i < n_out; ++i) {
+    const char* name;
+    int is_loss;
+    const mx_uint* shape;
+    mx_uint ndim;
+    CHECK(MXTrainNativeOutputInfo(tr, i, &name, &is_loss, &shape, &ndim));
+    mx_uint n = 1;
+    for (mx_uint d = 0; d < ndim; ++d) n *= shape[d];
+    if (is_loss && loss_idx < 0) { loss_idx = (int)i; loss_elems = n; }
+  }
+
+  long data_per_row = data_elems / batch_rows;
+  long label_per_row = label_name ? label_elems / batch_rows : 0;
+  long n_rows = n_data / data_per_row;
+  long n_batches = n_rows / batch_rows;
+  if (n_batches < 1) { fprintf(stderr, "not enough rows\n"); return 1; }
+
+  FILE* lf = fopen(argv[8], "w");
+  if (!lf) { fprintf(stderr, "cannot write %s\n", argv[8]); return 2; }
+  float* loss_buf = loss_idx >= 0 ? (float*)malloc(loss_elems * sizeof(float))
+                                  : NULL;
+  for (long s = 0; s < steps; ++s) {
+    long b = s % n_batches;
+    CHECK(MXTrainNativeSetInput(tr, data_name,
+                                data + b * batch_rows * data_per_row,
+                                data_elems));
+    if (label_name)
+      CHECK(MXTrainNativeSetInput(tr, label_name,
+                                  labels + b * batch_rows * label_per_row,
+                                  label_elems));
+    CHECK(MXTrainNativeStep(tr, lr));
+    if (loss_idx >= 0 && (s % 50 == 0 || s == steps - 1)) {
+      CHECK(MXTrainNativeGetOutput(tr, (mx_uint)loss_idx, loss_buf,
+                                   loss_elems));
+      /* SoftmaxOutput's loss-flagged output is the class probabilities:
+       * when it is (batch, C) and labels are one id per row, report the
+       * cross-entropy; otherwise report the output mean (MakeLoss heads) */
+      double acc = 0;
+      long C = loss_elems / batch_rows;
+      if (label_name && label_per_row == 1 && C * batch_rows == loss_elems &&
+          C > 1) {
+        for (long r = 0; r < batch_rows; ++r) {
+          long cls = (long)labels[(s % n_batches) * batch_rows + r];
+          float p = loss_buf[r * C + cls];
+          acc += -log(p > 1e-8f ? p : 1e-8f);
+        }
+        acc /= batch_rows;
+      } else {
+        for (mx_uint i = 0; i < loss_elems; ++i) acc += loss_buf[i];
+        acc /= loss_elems;
+      }
+      fprintf(lf, "%ld %.6f\n", s, acc);
+      fflush(lf);
+    }
+  }
+  fclose(lf);
+  CHECK(MXTrainNativeSaveParams(tr, argv[7]));
+  CHECK(MXTrainNativeFree(tr));
+  printf("OK\n");
+  return 0;
+}
