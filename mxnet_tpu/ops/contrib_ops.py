@@ -231,6 +231,15 @@ def _mbt_infer(attrs, in_shapes, aux_shapes):
 get_op("_contrib_MultiBoxTarget")._infer_shape = _mbt_infer
 
 
+# Bounded NMS vectorization width shared by the detection ops below:
+# batch-wide vmapped NMS fused with its decode stage crashes the v5e TPU
+# worker ("kernel fault") at detection scale from N=16 up — deterministic,
+# N<=8 clean — and chunking also bounds the loop body's working set for any
+# batch size. Width 4 measured equal to the batch-wide vmap's steady rate
+# (docs/perf.md section ssd).
+_NMS_CHUNK = 4
+
+
 # ------------------------------------------------------------ MultiBoxDetection
 def _nms_loop(boxes, scores, cls_ids, nms_threshold, force_suppress, topk):
     """Greedy NMS over score-sorted boxes: a fori_loop where step i suppresses
@@ -304,15 +313,11 @@ def _multibox_detection(octx, attrs, args, auxs):
         )
         return row
 
-    # Decode/argmax vectorize over the batch; the sequential NMS stage runs
-    # in bounded-width chunks instead of one batch-wide vmap. A batch-wide
-    # vmapped NMS fused with the decode stage hits a TPU backend fault
-    # (worker kernel crash) at SSD-300 scale from N=16 up — measured on v5e,
-    # deterministic, N<=8 is clean — and chunking also bounds the loop
-    # body's working set for any batch size. Chunk width 4 measured equal to
-    # the full vmap's steady-state rate (docs/perf.md §ssd).
+    # decode/argmax vectorize over the batch; the sequential NMS stage
+    # runs in bounded-width chunks instead of one batch-wide vmap (the TPU
+    # fault guard — see _NMS_CHUNK above)
     pre = jax.vmap(per_batch_pre)(cls_prob, loc_pred.reshape(N, -1))
-    out = jax.lax.map(per_batch_nms, pre, batch_size=min(4, N))
+    out = jax.lax.map(per_batch_nms, pre, batch_size=min(_NMS_CHUNK, N))
     return [jax.lax.stop_gradient(out)], []
 
 
@@ -404,6 +409,11 @@ def _proposal(octx, attrs, args, auxs):
         pre_n = min(attrs["rpn_pre_nms_top_n"], fg.shape[0])
         top_s, top_i = jax.lax.top_k(fg, pre_n)
         top_b = boxes[top_i]
+        return top_b, top_s
+
+    def per_batch_nms(args2):
+        top_b, top_s = args2
+        pre_n = top_s.shape[0]
         b, s, _, keep = _nms_loop(
             top_b, top_s, jnp.zeros(pre_n, jnp.int32), attrs["threshold"], True,
             attrs["rpn_post_nms_top_n"] * 4,
@@ -418,7 +428,12 @@ def _proposal(octx, attrs, args, auxs):
             sel_s = jnp.concatenate([sel_s, jnp.full((pad,), -jnp.inf)], 0)
         return rois, sel_s
 
-    rois, scores = jax.vmap(per_batch)(cls_prob, bbox_pred, im_info)
+    # same TPU-fault guard as MultiBoxDetection: anchor decode + top_k
+    # vectorize over the batch, the sequential NMS stage runs in bounded
+    # lax.map chunks (see _NMS_CHUNK above)
+    pre = jax.vmap(per_batch)(cls_prob, bbox_pred, im_info)
+    rois, scores = jax.lax.map(per_batch_nms, pre,
+                               batch_size=min(_NMS_CHUNK, N))
     batch_idx = jnp.repeat(
         jnp.arange(N, dtype=jnp.float32)[:, None], rois.shape[1], axis=1
     )[..., None]

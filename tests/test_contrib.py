@@ -105,6 +105,26 @@ def test_multibox_detection_batch_chunk_consistency():
         np.testing.assert_allclose(full[i], one[0], atol=1e-5)
 
 
+def test_proposal_batch_chunk_consistency():
+    # Proposal's NMS stage shares MultiBoxDetection's bounded lax.map guard;
+    # batched rois must equal per-sample runs at a non-multiple-of-chunk N
+    rng = np.random.RandomState(1)
+    K, N, post = 12, 6, 20  # default scales (4) x ratios (3)
+    cls = nd.array(rng.rand(N, 2 * K, 8, 8).astype(np.float32))
+    bbox = nd.array((rng.randn(N, 4 * K, 8, 8) * 0.1).astype(np.float32))
+    info = nd.array(np.tile([128.0, 128.0, 1.0], (N, 1)).astype(np.float32))
+    full = mx.contrib.ndarray.Proposal(
+        cls, bbox, info, rpn_pre_nms_top_n=100, rpn_post_nms_top_n=post
+    ).asnumpy()
+    for i in range(N):
+        one = mx.contrib.ndarray.Proposal(
+            cls[i : i + 1], bbox[i : i + 1], info[i : i + 1],
+            rpn_pre_nms_top_n=100, rpn_post_nms_top_n=post,
+        ).asnumpy()
+        np.testing.assert_allclose(full[i * post : (i + 1) * post, 1:],
+                                   one[:, 1:], atol=1e-4)
+
+
 def test_ctc_loss_simple():
     # single sequence, alphabet {blank=0, 1}: T=2 emissions of label [1]
     T, N, C = 2, 1, 3
