@@ -202,7 +202,8 @@ def export_predict_artifact(symbol, arg_params, aux_params, input_shapes,
 def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
                           optimizer_params=None, initializer=None,
                           arg_params=None, aux_params=None, platform="tpu",
-                          matmul_precision="highest", seed=0):
+                          matmul_precision="highest", seed=0,
+                          compute_dtype=None):
     """AOT-export a full TRAINING step into a ``.mxa`` file (kind="train").
 
     Goes beyond the reference's deployment stack: its amalgamation/predict
@@ -230,6 +231,11 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
     Stochastic graphs (Dropout etc.) derive their per-step rng key inside
     the program from ``t`` and the baked ``seed`` — deterministic replay,
     nothing extra for the C client to feed.
+
+    ``compute_dtype="bfloat16"`` bakes the TPU-native mixed-precision
+    recipe into the artifact (same as the fused fit path: fp32 master
+    params and optimizer slots at the boundary, bf16 graph compute, fp32
+    gradients through the cast); the flat C signature stays float32.
     """
     import jax
     import jax.numpy as jnp
@@ -256,7 +262,8 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
     mesh = build_mesh({"dp": 1}, list(jax.devices("cpu"))[:1])
     trainer = SPMDTrainer(symbol, mesh, data_shapes=data_shapes,
                           label_shapes=label_shapes, optimizer=optimizer,
-                          optimizer_params=optimizer_params, donate=False)
+                          optimizer_params=optimizer_params, donate=False,
+                          compute_dtype=compute_dtype)
 
     # ---- initial values (host-side numpy; nothing touches a device) ------
     from . import ndarray as nd
@@ -324,7 +331,12 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
             new_states.extend(s)
         out_flat.extend(new_states)
         out_flat.extend(new_auxs[n] for n in anames)
-        out_flat.extend(outs)
+        # graph outputs keep the C contract at float32 even under a bf16
+        # compute_dtype (the native GetOutput surface is f32-only)
+        out_flat.extend(
+            o.astype(np.float32) if jnp.issubdtype(o.dtype, jnp.floating)
+            and o.dtype != np.float32 else o
+            for o in outs)
         return tuple(out_flat)
 
     n_params, n_auxs = len(pnames), len(anames)
@@ -391,6 +403,8 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
         "kind": "train",
         "platform": platform,
         "matmul_precision": matmul_precision,
+        "compute_dtype": str(np.dtype(compute_dtype))
+        if compute_dtype is not None else "float32",
         "optimizer": type(trainer.optimizer).__name__,
         "nslot": nslot,
         "t0": 1,

@@ -160,6 +160,41 @@ def test_c_client_trains_mlp(tmp_path):
 
 
 @needs_toolchain
+def test_c_client_trains_bf16(tmp_path):
+    """compute_dtype='bfloat16' bakes the mixed-precision recipe into the
+    artifact: a pure-C process trains with bf16 compute + fp32 masters."""
+    env = _plugin_env()
+    import mxnet_tpu as mx
+    exe = _build_client(tmp_path)
+    net = _mlp()
+    batch = 32
+    path = str(tmp_path / "mlp_bf16.mxa")
+    m = mx.export_train_artifact(
+        net, {"data": (batch, 8)}, path, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+        platform="tpu", seed=3, compute_dtype="bfloat16")
+    assert m["compute_dtype"] == "bfloat16"
+    # the C signature stays float32 everywhere
+    assert all(a["dtype"] == "float32" for a in m["args"]
+               if a["role"] != "t")
+
+    x, y = _three_class_data(128)
+    x.tofile(str(tmp_path / "data.f32"))
+    y.tofile(str(tmp_path / "labels.f32"))
+    params_out = str(tmp_path / "bf16.params")
+    r = subprocess.run(
+        [exe, path, str(tmp_path / "data.f32"), str(tmp_path / "labels.f32"),
+         str(batch), "300", "0.05", params_out, str(tmp_path / "l.txt")],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert r.returncode == 0, "client failed:\n" + r.stdout + r.stderr
+    losses = [float(l.split()[1]) for l in open(str(tmp_path / "l.txt"))]
+    assert losses[-1] < losses[0] * 0.2, losses
+    # fp32 master params round-trip
+    sd = mx.nd.load(params_out)
+    assert all(v.asnumpy().dtype == np.float32 for v in sd.values())
+
+
+@needs_toolchain
 def test_native_steps_match_python_trainer(tmp_path):
     """The native step IS the fused step: three C steps from a fixed init
     match three SPMDTrainer.step calls on the same batches."""
