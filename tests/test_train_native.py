@@ -195,6 +195,56 @@ def test_c_client_trains_bf16(tmp_path):
 
 
 @needs_toolchain
+def test_c_client_trains_conv_bn(tmp_path):
+    """Aux-state carry through the native step: a conv+BatchNorm net's
+    moving statistics must be UPDATED by C-side training (they ride the
+    carry like params) and land in the saved checkpoint."""
+    env = _plugin_env()
+    import mxnet_tpu as mx
+    exe = _build_client(tmp_path)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="c1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    batch = 16
+    path = str(tmp_path / "convbn.mxa")
+    m = mx.export_train_artifact(
+        net, {"data": (batch, 1, 8, 8)}, path, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+        platform="tpu", seed=1)
+    assert any(a["role"] == "aux" for a in m["args"])
+
+    x, ycls = _three_class_data(64, seed=4)
+    # lift the 8-D blobs into 1x8x8 images (shifted copies fill the rows)
+    xi = np.zeros((64, 1, 8, 8), np.float32)
+    for r in range(8):
+        xi[:, 0, r, :] = np.roll(x, r, axis=1)
+    xi.tofile(str(tmp_path / "data.f32"))
+    ycls.tofile(str(tmp_path / "labels.f32"))
+    params_out = str(tmp_path / "convbn.params")
+    r = subprocess.run(
+        [exe, path, str(tmp_path / "data.f32"), str(tmp_path / "labels.f32"),
+         str(batch), "120", "0.05", params_out, str(tmp_path / "l.txt")],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert r.returncode == 0, "client failed:\n" + r.stdout + r.stderr
+    losses = [float(l.split()[1]) for l in open(str(tmp_path / "l.txt"))]
+    assert losses[-1] < losses[0] * 0.5, losses
+
+    sd = mx.nd.load(params_out)
+    mean = sd["aux:bn1_moving_mean"].asnumpy()
+    var = sd["aux:bn1_moving_var"].asnumpy()
+    # moving stats moved off their init (mean 0 / var 1) => aux carry works
+    assert np.abs(mean).max() > 1e-3, mean
+    assert np.abs(var - 1.0).max() > 1e-3, var
+
+
+@needs_toolchain
 def test_native_steps_match_python_trainer(tmp_path):
     """The native step IS the fused step: three C steps from a fixed init
     match three SPMDTrainer.step calls on the same batches."""
