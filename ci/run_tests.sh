@@ -17,6 +17,13 @@ run_unit() {
   # 3). Files are dealt size-descending round-robin across shards, each
   # shard is its own pytest process, and the stage fails if any shard
   # fails. MXTPU_TEST_SHARDS=1 restores the serial run.
+  #
+  # The PJRT-plugin suites (predict_native/train_native) have their own
+  # stage AND talk to the real chip through subprocess C clients — inside
+  # the parallel shards they contend for the single tunneled TPU worker
+  # and flake; keep them out of the unit stage unconditionally.
+  set -- "$@" --ignore=tests/test_predict_native.py \
+              --ignore=tests/test_train_native.py
   local shards="${MXTPU_TEST_SHARDS:-6}"
   if [ "$shards" -le 1 ]; then
     python -m pytest tests/ -x -q "$@"

@@ -122,7 +122,7 @@ def test_c_client_trains_mlp(tmp_path):
     path = str(tmp_path / "mlp_train.mxa")
     mx.export_train_artifact(
         net, {"data": (batch, 8)}, path, optimizer="sgd",
-        optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+        optimizer_params={"learning_rate": 0.02, "momentum": 0.9},
         platform="tpu", seed=3)
 
     x, y = _three_class_data(128)
@@ -132,7 +132,7 @@ def test_c_client_trains_mlp(tmp_path):
     loss_out = str(tmp_path / "loss.txt")
     r = subprocess.run(
         [exe, path, str(tmp_path / "data.f32"), str(tmp_path / "labels.f32"),
-         str(batch), "300", "0.05", params_out, loss_out],
+         str(batch), "400", "0.02", params_out, loss_out],
         capture_output=True, text=True, env=env, timeout=900)
     assert r.returncode == 0, "client failed:\n" + r.stdout + r.stderr
 
@@ -171,7 +171,7 @@ def test_c_client_trains_bf16(tmp_path):
     path = str(tmp_path / "mlp_bf16.mxa")
     m = mx.export_train_artifact(
         net, {"data": (batch, 8)}, path, optimizer="sgd",
-        optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+        optimizer_params={"learning_rate": 0.02, "momentum": 0.9},
         platform="tpu", seed=3, compute_dtype="bfloat16")
     assert m["compute_dtype"] == "bfloat16"
     # the C signature stays float32 everywhere
@@ -184,7 +184,7 @@ def test_c_client_trains_bf16(tmp_path):
     params_out = str(tmp_path / "bf16.params")
     r = subprocess.run(
         [exe, path, str(tmp_path / "data.f32"), str(tmp_path / "labels.f32"),
-         str(batch), "300", "0.05", params_out, str(tmp_path / "l.txt")],
+         str(batch), "400", "0.02", params_out, str(tmp_path / "l.txt")],
         capture_output=True, text=True, env=env, timeout=900)
     assert r.returncode == 0, "client failed:\n" + r.stdout + r.stderr
     losses = [float(l.split()[1]) for l in open(str(tmp_path / "l.txt"))]
@@ -216,7 +216,7 @@ def test_c_client_trains_conv_bn(tmp_path):
     path = str(tmp_path / "convbn.mxa")
     m = mx.export_train_artifact(
         net, {"data": (batch, 1, 8, 8)}, path, optimizer="sgd",
-        optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+        optimizer_params={"learning_rate": 0.02, "momentum": 0.9},
         platform="tpu", seed=1)
     assert any(a["role"] == "aux" for a in m["args"])
 
@@ -230,7 +230,7 @@ def test_c_client_trains_conv_bn(tmp_path):
     params_out = str(tmp_path / "convbn.params")
     r = subprocess.run(
         [exe, path, str(tmp_path / "data.f32"), str(tmp_path / "labels.f32"),
-         str(batch), "120", "0.05", params_out, str(tmp_path / "l.txt")],
+         str(batch), "300", "0.02", params_out, str(tmp_path / "l.txt")],
         capture_output=True, text=True, env=env, timeout=900)
     assert r.returncode == 0, "client failed:\n" + r.stdout + r.stderr
     losses = [float(l.split()[1]) for l in open(str(tmp_path / "l.txt"))]
