@@ -16,6 +16,13 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <time.h>
+
+static double now_s(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
 
 typedef unsigned int mx_uint;
 typedef float mx_float;
@@ -125,7 +132,10 @@ int main(int argc, char** argv) {
   if (!lf) { fprintf(stderr, "cannot write %s\n", argv[8]); return 2; }
   float* loss_buf = loss_idx >= 0 ? (float*)malloc(loss_elems * sizeof(float))
                                   : NULL;
+  double t_rate = 0.0;
+  long rate_from = steps > 4 ? 2 : 0;  /* skip warmup/compile steps */
   for (long s = 0; s < steps; ++s) {
+    if (s == rate_from) t_rate = now_s();
     long b = s % n_batches;
     CHECK(MXTrainNativeSetInput(tr, data_name,
                                 data + b * batch_rows * data_per_row,
@@ -160,6 +170,14 @@ int main(int argc, char** argv) {
     }
   }
   fclose(lf);
+  /* steady-state step rate: the final loss fetch above synced the queue,
+   * so the window [rate_from, steps) covers completed device work */
+  if (steps > rate_from + 1) {
+    double dt = now_s() - t_rate;
+    printf("rate %.2f samples/sec (%ld steps x %ld rows in %.2fs)\n",
+           (double)(steps - rate_from) * batch_rows / dt, steps - rate_from,
+           batch_rows, dt);
+  }
   CHECK(MXTrainNativeSaveParams(tr, argv[7]));
   CHECK(MXTrainNativeFree(tr));
   printf("OK\n");
