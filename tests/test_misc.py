@@ -654,3 +654,45 @@ def test_map_metric_edge_guards():
     m2.update([mx.nd.array(np.asarray([gt], np.float32))],
               [mx.nd.array(np.asarray([det], np.float32))])
     np.testing.assert_allclose(m2.get()[1], 4.0 / 11.0, rtol=1e-6)
+
+
+def test_export_model_cli(tmp_path):
+    """tools/export_model.py: checkpoint -> predict and train artifacts from
+    the command line (docs/deployment.md workflow as one command)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc1")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+
+    def run(*args):
+        r = subprocess.run([sys.executable,
+                            os.path.join(root, "tools", "export_model.py")]
+                           + list(args), capture_output=True, text=True,
+                           env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 0, r.stderr[-800:]
+        return json.loads(r.stdout[r.stdout.index("{"):])
+
+    p = run("predict", "--prefix", prefix, "--epoch", "1",
+            "--shape", "data:2,6", "--out", str(tmp_path / "p.mxa"),
+            "--platform", "cpu")
+    assert p["inputs"] == ["data", "softmax_label"]
+    m, plen, qlen = mx.export_artifact.load_artifact_manifest(
+        str(tmp_path / "p.mxa"))
+    assert plen > 0 and qlen > 0
+
+    t = run("train", "--prefix", prefix, "--epoch", "1",
+            "--shape", "data:8,6", "--optimizer", "adam", "--lr", "0.001",
+            "--out", str(tmp_path / "t.mxa"), "--platform", "cpu", "--bf16")
+    assert t["kind"] == "train" and t["params"] == 2 \
+        and t["state_slots"] == 4 and t["compute_dtype"] == "bfloat16"
