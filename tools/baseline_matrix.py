@@ -374,12 +374,15 @@ def run_dcgan(quick=False):
 
 
 # ------------------------------------------------------------ LSTM-LM ----
-def run_lstm(quick=False, batch=32, buckets=(8, 16, 24, 32), epochs=None):
+def run_lstm(quick=False, batch=32, buckets=(8, 16, 24, 32), epochs=None,
+             max_sentences=None):
     sys.path.insert(0, os.path.join(ROOT, "examples"))
     from lstm_bucketing import stdlib_corpus
 
+    if max_sentences is None:
+        max_sentences = 1000 if quick else 4000
     sent, vocab = stdlib_corpus(vocab_size=5000,
-                                max_sentences=1000 if quick else 4000)
+                                max_sentences=max_sentences)
     it = mx.rnn.BucketSentenceIter(sent, batch, buckets=list(buckets))
     num_hidden, num_embed = 128, 128
     cell = mx.rnn.SequentialRNNCell()
@@ -460,8 +463,10 @@ def run_lstm_scaling(quick=False):
     if quick:
         combos = combos[:2]
     for batch, buckets in combos:
+        # the corpus must pack >=2 steady batches per bucket at this batch
+        # size or the rate is unmeasurable (the round-4 512-row gap)
         _, rates = run_lstm(quick=True, batch=batch, buckets=buckets,
-                            epochs=2)
+                            epochs=2, max_sentences=max(1000, batch * 12))
         rows.append((batch, len(buckets),
                      float(np.median(rates)) if rates else float("nan")))
         emit("lstm_scaling_tokens_per_sec", rows[-1][2], "tok/s",
