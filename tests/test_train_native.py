@@ -299,3 +299,47 @@ def test_native_steps_match_python_trainer(tmp_path):
     for n in tr.param_names:
         np.testing.assert_allclose(got[n], np.asarray(params[n]),
                                    atol=5e-4, rtol=5e-4)
+
+
+@needs_toolchain
+def test_corrupt_mxa_shape_mismatch_fails_cleanly(tmp_path):
+    """A crafted .mxa whose manifest shape exceeds the params-blob record
+    must fail at create time with a clear error, not read past the record
+    (the ndarray_wire.h 'corrupt files fail cleanly' invariant)."""
+    import json
+    import struct
+
+    env = _plugin_env()
+    import mxnet_tpu as mx
+    exe = _build_client(tmp_path)
+    net = _mlp()
+    path = str(tmp_path / "ok.mxa")
+    mx.export_train_artifact(
+        net, {"data": (8, 8)}, path, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1}, platform="tpu")
+
+    # rewrite the container with the first param's shape inflated 4x
+    raw = open(path, "rb").read()
+    assert raw[:8] == b"MXTPUAR1"
+    (mlen,) = struct.unpack("<Q", raw[8:16])
+    manifest = json.loads(raw[16:16 + mlen].decode())
+    first_param = next(a for a in manifest["args"] if a["role"] == "param")
+    first_param["shape"][0] *= 4
+    mjs = json.dumps(manifest, indent=1).encode()
+    bad = str(tmp_path / "bad.mxa")
+    with open(bad, "wb") as f:
+        f.write(raw[:8])
+        f.write(struct.pack("<Q", len(mjs)))
+        f.write(mjs)
+        f.write(raw[16 + mlen:])
+
+    x = np.zeros(64, np.float32)
+    x.tofile(str(tmp_path / "d.f32"))
+    x.tofile(str(tmp_path / "l.f32"))
+    r = subprocess.run(
+        [exe, bad, str(tmp_path / "d.f32"), str(tmp_path / "l.f32"),
+         "8", "1", "0.1", str(tmp_path / "o.params"),
+         str(tmp_path / "loss.txt")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode != 0
+    assert "shape mismatch" in (r.stdout + r.stderr)
