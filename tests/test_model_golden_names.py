@@ -1,0 +1,86 @@
+"""Golden layer-name tests for the model zoo builders.
+
+The arg/aux NAMES are the zoo contract: checkpoints, the pretrained-model
+interchange, and finetuning scripts all key parameters by these strings
+(reference: example/image-classification/symbols/*.py derive them from the
+layer names). The builders' INTERNALS are free to change — these tests pin
+only the name surface, via a digest over the ordered arg+aux list plus
+spot checks that document the naming conventions.
+
+If a digest changes, the builder broke checkpoint compatibility with the
+reference zoo; fix the builder, do not update the digest.
+"""
+import hashlib
+import importlib
+
+import pytest
+
+
+def _names(model, **kw):
+    mod = importlib.import_module("mxnet_tpu.models." + model)
+    s = mod.get_symbol(**kw)
+    return s.list_arguments() + s.list_auxiliary_states()
+
+
+def _digest(names):
+    return hashlib.sha256("\n".join(names).encode()).hexdigest()[:24]
+
+
+@pytest.mark.parametrize("model,kw,expect_digest,expect_count", [
+    ("resnet", dict(num_classes=1000, num_layers=50),
+     "36bd628ce939ccaab31d5f81", 257),
+    ("resnet", dict(num_classes=10, num_layers=20, image_shape="3,28,28"),
+     "68e998ca976b1602d59a801e", 102),
+    ("resnext", dict(num_classes=1000, num_layers=101, num_group=32),
+     "fdee9632fbdc0ea8a1b3b0a4", 528),
+    ("inception_v3", dict(num_classes=1000),
+     "9e4572c3f5f0caab5960f248", 474),
+])
+def test_zoo_name_digest(model, kw, expect_digest, expect_count):
+    names = _names(model, **kw)
+    assert len(names) == expect_count
+    assert _digest(names) == expect_digest
+
+
+def test_resnet_name_conventions():
+    names = set(_names("resnet", num_classes=1000, num_layers=50))
+    # stem / head
+    for n in ("conv0_weight", "bn0_gamma", "bn1_beta", "fc1_weight",
+              "fc1_bias", "bn0_moving_mean"):
+        assert n in names, n
+    # pre-activation bottleneck unit: three bn/conv pairs + projection
+    for n in ("stage1_unit1_bn1_gamma", "stage1_unit1_conv1_weight",
+              "stage1_unit1_conv2_weight", "stage1_unit1_conv3_weight",
+              "stage1_unit1_sc_weight", "stage4_unit3_bn3_beta"):
+        assert n in names, n
+    # convs are bias-free
+    assert "stage1_unit1_conv1_bias" not in names
+
+
+def test_resnext_name_conventions():
+    names = set(_names("resnext", num_classes=1000, num_layers=101,
+                       num_group=32))
+    for n in ("bn_data_gamma", "stage1_unit1_conv2_weight",
+              "stage1_unit1_bn3_gamma", "stage1_unit1_sc_weight",
+              "stage1_unit1_sc_bn_gamma", "stage3_unit23_conv1_weight"):
+        assert n in names, n
+
+
+def test_inception_v3_name_conventions():
+    names = set(_names("inception_v3", num_classes=1000))
+    for n in (
+        # stem
+        "conv_conv2d_weight", "conv_batchnorm_gamma", "conv_4_conv2d_weight",
+        # A block towers
+        "mixed_conv_conv2d_weight", "mixed_tower_conv_1_conv2d_weight",
+        "mixed_tower_1_conv_2_conv2d_weight",
+        "mixed_tower_2_conv_conv2d_weight",
+        # C block quadruple-7 tower
+        "mixed_4_tower_1_conv_4_conv2d_weight",
+        # E block forked 3-factorizations
+        "mixed_9_tower_mixed_conv_conv2d_weight",
+        "mixed_10_tower_1_mixed_conv_1_conv2d_weight",
+        # head
+        "fc1_weight",
+    ):
+        assert n in names, n
