@@ -1,0 +1,73 @@
+"""Installed-package smoke: run from OUTSIDE the checkout against a wheel
+pip-installed into a clean venv (ci/run_tests.sh `package` stage; the
+reference equivalent is installing tools/pip_package and `import mxnet`).
+
+Asserts the import resolves to the installed location (not the checkout),
+the prebuilt native runtime loads from the wheel, and a tiny Module.fit
+converges — the end-to-end user contract from an installation.
+"""
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    forbidden = os.environ.get("MXTPU_CHECKOUT")
+    import mxnet_tpu as mx
+
+    loc = os.path.abspath(mx.__file__)
+    # must come from THIS venv — not the checkout, and not an mxnet_tpu
+    # that happens to be installed in the invoking interpreter (PYTHONPATH
+    # is searched before the venv's site-packages)
+    assert loc.startswith(os.path.abspath(sys.prefix) + os.sep), (
+        "import resolved outside the venv under test: %s" % loc)
+    if forbidden:
+        assert not loc.startswith(os.path.abspath(forbidden) + os.sep), (
+            "import resolved to the checkout, not the installed wheel: %s"
+            % loc)
+    print("mxnet_tpu %s from %s" % (mx.__version__, mx.__file__))
+
+    # packaging metadata agrees with the package
+    try:
+        from importlib.metadata import version
+        assert version("mxnet-tpu") == mx.__version__
+    except ModuleNotFoundError:
+        pass
+
+    # prebuilt native runtime loads from the installed tree
+    from mxnet_tpu import _native
+    lib = _native.get_lib()
+    assert lib is not None, "native runtime missing from the wheel"
+    print("native runtime loaded:", _native._LIB_PATH)
+
+    # the deployment runtime shipped too
+    pjrt = os.path.join(os.path.dirname(_native._LIB_PATH),
+                        "libmxtpu_predict_native.so")
+    assert os.path.exists(pjrt), pjrt
+
+    # a tiny end-to-end fit
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 8).astype(np.float32)
+    w = rs.rand(8, 3).astype(np.float32)
+    y = (x @ w).argmax(axis=1).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(net, label_names=["softmax_label"], context=mx.cpu())
+    metric = mx.metric.Accuracy()
+    mod.fit(it, num_epoch=20, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric=metric, initializer=mx.init.Xavier())
+    it.reset()
+    mod.score(it, metric)
+    acc = metric.get()[1]
+    assert acc > 0.8, "installed-package fit scored %.3f" % acc
+    print("package smoke OK (train acc %.3f)" % acc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,6 +122,33 @@ run_bench() {
   python bench.py
 }
 
+run_package() {
+  # installable-package leg (reference: python/setup.py + tools/pip_package):
+  # build a wheel (with the prebuilt native libs), pip-install it into a
+  # clean venv, and run the import+fit smoke from OUTSIDE the checkout.
+  # jax/numpy come from the invoking interpreter's site-packages via
+  # PYTHONPATH (no network in CI; mxnet_tpu is NOT installed there, so the
+  # wheel still proves itself); --no-deps proves the wheel, not resolution.
+  local workdir repo sitepkgs
+  repo="$PWD"
+  workdir=$(mktemp -d)
+  # set -e exits this function on any failure: clean the workdir (a full
+  # venv + wheel) either way
+  # shellcheck disable=SC2064
+  trap "rm -rf '$workdir'" RETURN
+  # purelib AND platlib: numpy/jaxlib are C extensions and land in platlib
+  # on split-lib systems
+  sitepkgs=$(python -c "import sysconfig; p = sysconfig.get_paths(); \
+print(':'.join(dict.fromkeys([p['purelib'], p['platlib']])))")
+  python -m pip wheel . --no-deps --no-build-isolation -w "$workdir/dist"
+  python -m venv "$workdir/venv"
+  "$workdir/venv/bin/pip" install --no-deps --force-reinstall -q \
+    "$workdir"/dist/mxnet_tpu-*.whl
+  (cd "$workdir" \
+     && MXTPU_CHECKOUT="$repo" JAX_PLATFORMS=cpu PYTHONPATH="$sitepkgs" \
+        "$workdir/venv/bin/python" "$repo/ci/package_smoke.py")
+}
+
 run_tpu() {
   # the device-consistency sweep (reference: tests/python/gpu/): the
   # operator/module/model/attention/rnn/core suites re-executed under the
@@ -200,9 +227,10 @@ case "$stage" in
   bench) run_bench ;;
   tpu) run_tpu ;;
   examples) run_examples ;;
-  all) run_native; run_predict; run_predict_native; run_entry;
+  package) run_package ;;
+  all) run_native; run_predict; run_predict_native; run_entry; run_package;
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
                 --ignore=tests/test_train_native.py ;;
-  *) echo "unknown stage: $stage (unit|native|predict|predict_native|entry|bench|tpu|examples|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
 esac
