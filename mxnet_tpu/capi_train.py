@@ -445,22 +445,50 @@ def _c_list_all_ops():
 
 
 def _c_imperative_invoke(op_name, blobs, shapes, dtypes, param_keys,
-                         param_vals):
+                         param_vals, in_ids=None):
     """Run one op imperatively on host blobs (reference: MXImperativeInvoke,
-    c_api_ndarray.cc:324). Returns (out_blobs, out_shapes, out_dtypes)."""
+    c_api_ndarray.cc:324). Returns (out_blobs, out_shapes, out_dtypes).
+
+    ``in_ids`` carries the C handle ids: inputs known to the autograd
+    session (marked variables, adopted outputs) are fed as their LIVE
+    python arrays so the tape stays connected — marked variables get their
+    value re-synced from the C bytes first (the C side may have written the
+    handle since mark time). When recording, outputs are stashed for
+    _c_autograd_adopt."""
     from . import ndarray as nd
     from .base import _DTYPE_MX_TO_NP, _DTYPE_NP_TO_MX
+    from .contrib import autograd
 
+    global _AUTOGRAD_PENDING
+    # a failed previous invoke (error after the python call) must not leave
+    # its outputs around for THIS invoke's adoption
+    _AUTOGRAD_PENDING = []
+    if in_ids is None:
+        in_ids = [0] * len(blobs)
     arrs = []
-    for b, s, t in zip(blobs, shapes, dtypes):
-        dt = np.dtype(_DTYPE_MX_TO_NP[int(t)])
-        arr = np.frombuffer(bytes(b), dtype=dt).reshape(
-            [int(x) for x in s])
-        arrs.append(nd.array(arr, dtype=dt))
+    for b, s, t, hid in zip(blobs, shapes, dtypes, in_ids):
+        live = _AUTOGRAD_ARRAYS.get(int(hid))
+        if live is not None:
+            if int(hid) in _AUTOGRAD_MARKED:
+                dt = np.dtype(_DTYPE_MX_TO_NP[int(t)])
+                cur = np.frombuffer(bytes(b), dtype=dt).reshape(
+                    [int(x) for x in s])
+                if cur.shape == tuple(live.shape):
+                    live._set_data(np.asarray(cur, dtype=dt))
+            arrs.append(live)
+            continue
+        if len(b) == 0 and any(int(x) for x in s):
+            # the C side skipped the bytes expecting a live tape array we
+            # no longer hold — fail loudly rather than compute on garbage
+            from .base import MXNetError
+            raise MXNetError("stale autograd handle fed to %s" % op_name)
+        arrs.append(_from_blob(b, s, t))
     attrs = {k: v for k, v in zip(param_keys, param_vals)}
     res = nd.imperative_invoke(op_name, arrs, attrs)
     if not isinstance(res, (list, tuple)):
         res = [res]
+    if autograd.is_recording():
+        _AUTOGRAD_PENDING = list(res)
     out_blobs, out_shapes, out_dtypes = [], [], []
     for r in res:
         a = r.asnumpy()
@@ -653,3 +681,115 @@ def _c_exec_outputs(cexec):
         a = np.ascontiguousarray(o.asnumpy().astype(np.float32))
         ret.append((a.tobytes(), [int(x) for x in a.shape]))
     return ret
+
+
+# ---- imperative autograd session (reference: MXAutogradSetIsTraining /
+# MXAutogradMarkVariables / MXAutogradComputeGradient, c_api.h:549-601 over
+# src/ndarray/autograd.cc). The C boundary marshals host blobs, so the
+# session keeps the LIVE python NDArray for every C handle the tape must
+# see: marked variables (value re-synced from the C bytes at each invoke)
+# and recorded op outputs (adopted under their C handle ids right after
+# MXImperativeInvoke creates the handles).
+
+_AUTOGRAD_ARRAYS = {}   # C handle id -> live python NDArray on the tape
+_AUTOGRAD_MARKED = {}   # C var handle id -> (var, grad, grad handle id, req)
+_AUTOGRAD_PENDING = []  # outputs of the last recorded invoke, pre-adoption
+
+
+def _from_blob(blob, shape, dtype):
+    from . import ndarray as nd
+    from .base import _DTYPE_MX_TO_NP
+
+    dt = np.dtype(_DTYPE_MX_TO_NP[int(dtype)])
+    a = np.frombuffer(bytes(blob), dtype=dt).reshape([int(x) for x in shape])
+    return nd.array(a, dtype=dt)
+
+
+def _c_autograd_set_is_training(flag):
+    from .contrib import autograd
+
+    return 1 if autograd.set_is_training(bool(flag)) else 0
+
+
+def _c_autograd_mark_variables(var_ids, blobs, shapes, dtypes, reqs,
+                               grad_ids, grad_blobs):
+    """reqs use the reference OpReqType enum: 0 null / 1 write /
+    2 write-inplace (treated as write) / 3 add."""
+    from .contrib import autograd
+
+    req_name = {0: "null", 1: "write", 2: "write", 3: "add"}
+    variables, gradients, grad_reqs = [], [], []
+    for vid, b, s, t, r, gid, gb in zip(var_ids, blobs, shapes, dtypes,
+                                        reqs, grad_ids, grad_blobs):
+        var = _from_blob(b, s, t)
+        grad = _from_blob(gb, s, t)  # grads share the variable's shape/dtype
+        req = req_name[int(r)]
+        _AUTOGRAD_ARRAYS[int(vid)] = var
+        _AUTOGRAD_MARKED[int(vid)] = (var, grad, int(gid), req)
+        variables.append(var)
+        gradients.append(grad)
+        grad_reqs.append(req)
+    autograd.mark_variables(variables, gradients, grad_reqs)
+
+
+def _c_autograd_adopt(out_ids):
+    """Bind the C handle ids MXImperativeInvoke just created to the python
+    outputs of the recorded invoke (same order). Returns how many were
+    adopted (0 when the invoke was not recorded)."""
+    global _AUTOGRAD_PENDING
+    n = 0
+    for hid, arr in zip(out_ids, _AUTOGRAD_PENDING):
+        _AUTOGRAD_ARRAYS[int(hid)] = arr
+        n += 1
+    _AUTOGRAD_PENDING = []
+    return n
+
+
+def _c_autograd_compute_gradient(head_ids):
+    """Replay the tape, then return the marked gradients as
+    [(grad C handle id, f-contiguous bytes, shape, mx dtype), ...] for the
+    C side to write back into the caller's grad handles."""
+    from .base import _DTYPE_NP_TO_MX, MXNetError
+    from .contrib import autograd
+
+    heads = []
+    for hid in head_ids:
+        arr = _AUTOGRAD_ARRAYS.get(int(hid))
+        if arr is None:
+            raise MXNetError(
+                "MXAutogradComputeGradient: output handle was not produced "
+                "by a recorded MXImperativeInvoke (is training on?)")
+        heads.append(arr)
+    autograd.compute_gradient(heads)
+    ret = []
+    for vid, (var, grad, gid, req) in _AUTOGRAD_MARKED.items():
+        if req == "null":  # OpReqType null: never write the caller's handle
+            continue
+        g = grad.asnumpy()
+        ret.append((gid, np.ascontiguousarray(g).tobytes(),
+                    [int(x) for x in g.shape],
+                    int(_DTYPE_NP_TO_MX[np.dtype(g.dtype)])))
+    # drop adopted intermediates (their tape is consumed); keep marked vars
+    # live so another recorded forward can run against them
+    _AUTOGRAD_ARRAYS.clear()
+    _AUTOGRAD_ARRAYS.update(
+        {vid: e[0] for vid, e in _AUTOGRAD_MARKED.items()})
+    return ret
+
+
+def _c_autograd_forget(hid):
+    """MXNDArrayFree purge: a freed handle's id must not resurrect a stale
+    array when the allocator recycles the address. Dropping a marked
+    variable's var OR grad handle unmarks it."""
+    from .contrib import autograd
+
+    hid = int(hid)
+    _AUTOGRAD_ARRAYS.pop(hid, None)
+    entry = _AUTOGRAD_MARKED.pop(hid, None)
+    if entry is not None:
+        autograd._MARKED.pop(id(entry[0]), None)
+        return
+    for vid, (var, _grad, gid, _req) in list(_AUTOGRAD_MARKED.items()):
+        if gid == hid:
+            del _AUTOGRAD_MARKED[vid]
+            autograd._MARKED.pop(id(var), None)

@@ -136,7 +136,14 @@ MXNET_DLL int MXNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
   return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0, out);
 }
 
+// optional observer for handle teardown: the autograd session in
+// c_api_train.cc installs itself here so freed handles are purged from its
+// id->array maps (a recycled heap address must not resurrect a stale tape
+// entry). Null when that family is unused or not linked in.
+void (*mxtpu_ndarray_free_hook)(void*) = nullptr;
+
 MXNET_DLL int MXNDArrayFree(NDArrayHandle handle) {
+  if (mxtpu_ndarray_free_hook) mxtpu_ndarray_free_hook(handle);
   delete static_cast<CArray*>(handle);
   return 0;
 }

@@ -12,8 +12,10 @@
 // tests/test_c_train.py.
 #include <Python.h>
 
+#include <atomic>
 #include <cstring>
 #include <string>
+#include <unordered_set>
 #include <vector>
 
 // the public header declares every exported signature — including it makes
@@ -29,6 +31,10 @@ extern thread_local std::string g_last_error_train;
 thread_local std::string g_last_error_train;
 
 void mxtpu_promote_libpython();  // c_predict_api.cc (libpython RTLD_GLOBAL)
+
+// c_api_ndarray.cc invokes this (when set) for every MXNDArrayFree; the
+// autograd session installs its purge callback into it
+extern void (*mxtpu_ndarray_free_hook)(void*);
 
 // pure-C++ API files (c_api_recordio.cc) report through the train-error
 // channel this header documents, without touching Python
@@ -1046,6 +1052,42 @@ MXNET_DLL int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
   return 0;
 }
 
+namespace {
+
+// C-side mirror of the autograd session's handle ids (python: capi_train's
+// _AUTOGRAD_* maps). All access is under the GIL (every entry point takes
+// GilT), which serializes it.
+std::unordered_set<void*>& autograd_adopted() {
+  static std::unordered_set<void*> s;
+  return s;
+}
+std::unordered_set<void*>& autograd_marked() {
+  static std::unordered_set<void*> s;
+  return s;
+}
+std::atomic<bool> g_autograd_used{false};
+
+// purge a freed handle from the session (installed as the NDArrayFree hook
+// below): a recycled heap address must not resurrect a stale tape array
+void autograd_on_free(void* handle) {
+  if (!g_autograd_used.load(std::memory_order_acquire)) return;
+  GilT gil;
+  autograd_adopted().erase(handle);
+  autograd_marked().erase(handle);
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_autograd_forget",
+                                      "O&", PyLong_FromVoidPtr, handle);
+  if (!res)
+    PyErr_Clear();  // teardown path: never surface errors from Free
+  else
+    Py_DECREF(res);
+}
+
+struct InstallFreeHook {
+  InstallFreeHook() { mxtpu_ndarray_free_hook = autograd_on_free; }
+} g_install_free_hook;
+
+}  // namespace
+
 MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
                                  NDArrayHandle* inputs, int* num_outputs,
                                  NDArrayHandle** outputs, int num_params,
@@ -1062,10 +1104,17 @@ MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
   PyObject* dtypes = PyList_New(num_inputs);
   for (int i = 0; i < num_inputs; ++i) {
     auto* a = static_cast<CArray*>(inputs[i]);
+    // adopted (non-marked) handles are fed as their live python tape
+    // arrays — the bytes would be discarded, so skip the copy entirely.
+    // Marked variables DO marshal: their current bytes re-sync the value.
+    bool skip_bytes = autograd_adopted().count(inputs[i]) &&
+                      !autograd_marked().count(inputs[i]);
     PyList_SetItem(blobs, i,
-                   PyBytes_FromStringAndSize(
-                       reinterpret_cast<const char*>(a->data.data()),
-                       a->data.size()));
+                   skip_bytes
+                       ? PyBytes_FromStringAndSize(nullptr, 0)
+                       : PyBytes_FromStringAndSize(
+                             reinterpret_cast<const char*>(a->data.data()),
+                             a->data.size()));
     PyObject* dims = PyList_New(a->shape.size());
     for (size_t j = 0; j < a->shape.size(); ++j)
       PyList_SetItem(dims, j, PyLong_FromUnsignedLong(a->shape[j]));
@@ -1078,14 +1127,20 @@ MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
     PyList_SetItem(pkeys, i, PyUnicode_FromString(param_keys[i]));
     PyList_SetItem(pvals, i, PyUnicode_FromString(param_vals[i]));
   }
+  // handle ids let the autograd session substitute live tape arrays for
+  // marked/recorded inputs (see capi_train._c_imperative_invoke)
+  PyObject* in_ids = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i)
+    PyList_SetItem(in_ids, i, PyLong_FromVoidPtr(inputs[i]));
   PyObject* res = PyObject_CallMethod(
-      train_module(), "_c_imperative_invoke", "sOOOOO", op_name.c_str(),
-      blobs, shapes, dtypes, pkeys, pvals);
+      train_module(), "_c_imperative_invoke", "sOOOOOO", op_name.c_str(),
+      blobs, shapes, dtypes, pkeys, pvals, in_ids);
   Py_DECREF(blobs);
   Py_DECREF(shapes);
   Py_DECREF(dtypes);
   Py_DECREF(pkeys);
   Py_DECREF(pvals);
+  Py_DECREF(in_ids);
   if (!res) {
     set_err();
     return fail();
@@ -1139,6 +1194,150 @@ MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
     *num_outputs = static_cast<int>(n_out);
     *outputs = out_handles.data();
   }
+  // bind the (now known) output handle ids to the recorded python outputs;
+  // a no-op unless this invoke was recorded by the autograd session
+  PyObject* oids = PyList_New(n_out);
+  for (Py_ssize_t i = 0; i < n_out; ++i)
+    PyList_SetItem(
+        oids, i,
+        PyLong_FromVoidPtr(caller_provided ? (*outputs)[i]
+                                           : out_handles[i]));
+  PyObject* ares =
+      PyObject_CallMethod(train_module(), "_c_autograd_adopt", "O", oids);
+  Py_DECREF(oids);
+  if (!ares) {
+    set_err();
+    return fail();
+  }
+  // helper returns how many it adopted (0 when not recording): mirror the
+  // now-live ids so later invokes skip marshaling their bytes
+  if (PyLong_AsLong(ares) == n_out && n_out > 0)
+    for (Py_ssize_t i = 0; i < n_out; ++i)
+      autograd_adopted().insert(caller_provided ? (*outputs)[i]
+                                                : out_handles[i]);
+  Py_DECREF(ares);
+  return 0;
+}
+
+// ---- imperative autograd (reference: c_api.h:549-601 MXAutogradSetIsTraining
+// / MarkVariables / ComputeGradient over src/ndarray/autograd.cc; here the
+// tape + jax.vjp replay in mxnet_tpu.contrib.autograd) ----------------------
+
+MXNET_DLL int MXAutogradSetIsTraining(int is_training, int* prev) {
+  GilT gil;
+  if (is_training) g_autograd_used.store(true, std::memory_order_release);
+  PyObject* res = PyObject_CallMethod(
+      train_module(), "_c_autograd_set_is_training", "i", is_training);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXAutogradMarkVariables(mx_uint num_var,
+                                      NDArrayHandle* var_handles,
+                                      mx_uint* reqs_array,
+                                      NDArrayHandle* grad_handles) {
+  GilT gil;
+  g_autograd_used.store(true, std::memory_order_release);
+  for (mx_uint i = 0; i < num_var; ++i)
+    autograd_marked().insert(var_handles[i]);
+  PyObject* ids = PyList_New(num_var);
+  PyObject* blobs = PyList_New(num_var);
+  PyObject* shapes = PyList_New(num_var);
+  PyObject* dtypes = PyList_New(num_var);
+  PyObject* reqs = PyList_New(num_var);
+  PyObject* gids = PyList_New(num_var);
+  PyObject* gblobs = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i) {
+    auto* v = static_cast<CArray*>(var_handles[i]);
+    auto* g = static_cast<CArray*>(grad_handles[i]);
+    PyList_SetItem(ids, i, PyLong_FromVoidPtr(var_handles[i]));
+    PyList_SetItem(blobs, i,
+                   PyBytes_FromStringAndSize(
+                       reinterpret_cast<const char*>(v->data.data()),
+                       v->data.size()));
+    PyObject* dims = PyList_New(v->shape.size());
+    for (size_t j = 0; j < v->shape.size(); ++j)
+      PyList_SetItem(dims, j, PyLong_FromUnsignedLong(v->shape[j]));
+    PyList_SetItem(shapes, i, dims);
+    PyList_SetItem(dtypes, i, PyLong_FromLong(v->dtype));
+    PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+    PyList_SetItem(gids, i, PyLong_FromVoidPtr(grad_handles[i]));
+    PyList_SetItem(gblobs, i,
+                   PyBytes_FromStringAndSize(
+                       reinterpret_cast<const char*>(g->data.data()),
+                       g->data.size()));
+  }
+  PyObject* res = PyObject_CallMethod(
+      train_module(), "_c_autograd_mark_variables", "OOOOOOO", ids, blobs,
+      shapes, dtypes, reqs, gids, gblobs);
+  Py_DECREF(ids);
+  Py_DECREF(blobs);
+  Py_DECREF(shapes);
+  Py_DECREF(dtypes);
+  Py_DECREF(reqs);
+  Py_DECREF(gids);
+  Py_DECREF(gblobs);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+MXNET_DLL int MXAutogradComputeGradient(mx_uint num_output,
+                                        NDArrayHandle* output_handles) {
+  GilT gil;
+  // the python session drops adopted intermediates after backward (marked
+  // variables stay live) — mirror that here
+  autograd_adopted().clear();
+  PyObject* heads = PyList_New(num_output);
+  for (mx_uint i = 0; i < num_output; ++i)
+    PyList_SetItem(heads, i, PyLong_FromVoidPtr(output_handles[i]));
+  PyObject* res = PyObject_CallMethod(
+      train_module(), "_c_autograd_compute_gradient", "O", heads);
+  Py_DECREF(heads);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  // [(grad handle id, bytes, shape, dtype), ...] -> write into the grad
+  // handles the caller registered via MXAutogradMarkVariables
+  if (!PyList_Check(res)) {
+    Py_DECREF(res);
+    mxtpu_set_train_error("autograd: helper did not return a list");
+    return fail();
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+    PyObject* row = PyList_GetItem(res, i);
+    PyObject *gid = nullptr, *blob = nullptr, *shp = nullptr, *dt = nullptr;
+    if (!PyArg_ParseTuple(row, "OOOO", &gid, &blob, &shp, &dt)) {
+      Py_DECREF(res);
+      set_err();
+      return fail();
+    }
+    auto* g = static_cast<CArray*>(PyLong_AsVoidPtr(gid));
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    if (!g || PyBytes_AsStringAndSize(blob, &buf, &len) != 0) {
+      Py_DECREF(res);
+      set_err();
+      return fail();
+    }
+    g->shape.clear();
+    for (Py_ssize_t j = 0; j < PyList_Size(shp); ++j)
+      g->shape.push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyList_GetItem(shp, j))));
+    g->dtype = static_cast<int>(PyLong_AsLong(dt));
+    g->data.assign(buf, buf + len);
+    g->none = false;
+  }
+  Py_DECREF(res);
   return 0;
 }
 

@@ -112,6 +112,20 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
                        NDArrayHandle* inputs, int* num_outputs,
                        NDArrayHandle** outputs, int num_params,
                        const char** param_keys, const char** param_vals);
+/* ---- Imperative autograd (reference: c_api.h MXAutogradSetIsTraining
+ * :549, MXAutogradMarkVariables :558, MXAutogradComputeGradient :570 over
+ * src/ndarray/autograd.cc; here over mxnet_tpu.contrib.autograd's tape —
+ * the replay differentiates as ONE jitted XLA program). Flow: set training
+ * on, mark variable handles with grad handles (reqs use the OpReqType
+ * enum: 0 null / 1 write / 3 add), run ops through MXImperativeInvoke,
+ * then ComputeGradient on the loss handle writes into the grad handles.
+ * A marked variable's CURRENT bytes are read at each invoke, so updating
+ * it via MXNDArraySyncCopyFromCPU between steps is seen. ---- */
+int MXAutogradSetIsTraining(int is_training, int* prev);
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle* var_handles,
+                            mx_uint* reqs_array, NDArrayHandle* grad_handles);
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle* output_handles);
 /* Shape inference (reference signature, CSR shape args like simple_bind;
  * keys==NULL means positional). Unknown shapes come back with ndim 0;
  * *complete is 1 when every shape is fully known. Returned tables are

@@ -1,0 +1,148 @@
+/* Exercises the imperative autograd C API from pure C (reference:
+ * c_api.h MXAutogradSetIsTraining :549, MXAutogradMarkVariables :558,
+ * MXAutogradComputeGradient :570 over src/ndarray/autograd.cc; the python
+ * reference flow is tests/python/unittest/test_autograd.py).
+ *
+ * Flow: mark x (2x3) with grad gx, record z = sum(square(x)) through
+ * MXImperativeInvoke, ComputeGradient, check gx == 2x. Then update x's
+ * bytes and run a second recorded forward/backward to prove the tape
+ * resets and the marked variable's current value is used.
+ * Exit 0 only if every check passes. */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef unsigned int mx_uint;
+typedef void* NDArrayHandle;
+typedef void* AtomicSymbolCreator;
+
+extern const char* MXTrainGetLastError(void);
+extern int MXListAllOpNames(mx_uint*, const char***);
+extern int MXSymbolListAtomicSymbolCreators(mx_uint*, AtomicSymbolCreator**);
+extern int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator, const char**);
+extern int MXImperativeInvoke(AtomicSymbolCreator, int, NDArrayHandle*, int*,
+                              NDArrayHandle**, int, const char**,
+                              const char**);
+extern int MXNDArrayCreateEx(const mx_uint*, mx_uint, int, int, int, int,
+                             NDArrayHandle*);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle, const void*, size_t);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle, void*, size_t);
+extern int MXNDArrayFree(NDArrayHandle);
+extern int MXAutogradSetIsTraining(int, int*);
+extern int MXAutogradMarkVariables(mx_uint, NDArrayHandle*, mx_uint*,
+                                   NDArrayHandle*);
+extern int MXAutogradComputeGradient(mx_uint, NDArrayHandle*);
+
+#define CHECK0(call)                                                  \
+  do {                                                                \
+    if ((call) != 0) {                                                \
+      fprintf(stderr, "FAIL %s: %s\n", #call, MXTrainGetLastError()); \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+static AtomicSymbolCreator find_creator(const char* name) {
+  mx_uint n = 0;
+  AtomicSymbolCreator* creators = NULL;
+  if (MXSymbolListAtomicSymbolCreators(&n, &creators) != 0) return NULL;
+  for (mx_uint i = 0; i < n; ++i) {
+    const char* cname = NULL;
+    if (MXSymbolGetAtomicSymbolName(creators[i], &cname) == 0 &&
+        strcmp(cname, name) == 0)
+      return creators[i];
+  }
+  return NULL;
+}
+
+/* one recorded forward z = sum(square(x)) followed by backward into gx */
+static int forward_backward(AtomicSymbolCreator square,
+                            AtomicSymbolCreator sum, NDArrayHandle x) {
+  int n_out = 0;
+  NDArrayHandle* outs = NULL;
+  NDArrayHandle ins[1] = {x};
+  CHECK0(MXImperativeInvoke(square, 1, ins, &n_out, &outs, 0, NULL, NULL));
+  if (n_out != 1) { fprintf(stderr, "square outputs %d\n", n_out); return 1; }
+  NDArrayHandle y = outs[0];
+  int n_out2 = 0;
+  NDArrayHandle* outs2 = NULL;
+  NDArrayHandle ins2[1] = {y};
+  CHECK0(MXImperativeInvoke(sum, 1, ins2, &n_out2, &outs2, 0, NULL, NULL));
+  if (n_out2 != 1) { fprintf(stderr, "sum outputs %d\n", n_out2); return 1; }
+  NDArrayHandle z = outs2[0];
+  CHECK0(MXAutogradComputeGradient(1, &z));
+  CHECK0(MXNDArrayFree(y));
+  CHECK0(MXNDArrayFree(z));
+  return 0;
+}
+
+int main(void) {
+  AtomicSymbolCreator square = find_creator("square");
+  AtomicSymbolCreator sum = find_creator("sum");
+  if (!square || !sum) { fprintf(stderr, "creators missing\n"); return 1; }
+
+  int prev = -1;
+  CHECK0(MXAutogradSetIsTraining(1, &prev));
+  if (prev != 0) { fprintf(stderr, "prev training was %d\n", prev); return 1; }
+
+  /* x = [[1..6]] (2x3), gx zeroed */
+  mx_uint shape[2] = {2, 3};
+  NDArrayHandle x = NULL, gx = NULL;
+  CHECK0(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &x));
+  CHECK0(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &gx));
+  float xv[6] = {1, 2, 3, 4, 5, 6}, zeros[6] = {0};
+  CHECK0(MXNDArraySyncCopyFromCPU(x, xv, 6));
+  CHECK0(MXNDArraySyncCopyFromCPU(gx, zeros, 6));
+
+  mx_uint req = 1; /* write */
+  CHECK0(MXAutogradMarkVariables(1, &x, &req, &gx));
+
+  if (forward_backward(square, sum, x) != 0) return 1;
+  float gv[6];
+  CHECK0(MXNDArraySyncCopyToCPU(gx, gv, 6));
+  for (int i = 0; i < 6; ++i)
+    if (fabsf(gv[i] - 2 * xv[i]) > 1e-5f) {
+      fprintf(stderr, "grad[%d] = %g want %g\n", i, gv[i], 2 * xv[i]);
+      return 1;
+    }
+
+  /* second step at a new x value: the session must read the CURRENT bytes
+   * and the first backward must have consumed the old tape */
+  float xv2[6] = {-3, 0.5f, 7, -1, 2, 4};
+  CHECK0(MXNDArraySyncCopyFromCPU(x, xv2, 6));
+  if (forward_backward(square, sum, x) != 0) return 1;
+  CHECK0(MXNDArraySyncCopyToCPU(gx, gv, 6));
+  for (int i = 0; i < 6; ++i)
+    if (fabsf(gv[i] - 2 * xv2[i]) > 1e-5f) {
+      fprintf(stderr, "step2 grad[%d] = %g want %g\n", i, gv[i], 2 * xv2[i]);
+      return 1;
+    }
+
+  /* req=null (OpReqType 0): the grad handle must NOT be written */
+  NDArrayHandle x2 = NULL, gx2 = NULL;
+  CHECK0(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &x2));
+  CHECK0(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &gx2));
+  float sentinel[6] = {9, 9, 9, 9, 9, 9};
+  CHECK0(MXNDArraySyncCopyFromCPU(x2, xv, 6));
+  CHECK0(MXNDArraySyncCopyFromCPU(gx2, sentinel, 6));
+  /* free the old pair first: freed handles must drop out of the session */
+  CHECK0(MXNDArrayFree(x));
+  CHECK0(MXNDArrayFree(gx));
+  mx_uint req_null = 0;
+  CHECK0(MXAutogradMarkVariables(1, &x2, &req_null, &gx2));
+  if (forward_backward(square, sum, x2) != 0) return 1;
+  CHECK0(MXNDArraySyncCopyToCPU(gx2, gv, 6));
+  for (int i = 0; i < 6; ++i)
+    if (gv[i] != 9) {
+      fprintf(stderr, "req=null grad[%d] written: %g\n", i, gv[i]);
+      return 1;
+    }
+
+  CHECK0(MXAutogradSetIsTraining(0, &prev));
+  if (prev != 1) { fprintf(stderr, "prev training was %d\n", prev); return 1; }
+
+  CHECK0(MXNDArrayFree(x2));
+  CHECK0(MXNDArrayFree(gx2));
+  printf("OK autograd c api\n");
+  return 0;
+}

@@ -64,16 +64,8 @@ MAPPED = {
                        "variants differ only in how arrays arrive)"),
     "MXExecutorBindX": ("equivalent", "see `MXExecutorBind`"),
     "MXExecutorBindEX": ("equivalent", "see `MXExecutorBind`"),
-    # autograd C family: the recording surface is python contrib.autograd;
-    # C clients compute gradients through the executor
-    "MXAutogradSetIsTraining": (
-        "descoped",
-        "imperative autograd recording is the python "
-        "`mx.contrib.autograd` surface; C gradients flow through "
-        "`MXExecutorBackward`"),
-    "MXAutogradMarkVariables": ("descoped", "see `MXAutogradSetIsTraining`"),
-    "MXAutogradComputeGradient": ("descoped",
-                                  "see `MXAutogradSetIsTraining`"),
+# (round 5: the MXAutograd* family moved from descoped to implemented —
+# c_api_train.cc binds the contrib.autograd tape; tests/test_c_autograd.py)
     "MXSetNumOMPThreads": (
         "descoped",
         "host threading belongs to XLA's thread pools (configure via "
