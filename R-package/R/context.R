@@ -1,0 +1,34 @@
+# Device contexts (reference: R-package/R/context.R — mx.cpu/mx.gpu
+# constructors and the mutable default context).
+
+.MXContextEnv <- new.env(parent = emptyenv())
+.MXContextEnv$default <- NULL
+
+mx.context <- function(device, device.id = 0) {
+  structure(list(device = device, device_id = device.id),
+            class = "MXContext")
+}
+
+#' Create a CPU context.
+#' @export
+mx.cpu <- function(dev.id = 0) mx.context("cpu", dev.id)
+
+#' Create a TPU context (the accelerator slot the reference's mx.gpu fills).
+#' @export
+mx.tpu <- function(dev.id = 0) mx.context("tpu", dev.id)
+
+#' Alias kept for reference-script compatibility: mx.gpu() returns the
+#' accelerator context (TPU here).
+#' @export
+mx.gpu <- function(dev.id = 0) mx.tpu(dev.id)
+
+#' @export
+is.mx.context <- function(x) inherits(x, "MXContext")
+
+#' Default context used when ctx is not specified (reference:
+#' mx.ctx.default with an optional new default).
+#' @export
+mx.ctx.default <- function(new = NULL) {
+  if (!is.null(new)) .MXContextEnv$default <- new
+  if (is.null(.MXContextEnv$default)) mx.cpu() else .MXContextEnv$default
+}

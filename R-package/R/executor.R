@@ -2,15 +2,21 @@
 # mx.simple.bind / mx.exec.forward / mx.exec.backward over the C API).
 
 #' Bind a symbol into an executor. Shapes are passed for the DATA/LABEL
-#' inputs; parameter shapes are inferred (the C side runs simple_bind).
-#'   ex <- mx.simple.bind(sym, ctx = "cpu", grad.req = "write",
-#'                        data = c(32, 10), softmax_label = c(32))
+#' inputs in the R (column-major, reversed) convention — a (features,
+#' batch) R matrix binds as data = c(10, 32); parameter shapes are
+#' inferred (the C side runs simple_bind).
+#'   ex <- mx.simple.bind(sym, ctx = mx.cpu(), grad.req = "write",
+#'                        data = c(10, 32), softmax_label = c(32))
 mx.simple.bind <- function(symbol, ctx = "cpu", dev.id = 0,
                            grad.req = "write", ...) {
   shapes <- list(...)
+  if (is.mx.context(ctx)) {
+    dev.id <- ctx$device_id
+    ctx <- ctx$device
+  }
   handle <- .Call("RMX_simple_bind", symbol$handle, ctx,
                   as.integer(dev.id), names(shapes),
-                  lapply(shapes, as.integer), grad.req)
+                  lapply(shapes, function(s) rev(as.integer(s))), grad.req)
   structure(list(handle = handle, symbol = symbol,
                  input.names = names(shapes)),
             class = "MXExecutor")
@@ -20,6 +26,11 @@ mx.simple.bind <- function(symbol, ctx = "cpu", dev.id = 0,
 #' multi-dim values must already be flattened row-major — mx.nd.flatten).
 mx.exec.set.arg <- function(exec, name, value) {
   invisible(.Call("RMX_set_arg", exec$handle, name, as.double(value)))
+}
+
+#' Write an auxiliary state (BatchNorm moving stats etc.).
+mx.exec.set.aux <- function(exec, name, value) {
+  invisible(.Call("RMX_set_aux", exec$handle, name, as.double(value)))
 }
 
 mx.exec.get.arg <- function(exec, name) .Call("RMX_get_arg", exec$handle, name)

@@ -1,0 +1,70 @@
+# Weight initializers (reference: R-package/R/initializer.R —
+# mx.init.uniform/normal/Xavier factories returning a function
+# (name, shape, ctx) -> mx.ndarray, plus mx.init.create applying one over
+# the parameter list with the reference's name rules).
+
+#' Uniform(-scale, scale) initializer (reference: mx.init.uniform).
+#' @export
+mx.init.uniform <- function(scale) {
+  function(name, shape, ctx) {
+    mx.nd.array(array(stats::runif(prod(shape), -scale, scale), dim = shape))
+  }
+}
+
+#' Normal(0, sd) initializer (reference: mx.init.normal).
+#' @export
+mx.init.normal <- function(sd) {
+  function(name, shape, ctx) {
+    mx.nd.array(array(stats::rnorm(prod(shape), 0, sd), dim = shape))
+  }
+}
+
+#' Xavier initializer (reference: mx.init.Xavier — rnd_type
+#' "uniform"/"gaussian", factor_type "avg"/"in"/"out").
+#' @export
+mx.init.Xavier <- function(rnd_type = "uniform", factor_type = "avg",
+                           magnitude = 3) {
+  function(name, shape, ctx) {
+    # R shape is reversed: last dim is fan-in rows (framework dim 0)
+    ndim <- length(shape)
+    fan.out <- shape[[ndim]]
+    fan.in <- prod(shape) / fan.out
+    factor <- switch(factor_type, avg = (fan.in + fan.out) / 2,
+                     "in" = fan.in, out = fan.out,
+                     stop("bad factor_type: ", factor_type))
+    scale <- sqrt(magnitude / factor)
+    vals <- if (rnd_type == "uniform") {
+      stats::runif(prod(shape), -scale, scale)
+    } else if (rnd_type == "gaussian") {
+      stats::rnorm(prod(shape), 0, scale)
+    } else stop("bad rnd_type: ", rnd_type)
+    mx.nd.array(array(vals, dim = shape))
+  }
+}
+
+#' Apply an initializer over named shapes with the reference name rules:
+#' *_bias / *_gamma / *_beta / *_moving_mean get fixed defaults, weights go
+#' through the initializer (reference: mx.init.internal.default +
+#' mx.init.create).
+#' @export
+mx.init.create <- function(initializer, shape.array, ctx = NULL,
+                           skip.unknown = TRUE) {
+  out <- list()
+  for (name in names(shape.array)) {
+    shape <- shape.array[[name]]
+    value <- if (endsWith(name, "bias") || endsWith(name, "beta") ||
+                 endsWith(name, "moving_mean")) {
+      mx.nd.zeros(shape)
+    } else if (endsWith(name, "gamma") || endsWith(name, "moving_var")) {
+      mx.nd.array(array(1, dim = shape))
+    } else if (endsWith(name, "weight")) {
+      initializer(name, shape, ctx)
+    } else if (!skip.unknown) {
+      initializer(name, shape, ctx)
+    } else {
+      NULL
+    }
+    if (!is.null(value)) out[[name]] <- value
+  }
+  out
+}

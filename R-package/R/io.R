@@ -1,0 +1,116 @@
+# Data iterators (reference: R-package/R/io.R — is.mx.dataiter, mx.io.extract,
+# mx.io.arrayiter; plus the C-iterator family CSVIter/MNISTIter reachable
+# through mx.io.create, the analog of the reference's Rcpp_MXNativeDataIter).
+#
+# Iterator protocol (the reference's): an iterator is a list with
+# $iter.next(), $reset(), $value() -> list(data=mx.ndarray-convertible,
+# label=...), and $num.pad().
+
+#' @export
+is.mx.dataiter <- function(x) inherits(x, "MXDataIter")
+
+#' Iterator over in-memory R arrays (reference: mx.io.arrayiter). `data`'s
+#' LAST R dimension is the example axis (column-major convention).
+#' @export
+mx.io.arrayiter <- function(data, label, batch.size = 128, shuffle = FALSE) {
+  data <- as.array(data)
+  dshape <- dim(data)
+  ndim <- length(dshape)
+  n <- dshape[[ndim]]
+  label <- if (is.null(label)) rep(0, n) else as.array(label)
+  env <- new.env()
+  env$order <- seq_len(n)
+  env$cursor <- 0L
+  feat <- prod(dshape) / n
+  flat <- matrix(data, nrow = feat)  # one reshape at construction
+  it <- list(
+    iter.next = function() {
+      if (env$cursor >= n) return(FALSE)
+      env$cursor <- env$cursor + batch.size
+      TRUE
+    },
+    reset = function() {
+      env$cursor <- 0L
+      if (shuffle) env$order <- sample(n)
+      invisible(NULL)
+    },
+    value = function() {
+      idx <- (env$cursor - batch.size + 1):env$cursor
+      idx[idx > n] <- 1L  # pad with wrapped examples (reference pads)
+      rows <- env$order[idx]
+      bshape <- c(dshape[-ndim], batch.size)
+      list(data = array(flat[, rows, drop = FALSE], dim = bshape),
+           label = as.numeric(label)[rows])
+    },
+    num.pad = function() {
+      max(0L, env$cursor - n)
+    })
+  class(it) <- c("MXArrayDataIter", "MXDataIter")
+  it
+}
+
+#' Create one of the framework's C-side iterators by registry name
+#' (reference: the generated mx.io.CSVIter/MNISTIter constructors):
+#'   it <- mx.io.create("CSVIter", data.csv = f, data.shape = c(3),
+#'                      batch.size = 8)
+#' Parameter names may use R dots; they convert to underscores.
+#' @export
+mx.io.create <- function(iter.name, ...) {
+  params <- list(...)
+  keys <- gsub(".", "_", names(params), fixed = TRUE)
+  # shapes arrive in the R (reversed) convention; the C schema wants the
+  # framework order
+  vals <- vapply(seq_along(params), function(i) {
+    v <- params[[i]]
+    if (is.numeric(v) && length(v) > 1) v <- rev(v)
+    mx.internal.param.str(v)
+  }, character(1))
+  handle <- .Call("RMX_io_create", iter.name, keys, vals)
+  it <- list(
+    iter.next = function() .Call("RMX_io_next", handle) == 1L,
+    reset = function() invisible(.Call("RMX_io_before_first", handle)),
+    value = function() {
+      d <- .Call("RMX_io_data", handle)
+      l <- .Call("RMX_io_label", handle)
+      list(data = array(d[[1]], dim = d[[2]]),
+           label = as.numeric(l[[1]]))
+    },
+    num.pad = function() .Call("RMX_io_pad", handle))
+  class(it) <- c("MXNativeDataIter", "MXDataIter")
+  it
+}
+
+#' List the registered C-side iterators (reference: MXListDataIters).
+#' @export
+mx.io.list.iters <- function() .Call("RMX_io_list_iters")
+
+#' CSV iterator (reference: the generated mx.io.CSVIter).
+#' @export
+mx.io.CSVIter <- function(...) mx.io.create("CSVIter", ...)
+
+#' Extract a field ("data" or "label") across a whole iterator, dropping
+#' pad examples (reference: mx.io.extract).
+#' @export
+mx.io.extract <- function(iter, field) {
+  chunks <- list()
+  iter$reset()
+  while (iter$iter.next()) {
+    v <- iter$value()[[field]]
+    pad <- iter$num.pad()
+    v <- as.array(v)
+    dims <- dim(v)
+    if (is.null(dims)) dims <- length(v)
+    ndim <- length(dims)
+    keep <- dims[[ndim]] - pad
+    flat <- matrix(v, ncol = dims[[ndim]])[, seq_len(keep), drop = FALSE]
+    chunks[[length(chunks) + 1]] <-
+      array(flat, dim = c(dims[-ndim], keep))
+  }
+  iter$reset()
+  ndim <- length(dim(chunks[[1]]))
+  total <- sum(vapply(chunks, function(c) dim(c)[[ndim]], numeric(1)))
+  feat.dims <- dim(chunks[[1]])[-ndim]
+  flat <- do.call(cbind, lapply(chunks, function(c)
+    matrix(c, ncol = dim(c)[[ndim]])))
+  array(flat, dim = c(feat.dims, total))
+}

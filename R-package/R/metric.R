@@ -1,0 +1,38 @@
+# Evaluation metrics (reference: R-package/R/metric.R — mx.metric.custom
+# factory and the accuracy/rmse/mae instances; the functional
+# init/update/get protocol is the reference's).
+
+#' Create a custom metric from a function(label, pred) -> numeric
+#' (reference: mx.metric.custom).
+#' @export
+mx.metric.custom <- function(name, feval) {
+  init <- function() list(sum = 0, n = 0)
+  update <- function(label, pred, state) {
+    list(sum = state$sum + feval(as.array(label), as.array(pred)),
+         n = state$n + 1)
+  }
+  get <- function(state) list(name = name, value = state$sum / state$n)
+  structure(list(init = init, update = update, get = get),
+            class = "mx.metric")
+}
+
+#' Classification accuracy: pred is (classes, batch) in R's column-major
+#' view, labels are class indices (reference: mx.metric.accuracy).
+#' @export
+mx.metric.accuracy <- mx.metric.custom("accuracy", function(label, pred) {
+  pred <- as.matrix(pred)
+  yhat <- max.col(t(pred)) - 1
+  mean(as.vector(label) == yhat)
+})
+
+#' Root mean squared error (reference: mx.metric.rmse).
+#' @export
+mx.metric.rmse <- mx.metric.custom("rmse", function(label, pred) {
+  sqrt(mean((as.vector(label) - as.vector(pred))^2))
+})
+
+#' Mean absolute error (reference: mx.metric.mae).
+#' @export
+mx.metric.mae <- mx.metric.custom("mae", function(label, pred) {
+  mean(abs(as.vector(label) - as.vector(pred)))
+})

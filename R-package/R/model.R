@@ -1,117 +1,311 @@
-# FeedForward-shaped estimator (reference: R-package/R/model.R —
-# mx.model.FeedForward.create: bind, init, epoch loop of
-# forward/backward/update, checkpoint save/load).
+# FeedForward model (reference: R-package/R/model.R —
+# mx.model.FeedForward.create with the reference argument surface, the
+# internal init.iter/init.params/train helpers, predict(), and
+# checkpoint save/load in the reference file formats).
+#
+# Single-context training (the TPU build's multi-device story lives in the
+# python Module/SPMD path); the R-side loop mirrors the reference's:
+# bind -> init params -> per batch set/forward/metric/backward/update ->
+# epoch metric + callbacks.
 
-#' Train a feed-forward network.
-#'
-#' @param symbol the network (its last op a loss head, e.g. SoftmaxOutput)
-#' @param X numeric matrix, one ROW per example (converted row-major)
-#' @param y numeric label vector
-#' @param batch.size,num.round,learning.rate,momentum,wd usual knobs
-#' @return an MXFeedForwardModel (symbol + bound executor)
-mx.model.FeedForward.create <- function(symbol, X, y, batch.size = 32,
-                                        num.round = 10, learning.rate = 0.1,
-                                        momentum = 0.9, wd = 0,
-                                        initializer.seed = 0,
-                                        verbose = FALSE) {
-  n <- nrow(X)
-  if (n %% batch.size != 0)
-    stop("batch.size must divide nrow(X) (pad your data)")
-  data.name <- "data"
-  label.name <- grep("label", arguments(symbol), value = TRUE)[1]
-  shapes <- list(c(batch.size, ncol(X)), c(batch.size))
-  names(shapes) <- c(data.name, label.name)
-  exec <- do.call(mx.simple.bind,
-                  c(list(symbol = symbol, ctx = "cpu", grad.req = "write"),
-                    shapes))
-  mx.exec.init.xavier(exec, initializer.seed)
-  n.batch <- n / batch.size
-  for (round in seq_len(num.round)) {
-    for (b in seq_len(n.batch)) {
-      rows <- ((b - 1) * batch.size + 1):(b * batch.size)
-      # t() flattens row-major for the C API's row-major contract
-      mx.exec.set.arg(exec, data.name, as.double(t(X[rows, , drop = FALSE])))
-      mx.exec.set.arg(exec, label.name, as.double(y[rows]))
+mx.model.select.layout.train <- function(X, y) {
+  if (is.null(y)) stop("Need to provide parameter y for training")
+  y <- as.vector(y)
+  dimX <- dim(X)
+  if (dimX[[1]] == dimX[[2]])
+    stop("X is a square matrix: specify array.layout explicitly")
+  if (dimX[[2]] == length(y)) return("colmajor")
+  if (dimX[[1]] == length(y)) return("rowmajor")
+  stop("Cannot auto select array.layout: no dimension of X matches ",
+       "length(y)")
+}
+
+mx.model.select.layout.predict <- function(X, model) {
+  dimX <- dim(X)
+  if (dimX[[1]] == dimX[[2]])
+    stop("X is a square matrix: specify array.layout explicitly")
+  # feature count from the first-layer weight's R shape (in-dim is first)
+  w <- model$arg.params[[grep("weight", names(model$arg.params))[1]]]
+  nfeat <- dim(w)[[1]]
+  if (dimX[[1]] == nfeat) return("colmajor")
+  if (dimX[[2]] == nfeat) return("rowmajor")
+  stop("Cannot auto select array.layout for prediction")
+}
+
+mx.model.init.iter <- function(X, y, batch.size, is.train) {
+  if (is.mx.dataiter(X)) return(X)
+  if (is.null(dim(X)))
+    stop("Need a matrix/array (or mx.io iterator) as data")
+  mx.io.arrayiter(X, y, batch.size = batch.size, shuffle = is.train)
+}
+
+mx.model.check.arguments <- function(symbol) {
+  args <- arguments(symbol)
+  data.name <- args[args == "data"]
+  if (length(data.name) != 1)
+    stop("the model symbol needs exactly one 'data' argument")
+  label.name <- args[endsWith(args, "label")]
+  if (length(label.name) != 1)
+    stop("the model symbol needs exactly one '*label' argument")
+  c(data.name, label.name)
+}
+
+#' Infer and initialize parameters (reference: mx.model.init.params).
+#' Shapes are in the R (reversed) convention.
+mx.model.init.params <- function(symbol, input.shape, output.shape,
+                                 initializer, ctx) {
+  inferred <- mx.symbol.infer.shape(symbol, data = input.shape)
+  if (is.null(inferred)) stop("Cannot infer shapes from data shape")
+  arg.shapes <- inferred$arg.shapes
+  arg.shapes <- arg.shapes[!(names(arg.shapes) %in%
+                             c("data", grep("label", names(arg.shapes),
+                                            value = TRUE)))]
+  arg.params <- mx.init.create(initializer, arg.shapes, ctx,
+                               skip.unknown = FALSE)
+  aux.shapes <- inferred$aux.shapes
+  aux.params <- if (length(aux.shapes))
+    mx.init.create(initializer, aux.shapes, ctx, skip.unknown = FALSE)
+  else list()
+  list(arg.params = arg.params, aux.params = aux.params)
+}
+
+# executor <-> R parameter plumbing (flat row-major floats cross the C
+# boundary; R col-major bytes of the reversed dim are identical)
+mx.model.internal.set.nd <- function(exec, name, nd) {
+  mx.exec.set.arg(exec, name, as.double(as.array(nd)))
+}
+
+mx.model.internal.get.nd <- function(values, rshape) {
+  mx.nd.array(array(values, dim = rshape))
+}
+
+mx.model.internal.output <- function(exec, index = 0) {
+  v <- mx.exec.get.output(exec, index)
+  shape <- attr(v, "mx.shape")
+  array(as.numeric(v), dim = rev(shape))
+}
+
+#' Internal single-device training loop (reference: mx.model.train).
+mx.model.train <- function(symbol, ctx, input.shape, output.shape,
+                           arg.params, aux.params, begin.round, end.round,
+                           optimizer, train.data, eval.data, metric,
+                           epoch.end.callback, batch.end.callback,
+                           verbose = TRUE) {
+  input.names <- mx.model.check.arguments(symbol)
+  data.name <- input.names[[1]]
+  label.name <- input.names[[2]]
+  arg_lst <- list(symbol = symbol, ctx = ctx, grad.req = "write")
+  arg_lst[[data.name]] <- input.shape
+  arg_lst[[label.name]] <- output.shape
+  exec <- do.call(mx.simple.bind, arg_lst)
+  arg.rshapes <- lapply(arg.params, dim)
+  for (name in names(arg.params))
+    mx.model.internal.set.nd(exec, name, arg.params[[name]])
+  for (name in names(aux.params))
+    mx.exec.set.aux(exec, name, as.array(aux.params[[name]]))
+  updater <- mx.opt.get.updater(optimizer, arg.params)
+  model <- list(symbol = symbol, arg.params = arg.params,
+                aux.params = aux.params)
+  class(model) <- "MXFeedForwardModel"
+  for (iteration in begin.round:end.round) {
+    nbatch <- 0
+    train.metric <- if (!is.null(metric)) metric$init() else NULL
+    train.data$reset()
+    while (train.data$iter.next()) {
+      batch <- train.data$value()
+      mx.exec.set.arg(exec, data.name, as.double(batch$data))
+      mx.exec.set.arg(exec, label.name, as.double(batch$label))
       mx.exec.forward(exec, is.train = TRUE)
+      if (!is.null(metric))
+        train.metric <- metric$update(batch$label,
+                                      mx.model.internal.output(exec),
+                                      train.metric)
       mx.exec.backward(exec)
-      mx.exec.momentum.update(exec, lr = learning.rate, wd = wd,
-                              momentum = momentum,
-                              rescale = 1 / batch.size)
+      grads <- lapply(names(arg.params), function(name)
+        mx.model.internal.get.nd(mx.exec.get.grad(exec, name),
+                                 arg.rshapes[[name]]))
+      names(grads) <- names(arg.params)
+      weights <- lapply(names(arg.params), function(name)
+        mx.model.internal.get.nd(mx.exec.get.arg(exec, name),
+                                 arg.rshapes[[name]]))
+      names(weights) <- names(arg.params)
+      new.weights <- updater(weights, grads)
+      for (name in names(arg.params))
+        mx.model.internal.set.nd(exec, name, new.weights[[name]])
+      nbatch <- nbatch + 1
+      if (!is.null(batch.end.callback)) {
+        env <- environment()
+        batch.end.callback(iteration, nbatch, env)
+      }
     }
-    if (verbose)
-      cat(sprintf("round %d: train.acc=%.4f\n", round,
-                  mx.model.accuracy(exec, X, y, batch.size, data.name,
-                                    label.name)))
+    if (!is.null(metric) && verbose) {
+      result <- metric$get(train.metric)
+      message("[", iteration, "] Train-", result$name, "=", result$value)
+    }
+    eval.metric <- NULL
+    if (!is.null(eval.data) && !is.null(metric)) {
+      eval.metric <- metric$init()
+      eval.data$reset()
+      while (eval.data$iter.next()) {
+        batch <- eval.data$value()
+        mx.exec.set.arg(exec, data.name, as.double(batch$data))
+        mx.exec.set.arg(exec, label.name, as.double(batch$label))
+        mx.exec.forward(exec, is.train = FALSE)
+        eval.metric <- metric$update(batch$label,
+                                     mx.model.internal.output(exec),
+                                     eval.metric)
+      }
+      eval.data$reset()
+      if (verbose) {
+        result <- metric$get(eval.metric)
+        message("[", iteration, "] Validation-", result$name, "=",
+                result$value)
+      }
+    }
+    # refresh the model params for callbacks/checkpoints
+    model$arg.params <- lapply(names(arg.params), function(name)
+      mx.model.internal.get.nd(mx.exec.get.arg(exec, name),
+                               arg.rshapes[[name]]))
+    names(model$arg.params) <- names(arg.params)
+    model$aux.params <- lapply(names(aux.params), function(name)
+      mx.nd.array(mx.exec.get.aux(exec, name)))
+    names(model$aux.params) <- names(aux.params)
+    if (!is.null(epoch.end.callback)) {
+      env <- environment()
+      if (identical(epoch.end.callback(iteration, 0, env, verbose), FALSE))
+        break
+    }
   }
-  structure(list(symbol = symbol, exec = exec, batch.size = batch.size,
-                 data.name = data.name, label.name = label.name),
-            class = "MXFeedForwardModel")
+  model
 }
 
-mx.model.accuracy <- function(exec, X, y, batch.size, data.name = "data",
-                              label.name = "softmax_label") {
-  n <- nrow(X)
-  if (n %% batch.size != 0)
-    stop("nrow(X) must be a multiple of batch.size (the bound executor has",
-         " a fixed batch); pad or subset your data")
-  correct <- 0
-  for (b in seq_len(n / batch.size)) {
-    rows <- ((b - 1) * batch.size + 1):(b * batch.size)
-    mx.exec.set.arg(exec, data.name, as.double(t(X[rows, , drop = FALSE])))
+#' Train a feed-forward model (the reference argument surface:
+#' R-package/R/model.R mx.model.FeedForward.create).
+#' @export
+mx.model.FeedForward.create <-
+  function(symbol, X, y = NULL, ctx = NULL, begin.round = 1, num.round = 10,
+           optimizer = "sgd", initializer = mx.init.uniform(0.01),
+           eval.data = NULL, eval.metric = NULL, epoch.end.callback = NULL,
+           batch.end.callback = NULL, array.batch.size = 128,
+           array.layout = "auto", kvstore = "local", verbose = TRUE,
+           arg.params = NULL, aux.params = NULL, ...) {
+  if (is.array(X) || is.matrix(X)) {
+    if (array.layout == "auto")
+      array.layout <- mx.model.select.layout.train(X, y)
+    if (array.layout == "rowmajor") X <- t(X)
+  }
+  X <- mx.model.init.iter(X, y, batch.size = array.batch.size,
+                          is.train = TRUE)
+  X$reset()
+  if (!X$iter.next()) stop("Empty input")
+  input.shape <- dim(X$value()$data)
+  output.shape <- length(X$value()$label)
+  X$reset()
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  params <- mx.model.init.params(symbol, input.shape, output.shape,
+                                 initializer, ctx)
+  if (!is.null(arg.params)) params$arg.params <- arg.params
+  if (!is.null(aux.params)) params$aux.params <- aux.params
+  if (is.character(optimizer)) {
+    ndim <- length(input.shape)
+    batchsize <- input.shape[[ndim]]
+    optimizer <- mx.opt.create(optimizer, rescale.grad = 1 / batchsize, ...)
+  }
+  if (is.list(eval.data) && !is.mx.dataiter(eval.data)) {
+    if (is.null(eval.data$data) || is.null(eval.data$label))
+      stop("eval.data must be list(data=..., label=...) or an mx.io iterator")
+    ed <- eval.data$data
+    if (is.array(ed) || is.matrix(ed)) {
+      # layout is detected on the eval matrix ITSELF (X may have been an
+      # iterator, leaving array.layout at "auto")
+      ed.layout <- array.layout
+      if (ed.layout == "auto")
+        ed.layout <- mx.model.select.layout.train(ed, eval.data$label)
+      if (ed.layout == "rowmajor") ed <- t(ed)
+    }
+    eval.data <- mx.model.init.iter(ed, eval.data$label,
+                                    batch.size = array.batch.size,
+                                    is.train = FALSE)
+  }
+  mx.model.train(symbol, ctx, input.shape, output.shape,
+                 params$arg.params, params$aux.params, begin.round,
+                 num.round, optimizer = optimizer, train.data = X,
+                 eval.data = eval.data, metric = eval.metric,
+                 epoch.end.callback = epoch.end.callback,
+                 batch.end.callback = batch.end.callback,
+                 verbose = verbose)
+}
+
+#' Predict: returns the output matrix with dim (classes, n)
+#' (reference: predict.MXFeedForwardModel; col-major convention).
+#' @export
+predict.MXFeedForwardModel <- function(object, X, ctx = NULL,
+                                       array.batch.size = 128,
+                                       array.layout = "auto", ...) {
+  if (is.array(X) || is.matrix(X)) {
+    if (array.layout == "auto")
+      array.layout <- mx.model.select.layout.predict(X, object)
+    if (array.layout == "rowmajor") X <- t(X)
+  }
+  X <- mx.model.init.iter(X, NULL, batch.size = array.batch.size,
+                          is.train = FALSE)
+  X$reset()
+  if (!X$iter.next()) stop("Empty input")
+  input.shape <- dim(X$value()$data)
+  X$reset()
+  if (is.null(ctx)) ctx <- mx.ctx.default()
+  input.names <- mx.model.check.arguments(object$symbol)
+  arg_lst <- list(symbol = object$symbol, ctx = ctx, grad.req = "null")
+  arg_lst[[input.names[[1]]]] <- input.shape
+  arg_lst[[input.names[[2]]]] <- input.shape[[length(input.shape)]]
+  exec <- do.call(mx.simple.bind, arg_lst)
+  for (name in names(object$arg.params))
+    mx.model.internal.set.nd(exec, name, object$arg.params[[name]])
+  for (name in names(object$aux.params))
+    mx.exec.set.aux(exec, name, as.array(object$aux.params[[name]]))
+  chunks <- list()
+  X$reset()
+  while (X$iter.next()) {
+    batch <- X$value()
+    mx.exec.set.arg(exec, input.names[[1]], as.double(batch$data))
     mx.exec.forward(exec, is.train = FALSE)
-    out <- mx.exec.get.output(exec, 0)
-    shp <- attr(out, "mx.shape")
-    probs <- matrix(out, nrow = shp[1], ncol = shp[2], byrow = TRUE)
-    pred <- max.col(probs) - 1
-    correct <- correct + sum(pred == y[rows])
+    out <- mx.model.internal.output(exec)  # (classes, batch) col-major
+    pad <- X$num.pad()
+    keep <- ncol(out) - pad
+    chunks[[length(chunks) + 1]] <- out[, seq_len(keep), drop = FALSE]
   }
-  correct / n
+  X$reset()
+  do.call(cbind, chunks)
 }
 
-#' Predict class probabilities for X (row-major batches).
-predict.MXFeedForwardModel <- function(object, X, ...) {
-  exec <- object$exec
-  bs <- object$batch.size
-  n <- nrow(X)
-  out.all <- NULL
-  for (b in seq_len(ceiling(n / bs))) {
-    rows <- ((b - 1) * bs + 1):min(b * bs, n)
-    pad <- bs - length(rows)
-    Xb <- X[c(rows, rep(rows[length(rows)], pad)), , drop = FALSE]
-    mx.exec.set.arg(exec, object$data.name, as.double(t(Xb)))
-    mx.exec.forward(exec, is.train = FALSE)
-    out <- mx.exec.get.output(exec, 0)
-    shp <- attr(out, "mx.shape")
-    probs <- matrix(out, nrow = shp[1], ncol = shp[2], byrow = TRUE)
-    if (is.null(out.all))  # allocate once, now that ncol is known
-      out.all <- matrix(0, nrow = n, ncol = shp[2])
-    out.all[rows, ] <- probs[seq_along(rows), , drop = FALSE]
-  }
-  out.all
-}
-
-#' Save `prefix-symbol.json` + `prefix-%04d.params` (reference
-#' model.save_checkpoint format — interchange with python and the
-#' reference).
+#' Save a model checkpoint in the reference file formats:
+#' prefix-symbol.json + prefix-%04d.params with arg:/aux: keys
+#' (reference: mx.model.save) — files interchange with the python side.
+#' @export
 mx.model.save <- function(model, prefix, iteration = 1) {
-  mx.symbol.save(model$symbol, sprintf("%s-symbol.json", prefix))
-  mx.exec.save.params(model$exec, sprintf("%s-%04d.params", prefix,
-                                          iteration))
+  mx.symbol.save(model$symbol, paste0(prefix, "-symbol.json"))
+  save.list <- list()
+  for (name in names(model$arg.params))
+    save.list[[paste0("arg:", name)]] <- model$arg.params[[name]]
+  for (name in names(model$aux.params))
+    save.list[[paste0("aux:", name)]] <- model$aux.params[[name]]
+  mx.nd.save(save.list, sprintf("%s-%04d.params", prefix, iteration))
   invisible(NULL)
 }
 
-#' Load a checkpoint back into a bound model (shapes from `input.shapes`,
-#' a named list like the bind call's).
-mx.model.load <- function(prefix, iteration, input.shapes) {
-  symbol <- mx.symbol.load(sprintf("%s-symbol.json", prefix))
-  exec <- do.call(mx.simple.bind,
-                  c(list(symbol = symbol, ctx = "cpu", grad.req = "null"),
-                    input.shapes))
-  mx.exec.load.params(exec, sprintf("%s-%04d.params", prefix, iteration))
-  data.name <- names(input.shapes)[1]
-  label.name <- names(input.shapes)[2]
-  structure(list(symbol = symbol, exec = exec,
-                 batch.size = input.shapes[[1]][1],
-                 data.name = data.name, label.name = label.name),
-            class = "MXFeedForwardModel")
+#' Load a checkpoint saved by mx.model.save / the python side / the
+#' reference (reference: mx.model.load).
+#' @export
+mx.model.load <- function(prefix, iteration) {
+  symbol <- mx.symbol.load(paste0(prefix, "-symbol.json"))
+  loaded <- mx.nd.load(sprintf("%s-%04d.params", prefix, iteration))
+  nms <- names(loaded)
+  arg.params <- loaded[startsWith(nms, "arg:")]
+  names(arg.params) <- substring(names(arg.params), 5)
+  aux.params <- loaded[startsWith(nms, "aux:")]
+  names(aux.params) <- substring(names(aux.params), 5)
+  model <- list(symbol = symbol, arg.params = arg.params,
+                aux.params = aux.params)
+  class(model) <- "MXFeedForwardModel"
+  model
 }

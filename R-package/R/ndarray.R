@@ -1,0 +1,177 @@
+# NDArray (reference: R-package/R/ndarray.R — the MXNDArray class, creation
+# helpers, save/load, and operator overloads; generated mx.nd.* op functions
+# mirror the reference's registry-generated surface).
+#
+# Layout contract (the reference R package's own): an R array with
+# dim c(d1..dk) maps to the framework NDArray with REVERSED shape (dk..d1).
+# R's column-major storage equals the row-major storage of the reversed
+# shape, so values cross the boundary without permutation.
+
+mx.nd.internal.new <- function(handle) {
+  structure(list(handle = handle), class = "MXNDArray")
+}
+
+is.MXNDArray <- function(nd) inherits(nd, "MXNDArray")
+
+#' Check if src.array is mx.ndarray
+#' @export
+is.mx.ndarray <- function(src.array) is.MXNDArray(src.array)
+
+#' Create an mx.ndarray from an R array, vector or matrix.
+#' @export
+mx.nd.array <- function(src.array, ctx = NULL) {
+  if (is.MXNDArray(src.array)) return(src.array)
+  if (!is.array(src.array)) {
+    if (!is.vector(src.array) && !is.matrix(src.array))
+      stop("mx.nd.array takes an object of class array, vector or matrix only.")
+    src.array <- as.array(src.array)
+  }
+  mx.nd.internal.new(.Call("RMX_nd_from_array", as.double(src.array),
+                           as.integer(dim(src.array))))
+}
+
+#' An mx.ndarray of zeros.
+#' @export
+mx.nd.zeros <- function(shape, ctx = NULL) {
+  mx.nd.internal.new(.Call("RMX_nd_create", as.integer(shape)))
+}
+
+#' An mx.ndarray of ones.
+#' @export
+mx.nd.ones <- function(shape, ctx = NULL) {
+  nd <- mx.nd.zeros(shape, ctx)
+  nd + 1
+}
+
+#' Copy an mx.ndarray to another context (host arrays: a plain copy).
+#' @export
+mx.nd.copyto <- function(src, ctx) mx.nd.array(as.array(src), ctx)
+
+#' Save a list of mx.ndarray (or a single one) in the reference .params
+#' container format — files interchange with python mx.nd.load and the
+#' reference itself.
+#' @export
+mx.nd.save <- function(ndarray, filename) {
+  filename <- path.expand(filename)
+  if (!is.list(ndarray)) ndarray <- list(ndarray)
+  nms <- names(ndarray)
+  if (is.null(nms)) nms <- rep("", length(ndarray))
+  invisible(.Call("RMX_nd_save", nms,
+                  lapply(ndarray, function(x) x$handle), filename))
+}
+
+#' Load mx.ndarray(s) saved by mx.nd.save / python / the reference.
+#' @export
+mx.nd.load <- function(filename) {
+  res <- .Call("RMX_nd_load", path.expand(filename))
+  out <- lapply(res[[2]], mx.nd.internal.new)
+  if (any(nzchar(res[[1]]))) names(out) <- res[[1]]
+  out
+}
+
+#' dim overload (R convention: reversed framework shape).
+#' @export
+dim.MXNDArray <- function(x) .Call("RMX_nd_shape", x$handle)
+
+#' @export
+length.MXNDArray <- function(x) prod(dim(x))
+
+#' as.array overload.
+#' @export
+as.array.MXNDArray <- function(x, ...) {
+  array(.Call("RMX_nd_as_array", x$handle), dim = dim(x))
+}
+
+#' as.matrix overload.
+#' @export
+as.matrix.MXNDArray <- function(x, ...) {
+  if (length(dim(x)) != 2)
+    stop("The input argument is not two dimensional matrix.")
+  as.matrix(as.array(x))
+}
+
+#' @export
+print.MXNDArray <- function(x, ...) print(as.array(x))
+
+#' Context of an mx.ndarray.
+#' @export
+ctx <- function(nd) mx.cpu()
+
+#' Slice along the batch (last R) dimension: rows [begin, end) in the
+#' framework's first axis (reference: mx.nd.slice).
+#' @export
+mx.nd.slice <- function(nd, begin, end) {
+  mx.nd.internal.invoke("slice_axis", list(nd),
+                        list(axis = "0", begin = as.character(begin),
+                             end = as.character(end)))[[1]]
+}
+
+# ---- imperative op dispatch -----------------------------------------------
+
+mx.nd.internal.invoke <- function(op, nd.list, attrs) {
+  keys <- names(attrs)
+  if (is.null(keys)) keys <- character(0)
+  vals <- vapply(attrs, mx.internal.param.str, character(1))
+  res <- .Call("RMX_imperative_invoke", op,
+               lapply(nd.list, function(x) x$handle),
+               as.character(keys), as.character(vals))
+  lapply(res, mx.nd.internal.new)
+}
+
+#' Run any registered operator on mx.ndarray inputs:
+#' mx.nd.invoke("exp", x) or mx.nd.invoke("sum", x, axis = 0).
+#' @export
+mx.nd.invoke <- function(op, ..., out.all = FALSE) {
+  args <- list(...)
+  nds <- Filter(is.MXNDArray, args)
+  nms <- names(args)
+  if (is.null(nms)) nms <- rep("", length(args))
+  attrs <- args[nzchar(nms) & !vapply(args, is.MXNDArray, logical(1))]
+  res <- mx.nd.internal.invoke(op, nds, attrs)
+  if (out.all || length(res) != 1) res else res[[1]]
+}
+
+#' Operator overloads (reference: Ops.MXNDArray -> internal dispatch).
+#' Scalar operands route to the *_scalar op family.
+#' @export
+Ops.MXNDArray <- function(e1, e2) {
+  two.nd <- is.MXNDArray(e1) && (missing(e2) || is.MXNDArray(e2))
+  op <- switch(.Generic, "+" = "_plus", "-" = "_minus", "*" = "_mul",
+               "/" = "_div", stop("unsupported operator for mx.ndarray: ",
+                                  .Generic))
+  if (two.nd) {
+    if (missing(e2)) stop("unary ", .Generic, " not supported")
+    return(mx.nd.internal.invoke(op, list(e1, e2), list())[[1]])
+  }
+  if (is.MXNDArray(e1)) {  # nd <op> scalar
+    return(mx.nd.internal.invoke(paste0(op, "_scalar"), list(e1),
+                                 list(scalar = e2))[[1]])
+  }
+  # scalar <op> nd: + and * commute; - and / use the reflected ops
+  rop <- switch(.Generic, "+" = "_plus_scalar", "*" = "_mul_scalar",
+                "-" = "_rminus_scalar", "/" = "_rdiv_scalar")
+  mx.nd.internal.invoke(rop, list(e2), list(scalar = e1))[[1]]
+}
+
+# ---- generated op surface -------------------------------------------------
+
+#' Generate mx.nd.<op> functions for every registered operator (reference:
+#' the R package's registry-generated mx.nd.* functions; python analog
+#' _init_ndarray_module). Called by the package loader; when sourcing the
+#' files directly call it once after loading.
+#' @export
+mx.nd.init.generated <- function(envir = parent.frame()) {
+  ops <- .Call("RMX_list_ops")
+  for (op in ops) {
+    # skip names that collide with the hand-written helpers above
+    fname <- paste0("mx.nd.", op)
+    if (fname %in% c("mx.nd.zeros", "mx.nd.ones", "mx.nd.array",
+                     "mx.nd.slice", "mx.nd.load", "mx.nd.save"))
+      next
+    assign(fname, local({
+      op.name <- op
+      function(...) mx.nd.invoke(op.name, ...)
+    }), envir = envir)
+  }
+  invisible(length(ops))
+}

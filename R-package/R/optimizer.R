@@ -1,0 +1,139 @@
+# Optimizers (reference: R-package/R/optimizer.R — mx.opt.sgd / rmsprop /
+# adam factories returning list(create.state, update); mx.opt.create by
+# name; mx.opt.get.updater closing over per-weight state). update()
+# operates on mx.ndarray values through the overloaded Ops, the same
+# functional protocol as the reference.
+
+mx.opt.internal.env <- function(lr) {
+  e <- new.env()
+  e$lr <- lr
+  e$count <- 0
+  e$num_update <- 0
+  e
+}
+
+mx.opt.internal.tick <- function(optEnv, index, lr_scheduler) {
+  if (is.null(lr_scheduler)) return(optEnv$lr)
+  indexKey <- paste0("ik", index)
+  if (!exists(indexKey, envir = optEnv)) {
+    assign(indexKey, 0, envir = optEnv)
+  } else {
+    assign(indexKey, get(indexKey, envir = optEnv) + 1, envir = optEnv)
+    optEnv$num_update <- max(optEnv$num_update, get(indexKey, envir = optEnv))
+  }
+  lr_scheduler(optEnv)
+  optEnv$lr
+}
+
+mx.opt.internal.clip <- function(grad, clip_gradient) {
+  if (is.null(clip_gradient)) return(grad)
+  if (clip_gradient < 0) stop("clip_gradient should be a positive number")
+  g <- as.array(grad)
+  mx.nd.array(pmin(pmax(g, -clip_gradient), clip_gradient))
+}
+
+#' SGD with momentum (reference: mx.opt.sgd).
+#' @export
+mx.opt.sgd <- function(learning.rate = 0.01, momentum = 0, wd = 0,
+                       rescale.grad = 1, clip_gradient = NULL,
+                       lr_scheduler = NULL) {
+  env <- mx.opt.internal.env(learning.rate)
+  create.state <- function(index, weight) {
+    if (momentum == 0) NULL else mx.nd.zeros(dim(weight))
+  }
+  update <- function(index, weight, grad, state) {
+    lr <- mx.opt.internal.tick(env, index, lr_scheduler)
+    grad <- mx.opt.internal.clip(grad * rescale.grad, clip_gradient)
+    if (is.null(state)) {
+      weight <- weight - lr * (grad + wd * weight)
+    } else {
+      mom <- state * momentum - lr * (grad + wd * weight)
+      weight <- weight + mom
+      state <- mom
+    }
+    list(weight = weight, state = state)
+  }
+  list(create.state = create.state, update = update)
+}
+
+#' RMSProp (reference: mx.opt.rmsprop — the Graves 2013 form with the
+#' gamma2 "momentum" average).
+#' @export
+mx.opt.rmsprop <- function(learning.rate = 0.002, gamma1 = 0.95,
+                           gamma2 = 0.9, wd = 0, rescale.grad = 1,
+                           clip_gradient = NULL, lr_scheduler = NULL) {
+  env <- mx.opt.internal.env(learning.rate)
+  create.state <- function(index, weight) {
+    list(n = mx.nd.zeros(dim(weight)), g = mx.nd.zeros(dim(weight)),
+         delta = mx.nd.zeros(dim(weight)))
+  }
+  update <- function(index, weight, grad, state) {
+    lr <- mx.opt.internal.tick(env, index, lr_scheduler)
+    grad <- mx.opt.internal.clip(grad * rescale.grad, clip_gradient)
+    n <- gamma1 * state$n + (1 - gamma1) * (grad * grad)
+    g <- gamma1 * state$g + (1 - gamma1) * grad
+    denom <- mx.nd.invoke("sqrt", n - g * g + 1e-4)
+    delta <- gamma2 * state$delta - lr * (grad / denom + wd * weight)
+    weight <- weight + delta
+    list(weight = weight, state = list(n = n, g = g, delta = delta))
+  }
+  list(create.state = create.state, update = update)
+}
+
+#' Adam (reference: mx.opt.adam).
+#' @export
+mx.opt.adam <- function(learning.rate = 0.001, beta1 = 0.9, beta2 = 0.999,
+                        epsilon = 1e-8, wd = 0, rescale.grad = 1,
+                        clip_gradient = NULL, lr_scheduler = NULL) {
+  env <- mx.opt.internal.env(learning.rate)
+  env$time <- 0
+  create.state <- function(index, weight) {
+    list(mean = mx.nd.zeros(dim(weight)), var = mx.nd.zeros(dim(weight)))
+  }
+  update <- function(index, weight, grad, state) {
+    lr <- mx.opt.internal.tick(env, index, lr_scheduler)
+    env$time <- env$time + 1
+    t <- env$time
+    grad <- mx.opt.internal.clip(grad * rescale.grad, clip_gradient)
+    grad <- grad + wd * weight
+    mean <- beta1 * state$mean + (1 - beta1) * grad
+    var <- beta2 * state$var + (1 - beta2) * (grad * grad)
+    coef <- lr * sqrt(1 - beta2^t) / (1 - beta1^t)
+    weight <- weight - coef * mean /
+      (mx.nd.invoke("sqrt", var) + epsilon)
+    list(weight = weight, state = list(mean = mean, var = var))
+  }
+  list(create.state = create.state, update = update)
+}
+
+#' Create an optimizer by name (reference: mx.opt.create).
+#' @export
+mx.opt.create <- function(name, ...) {
+  switch(name,
+         sgd = mx.opt.sgd(...),
+         rmsprop = mx.opt.rmsprop(...),
+         adam = mx.opt.adam(...),
+         stop("unknown optimizer: ", name))
+}
+
+#' Build an updater closing over one state slot per weight
+#' (reference: mx.opt.get.updater).
+#' @export
+mx.opt.get.updater <- function(optimizer, weights) {
+  n <- length(weights)
+  state.list <- lapply(seq_len(n), function(i) {
+    if (is.null(weights[[i]])) NULL
+    else optimizer$create.state(i, weights[[i]])
+  })
+  update <- optimizer$update
+  function(weight, grad) {
+    ulist <- lapply(seq_len(n), function(i) {
+      if (is.null(grad[[i]])) NULL
+      else update(i, weight[[i]], grad[[i]], state.list[[i]])
+    })
+    state.list <<- lapply(ulist, function(x) x$state)
+    out <- lapply(ulist, function(x) x$weight)
+    names(out) <- names(weights)
+    out
+  }
+}

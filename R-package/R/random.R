@@ -1,0 +1,22 @@
+# Random numbers (reference: R-package/R/random.R — mx.set.seed and the
+# mx.runif/mx.rnorm samplers returning mx.ndarray).
+
+#' Seed the framework RNG (reference: mx.set.seed; also seeds R's RNG so
+#' R-side shuffles/initializers are reproducible).
+#' @export
+mx.set.seed <- function(seed) {
+  set.seed(seed)
+  invisible(.Call("RMX_random_seed", as.integer(seed)))
+}
+
+#' Uniform samples as an mx.ndarray (reference: mx.runif).
+#' @export
+mx.runif <- function(shape, min = 0, max = 1, ctx = NULL) {
+  mx.nd.array(array(stats::runif(prod(shape), min, max), dim = shape))
+}
+
+#' Normal samples as an mx.ndarray (reference: mx.rnorm).
+#' @export
+mx.rnorm <- function(shape, mean = 0, sd = 1, ctx = NULL) {
+  mx.nd.array(array(stats::rnorm(prod(shape), mean, sd), dim = shape))
+}

@@ -70,14 +70,40 @@ mx.symbol.tojson <- function(symbol) .Call("RMX_symbol_to_json", symbol$handle)
 
 arguments <- function(symbol) .Call("RMX_symbol_arguments", symbol$handle)
 
+#' Infer shapes from known input shapes, all in the R (column-major,
+#' reversed) convention — mx.symbol.infer.shape(net, data = c(10, 32))
+#' for 32 examples of 10 features (reference: symbol.R infer.shape).
 mx.symbol.infer.shape <- function(symbol, ...) {
   shapes <- list(...)
   keys <- names(shapes)
   res <- .Call("RMX_symbol_infer_shape", symbol$handle, keys,
-               lapply(shapes, as.integer))
+               lapply(shapes, function(s) rev(as.integer(s))))
+  rev.all <- function(lst) lapply(lst, rev)
   args <- arguments(symbol)
-  arg.shapes <- res[[1]]
+  arg.shapes <- rev.all(res[[1]])
   if (length(arg.shapes) == length(args)) names(arg.shapes) <- args
-  list(arg.shapes = arg.shapes, out.shapes = res[[2]],
-       aux.shapes = res[[3]], complete = res[[4]] == 1L)
+  aux.shapes <- rev.all(res[[3]])
+  aux.names <- .Call("RMX_symbol_aux_states", symbol$handle)
+  if (length(aux.shapes) == length(aux.names)) names(aux.shapes) <- aux.names
+  list(arg.shapes = arg.shapes, out.shapes = rev.all(res[[2]]),
+       aux.shapes = aux.shapes, complete = res[[4]] == 1L)
+}
+
+# ---- generated op surface -------------------------------------------------
+
+#' Generate mx.symbol.<op> constructors for every registered operator
+#' (reference: the R package's registry-generated mx.symbol.* functions).
+#' Hand-written wrappers above take precedence.
+#' @export
+mx.symbol.init.generated <- function(envir = parent.frame()) {
+  ops <- .Call("RMX_list_ops")
+  for (op in ops) {
+    fname <- paste0("mx.symbol.", op)
+    if (exists(fname, envir = envir, inherits = FALSE)) next
+    assign(fname, local({
+      op.name <- op
+      function(...) mx.symbol.create(op.name, ...)
+    }), envir = envir)
+  }
+  invisible(length(ops))
 }

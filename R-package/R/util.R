@@ -1,0 +1,10 @@
+# Internal utilities (reference: R-package/R/util.R).
+
+#' Drop NULL entries from a list (reference: mx.util.filter.null).
+#' @export
+mx.util.filter.null <- function(lst) {
+  lst[!vapply(lst, is.null, logical(1))]
+}
+
+#' String split helper (reference: mx.util.str.split).
+mx.util.str.split <- function(x, split) strsplit(x, split)[[1]]

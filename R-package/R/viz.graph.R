@@ -1,0 +1,83 @@
+# Network visualization (reference: R-package/R/viz.graph.R —
+# graph.viz renders the symbol's node graph; that build draws with
+# DiagrammeR. Here the same node/edge extraction feeds a dependency-free
+# text rendering plus an optional DOT export any graphviz consumer reads.)
+
+# minimal JSON node extraction for the symbol graph (tojson's schema is
+# fixed: nodes = [{"op":..,"name":..,"inputs":[[id,..],..], "attrs":{..}},
+# ...]). Node objects can hold a NESTED attrs dict, so chunks are cut by
+# brace depth, not by regex.
+mx.viz.internal.nodes <- function(json) {
+  start <- regexpr('"nodes"\\s*:\\s*\\[', json)
+  chars <- strsplit(substring(json, start + attr(start, "match.length")),
+                    "")[[1]]
+  chunks <- character(0)
+  depth <- 0
+  buf <- character(0)
+  in.str <- FALSE
+  for (ch in chars) {
+    if (in.str) {
+      buf <- c(buf, ch)
+      if (ch == '"') in.str <- FALSE
+      next
+    }
+    if (ch == '"') in.str <- TRUE
+    if (ch == "{") depth <- depth + 1
+    if (depth > 0) buf <- c(buf, ch)
+    if (ch == "}") {
+      depth <- depth - 1
+      if (depth == 0) {
+        chunks <- c(chunks, paste(buf, collapse = ""))
+        buf <- character(0)
+      }
+    }
+    if (ch == "]" && depth == 0) break
+  }
+  lapply(chunks, function(ch) {
+    op <- sub('.*?"op"\\s*:\\s*"([^"]*)".*', "\\1", ch)
+    name <- sub('.*?"name"\\s*:\\s*"([^"]*)".*', "\\1", ch)
+    ins.block <- sub('.*?"inputs"\\s*:\\s*(\\[.*?\\]\\]|\\[\\]).*', "\\1", ch)
+    ins <- regmatches(ins.block, gregexpr("\\[\\s*\\d+", ins.block))[[1]]
+    list(op = op, name = name,
+         inputs = as.integer(sub("\\[\\s*", "", ins)))
+  })
+}
+
+#' Print a layer summary table of a symbol's graph (the reference
+#' graph.viz's information, rendered as text).
+#' @export
+graph.viz <- function(symbol, graph.title = "Network") {
+  nodes <- mx.viz.internal.nodes(mx.symbol.tojson(symbol))
+  cat(graph.title, "\n")
+  for (i in seq_along(nodes)) {
+    nd <- nodes[[i]]
+    if (nd$op == "null") next
+    deps <- vapply(nd$inputs + 1, function(j) {
+      if (j >= 1 && j <= length(nodes)) nodes[[j]]$name else "?"
+    }, character(1))
+    deps <- deps[vapply(nd$inputs + 1, function(j)
+      nodes[[j]]$op != "null", logical(1))]
+    cat(sprintf("  %-28s %-18s <- %s\n", nd$name, nd$op,
+                paste(deps, collapse = ", ")))
+  }
+  invisible(nodes)
+}
+
+#' Export the symbol graph as graphviz DOT (render with any dot viewer).
+#' @export
+mx.viz.dot <- function(symbol, file = NULL) {
+  nodes <- mx.viz.internal.nodes(mx.symbol.tojson(symbol))
+  lines <- c("digraph mxnet {", "  rankdir=BT;")
+  for (i in seq_along(nodes)) {
+    nd <- nodes[[i]]
+    if (nd$op == "null") next
+    lines <- c(lines, sprintf('  n%d [label="%s\\n%s"];', i, nd$name, nd$op))
+    for (j in nd$inputs + 1)
+      if (nodes[[j]]$op != "null")
+        lines <- c(lines, sprintf("  n%d -> n%d;", j, i))
+  }
+  lines <- c(lines, "}")
+  dot <- paste(lines, collapse = "\n")
+  if (!is.null(file)) writeLines(dot, file)
+  invisible(dot)
+}

@@ -6,3 +6,11 @@
 .onUnload <- function(libpath) {
   library.dynam.unload("mxnetTPU", libpath)
 }
+
+.onLoad <- function(libname, pkgname) {
+  # generate the registry-backed op surfaces (reference: the R package's
+  # generated mx.nd.* / mx.symbol.* functions) into the namespace
+  ns <- asNamespace(pkgname)
+  try(mx.nd.init.generated(envir = ns), silent = TRUE)
+  try(mx.symbol.init.generated(envir = ns), silent = TRUE)
+}

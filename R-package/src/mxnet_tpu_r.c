@@ -229,6 +229,17 @@ static SEXP floats_out(const float* data, mx_uint n) {
   return out;
 }
 
+SEXP RMX_set_aux(SEXP ex, SEXP name, SEXP value) {
+  int n = LENGTH(value);
+  float* buf = (float*)R_alloc(n, sizeof(float));
+  const double* src = REAL(value);
+  for (int i = 0; i < n; ++i) buf[i] = (float)src[i];
+  check(MXExecutorSetAux(unwrap(ex, "executor"), CHAR(STRING_ELT(name, 0)),
+                         buf, (mx_uint)n),
+        "MXExecutorSetAux");
+  return R_NilValue;
+}
+
 SEXP RMX_get_arg(SEXP ex, SEXP name) {
   const float* out = NULL;
   mx_uint n = 0;
@@ -406,6 +417,244 @@ SEXP RMX_random_seed(SEXP seed) {
   return R_NilValue;
 }
 
+/* ---- NDArray (reference: R-package/R/ndarray.R over c_api.h's NDArray
+ * family). Layout contract: an R array with dim c(d1..dk) maps to the C
+ * NDArray with REVERSED shape (dk..d1) — R's column-major bytes equal the
+ * row-major bytes of the reversed shape, so no permutation happens at the
+ * boundary (the reference R package uses the same convention). ---- */
+
+static void nd_finalizer(SEXP p) {
+  NDArrayHandle h = R_ExternalPtrAddr(p);
+  if (h) {
+    MXNDArrayFree(h);
+    R_ClearExternalPtr(p);
+  }
+}
+
+/* rdims (R dim vector) -> new zero-filled f32 NDArray with reversed shape */
+SEXP RMX_nd_create(SEXP rdims) {
+  int nd = LENGTH(rdims);
+  mx_uint shape[32];
+  if (nd > 32) Rf_error("too many dimensions");
+  for (int i = 0; i < nd; ++i)
+    shape[i] = (mx_uint)INTEGER(rdims)[nd - 1 - i];
+  NDArrayHandle h = NULL;
+  check(MXNDArrayCreateEx(shape, (mx_uint)nd, 1, 0, 0, 0, &h),
+        "MXNDArrayCreateEx");
+  return wrap_ptr(h, nd_finalizer);
+}
+
+SEXP RMX_nd_from_array(SEXP values, SEXP rdims) {
+  SEXP p = PROTECT(RMX_nd_create(rdims));  // R_alloc below may trigger GC
+  int n = LENGTH(values);
+  float* buf = (float*)R_alloc(n, sizeof(float));
+  const double* src = REAL(values);
+  for (int i = 0; i < n; ++i) buf[i] = (float)src[i];
+  check(MXNDArraySyncCopyFromCPU(R_ExternalPtrAddr(p), buf, (size_t)n),
+        "MXNDArraySyncCopyFromCPU");
+  UNPROTECT(1);
+  return p;
+}
+
+/* C shape (s1..sk) -> R dim c(sk..s1) */
+SEXP RMX_nd_shape(SEXP nd) {
+  mx_uint ndim = 0;
+  const mx_uint* shape = NULL;
+  check(MXNDArrayGetShape(unwrap(nd, "ndarray"), &ndim, &shape),
+        "MXNDArrayGetShape");
+  SEXP out = PROTECT(Rf_allocVector(INTSXP, ndim));
+  for (mx_uint i = 0; i < ndim; ++i)
+    INTEGER(out)[i] = (int)shape[ndim - 1 - i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP RMX_nd_as_array(SEXP nd) {
+  NDArrayHandle h = unwrap(nd, "ndarray");
+  mx_uint ndim = 0;
+  const mx_uint* shape = NULL;
+  check(MXNDArrayGetShape(h, &ndim, &shape), "MXNDArrayGetShape");
+  size_t n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= shape[i];
+  float* buf = (float*)R_alloc(n, sizeof(float));
+  check(MXNDArraySyncCopyToCPU(h, buf, n), "MXNDArraySyncCopyToCPU");
+  return floats_out(buf, (mx_uint)n);
+}
+
+SEXP RMX_nd_save(SEXP names, SEXP handles, SEXP path) {
+  int n = LENGTH(handles);
+  NDArrayHandle* hs = (NDArrayHandle*)R_alloc(n, sizeof(NDArrayHandle));
+  const char** ks = (const char**)R_alloc(n ? n : 1, sizeof(char*));
+  int named = 0;  /* all-empty names mean "no name table" in the format */
+  for (int i = 0; i < n; ++i) {
+    hs[i] = unwrap(VECTOR_ELT(handles, i), "ndarray");
+    ks[i] = i < LENGTH(names) ? CHAR(STRING_ELT(names, i)) : "";
+    if (ks[i][0]) named = 1;
+  }
+  check(MXNDArraySave(CHAR(STRING_ELT(path, 0)), (mx_uint)n, hs,
+                      named ? ks : NULL),
+        "MXNDArraySave");
+  return R_NilValue;
+}
+
+/* -> list(names chr, handles list) */
+SEXP RMX_nd_load(SEXP path) {
+  mx_uint n = 0, nk = 0;
+  NDArrayHandle* hs = NULL;
+  const char** ks = NULL;
+  check(MXNDArrayLoad(CHAR(STRING_ELT(path, 0)), &n, &hs, &nk, &ks),
+        "MXNDArrayLoad");
+  SEXP names = PROTECT(Rf_allocVector(STRSXP, n));
+  SEXP handles = PROTECT(Rf_allocVector(VECSXP, n));
+  for (mx_uint i = 0; i < n; ++i) {
+    SET_STRING_ELT(names, i, Rf_mkChar(nk > i && ks[i] ? ks[i] : ""));
+    SET_VECTOR_ELT(handles, i, wrap_ptr(hs[i], nd_finalizer));
+  }
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, 2));
+  SET_VECTOR_ELT(out, 0, names);
+  SET_VECTOR_ELT(out, 1, handles);
+  UNPROTECT(3);
+  return out;
+}
+
+/* ---- imperative invoke + op registry (reference: R-package generated
+ * mx.nd.* functions over MXImperativeInvoke; the creator table mirrors the
+ * python _init_ndarray_module flow, ndarray.py:2385) ---- */
+
+SEXP RMX_list_ops(void) {
+  mx_uint n = 0;
+  const char** names = NULL;
+  check(MXListAllOpNames(&n, &names), "MXListAllOpNames");
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (mx_uint i = 0; i < n; ++i) SET_STRING_ELT(out, i, Rf_mkChar(names[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+static AtomicSymbolCreator r_find_creator(const char* name) {
+  mx_uint n = 0;
+  AtomicSymbolCreator* creators = NULL;
+  if (MXSymbolListAtomicSymbolCreators(&n, &creators) != 0) return NULL;
+  for (mx_uint i = 0; i < n; ++i) {
+    const char* cname = NULL;
+    if (MXSymbolGetAtomicSymbolName(creators[i], &cname) == 0 &&
+        strcmp(cname, name) == 0)
+      return creators[i];
+  }
+  return NULL;
+}
+
+SEXP RMX_imperative_invoke(SEXP op, SEXP in_handles, SEXP pkeys, SEXP pvals) {
+  AtomicSymbolCreator creator = r_find_creator(CHAR(STRING_ELT(op, 0)));
+  if (!creator) Rf_error("unknown op: %s", CHAR(STRING_ELT(op, 0)));
+  int n_in = LENGTH(in_handles);
+  NDArrayHandle ins[64];
+  if (n_in > 64) Rf_error("too many inputs");
+  for (int i = 0; i < n_in; ++i)
+    ins[i] = unwrap(VECTOR_ELT(in_handles, i), "ndarray");
+  int np = LENGTH(pkeys);
+  const char** ks = (const char**)R_alloc(np ? np : 1, sizeof(char*));
+  const char** vs = (const char**)R_alloc(np ? np : 1, sizeof(char*));
+  for (int i = 0; i < np; ++i) {
+    ks[i] = CHAR(STRING_ELT(pkeys, i));
+    vs[i] = CHAR(STRING_ELT(pvals, i));
+  }
+  int n_out = 0;
+  NDArrayHandle* outs = NULL;
+  check(MXImperativeInvoke(creator, n_in, ins, &n_out, &outs, np, ks, vs),
+        "MXImperativeInvoke");
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, n_out));
+  for (int i = 0; i < n_out; ++i)
+    SET_VECTOR_ELT(out, i, wrap_ptr(outs[i], nd_finalizer));
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---- DataIter family (reference: R-package io over c_api.h MXDataIter*;
+ * the C iterators are CSVIter/MNISTIter etc., io.R's arrayiter is R-side) */
+
+static void iter_finalizer(SEXP p) {
+  DataIterHandle h = R_ExternalPtrAddr(p);
+  if (h) {
+    MXDataIterFree(h);
+    R_ClearExternalPtr(p);
+  }
+}
+
+SEXP RMX_io_list_iters(void) {
+  mx_uint n = 0;
+  const char** names = NULL;
+  check(MXListDataIters(&n, &names), "MXListDataIters");
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (mx_uint i = 0; i < n; ++i) SET_STRING_ELT(out, i, Rf_mkChar(names[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP RMX_io_create(SEXP name, SEXP keys, SEXP vals) {
+  int np = LENGTH(keys);
+  const char** ks = (const char**)R_alloc(np ? np : 1, sizeof(char*));
+  const char** vs = (const char**)R_alloc(np ? np : 1, sizeof(char*));
+  for (int i = 0; i < np; ++i) {
+    ks[i] = CHAR(STRING_ELT(keys, i));
+    vs[i] = CHAR(STRING_ELT(vals, i));
+  }
+  DataIterHandle h = NULL;
+  check(MXDataIterCreate(CHAR(STRING_ELT(name, 0)), (mx_uint)np, ks, vs, &h),
+        "MXDataIterCreate");
+  return wrap_ptr(h, iter_finalizer);
+}
+
+SEXP RMX_io_next(SEXP it) {
+  int out = 0;
+  check(MXDataIterNext(unwrap(it, "dataiter"), &out), "MXDataIterNext");
+  return Rf_ScalarInteger(out);
+}
+
+SEXP RMX_io_before_first(SEXP it) {
+  check(MXDataIterBeforeFirst(unwrap(it, "dataiter")),
+        "MXDataIterBeforeFirst");
+  return R_NilValue;
+}
+
+/* -> list(values dbl, rdim int): shape reversed into the R convention.
+ * The C API exposes only the DATA shape (labels are flat (batch,)). */
+static SEXP iter_batch(DataIterHandle h, int is_label) {
+  const float* data = NULL;
+  mx_uint n = 0, ndim = 0;
+  const mx_uint* shape = NULL;
+  SEXP vals, rdim;
+  if (is_label) {
+    check(MXDataIterGetLabel(h, &data, &n), "MXDataIterGetLabel");
+    vals = PROTECT(floats_out(data, n));
+    rdim = PROTECT(Rf_allocVector(INTSXP, 1));
+    INTEGER(rdim)[0] = (int)n;
+  } else {
+    check(MXDataIterGetData(h, &data, &n), "MXDataIterGetData");
+    check(MXDataIterGetDataShape(h, &shape, &ndim),
+          "MXDataIterGetDataShape");
+    vals = PROTECT(floats_out(data, n));
+    rdim = PROTECT(Rf_allocVector(INTSXP, ndim));
+    for (mx_uint i = 0; i < ndim; ++i)
+      INTEGER(rdim)[i] = (int)shape[ndim - 1 - i];
+  }
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, 2));
+  SET_VECTOR_ELT(out, 0, vals);
+  SET_VECTOR_ELT(out, 1, rdim);
+  UNPROTECT(3);
+  return out;
+}
+
+SEXP RMX_io_data(SEXP it) { return iter_batch(unwrap(it, "dataiter"), 0); }
+SEXP RMX_io_label(SEXP it) { return iter_batch(unwrap(it, "dataiter"), 1); }
+
+SEXP RMX_io_pad(SEXP it) {
+  int out = 0;
+  check(MXDataIterGetPadNum(unwrap(it, "dataiter"), &out),
+        "MXDataIterGetPadNum");
+  return Rf_ScalarInteger(out);
+}
+
 /* ---- registration ---- */
 #include <R_ext/Rdynload.h>
 
@@ -421,6 +670,7 @@ static const R_CallMethodDef call_methods[] = {
     ENTRY(RMX_symbol_infer_shape, 3),
     ENTRY(RMX_simple_bind, 6),
     ENTRY(RMX_set_arg, 3),
+    ENTRY(RMX_set_aux, 3),
     ENTRY(RMX_get_arg, 2),
     ENTRY(RMX_get_grad, 2),
     ENTRY(RMX_get_aux, 2),
@@ -441,6 +691,21 @@ static const R_CallMethodDef call_methods[] = {
     ENTRY(RMX_kv_push, 4),
     ENTRY(RMX_kv_pull, 2),
     ENTRY(RMX_random_seed, 1),
+    ENTRY(RMX_nd_create, 1),
+    ENTRY(RMX_nd_from_array, 2),
+    ENTRY(RMX_nd_shape, 1),
+    ENTRY(RMX_nd_as_array, 1),
+    ENTRY(RMX_nd_save, 3),
+    ENTRY(RMX_nd_load, 1),
+    ENTRY(RMX_list_ops, 0),
+    ENTRY(RMX_imperative_invoke, 4),
+    ENTRY(RMX_io_list_iters, 0),
+    ENTRY(RMX_io_create, 3),
+    ENTRY(RMX_io_next, 1),
+    ENTRY(RMX_io_before_first, 1),
+    ENTRY(RMX_io_data, 1),
+    ENTRY(RMX_io_label, 1),
+    ENTRY(RMX_io_pad, 1),
     {NULL, NULL, 0}};
 
 void R_init_mxnetTPU(DllInfo* dll) {

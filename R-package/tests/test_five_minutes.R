@@ -1,0 +1,81 @@
+# Port of the reference "five minutes neural network" vignette
+# (reference: R-package/vignettes/fiveMinutesNeuralNetwork.Rmd) — the
+# classification mx.mlp flow and the symbol-built regression flow, with
+# every mx.* call the vignette's. mlbench's Sonar / BostonHousing are
+# replaced by synthetic data of the same shapes (mlbench is not in CI);
+# the regression learning rate is scaled to the synthetic data.
+# Run: Rscript test_five_minutes.R
+library(mxnetTPU)
+mx.nd.init.generated(envir = globalenv())
+mx.symbol.init.generated(envir = globalenv())
+
+# ---- classification (Sonar stand-in: 208 examples x 60 features, 2 classes)
+set.seed(7)
+n <- 208; p <- 60
+centers <- matrix(rnorm(2 * p), nrow = 2) * 1.5
+lab <- rep(0:1, length.out = n)
+feats <- centers[lab + 1, ] + matrix(rnorm(n * p), nrow = n)
+train.ind <- c(1:50, 100:150)
+train.x <- data.matrix(feats[train.ind, ])
+train.y <- lab[train.ind]
+test.x <- data.matrix(feats[-train.ind, ])
+test.y <- lab[-train.ind]
+
+mx.set.seed(0)
+model <- mx.mlp(train.x, train.y, hidden_node = 10, out_node = 2,
+                out_activation = "softmax", num.round = 20,
+                array.batch.size = 15, learning.rate = 0.07,
+                momentum = 0.9, eval.metric = mx.metric.accuracy,
+                verbose = FALSE)
+
+graph.viz(model$symbol)
+
+preds <- predict(model, test.x)
+pred.label <- max.col(t(preds)) - 1
+print(table(pred.label, test.y))
+acc <- mean(pred.label == test.y)
+cat(sprintf("classification accuracy: %.4f\n", acc))
+stopifnot(acc > 0.85)
+
+# ---- regression (BostonHousing stand-in: 506 examples x 13 features)
+set.seed(11)
+nb <- 506; pb <- 13
+bx <- matrix(rnorm(nb * pb), nrow = nb)
+w.true <- rnorm(pb)
+by <- as.vector(bx %*% w.true) * 0.3 + rnorm(nb, sd = 0.1)
+train.ind <- seq(1, nb, 3)
+train.x <- data.matrix(bx[train.ind, ])
+train.y <- by[train.ind]
+test.x <- data.matrix(bx[-train.ind, ])
+test.y <- by[-train.ind]
+
+# Define the input data
+data <- mx.symbol.Variable("data")
+# A fully connected hidden layer: data input, 1 neuron (linear model)
+fc1 <- mx.symbol.FullyConnected(data, num_hidden = 1)
+# Use linear regression for the output layer
+lro <- mx.symbol.LinearRegressionOutput(fc1)
+
+mx.set.seed(0)
+model <- mx.model.FeedForward.create(
+  lro, X = train.x, y = train.y, ctx = mx.cpu(), num.round = 50,
+  array.batch.size = 20, learning.rate = 0.02, momentum = 0.9,
+  eval.metric = mx.metric.rmse, verbose = FALSE)
+
+preds <- predict(model, test.x)
+rmse <- sqrt(mean((as.vector(preds) - test.y)^2))
+cat(sprintf("regression rmse: %.4f (sd(y)=%.4f)\n", rmse, sd(test.y)))
+stopifnot(rmse < 0.5 * sd(test.y))
+
+# the vignette's custom-metric demo
+demo.metric.mae <- mx.metric.custom("mae", function(label, pred) {
+  mean(abs(as.vector(label) - as.vector(pred)))
+})
+mx.set.seed(0)
+model <- mx.model.FeedForward.create(
+  lro, X = train.x, y = train.y, ctx = mx.cpu(), num.round = 5,
+  array.batch.size = 20, learning.rate = 0.02, momentum = 0.9,
+  eval.metric = demo.metric.mae, verbose = FALSE)
+stopifnot(inherits(model, "MXFeedForwardModel"))
+
+cat("R_FIVE_MIN_OK\n")

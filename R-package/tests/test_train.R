@@ -1,17 +1,18 @@
 # R binding end-to-end test (reference: R-package/tests/): train an MLP on
-# linearly separable data to >90% accuracy through the C API, checkpoint in
-# the reference format, reload, and verify predictions survive.
-# Run: Rscript test_train.R <workdir>   (exits non-zero on failure)
+# linearly separable data to >90% accuracy through the reference-surface
+# FeedForward API, checkpoint in the reference format, reload, and verify
+# predictions survive. Run: Rscript test_train.R <workdir>
 library(mxnetTPU)
+mx.nd.init.generated(envir = globalenv())
+mx.symbol.init.generated(envir = globalenv())
 
 args <- commandArgs(trailingOnly = TRUE)
 workdir <- if (length(args) >= 1) args[1] else tempdir()
 
-set.seed(42)
 mx.set.seed(42)
 n <- 256
 p <- 10
-X <- matrix(rnorm(n * p), nrow = n)
+X <- matrix(rnorm(n * p), nrow = n)  # rowmajor: (examples, features)
 y <- as.numeric(X[, 1] + 0.5 * X[, 2] > 0)
 
 data <- mx.symbol.Variable("data")
@@ -20,26 +21,43 @@ net <- mx.symbol.Activation(data = net, act_type = "relu")
 net <- mx.symbol.FullyConnected(data = net, num_hidden = 2, name = "fc2")
 net <- mx.symbol.SoftmaxOutput(data = net, name = "softmax")
 
-# shape inference sanity
-shp <- mx.symbol.infer.shape(net, data = c(32, p))
+# shape inference in the R (column-major) convention: fc1_weight is (p, 16)
+shp <- mx.symbol.infer.shape(net, data = c(p, 32))
 stopifnot(shp$complete)
-stopifnot(identical(shp$arg.shapes[["fc1_weight"]], c(16L, as.integer(p))))
+stopifnot(identical(shp$arg.shapes[["fc1_weight"]], c(as.integer(p), 16L)))
 
-model <- mx.model.FeedForward.create(net, X, y, batch.size = 32,
-                                     num.round = 15, learning.rate = 0.2,
-                                     momentum = 0.9)
-acc <- mx.model.accuracy(model$exec, X, y, 32)
+# NDArray surface sanity: generated ops + overloads
+nd <- mx.nd.array(matrix(1:6, nrow = 2))
+stopifnot(identical(dim(nd), c(2L, 3L)))
+stopifnot(max(abs(as.array(nd * 2 + 1) - (as.array(nd) * 2 + 1))) < 1e-6)
+stopifnot(max(abs(as.array(mx.nd.square(nd)) - as.array(nd)^2)) < 1e-6)
+
+model <- mx.model.FeedForward.create(
+  net, X, y, ctx = mx.cpu(), num.round = 15, array.batch.size = 32,
+  learning.rate = 0.2, momentum = 0.9,
+  eval.metric = mx.metric.accuracy,
+  eval.data = list(data = X, label = y),
+  batch.end.callback = mx.callback.log.train.metric(5),
+  verbose = FALSE)
+
+preds <- predict(model, X)           # (classes, n)
+stopifnot(nrow(preds) == 2, ncol(preds) == n)
+acc <- mean((max.col(t(preds)) - 1) == y)
 cat(sprintf("train accuracy: %.4f\n", acc))
 stopifnot(acc > 0.90)
 
-# checkpoint round-trip (reference format)
+# checkpoint round-trip (reference format: prefix-symbol.json + .params)
 prefix <- file.path(workdir, "r_mlp")
 mx.model.save(model, prefix, iteration = 1)
-reloaded <- mx.model.load(prefix, 1,
-                          list(data = c(32L, as.integer(p)),
-                               softmax_label = c(32L)))
-p1 <- predict(model, X[1:32, ])
-p2 <- predict(reloaded, X[1:32, ])
-stopifnot(max(abs(p1 - p2)) < 1e-6)
+reloaded <- mx.model.load(prefix, 1)
+p2 <- predict(reloaded, X)
+stopifnot(max(abs(preds - p2)) < 1e-5)
+
+# data iterator surface: arrayiter feeds FeedForward directly
+it <- mx.io.arrayiter(t(X), y, batch.size = 32)
+model2 <- mx.model.FeedForward.create(
+  net, it, ctx = mx.cpu(), num.round = 3, learning.rate = 0.2,
+  momentum = 0.9, eval.metric = mx.metric.accuracy, verbose = FALSE)
+stopifnot(inherits(model2, "MXFeedForwardModel"))
 
 cat("R_BINDING_OK", acc, "\n")

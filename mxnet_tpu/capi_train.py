@@ -108,6 +108,20 @@ def _c_set_arg(cexec, name, data_bytes):
     arr[:] = flat.reshape(arr.shape).astype(arr.dtype)
 
 
+def _c_set_aux(cexec, name, data_bytes):
+    """(reference: aux states are set through MXExecutor's aux dict —
+    base_module.set_params writes both arg and aux)."""
+    arr = cexec.executor.aux_dict.get(name)
+    if arr is None:
+        raise ValueError("no auxiliary state named %s" % name)
+    flat = np.frombuffer(data_bytes, dtype=np.float32)
+    if flat.size != int(np.prod(arr.shape)):
+        raise ValueError(
+            "size mismatch for aux %s: got %d floats, need %d"
+            % (name, flat.size, int(np.prod(arr.shape))))
+    arr[:] = flat.reshape(arr.shape).astype(arr.dtype)
+
+
 def _c_get_array(cexec, which, name_or_index):
     """bytes of (arg|grad|output|aux) as float32."""
     if which == "arg":

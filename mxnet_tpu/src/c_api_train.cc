@@ -257,6 +257,23 @@ MXNET_DLL int MXSymbolListArguments(SymbolHandle sym, mx_uint* out_size,
       out_size, out_array);
 }
 
+MXNET_DLL int MXExecutorSetAux(ExecutorHandle h, const char* name,
+                               const float* data, mx_uint size) {
+  GilT gil;
+  auto* e = static_cast<CExec*>(h);
+  PyObject* blob = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), size * sizeof(float));
+  PyObject* res = PyObject_CallMethod(train_module(), "_c_set_aux", "OsO",
+                                      e->obj, name, blob);
+  Py_DECREF(blob);
+  if (!res) {
+    set_err();
+    return fail();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
 MXNET_DLL int MXExecutorSetArg(ExecutorHandle h, const char* name,
                                const float* data, mx_uint size) {
   GilT gil;

@@ -61,6 +61,8 @@ int MXExecutorSimpleBindLite(SymbolHandle sym, const char* dev_type,
 int MXExecutorInitXavier(ExecutorHandle exec, int seed);
 int MXExecutorSetArg(ExecutorHandle exec, const char* name, const float* data,
                      mx_uint size);
+int MXExecutorSetAux(ExecutorHandle exec, const char* name, const float* data,
+                     mx_uint size);
 int MXExecutorGetArg(ExecutorHandle exec, const char* name, const float** out,
                      mx_uint* out_size);
 int MXExecutorGetGrad(ExecutorHandle exec, const char* name,
@@ -97,6 +99,39 @@ int MXExecutorFree(ExecutorHandle exec);
  * c_predict_api.h's NDList family (same CArray type across the .so). ---- */
 typedef void* NDArrayHandle;
 typedef void* AtomicSymbolCreator;
+
+/* ---- NDArray host-array family (implemented in pure C++ by
+ * c_api_ndarray.cc; reference: c_api.h MXNDArrayCreate :139 and friends).
+ * Data is dtype-sized host bytes; sizes are in ELEMENTS. ---- */
+int MXNDArrayCreateNone(NDArrayHandle* out);
+int MXNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle* out);
+int MXNDArrayCreateEx(const mx_uint* shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+int MXNDArrayWaitAll(void);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint* out_dim,
+                      const mx_uint** out_pdata);
+int MXNDArrayGetData(NDArrayHandle handle, void** out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype);
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id);
+int MXNDArraySave(const char* fname, mx_uint num_args, NDArrayHandle* args,
+                  const char** keys);
+int MXNDArrayLoad(const char* fname, mx_uint* out_size,
+                  NDArrayHandle** out_arr, mx_uint* out_name_size,
+                  const char*** out_names);
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle* out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle* out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
+                     NDArrayHandle* out);
 
 int MXListAllOpNames(mx_uint* out_size, const char*** out_array);
 int MXSymbolListAtomicSymbolCreators(mx_uint* out_size,

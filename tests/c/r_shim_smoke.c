@@ -162,6 +162,65 @@ int main(int argc, char** argv) {
       fprintf(stderr, "reload mismatch\n");
       return 1;
     }
+
+  /* ---- NDArray + generated-op (imperative) path (round 5: the surface
+   * behind mx.nd.* / mx.nd.init.generated) ---- */
+  {
+    SEXP ops = RMX_list_ops();
+    if (LENGTH(ops) < 100) {
+      fprintf(stderr, "op registry too small: %d\n", LENGTH(ops));
+      return 1;
+    }
+    /* x: R dim (2,3) -> framework shape (3,2); values survive both ways */
+    double vals[6] = {1, 2, 3, 4, 5, 6};
+    int rdim[2] = {2, 3};
+    SEXP x = RMX_nd_from_array(realvec(6, vals), intvec(2, rdim));
+    SEXP shp = RMX_nd_shape(x);
+    if (LENGTH(shp) != 2 || INTEGER(shp)[0] != 2 || INTEGER(shp)[1] != 3) {
+      fprintf(stderr, "nd shape wrong\n");
+      return 1;
+    }
+    SEXP sq = RMX_imperative_invoke(str1("square"), vecsxp1(x),
+                                    strvec(0, NULL), strvec(0, NULL));
+    SEXP yv = RMX_nd_as_array(VECTOR_ELT(sq, 0));
+    for (int i = 0; i < 6; ++i)
+      if (fabs(REAL(yv)[i] - vals[i] * vals[i]) > 1e-5) {
+        fprintf(stderr, "square values wrong\n");
+        return 1;
+      }
+    /* attr marshaling: _plus_scalar(x, scalar=10) */
+    {
+      const char* pk[1] = {"scalar"};
+      const char* pv[1] = {"10"};
+      SEXP ps = RMX_imperative_invoke(str1("_plus_scalar"), vecsxp1(x),
+                                      strvec(1, pk), strvec(1, pv));
+      SEXP pvout = RMX_nd_as_array(VECTOR_ELT(ps, 0));
+      for (int i = 0; i < 6; ++i)
+        if (fabs(REAL(pvout)[i] - (vals[i] + 10)) > 1e-5) {
+          fprintf(stderr, "_plus_scalar values wrong\n");
+          return 1;
+        }
+    }
+    /* save/load the reference container through the shim */
+    char ndfile[512];
+    snprintf(ndfile, sizeof ndfile, "%s/r_shim_nd.params", workdir);
+    const char* nm[1] = {"arg:w"};
+    RMX_nd_save(strvec(1, nm), vecsxp1(x), str1(ndfile));
+    SEXP loaded = RMX_nd_load(str1(ndfile));
+    SEXP lnames = VECTOR_ELT(loaded, 0);
+    SEXP lhandles = VECTOR_ELT(loaded, 1);
+    if (LENGTH(lhandles) != 1 ||
+        strcmp(CHAR(STRING_ELT(lnames, 0)), "arg:w") != 0) {
+      fprintf(stderr, "nd load names wrong\n");
+      return 1;
+    }
+    SEXP lv = RMX_nd_as_array(VECTOR_ELT(lhandles, 0));
+    for (int i = 0; i < 6; ++i)
+      if (fabs(REAL(lv)[i] - vals[i]) > 1e-6) {
+        fprintf(stderr, "nd load values wrong\n");
+        return 1;
+      }
+  }
   printf("OK\n");
   return 0;
 }
