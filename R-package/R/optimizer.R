@@ -86,14 +86,16 @@ mx.opt.adam <- function(learning.rate = 0.001, beta1 = 0.9, beta2 = 0.999,
                         epsilon = 1e-8, wd = 0, rescale.grad = 1,
                         clip_gradient = NULL, lr_scheduler = NULL) {
   env <- mx.opt.internal.env(learning.rate)
-  env$time <- 0
   create.state <- function(index, weight) {
-    list(mean = mx.nd.zeros(dim(weight)), var = mx.nd.zeros(dim(weight)))
+    # time lives per index (the reference keeps per-key counters): one
+    # tick per optimization step for each parameter
+    list(mean = mx.nd.zeros(dim(weight)), var = mx.nd.zeros(dim(weight)),
+         time = 0)
   }
   update <- function(index, weight, grad, state) {
     lr <- mx.opt.internal.tick(env, index, lr_scheduler)
-    env$time <- env$time + 1
-    t <- env$time
+    state$time <- state$time + 1
+    t <- state$time
     grad <- mx.opt.internal.clip(grad * rescale.grad, clip_gradient)
     grad <- grad + wd * weight
     mean <- beta1 * state$mean + (1 - beta1) * grad
@@ -101,7 +103,8 @@ mx.opt.adam <- function(learning.rate = 0.001, beta1 = 0.9, beta2 = 0.999,
     coef <- lr * sqrt(1 - beta2^t) / (1 - beta1^t)
     weight <- weight - coef * mean /
       (mx.nd.invoke("sqrt", var) + epsilon)
-    list(weight = weight, state = list(mean = mean, var = var))
+    list(weight = weight,
+         state = list(mean = mean, var = var, time = t))
   }
   list(create.state = create.state, update = update)
 }

@@ -9,30 +9,34 @@
 # brace depth, not by regex.
 mx.viz.internal.nodes <- function(json) {
   start <- regexpr('"nodes"\\s*:\\s*\\[', json)
-  chars <- strsplit(substring(json, start + attr(start, "match.length")),
-                    "")[[1]]
-  chunks <- character(0)
+  body <- substring(json, start + attr(start, "match.length"))
+  # walk only the structural tokens (quotes/braces/array close), not every
+  # character — keeps parsing linear in the JSON size
+  toks <- gregexpr('["{}\\]]', body)[[1]]
+  tok.chars <- substring(body, toks, toks)
   depth <- 0
-  buf <- character(0)
   in.str <- FALSE
-  for (ch in chars) {
+  obj.start <- integer(0)
+  obj.end <- integer(0)
+  for (k in seq_along(toks)) {
+    ch <- tok.chars[k]
     if (in.str) {
-      buf <- c(buf, ch)
       if (ch == '"') in.str <- FALSE
       next
     }
-    if (ch == '"') in.str <- TRUE
-    if (ch == "{") depth <- depth + 1
-    if (depth > 0) buf <- c(buf, ch)
-    if (ch == "}") {
+    if (ch == '"') {
+      in.str <- TRUE
+    } else if (ch == "{") {
+      depth <- depth + 1
+      if (depth == 1) obj.start <- c(obj.start, toks[k])
+    } else if (ch == "}") {
       depth <- depth - 1
-      if (depth == 0) {
-        chunks <- c(chunks, paste(buf, collapse = ""))
-        buf <- character(0)
-      }
+      if (depth == 0) obj.end <- c(obj.end, toks[k])
+    } else if (ch == "]" && depth == 0) {
+      break
     }
-    if (ch == "]" && depth == 0) break
   }
+  chunks <- substring(body, obj.start, obj.end)
   lapply(chunks, function(ch) {
     op <- sub('.*?"op"\\s*:\\s*"([^"]*)".*', "\\1", ch)
     name <- sub('.*?"name"\\s*:\\s*"([^"]*)".*', "\\1", ch)

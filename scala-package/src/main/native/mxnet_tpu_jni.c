@@ -382,3 +382,442 @@ JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_kvFree(
   (void)cls;
   MXKVStoreFree((KVStoreHandle)(intptr_t)kv);
 }
+
+/* ---- KVStore init/push/pull (reference: KVStore.scala over
+ * MXKVStoreInit/Push/Pull; float buffers, int keys) ---- */
+/* copy a jintArray shape into shp[32]; throws and returns -1 on overflow */
+static int jni_shape_of(JNIEnv* env, jintArray shape, mx_uint* shp) {
+  int ndim = (*env)->GetArrayLength(env, shape);
+  if (ndim > 32) {
+    jclass ecls = (*env)->FindClass(env, "java/lang/RuntimeException");
+    (*env)->ThrowNew(env, ecls, "too many dimensions (max 32)");
+    return -1;
+  }
+  jint* s = (*env)->GetIntArrayElements(env, shape, 0);
+  for (int i = 0; i < ndim; ++i) shp[i] = (mx_uint)s[i];
+  (*env)->ReleaseIntArrayElements(env, shape, s, 0);
+  return ndim;
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_kvInit(
+    JNIEnv* env, jclass cls, jlong kv, jint key, jfloatArray value,
+    jintArray shape) {
+  (void)cls;
+  mx_uint shp[32];
+  int ndim = jni_shape_of(env, shape, shp);
+  if (ndim < 0) return;
+  jfloat* v = (*env)->GetFloatArrayElements(env, value, 0);
+  int rc = MXKVStoreInit((KVStoreHandle)(intptr_t)kv, key, v, shp,
+                         (mx_uint)ndim);
+  (*env)->ReleaseFloatArrayElements(env, value, v, 0);
+  CHECK_OR(env, rc, "MXKVStoreInit", );
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_kvPush(
+    JNIEnv* env, jclass cls, jlong kv, jint key, jfloatArray value,
+    jintArray shape) {
+  (void)cls;
+  mx_uint shp[32];
+  int ndim = jni_shape_of(env, shape, shp);
+  if (ndim < 0) return;
+  jfloat* v = (*env)->GetFloatArrayElements(env, value, 0);
+  int rc = MXKVStorePush((KVStoreHandle)(intptr_t)kv, key, v, shp,
+                         (mx_uint)ndim);
+  (*env)->ReleaseFloatArrayElements(env, value, v, 0);
+  CHECK_OR(env, rc, "MXKVStorePush", );
+}
+
+JNIEXPORT jfloatArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_kvPull(
+    JNIEnv* env, jclass cls, jlong kv, jint key) {
+  (void)cls;
+  const float* out = NULL;
+  mx_uint n = 0;
+  CHECK_OR(env, MXKVStorePull((KVStoreHandle)(intptr_t)kv, key, &out, &n),
+           "MXKVStorePull", NULL);
+  jfloatArray arr = (*env)->NewFloatArray(env, (jsize)n);
+  (*env)->SetFloatArrayRegion(env, arr, 0, (jsize)n, out);
+  return arr;
+}
+
+/* ---- Executor aux states ---- */
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_setAux(
+    JNIEnv* env, jclass cls, jlong ex, jstring name, jfloatArray value) {
+  (void)cls;
+  const char* n = (*env)->GetStringUTFChars(env, name, 0);
+  jfloat* v = (*env)->GetFloatArrayElements(env, value, 0);
+  int len = (*env)->GetArrayLength(env, value);
+  int rc = MXExecutorSetAux((ExecutorHandle)(intptr_t)ex, n, v,
+                            (mx_uint)len);
+  (*env)->ReleaseFloatArrayElements(env, value, v, 0);
+  (*env)->ReleaseStringUTFChars(env, name, n);
+  CHECK_OR(env, rc, "MXExecutorSetAux", );
+}
+
+JNIEXPORT jfloatArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_getAux(
+    JNIEnv* env, jclass cls, jlong ex, jstring name) {
+  (void)cls;
+  const char* n = (*env)->GetStringUTFChars(env, name, 0);
+  const float* out = NULL;
+  mx_uint sz = 0;
+  int rc = MXExecutorGetAux((ExecutorHandle)(intptr_t)ex, n, &out, &sz);
+  (*env)->ReleaseStringUTFChars(env, name, n);
+  CHECK_OR(env, rc, "MXExecutorGetAux", NULL);
+  jfloatArray arr = (*env)->NewFloatArray(env, (jsize)sz);
+  (*env)->SetFloatArrayRegion(env, arr, 0, (jsize)sz, out);
+  return arr;
+}
+
+/* ---- NDArray (reference: NDArray.scala over c_api.h's NDArray family;
+ * shapes are framework-order, row-major, like the reference JVM binding)
+ * ---- */
+JNIEXPORT jlong JNICALL Java_ml_mxnettpu_LibMXNetTPU_ndFromArray(
+    JNIEnv* env, jclass cls, jfloatArray values, jintArray shape) {
+  (void)cls;
+  mx_uint shp[32];
+  int ndim = jni_shape_of(env, shape, shp);
+  if (ndim < 0) return 0;
+  NDArrayHandle h = NULL;
+  CHECK_OR(env, MXNDArrayCreateEx(shp, (mx_uint)ndim, 1, 0, 0, 0, &h),
+           "MXNDArrayCreateEx", 0);
+  jfloat* v = (*env)->GetFloatArrayElements(env, values, 0);
+  int n = (*env)->GetArrayLength(env, values);
+  int rc = MXNDArraySyncCopyFromCPU(h, v, (size_t)n);
+  (*env)->ReleaseFloatArrayElements(env, values, v, 0);
+  if (rc != 0) {
+    MXNDArrayFree(h);
+    throw_err(env, "MXNDArraySyncCopyFromCPU");
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jintArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_ndShape(
+    JNIEnv* env, jclass cls, jlong nd) {
+  (void)cls;
+  mx_uint ndim = 0;
+  const mx_uint* shape = NULL;
+  CHECK_OR(env,
+           MXNDArrayGetShape((NDArrayHandle)(intptr_t)nd, &ndim, &shape),
+           "MXNDArrayGetShape", NULL);
+  jintArray out = (*env)->NewIntArray(env, (jsize)ndim);
+  for (mx_uint i = 0; i < ndim; ++i) {
+    jint v = (jint)shape[i];
+    (*env)->SetIntArrayRegion(env, out, (jsize)i, 1, &v);
+  }
+  return out;
+}
+
+JNIEXPORT jfloatArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_ndToArray(
+    JNIEnv* env, jclass cls, jlong nd) {
+  (void)cls;
+  mx_uint ndim = 0;
+  const mx_uint* shape = NULL;
+  NDArrayHandle h = (NDArrayHandle)(intptr_t)nd;
+  CHECK_OR(env, MXNDArrayGetShape(h, &ndim, &shape), "MXNDArrayGetShape",
+           NULL);
+  size_t n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= shape[i];
+  float* buf = (float*)malloc((n ? n : 1) * sizeof(float));
+  int rc = MXNDArraySyncCopyToCPU(h, buf, n);
+  if (rc != 0) {
+    free(buf);
+    throw_err(env, "MXNDArraySyncCopyToCPU");
+    return NULL;
+  }
+  jfloatArray out = (*env)->NewFloatArray(env, (jsize)n);
+  (*env)->SetFloatArrayRegion(env, out, 0, (jsize)n, buf);
+  free(buf);
+  return out;
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_ndSave(
+    JNIEnv* env, jclass cls, jobjectArray names, jlongArray handles,
+    jstring path) {
+  (void)cls;
+  StrList ks = get_strings(env, names);
+  jlong* hs = (*env)->GetLongArrayElements(env, handles, 0);
+  int n = (*env)->GetArrayLength(env, handles);
+  NDArrayHandle* nh =
+      (NDArrayHandle*)malloc((n ? n : 1) * sizeof(NDArrayHandle));
+  int named = 0;
+  for (int i = 0; i < n; ++i) {
+    nh[i] = (NDArrayHandle)(intptr_t)hs[i];
+    if (i < ks.n && ks.utf[i][0]) named = 1;
+  }
+  const char* p = (*env)->GetStringUTFChars(env, path, 0);
+  int rc = MXNDArraySave(p, (mx_uint)n, nh, named ? ks.utf : NULL);
+  (*env)->ReleaseStringUTFChars(env, path, p);
+  free(nh);
+  release_strings(env, &ks);
+  (*env)->ReleaseLongArrayElements(env, handles, hs, 0);
+  CHECK_OR(env, rc, "MXNDArraySave", );
+}
+
+/* one parse: returns Object[2] = { String[] names, long[] handles }
+ * (reference NDArray.load returns names + arrays together) */
+JNIEXPORT jobjectArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_ndLoad(
+    JNIEnv* env, jclass cls, jstring path) {
+  (void)cls;
+  const char* p = (*env)->GetStringUTFChars(env, path, 0);
+  mx_uint n = 0, nk = 0;
+  NDArrayHandle* hs = NULL;
+  const char** ks = NULL;
+  int rc = MXNDArrayLoad(p, &n, &hs, &nk, &ks);
+  (*env)->ReleaseStringUTFChars(env, path, p);
+  CHECK_OR(env, rc, "MXNDArrayLoad", NULL);
+  jclass str_cls = (*env)->FindClass(env, "java/lang/String");
+  jobjectArray names = (*env)->NewObjectArray(env, (jsize)n, str_cls, NULL);
+  for (mx_uint i = 0; i < n; ++i) {
+    jstring s = (*env)->NewStringUTF(env, i < nk && ks[i] ? ks[i] : "");
+    (*env)->SetObjectArrayElement(env, names, (jsize)i, s);
+    (*env)->DeleteLocalRef(env, s);
+  }
+  jlongArray handles = (*env)->NewLongArray(env, (jsize)n);
+  for (mx_uint i = 0; i < n; ++i) {
+    jlong v = (jlong)(intptr_t)hs[i];
+    (*env)->SetLongArrayRegion(env, handles, (jsize)i, 1, &v);
+  }
+  jclass obj_cls = (*env)->FindClass(env, "java/lang/Object");
+  jobjectArray out = (*env)->NewObjectArray(env, 2, obj_cls, NULL);
+  (*env)->SetObjectArrayElement(env, out, 0, (jobject)names);
+  (*env)->SetObjectArrayElement(env, out, 1, (jobject)handles);
+  return out;
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_ndFree(
+    JNIEnv* env, jclass cls, jlong nd) {
+  (void)env;
+  (void)cls;
+  MXNDArrayFree((NDArrayHandle)(intptr_t)nd);
+}
+
+/* ---- op registry + imperative invoke (reference: the macro-generated
+ * NDArray function surface over MXImperativeInvoke) ---- */
+JNIEXPORT jobjectArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_listOps(
+    JNIEnv* env, jclass cls) {
+  (void)cls;
+  mx_uint n = 0;
+  const char** names = NULL;
+  CHECK_OR(env, MXListAllOpNames(&n, &names), "MXListAllOpNames", NULL);
+  jclass str_cls = (*env)->FindClass(env, "java/lang/String");
+  jobjectArray out = (*env)->NewObjectArray(env, (jsize)n, str_cls, NULL);
+  for (mx_uint i = 0; i < n; ++i) {
+    jstring s = (*env)->NewStringUTF(env, names[i]);
+    (*env)->SetObjectArrayElement(env, out, (jsize)i, s);
+    (*env)->DeleteLocalRef(env, s);
+  }
+  return out;
+}
+
+static AtomicSymbolCreator jni_find_creator(const char* name) {
+  mx_uint n = 0;
+  AtomicSymbolCreator* creators = NULL;
+  if (MXSymbolListAtomicSymbolCreators(&n, &creators) != 0) return NULL;
+  for (mx_uint i = 0; i < n; ++i) {
+    const char* cname = NULL;
+    if (MXSymbolGetAtomicSymbolName(creators[i], &cname) == 0 &&
+        strcmp(cname, name) == 0)
+      return creators[i];
+  }
+  return NULL;
+}
+
+JNIEXPORT jlongArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_imperativeInvoke(
+    JNIEnv* env, jclass cls, jstring op, jlongArray inputs,
+    jobjectArray pkeys, jobjectArray pvals) {
+  (void)cls;
+  const char* opn = (*env)->GetStringUTFChars(env, op, 0);
+  AtomicSymbolCreator creator = jni_find_creator(opn);
+  (*env)->ReleaseStringUTFChars(env, op, opn);
+  if (!creator) {
+    jclass ecls = (*env)->FindClass(env, "java/lang/RuntimeException");
+    (*env)->ThrowNew(env, ecls, "unknown op");
+    return NULL;
+  }
+  int n_in = (*env)->GetArrayLength(env, inputs);
+  if (n_in > 64) {  /* fail loudly; truncating would compute wrong results */
+    jclass ecls = (*env)->FindClass(env, "java/lang/RuntimeException");
+    (*env)->ThrowNew(env, ecls, "too many inputs (max 64)");
+    return NULL;
+  }
+  jlong* in = (*env)->GetLongArrayElements(env, inputs, 0);
+  NDArrayHandle ins[64];
+  for (int i = 0; i < n_in; ++i) ins[i] = (NDArrayHandle)(intptr_t)in[i];
+  (*env)->ReleaseLongArrayElements(env, inputs, in, 0);
+  StrList pk = get_strings(env, pkeys);
+  StrList pv = get_strings(env, pvals);
+  int n_out = 0;
+  NDArrayHandle* outs = NULL;
+  int rc = MXImperativeInvoke(creator, n_in, ins, &n_out, &outs, pk.n,
+                              pk.utf, pv.utf);
+  release_strings(env, &pk);
+  release_strings(env, &pv);
+  CHECK_OR(env, rc, "MXImperativeInvoke", NULL);
+  jlongArray out = (*env)->NewLongArray(env, (jsize)n_out);
+  for (int i = 0; i < n_out; ++i) {
+    jlong v = (jlong)(intptr_t)outs[i];
+    (*env)->SetLongArrayRegion(env, out, (jsize)i, 1, &v);
+  }
+  return out;
+}
+
+/* ---- DataIter family (reference: IO.scala over MXDataIter*) ---- */
+JNIEXPORT jobjectArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_ioListIters(
+    JNIEnv* env, jclass cls) {
+  (void)cls;
+  mx_uint n = 0;
+  const char** names = NULL;
+  CHECK_OR(env, MXListDataIters(&n, &names), "MXListDataIters", NULL);
+  jclass str_cls = (*env)->FindClass(env, "java/lang/String");
+  jobjectArray out = (*env)->NewObjectArray(env, (jsize)n, str_cls, NULL);
+  for (mx_uint i = 0; i < n; ++i) {
+    jstring s = (*env)->NewStringUTF(env, names[i]);
+    (*env)->SetObjectArrayElement(env, out, (jsize)i, s);
+    (*env)->DeleteLocalRef(env, s);
+  }
+  return out;
+}
+
+JNIEXPORT jlong JNICALL Java_ml_mxnettpu_LibMXNetTPU_ioCreate(
+    JNIEnv* env, jclass cls, jstring name, jobjectArray keys,
+    jobjectArray vals) {
+  (void)cls;
+  StrList ks = get_strings(env, keys);
+  StrList vs = get_strings(env, vals);
+  const char* n = (*env)->GetStringUTFChars(env, name, 0);
+  DataIterHandle h = NULL;
+  int rc = MXDataIterCreate(n, (mx_uint)ks.n, ks.utf, vs.utf, &h);
+  (*env)->ReleaseStringUTFChars(env, name, n);
+  release_strings(env, &ks);
+  release_strings(env, &vs);
+  CHECK_OR(env, rc, "MXDataIterCreate", 0);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jint JNICALL Java_ml_mxnettpu_LibMXNetTPU_ioNext(
+    JNIEnv* env, jclass cls, jlong it) {
+  (void)cls;
+  int out = 0;
+  CHECK_OR(env, MXDataIterNext((DataIterHandle)(intptr_t)it, &out),
+           "MXDataIterNext", 0);
+  return out;
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_ioBeforeFirst(
+    JNIEnv* env, jclass cls, jlong it) {
+  (void)cls;
+  CHECK_OR(env, MXDataIterBeforeFirst((DataIterHandle)(intptr_t)it),
+           "MXDataIterBeforeFirst", );
+}
+
+JNIEXPORT jfloatArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_ioData(
+    JNIEnv* env, jclass cls, jlong it) {
+  (void)cls;
+  const float* data = NULL;
+  mx_uint n = 0;
+  CHECK_OR(env, MXDataIterGetData((DataIterHandle)(intptr_t)it, &data, &n),
+           "MXDataIterGetData", NULL);
+  jfloatArray out = (*env)->NewFloatArray(env, (jsize)n);
+  (*env)->SetFloatArrayRegion(env, out, 0, (jsize)n, data);
+  return out;
+}
+
+JNIEXPORT jintArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_ioDataShape(
+    JNIEnv* env, jclass cls, jlong it) {
+  (void)cls;
+  const mx_uint* shape = NULL;
+  mx_uint ndim = 0;
+  CHECK_OR(env,
+           MXDataIterGetDataShape((DataIterHandle)(intptr_t)it, &shape,
+                                  &ndim),
+           "MXDataIterGetDataShape", NULL);
+  jintArray out = (*env)->NewIntArray(env, (jsize)ndim);
+  for (mx_uint i = 0; i < ndim; ++i) {
+    jint v = (jint)shape[i];
+    (*env)->SetIntArrayRegion(env, out, (jsize)i, 1, &v);
+  }
+  return out;
+}
+
+JNIEXPORT jfloatArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_ioLabel(
+    JNIEnv* env, jclass cls, jlong it) {
+  (void)cls;
+  const float* data = NULL;
+  mx_uint n = 0;
+  CHECK_OR(env, MXDataIterGetLabel((DataIterHandle)(intptr_t)it, &data, &n),
+           "MXDataIterGetLabel", NULL);
+  jfloatArray out = (*env)->NewFloatArray(env, (jsize)n);
+  (*env)->SetFloatArrayRegion(env, out, 0, (jsize)n, data);
+  return out;
+}
+
+JNIEXPORT jint JNICALL Java_ml_mxnettpu_LibMXNetTPU_ioPad(
+    JNIEnv* env, jclass cls, jlong it) {
+  (void)cls;
+  int out = 0;
+  CHECK_OR(env, MXDataIterGetPadNum((DataIterHandle)(intptr_t)it, &out),
+           "MXDataIterGetPadNum", 0);
+  return out;
+}
+
+JNIEXPORT void JNICALL Java_ml_mxnettpu_LibMXNetTPU_ioFree(
+    JNIEnv* env, jclass cls, jlong it) {
+  (void)env;
+  (void)cls;
+  MXDataIterFree((DataIterHandle)(intptr_t)it);
+}
+
+/* ---- shape inference (reference: MXSymbolInferShape; flat-encoded
+ * return: [complete, nArg, (ndim, dims..)*nArg, nOut, (..)*, nAux, (..)*]
+ * — JNI returns one array, the Scala side decodes) ---- */
+JNIEXPORT jintArray JNICALL Java_ml_mxnettpu_LibMXNetTPU_inferShape(
+    JNIEnv* env, jclass cls, jlong sym, jobjectArray keys,
+    jintArray shapeData, jintArray shapeIdx) {
+  (void)cls;
+  StrList ks = get_strings(env, keys);
+  jint* data = (*env)->GetIntArrayElements(env, shapeData, 0);
+  jint* idx = (*env)->GetIntArrayElements(env, shapeIdx, 0);
+  int nd = (*env)->GetArrayLength(env, shapeData);
+  int ni = (*env)->GetArrayLength(env, shapeIdx);
+  mx_uint* ud = (mx_uint*)malloc((nd ? nd : 1) * sizeof(mx_uint));
+  mx_uint* ui = (mx_uint*)malloc((ni ? ni : 1) * sizeof(mx_uint));
+  for (int i = 0; i < nd; ++i) ud[i] = (mx_uint)data[i];
+  for (int i = 0; i < ni; ++i) ui[i] = (mx_uint)idx[i];
+  (*env)->ReleaseIntArrayElements(env, shapeData, data, 0);
+  (*env)->ReleaseIntArrayElements(env, shapeIdx, idx, 0);
+  mx_uint in_sz, out_sz, aux_sz;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_d, **out_d, **aux_d;
+  int complete = 0;
+  int rc = MXSymbolInferShape((SymbolHandle)(intptr_t)sym, (mx_uint)ks.n,
+                              ks.utf, ui, ud, &in_sz, &in_nd, &in_d,
+                              &out_sz, &out_nd, &out_d, &aux_sz, &aux_nd,
+                              &aux_d, &complete);
+  free(ud);
+  free(ui);
+  release_strings(env, &ks);
+  CHECK_OR(env, rc, "MXSymbolInferShape", NULL);
+  size_t total = 4;  /* complete + three counts */
+  const mx_uint* sizes[3] = {&in_sz, &out_sz, &aux_sz};
+  const mx_uint* nds[3] = {in_nd, out_nd, aux_nd};
+  for (int t = 0; t < 3; ++t)
+    for (mx_uint i = 0; i < *sizes[t]; ++i) total += 1 + nds[t][i];
+  jintArray out = (*env)->NewIntArray(env, (jsize)total);
+  jsize pos = 0;
+  jint v = complete;
+  (*env)->SetIntArrayRegion(env, out, pos++, 1, &v);
+  const mx_uint** ds[3] = {in_d, out_d, aux_d};
+  for (int t = 0; t < 3; ++t) {
+    v = (jint)*sizes[t];
+    (*env)->SetIntArrayRegion(env, out, pos++, 1, &v);
+    for (mx_uint i = 0; i < *sizes[t]; ++i) {
+      v = (jint)nds[t][i];
+      (*env)->SetIntArrayRegion(env, out, pos++, 1, &v);
+      for (mx_uint j = 0; j < nds[t][i]; ++j) {
+        v = (jint)ds[t][i][j];
+        (*env)->SetIntArrayRegion(env, out, pos++, 1, &v);
+      }
+    }
+  }
+  return out;
+}

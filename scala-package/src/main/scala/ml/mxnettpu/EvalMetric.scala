@@ -1,0 +1,63 @@
+package ml.mxnettpu
+
+/** Evaluation metrics (reference:
+  * scala-package/core/src/main/scala/ml/dmlc/mxnet/EvalMetric.scala —
+  * stateful update(labels, preds)/get/reset protocol; Accuracy and MSE
+  * instances plus a custom-function metric).
+  */
+abstract class EvalMetric(val name: String) {
+  protected var sumMetric: Float = 0f
+  protected var numInst: Int = 0
+
+  /** preds is (batch, classes) row-major, labels (batch,). */
+  def update(labels: Array[Float], preds: Array[Float],
+             predShape: Array[Int]): Unit
+
+  def get: (String, Float) =
+    (name, if (numInst == 0) Float.NaN else sumMetric / numInst)
+
+  def reset(): Unit = {
+    sumMetric = 0f
+    numInst = 0
+  }
+}
+
+class Accuracy extends EvalMetric("accuracy") {
+  override def update(labels: Array[Float], preds: Array[Float],
+                      predShape: Array[Int]): Unit = {
+    val classes = predShape.last
+    for (i <- labels.indices) {
+      var best = 0
+      for (c <- 1 until classes)
+        if (preds(i * classes + c) > preds(i * classes + best)) best = c
+      if (best == labels(i).toInt) sumMetric += 1
+      numInst += 1
+    }
+  }
+}
+
+class MSE extends EvalMetric("mse") {
+  override def update(labels: Array[Float], preds: Array[Float],
+                      predShape: Array[Int]): Unit = {
+    val per = preds.length / labels.length
+    for (i <- labels.indices) {
+      var s = 0f
+      for (j <- 0 until per) {
+        val d = preds(i * per + j) - labels(i)
+        s += d * d
+      }
+      sumMetric += s / per
+      numInst += 1
+    }
+  }
+}
+
+/** Metric from a function (reference: CustomMetric). */
+class CustomMetric(fEval: (Array[Float], Array[Float]) => Float,
+                   name: String = "custom") extends EvalMetric(name) {
+  override def update(labels: Array[Float], preds: Array[Float],
+                      predShape: Array[Int]): Unit = {
+    sumMetric += fEval(labels, preds)
+    numInst += 1
+  }
+}

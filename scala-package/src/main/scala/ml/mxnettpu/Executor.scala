@@ -9,6 +9,9 @@ class Executor private[mxnettpu] (private[mxnettpu] val handle: Long) {
     LibMXNetTPU.lib.setArg(handle, name, value)
   def getArg(name: String): Array[Float] = LibMXNetTPU.lib.getArg(handle, name)
   def getGrad(name: String): Array[Float] = LibMXNetTPU.lib.getGrad(handle, name)
+  def setAux(name: String, value: Array[Float]): Unit =
+    LibMXNetTPU.lib.setAux(handle, name, value)
+  def getAux(name: String): Array[Float] = LibMXNetTPU.lib.getAux(handle, name)
   def forward(isTrain: Boolean = false): Unit =
     LibMXNetTPU.lib.forward(handle, if (isTrain) 1 else 0)
   def backward(): Unit = LibMXNetTPU.lib.backward(handle)

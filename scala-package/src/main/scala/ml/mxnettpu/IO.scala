@@ -1,0 +1,91 @@
+package ml.mxnettpu
+
+/** Data iterators (reference:
+  * scala-package/core/src/main/scala/ml/dmlc/mxnet/IO.scala — DataBatch,
+  * the DataIter trait, NDArrayIter, and the C-backed MXDataIter created
+  * by registry name).
+  */
+case class DataBatch(data: Array[Float], dataShape: Array[Int],
+                     label: Array[Float], pad: Int)
+
+trait DataIter {
+  def reset(): Unit
+  def hasNext: Boolean
+  def next(): DataBatch
+}
+
+/** Iterator over in-memory arrays (reference: NDArrayIter). `data` is
+  * row-major (batchable first axis = examples); the last partial batch
+  * pads by wrapping, with `pad` reporting the wrapped count.
+  */
+class NDArrayIter(data: Array[Float], dataShape: Array[Int],
+                  label: Array[Float], batchSize: Int,
+                  shuffle: Boolean = false) extends DataIter {
+  require(dataShape.head == label.length,
+          "first data axis must match label length")
+  private val n = dataShape.head
+  private val feat = dataShape.product / n
+  private var cursor = 0
+  private var order = (0 until n).toArray
+  private val rng = new scala.util.Random(0)
+
+  override def reset(): Unit = {
+    cursor = 0
+    if (shuffle) order = rng.shuffle(order.toSeq).toArray
+  }
+
+  override def hasNext: Boolean = cursor < n
+
+  override def next(): DataBatch = {
+    val idx = (cursor until cursor + batchSize).map(i =>
+      if (i < n) order(i) else order(0))
+    val pad = math.max(0, cursor + batchSize - n)
+    cursor += batchSize
+    val d = new Array[Float](batchSize * feat)
+    val l = new Array[Float](batchSize)
+    for ((row, k) <- idx.zipWithIndex) {
+      System.arraycopy(data, row * feat, d, k * feat, feat)
+      l(k) = label(row)
+    }
+    DataBatch(d, Array(batchSize) ++ dataShape.tail, l, pad)
+  }
+}
+
+/** C-backed iterator by registry name (reference: the generated
+  * IO.CSVIter etc. over MXDataIterCreateIter). */
+class MXDataIter private[mxnettpu] (handle: Long) extends DataIter {
+  private var fetched: Option[Boolean] = None
+  override def reset(): Unit = {
+    LibMXNetTPU.lib.ioBeforeFirst(handle)
+    fetched = None  // a drained iterator must not stay cached-exhausted
+  }
+  override def hasNext: Boolean = {
+    if (fetched.isEmpty) fetched = Some(LibMXNetTPU.lib.ioNext(handle) == 1)
+    fetched.get
+  }
+  override def next(): DataBatch = {
+    if (!hasNext) throw new NoSuchElementException
+    fetched = None
+    DataBatch(LibMXNetTPU.lib.ioData(handle),
+              LibMXNetTPU.lib.ioDataShape(handle),
+              LibMXNetTPU.lib.ioLabel(handle),
+              LibMXNetTPU.lib.ioPad(handle))
+  }
+  def dispose(): Unit = LibMXNetTPU.lib.ioFree(handle)
+}
+
+object IO {
+  /** Registered C-side iterator names (reference: IO.scala initIOModule
+    * over MXListDataIters). */
+  def listIters(): Array[String] = LibMXNetTPU.lib.ioListIters()
+
+  /** Create a C-side iterator: IO.createIterator("CSVIter",
+    * Seq("data_csv" -> path, "data_shape" -> "(3)", "batch_size" -> 8)).
+    */
+  def createIterator(name: String,
+                     params: Seq[(String, Any)]): MXDataIter = {
+    val keys = params.map(_._1).toArray
+    val vals = params.map { case (_, v) => Symbol.paramStr(v) }.toArray
+    new MXDataIter(LibMXNetTPU.lib.ioCreate(name, keys, vals))
+  }
+}

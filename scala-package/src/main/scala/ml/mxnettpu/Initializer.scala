@@ -1,0 +1,57 @@
+package ml.mxnettpu
+
+/** Weight initializers (reference:
+  * scala-package/core/src/main/scala/ml/dmlc/mxnet/Initializer.scala —
+  * apply(name, shape) with the reference name rules: *_bias/*_beta/
+  * *_moving_mean zero, *_gamma/*_moving_var one, weights through the
+  * concrete initializer).
+  */
+abstract class Initializer(seed: Int = 0) {
+  protected val rng = new scala.util.Random(seed)
+
+  def apply(name: String, shape: Array[Int]): Array[Float] = {
+    if (name.endsWith("bias") || name.endsWith("beta") ||
+        name.endsWith("moving_mean"))
+      new Array[Float](shape.product)
+    else if (name.endsWith("gamma") || name.endsWith("moving_var"))
+      Array.fill(shape.product)(1f)
+    else initWeight(name, shape)
+  }
+
+  protected def initWeight(name: String, shape: Array[Int]): Array[Float]
+}
+
+class Uniform(scale: Float = 0.07f, seed: Int = 0) extends Initializer(seed) {
+  override protected def initWeight(name: String,
+                                    shape: Array[Int]): Array[Float] =
+    Array.fill(shape.product)((rng.nextFloat() * 2 - 1) * scale)
+}
+
+class Normal(sigma: Float = 0.01f, seed: Int = 0) extends Initializer(seed) {
+  override protected def initWeight(name: String,
+                                    shape: Array[Int]): Array[Float] =
+    Array.fill(shape.product)(rng.nextGaussian().toFloat * sigma)
+}
+
+class Xavier(rndType: String = "uniform", factorType: String = "avg",
+             magnitude: Float = 3f, seed: Int = 0) extends Initializer(seed) {
+  override protected def initWeight(name: String,
+                                    shape: Array[Int]): Array[Float] = {
+    val fanOut = shape.head.toFloat
+    val fanIn = (shape.product / shape.head).toFloat
+    val factor = factorType match {
+      case "avg" => (fanIn + fanOut) / 2
+      case "in" => fanIn
+      case "out" => fanOut
+      case other => throw new IllegalArgumentException(other)
+    }
+    val scale = math.sqrt(magnitude / factor).toFloat
+    rndType match {
+      case "uniform" =>
+        Array.fill(shape.product)((rng.nextFloat() * 2 - 1) * scale)
+      case "gaussian" =>
+        Array.fill(shape.product)(rng.nextGaussian().toFloat * scale)
+      case other => throw new IllegalArgumentException(other)
+    }
+  }
+}

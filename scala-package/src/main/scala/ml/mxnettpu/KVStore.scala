@@ -1,0 +1,30 @@
+package ml.mxnettpu
+
+/** Parameter store (reference:
+  * scala-package/core/src/main/scala/ml/dmlc/mxnet/KVStore.scala —
+  * create by type string, init/push/pull by integer key; aggregation runs
+  * inside the framework's KVStore).
+  */
+class KVStore private[mxnettpu] (private[mxnettpu] val handle: Long) {
+  def rank: Int = LibMXNetTPU.lib.kvRank(handle)
+  def numWorkers: Int = LibMXNetTPU.lib.kvNumWorkers(handle)
+
+  def init(key: Int, value: NDArray): Unit =
+    LibMXNetTPU.lib.kvInit(handle, key, value.toArray, value.shape)
+
+  def push(key: Int, value: NDArray): Unit =
+    LibMXNetTPU.lib.kvPush(handle, key, value.toArray, value.shape)
+
+  /** Pull the aggregated value (flat float32; reshape with the key's
+    * known shape). */
+  def pull(key: Int): Array[Float] = LibMXNetTPU.lib.kvPull(handle, key)
+
+  def dispose(): Unit = LibMXNetTPU.lib.kvFree(handle)
+}
+
+object KVStore {
+  /** Create by type string — "local", "device", "dist_sync", ...
+    * (reference: KVStore.create). */
+  def create(kvType: String = "local"): KVStore =
+    new KVStore(LibMXNetTPU.lib.kvCreate(kvType))
+}

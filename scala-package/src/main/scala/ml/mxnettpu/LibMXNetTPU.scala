@@ -26,6 +26,9 @@ class LibMXNetTPU {
   @native def symbolArguments(sym: Long): Array[String]
   @native def symbolOutputs(sym: Long): Array[String]
   @native def symbolFree(sym: Long): Unit
+  @native def inferShape(sym: Long, keys: Array[String],
+                         shapeData: Array[Int],
+                         shapeIdx: Array[Int]): Array[Int]
 
   // Executor
   @native def simpleBind(sym: Long, dev: String, devId: Int,
@@ -47,11 +50,44 @@ class LibMXNetTPU {
   @native def loadParams(ex: Long, path: String): Int
   @native def executorFree(ex: Long): Unit
 
+  @native def setAux(ex: Long, name: String, value: Array[Float]): Unit
+  @native def getAux(ex: Long, name: String): Array[Float]
+
   // KVStore
   @native def kvCreate(kvType: String): Long
   @native def kvRank(kv: Long): Int
   @native def kvNumWorkers(kv: Long): Int
+  @native def kvInit(kv: Long, key: Int, value: Array[Float],
+                     shape: Array[Int]): Unit
+  @native def kvPush(kv: Long, key: Int, value: Array[Float],
+                     shape: Array[Int]): Unit
+  @native def kvPull(kv: Long, key: Int): Array[Float]
   @native def kvFree(kv: Long): Unit
+
+  // NDArray + imperative ops
+  @native def ndFromArray(values: Array[Float], shape: Array[Int]): Long
+  @native def ndShape(nd: Long): Array[Int]
+  @native def ndToArray(nd: Long): Array[Float]
+  @native def ndSave(names: Array[String], handles: Array[Long],
+                     path: String): Unit
+  @native def ndLoad(path: String): Array[AnyRef]
+  @native def ndFree(nd: Long): Unit
+  @native def listOps(): Array[String]
+  @native def imperativeInvoke(op: String, inputs: Array[Long],
+                               paramKeys: Array[String],
+                               paramVals: Array[String]): Array[Long]
+
+  // DataIter family
+  @native def ioListIters(): Array[String]
+  @native def ioCreate(name: String, keys: Array[String],
+                       vals: Array[String]): Long
+  @native def ioNext(it: Long): Int
+  @native def ioBeforeFirst(it: Long): Unit
+  @native def ioData(it: Long): Array[Float]
+  @native def ioDataShape(it: Long): Array[Int]
+  @native def ioLabel(it: Long): Array[Float]
+  @native def ioPad(it: Long): Int
+  @native def ioFree(it: Long): Unit
 }
 
 object LibMXNetTPU {

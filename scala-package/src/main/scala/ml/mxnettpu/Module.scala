@@ -1,0 +1,116 @@
+package ml.mxnettpu
+
+/** Module-shaped trainer (reference:
+  * scala-package/core/src/main/scala/ml/dmlc/mxnet/module/Module.scala —
+  * the bind -> initParams -> initOptimizer -> forward/backward/update
+  * lifecycle over one executor, with fit() driving a DataIter and an
+  * EvalMetric the way BaseModule.fit does).
+  */
+class Module(symbol: Symbol, dataName: String = "data",
+             labelName: String = "softmax_label", ctx: String = "cpu") {
+  private var exec: Executor = _
+  private var argNames: Array[String] = _
+  private var paramNames: Array[String] = _
+  private var auxNames: Array[String] = Array.empty
+  private var optimizer: Optimizer = _
+  private var optStates: Map[String, AnyRef] = Map.empty
+  private var batchSize = 0
+
+  def bound: Boolean = exec != null
+
+  def bind(dataShape: Array[Int], labelShape: Array[Int],
+           gradReq: String = "write"): Unit = {
+    batchSize = dataShape.head
+    exec = symbol.simpleBind(ctx = ctx, gradReq = gradReq,
+                             shapes = Seq(dataName -> dataShape,
+                                          labelName -> labelShape))
+    argNames = symbol.arguments
+    paramNames = argNames.filterNot(n => n == dataName || n == labelName)
+    val (argShapes, _, auxShapes) =
+      symbol.inferShape(Seq(dataName -> dataShape))
+    this.inferred = argShapes
+  }
+
+  private var inferred: Map[String, Array[Int]] = Map.empty
+
+  /** Initialize parameters with a Scala-side initializer (reference:
+    * Module.initParams). */
+  def initParams(initializer: Initializer = new Xavier()): Unit = {
+    require(bound, "call bind first")
+    for (name <- paramNames; shape <- inferred.get(name))
+      exec.setArg(name, initializer(name, shape))
+  }
+
+  /** Load parameters from a reference-format .params map. */
+  def setParams(params: Map[String, NDArray]): Unit = {
+    require(bound, "call bind first")
+    for ((k, v) <- params) {
+      if (k.startsWith("arg:")) exec.setArg(k.substring(4), v.toArray)
+      else if (k.startsWith("aux:")) exec.setAux(k.substring(4), v.toArray)
+    }
+  }
+
+  def initOptimizer(opt: Optimizer): Unit = {
+    require(bound, "call bind first")
+    optimizer = opt
+    optStates = paramNames.map { n =>
+      n -> optimizer.createState(0, exec.getArg(n))
+    }.toMap
+  }
+
+  def forward(batch: DataBatch, isTrain: Boolean = true): Unit = {
+    exec.setArg(dataName, batch.data)
+    if (isTrain) exec.setArg(labelName, batch.label)
+    exec.forward(isTrain)
+  }
+
+  def backward(): Unit = exec.backward()
+
+  /** Apply the Scala optimizer to every parameter (reference:
+    * Module.update; gradients are batch-summed, the optimizer's
+    * rescaleGrad carries 1/batch). */
+  def update(): Unit = {
+    require(optimizer != null, "call initOptimizer first")
+    var i = 0
+    for (name <- paramNames) {
+      val w = exec.getArg(name)
+      optimizer.update(i, w, exec.getGrad(name), optStates(name))
+      exec.setArg(name, w)
+      i += 1
+    }
+  }
+
+  def outputs: Array[Float] = exec.output(0)
+  def outputShape: Array[Int] = exec.outputShape(0)
+
+  /** The reference BaseModule.fit loop: per epoch, drive the iterator
+    * through forward/backward/update and feed the metric. */
+  def fit(data: DataIter, numEpoch: Int, metric: EvalMetric): Unit = {
+    for (_ <- 0 until numEpoch) {
+      metric.reset()
+      data.reset()
+      while (data.hasNext) {
+        val batch = data.next()
+        forward(batch, isTrain = true)
+        metric.update(batch.label, outputs, outputShape)
+        backward()
+        update()
+      }
+    }
+  }
+
+  def score(data: DataIter, metric: EvalMetric): (String, Float) = {
+    metric.reset()
+    data.reset()
+    while (data.hasNext) {
+      val batch = data.next()
+      forward(batch, isTrain = false)
+      metric.update(batch.label, outputs, outputShape)
+    }
+    data.reset()
+    metric.get
+  }
+
+  def saveCheckpoint(path: String): Unit = exec.saveParams(path)
+  def loadCheckpoint(path: String): Int = exec.loadParams(path)
+}

@@ -1,0 +1,89 @@
+package ml.mxnettpu
+
+/** Imperative host tensor (reference:
+  * scala-package/core/src/main/scala/ml/dmlc/mxnet/NDArray.scala — the
+  * NDArray class with its macro-generated operator surface). Shapes are
+  * framework-order (row-major), like the reference JVM binding. The
+  * reference's compile-time macro generation collapses here to the
+  * runtime-generic `NDArray.invoke` over the same registry
+  * (`NDArray.listOps` enumerates it); arithmetic operators forward to the
+  * same fused element-wise ops the reference dispatches to.
+  */
+class NDArray private[mxnettpu] (private[mxnettpu] val handle: Long) {
+  def shape: Array[Int] = LibMXNetTPU.lib.ndShape(handle)
+  def size: Int = shape.product
+  def toArray: Array[Float] = LibMXNetTPU.lib.ndToArray(handle)
+  def dispose(): Unit = LibMXNetTPU.lib.ndFree(handle)
+
+  private def binary(op: String, other: NDArray): NDArray =
+    NDArray.invoke(op, Seq(this, other)).head
+  private def scalarOp(op: String, v: Float): NDArray =
+    NDArray.invoke(op, Seq(this), Seq("scalar" -> v)).head
+
+  def +(other: NDArray): NDArray = binary("_plus", other)
+  def -(other: NDArray): NDArray = binary("_minus", other)
+  def *(other: NDArray): NDArray = binary("_mul", other)
+  def /(other: NDArray): NDArray = binary("_div", other)
+  def +(v: Float): NDArray = scalarOp("_plus_scalar", v)
+  def -(v: Float): NDArray = scalarOp("_minus_scalar", v)
+  def *(v: Float): NDArray = scalarOp("_mul_scalar", v)
+  def /(v: Float): NDArray = scalarOp("_div_scalar", v)
+
+  def copy(): NDArray = NDArray.array(toArray, shape)
+}
+
+object NDArray {
+  /** Every registered operator name (reference: the registry the Scala
+    * macros generate from; MXListAllOpNames). */
+  def listOps(): Array[String] = LibMXNetTPU.lib.listOps()
+
+  /** Generic operator application — the runtime form of the reference's
+    * generated per-op methods: NDArray.invoke("dot", Seq(a, b)) or
+    * NDArray.invoke("sum", Seq(x), Seq("axis" -> 0)). */
+  def invoke(op: String, inputs: Seq[NDArray],
+             params: Seq[(String, Any)] = Nil): IndexedSeq[NDArray] = {
+    val pk = params.map(_._1).toArray
+    val pv = params.map { case (_, v) => Symbol.paramStr(v) }.toArray
+    LibMXNetTPU.lib
+      .imperativeInvoke(op, inputs.map(_.handle).toArray, pk, pv)
+      .toIndexedSeq
+      .map(new NDArray(_))
+  }
+
+  def array(values: Array[Float], shape: Array[Int]): NDArray = {
+    require(values.length == shape.product,
+            s"${values.length} values for shape ${shape.mkString("x")}")
+    new NDArray(LibMXNetTPU.lib.ndFromArray(values, shape))
+  }
+
+  def zeros(shape: Array[Int]): NDArray =
+    array(Array.fill(shape.product)(0f), shape)
+
+  def ones(shape: Array[Int]): NDArray =
+    array(Array.fill(shape.product)(1f), shape)
+
+  /** Save named arrays in the reference .params container (interchanges
+    * with the Python side and the reference). */
+  def save(path: String, arrays: Map[String, NDArray]): Unit = {
+    val names = arrays.keys.toArray
+    LibMXNetTPU.lib.ndSave(names, names.map(arrays(_).handle), path)
+  }
+
+  /** Load as (names, arrays) — names are empty strings for a bare-list
+    * file (reference: NDArray.load). */
+  def load(path: String): (Array[String], Array[NDArray]) = {
+    val parts = LibMXNetTPU.lib.ndLoad(path)
+    val names = parts(0).asInstanceOf[Array[String]]
+    val handles = parts(1).asInstanceOf[Array[Long]]
+    (names, handles.map(new NDArray(_)))
+  }
+
+  /** Load as a map; rejects unnamed entries rather than silently
+    * collapsing them (reference: NDArray.load2Map). */
+  def load2Map(path: String): Map[String, NDArray] = {
+    val (names, arrays) = load(path)
+    require(names.forall(_.nonEmpty),
+            s"$path holds unnamed arrays; use NDArray.load")
+    names.zip(arrays).toMap
+  }
+}

@@ -1,0 +1,86 @@
+package ml.mxnettpu
+
+/** Optimizers (reference:
+  * scala-package/core/src/main/scala/ml/dmlc/mxnet/Optimizer.scala and
+  * optimizer/SGD.scala, Adam.scala — createState/update per weight index,
+  * with the same rescale/clip/wd conventions).
+  */
+abstract class Optimizer extends Serializable {
+  protected var lrScale: Map[Int, Float] = Map.empty
+  def createState(index: Int, weight: Array[Float]): AnyRef
+  def update(index: Int, weight: Array[Float], grad: Array[Float],
+             state: AnyRef): Unit
+
+  protected def rescaleAndClip(grad: Array[Float], rescale: Float,
+                               clip: Float): Array[Float] = {
+    val g = grad.map(_ * rescale)
+    if (clip > 0f) g.map(v => math.max(-clip, math.min(clip, v)))
+    else g
+  }
+}
+
+/** SGD with momentum (reference: optimizer/SGD.scala). */
+class SGD(val learningRate: Float = 0.01f, val momentum: Float = 0f,
+          val wd: Float = 0f, val rescaleGrad: Float = 1f,
+          val clipGradient: Float = 0f) extends Optimizer {
+
+  override def createState(index: Int, weight: Array[Float]): AnyRef =
+    if (momentum == 0f) null else new Array[Float](weight.length)
+
+  override def update(index: Int, weight: Array[Float], grad: Array[Float],
+                      state: AnyRef): Unit = {
+    val g = rescaleAndClip(grad, rescaleGrad, clipGradient)
+    if (state == null) {
+      var i = 0
+      while (i < weight.length) {
+        weight(i) -= learningRate * (g(i) + wd * weight(i))
+        i += 1
+      }
+    } else {
+      val mom = state.asInstanceOf[Array[Float]]
+      var i = 0
+      while (i < weight.length) {
+        mom(i) = momentum * mom(i) - learningRate * (g(i) + wd * weight(i))
+        weight(i) += mom(i)
+        i += 1
+      }
+    }
+  }
+}
+
+/** Adam (reference: optimizer/Adam.scala). */
+class Adam(val learningRate: Float = 0.001f, val beta1: Float = 0.9f,
+           val beta2: Float = 0.999f, val epsilon: Float = 1e-8f,
+           val wd: Float = 0f, val rescaleGrad: Float = 1f,
+           val clipGradient: Float = 0f) extends Optimizer {
+
+  // per-state step counter (reference Adam keeps time per index: one tick
+  // per optimization STEP for each parameter, not per update() call)
+  private class AdamState(n: Int) {
+    val mean = new Array[Float](n)
+    val variance = new Array[Float](n)
+    var time = 0
+  }
+
+  override def createState(index: Int, weight: Array[Float]): AnyRef =
+    new AdamState(weight.length)
+
+  override def update(index: Int, weight: Array[Float], grad: Array[Float],
+                      state: AnyRef): Unit = {
+    val s = state.asInstanceOf[AdamState]
+    s.time += 1
+    val g = rescaleAndClip(grad, rescaleGrad, clipGradient)
+    val coef = (learningRate *
+      math.sqrt(1 - math.pow(beta2, s.time)) /
+      (1 - math.pow(beta1, s.time))).toFloat
+    var i = 0
+    while (i < weight.length) {
+      val gi = g(i) + wd * weight(i)
+      s.mean(i) = beta1 * s.mean(i) + (1 - beta1) * gi
+      s.variance(i) = beta2 * s.variance(i) + (1 - beta2) * gi * gi
+      weight(i) -= coef * s.mean(i) /
+        (math.sqrt(s.variance(i)).toFloat + epsilon)
+      i += 1
+    }
+  }
+}

@@ -11,6 +11,30 @@ class Symbol private[mxnettpu] (private[mxnettpu] val handle: Long) {
   def outputs: Array[String] = LibMXNetTPU.lib.symbolOutputs(handle)
   def dispose(): Unit = LibMXNetTPU.lib.symbolFree(handle)
 
+  /** Infer all shapes from known input shapes (reference: Symbol.scala
+    * inferShape). Returns (argShapes by name, outShapes, auxShapes). */
+  def inferShape(shapes: Seq[(String, Array[Int])])
+      : (Map[String, Array[Int]], IndexedSeq[Array[Int]],
+         IndexedSeq[Array[Int]]) = {
+    val keys = shapes.map(_._1).toArray
+    val data = shapes.flatMap(_._2).toArray
+    val idx = shapes.scanLeft(0)(_ + _._2.length).toArray
+    val flat = LibMXNetTPU.lib.inferShape(handle, keys, data, idx)
+    var pos = 1  // flat(0) = complete flag
+    def takeGroup(): IndexedSeq[Array[Int]] = {
+      val n = flat(pos); pos += 1
+      (0 until n).map { _ =>
+        val ndim = flat(pos); pos += 1
+        val dims = flat.slice(pos, pos + ndim); pos += ndim
+        dims
+      }
+    }
+    val args = takeGroup()
+    val outs = takeGroup()
+    val auxs = takeGroup()
+    (arguments.zip(args).toMap, outs, auxs)
+  }
+
   def simpleBind(ctx: String = "cpu", devId: Int = 0,
                  gradReq: String = "write",
                  shapes: Seq[(String, Array[Int])]): Executor = {
@@ -42,7 +66,7 @@ object Symbol {
     new Symbol(LibMXNetTPU.lib.symbolCreate(op, name, pk, pv, ik, ih))
   }
 
-  private def paramStr(v: Any): String = v match {
+  private[mxnettpu] def paramStr(v: Any): String = v match {
     case arr: Array[_] => arr.mkString("(", ", ", ")")
     case seq: Seq[_] => seq.mkString("(", ", ", ")")
     case other => other.toString

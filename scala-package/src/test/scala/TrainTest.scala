@@ -3,7 +3,11 @@ import ml.mxnettpu._
 /** End-to-end JVM test (runs under the JDK tier of
   * tests/test_scala_binding.py): trains an MLP on linearly separable data
   * to >90% and writes a reference-format checkpoint that the Python
-  * Module loads. Mirrors the reference scala-package's train tests.
+  * Module loads. Mirrors the reference scala-package's train tests, then
+  * drives the round-5 surface: NDArray + imperative ops, NDArrayIter,
+  * Module.fit with a Scala optimizer/metric, KVStore, and the ported
+  * reference TrainMnist getMlp network (reference:
+  * scala-package/examples/.../imclassification/TrainMnist.scala:31-38).
   */
 object TrainTest {
   def main(args: Array[String]): Unit = {
@@ -31,6 +35,67 @@ object TrainTest {
     println(f"train accuracy: $acc%.4f")
     require(acc > 0.90, s"accuracy too low: $acc")
     model.saveCheckpoint(s"$workdir/scala_mlp", 1)
+
+    // ---- NDArray + imperative ops ----
+    val nd = NDArray.array(Array(1f, 2f, 3f, 4f, 5f, 6f), Array(2, 3))
+    require(nd.shape.sameElements(Array(2, 3)))
+    val sq = NDArray.invoke("square", Seq(nd)).head
+    require(sq.toArray.zip(nd.toArray).forall { case (s, v) =>
+      math.abs(s - v * v) < 1e-5 })
+    val twice = nd * 2f + 1f
+    require(math.abs(twice.toArray(0) - 3f) < 1e-5)
+    require(NDArray.listOps().length > 100)
+    NDArray.save(s"$workdir/scala_nd.params", Map("arg:w" -> nd))
+    val loaded = NDArray.load2Map(s"$workdir/scala_nd.params")
+    require(loaded.contains("arg:w") &&
+            loaded("arg:w").toArray.sameElements(nd.toArray))
+
+    // ---- infer shape ----
+    val (argShapes, _, _) = net.inferShape(Seq("data" -> Array(32, p)))
+    require(argShapes("fc1_weight").sameElements(Array(16, p)))
+
+    // ---- Module.fit over an NDArrayIter with a Scala optimizer ----
+    // the MLP is the ported reference TrainMnist.getMlp (128/64/10)
+    val d2 = Symbol.Variable("data")
+    val fc1 = Symbol.create("FullyConnected", "fc1", Seq("data" -> d2),
+                            Seq("num_hidden" -> 128))
+    val act1 = Symbol.create("Activation", "relu1", Seq("data" -> fc1),
+                             Seq("act_type" -> "relu"))
+    val fc2 = Symbol.create("FullyConnected", "fc2", Seq("data" -> act1),
+                            Seq("num_hidden" -> 64))
+    val act2 = Symbol.create("Activation", "relu2", Seq("data" -> fc2),
+                             Seq("act_type" -> "relu"))
+    val fc3 = Symbol.create("FullyConnected", "fc3", Seq("data" -> act2),
+                            Seq("num_hidden" -> 10))
+    val mlp = Symbol.create("SoftmaxOutput", "softmax", Seq("data" -> fc3))
+
+    val y10 = Array.tabulate(n)(i => (i % 10).toFloat)
+    val x10 = Array.tabulate(n * p) { j =>
+      val i = j / p
+      (if (j % p == i % 10) 3f else 0f) + rng.nextGaussian().toFloat * 0.3f
+    }
+    val iter = new NDArrayIter(x10, Array(n, p), y10, batchSize = 32,
+                               shuffle = true)
+    val mod = new Module(mlp)
+    mod.bind(Array(32, p), Array(32))
+    mod.initParams(new Xavier(seed = 3))
+    mod.initOptimizer(new SGD(learningRate = 0.1f, momentum = 0.9f,
+                              rescaleGrad = 1f / 32))
+    val metric = new Accuracy
+    mod.fit(iter, numEpoch = 20, metric)
+    val (mname, macc) = mod.score(iter, new Accuracy)
+    println(f"module $mname: $macc%.4f")
+    require(macc > 0.9, s"module accuracy too low: $macc")
+    mod.saveCheckpoint(s"$workdir/scala_module.params")
+
+    // ---- KVStore init/push/pull ----
+    val kv = KVStore.create("local")
+    val w = NDArray.array(Array(1f, 1f, 1f, 1f), Array(4))
+    kv.init(7, w)
+    kv.push(7, NDArray.array(Array(0.5f, -0.5f, 2f, 0f), Array(4)))
+    require(kv.pull(7).length == 4)
+    kv.dispose()
+
     println("SCALA_BINDING_OK " + acc)
   }
 }

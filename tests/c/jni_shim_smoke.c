@@ -112,6 +112,18 @@ static jint S_ThrowNew(JNIEnv* env, jclass cls, const char* msg) {
            cls && cls->utf ? cls->utf : "?", msg ? msg : "");
   return 0;
 }
+static jlongArray S_NewLongArray(JNIEnv* env, jsize n) {
+  (void)env;
+  jlongArray a = new_obj();
+  a->len = n;
+  a->longs = (jlong*)calloc(n ? n : 1, sizeof(jlong));
+  return a;
+}
+static void S_SetLongArrayRegion(JNIEnv* env, jlongArray a, jsize start,
+                                 jsize n, const jlong* src) {
+  (void)env;
+  memcpy(a->longs + start, src, n * sizeof(jlong));
+}
 
 static struct JNINativeInterface_ g_table = {
     0, {0},
@@ -121,7 +133,7 @@ static struct JNINativeInterface_ g_table = {
     S_GetIntArrayElements, S_ReleaseIntArrayElements,
     S_GetFloatArrayElements, S_ReleaseFloatArrayElements, S_NewFloatArray,
     S_SetFloatArrayRegion, S_NewIntArray, S_SetIntArrayRegion, S_FindClass,
-    S_ThrowNew, S_DeleteLocalRef};
+    S_ThrowNew, S_DeleteLocalRef, S_NewLongArray, S_SetLongArrayRegion};
 static const struct JNINativeInterface_* g_env = &g_table;
 static JNIEnv* ENV = &g_env;
 
@@ -297,6 +309,99 @@ int main(int argc, char** argv) {
     }
   Java_ml_mxnettpu_LibMXNetTPU_executorFree(ENV, NULL, ex);
   Java_ml_mxnettpu_LibMXNetTPU_executorFree(ENV, NULL, ex2);
+
+  /* ---- round 5: NDArray + imperative ops, infer-shape, KVStore
+   * init/push/pull — the surface behind NDArray.scala / Module.scala /
+   * KVStore.scala ---- */
+  {
+    jobjectArray ops = Java_ml_mxnettpu_LibMXNetTPU_listOps(ENV, NULL);
+    CHECK_EXC();
+    if (ops->len < 100) { fprintf(stderr, "op list small\n"); return 1; }
+
+    float vals[6] = {1, 2, 3, 4, 5, 6};
+    jint shp[2] = {2, 3};
+    jlong nd = Java_ml_mxnettpu_LibMXNetTPU_ndFromArray(
+        ENV, NULL, jfloats(6, vals), jints(2, shp));
+    CHECK_EXC();
+    jintArray backshape = Java_ml_mxnettpu_LibMXNetTPU_ndShape(ENV, NULL, nd);
+    if (backshape->len != 2 || backshape->ints[0] != 2 ||
+        backshape->ints[1] != 3) {
+      fprintf(stderr, "nd shape wrong\n");
+      return 1;
+    }
+    jlong in1[1] = {nd};
+    jlongArray sq = Java_ml_mxnettpu_LibMXNetTPU_imperativeInvoke(
+        ENV, NULL, js("square"), jlongs(1, in1), jstrs(0, NULL),
+        jstrs(0, NULL));
+    CHECK_EXC();
+    jfloatArray sqv = Java_ml_mxnettpu_LibMXNetTPU_ndToArray(
+        ENV, NULL, sq->longs[0]);
+    for (int i = 0; i < 6; ++i)
+      if (fabsf(sqv->floats[i] - vals[i] * vals[i]) > 1e-5f) {
+        fprintf(stderr, "square wrong\n");
+        return 1;
+      }
+
+    /* nd save/load round trip in the reference container */
+    char ndfile[512];
+    snprintf(ndfile, sizeof ndfile, "%s/jni_nd.params", workdir);
+    const char* nm[1] = {"arg:w"};
+    Java_ml_mxnettpu_LibMXNetTPU_ndSave(ENV, NULL, jstrs(1, nm),
+                                        jlongs(1, in1), js(ndfile));
+    CHECK_EXC();
+    jobjectArray lres = Java_ml_mxnettpu_LibMXNetTPU_ndLoad(ENV, NULL,
+                                                            js(ndfile));
+    CHECK_EXC();
+    jobjectArray ln = (jobjectArray)lres->objs[0];
+    jlongArray lh = (jlongArray)lres->objs[1];
+    if (lh->len != 1 || strcmp(ln->objs[0]->utf, "arg:w") != 0) {
+      fprintf(stderr, "nd load wrong\n");
+      return 1;
+    }
+
+    /* infer shape: fc1_weight of the trained net is (16, P) */
+    {
+      const char* ikeys[1] = {"data"};
+      jint sdata[2] = {BS, P};
+      jint sidx[2] = {0, 2};
+      jintArray flat = Java_ml_mxnettpu_LibMXNetTPU_inferShape(
+          ENV, NULL, net, jstrs(1, ikeys), jints(2, sdata), jints(2, sidx));
+      CHECK_EXC();
+      if (flat->ints[0] != 1) { fprintf(stderr, "incomplete\n"); return 1; }
+      /* decode group 1 (args): entry 1 is fc1_weight (arg order:
+       * data, fc1_weight, fc1_bias, ...) */
+      int pos = 1;
+      int n_args = flat->ints[pos++];
+      if (n_args < 2) { fprintf(stderr, "args missing\n"); return 1; }
+      pos += 1 + flat->ints[pos];  /* skip data's shape */
+      int ndim = flat->ints[pos++];
+      if (ndim != 2 || flat->ints[pos] != 16 || flat->ints[pos + 1] != P) {
+        fprintf(stderr, "fc1_weight infer wrong\n");
+        return 1;
+      }
+    }
+
+    /* kvstore init/push/pull aggregation identity */
+    {
+      jlong kv = Java_ml_mxnettpu_LibMXNetTPU_kvCreate(ENV, NULL,
+                                                       js("local"));
+      CHECK_EXC();
+      float w0[4] = {1, 1, 1, 1};
+      float g0[4] = {0.5f, -0.5f, 2, 0};
+      jint kshp[1] = {4};
+      Java_ml_mxnettpu_LibMXNetTPU_kvInit(ENV, NULL, kv, 3,
+                                          jfloats(4, w0), jints(1, kshp));
+      Java_ml_mxnettpu_LibMXNetTPU_kvPush(ENV, NULL, kv, 3,
+                                          jfloats(4, g0), jints(1, kshp));
+      jfloatArray pulled = Java_ml_mxnettpu_LibMXNetTPU_kvPull(ENV, NULL,
+                                                               kv, 3);
+      CHECK_EXC();
+      if (pulled->len != 4) { fprintf(stderr, "kv pull len\n"); return 1; }
+      Java_ml_mxnettpu_LibMXNetTPU_kvFree(ENV, NULL, kv);
+    }
+    Java_ml_mxnettpu_LibMXNetTPU_ndFree(ENV, NULL, nd);
+    Java_ml_mxnettpu_LibMXNetTPU_ndFree(ENV, NULL, sq->longs[0]);
+  }
   Java_ml_mxnettpu_LibMXNetTPU_symbolFree(ENV, NULL, net);
   printf("OK\n");
   return 0;

@@ -69,6 +69,9 @@ struct JNINativeInterface_ {
   jclass (*FindClass)(JNIEnv*, const char*);
   jint (*ThrowNew)(JNIEnv*, jclass, const char*);
   void (*DeleteLocalRef)(JNIEnv*, jobject);
+  jlongArray (*NewLongArray)(JNIEnv*, jsize);
+  void (*SetLongArrayRegion)(JNIEnv*, jlongArray, jsize, jsize,
+                             const jlong*);
 };
 
 #endif /* MXTPU_JNI_STUB_H_ */
