@@ -203,7 +203,7 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
                           optimizer_params=None, initializer=None,
                           arg_params=None, aux_params=None, platform="tpu",
                           matmul_precision="highest", seed=0,
-                          compute_dtype=None):
+                          compute_dtype=None, num_devices=1):
     """AOT-export a full TRAINING step into a ``.mxa`` file (kind="train").
 
     Goes beyond the reference's deployment stack: its amalgamation/predict
@@ -236,6 +236,16 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
     recipe into the artifact (same as the fused fit path: fp32 master
     params and optimizer slots at the boundary, bf16 graph compute, fp32
     gradients through the cast); the flat C signature stays float32.
+
+    ``num_devices=N`` exports a data-parallel SPMD step: params/optimizer
+    state replicate, data/label shard on the batch axis (N must divide the
+    batch), and XLA's GSPMD partitioner inserts the gradient all-reduce —
+    the math is identical to the single-device step. The manifest carries
+    per-arg sharding tags plus the serialized compile options
+    (num_partitions=N), and the native runtime executes across N
+    addressable PJRT devices from the one file. Export needs N visible
+    devices of ``platform`` (on a pod host they are the chips; in CI,
+    XLA_FLAGS=--xla_force_host_platform_device_count virtualizes CPUs).
     """
     import jax
     import jax.numpy as jnp
@@ -258,6 +268,24 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
 
     data_shapes = [(n, s) for n, s in shapes.items() if n not in label_like]
     label_shapes = [(n, shapes[n]) for n in label_like if n in shapes]
+
+    # SPMD preconditions are validated BEFORE any initializer runs: a
+    # failed export must not consume RNG draws (it would silently change
+    # the next export's initial weights in the same process)
+    if num_devices > 1:
+        for n, _ in data_shapes + label_shapes:
+            shp = shapes[n]
+            if not shp or shp[0] % num_devices != 0:
+                raise ValueError(
+                    "num_devices=%d must divide input '%s' batch dim %r"
+                    % (num_devices, n, shp[:1]))
+        if len(jax.devices(platform)) < num_devices:
+            raise ValueError(
+                "export with num_devices=%d needs %d visible %s devices "
+                "(found %d); on CPU set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count"
+                % (num_devices, num_devices, platform,
+                   len(jax.devices(platform))))
 
     mesh = build_mesh({"dp": 1}, list(jax.devices("cpu"))[:1])
     trainer = SPMDTrainer(symbol, mesh, data_shapes=data_shapes,
@@ -355,9 +383,45 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
         + [jax.ShapeDtypeStruct((), f32), jax.ShapeDtypeStruct((), np.int32)]
     )
 
+    # ---- SPMD shardings (num_devices > 1): dp over the batch axis --------
+    compile_options_b64 = None
+    in_shard_tags = ["rep"] * len(in_specs)
+    out_shard_tags = None
+    jit_kwargs = dict(donate_argnums=donate)
+    if num_devices > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        devs = list(jax.devices(platform))  # presence validated up front
+        emesh = Mesh(np.array(devs[:num_devices]), ("dp",))
+        rep = NamedSharding(emesh, PartitionSpec())
+        batched = NamedSharding(emesh, PartitionSpec("dp"))
+        n_fixed = n_params + n_states + n_auxs
+        in_shardings = [rep] * n_fixed
+        for k in range(len(data_shapes) + len(label_shapes)):
+            in_shard_tags[n_fixed + k] = "batch"
+            in_shardings.append(batched)
+        in_shardings += [rep, rep]  # lr, t
+        out_avals_probe = jax.eval_shape(flat_step, *in_specs)
+        # only outputs whose leading dim IS the global batch shard; a
+        # divisibility-only test would mis-tag hidden-dim outputs and buy a
+        # pointless per-step reshard
+        global_batch = shapes[data_shapes[0][0]][0] if data_shapes else -1
+        out_shardings, out_shard_tags = [], []
+        for k, o in enumerate(out_avals_probe):
+            if (k >= n_fixed and len(o.shape)
+                    and o.shape[0] == global_batch):
+                out_shardings.append(batched)
+                out_shard_tags.append("batch")
+            else:
+                out_shardings.append(rep)
+                out_shard_tags.append("rep")
+        jit_kwargs.update(in_shardings=tuple(in_shardings),
+                          out_shardings=tuple(out_shardings))
+        compile_options_b64 = _spmd_compile_options_b64(num_devices)
+
     with jax.default_matmul_precision(matmul_precision):
         exported = jax.export.export(
-            jax.jit(flat_step, donate_argnums=donate),
+            jax.jit(flat_step, **jit_kwargs),
             platforms=[platform])(*in_specs)
     program = _serialize_max_compat(exported)
     kept = set(exported.module_kept_var_idx)
@@ -369,7 +433,8 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
         args_desc.append({
             "name": name, "role": role, "shape": [int(d) for d in shape],
             "dtype": "int32" if role == "t" else "float32",
-            "kept": idx in kept, "donated": idx in set(donate)})
+            "kept": idx in kept, "donated": idx in set(donate),
+            "sharding": in_shard_tags[idx]})
 
     idx = 0
     for n in pnames:
@@ -394,13 +459,15 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
            for n in pnames for k in range(nslot)]
         + [{"name": n, "role": "aux"} for n in anames]
         + [{"name": n, "role": "out"} for n in out_names])
-    for d, a in zip(outs_desc, exported.out_avals):
+    for k, (d, a) in enumerate(zip(outs_desc, exported.out_avals)):
         d["shape"] = [int(x) for x in a.shape]
         d["dtype"] = str(np.dtype(a.dtype))
+        d["sharding"] = out_shard_tags[k] if out_shard_tags else "rep"
 
     manifest = {
         "version": 2,
         "kind": "train",
+        "num_devices": int(num_devices),
         "platform": platform,
         "matmul_precision": matmul_precision,
         "compute_dtype": str(np.dtype(compute_dtype))
@@ -413,6 +480,8 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
         "args": args_desc,
         "outputs": outs_desc,
     }
+    if compile_options_b64 is not None:
+        manifest["compile_options"] = compile_options_b64
 
     blob = io.BytesIO()
     params_dict = {}
@@ -436,6 +505,22 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
         f.write(struct.pack("<Q", len(pbytes)))
         f.write(pbytes)
     return manifest
+
+
+def _spmd_compile_options_b64(num_devices):
+    """Serialized xla.CompileOptionsProto for 1 replica x N partitions with
+    SPMD partitioning — the native runtime compiles the exported program
+    with exactly these options (see compile_options_blob in
+    src/c_predict_pjrt.cc for the single-device default it replaces)."""
+    import base64
+
+    from jax._src import compiler as _jax_compiler
+
+    opts = _jax_compiler.get_compile_options(
+        num_replicas=1, num_partitions=num_devices,
+        device_assignment=np.arange(num_devices).reshape(1, num_devices),
+        use_spmd_partitioning=True)
+    return base64.b64encode(opts.SerializeAsString()).decode()
 
 
 def _serialize_max_compat(exported):

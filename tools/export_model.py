@@ -76,6 +76,9 @@ def main():
     ap.add_argument("--bf16", action="store_true",
                     help="bake bf16 compute (fp32 masters) into the step")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--num-devices", type=int, default=1,
+                    help="export a data-parallel SPMD step over N devices "
+                         "(train only; batch must divide N)")
     a = ap.parse_args()
 
     shapes = parse_shapes(a.shape)
@@ -88,7 +91,8 @@ def main():
             ("--bf16", a.bf16), ("--momentum", a.momentum is not None),
             ("--wd", a.wd is not None),
             ("--optimizer", a.optimizer != "sgd"), ("--lr", a.lr != 0.01),
-            ("--seed", a.seed != 0)) if on]
+            ("--seed", a.seed != 0),
+            ("--num-devices", a.num_devices != 1)) if on]
         if dropped:
             raise SystemExit("%s only apply to 'train' exports (predict "
                              "precision is --precision)" % ", ".join(dropped))
@@ -110,7 +114,8 @@ def main():
             arg_params=arg_params or None, aux_params=aux_params or None,
             platform=a.platform, matmul_precision=a.precision,
             seed=a.seed,
-            compute_dtype="bfloat16" if a.bf16 else None)
+            compute_dtype="bfloat16" if a.bf16 else None,
+            num_devices=a.num_devices)
 
     size = os.path.getsize(a.out)
     summary = {
