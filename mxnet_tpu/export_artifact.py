@@ -279,13 +279,19 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
                 raise ValueError(
                     "num_devices=%d must divide input '%s' batch dim %r"
                     % (num_devices, n, shp[:1]))
-        if len(jax.devices(platform)) < num_devices:
+        try:
+            n_vis = len(jax.devices(platform))
+        except RuntimeError as e:  # backend absent: surface the same
+            raise ValueError(                 # documented ValueError
+                "export with num_devices=%d needs %d visible %s devices "
+                "(no %s backend: %s)"
+                % (num_devices, num_devices, platform, platform, e)) from e
+        if n_vis < num_devices:
             raise ValueError(
                 "export with num_devices=%d needs %d visible %s devices "
                 "(found %d); on CPU set "
                 "XLA_FLAGS=--xla_force_host_platform_device_count"
-                % (num_devices, num_devices, platform,
-                   len(jax.devices(platform))))
+                % (num_devices, num_devices, platform, n_vis))
 
     mesh = build_mesh({"dp": 1}, list(jax.devices("cpu"))[:1])
     trainer = SPMDTrainer(symbol, mesh, data_shapes=data_shapes,

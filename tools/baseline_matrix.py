@@ -373,6 +373,149 @@ def run_dcgan(quick=False):
     return rate, d_losses, g_losses
 
 
+def run_dcgan_fused(quick=False, steps=None, loss_every=10):
+    """The fused opt-in (VERDICT round-4 item 7): the WHOLE adversarial
+    iteration — G forward, D grads on fake+real, D update, G grads through
+    the UPDATED D, G update — as ONE jitted program over device-resident
+    params/optimizer state (donated buffers) and a device-resident real
+    pool. Per-step semantics mirror the host-orchestrated loop exactly
+    (same grad sums, same aux chaining order real -> fake -> G-step, Adam
+    per update); z is derived in-graph from the step counter. The host
+    does one dispatch per step and fetches losses every `loss_every`."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.executor import build_graph_fn
+    from mxnet_tpu.models import make_discriminator, make_generator
+    from mxnet_tpu.parallel import fused_opt
+
+    batch = 16 if quick else 64
+    z_dim = 100
+    if steps is None:
+        steps = 10 if quick else 200
+    if steps < 4:
+        raise ValueError("steps must be >= 4 (timing starts after 2 "
+                         "warmup/compile steps)")
+    lr = 2e-4
+    gen = make_generator(ngf=32, nc=1)
+    dis = make_discriminator(ndf=32)
+    g_fn, g_args, g_auxn = build_graph_fn(gen)
+    d_fn, d_args, d_auxn = build_graph_fn(dis)
+    g_pnames = [n for n in g_args if n != "rand"]
+    d_pnames = [n for n in d_args if n not in ("data", "label")]
+
+    # identical initialization to the host-orchestrated run: let the
+    # Modules init (no forward -> no compile), then lift the arrays
+    ctx = _ctx()
+    gen_mod = mx.mod.Module(gen, data_names=("rand",), label_names=None,
+                            context=ctx)
+    gen_mod.bind(data_shapes=[("rand", (batch, z_dim, 1, 1))])
+    gen_mod.init_params(initializer=mx.init.Normal(0.02))
+    dis_mod = mx.mod.Module(dis, data_names=("data",),
+                            label_names=("label",), context=ctx)
+    dis_mod.bind(data_shapes=[("data", (batch, 1, 64, 64))],
+                 label_shapes=[("label", (batch,))])
+    dis_mod.init_params(initializer=mx.init.Normal(0.02))
+    gp = {k: v.asnumpy() for k, v in gen_mod.get_params()[0].items()}
+    ga = {k: v.asnumpy() for k, v in gen_mod.get_params()[1].items()}
+    dp = {k: v.asnumpy() for k, v in dis_mod.get_params()[0].items()}
+    da = {k: v.asnumpy() for k, v in dis_mod.get_params()[1].items()}
+
+    opt = mx.optimizer.create("adam", learning_rate=lr, beta1=0.5)
+    rule = fused_opt.make_rule(opt)
+    gs = {n: rule.init_state(gp[n].shape, np.float32) for n in g_pnames}
+    ds = {n: rule.init_state(dp[n].shape, np.float32) for n in d_pnames}
+
+    def g_forward(gp_, ga_, z):
+        args = [z if n == "rand" else gp_[n] for n in g_args]
+        outs, new_aux = g_fn(args, [ga_[n] for n in g_auxn], None, True)
+        return outs[0], dict(zip(g_auxn, new_aux))
+
+    def d_forward(dp_, da_, x, label):
+        args = [x if n == "data" else label if n == "label" else dp_[n]
+                for n in d_args]
+        outs, new_aux = d_fn(args, [da_[n] for n in d_auxn], None, True)
+        p = outs[0].reshape(-1)
+        ce = -jnp.mean(label * jnp.log(jnp.maximum(p, 1e-8)) +
+                       (1 - label) * jnp.log(jnp.maximum(1 - p, 1e-8)))
+        return ce, dict(zip(d_auxn, new_aux))
+
+    def step(gp_, gs_, ga_, dp_, ds_, da_, real, t):
+        key = jax.random.fold_in(jax.random.PRNGKey(7), t)
+        z = jax.random.normal(key, (batch, z_dim, 1, 1), jnp.float32)
+        ones = jnp.ones((batch,), jnp.float32)
+        zeros = jnp.zeros((batch,), jnp.float32)
+        fake, ga1 = g_forward(gp_, ga_, z)
+        fake_sg = jax.lax.stop_gradient(fake)
+
+        def d_loss_fn(p):
+            ce_r, da1 = d_forward(p, da_, real, ones)
+            ce_f, da2 = d_forward(p, da1, fake_sg, zeros)
+            return ce_r + ce_f, (ce_r, ce_f, da2)
+
+        (_, (ce_r, ce_f, da2)), d_grads = jax.value_and_grad(
+            d_loss_fn, has_aux=True)(dp_)
+        dp1, ds1 = {}, {}
+        for n in d_pnames:
+            dp1[n], ds1[n] = rule.apply(dp_[n], d_grads[n], ds_[n],
+                                        lr, 0.0, t)
+
+        def g_loss_fn(p):
+            fake2, _ = g_forward(p, ga_, z)  # same value; aux from 1st call
+            ce, da3 = d_forward(dp1, da2, fake2, ones)
+            return ce, da3
+
+        (g_ce, da3), g_grads = jax.value_and_grad(
+            g_loss_fn, has_aux=True)(gp_)
+        gp1, gs1 = {}, {}
+        for n in g_pnames:
+            gp1[n], gs1[n] = rule.apply(gp_[n], g_grads[n], gs_[n],
+                                        lr, 0.0, t)
+        return gp1, gs1, ga1, dp1, ds1, da3, 0.5 * (ce_r + ce_f), g_ce
+
+    step_jit = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
+
+    # the same device-resident real pool the host-orchestrated run builds
+    rng = np.random.RandomState(0)
+    yy, xx = np.mgrid[:64, :64]
+    pool = []
+    for _ in range(8):
+        x = np.zeros((batch, 1, 64, 64), np.float32)
+        for i in range(batch):
+            cx, cy = rng.randint(16, 48, 2)
+            r = rng.randint(6, 16)
+            x[i, 0] = (((xx - cx) ** 2 + (yy - cy) ** 2) < r * r) * 1.0
+        pool.append(jax.device_put(x * 2 - 1))
+
+    d_losses, g_losses = [], []
+    carry = (gp, gs, ga, dp, ds, da)
+    t_start = None
+    for i in range(steps):
+        if i == 2:
+            jax.block_until_ready(carry)
+            t_start = time.perf_counter()  # after compiles
+        out = step_jit(*carry, pool[rng.randint(len(pool))],
+                       np.int32(i + 1))
+        carry = out[:6]
+        if i % loss_every == 0 or i == steps - 1:
+            d_losses.append(float(out[6]))
+            g_losses.append(float(out[7]))
+    jax.block_until_ready(carry)
+    dt = time.perf_counter() - t_start
+    rate = batch * (steps - 2) / dt
+    emit("dcgan_fused_train_imgs_per_sec", rate, "img/s",
+         {"batch": batch, "device": str(_ctx()), "loss_every": loss_every})
+    third = max(len(d_losses) // 3, 1)
+    emit("dcgan_fused_d_loss_final_third",
+         float(np.mean(d_losses[-third:])), "ce",
+         {"first_third": round(float(np.mean(d_losses[:third])), 3)})
+    emit("dcgan_fused_g_loss_final_third",
+         float(np.mean(g_losses[-third:])), "ce",
+         {"first_third": round(float(np.mean(g_losses[:third])), 3)})
+    assert np.isfinite(d_losses).all() and np.isfinite(g_losses).all()
+    return rate, d_losses, g_losses
+
+
 # ------------------------------------------------------------ LSTM-LM ----
 def run_lstm(quick=False, batch=32, buckets=(8, 16, 24, 32), epochs=None,
              max_sentences=None):
@@ -476,7 +619,8 @@ def run_lstm_scaling(quick=False):
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("config", choices=["ssd", "ssd_overfit", "dcgan", "lstm",
+    ap.add_argument("config", choices=["ssd", "ssd_overfit", "dcgan",
+                                       "dcgan_fused", "lstm",
                                        "lstm_scaling", "all"])
     ap.add_argument("--quick", action="store_true",
                     help="tiny sizes for CI smoke")
@@ -494,6 +638,8 @@ if __name__ == "__main__":
             run_ssd_overfit(steps=a.steps, lr=a.lr)
     if a.config in ("dcgan", "all"):
         run_dcgan(a.quick)
+    if a.config in ("dcgan_fused", "all"):
+        run_dcgan_fused(a.quick)
     if a.config in ("lstm", "all"):
         run_lstm(a.quick)
     if a.config in ("lstm_scaling", "all"):
