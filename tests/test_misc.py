@@ -675,24 +675,46 @@ def test_export_model_cli(tmp_path):
     prefix = str(tmp_path / "m")
     mod.save_checkpoint(prefix, 1)
 
-    def run(*args):
-        r = subprocess.run([sys.executable,
-                            os.path.join(root, "tools", "export_model.py")]
-                           + list(args), capture_output=True, text=True,
-                           env=dict(os.environ, JAX_PLATFORMS="cpu"))
-        assert r.returncode == 0, r.stderr[-800:]
-        return json.loads(r.stdout[r.stdout.index("{"):])
+    # both CLI invocations run inside ONE subprocess (a runpy driver over
+    # the real script): each separate subprocess paid a full cold jax
+    # import + XLA compile (~40 s apiece on this 1-core host), which was
+    # the single slowest unit-suite entry
+    cli = os.path.join(root, "tools", "export_model.py")
+    invocations = [
+        ["predict", "--prefix", prefix, "--epoch", "1",
+         "--shape", "data:2,6", "--out", str(tmp_path / "p.mxa"),
+         "--platform", "cpu"],
+        ["train", "--prefix", prefix, "--epoch", "1",
+         "--shape", "data:8,6", "--optimizer", "adam", "--lr", "0.001",
+         "--out", str(tmp_path / "t.mxa"), "--platform", "cpu", "--bf16"],
+    ]
+    driver = (
+        "import sys, runpy\n"
+        "cli, argvs = sys.argv[1], %r\n"
+        "for argv in argvs:\n"
+        "    sys.argv = ['export_model.py'] + argv\n"
+        "    runpy.run_path(cli, run_name='__main__')\n" % (invocations,))
+    r = subprocess.run([sys.executable, "-c", driver, cli],
+                       capture_output=True, text=True,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr[-800:]
+    # the CLI prints indented (multi-line) JSON: scan out every top-level
+    # object in order
+    dec = json.JSONDecoder()
+    blobs, i = [], 0
+    while True:
+        j = r.stdout.find("{", i)
+        if j < 0:
+            break
+        obj, end = dec.raw_decode(r.stdout[j:])
+        blobs.append(obj)
+        i = j + end
+    assert len(blobs) == 2, r.stdout
+    p, t = blobs
 
-    p = run("predict", "--prefix", prefix, "--epoch", "1",
-            "--shape", "data:2,6", "--out", str(tmp_path / "p.mxa"),
-            "--platform", "cpu")
     assert p["inputs"] == ["data", "softmax_label"]
     m, plen, qlen = mx.export_artifact.load_artifact_manifest(
         str(tmp_path / "p.mxa"))
     assert plen > 0 and qlen > 0
-
-    t = run("train", "--prefix", prefix, "--epoch", "1",
-            "--shape", "data:8,6", "--optimizer", "adam", "--lr", "0.001",
-            "--out", str(tmp_path / "t.mxa"), "--platform", "cpu", "--bf16")
     assert t["kind"] == "train" and t["params"] == 2 \
         and t["state_slots"] == 4 and t["compute_dtype"] == "bfloat16"

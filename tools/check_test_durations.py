@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Per-test duration ceiling for the unit suite (VERDICT round-4 item 6:
+'--durations regression tracking with a per-file ceiling').
+
+Parses pytest --durations output lines ("12.34s call path::test") from the
+shard logs and fails when any single test's call time exceeds the ceiling
+— the budget lever that works on THIS 1-core host, where process sharding
+buys nothing. Also writes the merged slowest-test report so the timings
+are a checked artifact of every CI run.
+
+    python tools/check_test_durations.py LOG [LOG...] \
+        [--ceiling 120] [--report out.txt]
+"""
+import argparse
+import re
+import sys
+
+LINE = re.compile(r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)",
+                  re.MULTILINE)
+
+
+def parse_logs(paths):
+    rows = []
+    for path in paths:
+        try:
+            text = open(path).read()
+        except OSError as e:
+            print("warning: %s: %s" % (path, e), file=sys.stderr)
+            continue
+        for m in LINE.finditer(text):
+            rows.append((float(m.group(1)), m.group(2), m.group(3)))
+    return sorted(rows, reverse=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logs", nargs="+")
+    ap.add_argument("--ceiling", type=float, default=120.0,
+                    help="max seconds any single test call may take")
+    ap.add_argument("--report", help="write the merged slowest-test table")
+    a = ap.parse_args()
+    rows = parse_logs(a.logs)
+    if a.report:
+        import contextlib
+        opener = (contextlib.nullcontext(sys.stdout) if a.report == "-"
+                  else open(a.report, "w"))
+        with opener as f:
+            f.write("# slowest unit tests (merged from shard logs)\n")
+            for dur, phase, test in rows[:40]:
+                f.write("%8.2fs %-8s %s\n" % (dur, phase, test))
+    over = [(d, t) for d, p, t in rows if p == "call" and d > a.ceiling]
+    if over:
+        print("tests over the %.0fs ceiling:" % a.ceiling)
+        for d, t in over:
+            print("  %8.2fs %s" % (d, t))
+        print("speed them up or split them (tests/README timing policy); "
+              "the ceiling keeps the 1-core suite inside its budget")
+        return 1
+    if rows:
+        print("slowest test: %.2fs (%s) — ceiling %.0fs ok"
+              % (rows[0][0], rows[0][2], a.ceiling))
+    else:
+        print("warning: no duration lines found", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Round-5 perf-residue audit (VERDICT round-4 item 9): where do the fused
+ResNet-50 step's `copy` (0.90 ms) and layout/formatting (0.83 ms)
+categories come from?
+
+Audits the EXACT program bench.py measures (bench.build_raw_step):
+
+  (a) donation aliasing — every carried buffer must appear in the entry's
+      input_output_alias table;
+  (b) carried layouts — input format vs output format per carried buffer
+      (a mismatch would mean XLA relayouts that parameter every step, the
+      case layout *pinning* could fix);
+  (c) copy census — every copy op in the optimized HLO with its
+      shape+layout string, grouped.
+
+Round-5 findings this tool reproduces (docs/perf.md "perf residue"):
+donation is complete (410/410 may-alias) and carried layouts already
+match input=output (0 mismatches), so there is nothing for layout
+pinning to pin; the copy population is per-WEIGHT layout conversions
+between the carried master layout and the per-direction conv kernel
+layouts (fwd/dgrad/wgrad each want different kernel layouts) — a
+structural consequence of XLA's conv layout assignment under mixed
+precision, not a framework-removable cost.
+
+Run on the TPU host:  python tools/step_hlo_audit.py [--batch 32]
+"""
+import argparse
+import re
+import sys
+from collections import Counter
+
+import numpy as np
+
+ROOT = __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    a = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import build_raw_step
+
+    step_fn, call_args = build_raw_step(a.batch, np.dtype(jnp.bfloat16))
+    compiled = step_fn.lower(*call_args).compile()
+    txt = compiled.as_text()
+
+    # (a) donation aliasing
+    m = re.search(r"input_output_alias=\{(.*?)\}\n", txt, re.S)
+    n_alias = m.group(1).count("may-alias") if m else 0
+    print("aliased (donated) input->output pairs:", n_alias)
+
+    # (b) carried layout stability (params, auxs, states trees)
+    il = compiled.input_formats
+    ol = compiled.output_formats
+    flat_in, _ = jax.tree_util.tree_flatten(il[0][:3])
+    flat_out, _ = jax.tree_util.tree_flatten(ol)
+    mism = sum(1 for x, y in zip(flat_in, flat_out[:len(flat_in)])
+               if str(x) != str(y))
+    print("carried buffers: %d, input-vs-output layout mismatches: %d"
+          % (len(flat_in), mism))
+
+    # (c) copy census with layouts
+    copies = Counter(re.findall(r"= (\S+?) copy\(", txt))
+    print("copy ops: %d total, %d distinct shape/layout forms"
+          % (sum(copies.values()), len(copies)))
+    for shape, n in copies.most_common(15):
+        print("   copy %-52s x%d" % (shape, n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
