@@ -3,6 +3,7 @@
 # docker-matrix build/test driver). One stage per reference CI axis:
 #   unit      python unit tests on the virtual 8-device CPU mesh
 #   native    C++ runtime build + native-path tests
+#   faults    fault-injection / robustness suite (fast, host-only)
 #   predict   C predict shim build + compiled-client test
 #   entry     driver contract: graft entry compile + multichip dryrun
 #   bench     (opt-in, needs a TPU) headline benchmark
@@ -145,6 +146,16 @@ run_entry() {
   python tools/c_api_coverage.py --check
 }
 
+run_faults() {
+  # fault-injection / robustness tier (docs/fault_tolerance.md): crash-safe
+  # checkpoints, engine error propagation, KVStore retry + dead-node
+  # handling, all driven deterministically through mxnet_tpu/fault.py.
+  # Host-only (no accelerator) and fast; the dist cases need the native lib
+  # (run_native builds it) and skip cleanly when it is absent.
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_fault_tolerance.py \
+    -q -m "not slow"
+}
+
 run_bench() {
   python bench.py
 }
@@ -248,6 +259,7 @@ run_examples() {
 case "$stage" in
   unit) run_unit ;;
   native) run_native ;;
+  faults) run_faults ;;
   predict) run_predict ;;
   predict_native) run_predict_native ;;
   entry) run_entry ;;
@@ -256,8 +268,9 @@ case "$stage" in
   examples) run_examples ;;
   package) run_package ;;
   all) run_native; run_predict; run_predict_native; run_entry; run_package;
+       run_faults;
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
                 --ignore=tests/test_train_native.py ;;
-  *) echo "unknown stage: $stage (unit|native|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|faults|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
 esac

@@ -41,6 +41,7 @@ from . import module as mod
 from . import executor_manager
 from . import model
 from .model import FeedForward
+from . import fault
 from . import rnn
 from . import visualization
 from . import visualization as viz

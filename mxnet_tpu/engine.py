@@ -37,6 +37,43 @@ class Var:
 
 
 class Engine:
+    """Base engine with the reference's error-propagation contract: an
+    exception raised inside a pushed fn is recorded (first one wins, like the
+    on_complete error path in threaded_engine.cc) and re-raised from the next
+    ``wait_for_var``/``wait_all`` on the pushing thread — never printed and
+    dropped. The recorded error is cleared when raised, so training loops
+    that catch it can keep using the engine."""
+
+    def __init__(self):
+        self._err_lock = threading.Lock()
+        self._first_error = None
+
+    def _record_error(self, exc):
+        import logging
+
+        with self._err_lock:
+            if self._first_error is None:
+                self._first_error = exc
+                # also log NOW: if the program never reaches another wait
+                # (e.g. it exits after its last push), the re-raise path
+                # never runs and this line is the only trace of the failure
+                logging.getLogger(__name__).error(
+                    "engine: pushed fn failed; will re-raise at the next "
+                    "wait_for_var/wait_all", exc_info=exc)
+                return
+        # only one error can re-raise at the wait; later ones must still
+        # leave a trace (the old print-and-drop behavior, kept for exactly
+        # the errors the new path cannot surface)
+        logging.getLogger(__name__).error(
+            "engine: dropping secondary error (an earlier one is pending "
+            "re-raise at the next wait)", exc_info=exc)
+
+    def _raise_pending(self):
+        with self._err_lock:
+            err, self._first_error = self._first_error, None
+        if err is not None:
+            raise err
+
     def new_variable(self):
         raise NotImplementedError
 
@@ -54,19 +91,35 @@ class Engine:
 
 
 class NaiveEngine(Engine):
-    """Synchronous engine: push == run (reference: src/engine/naive_engine.cc)."""
+    """Synchronous engine: push == run (reference: src/engine/naive_engine.cc).
+
+    Errors still surface at the wait, not the push — matching ThreadedEngine
+    so code bisected under MXNET_ENGINE_TYPE=NaiveEngine sees identical
+    control flow, and so a failed push doesn't prevent later pushes."""
 
     def new_variable(self):
         return Var(None)
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
-        fn()
+        from . import fault
+
+        try:
+            fn()
+        except (Exception, fault.InjectedCrash) as e:
+            # parity with the threaded trampoline: errors (including a
+            # simulated crash) surface at the wait, not the push. But this
+            # runs on the PUSHING thread, so KeyboardInterrupt/SystemExit
+            # must propagate immediately — deferring Ctrl-C would make the
+            # process un-interruptible, which the worker-thread trampoline
+            # can't cause (the interpreter delivers signals to the main
+            # thread only).
+            self._record_error(e)
 
     def wait_for_var(self, var):
-        pass
+        self._raise_pending()
 
     def wait_all(self):
-        pass
+        self._raise_pending()
 
     def delete_variable(self, var):
         pass
@@ -82,6 +135,7 @@ class ThreadedEngine(Engine):
     def __init__(self, num_workers=None):
         import ctypes
 
+        super().__init__()
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native runtime unavailable (no g++?); "
@@ -102,10 +156,9 @@ class ThreadedEngine(Engine):
                 fn = self._pending.pop(key)
             try:
                 fn()
-            except Exception:  # worker threads must never die on user errors
-                import traceback
-
-                traceback.print_exc()
+            except BaseException as e:  # noqa: BLE001 — a worker thread must
+                # never throw into the C++ callback; record for the next wait
+                self._record_error(e)
 
         self._trampoline = ENGINE_FN(_trampoline)  # keep alive
 
@@ -127,15 +180,25 @@ class ThreadedEngine(Engine):
             self._pending[key] = fn
         cv = self._var_array(const_vars)
         mv = self._var_array(mutable_vars)
-        self._lib.mxt_engine_push(
-            self._handle, self._ctypes.cast(self._trampoline, self._ctypes.c_void_p),
-            key, cv, len(const_vars), mv, len(mutable_vars), priority)
+        try:
+            self._lib.mxt_engine_push(
+                self._handle, self._ctypes.cast(self._trampoline, self._ctypes.c_void_p),
+                key, cv, len(const_vars), mv, len(mutable_vars), priority)
+        except BaseException:
+            # the native side never saw the op, so the trampoline will never
+            # pop this entry — without this, every failed push leaks its fn
+            # (and everything the closure captures) forever
+            with self._pending_lock:
+                self._pending.pop(key, None)
+            raise
 
     def wait_for_var(self, var):
         self._lib.mxt_engine_wait_for_var(self._handle, var.handle)
+        self._raise_pending()
 
     def wait_all(self):
         self._lib.mxt_engine_wait_all(self._handle)
+        self._raise_pending()
 
     def delete_variable(self, var):
         self._lib.mxt_engine_delete_var(self._handle, var.handle)

@@ -1,0 +1,187 @@
+"""Deterministic in-process fault injection.
+
+The reference's fault-tolerance story was validated with out-of-process chaos
+(kill -9 a ps-lite server, tests/nightly/dist_sync_kvstore.py relaunch runs).
+That is non-deterministic and needs a cluster; this module instead threads
+named *injection points* through the runtime's failure-prone seams so every
+recovery path is testable in a single process, byte-for-byte reproducibly:
+
+* ``checkpoint_write`` — inside the atomic checkpoint writer
+  (``crash_after_bytes=N`` kills the write mid-stream, leaving a torn temp
+  file exactly N bytes long).
+* ``checkpoint_between_files`` — after the symbol json, before the params
+  blob (the classic half-written two-file checkpoint).
+* ``kv_push`` / ``kv_pull`` — the dist KVStore RPCs (``drop=1`` fails the
+  attempt, ``delay_ms=N`` stalls it) to exercise retry/backoff.
+* ``server_updater`` — the PS server's optimizer application (``raise=1``)
+  to exercise the server's failure counting and threshold.
+
+Faults are described by a spec string, either in ``MXNET_FAULT_SPEC`` (so a
+whole process tree — e.g. launched PS servers — inherits them) or pushed
+programmatically with :func:`inject`::
+
+    MXNET_FAULT_SPEC="checkpoint_write:crash_after_bytes=128;kv_push:drop=1,times=2"
+
+Grammar: ``point:arg=val[,arg=val...]`` joined by ``;``. Common args:
+
+* ``times=N``  — fire at most N times (default: unlimited).
+* ``after=N``  — let the first N hits through untouched.
+* ``raise=1``  — raise :class:`InjectedFault` (an ``MXNetError``).
+* ``crash=1``  — raise :class:`InjectedCrash` (a ``BaseException``: ordinary
+  ``except Exception`` recovery code cannot swallow it, so it behaves like a
+  real ``kill -9`` for everything except the test harness that expects it).
+* ``delay_ms=N`` — sleep before returning (transient-stall simulation).
+* ``drop=1`` / ``crash_after_bytes=N`` — interpreted by the call site.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+from .base import MXNetError
+
+__all__ = ["InjectedFault", "InjectedCrash", "hit", "inject", "reset",
+           "crash_after_bytes"]
+
+
+class InjectedFault(MXNetError):
+    """A recoverable failure raised by an injection point."""
+
+
+class InjectedCrash(BaseException):
+    """A simulated hard crash (power loss / kill -9).
+
+    Deliberately NOT an ``Exception``: recovery paths that catch ``Exception``
+    must not be able to "handle" a crash — only the test that injected it
+    catches this, the same way a supervisor observes a dead process.
+    """
+
+
+_lock = threading.RLock()
+_rules = None  # lazily parsed from MXNET_FAULT_SPEC
+_spec_stack = []  # programmatic overrides from inject()
+
+
+def _parse(spec):
+    rules = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, argstr = part.partition(":")
+        args = {}
+        for kv in argstr.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            args[k.strip()] = v.strip()
+        rules.append({"point": point.strip(), "args": args,
+                      "hits": 0, "fired": 0})
+    return rules
+
+
+def _active_rules():
+    global _rules
+    with _lock:
+        if _spec_stack:
+            return _spec_stack[-1]
+        if _rules is None:
+            _rules = _parse(os.environ.get("MXNET_FAULT_SPEC", ""))
+        return _rules
+
+
+def reset():
+    """Forget parsed env rules and their counters (re-reads the env on next
+    hit). Programmatic injections from :func:`inject` are unaffected."""
+    global _rules
+    with _lock:
+        _rules = None
+
+
+@contextmanager
+def inject(spec):
+    """Activate ``spec`` for the dynamic extent of the block (test harness
+    entry point). Nested injects stack; the innermost wins wholesale."""
+    rules = _parse(spec)
+    with _lock:
+        _spec_stack.append(rules)
+    try:
+        yield rules
+    finally:
+        with _lock:
+            _spec_stack.remove(rules)
+
+
+def _arm(name, require=None):
+    """Shared after/times gating (caller holds ``_lock``): find ``name``'s
+    rule (with arg ``require``, when given), count the hit, and return the
+    rule if it should fire — NOT yet marked fired, so the caller decides
+    whether firing happens now (:func:`hit`) or when a stream wrapper later
+    exhausts its budget (:func:`crash_after_bytes` → :func:`consume`)."""
+    for r in _active_rules():
+        if r["point"] != name:
+            continue
+        if require is not None and require not in r["args"]:
+            continue
+        args = r["args"]
+        r["hits"] += 1
+        if r["hits"] <= int(args.get("after", 0)):
+            return None
+        times = args.get("times")
+        if times is not None and r["fired"] >= int(times):
+            return None
+        return r
+    return None
+
+
+def hit(name):
+    """Consult the active spec at injection point ``name``.
+
+    Returns ``None`` when no rule fires. Otherwise applies ``delay_ms`` /
+    ``raise`` / ``crash`` itself and returns the rule's arg dict so the call
+    site can interpret point-specific args (``drop``, ``crash_after_bytes``).
+    """
+    with _lock:
+        rule = _arm(name)
+        if rule is None:
+            return None
+        rule["fired"] += 1
+        args = rule["args"]
+    delay = args.get("delay_ms")
+    if delay:
+        time.sleep(int(delay) / 1000.0)
+    if args.get("crash") not in (None, "0"):
+        raise InjectedCrash("injected crash at %s" % name)
+    if args.get("raise") not in (None, "0"):
+        raise InjectedFault("injected fault at %s" % name)
+    return args
+
+
+def crash_after_bytes(name):
+    """Byte budget for a write-stream injection point, or ``None``.
+
+    Each call counts as one hit (one stream opened at the point), so
+    ``after=N`` lets the first N streams through untouched and ``times=N``
+    stops arming budgets after N crashes. Does NOT record a firing — the
+    stream wrapper that enforces the budget calls :func:`consume` when the
+    budget is actually exhausted.
+    """
+    with _lock:
+        rule = _arm(name, require="crash_after_bytes")
+        if rule is None:
+            return None
+        return int(rule["args"]["crash_after_bytes"])
+
+
+def consume(name):
+    """Record a firing for ``name`` without applying any action (used by
+    stream wrappers that enforce ``crash_after_bytes`` themselves; the hit
+    was already counted when :func:`crash_after_bytes` armed the budget)."""
+    with _lock:
+        for r in _active_rules():
+            if r["point"] == name:
+                r["fired"] += 1
+                return

@@ -235,14 +235,22 @@ class KVStore:
         return env_flag("DMLC_PS_RECOVERY")
 
     def save_optimizer_states(self, fname):
+        from .utils.atomic_file import atomic_write
+
         assert self._updater is not None, "Cannot save states for distributed training"
-        with open(fname, "wb") as fout:
+        with atomic_write(fname) as fout:
             fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
+        from .utils.atomic_file import read_verified
+
         assert self._updater is not None, "Cannot load states for distributed training"
-        with open(fname, "rb") as fin:
-            self._updater.set_states(fin.read())
+        self._updater.set_states(read_verified(fname))
+
+
+class KVProtocolError(MXNetError):
+    """Client and server deterministically disagree (e.g. pull size
+    mismatch): not a transient transport failure, never retried."""
 
 
 class KVStoreDist(KVStore):
@@ -290,7 +298,6 @@ class KVStoreDist(KVStore):
 
         self._engine = get_engine()
         self._key_vars = {}
-        self._push_error = None
         self._update_on_kvstore = True
 
     # ---- helpers --------------------------------------------------------
@@ -300,34 +307,152 @@ class KVStoreDist(KVStore):
     def _client_for(self, ikey):
         return self._clients[ikey % self._num_servers]
 
+    def _addr_for(self, ikey):
+        # same modulus as _client_for: the probe must target the exact
+        # server the client RPC went to
+        return self._server_addrs[ikey % self._num_servers]
+
     def _var(self, k):
         if k not in self._key_vars:
             self._key_vars[k] = self._engine.new_variable()
         return self._key_vars[k]
 
+    # ---- resilience ------------------------------------------------------
+    @staticmethod
+    def _retry_config():
+        """MXNET_KV_RETRIES extra attempts after the first failure (0 turns
+        retry off); MXNET_KV_TIMEOUT_MS bounds the liveness probe that
+        classifies each failure."""
+        return (int(os.environ.get("MXNET_KV_RETRIES", "3")),
+                max(int(os.environ.get("MXNET_KV_TIMEOUT_MS", "10000")), 1))
+
+    def _with_retry(self, what, ikey, attempt_fn):
+        """Run ``attempt_fn`` with bounded retry + exponential backoff.
+
+        Each failure is classified with fresh deadline-bounded
+        ``mxt_ps_probe`` calls — against the key's server shard, or against
+        EVERY server when ``ikey`` is None (barrier talks to the whole
+        group): any unreachable server fails FAST with an error naming the
+        node(s) (retrying into a dead server only hides the outage), while
+        reachable-but-erroring servers are treated as a transient stall and
+        retried with doubling, jittered sleeps (jitter keeps N workers from
+        re-stampeding the server that just recovered).
+
+        Why retrying non-idempotent pushes/barriers is safe here: PSClient
+        (src/ps.cc) never reconnects — once its connection drops, every
+        later call on that client fails fast on the dead_ flag without
+        touching the wire. So a request the server may have already applied
+        (failure after delivery, before the response) can never be
+        re-delivered by this loop; only attempts that never reached the
+        server re-run. If the transport ever grows reconnection, it must
+        add request dedup before this retry remains correct."""
+        import random
+        import time
+
+        retries, timeout_ms = self._retry_config()
+        if ikey is None:
+            # barrier talks to the whole group but over client 0's
+            # connection, so that is the one whose health we can check
+            addrs, conn_addrs = self._server_addrs, [self._server_addrs[0]]
+            clients = [self._clients[0]]
+        else:
+            addrs = conn_addrs = [self._addr_for(ikey)]
+            clients = [self._client_for(ikey)]
+        attempt = 0
+        while True:
+            try:
+                return attempt_fn()
+            except KVProtocolError:
+                # deterministic disagreement (e.g. pull size mismatch), not
+                # a network blip: retrying can't change the answer and only
+                # buries the root cause under backoff noise
+                raise
+            except MXNetError as err:
+                if retries == 0:
+                    # retry disabled: fail fast as documented — don't spend
+                    # tens of seconds of probing on an error we'd raise
+                    # anyway (env_var.md: 'MXNET_KV_RETRIES=0 disables')
+                    raise
+                dead = self._probe_dead(addrs, timeout_ms)
+                if dead:
+                    raise MXNetError(
+                        "kvstore %s failed: server(s) %s unreachable "
+                        "(dead node) — failing fast; restart and relaunch "
+                        "workers with DMLC_PS_RECOVERY=1 (cause: %s)"
+                        % (what, ", ".join("%s:%d" % a for a in dead),
+                           err)) from err
+                bad_conn = [a for a, c in zip(conn_addrs, clients)
+                            if self._lib.mxt_ps_client_probe(
+                                c, b"ping", timeout_ms) != 0]
+                if bad_conn:
+                    # the SERVER is alive (fresh-socket probe above passed)
+                    # but this worker's shared connection is dead — and
+                    # PSClient never reconnects, so every retry would fail
+                    # instantly until the worker restarts
+                    raise MXNetError(
+                        "kvstore %s failed: this worker's connection to "
+                        "server(s) %s is dead (the server itself is alive) "
+                        "— the client transport does not reconnect; restart "
+                        "this worker with DMLC_PS_RECOVERY=1 and "
+                        "auto_resume= to continue (cause: %s)"
+                        % (what, ", ".join("%s:%d" % a for a in bad_conn),
+                           err)) from err
+                attempt += 1
+                if attempt > retries:
+                    raise MXNetError(
+                        "kvstore %s to live server(s) %s still failing "
+                        "after %d retries: %s"
+                        % (what, ", ".join("%s:%d" % a for a in addrs),
+                           retries, err)) from err
+                delay = min(0.05 * (1 << (attempt - 1)), 2.0)
+                time.sleep(delay * (0.5 + random.random()))
+
     def _zpush(self, ikey, arr_np):
         import ctypes
 
+        from . import fault
+
         flat = np.ascontiguousarray(arr_np.reshape(-1), np.float32)
-        rc = self._lib.mxt_ps_client_push(
-            self._client_for(ikey), ikey,
-            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), flat.size)
-        if rc != 0:
-            # remembered and re-raised at the next sync point: pushes run on
-            # engine threads where a raise only prints
-            self._push_error = "push failed for key %d (server down?)" % ikey
-            raise MXNetError(self._push_error)
+
+        def attempt():
+            rule = fault.hit("kv_push")
+            if rule is not None and rule.get("drop") not in (None, "0"):
+                raise MXNetError("injected push drop for key %d" % ikey)
+            rc = self._lib.mxt_ps_client_push(
+                self._client_for(ikey), ikey,
+                flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), flat.size)
+            if rc != 0:
+                raise MXNetError("push rpc failed for key %d" % ikey)
+
+        # pushes run on engine threads: a raise here is recorded by the
+        # engine and re-thrown from wait_for_var/wait_all (engine.py)
+        self._with_retry("push", ikey, attempt)
 
     def _zpull(self, ikey, n):
         import ctypes
 
+        from . import fault
+
         out = np.empty(n, np.float32)
-        got = self._lib.mxt_ps_client_pull(
-            self._client_for(ikey), ikey,
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
-        if got != n:
-            raise MXNetError("pull size mismatch for key %d: %d != %d" % (ikey, got, n))
-        return out
+
+        def attempt():
+            rule = fault.hit("kv_pull")
+            if rule is not None and rule.get("drop") not in (None, "0"):
+                raise MXNetError("injected pull drop for key %d" % ikey)
+            got = self._lib.mxt_ps_client_pull(
+                self._client_for(ikey), ikey,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+            if got < 0:  # transport failure (PSClient::Pull returns -1)
+                raise MXNetError("pull rpc failed for key %d" % ikey)
+            if got != n:
+                # the server answered with the WRONG size: a key/shape
+                # disagreement, deterministic — retrying can't fix it
+                raise KVProtocolError(
+                    "pull size mismatch for key %d: server sent %d floats, "
+                    "expected %d" % (ikey, got, n))
+            return out
+
+        return self._with_retry("pull", ikey, attempt)
 
     # ---- API ------------------------------------------------------------
     def init(self, key, value):
@@ -365,9 +490,10 @@ class KVStoreDist(KVStore):
         else:
             outs = _value_list(out, len(keys))
         for k, os_ in zip(keys, outs):
-            self._engine.wait_for_var(self._var(k))  # order after pushes
-            if self._push_error:
-                raise MXNetError(self._push_error)
+            # order after pushes; a failed async push re-raises HERE via the
+            # engine's error slot (read-and-clear, so one failed push does
+            # not poison later pulls after recovery)
+            self._engine.wait_for_var(self._var(k))
             n = int(np.prod(os_[0].shape))
             flat = self._zpull(self._ikey(k), n)
             src = NDArray(flat.reshape(os_[0].shape), ctx=os_[0].context)
@@ -401,7 +527,15 @@ class KVStoreDist(KVStore):
 
     def barrier(self):
         self._engine.wait_all()
-        self._lib.mxt_ps_client_barrier(self._clients[0])
+
+        def attempt():
+            if self._lib.mxt_ps_client_barrier(self._clients[0]) != 0:
+                raise MXNetError("barrier rpc failed")
+
+        # barrier synchronizes against the whole server group: probe every
+        # server (ikey=None), not just shard 0, so a dead non-zero server
+        # fails fast with its own name instead of burning retries
+        self._with_retry("barrier", None, attempt)
 
     def get_num_dead_node(self, node_id=0, timeout=120):
         """Probe each PS server on a FRESH deadline-bounded connection —
@@ -409,23 +543,60 @@ class KVStoreDist(KVStore):
         kvstore_dist.h:159-168 — ps-lite liveness over the server group;
         workers don't track each other here either). A fresh socket also
         can't block behind an in-flight bulk push on the shared client
-        connection."""
-        import threading
+        connection.
 
+        Dead-node semantics: a server counts as dead when its probe returns
+        non-zero, when the probe call itself raised, OR when the probe thread
+        is still running after its own deadline plus grace — an unjoined
+        probe means the server wedged the connection so badly even the
+        deadline-bounded native call didn't return, which is the strongest
+        possible liveness failure, not a reason to report the node healthy."""
         del node_id  # kept for API parity; all servers are probed
         timeout_ms = max(int(timeout * 1000), 1)
-        results = [0] * len(self._server_addrs)
+        return len(self._probe_dead(self._server_addrs, timeout_ms))
+
+    def _probe_dead(self, addrs, timeout_ms):
+        """The (host, port) pairs in ``addrs`` whose liveness probe failed —
+        one fresh deadline-bounded connection per server, all concurrent, so
+        N wedged servers cost one timeout, not N (see get_num_dead_node for
+        the dead-node semantics)."""
+        import threading
+        import time
+
+        results = [None] * len(addrs)  # None = probe never finished
 
         def probe(i, host, port):
             results[i] = self._lib.mxt_ps_probe(host.encode(), port, timeout_ms)
 
         threads = [threading.Thread(target=probe, args=(i, h, p), daemon=True)
-                   for i, (h, p) in enumerate(self._server_addrs)]
+                   for i, (h, p) in enumerate(addrs)]
         for t in threads:
             t.start()
+        # one SHARED deadline for all joins: the probes run concurrently, so
+        # N wedged servers must cost one timeout total, not one each
+        deadline = time.monotonic() + timeout_ms / 1000.0 + 5
         for t in threads:
-            t.join(timeout + 5)
-        return sum(1 for r in results if r != 0)
+            t.join(max(deadline - time.monotonic(), 0))
+        return [a for a, t, r in zip(addrs, threads, results)
+                if t.is_alive() or r is None or r != 0]
+
+    def request_server_stats(self):
+        """Ask every server to log its health counters (update failures,
+        applied updates) — the worker-side trigger for the server's
+        ``b"stats"`` command; output lands on each server's log. Servers
+        that did not acknowledge are logged here: the silent server is
+        exactly the diagnostic signal this call exists to surface. The
+        round-trip is deadline-bounded (MXNET_KV_TIMEOUT_MS): a WEDGED
+        server — open socket, no replies, the case this diagnostic exists
+        for — must produce the warning, not hang the caller."""
+        import logging
+
+        _, timeout_ms = self._retry_config()
+        for i, c in enumerate(self._clients):
+            if self._lib.mxt_ps_client_probe(c, b"stats", timeout_ms) != 0:
+                logging.warning(
+                    "kvstore: server %s:%d did not acknowledge the stats "
+                    "command (dead or wedged?)", *self._server_addrs[i])
 
     def _stop_servers(self):
         """Shut down server processes (rank 0, exit path)."""

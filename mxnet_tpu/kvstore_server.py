@@ -14,6 +14,7 @@ by key, on flat fp32 views — the reference's server also updates flattened
 from __future__ import annotations
 
 import base64
+import logging
 import os
 import pickle
 import threading
@@ -44,6 +45,17 @@ class KVStoreServer:
         self._updater = None
         self._updater_lock = threading.Lock()
         self._states = {}
+        # update-failure accounting: a raising updater must not silently
+        # leave weights stale forever (the old behavior printed and kept
+        # serving). Every failure is counted and logged; past the threshold
+        # the server stops with an error instead of training on garbage.
+        # MXNET_KV_SERVER_MAX_UPDATE_FAILURES=0 means die on the first one.
+        self._stats_lock = threading.Lock()  # counters bump on conn threads
+        self._update_failures = 0
+        self._updates_applied = 0
+        self._last_update_error = None
+        self._max_update_failures = int(os.environ.get(
+            "MXNET_KV_SERVER_MAX_UPDATE_FAILURES", "10"))
 
         # ALL python work (optimizer unpickle + update) runs on the server's
         # MAIN thread via this queue — the reference's single-threaded
@@ -62,17 +74,14 @@ class KVStoreServer:
             def task():
                 try:
                     fn()
-                except Exception as e:  # surface in server log, don't wedge
-                    box["err"] = e
+                except Exception as e:  # don't wedge the run loop; the
+                    box["err"] = e      # caller decides what the error means
                 finally:
                     done.set()
 
             self._exec_q.put(task)
             done.wait()
-            if "err" in box:
-                import traceback
-
-                traceback.print_exception(box["err"])
+            return box.get("err")
 
         def _apply(key, grad_ptr, weight_ptr, n):
             # flat fp32 views over the server's buffers; optimizer updates
@@ -91,7 +100,12 @@ class KVStoreServer:
             if fn is None:
                 weight[:] = grad
             else:
-                _on_main(lambda: fn(int(key), grad, weight))
+                err = _on_main(lambda: fn(int(key), grad, weight))
+                if err is None:
+                    with self._stats_lock:
+                        self._updates_applied += 1
+                else:
+                    self._note_update_failure(int(key), err)
 
         def _command(cmd_ptr, n):
             import ctypes
@@ -99,7 +113,15 @@ class KVStoreServer:
             cmd = ctypes.string_at(cmd_ptr, n)
             if cmd.startswith(b"optim:"):
                 blob = base64.b64decode(cmd[6:])
-                _on_main(lambda: self._set_optimizer(pickle.loads(blob)))
+                err = _on_main(lambda: self._set_optimizer(pickle.loads(blob)))
+                if err is not None:
+                    import traceback
+
+                    traceback.print_exception(err)
+            elif cmd.strip() == b"stats":
+                # operator-facing liveness/health line on the server log;
+                # in-process callers use .stats() directly
+                logging.warning("kvstore-server stats: %s", self.stats())
 
         self._apply_cb = UPDATER_FN(_apply)        # keep refs alive
         self._command_cb = COMMAND_FN(_command)
@@ -110,13 +132,56 @@ class KVStoreServer:
         lib.mxt_ps_server_set_command_handler(
             self._handle, ctypes.cast(self._command_cb, ctypes.c_void_p))
 
+    def _note_update_failure(self, key, err):
+        """Count a failed server-side update (runs on a conn thread).
+
+        The weight for ``key`` kept its previous value — the failed update
+        was dropped, which under BSP silently biases training if it keeps
+        happening. So: log loudly every time, and past
+        MXNET_KV_SERVER_MAX_UPDATE_FAILURES enqueue a poison task that
+        re-raises out of :meth:`run`, killing the server process (workers
+        then observe a dead node via their probes instead of pulling
+        quietly-stale weights forever)."""
+        with self._stats_lock:
+            self._update_failures += 1
+            self._last_update_error = "key %d: %r" % (key, err)
+            failures = self._update_failures
+        logging.error(
+            "kvstore-server: updater failed for key %d (%d failure(s) so "
+            "far, threshold %d): %r",
+            key, failures, self._max_update_failures, err)
+        if failures > self._max_update_failures:
+            stats = self.stats()
+
+            def die():
+                raise RuntimeError(
+                    "kvstore-server: %d optimizer updates failed (threshold "
+                    "%d) — refusing to keep serving stale weights; last "
+                    "error: %s; stats: %s"
+                    % (stats["update_failures"], self._max_update_failures,
+                       stats["last_update_error"], stats)) from err
+
+            self._exec_q.put(die)
+
+    def stats(self):
+        """Health counters (also printed by the ``b"stats"`` client command)."""
+        with self._stats_lock:  # counters bump on conn threads; snapshot
+            return {            # must pair count with its matching error
+                "updates_applied": self._updates_applied,
+                "update_failures": self._update_failures,
+                "last_update_error": self._last_update_error,
+                "has_optimizer": self._updater is not None,
+            }
+
     def _set_optimizer(self, optimizer):
+        from . import fault
         from . import optimizer as opt
         from .ndarray import NDArray
 
         updater = opt.get_updater(optimizer)
 
         def apply_np(key, grad_np, weight_np):
+            fault.hit("server_updater")
             g = NDArray(np.array(grad_np))
             w = NDArray(weight_np.copy())
             updater(key, g, w)

@@ -20,7 +20,8 @@ from . import symbol as sym
 from .base import MXNetError
 from .context import cpu, current_context
 
-__all__ = ["FeedForward", "save_checkpoint", "load_checkpoint", "BatchEndParam"]
+__all__ = ["FeedForward", "save_checkpoint", "load_checkpoint",
+           "load_latest_valid_checkpoint", "BatchEndParam"]
 
 BatchEndParam = namedtuple("BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
 
@@ -90,9 +91,17 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Save symbol + params (reference: model.py:319)."""
+    """Save symbol + params crash-safely (reference: model.py:319).
+
+    Both files go through utils/atomic_file.py (temp + fsync + rename with a
+    CRC32 footer on the params blob), so a crash at ANY byte of the write
+    leaves the previous epoch's files intact and at worst a torn ``.tmp``
+    file — never a torn checkpoint under the final name."""
+    from . import fault
+
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        symbol.save("%s-symbol.json" % prefix)  # atomic (symbol.py)
+    fault.hit("checkpoint_between_files")
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
@@ -100,10 +109,9 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     logging.info('Saved checkpoint to "%s"', param_name)
 
 
-def load_checkpoint(prefix, epoch):
-    """Load symbol + params (reference: model.py:349)."""
-    symbol = sym.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+def _split_params(save_dict):
+    """Split a checkpoint save_dict into (arg_params, aux_params) by the
+    ``arg:``/``aux:`` key prefixes (reference: model.py load_checkpoint)."""
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():
@@ -112,7 +120,68 @@ def load_checkpoint(prefix, epoch):
             arg_params[name] = v
         if tp == "aux":
             aux_params[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Load symbol + params (reference: model.py:349)."""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = _split_params(save_dict)
     return (symbol, arg_params, aux_params)
+
+
+def load_latest_valid_checkpoint(prefix):
+    """Newest loadable checkpoint for ``prefix``, skipping corrupt epochs.
+
+    Scans ``prefix-EPOCH.params`` files newest-first and returns
+    ``(symbol, arg_params, aux_params, epoch)`` for the first one whose
+    params blob passes the CRC/format checks. Epochs that fail — truncated
+    writes that lost the footer, flipped bytes the CRC catches, a params
+    file orphaned by a crash, files whose keys aren't checkpoint-shaped —
+    are logged and skipped, which is what makes restart-after-crash safe:
+    the torn newest epoch falls through to the last intact one. An
+    unloadable ``prefix-symbol.json`` degrades to params-only resume
+    (``symbol`` is ``None``; ``fit`` rebuilds the graph from its own symbol
+    anyway). Returns ``None`` when no epoch is loadable (fresh start)."""
+    import os
+    import re
+
+    dirname = os.path.dirname(prefix) or "."
+    base = os.path.basename(prefix)
+    pat = re.compile(re.escape(base) + r"-(\d+)\.params$")
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return None
+    # keep the matched filename: epoch numbers wider or narrower than the
+    # writer's %04d (hand-saved/renamed files) must load from the file that
+    # actually matched, not a re-derived name that may not exist
+    epochs = sorted(((int(m.group(1)), os.path.join(dirname, f))
+                     for f in entries if (m := pat.match(f))), reverse=True)
+    if not epochs:
+        return None
+    symbol = None
+    try:
+        symbol = sym.load("%s-symbol.json" % prefix)
+    except Exception as exc:  # noqa: BLE001 — a torn/missing symbol json must
+        # not invalidate intact params files: resume params-only
+        logging.warning(
+            "auto-resume: cannot load %s-symbol.json (%s); resuming with "
+            "params only", prefix, exc)
+    for epoch, param_file in epochs:
+        try:
+            # key parsing stays inside the try: a matching file that is not
+            # checkpoint-shaped (a list, unprefixed keys) is skipped like any
+            # other unloadable epoch, not a crash in the resume path
+            arg_params, aux_params = _split_params(nd.load(param_file))
+        except Exception as exc:  # noqa: BLE001 — any unloadable epoch is skipped
+            logging.warning(
+                "skipping corrupt/unloadable checkpoint %s: %s",
+                param_file, exc)
+            continue
+        return (symbol, arg_params, aux_params, epoch)
+    return None
 
 
 class FeedForward:
@@ -172,8 +241,11 @@ class FeedForward:
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
-            eval_end_callback=None, eval_batch_end_callback=None):
-        """(reference: model.py FeedForward.fit — delegates the loop to Module)"""
+            eval_end_callback=None, eval_batch_end_callback=None,
+            auto_resume=None):
+        """(reference: model.py FeedForward.fit — delegates the loop to Module).
+        ``auto_resume``: checkpoint prefix to continue from the newest intact
+        epoch (see BaseModule.fit)."""
         from .module import Module
 
         data = self._prepare_iter(X, y, is_train=True)
@@ -192,6 +264,7 @@ class FeedForward:
             arg_params=self.arg_params, aux_params=self.aux_params,
             allow_missing=True, begin_epoch=self.begin_epoch,
             num_epoch=self.num_epoch, monitor=monitor,
+            auto_resume=auto_resume,
         )
         self.arg_params, self.aux_params = mod.get_params()
         self._module = mod

@@ -145,13 +145,36 @@ class BaseModule:
             eval_end_callback=None, eval_batch_end_callback=None,
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
-            begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None):
-        """Train (reference: base_module.py:375-533)."""
+            begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
+            auto_resume=None):
+        """Train (reference: base_module.py:375-533).
+
+        ``auto_resume`` is a checkpoint prefix (the one passed to
+        ``callback.do_checkpoint``/``save_checkpoint``): when set, fit picks
+        the newest *intact* epoch under that prefix — corrupt or torn files
+        from a crash mid-save are CRC-detected and skipped — loads its
+        params, and fast-forwards ``begin_epoch``, so a killed-and-relaunched
+        training job continues instead of restarting. With no loadable
+        checkpoint it trains from scratch."""
         from .. import initializer as init_mod
 
         assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
             initializer = init_mod.Uniform(0.01)
+        resume_epoch = None
+        if auto_resume is not None:
+            from ..model import load_latest_valid_checkpoint
+
+            ckpt = load_latest_valid_checkpoint(auto_resume)
+            if ckpt is not None:
+                _, arg_params, aux_params, resume_epoch = ckpt
+                # checkpoint filenames carry the number of COMPLETED epochs
+                # (callback._every saves iter_no+1), so resuming at index
+                # resume_epoch repeats nothing and skips nothing
+                begin_epoch = max(begin_epoch, resume_epoch)
+                self.logger.info(
+                    "auto-resume: restored '%s' epoch %d, continuing at "
+                    "epoch %d", auto_resume, resume_epoch, begin_epoch)
         self.bind(
             data_shapes=train_data.provide_data, label_shapes=train_data.provide_label,
             for_training=True, force_rebind=force_rebind,
@@ -160,9 +183,38 @@ class BaseModule:
             self.install_monitor(monitor)
         self.init_params(
             initializer=initializer, arg_params=arg_params, aux_params=aux_params,
-            allow_missing=allow_missing, force_init=force_init,
+            allow_missing=allow_missing,
+            # a restored checkpoint must actually land: on an
+            # already-initialized module (in-process retry loop calling fit
+            # again) the default force_init=False would silently keep the
+            # stale in-memory weights while begin_epoch was fast-forwarded
+            force_init=force_init or resume_epoch is not None,
         )
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer, optimizer_params=optimizer_params)
+        if resume_epoch is not None:
+            # checkpoints written with save_optimizer_states=True also carry
+            # momentum/Adam state — restore it so the resumed run tracks the
+            # uninterrupted one; params-only checkpoints (do_checkpoint)
+            # resume with fresh optimizer state, as a warm start
+            import os
+
+            # try the writer's %04d name first, then the unpadded form —
+            # load_latest_valid_checkpoint deliberately accepts hand-saved/
+            # renamed 'prefix-N.params', whose sibling is 'prefix-N.states'
+            states = next(
+                (s for s in ("%s-%04d.states" % (auto_resume, resume_epoch),
+                             "%s-%d.states" % (auto_resume, resume_epoch))
+                 if os.path.exists(s)), None)
+            if states is not None and hasattr(self, "load_optimizer_states"):
+                try:
+                    self.load_optimizer_states(states)
+                    self.logger.info(
+                        "auto-resume: restored optimizer states from %s", states)
+                except Exception as exc:  # noqa: BLE001 — corrupt states must
+                    # not kill the resume; params are already verified
+                    self.logger.warning(
+                        "auto-resume: ignoring unloadable optimizer states "
+                        "%s: %s", states, exc)
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):

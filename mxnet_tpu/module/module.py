@@ -88,8 +88,12 @@ class Module(BaseModule):
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """(reference: module.py:134) — symbol.json + params + optional .states"""
+        """(reference: module.py:134) — symbol.json + params + optional .states
+        All files are written crash-safely (utils/atomic_file.py)."""
+        from .. import fault
+
         self._symbol.save("%s-symbol.json" % prefix)
+        fault.hit("checkpoint_between_files")
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
         logging.info('Saved checkpoint to "%s"', param_name)
@@ -599,28 +603,31 @@ class Module(BaseModule):
         self._params_dirty = False
 
     def save_optimizer_states(self, fname):
-        """(reference: module.py save_optimizer_states)"""
+        """(reference: module.py save_optimizer_states) — crash-safe + CRC,
+        like every other checkpoint file (utils/atomic_file.py)."""
+        from ..utils.atomic_file import atomic_write
+
         assert self.optimizer_initialized
         if self._fused is not None:
-            with open(fname, "wb") as fout:
+            with atomic_write(fname) as fout:
                 fout.write(self._fused.get_states_bytes())
         elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
+            with atomic_write(fname) as fout:
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         """(reference: module.py load_optimizer_states)"""
+        from ..utils.atomic_file import read_verified
+
         assert self.optimizer_initialized
         if self._fused is not None:
-            with open(fname, "rb") as f:
-                self._fused.set_states_bytes(f.read())
+            self._fused.set_states_bytes(read_verified(fname))
         elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
-            with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
+            self._updater.set_states(read_verified(fname))
 
     def install_monitor(self, mon):
         assert self.binded

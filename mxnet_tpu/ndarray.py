@@ -685,7 +685,14 @@ def save(fname, data):
     """Save a list or str->NDArray dict in the reference's exact binary format
     (src/ndarray/ndarray.cc:695-717): u64 0x112 magic, u64 reserved, then the
     dmlc-serialized vectors — [u64 count, NDArray blobs], [u64 count, strings]
-    — so .params files interchange with the reference both ways."""
+    — so .params files interchange with the reference both ways.
+
+    The write is crash-safe (temp + fsync + rename) and carries a trailing
+    CRC32 footer the reference's loader never reads — it stops after the
+    name vector — so interchange is preserved while :func:`load` gains
+    corruption detection (utils/atomic_file.py)."""
+    from .utils.atomic_file import atomic_write
+
     if isinstance(data, NDArray):
         data = [data]
     names = []
@@ -696,7 +703,7 @@ def save(fname, data):
             arrays.append(v)
     else:
         arrays = list(data)
-    with open(fname, "wb") as f:
+    with atomic_write(fname) as f:
         f.write(struct.pack("<Q", _LIST_MAGIC))
         f.write(struct.pack("<Q", 0))  # reserved
         f.write(struct.pack("<Q", len(arrays)))
@@ -712,11 +719,41 @@ def save(fname, data):
 def load(fname):
     """Load arrays saved by :func:`save`. Accepts a path or a binary
     file-like object (the predict API passes parameter blobs as BytesIO).
-    Returns list or dict."""
+    Verifies the CRC32 footer when present (files written before the footer
+    existed, or by the reference, load unchanged). Returns list or dict."""
+    from .utils.atomic_file import ChecksummingReader, PushbackReader
+
+    def _load_verified(f):
+        # CRC accumulates over the SAME pass the parser reads (no second
+        # read of a multi-GB checkpoint, no whole-file copies); the reader
+        # hides the footer from the self-delimiting parser
+        reader = ChecksummingReader(f)
+        try:
+            out = _load_stream(reader)
+        except Exception:
+            # the parser tripped first; when the CRC proves the file corrupt
+            # report THAT (the root cause) instead of the downstream symptom
+            reader.verify()
+            raise
+        reader.verify()
+        return out
+
     if hasattr(fname, "read"):
-        return _load_stream(fname)
+        if getattr(fname, "seekable", lambda: False)():
+            if fname.tell() != 0:
+                # stream positioned at an embedded blob: parse from the
+                # current offset exactly as before the footer existed (no
+                # footer verification — the footer is file-scoped)
+                return _load_stream(fname)
+            return _load_verified(fname)
+        # non-seekable (socket, pipe): the footer can't be located without
+        # buffering the whole stream and over-reading past the blob, so no
+        # CRC verification — self-delimiting parse that consumes exactly
+        # the blob, with the parser's one peek-back seek emulated via a
+        # pushback buffer
+        return _load_stream(PushbackReader(fname))
     with open(fname, "rb") as f:
-        return _load_stream(f)
+        return _load_verified(f)
 
 
 def _load_stream(f):

@@ -357,7 +357,11 @@ class Symbol:
         )
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        # crash-safe: a died-mid-write process must not leave a torn json at
+        # the final name (checkpoint auto-resume parses this file)
+        from .utils.atomic_file import atomic_write
+
+        with atomic_write(fname, checksum=False) as f:
             f.write(self.tojson())
 
     # ---- binding --------------------------------------------------------

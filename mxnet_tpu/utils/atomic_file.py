@@ -1,0 +1,284 @@
+"""Crash-safe file writes with end-to-end checksums.
+
+The reference saved checkpoints with a bare ``ofstream`` (ndarray.cc
+SaveToFile): a crash mid-write leaves a torn file at the *final* path, and
+nothing detects a flipped bit at load time. Here every checkpoint-shaped
+write goes through the classic crash-safe protocol:
+
+1. write to ``<path>.tmp.<pid>.<seq>`` in the same directory (same
+   filesystem, so the rename below is atomic; the per-process counter keeps
+   concurrent same-path writers on separate temp files),
+2. append a 16-byte CRC32 footer over the payload,
+3. ``fsync`` the file, ``os.replace`` onto the final path, ``fsync`` the
+   directory (so the rename itself survives power loss).
+
+A reader therefore sees either the complete old file or the complete new
+file — never a mix — and :func:`verify_and_strip` catches silent corruption
+(flipped bytes, truncation that kept a stale footer) via the CRC.
+
+Footer layout (little-endian): ``b"MXCR"`` magic, u32 crc32 of the payload,
+u64 payload length. Files without the footer (anything written before this
+module existed, or by the reference itself) verify as legacy and load
+unchanged — the footer is additive, not a format break.
+
+Fault injection: writers accept a ``fault_name`` consulted through
+:mod:`mxnet_tpu.fault`; ``crash_after_bytes=N`` aborts the stream after
+exactly N payload bytes with an :class:`~mxnet_tpu.fault.InjectedCrash`,
+leaving the torn temp file behind (as a real crash would) and the final
+path untouched.
+"""
+from __future__ import annotations
+
+import io
+import itertools
+import os
+import struct
+import zlib
+from contextlib import contextmanager
+
+from ..base import MXNetError
+
+__all__ = ["atomic_write", "ChecksumError", "ChecksummingReader",
+           "PushbackReader", "verify_and_strip", "read_verified",
+           "FOOTER_LEN"]
+
+_FOOTER_MAGIC = b"MXCR"
+FOOTER_LEN = 16  # magic(4) + crc32(4) + payload_len(8)
+_tmp_counter = itertools.count()
+
+
+class ChecksumError(MXNetError):
+    """Payload bytes do not match the file's CRC32 footer."""
+
+
+class _ChecksummedWriter:
+    """File-like wrapper: running CRC32 + optional injected byte budget."""
+
+    def __init__(self, f, fault_name):
+        self._f = f
+        self._crc = 0
+        self.nbytes = 0
+        self._budget = None
+        self._fault_name = fault_name
+        if fault_name is not None:
+            from .. import fault
+
+            self._budget = fault.crash_after_bytes(fault_name)
+
+    def write(self, data):
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        if self._budget is not None and self.nbytes + len(data) > self._budget:
+            from .. import fault
+
+            allowed = self._budget - self.nbytes
+            self._f.write(data[:allowed])
+            self.nbytes += allowed
+            fault.consume(self._fault_name)
+            raise fault.InjectedCrash(
+                "injected crash at %s after %d bytes"
+                % (self._fault_name, self.nbytes))
+        self._f.write(data)
+        self._crc = zlib.crc32(data, self._crc)
+        self.nbytes += len(data)
+        return len(data)
+
+    def footer(self):
+        return struct.pack("<4sIQ", _FOOTER_MAGIC, self._crc & 0xFFFFFFFF,
+                           self.nbytes)
+
+
+@contextmanager
+def atomic_write(path, checksum=True, fault_name="checkpoint_write"):
+    """Yield a writer whose output reaches ``path`` atomically.
+
+    On clean exit the CRC footer (when ``checksum``) is appended, the file is
+    fsynced and renamed over ``path``, and the directory entry is fsynced.
+    On an ordinary exception the temp file is removed and ``path`` is left
+    untouched. On :class:`~mxnet_tpu.fault.InjectedCrash` (and other
+    ``BaseException``, e.g. ``KeyboardInterrupt``) the torn temp file is left
+    behind, exactly as a process death would — ``path`` is still untouched.
+    """
+    path = os.fspath(path)
+    # pid alone is not unique within a process: two threads saving the same
+    # path would share one temp file and interleave into the FINAL file
+    # after the first rename (next(counter) is atomic under the GIL)
+    tmp = "%s.tmp.%d.%d" % (path, os.getpid(), next(_tmp_counter))
+    f = open(tmp, "wb")
+    writer = _ChecksummedWriter(f, fault_name)
+    try:
+        yield writer
+        if checksum:
+            f.write(writer.footer())
+        f.flush()
+        os.fsync(f.fileno())
+    except Exception:
+        f.close()
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    except BaseException:
+        f.close()  # simulated crash: leave the torn temp file on disk
+        raise
+    f.close()
+    try:
+        os.replace(tmp, path)
+    except OSError:
+        # rename-stage failure (permissions changed, path became a dir …) is
+        # an ordinary error, and the contract for those is: no temp litter
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _fsync_dir(dirname):
+    # the rename is only durable once the directory entry is on disk; some
+    # filesystems (and all of Windows) refuse to open directories — best
+    # effort there, the data file itself is already synced
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def verify_and_strip(data):
+    """Return ``data`` minus its CRC footer, verifying the checksum.
+
+    Bytes without a well-formed footer are legacy (pre-footer files and
+    reference-written files) and are returned unchanged — corruption there
+    still surfaces through the format parser's own structural checks.
+    Raises :class:`ChecksumError` when a footer is present but the payload
+    doesn't match it.
+    """
+    if len(data) < FOOTER_LEN:
+        return data
+    magic, crc, length = struct.unpack("<4sIQ", data[-FOOTER_LEN:])
+    if magic != _FOOTER_MAGIC or length != len(data) - FOOTER_LEN:
+        return data
+    payload = data[:-FOOTER_LEN]
+    actual = zlib.crc32(payload) & 0xFFFFFFFF
+    if actual != crc:
+        raise ChecksumError(
+            "checksum mismatch: footer says crc32=0x%08x over %d bytes, "
+            "payload has crc32=0x%08x — file is corrupt" % (crc, length, actual))
+    return payload
+
+
+def read_verified(path):
+    """Read ``path`` fully and :func:`verify_and_strip` it."""
+    with open(path, "rb") as f:
+        return verify_and_strip(f.read())
+
+
+class PushbackReader:
+    """The one seek shape self-delimiting parsers use to peek — a backward
+    relative seek within the most recent read — emulated with a pushback
+    buffer, so it works over any readable stream (sockets, pipes).
+    Re-served bytes come from the buffer; subclasses hook
+    :meth:`_read_fresh` to bound or observe bytes from the underlying file.
+    """
+
+    def __init__(self, f):
+        self._f = f
+        self._nread = 0  # fresh bytes consumed from the underlying file
+        self._last = b""  # most recent chunk served fresh (seek-back window)
+        self._pushback = b""  # already-served bytes awaiting re-serve
+
+    def _read_fresh(self, n):
+        return self._f.read(-1 if n is None or n < 0 else n)
+
+    def read(self, n=-1):
+        out = b""
+        if self._pushback:
+            if n is None or n < 0:
+                out, self._pushback = self._pushback, b""
+            else:
+                out, self._pushback = self._pushback[:n], self._pushback[n:]
+                n -= len(out)
+        if n is None or n < 0 or n > 0:
+            out += self._read_fresh_counted(n)
+        # the seek-back window is THIS read's result — including bytes
+        # re-served from pushback (they were removed from the buffer above,
+        # so a later seek-back may push them again), NOT a stale earlier
+        # chunk that would corrupt a second peek
+        self._last = out
+        return out
+
+    def _read_fresh_counted(self, n):
+        data = self._read_fresh(n)
+        self._nread += len(data)
+        return data
+
+    def seek(self, offset, whence=1):
+        if whence != 1 or not -len(self._last) <= offset <= 0:
+            raise io.UnsupportedOperation(
+                "only backward seeks within the last read are supported")
+        if offset:
+            self._pushback = self._last[offset:] + self._pushback
+            self._last = self._last[:offset]
+        # io contract: return the new absolute position (bytes the caller
+        # has consumed), not bytes remaining
+        return self._nread - len(self._pushback)
+
+
+class ChecksummingReader(PushbackReader):
+    """Read-through CRC verification for a seekable binary stream.
+
+    Wraps an open file positioned at 0 and accumulates the CRC32 of every
+    byte the parser reads, in the SAME pass — a multi-GB checkpoint is read
+    from disk once, not once for the checksum and again for the parse. The
+    footer (when well-formed; otherwise the file is legacy and unverified,
+    same rules as :func:`verify_and_strip`) is located up front and hidden:
+    reads are clamped to the payload, so self-delimiting parsers can't
+    consume it by accident. Call :meth:`verify` after parsing — it drains
+    any unread payload into the CRC and raises :class:`ChecksumError` on a
+    mismatch. Seek-back peeks (:class:`PushbackReader`) re-serve without
+    re-CRC'ing.
+    """
+
+    def __init__(self, f):
+        super().__init__(f)
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        self._expected = None
+        self._payload_len = size
+        if size >= FOOTER_LEN:
+            f.seek(size - FOOTER_LEN)
+            magic, crc, length = struct.unpack("<4sIQ", f.read(FOOTER_LEN))
+            if magic == _FOOTER_MAGIC and length == size - FOOTER_LEN:
+                self._expected = crc
+                self._payload_len = length
+        f.seek(0)
+        self._crc = 0
+
+    def _read_fresh(self, n):
+        remaining = self._payload_len - self._nread  # hide the footer
+        n = remaining if n is None or n < 0 else min(n, remaining)
+        data = self._f.read(n) if n > 0 else b""
+        self._crc = zlib.crc32(data, self._crc)
+        return data
+
+    def verify(self):
+        """Drain any unread payload through the CRC and check the footer."""
+        if self._expected is None:
+            return
+        while self._nread < self._payload_len:
+            if not self.read(1 << 20):
+                break
+        if self._crc & 0xFFFFFFFF != self._expected:
+            raise ChecksumError(
+                "checksum mismatch: footer says crc32=0x%08x over %d bytes, "
+                "payload has crc32=0x%08x — file is corrupt"
+                % (self._expected, self._payload_len,
+                   self._crc & 0xFFFFFFFF))

@@ -22,3 +22,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "faults: fault-injection / robustness tests "
+        "(ci/run_tests.sh faults tier; suite in tests_tpu/test_fault_tolerance.py)")
+    config.addinivalue_line("markers", "slow: long-running tests")

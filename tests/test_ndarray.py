@@ -177,11 +177,14 @@ def test_ndarray_pickle():
 
 
 def test_save_format_byte_compatible_with_reference():
-    """The .params binary layout must match the reference byte for byte
+    """The .params payload layout must match the reference byte for byte
     (ndarray.cc:618-643 NDArray::Save + :695-717 list save), so checkpoints
-    interchange across frameworks. This test hand-builds a file with the
-    reference's documented layout and loads it; then saves and re-parses the
-    bytes field by field."""
+    interchange across frameworks. Our save additionally appends a CRC32
+    footer the reference's loader never reads — it stops after the name
+    vector (docs/fault_tolerance.md) — so the payload before the footer is
+    the compatibility contract. This test hand-builds a file with the
+    reference's documented layout and loads it; then saves and checks the
+    payload bytes and the footer."""
     import struct
     import tempfile
 
@@ -206,9 +209,48 @@ def test_save_format_byte_compatible_with_reference():
     assert list(loaded) == ["w"]
     np.testing.assert_allclose(loaded["w"].asnumpy(), vals)
 
-    # our save must emit the identical bytes
+    # our save must emit the identical payload bytes, plus a verified CRC
+    # footer the reference ignores (its loader reads only the payload)
+    from mxnet_tpu.utils.atomic_file import FOOTER_LEN, verify_and_strip
+
     nd.save(path, {"w": nd.array(vals)})
-    assert open(path, "rb").read() == blob
+    raw = open(path, "rb").read()
+    assert raw[:-FOOTER_LEN] == blob
+    assert raw[-FOOTER_LEN:][:4] == b"MXCR"
+    assert verify_and_strip(raw) == blob
+
+
+def test_load_nonseekable_stream_consumes_exactly_the_blob():
+    """load() on a non-seekable stream (socket/pipe) must parse the
+    self-delimiting blob without buffering or consuming trailing bytes the
+    caller still needs (no CRC verification on this path — the footer can't
+    be located without over-reading)."""
+    import io
+    import tempfile
+
+    from mxnet_tpu.utils.atomic_file import FOOTER_LEN
+
+    class NonSeekable(io.RawIOBase):
+        def __init__(self, data):
+            self._b = io.BytesIO(data)
+
+        def readable(self):
+            return True
+
+        def seekable(self):
+            return False
+
+        def read(self, n=-1):
+            return self._b.read(n)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = d + "/a.params"
+        nd.save(path, {"w": nd.ones((2, 2))})
+        payload = open(path, "rb").read()[:-FOOTER_LEN]
+    stream = NonSeekable(payload + b"TRAILER")
+    out = nd.load(stream)
+    np.testing.assert_allclose(out["w"].asnumpy(), 1.0)
+    assert stream.read() == b"TRAILER"
 
 
 def test_module_level_binary_helpers():

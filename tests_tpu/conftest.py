@@ -18,6 +18,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
+# Host-side suites that live here because they belong to the TPU build's
+# runtime (ci/run_tests.sh faults) but exercise no accelerator: they run on
+# CPU-only hosts and are exempt from the hardware gate below.
+_HOST_ONLY_FILES = {"test_fault_tolerance.py"}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "faults: fault-injection / robustness tests (host-only)")
+    config.addinivalue_line("markers", "slow: long-running tests")
+
 
 def _activate_tpu_context():
     import mxnet_tpu as mx
@@ -39,7 +50,9 @@ def _activate_tpu_context():
 
 
 def pytest_collection_modifyitems(config, items):
-    mine = [it for it in items if str(it.fspath).startswith(_HERE)]
+    mine = [it for it in items
+            if str(it.fspath).startswith(_HERE)
+            and os.path.basename(str(it.fspath)) not in _HOST_ONLY_FILES]
     if not mine:
         return
     import mxnet_tpu as mx

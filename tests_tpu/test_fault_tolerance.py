@@ -1,0 +1,669 @@
+"""Fault-tolerance suite: crash-safe checkpoints, engine error propagation,
+KVStore retry/backoff and dead-node detection — all driven deterministically
+through mxnet_tpu/fault.py injection points (no real cluster or kill -9
+needed; the dist cases spin a real local PS via tools/launch.py).
+
+Host-side only: runs on a CPU-only machine (tests_tpu/conftest.py exempts
+this file from the hardware gate). `ci/run_tests.sh faults` is the CI tier.
+"""
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import fault  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.engine import NaiveEngine, ThreadedEngine  # noqa: E402
+from mxnet_tpu.model import (  # noqa: E402
+    load_checkpoint, load_latest_valid_checkpoint, save_checkpoint)
+from mxnet_tpu.utils.atomic_file import (  # noqa: E402
+    FOOTER_LEN, ChecksumError, atomic_write, verify_and_strip)
+from mxnet_tpu._native import get_lib  # noqa: E402
+
+pytestmark = pytest.mark.faults
+
+needs_native = pytest.mark.skipif(get_lib() is None,
+                                  reason="native lib unavailable")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _params(val=1.0):
+    return {"fc_weight": mx.nd.array(np.full((2, 4), val, np.float32)),
+            "fc_bias": mx.nd.zeros((2,))}
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    return mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+
+
+# ---------------------------------------------------------------------------
+# atomic_file: CRC format + crash-safety
+# ---------------------------------------------------------------------------
+
+def test_params_roundtrip_has_verified_footer(tmp_path):
+    fname = str(tmp_path / "a.params")
+    mx.nd.save(fname, _params(3.0))
+    raw = open(fname, "rb").read()
+    assert raw[-FOOTER_LEN:][:4] == b"MXCR"
+    assert len(verify_and_strip(raw)) == len(raw) - FOOTER_LEN
+    back = mx.nd.load(fname)
+    assert np.allclose(back["fc_weight"].asnumpy(), 3.0)
+
+
+def test_pre_footer_legacy_file_still_loads(tmp_path):
+    """Files written before the CRC footer existed (or by the reference)
+    must keep loading — the footer is additive, not a format break."""
+    fname = str(tmp_path / "a.params")
+    mx.nd.save(fname, _params(2.0))
+    raw = open(fname, "rb").read()
+    legacy = str(tmp_path / "legacy.params")
+    with open(legacy, "wb") as f:
+        f.write(raw[:-FOOTER_LEN])  # exactly the reference-format payload
+    back = mx.nd.load(legacy)
+    assert np.allclose(back["fc_weight"].asnumpy(), 2.0)
+
+
+def test_flipped_byte_detected_by_crc(tmp_path):
+    fname = str(tmp_path / "a.params")
+    mx.nd.save(fname, _params())
+    raw = bytearray(open(fname, "rb").read())
+    raw[len(raw) // 2] ^= 0x40  # corrupt a payload byte, keep the footer
+    with open(fname, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(ChecksumError):
+        mx.nd.load(fname)
+
+
+def test_truncated_file_rejected(tmp_path):
+    fname = str(tmp_path / "a.params")
+    mx.nd.save(fname, _params())
+    raw = open(fname, "rb").read()
+    with open(fname, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    with pytest.raises(Exception):  # struct/format error; never silent junk
+        mx.nd.load(fname)
+
+
+def test_crash_mid_write_leaves_target_untouched(tmp_path):
+    fname = str(tmp_path / "a.params")
+    mx.nd.save(fname, _params(1.0))
+    before = open(fname, "rb").read()
+    with fault.inject("checkpoint_write:crash_after_bytes=24,times=1"):
+        with pytest.raises(fault.InjectedCrash):
+            mx.nd.save(fname, _params(9.0))
+    assert open(fname, "rb").read() == before  # old file fully intact
+    torn = [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    assert torn and os.path.getsize(tmp_path / torn[0]) == 24
+
+
+def test_injected_crash_not_swallowed_by_except_exception():
+    """InjectedCrash models a hard crash: generic except-Exception recovery
+    code must not be able to 'handle' it."""
+    assert not issubclass(fault.InjectedCrash, Exception)
+    assert issubclass(fault.InjectedFault, MXNetError)
+
+
+def test_atomic_write_cleans_temp_on_ordinary_error(tmp_path):
+    fname = str(tmp_path / "x.bin")
+    with pytest.raises(ValueError):
+        with atomic_write(fname) as f:
+            f.write(b"partial")
+            raise ValueError("app error")
+    assert not os.path.exists(fname)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+def test_pushback_reader_double_peek():
+    """The seek-back window must track the LAST read even when that read was
+    served from the pushback buffer — a second peek must re-serve the right
+    bytes, not a stale earlier chunk."""
+    import io
+
+    from mxnet_tpu.utils.atomic_file import PushbackReader
+
+    r = PushbackReader(io.BytesIO(b"abcdefghij"))
+    assert r.read(6) == b"abcdef"
+    r.seek(-6, 1)  # push the whole chunk back
+    assert r.read(4) == b"abcd"  # served from pushback only
+    r.seek(-2, 1)  # second peek: must rewind within THAT read
+    assert r.read(6) == b"cdefgh"
+    assert r.read() == b"ij"
+
+
+# ---------------------------------------------------------------------------
+# fault spec semantics
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_times_and_after():
+    with fault.inject("p:raise=1,after=1,times=2"):
+        assert fault.hit("p") is None  # after=1: first hit passes
+        for _ in range(2):  # next two fire
+            with pytest.raises(fault.InjectedFault):
+                fault.hit("p")
+        assert fault.hit("p") is None  # times=2 exhausted
+        assert fault.hit("other_point") is None
+
+
+def test_crash_after_bytes_respects_after_and_times(tmp_path):
+    """after=N lets the first N write streams through untouched; times=N
+    stops arming budgets after N crashes."""
+    with fault.inject("checkpoint_write:crash_after_bytes=24,after=1,times=1"):
+        mx.nd.save(str(tmp_path / "a.params"), _params())  # after=1: passes
+        with pytest.raises(fault.InjectedCrash):
+            mx.nd.save(str(tmp_path / "b.params"), _params())
+        mx.nd.save(str(tmp_path / "c.params"), _params())  # times=1: spent
+    assert mx.nd.load(str(tmp_path / "a.params"))
+    assert mx.nd.load(str(tmp_path / "c.params"))
+
+
+def test_fault_spec_from_env(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_SPEC", "envpoint:raise=1,times=1")
+    fault.reset()
+    with pytest.raises(fault.InjectedFault):
+        fault.hit("envpoint")
+    assert fault.hit("envpoint") is None
+    monkeypatch.delenv("MXNET_FAULT_SPEC")
+    fault.reset()
+    assert fault.hit("envpoint") is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint scan + auto-resume (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+def test_load_latest_valid_skips_corrupt_and_truncated(tmp_path):
+    prefix = str(tmp_path / "ck")
+    net = _net()
+    for epoch in (1, 2, 3, 4):
+        save_checkpoint(prefix, epoch, net, _params(float(epoch)), {})
+    # epoch 4: flipped byte (CRC catches); epoch 3: truncation
+    p4 = "%s-0004.params" % prefix
+    raw = bytearray(open(p4, "rb").read())
+    raw[50] ^= 0xFF
+    open(p4, "wb").write(bytes(raw))
+    p3 = "%s-0003.params" % prefix
+    raw3 = open(p3, "rb").read()
+    open(p3, "wb").write(raw3[: len(raw3) // 3])
+    sym, arg, aux, epoch = load_latest_valid_checkpoint(prefix)
+    assert epoch == 2
+    assert np.allclose(arg["fc_weight"].asnumpy(), 2.0)
+    assert load_latest_valid_checkpoint(str(tmp_path / "nothing")) is None
+
+
+def test_load_latest_valid_accepts_nonpadded_epoch_names(tmp_path):
+    """A hand-saved/renamed 'prefix-7.params' (not the writer's %04d) must
+    load from the file that actually matched the scan, not a re-derived
+    'prefix-0007.params' that doesn't exist."""
+    prefix = str(tmp_path / "ck")
+    save_checkpoint(prefix, 1, _net(), _params(7.0), {})
+    os.rename("%s-0001.params" % prefix, "%s-7.params" % prefix)
+    sym, arg, aux, epoch = load_latest_valid_checkpoint(prefix)
+    assert epoch == 7
+    assert np.allclose(arg["fc_weight"].asnumpy(), 7.0)
+
+
+def test_load_latest_valid_degrades_to_params_only_without_symbol(tmp_path):
+    """A torn/missing symbol json must not invalidate intact params files:
+    resume returns them with symbol=None (fit rebuilds the graph anyway)."""
+    prefix = str(tmp_path / "ck")
+    save_checkpoint(prefix, 1, _net(), _params(4.0), {})
+    os.remove("%s-symbol.json" % prefix)
+    sym, arg, aux, epoch = load_latest_valid_checkpoint(prefix)
+    assert sym is None and epoch == 1
+    assert np.allclose(arg["fc_weight"].asnumpy(), 4.0)
+
+
+def test_load_latest_valid_skips_non_checkpoint_shaped_files(tmp_path):
+    """A matching .params file whose keys aren't arg:/aux:-prefixed (hand-
+    saved by other tooling) is skipped like any unloadable epoch, not a
+    crash in the resume path."""
+    prefix = str(tmp_path / "ck")
+    save_checkpoint(prefix, 1, _net(), _params(1.0), {})
+    mx.nd.save("%s-0002.params" % prefix, {"w": mx.nd.ones((2,))})
+    sym, arg, aux, epoch = load_latest_valid_checkpoint(prefix)
+    assert epoch == 1
+
+
+def test_fit_auto_resume_restores_params_on_reused_module(tmp_path):
+    """An in-process retry loop calls fit() again on the SAME module
+    instance: the restored checkpoint must overwrite the in-memory weights
+    (init_params is forced), not be silently ignored."""
+    prefix = str(tmp_path / "job")
+    mod = _make_module()
+    mod.fit(_make_iter(), num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    ckpt_arg = load_latest_valid_checkpoint(prefix)[1]
+    # trash the in-memory weights, then resume on the same instance with
+    # num_epoch == resume epoch: zero epochs run, so get_params() shows
+    # exactly what the resume restored
+    arg, _ = mod.get_params()
+    mod.set_params({k: mx.nd.zeros(v.shape) for k, v in arg.items()}, {},
+                   force_init=True)
+    mod.fit(_make_iter(), num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            auto_resume=prefix)
+    arg_after, _ = mod.get_params()
+    for k, v in ckpt_arg.items():
+        np.testing.assert_allclose(arg_after[k].asnumpy(), v.asnumpy())
+
+
+def test_crash_between_symbol_and_params_resumes_older_epoch(tmp_path):
+    prefix = str(tmp_path / "ck")
+    net = _net()
+    save_checkpoint(prefix, 1, net, _params(1.0), {})
+    with fault.inject("checkpoint_between_files:crash=1,times=1"):
+        with pytest.raises(fault.InjectedCrash):
+            save_checkpoint(prefix, 2, net, _params(2.0), {})
+    assert not os.path.exists("%s-0002.params" % prefix)
+    sym, arg, aux, epoch = load_latest_valid_checkpoint(prefix)
+    assert epoch == 1 and np.allclose(arg["fc_weight"].asnumpy(), 1.0)
+
+
+def _make_iter():
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=16)
+
+
+def _make_module():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    return mx.mod.Module(net, context=mx.cpu())
+
+
+def test_fit_auto_resumes_from_newest_intact_epoch(tmp_path):
+    """The acceptance scenario end to end: train with periodic checkpoints,
+    crash mid-checkpoint-write, restart with auto_resume — training picks up
+    from the newest INTACT epoch, skipping the torn one."""
+    prefix = str(tmp_path / "job")
+
+    mod = _make_module()
+    mod.fit(_make_iter(), num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    assert os.path.exists("%s-0003.params" % prefix)
+
+    # the job dies mid-write of the epoch-4 checkpoint (power loss at byte
+    # 40); each fit gets a fresh iterator, as each relaunched process would
+    mod2 = _make_module()
+    with fault.inject("checkpoint_write:crash_after_bytes=40,times=1"):
+        with pytest.raises(fault.InjectedCrash):
+            mod2.fit(_make_iter(), num_epoch=4, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1},
+                     epoch_end_callback=mx.callback.do_checkpoint(prefix),
+                     auto_resume=prefix)
+    # the torn epoch-4 file never reached its final name
+    assert not os.path.exists("%s-0004.params" % prefix)
+
+    # restart: resumes AFTER epoch 3, trains exactly epochs 3..4 (0-based)
+    seen = []
+    mod3 = _make_module()
+    mod3.fit(_make_iter(), num_epoch=5, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.1},
+             epoch_end_callback=mx.callback.do_checkpoint(prefix),
+             batch_end_callback=lambda p: seen.append(p.epoch),
+             auto_resume=prefix)
+    assert sorted(set(seen)) == [3, 4]
+    assert os.path.exists("%s-0005.params" % prefix)
+    # and the resumed params chain is loadable end to end
+    sym, arg, aux, epoch = load_latest_valid_checkpoint(prefix)
+    assert epoch == 5
+
+
+def test_fit_auto_resume_with_corrupt_newest_epoch(tmp_path):
+    """A corrupted (CRC-mismatch) newest params file is skipped, resuming
+    from the previous epoch instead of crashing or loading garbage."""
+    prefix = str(tmp_path / "job")
+    it = _make_iter()
+    mod = _make_module()
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    p2 = "%s-0002.params" % prefix
+    raw = bytearray(open(p2, "rb").read())
+    raw[60] ^= 0x01
+    open(p2, "wb").write(bytes(raw))
+
+    seen = []
+    mod2 = _make_module()
+    mod2.fit(it, num_epoch=3, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.1},
+             batch_end_callback=lambda p: seen.append(p.epoch),
+             auto_resume=prefix)
+    assert sorted(set(seen)) == [1, 2]  # epoch 2 file was bad -> resume at 1
+
+
+def test_fit_auto_resume_restores_optimizer_states(tmp_path, monkeypatch):
+    """Checkpoints written with save_optimizer_states=True resume with their
+    momentum restored, not reset; corrupt .states degrade to a warm start
+    instead of killing the resume."""
+    prefix = str(tmp_path / "job")
+    mod = _make_module()
+    mod.fit(_make_iter(), num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            epoch_end_callback=mx.callback.module_checkpoint(
+                mod, prefix, save_optimizer_states=True))
+    states = "%s-0002.states" % prefix
+    assert os.path.exists(states)
+
+    from mxnet_tpu.module.module import Module
+
+    loaded = []
+    orig = Module.load_optimizer_states
+
+    def spy(self, fname):
+        loaded.append(fname)
+        return orig(self, fname)
+
+    monkeypatch.setattr(Module, "load_optimizer_states", spy)
+    mod2 = _make_module()
+    mod2.fit(_make_iter(), num_epoch=3, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+             auto_resume=prefix)
+    assert loaded == [states]
+
+    # flip a byte: CRC rejects the states, fit warns and trains anyway
+    raw = bytearray(open(states, "rb").read())
+    raw[len(raw) // 2] ^= 0x01
+    open(states, "wb").write(bytes(raw))
+    mod3 = _make_module()
+    mod3.fit(_make_iter(), num_epoch=3, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+             auto_resume=prefix)
+
+
+def test_fit_auto_resume_fresh_start_when_no_checkpoints(tmp_path):
+    prefix = str(tmp_path / "never_saved")
+    it = _make_iter()
+    seen = []
+    mod = _make_module()
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            batch_end_callback=lambda p: seen.append(p.epoch),
+            auto_resume=prefix)
+    assert sorted(set(seen)) == [0]
+
+
+# ---------------------------------------------------------------------------
+# engine error propagation
+# ---------------------------------------------------------------------------
+
+def _engines():
+    engines = [NaiveEngine]
+    if get_lib() is not None:
+        engines.append(ThreadedEngine)
+    return engines
+
+
+@pytest.mark.parametrize("make_engine", _engines())
+def test_engine_error_propagates_from_wait_all(make_engine):
+    eng = make_engine()
+
+    def boom():
+        raise ZeroDivisionError("pushed fn failed")
+
+    eng.push(boom)
+    with pytest.raises(ZeroDivisionError):
+        eng.wait_all()
+    # error is consumed: the engine keeps working and later pushes run
+    ran = []
+    eng.push(lambda: ran.append(1))
+    eng.wait_all()
+    assert ran == [1]
+
+
+@pytest.mark.parametrize("make_engine", _engines())
+def test_engine_error_propagates_from_wait_for_var(make_engine):
+    eng = make_engine()
+    v = eng.new_variable()
+
+    def boom():
+        raise RuntimeError("write op failed")
+
+    eng.push(boom, mutable_vars=[v])
+    with pytest.raises(RuntimeError):
+        eng.wait_for_var(v)
+    eng.wait_all()  # consumed above: no re-raise
+
+
+@pytest.mark.parametrize("make_engine", _engines())
+def test_engine_first_error_wins(make_engine):
+    eng = make_engine()
+    v = eng.new_variable()  # serialize both ops on one var: deterministic order
+
+    def first():
+        raise KeyError("first")
+
+    def second():
+        raise ValueError("second")
+
+    eng.push(first, mutable_vars=[v])
+    eng.push(second, mutable_vars=[v])
+    with pytest.raises(KeyError):
+        eng.wait_all()
+
+
+@needs_native
+def test_threaded_push_failure_pops_pending_entry():
+    """A failed native push must not leak its callback entry forever."""
+    eng = ThreadedEngine(num_workers=1)
+
+    class _BrokenPush:
+        def __init__(self, real):
+            self._real = real
+
+        def __getattr__(self, name):
+            if name == "mxt_engine_push":
+                def boom(*_a, **_k):
+                    raise RuntimeError("injected native push failure")
+
+                return boom
+            return getattr(self._real, name)
+
+    real = eng._lib
+    eng._lib = _BrokenPush(real)
+    try:
+        with pytest.raises(RuntimeError):
+            eng.push(lambda: None)
+    finally:
+        eng._lib = real
+    assert eng._pending == {}
+    ran = []
+    eng.push(lambda: ran.append(1))  # engine still fully usable
+    eng.wait_all()
+    assert ran == [1]
+
+
+# ---------------------------------------------------------------------------
+# KVStore resilience
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@needs_native
+def test_dead_server_counted_by_probe():
+    """get_num_dead_node against a port nobody listens on: the probe (and an
+    unjoined/failed probe slot) counts as dead, not silently healthy."""
+    from mxnet_tpu.kvstore import KVStoreDist
+
+    kv = object.__new__(KVStoreDist)  # no cluster: exercise only the probe
+    kv._lib = get_lib()
+    kv._server_addrs = [("127.0.0.1", _free_port())]
+    assert kv.get_num_dead_node(timeout=2) == 1
+
+
+@needs_native
+def test_retry_fails_fast_on_dead_server():
+    """A failure whose probe shows a dead server must NOT burn retries."""
+    import time
+
+    from mxnet_tpu.kvstore import KVStoreDist
+
+    kv = object.__new__(KVStoreDist)
+    kv._lib = get_lib()
+    kv._server_addrs = [("127.0.0.1", _free_port())]
+    kv._num_servers = 1
+    kv._clients = [None]  # dead-server path raises before any client probe
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        raise MXNetError("rpc failed")
+
+    os.environ["MXNET_KV_TIMEOUT_MS"] = "500"
+    try:
+        t0 = time.time()
+        with pytest.raises(MXNetError, match="unreachable"):
+            kv._with_retry("push", 0, attempt)
+        assert len(calls) == 1  # no retries into a dead node
+        assert time.time() - t0 < 30
+    finally:
+        del os.environ["MXNET_KV_TIMEOUT_MS"]
+
+
+def _run_cluster(script, n_workers=1, env_extra=None, timeout=180,
+                 expect_rc0=True):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DMLC_ROLE", None)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", str(n_workers), "-s", "1", "--port", str(_free_port()),
+           sys.executable, "-c", script]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        out, err = proc.communicate()
+        raise AssertionError("cluster hung: %s %s" % (out, err))
+    if expect_rc0:
+        assert proc.returncode == 0, (out, err)
+    return proc.returncode, out, err
+
+
+WORKER_PUSH_RETRY = r"""
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import fault
+
+kv = mx.kv.create("dist_sync")
+kv.init(7, mx.nd.zeros((4,)))
+# drop the first two push attempts; the third (retry) succeeds. The server
+# stays alive throughout, so the probe classifies the drops as transient.
+with fault.inject("kv_push:drop=1,times=2"):
+    kv.push(7, mx.nd.ones((4,)) * 5)
+    out = mx.nd.zeros((4,))
+    kv.pull(7, out=out)
+assert np.allclose(out.asnumpy(), 5.0), out.asnumpy()
+kv.barrier()
+kv._stop_servers()
+print("WORKER_OK")
+"""
+
+
+@needs_native
+def test_kv_push_retries_through_transient_drops():
+    rc, out, err = _run_cluster(WORKER_PUSH_RETRY,
+                                env_extra={"MXNET_KV_RETRIES": "3"})
+    assert "WORKER_OK" in out, (out, err)
+
+
+WORKER_RETRY_EXHAUSTED = r"""
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import fault
+from mxnet_tpu.base import MXNetError
+
+kv = mx.kv.create("dist_sync")
+kv.init(7, mx.nd.zeros((4,)))
+# every attempt drops; MXNET_KV_RETRIES=1 -> 2 attempts, then a clear error
+with fault.inject("kv_push:drop=1"):
+    try:
+        kv.push(7, mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        kv.pull(7, out=out)
+        raise SystemExit("push should have failed")
+    except MXNetError as e:
+        assert "after 1 retries" in str(e), e
+print("SAW_RETRY_EXHAUSTED")
+kv._stop_servers()
+print("WORKER_OK")
+"""
+
+
+@needs_native
+def test_kv_retry_exhaustion_raises_clear_error():
+    rc, out, err = _run_cluster(WORKER_RETRY_EXHAUSTED,
+                                env_extra={"MXNET_KV_RETRIES": "1"})
+    assert "SAW_RETRY_EXHAUSTED" in out, (out, err)
+
+
+WORKER_SERVER_UPDATER_DIES = r"""
+import time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+kv = mx.kv.create("dist_sync")
+kv.init(0, mx.nd.ones((4,)))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+# the server's updater raises on every update (MXNET_FAULT_SPEC below) and
+# its failure threshold is 0: the first failed update kills the server
+# instead of serving stale weights. This worker must OBSERVE that death.
+err = None
+for step in range(100):
+    try:
+        kv.push(0, mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        kv.pull(0, out=out)
+        time.sleep(0.05)
+    except MXNetError as e:
+        err = e
+        break
+assert err is not None, "server death never surfaced to the worker"
+deadline = time.time() + 30
+while time.time() < deadline and kv.get_num_dead_node(timeout=2) == 0:
+    time.sleep(0.3)
+assert kv.get_num_dead_node(timeout=2) == 1, "dead server not counted"
+print("SAW_DEAD_SERVER")
+print("WORKER_OK")
+"""
+
+
+@needs_native
+def test_server_updater_failure_threshold_kills_server():
+    rc, out, err = _run_cluster(
+        WORKER_SERVER_UPDATER_DIES,
+        env_extra={"MXNET_FAULT_SPEC": "server_updater:raise=1",
+                   "MXNET_KV_SERVER_MAX_UPDATE_FAILURES": "0",
+                   "MXNET_KV_RETRIES": "1",
+                   "MXNET_KV_TIMEOUT_MS": "2000"},
+        timeout=240)
+    assert "SAW_DEAD_SERVER" in out, (out, err)
+    # the server said WHY it died
+    assert "refusing to keep serving stale weights" in (out + err), (out, err)
