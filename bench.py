@@ -33,12 +33,25 @@ BASELINE = 181.53  # P100 fp32 train img/s (BASELINE.md)
 
 
 def _emit(imgs_per_sec):
-    print(json.dumps({
+    from mxnet_tpu import telemetry
+
+    # the registry is the single source of truth for the headline number:
+    # the gauge is set, then read back for the JSON line, so CLI output and
+    # any concurrent telemetry dump/scrape can never disagree. With
+    # telemetry enabled (MXNET_TELEMETRY / MXNET_TELEMETRY_FILE) the full
+    # registry snapshot — fit.* step/data-wait splits included — rides
+    # along in the bench JSON.
+    telemetry.gauge("bench.imgs_per_sec").set(round(imgs_per_sec, 2))
+    value = telemetry.gauge("bench.imgs_per_sec").value
+    rec = {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
-        "value": round(imgs_per_sec, 2),
+        "value": value,
         "unit": "images/sec",
-        "vs_baseline": round(imgs_per_sec / BASELINE, 3),
-    }))
+        "vs_baseline": round(value / BASELINE, 3),
+    }
+    if telemetry.enabled():
+        rec["telemetry"] = telemetry.dump(include_events=False)
+    print(json.dumps(rec))
 
 
 def _shapes_for(layout):

@@ -4,6 +4,7 @@
 #   unit      python unit tests on the virtual 8-device CPU mesh
 #   native    C++ runtime build + native-path tests
 #   faults    fault-injection / robustness suite (fast, host-only)
+#   telemetry runtime-telemetry suite: registry/exposition/fit metrics (fast, host-only)
 #   predict   C predict shim build + compiled-client test
 #   entry     driver contract: graft entry compile + multichip dryrun
 #   bench     (opt-in, needs a TPU) headline benchmark
@@ -156,6 +157,15 @@ run_faults() {
     -q -m "not slow"
 }
 
+run_telemetry() {
+  # runtime-telemetry tier (docs/observability.md): registry semantics under
+  # concurrent writers, Prometheus/chrome-trace exposition, fit-loop
+  # step/data-wait metrics, KV retry counters under fault injection.
+  # Host-only (no accelerator) and fast.
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_telemetry.py \
+    -q -m "not slow"
+}
+
 run_bench() {
   python bench.py
 }
@@ -260,6 +270,7 @@ case "$stage" in
   unit) run_unit ;;
   native) run_native ;;
   faults) run_faults ;;
+  telemetry) run_telemetry ;;
   predict) run_predict ;;
   predict_native) run_predict_native ;;
   entry) run_entry ;;
@@ -268,9 +279,9 @@ case "$stage" in
   examples) run_examples ;;
   package) run_package ;;
   all) run_native; run_predict; run_predict_native; run_entry; run_package;
-       run_faults;
+       run_faults; run_telemetry;
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
                 --ignore=tests/test_train_native.py ;;
-  *) echo "unknown stage: $stage (unit|native|faults|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|faults|telemetry|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
 esac

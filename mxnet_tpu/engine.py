@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
+from . import telemetry
 from ._native import ENGINE_FN, get_lib
 
 __all__ = ["Engine", "NaiveEngine", "ThreadedEngine", "get_engine", "Var"]
@@ -51,6 +53,9 @@ class Engine:
     def _record_error(self, exc):
         import logging
 
+        # error-path counter: rare by definition, so it counts even with
+        # telemetry disabled (docs/observability.md "always-on counters")
+        telemetry.counter("engine.push_errors").inc()
         with self._err_lock:
             if self._first_error is None:
                 self._first_error = exc
@@ -103,6 +108,10 @@ class NaiveEngine(Engine):
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
         from . import fault
 
+        tel = telemetry.enabled()
+        if tel:
+            telemetry.counter("engine.pushes").inc()
+            t0 = time.perf_counter()
         try:
             fn()
         except (Exception, fault.InjectedCrash) as e:
@@ -114,6 +123,10 @@ class NaiveEngine(Engine):
             # can't cause (the interpreter delivers signals to the main
             # thread only).
             self._record_error(e)
+        finally:
+            if tel:
+                telemetry.histogram("engine.push_latency_seconds").observe(
+                    time.perf_counter() - t0)
 
     def wait_for_var(self, var):
         self._raise_pending()
@@ -154,11 +167,21 @@ class ThreadedEngine(Engine):
             key = int(arg)
             with self._pending_lock:
                 fn = self._pending.pop(key)
+                depth = len(self._pending)
+            tel = telemetry.enabled()
+            if tel:
+                telemetry.gauge("engine.queue_depth").set(depth)
+                t0 = time.perf_counter()
             try:
                 fn()
             except BaseException as e:  # noqa: BLE001 — a worker thread must
                 # never throw into the C++ callback; record for the next wait
                 self._record_error(e)
+            finally:
+                if tel:
+                    telemetry.histogram(
+                        "engine.push_latency_seconds").observe(
+                            time.perf_counter() - t0)
 
         self._trampoline = ENGINE_FN(_trampoline)  # keep alive
 
@@ -178,6 +201,12 @@ class ThreadedEngine(Engine):
             key = self._next_id[0]
             self._next_id[0] += 1
             self._pending[key] = fn
+            depth = len(self._pending)
+        if telemetry.enabled():
+            # queue depth = ops accepted but not yet started by a worker; the
+            # trampoline updates it downward as it drains
+            telemetry.counter("engine.pushes").inc()
+            telemetry.gauge("engine.queue_depth").set(depth)
         cv = self._var_array(const_vars)
         mv = self._var_array(mutable_vars)
         try:

@@ -150,6 +150,11 @@ def hit(name):
             return None
         rule["fired"] += 1
         args = rule["args"]
+    # always-on counter (telemetry.py module doc): robustness tests assert
+    # injected faults were actually exercised via the metrics dump
+    from . import telemetry
+
+    telemetry.counter("fault.injections", point=name).inc()
     delay = args.get("delay_ms")
     if delay:
         time.sleep(int(delay) / 1000.0)
@@ -179,9 +184,18 @@ def crash_after_bytes(name):
 def consume(name):
     """Record a firing for ``name`` without applying any action (used by
     stream wrappers that enforce ``crash_after_bytes`` themselves; the hit
-    was already counted when :func:`crash_after_bytes` armed the budget)."""
+    was already counted when :func:`crash_after_bytes` armed the budget).
+    Credits the rule that CARRIES a ``crash_after_bytes`` arg — the one
+    :func:`crash_after_bytes` armed — so a sibling rule on the same point
+    (e.g. a ``raise=1``) doesn't absorb the firing and leave the armed
+    rule's ``times=`` budget unspent, crashing forever."""
     with _lock:
         for r in _active_rules():
-            if r["point"] == name:
+            if r["point"] == name and "crash_after_bytes" in r["args"]:
                 r["fired"] += 1
-                return
+                break
+        else:
+            return
+    from . import telemetry
+
+    telemetry.counter("fault.injections", point=name).inc()

@@ -14,12 +14,14 @@ import gzip
 import os
 import struct
 import threading
+import time
 from collections import namedtuple
 
 import numpy as np
 
 from .base import MXNetError
 from . import ndarray as nd
+from . import telemetry
 from .ndarray import NDArray, array
 
 __all__ = [
@@ -72,6 +74,16 @@ class DataBatch:
         self.provide_label = provide_label
 
 
+def _observe_fetch(iterator, t0):
+    """Record one batch-fetch latency sample (docs/observability.md:
+    ``io.batch_fetch_seconds{iter=Class}``). For PrefetchingIter the sample
+    is the CONSUMER's wait — near-zero while the background producers keep
+    up, so a rising value there means the pipeline fell behind compute."""
+    telemetry.histogram(
+        "io.batch_fetch_seconds", iter=type(iterator).__name__).observe(
+            time.perf_counter() - t0)
+
+
 class DataIter:
     """Base iterator (reference: io.py:103)."""
 
@@ -85,10 +97,15 @@ class DataIter:
         pass
 
     def next(self):
+        tel = telemetry.enabled()
+        t0 = time.perf_counter() if tel else 0.0
         if self.iter_next():
-            return DataBatch(
+            batch = DataBatch(
                 data=self.getdata(), label=self.getlabel(), pad=self.getpad(), index=self.getindex()
             )
+            if tel:
+                _observe_fetch(self, t0)
+            return batch
         raise StopIteration
 
     def __next__(self):
@@ -272,7 +289,11 @@ class PrefetchingIter(DataIter):
         return True
 
     def next(self):
+        tel = telemetry.enabled()
+        t0 = time.perf_counter() if tel else 0.0
         if self.iter_next():
+            if tel:
+                _observe_fetch(self, t0)
             return self.current_batch
         raise StopIteration
 
@@ -379,10 +400,15 @@ class NDArrayIter(DataIter):
         return self.cursor < self.num_data
 
     def next(self):
+        tel = telemetry.enabled()
+        t0 = time.perf_counter() if tel else 0.0
         if self.iter_next():
-            return DataBatch(
+            batch = DataBatch(
                 data=self.getdata(), label=self.getlabel(), pad=self.getpad(), index=None
             )
+            if tel:
+                _observe_fetch(self, t0)
+            return batch
         raise StopIteration
 
     def _host(self, name, arr):

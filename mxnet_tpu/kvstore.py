@@ -22,11 +22,13 @@ from __future__ import annotations
 
 import os
 import pickle
+import time
 
 import numpy as np
 
 from .base import MXNetError
 from . import ndarray as nd
+from . import telemetry
 from .ndarray import NDArray
 from . import optimizer as opt
 
@@ -146,9 +148,11 @@ class KVStore:
             grouped = [[value]] if isinstance(value, NDArray) else [list(value)]
         else:
             grouped = _value_list(value, len(keys))
+        tel = telemetry.enabled()
         for k, vs in zip(keys, grouped):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
+            t0 = time.perf_counter() if tel else 0.0
             if isinstance(self._comm, CommDevice):
                 merged = self._comm.reduce_key(k, vs)
             else:
@@ -165,6 +169,10 @@ class KVStore:
                 self._updater(idx, merged, self._store[k])
             else:
                 self._store[k] = merged.copy()
+            if tel:
+                telemetry.histogram(
+                    "kvstore.push_latency_seconds", key=k).observe(
+                        time.perf_counter() - t0)
 
     def pull(self, key, out=None, priority=0):
         """Broadcast stored value to out arrays (reference: Comm.Broadcast)."""
@@ -174,10 +182,16 @@ class KVStore:
             outs = [[out]] if isinstance(out, NDArray) else [list(out)]
         else:
             outs = _value_list(out, len(keys))
+        tel = telemetry.enabled()
         for k, os_ in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
+            t0 = time.perf_counter() if tel else 0.0
             self._comm.broadcast(self._store[k], os_)
+            if tel:
+                telemetry.histogram(
+                    "kvstore.pull_latency_seconds", key=k).observe(
+                        time.perf_counter() - t0)
 
     # ---- updater / optimizer -------------------------------------------
     def set_updater(self, updater):
@@ -347,7 +361,6 @@ class KVStoreDist(KVStore):
         server re-run. If the transport ever grows reconnection, it must
         add request dedup before this retry remains correct."""
         import random
-        import time
 
         retries, timeout_ms = self._retry_config()
         if ikey is None:
@@ -368,6 +381,10 @@ class KVStoreDist(KVStore):
                 # buries the root cause under backoff noise
                 raise
             except MXNetError as err:
+                # failure/retry counters are always-on (rare path): a later
+                # `telemetry.dump()` must show the full outage history even
+                # when timing capture was off while it happened
+                telemetry.counter("kvstore.rpc_failures", op=what).inc()
                 if retries == 0:
                     # retry disabled: fail fast as documented — don't spend
                     # tens of seconds of probing on an error we'd raise
@@ -404,7 +421,10 @@ class KVStoreDist(KVStore):
                         "after %d retries: %s"
                         % (what, ", ".join("%s:%d" % a for a in addrs),
                            retries, err)) from err
+                telemetry.counter("kvstore.retries", op=what).inc()
                 delay = min(0.05 * (1 << (attempt - 1)), 2.0)
+                telemetry.counter("kvstore.backoff_ms", op=what).inc(
+                    int(delay * 1000))
                 time.sleep(delay * (0.5 + random.random()))
 
     def _zpush(self, ikey, arr_np):
@@ -426,6 +446,17 @@ class KVStoreDist(KVStore):
 
         # pushes run on engine threads: a raise here is recorded by the
         # engine and re-thrown from wait_for_var/wait_all (engine.py)
+        if telemetry.enabled():
+            t0 = time.perf_counter()
+            try:
+                self._with_retry("push", ikey, attempt)
+            finally:
+                # latency includes retries/backoff: this is the time the key
+                # was unavailable for the pull that orders after it
+                telemetry.histogram(
+                    "kvstore.push_latency_seconds", key=ikey).observe(
+                        time.perf_counter() - t0)
+            return
         self._with_retry("push", ikey, attempt)
 
     def _zpull(self, ikey, n):
@@ -452,6 +483,14 @@ class KVStoreDist(KVStore):
                     "expected %d" % (ikey, got, n))
             return out
 
+        if telemetry.enabled():
+            t0 = time.perf_counter()
+            try:
+                return self._with_retry("pull", ikey, attempt)
+            finally:
+                telemetry.histogram(
+                    "kvstore.pull_latency_seconds", key=ikey).observe(
+                        time.perf_counter() - t0)
         return self._with_retry("pull", ikey, attempt)
 
     # ---- API ------------------------------------------------------------
@@ -561,7 +600,6 @@ class KVStoreDist(KVStore):
         N wedged servers cost one timeout, not N (see get_num_dead_node for
         the dead-node semantics)."""
         import threading
-        import time
 
         results = [None] * len(addrs)  # None = probe never finished
 
@@ -577,26 +615,93 @@ class KVStoreDist(KVStore):
         deadline = time.monotonic() + timeout_ms / 1000.0 + 5
         for t in threads:
             t.join(max(deadline - time.monotonic(), 0))
-        return [a for a, t, r in zip(addrs, threads, results)
+        dead = [a for a, t, r in zip(addrs, threads, results)
                 if t.is_alive() or r is None or r != 0]
+        # gauge, not counter: the CURRENT number of unreachable servers. A
+        # full-group probe (get_num_dead_node, barrier) sets the exact count
+        # — including back down to 0 after recovery; a partial probe (one
+        # key's shard) only establishes a lower bound, so it can RAISE the
+        # gauge but never lower it below what a fuller probe reported.
+        # Always-on, like the failure counters — probing is already slow.
+        g = telemetry.gauge("kvstore.dead_nodes")
+        if len(addrs) == len(self._server_addrs):
+            g.set(len(dead))
+        elif len(dead) > g.value:
+            g.set(len(dead))
+        return dead
 
     def request_server_stats(self):
-        """Ask every server to log its health counters (update failures,
-        applied updates) — the worker-side trigger for the server's
-        ``b"stats"`` command; output lands on each server's log. Servers
-        that did not acknowledge are logged here: the silent server is
-        exactly the diagnostic signal this call exists to surface. The
-        round-trip is deadline-bounded (MXNET_KV_TIMEOUT_MS): a WEDGED
-        server — open socket, no replies, the case this diagnostic exists
-        for — must produce the warning, not hang the caller."""
+        """Fetch every server's health counters, returning them parsed:
+        ``{"host:port": {"updates_applied": int, "update_failures": int,
+        "has_optimizer": bool} | None}`` — ``None`` for a server that did
+        not answer. Callers and tests assert on the dict instead of
+        scraping server logs; the log side-effect is kept (each server
+        still prints its stats line, and a silent server is warned about
+        here — that silence is exactly the diagnostic signal this call
+        exists to surface).
+
+        Transport: the command channel carries no payload (src/ps.cc
+        responds to kCommand with an empty body), so each server PUBLISHES
+        its counters into its own store under a caller-chosen reserved key
+        (negative — user keys are always >= 0) via a loopback self-push,
+        and this worker pulls that key. The key is fresh per call+server, so
+        the self-push always takes the first-push init path — it can never
+        enter the BSP merge or touch the optimizer — and the server erases
+        negative-key entries after serving the pull (src/ps.cc kPull), so a
+        monitoring loop polling stats forever does not grow server memory.
+        Every round-trip is deadline-bounded (MXNET_KV_TIMEOUT_MS): a WEDGED
+        server — open socket, no replies — must produce a ``None`` entry,
+        not a hang."""
+        import ctypes
         import logging
+        import threading
+
+        from .kvstore_server import STATS_VEC_LEN, decode_stats_vec
 
         _, timeout_ms = self._retry_config()
+        out = {}
         for i, c in enumerate(self._clients):
-            if self._lib.mxt_ps_client_probe(c, b"stats", timeout_ms) != 0:
+            addr = "%s:%d" % self._server_addrs[i]
+            # unique across workers and calls: never reuses a key, so the
+            # server-side entry is always fresh (see docstring)
+            self._stats_seq = getattr(self, "_stats_seq", 0) + 1
+            key = -(2 + self._rank + self._stats_seq * max(self._nw, 1))
+            cmd = ("stats_to:%d" % key).encode()
+            if self._lib.mxt_ps_client_probe(c, cmd, timeout_ms) != 0:
                 logging.warning(
-                    "kvstore: server %s:%d did not acknowledge the stats "
-                    "command (dead or wedged?)", *self._server_addrs[i])
+                    "kvstore: server %s did not acknowledge the stats "
+                    "command (dead or wedged?)", addr)
+                out[addr] = None
+                continue
+            # bounded pull: PSClient::Pull itself has no deadline, so it
+            # runs on a daemon thread we abandon on timeout — a server that
+            # wedges AFTER acking the command yields a None entry, not a
+            # hang. The buffer stays referenced by the thread's closure, so
+            # a late response writes into live memory, never a freed one.
+            buf = np.zeros(STATS_VEC_LEN, np.float32)
+            result = [None]
+
+            def pull(c=c, key=key, buf=buf, result=result):
+                result[0] = self._lib.mxt_ps_client_pull(
+                    c, key,
+                    buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    STATS_VEC_LEN)
+
+            t = threading.Thread(target=pull, daemon=True)
+            t.start()
+            t.join(timeout_ms / 1000.0)
+            got = result[0]
+            if t.is_alive() or got != STATS_VEC_LEN:
+                logging.warning(
+                    "kvstore: server %s acknowledged stats but the pull %s "
+                    "(want %d values) — wedged or mixed-version cluster?",
+                    addr,
+                    "timed out" if t.is_alive() else "returned %s" % got,
+                    STATS_VEC_LEN)
+                out[addr] = None
+                continue
+            out[addr] = decode_stats_vec(buf)
+        return out
 
     def _stop_servers(self):
         """Shut down server processes (rank 0, exit path)."""

@@ -21,9 +21,41 @@ import threading
 
 import numpy as np
 
+from . import telemetry
 from ._native import COMMAND_FN, UPDATER_FN, get_lib
 
-__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+__all__ = ["KVStoreServer", "_init_kvstore_server_module",
+           "STATS_VEC_LEN", "encode_stats_vec", "decode_stats_vec"]
+
+# Wire format of the vector a server publishes under a reserved key when a
+# worker sends ``stats_to:<key>`` (kvstore.request_server_stats decodes it
+# back into a dict). The transport ships float32, which stops representing
+# consecutive integers past 2^24 (~16.7M updates — a few hours of real
+# training), so each counter travels as two 24-bit words: exact to 2^48.
+# Order is the wire contract — append fields, never reorder.
+_STATS_COUNTER_FIELDS = ("updates_applied", "update_failures")
+STATS_VEC_LEN = 2 * len(_STATS_COUNTER_FIELDS) + 1  # + has_optimizer flag
+
+
+def encode_stats_vec(stats):
+    """Server side: stats dict -> float32 wire vector (lo24/hi words)."""
+    vec = []
+    for f in _STATS_COUNTER_FIELDS:
+        v = int(stats[f])
+        vec.append(float(v & 0xFFFFFF))
+        vec.append(float(v >> 24))
+    vec.append(1.0 if stats["has_optimizer"] else 0.0)
+    return np.array(vec, np.float32)
+
+
+def decode_stats_vec(arr):
+    """Worker side: float32 wire vector -> stats dict (inverse of encode)."""
+    vals = [int(round(float(x))) for x in arr]
+    out = {}
+    for i, f in enumerate(_STATS_COUNTER_FIELDS):
+        out[f] = vals[2 * i] | (vals[2 * i + 1] << 24)
+    out["has_optimizer"] = bool(vals[2 * len(_STATS_COUNTER_FIELDS)])
+    return out
 
 
 class KVStoreServer:
@@ -42,6 +74,9 @@ class KVStoreServer:
         self._handle = lib.mxt_ps_server_create(port, num_workers, 1 if sync else 0)
         if not self._handle:
             raise RuntimeError("cannot bind PS server port %d" % port)
+        self._port = port
+        self._self_client = None  # lazy loopback client for stats publishing
+        self._self_client_lock = threading.Lock()
         self._updater = None
         self._updater_lock = threading.Lock()
         self._states = {}
@@ -104,6 +139,7 @@ class KVStoreServer:
                 if err is None:
                     with self._stats_lock:
                         self._updates_applied += 1
+                    telemetry.counter("kvstore_server.updates_applied").inc()
                 else:
                     self._note_update_failure(int(key), err)
 
@@ -122,6 +158,18 @@ class KVStoreServer:
                 # operator-facing liveness/health line on the server log;
                 # in-process callers use .stats() directly
                 logging.warning("kvstore-server stats: %s", self.stats())
+            elif cmd.startswith(b"stats_to:"):
+                # log (same side-effect as plain "stats") AND publish the
+                # counters under the worker-chosen reserved key, so
+                # kvstore.request_server_stats can pull them as data — the
+                # command response itself carries no payload (src/ps.cc)
+                logging.warning("kvstore-server stats: %s", self.stats())
+                try:
+                    self._publish_stats(int(cmd[9:]))
+                except Exception:  # noqa: BLE001 — a failed publish must not
+                    # take down the conn handler; the worker sees a short
+                    # pull and warns
+                    logging.exception("kvstore-server: stats publish failed")
 
         self._apply_cb = UPDATER_FN(_apply)        # keep refs alive
         self._command_cb = COMMAND_FN(_command)
@@ -142,6 +190,7 @@ class KVStoreServer:
         re-raises out of :meth:`run`, killing the server process (workers
         then observe a dead node via their probes instead of pulling
         quietly-stale weights forever)."""
+        telemetry.counter("kvstore_server.update_failures").inc()
         with self._stats_lock:
             self._update_failures += 1
             self._last_update_error = "key %d: %r" % (key, err)
@@ -162,6 +211,39 @@ class KVStoreServer:
                        stats["last_update_error"], stats)) from err
 
             self._exec_q.put(die)
+
+    def _publish_stats(self, key):
+        """Push this server's counters into its OWN store under ``key``
+        (runs on a conn handler thread, before the command response is sent,
+        so the requesting worker's follow-up pull always finds the entry).
+
+        The worker picks a fresh negative key per call, so this self-push
+        always takes the server's first-push init path (src/ps.cc
+        HandlePush) — it cannot join a BSP merge round or run the optimizer.
+        Only already-imported modules are touched: a first-time import here
+        would deadlock on the import lock the blocked main thread holds.
+
+        The push happens WHILE holding ``_self_client_lock``: the shutdown
+        path takes the same lock before destroying the loopback client, so
+        a stats request racing a stop can never push on a freed handle —
+        teardown waits for the in-flight publish (the server is still alive
+        at that point, so the publish completes promptly)."""
+        import ctypes
+
+        vec = encode_stats_vec(self.stats())
+        with self._self_client_lock:
+            if self._self_client is None:
+                c = self._lib.mxt_ps_client_create(b"127.0.0.1", self._port)
+                if not c:
+                    raise RuntimeError(
+                        "cannot open loopback client to own port %d"
+                        % self._port)
+                self._self_client = c
+            rc = self._lib.mxt_ps_client_push(
+                self._self_client, key,
+                vec.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), vec.size)
+        if rc != 0:
+            raise RuntimeError("loopback stats push failed (key %d)" % key)
 
     def stats(self):
         """Health counters (also printed by the ``b"stats"`` client command)."""
@@ -227,6 +309,10 @@ class KVStoreServer:
 
         d = threading.Thread(target=drainer)
         d.start()
+        with self._self_client_lock:
+            if self._self_client is not None:
+                self._lib.mxt_ps_client_destroy(self._self_client)
+                self._self_client = None
         self._lib.mxt_ps_server_destroy(self._handle)
         stop_drain.set()
         d.join()

@@ -17,6 +17,7 @@ import numpy as np
 from .. import io
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import telemetry
 from ..base import MXNetError
 from ..model import BatchEndParam
 
@@ -222,27 +223,74 @@ class BaseModule:
 
         ################################################################################
         # training loop (reference: base_module.py:475-533)
+        #
+        # Telemetry (docs/observability.md): while telemetry is enabled every
+        # batch records its wall time split into data-wait (blocking on the
+        # iterator) vs compute (forward_backward+update dispatch — on TPU
+        # this is DISPATCH time; XLA executes async, so sustained throughput
+        # comes from fit.step_time, not fit.compute), plus imgs/sec and
+        # per-epoch structured events. Disabled: one enabled() check/batch.
         ################################################################################
+        fit_instruments = None  # stable handles, resolved once when enabled:
+        # re-resolving through the registry every batch would take the
+        # global lock and re-render keys 6x per step for nothing
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
+            telemetry.event("epoch_start", epoch=epoch)
             eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
             end_of_batch = False
+            tel = telemetry.enabled()
+            t0 = time.perf_counter() if tel else 0.0
             next_data_batch = next(data_iter)
+            if tel:
+                telemetry.histogram("fit.data_wait_seconds").observe(
+                    time.perf_counter() - t0)
             while not end_of_batch:
                 data_batch = next_data_batch
+                tel = telemetry.enabled()
+                if tel and fit_instruments is None:
+                    fit_instruments = (
+                        telemetry.histogram("fit.compute_seconds"),
+                        telemetry.histogram("fit.data_wait_seconds"),
+                        telemetry.histogram("fit.step_time_seconds"),
+                        telemetry.counter("fit.batches"),
+                        telemetry.counter("fit.samples"),
+                        telemetry.gauge("fit.imgs_per_sec"),
+                    )
+                t_step = time.perf_counter() if tel else 0.0
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                # span, not gated on `tel`: with the profiler running but
+                # telemetry off, fit.step must still land on the chrome
+                # trace (span() itself no-ops when BOTH are off)
+                with telemetry.span("fit.step", "fit"):
+                    self.forward_backward(data_batch)
+                    self.update()
+                t_compute = time.perf_counter() if tel else 0.0
                 try:
                     # pre-fetch next batch to overlap host IO with device work
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch)
                 except StopIteration:
                     end_of_batch = True
+                t_data = time.perf_counter() if tel else 0.0
                 self.update_metric(eval_metric, data_batch.label)
+                if tel:
+                    h_comp, h_wait, h_step, c_batch, c_samp, g_ips = \
+                        fit_instruments
+                    now = time.perf_counter()
+                    step_s = now - t_step
+                    h_comp.observe(t_compute - t_step)
+                    h_wait.observe(t_data - t_compute)
+                    h_step.observe(step_s)
+                    n = _batch_samples(data_batch, train_data)
+                    c_batch.inc()
+                    if n:
+                        c_samp.inc(n)
+                        if step_s > 0:
+                            g_ips.set(n / step_s)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -257,6 +305,12 @@ class BaseModule:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            telemetry.counter("fit.epochs").inc()
+            telemetry.event(
+                "epoch_end", epoch=epoch, seconds=round(toc - tic, 6),
+                nbatch=nbatch,
+                metrics={name: val
+                         for name, val in eval_metric.get_name_value()})
             # sync aux params across devices (reference: base_module.py:514-516)
             arg_params_, aux_params_ = self.get_params()
             self.set_params(arg_params_, aux_params_)
@@ -384,3 +438,16 @@ def _as_list(obj):
     if isinstance(obj, (list, tuple)):
         return obj
     return [obj]
+
+
+def _batch_samples(data_batch, train_data):
+    """Samples in this batch, for throughput metrics: leading dim of the
+    first data array, net of padding; iterator batch_size as the fallback."""
+    try:
+        n = int(data_batch.data[0].shape[0])
+    except (AttributeError, IndexError, TypeError):
+        n = int(getattr(train_data, "batch_size", 0) or 0)
+    pad = getattr(data_batch, "pad", None)
+    if pad:
+        n = max(n - int(pad), 0)
+    return n

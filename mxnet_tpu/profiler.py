@@ -17,7 +17,8 @@ import os
 import threading
 import time
 
-__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile"]
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "emit_span", "is_running"]
 
 _state = {
     "mode": "symbolic",
@@ -31,30 +32,67 @@ _state = {
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
     """(reference: profiler.py profiler_set_config; modes 'symbolic'|'all')"""
-    _state["mode"] = mode
-    _state["filename"] = filename
+    with _state["lock"]:
+        _state["mode"] = mode
+        _state["filename"] = filename
 
 
 def profiler_set_state(state="stop"):
-    """'run' | 'stop' (reference: profiler.py profiler_set_state)."""
-    if state == "run" and not _state["running"]:
-        _state["running"] = True
-        _state["events"] = []
-        trace_dir = os.environ.get("MXNET_PROFILER_TRACE_DIR")
-        if trace_dir:
-            import jax
+    """'run' | 'stop' (reference: profiler.py profiler_set_state).
 
-            jax.profiler.start_trace(trace_dir)
-            _state["jax_trace_dir"] = trace_dir
-    elif state == "stop" and _state["running"]:
-        _state["running"] = False
-        if _state["jax_trace_dir"]:
-            import jax
+    State transitions and the event-buffer swap run under ``_state["lock"]``:
+    a span completing on a worker thread while another thread restarts the
+    profiler must land in exactly one of the old/new buffers, never corrupt
+    the list mid-swap (the jax trace start/stop rides along under the same
+    lock — it is rare and must not interleave with a concurrent toggle).
+    """
+    with _state["lock"]:
+        if state == "run" and not _state["running"]:
+            _state["running"] = True
+            _state["events"] = []
+            trace_dir = os.environ.get("MXNET_PROFILER_TRACE_DIR")
+            if trace_dir:
+                import jax
 
-            jax.profiler.stop_trace()
-            _state["jax_trace_dir"] = None
-    else:
+                jax.profiler.start_trace(trace_dir)
+                _state["jax_trace_dir"] = trace_dir
+        elif state == "stop" and _state["running"]:
+            _state["running"] = False
+            if _state["jax_trace_dir"]:
+                import jax
+
+                jax.profiler.stop_trace()
+                _state["jax_trace_dir"] = None
+        else:
+            return
+
+
+def is_running():
+    """Whether the python-tier profiler is collecting spans."""
+    return _state["running"]
+
+
+def emit_span(name, category, wall_t0, dur_s):
+    """Append one complete span to the chrome-trace buffer if the profiler
+    runs — the hook `telemetry.span` uses, so runtime-phase spans (the fit
+    loop's `fit.step`, any user-opened span) land in the same timeline as
+    the op/executor spans this module records itself."""
+    if not _state["running"]:
         return
+    with _state["lock"]:
+        if not _state["running"]:
+            return
+        _state["events"].append(
+            {
+                "name": name,
+                "cat": category,
+                "ph": "X",
+                "ts": wall_t0 * 1e6,
+                "dur": dur_s * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % (1 << 16),
+            }
+        )
 
 
 class _NullSpan:
@@ -82,19 +120,7 @@ class _Span:
         return self
 
     def __exit__(self, *a):
-        if _state["running"]:
-            with _state["lock"]:
-                _state["events"].append(
-                    {
-                        "name": self.name,
-                        "cat": self.category,
-                        "ph": "X",
-                        "ts": self.t0 * 1e6,
-                        "dur": (time.time() - self.t0) * 1e6,
-                        "pid": os.getpid(),
-                        "tid": threading.get_ident() % (1 << 16),
-                    }
-                )
+        emit_span(self.name, self.category, self.t0, time.time() - self.t0)
         return False
 
 
@@ -112,9 +138,14 @@ def record_span(name, category="operator"):
 
 def dump_profile():
     """Write accumulated spans as chrome://tracing JSON
-    (reference: MXDumpProfile → Profiler::DumpProfile, profiler.h:88)."""
-    with open(_state["filename"], "w") as f:
-        json.dump({"traceEvents": _state["events"], "displayTimeUnit": "ms"}, f)
+    (reference: MXDumpProfile → Profiler::DumpProfile, profiler.h:88).
+    The event list is snapshotted under the lock so a span completing on a
+    worker thread during the dump cannot mutate the list mid-serialization."""
+    with _state["lock"]:
+        events = list(_state["events"])
+        filename = _state["filename"]
+    with open(filename, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
 
 
 # autostart + at-exit dump (reference: MXNET_PROFILER_AUTOSTART env,

@@ -266,6 +266,14 @@ class PSServer {
         Respond(c, MsgHeader{kResp, h.key, h.req_id,
                              static_cast<uint64_t>(w.size() * sizeof(float))},
                 w.data());
+        if (h.key < 0) {
+          // negative keys are reserved single-shot diagnostic slots (the
+          // stats_to self-publish, kvstore_server.py): exactly one reader
+          // pulls each once, so erase after serving — without this every
+          // stats poll would permanently leak one Entry per server
+          std::unique_lock<std::mutex> mlk(mu_);
+          entries_.erase(h.key);
+        }
         break;
       }
       case kPushPull: {

@@ -1,0 +1,598 @@
+"""Unified runtime telemetry: metrics registry, span tracing, training events.
+
+The reference framework's observability was scattered — the profiler covered
+op spans (src/engine/profiler.h), Speedometer printed one throughput number
+(python/mxnet/callback.py:103), and everything else was free-text logging.
+This module gives the runtime ONE process-wide, thread-safe registry of
+
+* **counters**   — monotonically increasing event counts (engine push errors,
+  KVStore retries, injected faults, server-side update failures);
+* **gauges**     — last-value instruments (engine queue depth, dead PS nodes,
+  instantaneous imgs/sec);
+* **histograms** — bounded-bucket latency distributions with p50/p95/p99
+  (step time, data wait, KV push/pull RTT, batch fetch);
+
+plus **named spans** (context managers that feed the existing chrome-trace
+profiler AND observe their duration as a histogram) and **structured events**
+(epoch markers etc. as JSON-lines records).
+
+Exposition:
+
+* ``dump()``             — JSON-serializable snapshot of every instrument;
+* ``prometheus_text()``  — Prometheus text exposition format (metric names
+  are sanitized and prefixed ``mxnet_``);
+* a background flusher   — ``MXNET_TELEMETRY_FILE`` names a JSON-lines sink;
+  a daemon thread appends a snapshot record every
+  ``MXNET_TELEMETRY_INTERVAL_S`` seconds (default 60) and a final one at
+  exit; structured events are appended to the same file as they happen.
+
+Overhead contract (the disabled-by-default fast path): metric OBJECTS are
+always live — an ``inc()`` on a disabled registry still counts, so rare-path
+counters (errors, retries, faults) never lose events — but every TIMING
+instrumentation site in the runtime guards on :func:`enabled` before touching
+the clock, so with telemetry off a hot path pays one module-global load and a
+branch, no ``time`` calls, no dict lookups, no lock traffic. ``span()``
+returns a shared no-op object when neither telemetry nor the profiler is
+active.
+
+Enable with ``MXNET_TELEMETRY=1``, by setting ``MXNET_TELEMETRY_FILE``, or
+programmatically via :func:`enable`.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "Counter", "Gauge", "Histogram",
+    "counter", "gauge", "histogram", "span", "event",
+    "enable", "disable", "enabled",
+    "dump", "prometheus_text", "reset",
+    "flush", "start_flusher", "stop_flusher",
+]
+
+# ---------------------------------------------------------------------------
+# instruments
+# ---------------------------------------------------------------------------
+
+# Latency buckets in seconds: sub-millisecond host dispatch up through the
+# tens-of-seconds XLA-compile / dead-node-probe tail. Bounded: 16 buckets +
+# overflow, so a histogram's memory never grows with observation count.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0, 30.0,
+)
+
+
+class Counter:
+    """Monotonic event count. ``inc`` is atomic under its own lock, so N
+    concurrent writers lose nothing (asserted in tests_tpu/test_telemetry)."""
+
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name, labels=()):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError("counter can only increase (got %r)" % (n,))
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        return self.value
+
+
+class Gauge:
+    """Last-value instrument (queue depth, dead nodes, imgs/sec)."""
+
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name, labels=()):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v):
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1):
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        return self.value
+
+
+class Histogram:
+    """Bounded-bucket distribution with quantile estimates.
+
+    Observations land in fixed buckets (cumulative counts in snapshots, the
+    Prometheus convention); p50/p95/p99 are estimated by linear interpolation
+    inside the covering bucket, clamped to the observed min/max — exact
+    enough for latency triage, O(len(buckets)) memory forever.
+    """
+
+    __slots__ = ("name", "labels", "_lock", "_bounds", "_counts",
+                 "_count", "_sum", "_min", "_max")
+
+    def __init__(self, name, buckets=None, labels=()):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._bounds = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        if not self._bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self._bounds) + 1)  # last = overflow (+Inf)
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v):
+        v = float(v)
+        idx = bisect.bisect_left(self._bounds, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    def time(self):
+        """Context manager observing the block's wall duration."""
+        return _Timer(self)
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    def percentile(self, p):
+        """Estimated value at percentile ``p`` (0-100), or None when empty."""
+        with self._lock:
+            return self._percentile_locked(p)
+
+    def _percentile_locked(self, p):
+        if self._count == 0:
+            return None
+        target = self._count * min(max(p, 0.0), 100.0) / 100.0
+        cum = 0
+        lo = 0.0
+        for i, hi in enumerate(self._bounds):
+            prev = cum
+            cum += self._counts[i]
+            if cum >= target:
+                frac = ((target - prev) / self._counts[i]) if self._counts[i] else 0.0
+                est = lo + frac * (hi - lo)
+                return min(max(est, self._min), self._max)
+            lo = hi
+        return self._max  # landed in the overflow bucket
+
+    def snapshot(self):
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0, "sum": 0.0}
+            cum, cum_counts = 0, []
+            for c in self._counts[:-1]:
+                cum += c
+                cum_counts.append(cum)
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "p50": self._percentile_locked(50),
+                "p95": self._percentile_locked(95),
+                "p99": self._percentile_locked(99),
+                "buckets": {  # cumulative, le-keyed (Prometheus convention)
+                    **{("%g" % b): c for b, c in zip(self._bounds, cum_counts)},
+                    "+Inf": self._count,
+                },
+            }
+
+
+class _Timer:
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        self._hist.observe(time.perf_counter() - self._t0)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+_lock = threading.RLock()
+_metrics = {}  # rendered key -> instrument
+_name_types = {}  # bare name -> instrument class (Prometheus: one type/name)
+_events = deque(maxlen=1024)
+_enabled = False
+_flusher = None  # (thread, stop_event, path, interval)
+_file_lock = threading.Lock()  # serializes sink appends (flusher vs events)
+
+
+def _key(name, labels):
+    if not labels:
+        return name
+    return "%s{%s}" % (name, ",".join("%s=%s" % kv for kv in labels))
+
+
+def _get(cls, name, labels_dict, **ctor_kw):
+    labels = tuple(sorted((str(k), str(v)) for k, v in labels_dict.items()))
+    key = _key(name, labels)
+    with _lock:
+        # one instrument KIND per bare name, across all label sets — the
+        # Prometheus data-model rule; enforcing it at registration turns a
+        # mixed-type name into an immediate error at the misuse site
+        # instead of a crashing scrape endpoint later
+        have = _name_types.setdefault(name, cls)
+        if have is not cls:
+            raise TypeError("metric name %r already registered as %s"
+                            % (name, have.__name__))
+        m = _metrics.get(key)
+        if m is None:
+            m = cls(name, labels=labels, **ctor_kw)
+            _metrics[key] = m
+        return m
+
+
+def counter(name, **labels):
+    """Get-or-create the counter ``name`` (labels are kwargs)."""
+    return _get(Counter, name, labels)
+
+
+def gauge(name, **labels):
+    """Get-or-create the gauge ``name``."""
+    return _get(Gauge, name, labels)
+
+
+def histogram(name, buckets=None, **labels):
+    """Get-or-create the histogram ``name`` (bounded buckets, seconds)."""
+    return _get(Histogram, name, labels, buckets=buckets)
+
+
+def enable():
+    """Turn on timing capture, spans, and structured events."""
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def enabled():
+    """Whether timing instrumentation sites should record. Rare-path
+    counters (errors, retries, faults) count regardless — see module doc."""
+    return _enabled
+
+
+def reset():
+    """Drop every instrument and buffered event (test isolation)."""
+    with _lock:
+        _metrics.clear()
+        _name_types.clear()
+        _events.clear()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "category", "_t0", "_wall0")
+
+    def __init__(self, name, category):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self._wall0 = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        dur = time.perf_counter() - self._t0
+        if _enabled:
+            histogram(self.name).observe(dur)
+        from . import profiler
+
+        profiler.emit_span(self.name, self.category, self._wall0, dur)
+        return False
+
+
+def span(name, category="telemetry"):
+    """Context manager timing one named span.
+
+    While telemetry is enabled the duration lands in histogram ``name``;
+    while the profiler runs (``profiler_set_state('run')``) the span is ALSO
+    appended to the chrome-trace event buffer, so `dump_profile()` timelines
+    show runtime phases next to op/executor spans. When neither is active a
+    shared no-op is returned (the near-zero disabled path).
+    """
+    if not _enabled:
+        from . import profiler
+
+        if not profiler.is_running():
+            return _NULL_SPAN
+    return _Span(name, category)
+
+
+# ---------------------------------------------------------------------------
+# structured events (JSON lines)
+# ---------------------------------------------------------------------------
+
+
+def event(name, **fields):
+    """Record a structured training event (epoch markers, resume points).
+
+    Buffered in memory (bounded deque, visible via ``dump()['events']``) and
+    appended immediately as one JSON line to ``MXNET_TELEMETRY_FILE`` when a
+    file sink is active. No-op while telemetry is disabled.
+    """
+    if not _enabled:
+        return None
+    rec = {"ts": time.time(), "type": "event", "event": name}
+    rec.update(fields)
+    with _lock:
+        _events.append(rec)
+        sink = _flusher[2] if _flusher else os.environ.get("MXNET_TELEMETRY_FILE")
+    if sink:
+        _append_line(sink, rec)
+    return rec
+
+
+def events(name=None):
+    """Buffered events, optionally filtered by event name (newest last)."""
+    with _lock:
+        recs = list(_events)
+    if name is not None:
+        recs = [r for r in recs if r.get("event") == name]
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+
+def dump(include_events=True):
+    """JSON-serializable snapshot of the whole registry."""
+    with _lock:
+        items = sorted(_metrics.items())
+        evs = list(_events) if include_events else None
+    out = {
+        "ts": time.time(),
+        "enabled": _enabled,
+        "counters": {},
+        "gauges": {},
+        "histograms": {},
+    }
+    kind = {Counter: "counters", Gauge: "gauges", Histogram: "histograms"}
+    for key, m in items:
+        out[kind[type(m)]][key] = m.snapshot()
+    if evs is not None:
+        out["events"] = evs
+    return out
+
+
+def _prom_name(name):
+    import re
+
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not re.match(r"[a-zA-Z_:]", name):
+        name = "_" + name
+    return "mxnet_" + name
+
+
+def _prom_labels(labels, extra=()):
+    pairs = tuple(labels) + tuple(extra)
+    if not pairs:
+        return ""
+    body = ",".join('%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+                    for k, v in pairs)
+    return "{%s}" % body
+
+
+def _prom_num(v):
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def prometheus_text():
+    """The registry in Prometheus text exposition format (v0.0.4).
+
+    Metric names are sanitized (``.`` -> ``_``) and prefixed ``mxnet_``;
+    histograms expose the standard ``_bucket``/``_sum``/``_count`` triplet
+    with cumulative ``le`` buckets. Serve this from any HTTP handler to make
+    a training job scrapeable (docs/observability.md has a ready example).
+    """
+    with _lock:
+        items = sorted(_metrics.items())
+    by_name = {}
+    for _, m in items:
+        by_name.setdefault(m.name, []).append(m)
+    lines = []
+    for name in sorted(by_name):
+        group = by_name[name]
+        pname = _prom_name(name)
+        if isinstance(group[0], Counter):
+            lines.append("# TYPE %s counter" % pname)
+            for m in group:
+                lines.append("%s%s %s" % (pname, _prom_labels(m.labels),
+                                          _prom_num(m.value)))
+        elif isinstance(group[0], Gauge):
+            lines.append("# TYPE %s gauge" % pname)
+            for m in group:
+                lines.append("%s%s %s" % (pname, _prom_labels(m.labels),
+                                          _prom_num(m.value)))
+        else:
+            lines.append("# TYPE %s histogram" % pname)
+            for m in group:
+                # ONE snapshot (one lock acquisition) feeds every line: a
+                # second read of the live counts could see observations that
+                # arrived after it, printing finite buckets above le="+Inf"
+                # — a non-monotone histogram scrapers reject
+                snap = m.snapshot()
+                buckets = snap.get("buckets")
+                if buckets is None:  # empty histogram: all-zero buckets
+                    buckets = {"%g" % b: 0 for b in m._bounds}
+                    buckets["+Inf"] = 0
+                for le, cum in buckets.items():
+                    lines.append("%s_bucket%s %d" % (
+                        pname, _prom_labels(m.labels, (("le", le),)), cum))
+                lines.append("%s_sum%s %s" % (pname, _prom_labels(m.labels),
+                                              _prom_num(snap["sum"])))
+                lines.append("%s_count%s %d" % (pname, _prom_labels(m.labels),
+                                                snap["count"]))
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# background flusher
+# ---------------------------------------------------------------------------
+
+
+def _append_line(path, rec):
+    # one writer at a time: a multi-chunk snapshot append racing an event
+    # append would interleave buffered chunks and tear the JSON lines
+    # (O_APPEND only makes single syscalls atomic). This serializes writers
+    # within the process; across processes use one file per process, like
+    # the profiler's pid-suffixed default.
+    try:
+        with _file_lock, open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "telemetry: cannot append to %s", path, exc_info=True)
+
+
+def flush(path=None):
+    """Append one snapshot record to the JSON-lines sink now."""
+    path = path or (_flusher[2] if _flusher else
+                    os.environ.get("MXNET_TELEMETRY_FILE"))
+    if not path:
+        return
+    rec = dump(include_events=False)
+    rec["type"] = "snapshot"
+    _append_line(path, rec)
+
+
+def start_flusher(path=None, interval_s=None):
+    """Start the periodic snapshot flusher (idempotent).
+
+    Defaults come from ``MXNET_TELEMETRY_FILE`` / ``MXNET_TELEMETRY_INTERVAL_S``
+    (interval default 60s, floored at 0.05s). Also enables telemetry — a
+    flushing-but-disabled registry would record empty snapshots forever.
+    """
+    global _flusher
+    path = path or os.environ.get("MXNET_TELEMETRY_FILE")
+    if not path:
+        raise ValueError("no telemetry file: pass path= or set "
+                         "MXNET_TELEMETRY_FILE")
+    if interval_s is None:
+        interval_s = float(os.environ.get("MXNET_TELEMETRY_INTERVAL_S", "60"))
+    interval_s = max(float(interval_s), 0.05)
+    with _lock:
+        if _flusher is not None:
+            return
+        enable()
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval_s):
+                flush(path)
+
+        t = threading.Thread(target=loop, name="mxnet-telemetry-flusher",
+                             daemon=True)
+        _flusher = (t, stop, path, interval_s)
+        t.start()
+
+
+def stop_flusher(final_flush=True):
+    """Stop the periodic flusher (writing one last snapshot by default)."""
+    global _flusher
+    with _lock:
+        if _flusher is None:
+            return
+        t, stop, path, _ = _flusher
+        _flusher = None
+    stop.set()
+    t.join(timeout=5)
+    if final_flush:
+        flush(path)
+
+
+def _maybe_autostart():
+    import atexit
+
+    from .base import env_flag
+
+    if os.environ.get("MXNET_TELEMETRY_FILE"):
+        start_flusher()
+        atexit.register(stop_flusher)
+    elif env_flag("MXNET_TELEMETRY"):
+        enable()
+
+
+_maybe_autostart()

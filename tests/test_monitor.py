@@ -1,0 +1,139 @@
+"""Monitor — per-node statistics collection (reference:
+tests/python/unittest/test_monitor.py + monitor.py:16): interval
+activation, the node-output hook, the ``toc()`` weight/gradient sweep,
+pattern filtering and sorting. Previously untested."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import monitor as monitor_mod
+
+
+def _bound_executor(seed=0):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    rng = np.random.RandomState(seed)
+    for _, a in ex.arg_dict.items():
+        a[:] = rng.rand(*a.shape).astype(np.float32)
+    return ex
+
+
+def _train_batch(ex):
+    ex.forward(is_train=True)
+    ex.backward()
+
+
+def test_interval_activation():
+    """interval=2: windows open on batches 0, 2, 4... and ONLY there."""
+    mon = mx.mon.Monitor(interval=2)
+    ex = _bound_executor()
+    mon.install(ex)
+    active = []
+    for _ in range(4):
+        mon.tic()
+        active.append(mon.activated)
+        _train_batch(ex)
+        mon.toc()
+    assert active == [True, False, True, False]
+
+
+def test_off_interval_batches_collect_nothing():
+    mon = mx.mon.Monitor(interval=2)
+    ex = _bound_executor()
+    mon.install(ex)
+    mon.tic()                      # batch 0: active
+    _train_batch(ex)
+    assert mon.toc()
+    mon.tic()                      # batch 1: inactive
+    _train_batch(ex)
+    assert mon.toc() == []
+    # toc without any tic is a no-op too
+    assert mx.mon.Monitor(interval=1).toc() == []
+
+
+def test_node_outputs_reach_stat_helper():
+    """While a window is open the executor's monitored forward feeds every
+    node output through stat_helper (the per-node debug path)."""
+    mon = mx.mon.Monitor(interval=1)
+    ex = _bound_executor()
+    mon.install(ex)
+    mon.tic()
+    _train_batch(ex)
+    records = mon.toc()
+    assert records, "monitor collected nothing"
+    names = [name for _, name, _ in records]
+    assert any("fc" in n and "output" in n for n in names), names
+
+
+def test_toc_sweeps_weights_and_grads():
+    """toc() adds the bound arg arrays and their gradients (name + _grad)."""
+    mon = mx.mon.Monitor(interval=1)
+    ex = _bound_executor()
+    mon.install(ex)
+    mon.tic()
+    _train_batch(ex)
+    names = [name for _, name, _ in mon.toc()]
+    assert "fc_weight" in names
+    assert "fc_bias" in names
+    assert "fc_weight_grad" in names, names
+    assert "fc_bias_grad" in names, names
+
+
+def test_pattern_filters_and_sort_orders():
+    mon = mx.mon.Monitor(interval=1, pattern=".*weight.*", sort=True)
+    ex = _bound_executor()
+    mon.install(ex)
+    mon.tic()
+    _train_batch(ex)
+    records = mon.toc()
+    names = [name for _, name, _ in records]
+    assert names, "pattern matched nothing"
+    assert all("weight" in n for n in names), names
+    assert names == sorted(names)
+
+
+def test_custom_stat_func_and_step_numbering():
+    """stat_func replaces the default RMS; records carry the batch number of
+    the window that collected them."""
+    seen = []
+
+    def stat(arr):
+        seen.append(arr.shape)
+        return mx.nd.max(arr)
+
+    mon = mx.mon.Monitor(interval=2, stat_func=stat, pattern=".*weight$")
+    ex = _bound_executor()
+    mon.install(ex)
+    for _ in range(3):               # windows at step 0 and step 2
+        mon.tic()
+        _train_batch(ex)
+        records = mon.toc()
+    assert seen, "stat_func never called"
+    steps = {step for step, _, _ in records}
+    # tic() bumps step after opening the window, so records carry the
+    # 1-based batch count: the window opened at batch index 2 records as 3
+    assert steps == {3}, steps
+    # rendered stat is max(weight) as a scalar string
+    _, name, rendered = [r for r in records if r[1] == "fc_weight"][0]
+    expected = float(np.max(ex.arg_dict["fc_weight"].asnumpy()))
+    assert abs(float(rendered.strip()) - expected) < 1e-5
+
+
+def test_monitor_through_module_fit():
+    """install_monitor on a Module drives tic/toc per batch in fit (the
+    reference wiring, base_module.py fit monitor hooks)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 3).astype(np.float32)
+    y = rng.randint(0, 4, 8).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=4)
+    collected = []
+    mon = mx.mon.Monitor(interval=1, stat_func=lambda a: (
+        collected.append(1), mx.nd.norm(a))[1])
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=1, monitor=mon,
+            optimizer_params={"learning_rate": 0.01, "rescale_grad": 1.0})
+    assert collected, "monitor never saw a stat during fit"

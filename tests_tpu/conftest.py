@@ -19,14 +19,16 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
 # Host-side suites that live here because they belong to the TPU build's
-# runtime (ci/run_tests.sh faults) but exercise no accelerator: they run on
-# CPU-only hosts and are exempt from the hardware gate below.
-_HOST_ONLY_FILES = {"test_fault_tolerance.py"}
+# runtime (ci/run_tests.sh faults / telemetry) but exercise no accelerator:
+# they run on CPU-only hosts and are exempt from the hardware gate below.
+_HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py"}
 
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "faults: fault-injection / robustness tests (host-only)")
+    config.addinivalue_line(
+        "markers", "telemetry: runtime-telemetry tests (host-only)")
     config.addinivalue_line("markers", "slow: long-running tests")
 
 

@@ -1,0 +1,563 @@
+"""Runtime-telemetry suite: registry semantics under concurrent writers,
+Prometheus / chrome-trace exposition, fit-loop step metrics, and the KV
+retry counters under deterministic fault injection.
+
+Host-side only: runs on a CPU-only machine (tests_tpu/conftest.py exempts
+this file from the hardware gate). `ci/run_tests.sh telemetry` is the CI
+tier.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import fault  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+from mxnet_tpu import telemetry  # noqa: E402
+from mxnet_tpu._native import get_lib  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+
+pytestmark = pytest.mark.telemetry
+
+needs_native = pytest.mark.skipif(get_lib() is None,
+                                  reason="native lib unavailable")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Each test sees a fresh, enabled registry and leaves it disabled."""
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.stop_flusher(final_flush=False)
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# instrument semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_basics():
+    c = telemetry.counter("t.counter")
+    c.inc()
+    c.inc(41)
+    assert c.value == 42
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = telemetry.gauge("t.gauge")
+    g.set(2.5)
+    g.inc()
+    g.dec(0.5)
+    assert g.value == 3.0
+    # identity: same name+labels -> same object; labels split instruments
+    assert telemetry.counter("t.counter") is c
+    assert telemetry.counter("t.counter", op="x") is not c
+    # a name registered as one kind cannot silently become another — even
+    # under a different label set (the Prometheus one-type-per-name rule;
+    # a mixed-type name would crash the scrape endpoint otherwise)
+    with pytest.raises(TypeError):
+        telemetry.gauge("t.counter")
+    with pytest.raises(TypeError):
+        telemetry.histogram("t.counter", key="3")
+    telemetry.prometheus_text()  # still renders after the rejected attempts
+
+
+def test_histogram_percentiles_and_bounds():
+    h = telemetry.histogram("t.hist")
+    assert h.percentile(50) is None  # empty
+    for v in [0.001] * 50 + [0.01] * 45 + [5.0] * 5:
+        h.observe(v)
+    assert h.count == 100
+    assert abs(h.sum - (0.05 + 0.45 + 25.0)) < 1e-9
+    p50, p95, p99 = h.percentile(50), h.percentile(95), h.percentile(99)
+    assert p50 <= p95 <= p99
+    assert p50 <= 0.0025  # the p50 mass sits in the ~1ms bucket
+    assert p99 >= 2.5     # the tail lands in the 5s observations' bucket
+    snap = h.snapshot()
+    assert snap["count"] == 100 and snap["min"] == 0.001 and snap["max"] == 5.0
+    assert snap["buckets"]["+Inf"] == 100
+    # bounded: bucket array never grows with observations
+    assert len(snap["buckets"]) == len(telemetry.DEFAULT_BUCKETS) + 1
+
+
+def test_concurrent_writers_lose_nothing():
+    c = telemetry.counter("t.conc.counter")
+    g = telemetry.gauge("t.conc.gauge")
+    h = telemetry.histogram("t.conc.hist")
+    n_threads, n_iter = 8, 2000
+
+    def work(seed):
+        for i in range(n_iter):
+            c.inc()
+            g.set(i)
+            h.observe((seed + i) % 7 * 0.001)
+
+    threads = [threading.Thread(target=work, args=(s,))
+               for s in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * n_iter
+    assert h.count == n_threads * n_iter
+    snap = h.snapshot()
+    assert snap["buckets"]["+Inf"] == n_threads * n_iter
+
+
+def test_timer_context_observes():
+    h = telemetry.histogram("t.timer")
+    with h.time():
+        time.sleep(0.002)
+    assert h.count == 1
+    assert h.sum >= 0.002
+
+
+# ---------------------------------------------------------------------------
+# exposition: JSON dump + Prometheus text
+# ---------------------------------------------------------------------------
+
+
+def test_dump_is_json_serializable_and_complete():
+    telemetry.counter("d.counter", op="push").inc(3)
+    telemetry.gauge("d.gauge").set(1.5)
+    telemetry.histogram("d.hist").observe(0.01)
+    telemetry.event("d.event", epoch=2)
+    d = json.loads(json.dumps(telemetry.dump()))
+    assert d["counters"]["d.counter{op=push}"] == 3
+    assert d["gauges"]["d.gauge"] == 1.5
+    assert d["histograms"]["d.hist"]["count"] == 1
+    assert d["events"][-1]["event"] == "d.event"
+    assert d["events"][-1]["epoch"] == 2
+
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                   # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""        # first label
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"   # more labels
+    r" (\+Inf|-Inf|NaN|[0-9eE.+-]+)$")             # value
+
+
+def test_prometheus_text_parses():
+    telemetry.counter("p.counter", op="pull").inc(7)
+    telemetry.gauge("p.gauge").set(0.25)
+    h = telemetry.histogram("p.hist")
+    for v in (0.001, 0.2, 40.0):
+        h.observe(v)
+    text = telemetry.prometheus_text()
+    types = {}
+    samples = {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            assert kind in ("counter", "gauge", "histogram")
+            types[name] = kind
+        else:
+            assert _PROM_LINE.match(line), "unparseable line: %r" % line
+            name, _, value = line.rpartition(" ")
+            samples[name] = value
+    assert types["mxnet_p_counter"] == "counter"
+    assert samples['mxnet_p_counter{op="pull"}'] == "7"
+    assert float(samples["mxnet_p_gauge"]) == 0.25
+    # histogram triplet with cumulative, monotone buckets ending at +Inf
+    assert samples["mxnet_p_hist_count"] == "3"
+    assert float(samples["mxnet_p_hist_sum"]) == pytest.approx(40.201)
+    buckets = [(k, int(v)) for k, v in samples.items()
+               if k.startswith("mxnet_p_hist_bucket")]
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert buckets[-1][0].endswith('le="+Inf"}') and buckets[-1][1] == 3
+
+
+# ---------------------------------------------------------------------------
+# spans -> chrome-trace profiler + histograms
+# ---------------------------------------------------------------------------
+
+
+def test_spans_land_in_chrome_trace_dump(tmp_path):
+    fname = str(tmp_path / "trace.json")
+    profiler.profiler_set_config(mode="all", filename=fname)
+    profiler.profiler_set_state("run")
+    with telemetry.span("unit.test_span", "fit"):
+        time.sleep(0.001)
+    profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    spans = [e for e in events if e["name"] == "unit.test_span"]
+    assert spans, "telemetry span missing from the chrome trace"
+    e = spans[0]
+    assert e["ph"] == "X" and e["cat"] == "fit" and e["dur"] >= 1000  # >=1ms
+    # ...and the same span observed its duration as a histogram
+    assert telemetry.histogram("unit.test_span").count == 1
+
+
+def test_span_is_noop_when_everything_off():
+    telemetry.disable()
+    s = telemetry.span("off.span")
+    assert s is telemetry._NULL_SPAN
+    with s:
+        pass
+    telemetry.enable()
+    assert telemetry.histogram("off.span").count == 0
+
+
+def test_concurrent_span_writers_and_profiler_toggle(tmp_path):
+    """The satellite fix: spans appending while another thread flips
+    profiler state / dumps must neither crash nor corrupt the buffer."""
+    fname = str(tmp_path / "toggle.json")
+    profiler.profiler_set_config(mode="all", filename=fname)
+    stop = threading.Event()
+
+    def spam():
+        while not stop.is_set():
+            with telemetry.span("spam.span"):
+                pass
+
+    workers = [threading.Thread(target=spam) for _ in range(4)]
+    for w in workers:
+        w.start()
+    for _ in range(20):
+        profiler.profiler_set_state("run")
+        time.sleep(0.001)
+        profiler.profiler_set_state("stop")
+        profiler.dump_profile()
+    stop.set()
+    for w in workers:
+        w.join()
+    with open(fname) as f:
+        json.load(f)  # parseable = the buffer was never torn mid-dump
+
+
+# ---------------------------------------------------------------------------
+# events + file sink + flusher
+# ---------------------------------------------------------------------------
+
+
+def test_events_are_json_lines_in_sink(tmp_path):
+    sink = str(tmp_path / "telemetry.jsonl")
+    telemetry.start_flusher(path=sink, interval_s=3600)
+    telemetry.event("epoch_start", epoch=0)
+    telemetry.counter("sink.counter").inc()
+    telemetry.flush()
+    telemetry.stop_flusher()  # writes one final snapshot
+    with open(sink) as f:
+        recs = [json.loads(line) for line in f]
+    kinds = [r["type"] for r in recs]
+    assert "event" in kinds and "snapshot" in kinds
+    ev = next(r for r in recs if r["type"] == "event")
+    assert ev["event"] == "epoch_start" and ev["epoch"] == 0
+    snap = next(r for r in recs if r["type"] == "snapshot")
+    assert snap["counters"]["sink.counter"] == 1
+
+
+def test_periodic_flusher_appends_snapshots(tmp_path):
+    sink = str(tmp_path / "periodic.jsonl")
+    telemetry.counter("flush.counter").inc()
+    telemetry.start_flusher(path=sink, interval_s=0.05)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if os.path.exists(sink) and sum(
+                1 for _ in open(sink)) >= 2:
+            break
+        time.sleep(0.02)
+    telemetry.stop_flusher(final_flush=False)
+    with open(sink) as f:
+        recs = [json.loads(line) for line in f]
+    snaps = [r for r in recs if r["type"] == "snapshot"]
+    assert len(snaps) >= 2, "flusher never ticked"
+    assert all(s["counters"]["flush.counter"] == 1 for s in snaps)
+
+
+def test_env_autostart_enables_and_flushes(tmp_path):
+    """MXNET_TELEMETRY_FILE at import => enabled registry + at-exit flush."""
+    sink = str(tmp_path / "auto.jsonl")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "MXNET_TELEMETRY_FILE": sink,
+                "MXNET_TELEMETRY_INTERVAL_S": "3600",
+                "PYTHONPATH": ROOT + os.pathsep + env.get("PYTHONPATH", "")})
+    code = ("import mxnet_tpu as mx\n"
+            "assert mx.telemetry.enabled()\n"
+            "mx.telemetry.counter('auto.counter').inc(5)\n"
+            "mx.telemetry.event('marker', step=1)\n")
+    subprocess.run([sys.executable, "-c", code], env=env, check=True,
+                   timeout=180)
+    with open(sink) as f:
+        recs = [json.loads(line) for line in f]
+    assert any(r["type"] == "event" and r["event"] == "marker" for r in recs)
+    final = [r for r in recs if r["type"] == "snapshot"][-1]
+    assert final["counters"]["auto.counter"] == 5
+
+
+# ---------------------------------------------------------------------------
+# fit loop: step-time / data-wait / throughput metrics
+# ---------------------------------------------------------------------------
+
+
+def _toy_fit(batch_end_callback=None, num_epoch=2, batch_size=16):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 10).astype(np.float32)
+    y = rng.randint(0, 8, 64).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch_size)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, batch_end_callback=batch_end_callback,
+            optimizer_params={"learning_rate": 0.01, "rescale_grad": 1.0})
+    return mod
+
+
+def test_module_fit_populates_step_metrics():
+    _toy_fit()
+    d = telemetry.dump()
+    n_batches = 2 * (64 // 16)
+    assert d["counters"]["fit.batches"] == n_batches
+    assert d["counters"]["fit.samples"] == 2 * 64
+    assert d["counters"]["fit.epochs"] == 2
+    for name in ("fit.step_time_seconds", "fit.compute_seconds",
+                 "fit.data_wait_seconds"):
+        assert d["histograms"][name]["count"] >= n_batches, name
+        assert d["histograms"][name]["sum"] > 0, name
+    assert d["gauges"]["fit.imgs_per_sec"] > 0
+    # data iterators recorded fetch latency
+    assert d["histograms"]["io.batch_fetch_seconds{iter=NDArrayIter}"][
+        "count"] >= n_batches
+    # epoch markers arrived as structured events, in order
+    marks = [(e["event"], e["epoch"]) for e in telemetry.events()
+             if e["event"] in ("epoch_start", "epoch_end")]
+    assert marks == [("epoch_start", 0), ("epoch_end", 0),
+                     ("epoch_start", 1), ("epoch_end", 1)]
+    end = telemetry.events("epoch_end")[-1]
+    assert end["nbatch"] == 64 // 16 and "accuracy" in end["metrics"]
+
+
+def test_speedometer_reads_registry_and_publishes_gauge(caplog):
+    import logging
+
+    with caplog.at_level(logging.INFO):
+        _toy_fit(batch_end_callback=mx.callback.Speedometer(
+            batch_size=16, frequent=2))
+    assert telemetry.gauge("speedometer.samples_per_sec").value > 0
+    logged = [r.message for r in caplog.records if "Speed:" in r.message]
+    assert logged, "speedometer never logged"
+    # the printed number and the registry agree (single source of truth)
+    printed = float(re.search(r"Speed: ([0-9.]+)", logged[-1]).group(1))
+    assert printed == pytest.approx(
+        telemetry.gauge("speedometer.samples_per_sec").value, rel=1e-4)
+
+
+def test_speedometer_auto_reset_honored():
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu.model import BatchEndParam
+
+    def run(auto_reset):
+        metric = mx.metric.Accuracy()
+        metric.update([mx.nd.array(np.zeros(2))],
+                      [mx.nd.array(np.zeros((2, 2)))])
+        sp = Speedometer(batch_size=2, frequent=1, auto_reset=auto_reset)
+        sp(BatchEndParam(epoch=0, nbatch=0, eval_metric=metric, locals=None))
+        sp(BatchEndParam(epoch=0, nbatch=1, eval_metric=metric, locals=None))
+        return metric.num_inst
+
+    assert run(auto_reset=True) == 0      # window reset the metric
+    assert run(auto_reset=False) == 2     # accumulation preserved
+
+
+def test_disabled_fit_records_no_step_metrics():
+    telemetry.disable()
+    _toy_fit(num_epoch=1)
+    d = telemetry.dump()
+    assert "fit.step_time_seconds" not in d["histograms"]
+    assert "fit.batches" not in d["counters"]
+    assert d["events"] == []
+
+
+# ---------------------------------------------------------------------------
+# engine + fault + kvstore counters
+# ---------------------------------------------------------------------------
+
+
+def test_engine_push_metrics_and_error_counter():
+    from mxnet_tpu.engine import NaiveEngine
+
+    eng = NaiveEngine()
+    eng.push(lambda: None)
+    assert telemetry.counter("engine.pushes").value == 1
+    assert telemetry.histogram("engine.push_latency_seconds").count == 1
+
+    def boom():
+        raise RuntimeError("pushed fn failure")
+
+    eng.push(boom)
+    with pytest.raises(RuntimeError):
+        eng.wait_all()
+    assert telemetry.counter("engine.push_errors").value == 1
+
+
+def test_error_counters_count_even_when_disabled():
+    telemetry.disable()
+    from mxnet_tpu.engine import NaiveEngine
+
+    eng = NaiveEngine()
+
+    def boom():
+        raise RuntimeError("x")
+
+    eng.push(boom)
+    with pytest.raises(RuntimeError):
+        eng.wait_all()
+    assert telemetry.counter("engine.push_errors").value == 1
+
+
+def test_fault_injection_counter():
+    with fault.inject("some_point:raise=1,times=2"):
+        for _ in range(3):
+            try:
+                fault.hit("some_point")
+            except fault.InjectedFault:
+                pass
+    assert telemetry.counter("fault.injections", point="some_point").value == 2
+
+
+def test_local_kvstore_latency_histograms():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones((4,)))
+    kv.push(3, mx.nd.ones((4,)))
+    out = mx.nd.zeros((4,))
+    kv.pull(3, out=out)
+    assert telemetry.histogram("kvstore.push_latency_seconds", key=3).count == 1
+    assert telemetry.histogram("kvstore.pull_latency_seconds", key=3).count == 1
+
+
+class _FakeLib:
+    """Stands in for the native transport in retry-loop tests: every server
+    probe reports alive, so _with_retry classifies failures as transient."""
+
+    def mxt_ps_probe(self, host, port, timeout_ms):
+        return 0
+
+    def mxt_ps_client_probe(self, client, cmd, timeout_ms):
+        return 0
+
+
+def _retry_harness():
+    from mxnet_tpu.kvstore import KVStoreDist
+
+    kv = object.__new__(KVStoreDist)  # no cluster: exercise only the retry loop
+    kv._lib = _FakeLib()
+    kv._server_addrs = [("127.0.0.1", 12345)]
+    kv._num_servers = 1
+    kv._clients = [object()]
+    return kv
+
+
+def test_kv_retry_counters_increment_under_fault_inject(monkeypatch):
+    monkeypatch.setenv("MXNET_KV_RETRIES", "3")
+    monkeypatch.setenv("MXNET_KV_TIMEOUT_MS", "100")
+    kv = _retry_harness()
+
+    def attempt():
+        rule = fault.hit("kv_push")
+        if rule is not None and rule.get("drop") not in (None, "0"):
+            raise MXNetError("injected push drop")
+
+    with fault.inject("kv_push:drop=1,times=2"):
+        kv._with_retry("push", 0, attempt)  # 2 drops, 3rd attempt succeeds
+    assert telemetry.counter("kvstore.retries", op="push").value == 2
+    assert telemetry.counter("kvstore.rpc_failures", op="push").value == 2
+    assert telemetry.counter("kvstore.backoff_ms", op="push").value > 0
+    assert telemetry.counter("fault.injections", point="kv_push").value == 2
+
+
+def test_kv_retry_exhaustion_counts_every_retry(monkeypatch):
+    monkeypatch.setenv("MXNET_KV_RETRIES", "2")
+    monkeypatch.setenv("MXNET_KV_TIMEOUT_MS", "100")
+    kv = _retry_harness()
+
+    def attempt():
+        raise MXNetError("always fails")
+
+    with pytest.raises(MXNetError, match="after 2 retries"):
+        kv._with_retry("pull", 0, attempt)
+    assert telemetry.counter("kvstore.retries", op="pull").value == 2
+    assert telemetry.counter("kvstore.rpc_failures", op="pull").value == 3
+
+
+# ---------------------------------------------------------------------------
+# kvstore_server counters + request_server_stats dict (native cluster)
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+WORKER_SERVER_STATS = r"""
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_sync")
+kv.init(5, mx.nd.zeros((4,)))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0))
+for _ in range(3):
+    kv.push(5, mx.nd.ones((4,)))
+    out = mx.nd.zeros((4,))
+    kv.pull(5, out=out)
+stats = kv.request_server_stats()
+assert len(stats) == 1, stats
+(addr, s), = stats.items()
+assert s is not None, "server published no stats"
+assert s["has_optimizer"] is True, s
+assert s["updates_applied"] >= 3, s
+assert s["update_failures"] == 0, s
+# user traffic still works after the reserved-key stats round-trip
+kv.push(5, mx.nd.ones((4,)))
+kv.pull(5, out=out)
+print("STATS_DICT_OK", sorted(s.items()))
+kv._stop_servers()
+print("WORKER_OK")
+"""
+
+
+@needs_native
+def test_request_server_stats_returns_parsed_dict():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DMLC_ROLE", None)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "1", "-s", "1", "--port", str(_free_port()),
+           sys.executable, "-c", WORKER_SERVER_STATS]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=180)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        out, err = proc.communicate()
+        raise AssertionError("cluster hung: %s %s" % (out, err))
+    assert proc.returncode == 0, (out, err)
+    assert "STATS_DICT_OK" in out, (out, err)
+    assert "WORKER_OK" in out, (out, err)
