@@ -95,8 +95,15 @@ class DataParallelExecutorManager:
         return self.execgrp.aux_arrays
 
     def load_data_batch(self, data_batch):
-        """Stage a batch: slices scatter to the devices on forward."""
-        self._batch = data_batch
+        """Stage a batch: slices scatter to the devices on forward.
+
+        uint8-wire batches (io.WireSpec) decode eagerly here, so repeated
+        ``forward`` calls on one staged batch pay the decode once (target
+        device policy in io.wire_decode_ctx)."""
+        from . import io as io_mod
+
+        self._batch = io_mod.apply_wire(
+            data_batch, ctx=io_mod.wire_decode_ctx(self.ctx))
 
     def forward(self, is_train=False):
         if self._batch is None:

@@ -10,11 +10,14 @@ uses the same part_index/num_parts contract as dmlc::InputSplit.
 """
 from __future__ import annotations
 
+import atexit
 import gzip
 import os
+import queue
 import struct
 import threading
 import time
+import weakref
 from collections import namedtuple
 
 import numpy as np
@@ -27,6 +30,7 @@ from .ndarray import NDArray, array
 __all__ = [
     "DataDesc", "DataBatch", "DataIter", "ResizeIter", "PrefetchingIter",
     "NDArrayIter", "MNISTIter", "CSVIter", "ImageRecordIter",
+    "WireSpec", "apply_wire", "DeviceFeedIter",
 ]
 
 
@@ -57,10 +61,15 @@ class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
 
 
 class DataBatch:
-    """One mini-batch (reference: io.py DataBatch)."""
+    """One mini-batch (reference: io.py DataBatch).
+
+    ``wire``: optional :class:`WireSpec` marking the data arrays as being in
+    wire format (e.g. uint8 HWC) — the executor boundary decodes them
+    on-device via :func:`apply_wire` before they reach the graph."""
 
     def __init__(self, data, label=None, pad=None, index=None,
-                 bucket_key=None, provide_data=None, provide_label=None):
+                 bucket_key=None, provide_data=None, provide_label=None,
+                 wire=None):
         if data is not None:
             assert isinstance(data, (list, tuple)), "Data must be list of NDArrays"
         if label is not None:
@@ -72,6 +81,74 @@ class DataBatch:
         self.bucket_key = bucket_key
         self.provide_data = provide_data
         self.provide_label = provide_label
+        self.wire = wire
+
+
+class WireSpec:
+    """The uint8-wire contract between a data iterator and the executor.
+
+    Iterators that opt in (``ImageRecordIter(wire_dtype='uint8')``,
+    ``NDArrayIter(wire=...)``) ship batch data as **uint8 HWC** — 4x less
+    host->device wire traffic than fp32 — and advertise the POST-decode
+    descriptor (fp32, NCHW) in ``provide_data`` so ``bind`` and shape
+    inference are unchanged. The deferred mean/std normalize + layout
+    transpose run on device as one compiled program
+    (``_image_wire_normalize``) the first time the batch crosses the
+    executor boundary (docs/perf.md §pipeline attribution)."""
+
+    __slots__ = ("mean", "std", "layout")
+
+    def __init__(self, mean=None, std=None, layout="NHWC"):
+        self.mean = None if mean is None else tuple(float(m) for m in np.ravel(mean))
+        self.std = None if std is None else tuple(float(s) for s in np.ravel(std))
+        self.layout = layout
+
+    def decode(self, arr):
+        """Wire NDArray -> compute NDArray (fp32, NCHW), on ``arr``'s device."""
+        return nd.imperative_invoke(
+            "_image_wire_normalize", [arr],
+            {"mean": self.mean, "std": self.std, "layout": self.layout})
+
+    def decoded_desc(self, name, shape, batch_axis=0):
+        """The post-decode DataDesc a wire iterator advertises for ``bind``."""
+        shape = tuple(shape)
+        if self.layout == "NHWC" and len(shape) == 4:
+            shape = (shape[0], shape[3], shape[1], shape[2])
+        del batch_axis
+        return DataDesc(name, shape, np.float32)
+
+    def __repr__(self):
+        return "WireSpec(mean=%s, std=%s, layout=%s)" % (
+            self.mean, self.std, self.layout)
+
+
+def apply_wire(batch, ctx=None):
+    """Decode a wire-format batch at the executor boundary (idempotent).
+
+    Returns ``batch`` untouched when it carries no :class:`WireSpec`;
+    otherwise returns a new :class:`DataBatch` whose data arrays went
+    through the on-device decode. Labels are never wire-encoded.
+
+    ``ctx``: target device. The COMPACT uint8 array is moved there first
+    and the decode program runs on that device — this ordering is the
+    whole wire win (4x fewer host->device bytes). Without it the decode
+    runs wherever the array lives (the host, for a fresh iterator batch)
+    and the executor would then ship full-size fp32. Callers with one
+    device pass it; multi-device groups pass None and keep the host
+    decode, since their scatter slices on the host anyway."""
+    wire = getattr(batch, "wire", None)
+    if wire is None:
+        return batch
+
+    def _decode(d):
+        if ctx is not None and isinstance(d, NDArray):
+            d = d.as_in_context(ctx)
+        return wire.decode(d)
+
+    return DataBatch(
+        [_decode(d) for d in batch.data], batch.label,
+        pad=batch.pad, index=batch.index, bucket_key=batch.bucket_key,
+        provide_data=batch.provide_data, provide_label=batch.provide_label)
 
 
 def _observe_fetch(iterator, t0):
@@ -310,6 +387,217 @@ class PrefetchingIter(DataIter):
         return self.current_batch.pad
 
 
+# live device feeds; closed at interpreter exit so a feeder thread blocked
+# inside a device transfer never gets killed mid-call by CPython teardown
+_LIVE_FEEDS = weakref.WeakSet()
+
+
+@atexit.register
+def _close_live_feeds():
+    for it in list(_LIVE_FEEDS):
+        try:
+            it.close()
+        except Exception:  # noqa: BLE001 — interpreter is going down
+            pass
+
+
+class DeviceFeedIter(DataIter):
+    """Double-buffered asynchronous device feed (docs/perf.md §pipeline).
+
+    A dedicated transfer thread pulls host batches from ``data_iter``,
+    uploads them to ``ctx``'s device (and runs the on-device wire decode,
+    :func:`apply_wire`), and parks the *device-resident* batches in a
+    bounded queue of depth ``MXNET_FEED_DEPTH`` (default 2 — classic double
+    buffering). While the device computes step *N*, batch *N+1* is already
+    uploading from this thread, so the consumer's ``next()`` — and
+    ``fit.data_wait_seconds`` — collapse to a queue pop. This is the
+    host->device analog of the reference's ``PrefetcherIter``
+    (iter_prefetcher.h), one level further down the pipeline.
+
+    ``Module.fit`` wraps its training iterator in one of these
+    automatically when ``MXNET_FEED_DEPTH`` is set (docs/env_var.md)."""
+
+    def __init__(self, data_iter, ctx=None, depth=None):
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        if depth is None:
+            depth = int(os.environ.get("MXNET_FEED_DEPTH", "2") or 2)
+        self._iter = data_iter
+        self._ctx = ctx
+        self.depth = max(1, int(depth))
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    @property
+    def default_bucket_key(self):
+        return self._iter.default_bucket_key
+
+    # ---- transfer thread -------------------------------------------------
+    def _stage(self, batch):
+        """Upload one batch to the target device and decode its wire format;
+        blocks this (background) thread until the device owns the data."""
+        import jax
+
+        def _up(arrs):
+            if not arrs:
+                return arrs
+            if self._ctx is None:
+                return list(arrs)
+            return [a.as_in_context(self._ctx) if isinstance(a, NDArray)
+                    else array(a, ctx=self._ctx) for a in arrs]
+
+        staged = DataBatch(
+            _up(batch.data), _up(batch.label or []),
+            pad=batch.pad, index=batch.index, bucket_key=batch.bucket_key,
+            provide_data=batch.provide_data or self.provide_data,
+            provide_label=batch.provide_label or self.provide_label,
+            wire=getattr(batch, "wire", None))
+        staged = apply_wire(staged)
+        # block HERE so the queue holds transfer-complete batches and the
+        # upload wall lands on this thread, not the consumer's pop
+        for a in staged.data + (staged.label or []):
+            if isinstance(a, NDArray):
+                jax.block_until_ready(a.data)
+        return staged
+
+    def _feed(self, q, stop):
+        # q/stop are THIS generation's, passed as locals: a feeder that
+        # outlives a timed-out close() (wedged in a slow upload) must never
+        # observe the queue/event reset() installs for its successor — with
+        # `self._q` it would wake into the new generation and race the new
+        # thread on the non-thread-safe inner iterator
+        gauge = telemetry.gauge("pipeline.feed_depth")
+        try:
+            while not stop.is_set():
+                try:
+                    batch = self._iter.next()
+                except StopIteration:
+                    break
+                tel = telemetry.enabled()
+                t0 = time.perf_counter() if tel else 0.0
+                staged = self._stage(batch)
+                if tel:
+                    telemetry.pipeline_stage("upload").observe(
+                            time.perf_counter() - t0)
+                if not self._put(q, stop, staged):
+                    return
+                gauge.set(q.qsize())
+        except Exception as e:  # noqa: BLE001 — surface on the consumer side
+            self._put(q, stop, ("error", e))
+            return
+        self._put(q, stop, None)
+
+    @staticmethod
+    def _put(q, stop, item):
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _start(self):
+        _LIVE_FEEDS.add(self)
+        self._q = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._feed, args=(self._q, self._stop), daemon=True,
+            name="DeviceFeedIter")
+        self._thread.start()
+
+    # ---- consumer side ---------------------------------------------------
+    def next(self):
+        tel = telemetry.enabled()
+        t0 = time.perf_counter() if tel else 0.0
+        item = self._q.get()
+        if tel:
+            wait = time.perf_counter() - t0
+            telemetry.pipeline_stage("feed_wait").observe(wait)
+            _observe_fetch(self, t0)
+        if item is None:
+            # terminal marker: re-post so every subsequent next() also raises
+            # instead of blocking on an empty queue
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                pass
+            raise StopIteration
+        if isinstance(item, tuple) and len(item) == 2 and item[0] == "error":
+            # after surfacing the fault, later next() calls terminate instead
+            # of blocking on a queue whose producer is gone
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                pass
+            raise item[1]
+        return item
+
+    def close(self):
+        """Stop the transfer thread (terminal: ``next()`` raises)."""
+        if not hasattr(self, "_stop"):
+            return
+        self._stop.set()
+        deadline = time.time() + 10
+        while self._thread.is_alive() and time.time() < deadline:
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.2)
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:  # unreachable: queue just drained, thread dead
+            pass
+
+    def reset(self):
+        self.close()
+        self._iter.reset()
+        self._start()
+
+    def getdata(self):
+        raise NotImplementedError("DeviceFeedIter yields whole batches")
+
+    getlabel = getpad = getindex = getdata
+
+
+def wire_decode_ctx(contexts):
+    """The device the wire decode (and device feed) should target for a
+    consumer bound to ``contexts`` — THE single statement of the policy:
+
+    * one device: decode there — the compact uint8 moves first, fp32 never
+      crosses the wire (the whole point of the uint8 wire);
+    * several devices (or unknown): ``None`` — keep the decode where the
+      batch lives (the host), because the data-parallel scatter slices
+      host-side (executor_group._load_general), and pinning the full batch
+      to device 0 would add a device->host->device round trip per step."""
+    return contexts[0] if contexts and len(contexts) == 1 else None
+
+
+def maybe_device_feed(data_iter, contexts):
+    """Wrap ``data_iter`` in a :class:`DeviceFeedIter` when the user opted in
+    via ``MXNET_FEED_DEPTH`` (fit calls this; returns the iter unchanged when
+    the env var is unset/0 or the iter already is a feed). Target device per
+    :func:`wire_decode_ctx`."""
+    depth = int(os.environ.get("MXNET_FEED_DEPTH", "0") or 0)
+    if depth <= 0 or isinstance(data_iter, DeviceFeedIter):
+        return data_iter
+    return DeviceFeedIter(data_iter, ctx=wire_decode_ctx(contexts),
+                          depth=depth)
+
+
 def _init_data(data, allow_empty, default_name):
     """Normalize input data (reference: io.py _init_data)."""
     assert data is not None or allow_empty
@@ -337,11 +625,19 @@ def _init_data(data, allow_empty, default_name):
 
 
 class NDArrayIter(DataIter):
-    """Iterate over in-memory arrays (reference: io.py:491)."""
+    """Iterate over in-memory arrays (reference: io.py:491).
+
+    ``wire``: optional :class:`WireSpec`. When set, the backing data arrays
+    are treated as wire-format (e.g. uint8 HWC): batches ship in that
+    compact dtype/layout and ``provide_data`` advertises the post-decode
+    fp32 NCHW descriptor, so the executor boundary performs the cast /
+    normalize / transpose on device (docs/perf.md §pipeline)."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
-                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label",
+                 wire=None):
         super().__init__(batch_size)
+        self._wire = wire
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
         self.idx = np.arange(self.data[0][1].shape[0])
@@ -374,6 +670,12 @@ class NDArrayIter(DataIter):
 
     @property
     def provide_data(self):
+        if self._wire is not None:
+            return [
+                self._wire.decoded_desc(
+                    k, tuple([self.batch_size] + list(v.shape[1:])))
+                for k, v in self.data
+            ]
         return [
             DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])), v.dtype)
             for k, v in self.data
@@ -404,7 +706,8 @@ class NDArrayIter(DataIter):
         t0 = time.perf_counter() if tel else 0.0
         if self.iter_next():
             batch = DataBatch(
-                data=self.getdata(), label=self.getlabel(), pad=self.getpad(), index=None
+                data=self.getdata(), label=self.getlabel(), pad=self.getpad(), index=None,
+                wire=self._wire,
             )
             if tel:
                 _observe_fetch(self, t0)

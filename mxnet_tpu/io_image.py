@@ -16,16 +16,19 @@ from __future__ import annotations
 
 import atexit
 import logging
+import os
 import queue
 import threading
+import time
 import weakref
 
 import numpy as np
 
 from . import ndarray as nd
+from . import telemetry
 from .base import MXNetError
 from .image import CreateAugmenter, imdecode, imdecode_np
-from .io import DataBatch, DataDesc, DataIter
+from .io import DataBatch, DataDesc, DataIter, WireSpec
 from . import recordio
 
 __all__ = ["ImageRecordIter", "ImageDetRecordIter"]
@@ -69,12 +72,33 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=0.0, std_g=0.0, std_b=0.0,
                  max_random_contrast=0.0, max_random_illumination=0.0,
                  brightness=0.0, contrast=0.0, saturation=0.0, pca_noise=0.0,
+                 wire_dtype=None,
                  **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(int(x) for x in data_shape)
         self.label_width = label_width
         self.batch_size = batch_size
         mean, std = _mean_std(mean_r, mean_g, mean_b, std_r, std_g, std_b)
+        # uint8 wire (default off; docs/env_var.md MXNET_WIRE_UINT8): batches
+        # stay uint8 HWC end-to-end on the host — 4x less host->device wire
+        # than fp32 — and the mean/std normalize + HWC->CHW transpose defer
+        # to one on-device program at the executor boundary (io.WireSpec).
+        # provide_data keeps advertising the POST-decode fp32 NCHW desc.
+        explicit = wire_dtype is not None
+        if wire_dtype is None and os.environ.get("MXNET_WIRE_UINT8", "") == "1":
+            wire_dtype = "uint8"
+        if wire_dtype not in (None, "float32", "uint8"):
+            raise MXNetError("wire_dtype must be 'float32' or 'uint8', got %r"
+                             % (wire_dtype,))
+        if wire_dtype == "uint8" and not self._supports_wire():
+            if explicit:
+                raise MXNetError(
+                    "%s does not support wire_dtype='uint8'"
+                    % type(self).__name__)
+            wire_dtype = None  # env-var default: fall back quietly
+        self._wire = WireSpec(mean, std, "NHWC") if wire_dtype == "uint8" else None
+        if self._wire is not None:
+            mean = std = None  # normalize moves on-device
         self.auglist = self._build_auglist(
             resize=resize, rand_crop=rand_crop,
             rand_resize=rand_resize, rand_mirror=rand_mirror, mean=mean, std=std,
@@ -82,6 +106,14 @@ class ImageRecordIter(DataIter):
             contrast=contrast or max_random_contrast,
             saturation=saturation, pca_noise=pca_noise,
         )
+        if self._wire is not None:
+            # drop the unconditional uint8->fp32 CastAug: the wire path stays
+            # uint8 end-to-end on the host (the cast happens on device), and
+            # keeping it would pay a float round-trip + rint per image
+            from .image import CastAug
+
+            self.auglist = [a for a in self.auglist
+                            if not isinstance(a, CastAug)]
         self.path_imgrec = path_imgrec
         self.path_imgidx = path_imgidx
         self.shuffle = shuffle
@@ -99,15 +131,21 @@ class ImageRecordIter(DataIter):
         self._skipped = 0  # corrupt/undecodable records dropped (logged)
         self._start_pipeline()
 
+    def _supports_wire(self):
+        """Whether this iterator can ship uint8-HWC wire batches
+        (ImageDetRecordIter can't: its det_auglist normalizes inline)."""
+        return True
+
     def _build_auglist(self, **kwargs):
         """Classification augmenter list (ImageDetRecordIter overrides to
         skip this — its pipeline is the box-aware det_auglist)."""
         return CreateAugmenter(self.data_shape, **kwargs)
 
     def _process_record(self, s, use_np, rng=None):
-        """One record -> (CHW float array, flat label row). Runs on a decode
-        worker thread (``rng``: that worker's seeded random.Random);
-        ImageDetRecordIter overrides with the box-aware pipeline."""
+        """One record -> (CHW float array — or HWC uint8 on the wire path —
+        and flat label row). Runs on a decode worker thread (``rng``: that
+        worker's seeded random.Random); ImageDetRecordIter overrides with
+        the box-aware pipeline."""
         header, img = recordio.unpack(s)
         if use_np:
             data = imdecode_np(img)
@@ -118,7 +156,14 @@ class ImageRecordIter(DataIter):
             for aug in self.auglist:
                 data = aug(data)
             data = data.asnumpy()
-        arr = np.asarray(data).transpose(2, 0, 1)  # HWC -> CHW
+        arr = np.asarray(data)
+        if self._wire is not None:
+            # keep HWC; a float-producing augmenter (NDArray-chain fallback,
+            # CastAug appended by hand) rounds back into the uint8 wire
+            if arr.dtype != np.uint8:
+                arr = np.clip(np.rint(arr), 0, 255).astype(np.uint8)
+        else:
+            arr = arr.transpose(2, 0, 1)  # HWC -> CHW
         return arr, np.asarray(header.label).reshape(-1)
 
     # ---- pipeline --------------------------------------------------------
@@ -205,6 +250,9 @@ class ImageRecordIter(DataIter):
 
             # int-tuple hash is run-stable (PYTHONHASHSEED only perturbs str)
             rng = _random.Random(hash((self.seed, self._epoch, wid)))
+            # stage attribution (docs/observability.md): per-record
+            # decode+augment wall, resolved once — the registry lookup locks
+            decode_hist = telemetry.pipeline_stage("decode")
             try:
                 while not self._stop.is_set():
                     item = _get(self._raw_q)
@@ -212,7 +260,11 @@ class ImageRecordIter(DataIter):
                         return
                     seq, s = item
                     try:
+                        tel = telemetry.enabled()
+                        t0 = time.perf_counter() if tel else 0.0
                         arr, label = self._process_record(s, use_np, rng)
+                        if tel:
+                            decode_hist.observe(time.perf_counter() - t0)
                         _put(self._decoded_q, (seq, arr, label))
                     except Exception as e:  # noqa: BLE001 — corrupt record:
                         # skip, but still claim the seq so reassembly can't
@@ -235,11 +287,18 @@ class ImageRecordIter(DataIter):
 
             c, h, w = self.data_shape
             done_workers = 0
-            buf_data = np.zeros((self.batch_size, c, h, w), np.float32)
+            if self._wire is not None:
+                # uint8-wire batches keep the workers' HWC layout and dtype;
+                # the executor boundary restores fp32 NCHW on device
+                buf_data = np.zeros((self.batch_size, h, w, c), np.uint8)
+            else:
+                buf_data = np.zeros((self.batch_size, c, h, w), np.float32)
             # detection iters pad with -1 (invalid class) so short labels can't
             # alias real class-0 objects; classification keeps 0
             buf_label = np.full((self.batch_size, self.label_width),
                                 self._label_pad, np.float32)
+            assemble_hist = telemetry.pipeline_stage("assemble")
+            assemble_acc = [0.0]  # per-batch sum of slot-copy time
             i = 0
             # decode workers finish out of order; reassemble by sequence number
             # so batches keep record order (the reference's InstVector ordering,
@@ -254,12 +313,22 @@ class ImageRecordIter(DataIter):
                     next_seq += 1
 
             def _emit(arr, label, i):
+                tel = telemetry.enabled()
+                t0 = time.perf_counter() if tel else 0.0
                 buf_data[i] = arr
                 buf_label[i, :] = self._label_pad
                 buf_label[i, : len(label[: self.label_width])] = label[: self.label_width]
                 i += 1
-                if i == self.batch_size:
-                    _put(self._out_q, (buf_data.copy(), buf_label.copy(), 0))
+                full = i == self.batch_size
+                if full:
+                    out = (buf_data.copy(), buf_label.copy(), 0)
+                if tel:
+                    assemble_acc[0] += time.perf_counter() - t0
+                    if full:
+                        assemble_hist.observe(assemble_acc[0])
+                        assemble_acc[0] = 0.0
+                if full:
+                    _put(self._out_q, out)
                     i = 0
                 return i
 
@@ -375,9 +444,12 @@ class ImageRecordIter(DataIter):
             raise StopIteration
         data, label, pad = item
         label_out = label if self.label_width > 1 else label[:, 0]
+        # nd.array preserves numpy dtype: a wire batch ships uint8 over the
+        # host->device link; provide_data stays the post-decode descriptor
         return DataBatch(
             [nd.array(data)], [nd.array(label_out)], pad=pad,
             provide_data=self.provide_data, provide_label=self.provide_label,
+            wire=self._wire,
         )
 
 
@@ -399,6 +471,9 @@ class ImageDetRecordIter(ImageRecordIter):
     """
 
     _label_pad = -1.0
+
+    def _supports_wire(self):
+        return False  # det_auglist normalizes inline (box-aware pipeline)
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=-1,
                  max_objects=32, object_width=5,

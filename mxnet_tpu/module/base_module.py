@@ -176,159 +176,182 @@ class BaseModule:
                 self.logger.info(
                     "auto-resume: restored '%s' epoch %d, continuing at "
                     "epoch %d", auto_resume, resume_epoch, begin_epoch)
-        self.bind(
-            data_shapes=train_data.provide_data, label_shapes=train_data.provide_label,
-            for_training=True, force_rebind=force_rebind,
-        )
-        if monitor is not None:
-            self.install_monitor(monitor)
-        self.init_params(
-            initializer=initializer, arg_params=arg_params, aux_params=aux_params,
-            allow_missing=allow_missing,
-            # a restored checkpoint must actually land: on an
-            # already-initialized module (in-process retry loop calling fit
-            # again) the default force_init=False would silently keep the
-            # stale in-memory weights while begin_epoch was fast-forwarded
-            force_init=force_init or resume_epoch is not None,
-        )
-        self.init_optimizer(kvstore=kvstore, optimizer=optimizer, optimizer_params=optimizer_params)
-        if resume_epoch is not None:
-            # checkpoints written with save_optimizer_states=True also carry
-            # momentum/Adam state — restore it so the resumed run tracks the
-            # uninterrupted one; params-only checkpoints (do_checkpoint)
-            # resume with fresh optimizer state, as a warm start
-            import os
+        # opt-in double-buffered async device feed (docs/env_var.md
+        # MXNET_FEED_DEPTH): a dedicated transfer thread keeps the next
+        # batch(es) device-resident so the loop's data wait is a queue pop.
+        # Wrapping before bind lets the first uploads overlap the compile.
+        _inner_iter = train_data
+        train_data = io.maybe_device_feed(
+            train_data, getattr(self, "_context", None))
+        _owned_feed = train_data if train_data is not _inner_iter else None
+        try:
+            self.bind(
+                data_shapes=train_data.provide_data, label_shapes=train_data.provide_label,
+                for_training=True, force_rebind=force_rebind,
+            )
+            if monitor is not None:
+                self.install_monitor(monitor)
+            self.init_params(
+                initializer=initializer, arg_params=arg_params, aux_params=aux_params,
+                allow_missing=allow_missing,
+                # a restored checkpoint must actually land: on an
+                # already-initialized module (in-process retry loop calling fit
+                # again) the default force_init=False would silently keep the
+                # stale in-memory weights while begin_epoch was fast-forwarded
+                force_init=force_init or resume_epoch is not None,
+            )
+            self.init_optimizer(kvstore=kvstore, optimizer=optimizer, optimizer_params=optimizer_params)
+            if resume_epoch is not None:
+                # checkpoints written with save_optimizer_states=True also carry
+                # momentum/Adam state — restore it so the resumed run tracks the
+                # uninterrupted one; params-only checkpoints (do_checkpoint)
+                # resume with fresh optimizer state, as a warm start
+                import os
 
-            # try the writer's %04d name first, then the unpadded form —
-            # load_latest_valid_checkpoint deliberately accepts hand-saved/
-            # renamed 'prefix-N.params', whose sibling is 'prefix-N.states'
-            states = next(
-                (s for s in ("%s-%04d.states" % (auto_resume, resume_epoch),
-                             "%s-%d.states" % (auto_resume, resume_epoch))
-                 if os.path.exists(s)), None)
-            if states is not None and hasattr(self, "load_optimizer_states"):
-                try:
-                    self.load_optimizer_states(states)
-                    self.logger.info(
-                        "auto-resume: restored optimizer states from %s", states)
-                except Exception as exc:  # noqa: BLE001 — corrupt states must
-                    # not kill the resume; params are already verified
-                    self.logger.warning(
-                        "auto-resume: ignoring unloadable optimizer states "
-                        "%s: %s", states, exc)
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+                # try the writer's %04d name first, then the unpadded form —
+                # load_latest_valid_checkpoint deliberately accepts hand-saved/
+                # renamed 'prefix-N.params', whose sibling is 'prefix-N.states'
+                states = next(
+                    (s for s in ("%s-%04d.states" % (auto_resume, resume_epoch),
+                                 "%s-%d.states" % (auto_resume, resume_epoch))
+                     if os.path.exists(s)), None)
+                if states is not None and hasattr(self, "load_optimizer_states"):
+                    try:
+                        self.load_optimizer_states(states)
+                        self.logger.info(
+                            "auto-resume: restored optimizer states from %s", states)
+                    except Exception as exc:  # noqa: BLE001 — corrupt states must
+                        # not kill the resume; params are already verified
+                        self.logger.warning(
+                            "auto-resume: ignoring unloadable optimizer states "
+                            "%s: %s", states, exc)
+            if validation_metric is None:
+                validation_metric = eval_metric
+            if not isinstance(eval_metric, metric_mod.EvalMetric):
+                eval_metric = metric_mod.create(eval_metric)
 
-        ################################################################################
-        # training loop (reference: base_module.py:475-533)
-        #
-        # Telemetry (docs/observability.md): while telemetry is enabled every
-        # batch records its wall time split into data-wait (blocking on the
-        # iterator) vs compute (forward_backward+update dispatch — on TPU
-        # this is DISPATCH time; XLA executes async, so sustained throughput
-        # comes from fit.step_time, not fit.compute), plus imgs/sec and
-        # per-epoch structured events. Disabled: one enabled() check/batch.
-        ################################################################################
-        fit_instruments = None  # stable handles, resolved once when enabled:
-        # re-resolving through the registry every batch would take the
-        # global lock and re-render keys 6x per step for nothing
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            telemetry.event("epoch_start", epoch=epoch)
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            tel = telemetry.enabled()
-            t0 = time.perf_counter() if tel else 0.0
-            next_data_batch = next(data_iter)
-            if tel:
-                telemetry.histogram("fit.data_wait_seconds").observe(
-                    time.perf_counter() - t0)
-            while not end_of_batch:
-                data_batch = next_data_batch
+            ################################################################################
+            # training loop (reference: base_module.py:475-533)
+            #
+            # Telemetry (docs/observability.md): while telemetry is enabled every
+            # batch records its wall time split into data-wait (blocking on the
+            # iterator) vs compute (forward_backward+update dispatch — on TPU
+            # this is DISPATCH time; XLA executes async, so sustained throughput
+            # comes from fit.step_time, not fit.compute), plus imgs/sec and
+            # per-epoch structured events. Disabled: one enabled() check/batch.
+            ################################################################################
+            fit_instruments = None  # stable handles, resolved once when enabled:
+            # re-resolving through the registry every batch would take the
+            # global lock and re-render keys 6x per step for nothing
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                telemetry.event("epoch_start", epoch=epoch)
+                eval_metric.reset()
+                nbatch = 0
+                data_iter = iter(train_data)
+                end_of_batch = False
                 tel = telemetry.enabled()
-                if tel and fit_instruments is None:
-                    fit_instruments = (
-                        telemetry.histogram("fit.compute_seconds"),
-                        telemetry.histogram("fit.data_wait_seconds"),
-                        telemetry.histogram("fit.step_time_seconds"),
-                        telemetry.counter("fit.batches"),
-                        telemetry.counter("fit.samples"),
-                        telemetry.gauge("fit.imgs_per_sec"),
-                    )
-                t_step = time.perf_counter() if tel else 0.0
-                if monitor is not None:
-                    monitor.tic()
-                # span, not gated on `tel`: with the profiler running but
-                # telemetry off, fit.step must still land on the chrome
-                # trace (span() itself no-ops when BOTH are off)
-                with telemetry.span("fit.step", "fit"):
-                    self.forward_backward(data_batch)
-                    self.update()
-                t_compute = time.perf_counter() if tel else 0.0
-                try:
-                    # pre-fetch next batch to overlap host IO with device work
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                t_data = time.perf_counter() if tel else 0.0
-                self.update_metric(eval_metric, data_batch.label)
+                t0 = time.perf_counter() if tel else 0.0
+                next_data_batch = next(data_iter)
                 if tel:
-                    h_comp, h_wait, h_step, c_batch, c_samp, g_ips = \
-                        fit_instruments
-                    now = time.perf_counter()
-                    step_s = now - t_step
-                    h_comp.observe(t_compute - t_step)
-                    h_wait.observe(t_data - t_compute)
-                    h_step.observe(step_s)
-                    n = _batch_samples(data_batch, train_data)
-                    c_batch.inc()
-                    if n:
-                        c_samp.inc(n)
-                        if step_s > 0:
-                            g_ips.set(n / step_s)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals()
+                    telemetry.histogram("fit.data_wait_seconds").observe(
+                        time.perf_counter() - t0)
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    tel = telemetry.enabled()
+                    if tel and fit_instruments is None:
+                        fit_instruments = (
+                            telemetry.histogram("fit.compute_seconds"),
+                            telemetry.histogram("fit.data_wait_seconds"),
+                            telemetry.histogram("fit.step_time_seconds"),
+                            telemetry.counter("fit.batches"),
+                            telemetry.counter("fit.samples"),
+                            telemetry.gauge("fit.imgs_per_sec"),
+                        )
+                    t_step = time.perf_counter() if tel else 0.0
+                    if monitor is not None:
+                        monitor.tic()
+                    # span, not gated on `tel`: with the profiler running but
+                    # telemetry off, fit.step must still land on the chrome
+                    # trace (span() itself no-ops when BOTH are off)
+                    with telemetry.span("fit.step", "fit"):
+                        self.forward_backward(data_batch)
+                        self.update()
+                    t_compute = time.perf_counter() if tel else 0.0
+                    try:
+                        # pre-fetch next batch to overlap host IO with device work
+                        next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch)
+                    except StopIteration:
+                        end_of_batch = True
+                    t_data = time.perf_counter() if tel else 0.0
+                    self.update_metric(eval_metric, data_batch.label)
+                    if tel:
+                        h_comp, h_wait, h_step, c_batch, c_samp, g_ips = \
+                            fit_instruments
+                        now = time.perf_counter()
+                        step_s = now - t_step
+                        h_comp.observe(t_compute - t_step)
+                        h_wait.observe(t_data - t_compute)
+                        h_step.observe(step_s)
+                        n = _batch_samples(data_batch, train_data)
+                        c_batch.inc()
+                        if n:
+                            c_samp.inc(n)
+                            if step_s > 0:
+                                g_ips.set(n / step_s)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        batch_end_params = BatchEndParam(
+                            epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals()
+                        )
+                        for callback in _as_list(batch_end_callback):
+                            callback(batch_end_params)
+                    nbatch += 1
+                # one epoch of training is finished
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                toc = time.time()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+                telemetry.counter("fit.epochs").inc()
+                telemetry.event(
+                    "epoch_end", epoch=epoch, seconds=round(toc - tic, 6),
+                    nbatch=nbatch,
+                    metrics={name: val
+                             for name, val in eval_metric.get_name_value()})
+                # sync aux params across devices (reference: base_module.py:514-516)
+                arg_params_, aux_params_ = self.get_params()
+                self.set_params(arg_params_, aux_params_)
+                if epoch_end_callback is not None:
+                    for callback in _as_list(epoch_end_callback):
+                        callback(epoch, self.symbol, arg_params_, aux_params_)
+                # ----------------------------------------
+                # evaluation on validation set
+                if eval_data:
+                    res = self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback, epoch=epoch,
                     )
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
-            # one epoch of training is finished
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
-            telemetry.counter("fit.epochs").inc()
-            telemetry.event(
-                "epoch_end", epoch=epoch, seconds=round(toc - tic, 6),
-                nbatch=nbatch,
-                metrics={name: val
-                         for name, val in eval_metric.get_name_value()})
-            # sync aux params across devices (reference: base_module.py:514-516)
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
-            # ----------------------------------------
-            # evaluation on validation set
-            if eval_data:
-                res = self.score(
-                    eval_data, validation_metric,
-                    score_end_callback=eval_end_callback,
-                    batch_end_callback=eval_batch_end_callback, epoch=epoch,
-                )
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-            # end of 1 epoch, reset the data-iter for another epoch
-            train_data.reset()
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+                # end of 1 epoch, reset the data-iter for another epoch. An
+                # owned feed skips the FINAL reset: it would only respawn the
+                # transfer thread to decode+upload batches the close() in the
+                # finally immediately discards.
+                if _owned_feed is None or epoch < num_epoch - 1:
+                    train_data.reset()
+        finally:
+            if _owned_feed is not None:
+                # fit created the feed wrapper: stop its transfer thread on
+                # EVERY exit path (a crashed fit must not leave a thread
+                # pulling the caller's iterator — a retrying fit() would
+                # wrap a second feed over the same iterator and split its
+                # batches between the two), and leave the caller's
+                # iterator freshly reset.
+                _owned_feed.close()
+                _inner_iter.reset()
 
     # ---- symbol ----------------------------------------------------------
     @property

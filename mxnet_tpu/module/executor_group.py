@@ -44,9 +44,16 @@ def _split_input_slice(batch_size, work_load_list):
 
 def _load_general(data, targets, major_axis):
     """Scatter batch slices into per-device arrays (reference:
-    executor_group.py _load_general)."""
+    executor_group.py _load_general).
+
+    Dtype is part of the bind contract: a source whose dtype differs from
+    the bound target (e.g. a uint8 wire batch that skipped the
+    ``io.apply_wire`` decode) is cast explicitly — ``copyto`` alone would
+    silently retype the bound device array and poison the compiled step."""
     for d_src, d_targets in zip(data, targets):
         if isinstance(d_targets, nd.NDArray):
+            if isinstance(d_src, nd.NDArray) and d_src.dtype != d_targets.dtype:
+                d_src = d_src.astype(d_targets.dtype)
             d_src.copyto(d_targets)
         else:
             src_np = d_src.asnumpy() if isinstance(d_src, nd.NDArray) else np.asarray(d_src)
@@ -260,6 +267,13 @@ class DataParallelExecutorGroup:
 
     def forward(self, data_batch, is_train=None):
         """Scatter + per-exec forward (reference: executor_group.py:369)."""
+        from .. import io as io_mod
+
+        # uint8-wire batches decode before the scatter (no-op for ordinary
+        # batches; Module.forward usually did it already). Target device
+        # policy in io.wire_decode_ctx.
+        data_batch = io_mod.apply_wire(
+            data_batch, ctx=io_mod.wire_decode_ctx(self.contexts))
         _load_general(data_batch.data, self.data_arrays, self.data_layouts)
         if is_train is None:
             is_train = self.for_training

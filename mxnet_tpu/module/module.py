@@ -8,6 +8,7 @@ import logging
 import warnings
 
 from .. import context as ctx_mod
+from .. import io as io_mod
 from .. import ndarray as nd
 from .. import optimizer as opt
 from ..base import MXNetError
@@ -503,6 +504,12 @@ class Module(BaseModule):
     # ---- compute ---------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        # uint8-wire batches (io.WireSpec) decode HERE, before the fused
+        # path's shape check: the decoded fp32 NCHW arrays are what the
+        # bound shapes describe. No-op for ordinary batches; target device
+        # policy in io.wire_decode_ctx.
+        data_batch = io_mod.apply_wire(
+            data_batch, ctx=io_mod.wire_decode_ctx(self._context))
         if self._fused is not None:
             train = self.for_training if is_train is None else is_train
             if train and self._fused.accepts(data_batch):

@@ -326,3 +326,50 @@ def _kl_infer(attrs, in_shapes, aux_shapes):
 
 
 get_op("IdentityAttachKLSparseReg")._infer_shape = _kl_infer
+
+
+# --------------------------------------------------- uint8-wire input decode
+def _parse_rgb(v):
+    """Optional per-channel float tuple: None / '' / 'None' stay None."""
+    if v is None or (isinstance(v, str) and v in ("None", "")):
+        return None
+    if isinstance(v, str):
+        v = v.strip("()[] ").split(",")
+        v = [x for x in (s.strip() for s in v) if x]
+    try:
+        return tuple(float(x) for x in v)
+    except TypeError:
+        return (float(v),)
+
+
+@register(
+    "_image_wire_normalize",
+    params={
+        "mean": Param(_parse_rgb, None, kind="float tuple or None"),
+        "std": Param(_parse_rgb, None, kind="float tuple or None"),
+        "layout": Param.str("NHWC"),
+    },
+    infer_type=lambda attrs, dts: (
+        [dts[0] if dts[0] is not None else np.uint8], [np.float32], []),
+)
+def _image_wire_normalize(octx, attrs, args, auxs):
+    """Decode a wire-format image batch on device: cast to fp32, subtract
+    per-channel mean / divide by std, and transpose NHWC -> NCHW.
+
+    The host side of this contract is ``io.WireSpec`` (docs/perf.md
+    §pipeline): iterators ship batches as uint8 HWC — a 4x wire-size cut
+    vs fp32 — and this single fused XLA program restores the compute
+    layout at the device boundary. Channel stats apply along the last
+    axis of ``layout`` (the reference normalizes in HWC before its own
+    transpose, image_aug_default.cc)."""
+    x = args[0]
+    y = x.astype(jnp.float32)
+    if attrs["mean"] is not None:
+        y = y - jnp.asarray(attrs["mean"], jnp.float32)
+    if attrs["std"] is not None:
+        y = y / jnp.asarray(attrs["std"], jnp.float32)
+    if attrs["layout"] == "NHWC" and y.ndim == 4:
+        y = jnp.transpose(y, (0, 3, 1, 2))
+    # differentiable (g/std, transposed back) so inputs_need_grad works
+    # through the wire decode; integer wire inputs have no grad anyway
+    return [y], []

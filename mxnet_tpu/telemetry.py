@@ -54,6 +54,7 @@ __all__ = [
     "enable", "disable", "enabled",
     "dump", "prometheus_text", "reset",
     "flush", "start_flusher", "stop_flusher",
+    "pipeline_stage", "PIPELINE_STAGES",
 ]
 
 # ---------------------------------------------------------------------------
@@ -289,6 +290,24 @@ def gauge(name, **labels):
 def histogram(name, buckets=None, **labels):
     """Get-or-create the histogram ``name`` (bounded buckets, seconds)."""
     return _get(Histogram, name, labels, buckets=buckets)
+
+
+# Input-pipeline stage attribution (docs/perf.md §pipeline, docs/
+# observability.md): every stage of the rec-file path records its wall into
+# ONE histogram name keyed by a `stage` label, so a dashboard (or
+# tools/bench_pipeline.py's attribution table) reads the whole ladder with
+# one query. Canonical stages:
+#   decode    per-record JPEG decode + augment (ImageRecordIter workers)
+#   assemble  per-batch host buffer fill (ImageRecordIter batcher)
+#   upload    per-batch host->device transfer + on-device wire decode
+#             (DeviceFeedIter transfer thread)
+#   feed_wait per-batch consumer wait on the device feed queue
+PIPELINE_STAGES = ("decode", "assemble", "upload", "feed_wait")
+
+
+def pipeline_stage(stage):
+    """The ``pipeline.stage_seconds{stage=...}`` histogram for one stage."""
+    return histogram("pipeline.stage_seconds", stage=stage)
 
 
 def enable():

@@ -16,9 +16,19 @@ import importlib
 import pytest
 
 
-def _names(model, **kw):
+def _names(model, fn="get_symbol", **kw):
+    from mxnet_tpu.name import NameManager
+
     mod = importlib.import_module("mxnet_tpu.models." + model)
-    s = mod.get_symbol(**kw)
+    # fresh auto-naming scope: builders with anonymous layers (googlenet's
+    # pooling, lenet's activations) must digest the same regardless of what
+    # was built earlier in the process
+    with NameManager():
+        s = getattr(mod, fn)(**kw)
+        if model == "lstm_lm":
+            # get_symbol returns sym_gen(seq_len) for BucketingModule; the
+            # name surface is bucket-independent (shared params across buckets)
+            s = s(16)[0]
     return s.list_arguments() + s.list_auxiliary_states()
 
 
@@ -26,20 +36,67 @@ def _digest(names):
     return hashlib.sha256("\n".join(names).encode()).hexdigest()[:24]
 
 
-@pytest.mark.parametrize("model,kw,expect_digest,expect_count", [
-    ("resnet", dict(num_classes=1000, num_layers=50),
+# EVERY zoo builder has a digest row, so a rewrite of any of them (the
+# table-driven refactors) is safe by construction: same digest == same
+# checkpoint/finetune name surface.
+@pytest.mark.parametrize("model,fn,kw,expect_digest,expect_count", [
+    ("resnet", "get_symbol", dict(num_classes=1000, num_layers=50),
      "36bd628ce939ccaab31d5f81", 257),
-    ("resnet", dict(num_classes=10, num_layers=20, image_shape="3,28,28"),
+    ("resnet", "get_symbol",
+     dict(num_classes=10, num_layers=20, image_shape="3,28,28"),
      "68e998ca976b1602d59a801e", 102),
-    ("resnext", dict(num_classes=1000, num_layers=101, num_group=32),
+    ("resnext", "get_symbol", dict(num_classes=1000, num_layers=101, num_group=32),
      "fdee9632fbdc0ea8a1b3b0a4", 528),
-    ("inception_v3", dict(num_classes=1000),
+    ("inception_v3", "get_symbol", dict(num_classes=1000),
      "9e4572c3f5f0caab5960f248", 474),
+    ("inception_bn", "get_symbol", dict(num_classes=1000),
+     "abbb526c017fee6040ed43d3", 418),
+    ("inception_resnet_v2", "get_symbol", dict(num_classes=1000),
+     "e9a1bf4f8f99946704b45ba2", 1468),
+    ("googlenet", "get_symbol", dict(num_classes=1000),
+     "ce2077be3f2dcc76ea7abf20", 118),
+    ("alexnet", "get_symbol", dict(num_classes=1000),
+     "597bf935caf231c98a59c820", 18),
+    ("vgg", "get_symbol", dict(num_classes=1000),
+     "ca82b1f47efa36dd114a23c9", 34),
+    ("lenet", "get_symbol", dict(num_classes=10),
+     "acf8735e0aa7b4a409b9d6e5", 10),
+    ("mlp", "get_symbol", dict(num_classes=10),
+     "f6030528efd68c77020d57d8", 8),
+    ("lstm_lm", "get_symbol", dict(),
+     "72bbcf4b7829f7c3a6c2c2a9", 6),
+    ("transformer_lm", "get_symbol", dict(),
+     "8ec30176d133e32f7a11fc06", 48),
+    ("ssd", "get_symbol", dict(),
+     "bbf90da1d09c7ce9a0c924fb", 72),
+    ("dcgan", "make_generator", dict(),
+     "e9427adc4e461c69dcb9c659", 22),
+    ("dcgan", "make_discriminator", dict(),
+     "d3856cddf7a7e7c8d166ddf6", 19),
 ])
-def test_zoo_name_digest(model, kw, expect_digest, expect_count):
-    names = _names(model, **kw)
+def test_zoo_name_digest(model, fn, kw, expect_digest, expect_count):
+    names = _names(model, fn, **kw)
     assert len(names) == expect_count
     assert _digest(names) == expect_digest
+
+
+def test_inception_bn_name_conventions():
+    names = set(_names("inception_bn", num_classes=1000))
+    for n in (
+        # stem
+        "conv_conv1_weight", "bn_conv1_gamma", "conv_conv2red_weight",
+        # A module towers: 1x1 / reduced 3x3 / reduced double-3x3 / projection
+        "conv_3a_1x1_weight", "conv_3a_3x3_reduce_weight",
+        "conv_3a_double_3x3_reduce_weight", "conv_3a_double_3x3_1_weight",
+        "conv_3a_proj_weight", "bn_5b_3x3_reduce_moving_mean",
+        # B (reduction) module has no 1x1/projection tower
+        "conv_3c_3x3_reduce_weight", "conv_4e_double_3x3_1_weight",
+        # head
+        "fc1_weight", "fc1_bias",
+    ):
+        assert n in names, n
+    assert "conv_3c_1x1_weight" not in names
+    assert "conv_3c_proj_weight" not in names
 
 
 def test_resnet_name_conventions():

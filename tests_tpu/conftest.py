@@ -21,7 +21,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 # Host-side suites that live here because they belong to the TPU build's
 # runtime (ci/run_tests.sh faults / telemetry) but exercise no accelerator:
 # they run on CPU-only hosts and are exempt from the hardware gate below.
-_HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py"}
+_HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
+                    "test_pipeline_feed.py"}
 
 
 def pytest_configure(config):
@@ -29,6 +30,8 @@ def pytest_configure(config):
         "markers", "faults: fault-injection / robustness tests (host-only)")
     config.addinivalue_line(
         "markers", "telemetry: runtime-telemetry tests (host-only)")
+    config.addinivalue_line(
+        "markers", "pipeline: input-pipeline wire/feed tests (host-only)")
     config.addinivalue_line("markers", "slow: long-running tests")
 
 

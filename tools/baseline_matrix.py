@@ -489,11 +489,17 @@ def run_dcgan_fused(quick=False, steps=None, loss_every=10):
 
     d_losses, g_losses = [], []
     carry = (gp, gs, ga, dp, ds, da)
-    t_start = None
+    # measurement-hygiene contract (docs/perf.md): the timed span after the
+    # 2 warmup/compile steps splits into 5 synced windows, so every run
+    # reports a median-of-5 with its min-max band (the 5 boundary syncs are
+    # noise at 200 steps: dispatch is async, the sync drains ~1 step)
+    n_windows = min(5, max(steps - 2, 1))
+    bounds = [2 + ((steps - 2) * k) // n_windows for k in range(n_windows + 1)]
+    marks = []
     for i in range(steps):
-        if i == 2:
+        if i in bounds:
             jax.block_until_ready(carry)
-            t_start = time.perf_counter()  # after compiles
+            marks.append(time.perf_counter())
         out = step_jit(*carry, pool[rng.randint(len(pool))],
                        np.int32(i + 1))
         carry = out[:6]
@@ -501,10 +507,18 @@ def run_dcgan_fused(quick=False, steps=None, loss_every=10):
             d_losses.append(float(out[6]))
             g_losses.append(float(out[7]))
     jax.block_until_ready(carry)
-    dt = time.perf_counter() - t_start
-    rate = batch * (steps - 2) / dt
+    marks.append(time.perf_counter())
+    window_rates = [
+        batch * (b1 - b0) / (t1 - t0)
+        for b0, b1, t0, t1 in zip(bounds, bounds[1:], marks, marks[1:])
+        if t1 > t0 and b1 > b0
+    ]
+    rate = float(np.median(window_rates))
     emit("dcgan_fused_train_imgs_per_sec", rate, "img/s",
-         {"batch": batch, "device": str(_ctx()), "loss_every": loss_every})
+         {"batch": batch, "device": str(_ctx()), "loss_every": loss_every,
+          "band_lo": round(min(window_rates), 1),
+          "band_hi": round(max(window_rates), 1),
+          "windows": len(window_rates)})
     third = max(len(d_losses) // 3, 1)
     emit("dcgan_fused_d_loss_final_third",
          float(np.mean(d_losses[-third:])), "ce",

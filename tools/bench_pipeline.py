@@ -1,23 +1,31 @@
 #!/usr/bin/env python
-"""Host input-pipeline benchmark (VERDICT round-3 item 5; SURVEY §7's
-final hard part: the host must feed the chip).
+"""Host input-pipeline benchmark + stage attribution ladder (docs/perf.md
+§pipeline; SURVEY §7's final hard part: the host must feed the chip).
 
 Generates a synthetic JPEG dataset, packs it with tools/im2rec.py, then
-measures:
+measures an A/B ladder that decomposes the decode-capacity -> training-rate
+gap stage by stage:
 
-* raw JPEG decode cost per image (PIL vs cv2 backends),
-* `ImageRecordIter` end-to-end throughput (decode + augment + batch +
-  prefetch) vs `preprocess_threads`,
-* the same overlapped with a `Module.fit` consuming the batches,
+  A  raw JPEG decode cost per image (PIL vs cv2 backends)
+  B  `ImageRecordIter` into a null consumer (decode + augment + batch +
+     prefetch), fp32 wire vs uint8 wire
+  C  the same batches through a no-op device consumer (host->device
+     transfer + on-device wire decode, nothing else) — isolates the wire
+  D  the full `Module.fit` train step: fp32 wire, uint8 wire, and uint8
+     wire + the double-buffered async device feed (MXNET_FEED_DEPTH)
 
-and prints the gap against the device rate (BENCH ResNet-50 img/s). One
-JSON line per measurement; paste the markdown into docs/perf.md.
+Every ladder rung reports the MEDIAN over --reps measurement windows with
+its min-max band, and the per-stage `pipeline.stage_seconds` telemetry
+histograms are published while the ladder runs (docs/observability.md).
+One JSON line per measurement; a markdown attribution table for
+docs/perf.md prints at the end.
 
-    python tools/bench_pipeline.py [--n 512] [--size 224] [--quick]
+    python tools/bench_pipeline.py [--n 512] [--size 224] [--reps 5] [--quick]
 """
 import argparse
 import json
 import os
+import statistics
 import sys
 import tempfile
 import time
@@ -28,6 +36,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import telemetry  # noqa: E402
 
 
 def emit(metric, value, unit, extra=None):
@@ -35,6 +44,20 @@ def emit(metric, value, unit, extra=None):
     if extra:
         rec.update(extra)
     print(json.dumps(rec), flush=True)
+
+
+def _band(vals):
+    """(median, lo, hi) over a list of window rates."""
+    return statistics.median(vals), min(vals), max(vals)
+
+
+def _emit_band(metric, vals, unit, extra=None):
+    med, lo, hi = _band(vals)
+    extra = dict(extra or {})
+    extra.update({"band_lo": round(lo, 2), "band_hi": round(hi, 2),
+                  "windows": len(vals)})
+    emit(metric, med, unit, extra)
+    return med, lo, hi
 
 
 def gen_dataset(workdir, n, size):
@@ -102,30 +125,85 @@ def bench_decode(img_dir, n_meas=200):
     return pil_rate, cv_rate
 
 
-def bench_iter(rec, size, batch, threads, n_batches=30):
-    it = mx.io_image.ImageRecordIter(
+def _make_iter(rec, size, batch, threads, wire_dtype=None):
+    return mx.io_image.ImageRecordIter(
         path_imgrec=rec, data_shape=(3, size, size), batch_size=batch,
-        preprocess_threads=threads, shuffle=False)
-    # warm one batch (thread spin-up)
+        preprocess_threads=threads, shuffle=False, wire_dtype=wire_dtype)
+
+
+def _windows(it, batch, n_batches, reps, consume):
+    """reps timed windows of n_batches each over a restarting iterator;
+    returns per-window img/s. ``consume(batch)`` is the ladder rung's
+    consumer (None = null consumer)."""
+    rates = []
+    src = iter(it)
+    for _ in range(reps):
+        got = 0
+        t0 = time.perf_counter()
+        while got < n_batches * batch:
+            try:
+                b = next(src)
+            except StopIteration:
+                it.reset()
+                src = iter(it)
+                continue
+            if consume is not None:
+                consume(b)
+            got += b.data[0].shape[0]
+        rates.append(got / (time.perf_counter() - t0))
+    return rates
+
+
+def bench_iter(rec, size, batch, threads, n_batches=30, reps=5,
+               wire_dtype=None):
+    """Ladder rung B: decode+augment+batch into a NULL consumer."""
+    it = _make_iter(rec, size, batch, threads, wire_dtype)
+    next(iter(it))  # warm one batch (thread spin-up)
+    rates = _windows(it, batch, n_batches, reps, None)
+    it.close()
+    med, lo, hi = _emit_band(
+        "recorditer_imgs_per_sec", rates, "img/s",
+        {"threads": threads, "batch": batch, "size": size,
+         "wire": wire_dtype or "float32"})
+    return med, lo, hi
+
+
+def bench_transfer(rec, size, batch, threads, ctx, n_batches=30, reps=5,
+                   wire_dtype=None):
+    """Ladder rung C: batches into a no-op device consumer — each batch is
+    uploaded to ``ctx`` (+ on-device wire decode) and synced, nothing else.
+    The delta vs rung B is pure host->device wire cost."""
+    import jax
+
+    it = _make_iter(rec, size, batch, threads, wire_dtype)
+
+    def consume(b):
+        staged = mx.io.DataBatch(
+            [a.as_in_context(ctx) for a in b.data],
+            [a.as_in_context(ctx) for a in (b.label or [])],
+            pad=b.pad, wire=getattr(b, "wire", None))
+        staged = mx.io.apply_wire(staged)
+        for a in staged.data + (staged.label or []):
+            jax.block_until_ready(a.data)
+
     next(iter(it))
-    t0 = time.perf_counter()
-    got = 0
-    for i, b in enumerate(it):
-        got += b.data[0].shape[0]
-        if i >= n_batches:
-            break
-    rate = got / (time.perf_counter() - t0)
-    emit("recorditer_imgs_per_sec", rate, "img/s",
-         {"threads": threads, "batch": batch, "size": size})
-    return rate
+    consume(next(iter(it)))  # warm the decode program compile
+    rates = _windows(it, batch, n_batches, reps, consume)
+    it.close()
+    wire_mb = batch * size * size * 3 * (1 if wire_dtype == "uint8" else 4) / 1e6
+    med, lo, hi = _emit_band(
+        "rec_device_put_imgs_per_sec", rates, "img/s",
+        {"threads": threads, "batch": batch, "device": str(ctx),
+         "wire": wire_dtype or "float32", "wire_mb_per_batch": round(wire_mb, 2)})
+    return med, lo, hi
 
 
-def bench_overlapped(rec, size, batch, threads, epochs=2):
-    """ImageRecordIter driving a small conv net fit — the full
-    host-produce / device-consume overlap."""
-    it = mx.io_image.ImageRecordIter(
-        path_imgrec=rec, data_shape=(3, size, size), batch_size=batch,
-        preprocess_threads=threads, shuffle=False)
+def bench_overlapped(rec, size, batch, threads, reps=5, wire_dtype=None,
+                     feed_depth=0):
+    """Ladder rung D: ImageRecordIter driving a small conv net fit — the full
+    host-produce / device-consume overlap. Rate is measured PER EPOCH (first
+    epoch dropped: compile) so one fit yields ``reps`` median windows."""
+    it = _make_iter(rec, size, batch, threads, wire_dtype)
     data = mx.sym.Variable("data")
     net = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
                              stride=(2, 2), name="c1")
@@ -136,25 +214,57 @@ def bench_overlapped(rec, size, batch, threads, epochs=2):
     net = mx.sym.SoftmaxOutput(net, name="softmax")
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
     mod = mx.mod.Module(net, context=ctx)
-    times = []
+    epoch_marks = []  # (epoch, t) per batch
 
     def cb(param):
-        times.append(time.perf_counter())
+        epoch_marks.append((param.epoch, time.perf_counter()))
 
-    mod.fit(it, num_epoch=epochs, optimizer="sgd",
-            optimizer_params={"learning_rate": 0.01},
-            initializer=mx.init.Xavier(), batch_end_callback=[cb],
-            force_init=True)
-    # drop the compile-dominated first batches, not a whole epoch (with
-    # epochs=1 the latter would leave an empty window)
-    steady = times[2:] if len(times) > 3 else times[1:]
-    if len(steady) >= 2:
-        rate = batch * (len(steady) - 1) / (steady[-1] - steady[0])
-    else:
-        rate = float("nan")
-    emit("rec_training_imgs_per_sec", rate, "img/s",
-         {"threads": threads, "batch": batch, "device": str(ctx)})
-    return rate
+    old_depth = os.environ.get("MXNET_FEED_DEPTH")
+    if feed_depth:
+        os.environ["MXNET_FEED_DEPTH"] = str(feed_depth)
+    try:
+        mod.fit(it, num_epoch=reps + 1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.01},
+                initializer=mx.init.Xavier(), batch_end_callback=[cb],
+                force_init=True)
+    finally:
+        if feed_depth:
+            if old_depth is None:
+                os.environ.pop("MXNET_FEED_DEPTH", None)
+            else:
+                os.environ["MXNET_FEED_DEPTH"] = old_depth
+    it.close()
+    rates = []
+    for epoch in range(1, reps + 1):  # epoch 0 pays the compile
+        marks = [t for e, t in epoch_marks if e == epoch]
+        if len(marks) >= 2:
+            rates.append(batch * (len(marks) - 1) / (marks[-1] - marks[0]))
+    if not rates:
+        rates = [float("nan")]
+    med, lo, hi = _emit_band(
+        "rec_training_imgs_per_sec", rates, "img/s",
+        {"threads": threads, "batch": batch, "device": str(ctx),
+         "wire": wire_dtype or "float32", "feed_depth": feed_depth})
+    return med, lo, hi
+
+
+def _stage_p50s():
+    """p50 of each pipeline stage histogram (seconds), from the registry."""
+    out = {}
+    snap = telemetry.dump(include_events=False)
+    for key, h in snap.get("histograms", {}).items():
+        if key.startswith("pipeline.stage_seconds") and h.get("count"):
+            stage = key.split("stage=")[-1].rstrip("}")
+            out[stage] = h.get("p50")
+        if key.startswith("fit.data_wait_seconds") and h.get("count"):
+            out["fit.data_wait"] = h.get("p50")
+        if key.startswith("fit.compute_seconds") and h.get("count"):
+            out["fit.compute"] = h.get("p50")
+    return out
+
+
+def _fmt(med, lo, hi):
+    return "**%.0f** (%.0f-%.0f)" % (med, lo, hi)
 
 
 def main():
@@ -162,12 +272,14 @@ def main():
     ap.add_argument("--n", type=int, default=512)
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--reps", type=int, default=5,
+                    help="measurement windows per ladder rung (median + band)")
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--keep", default=None,
                     help="directory to build the dataset in (reused)")
     a = ap.parse_args()
     if a.quick:
-        a.n, a.size = 64, 96
+        a.n, a.size, a.reps = 64, 96, 3
     workdir = a.keep or tempfile.mkdtemp(prefix="mxtpu_pipe_")
     rec = os.path.join(workdir, "data.rec")
     if not os.path.exists(rec):
@@ -177,12 +289,61 @@ def main():
         img_dir = os.path.join(workdir, "imgs")
     ncpu = os.cpu_count()
     emit("host_cpu_count", ncpu, "cores")
-    bench_decode(img_dir, n_meas=min(a.n, 200))
+    telemetry.enable()
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    nb = 8 if a.quick else 30
+    rows = []
+
+    # A: raw decode capacity
+    pil_rate, cv_rate = bench_decode(img_dir, n_meas=min(a.n, 200))
+    rows.append(("A raw JPEG decode (%s)" % ("cv2" if cv_rate else "PIL"),
+                 None, "%.0f" % (cv_rate or pil_rate)))
+
+    # B: iterator -> null consumer, per thread count, then wire A/B
     for threads in (1, 2, 4):
-        bench_iter(rec, a.size, a.batch, threads,
-                   n_batches=8 if a.quick else 30)
-    bench_overlapped(rec, a.size, a.batch, threads=2,
-                     epochs=3 if a.quick else 2)
+        b_f = bench_iter(rec, a.size, a.batch, threads, nb, a.reps)
+        if threads == 2:
+            rows.append(("B decode+augment+batch -> null (2 thr, fp32)",
+                         None, _fmt(*b_f)))
+    b_u = bench_iter(rec, a.size, a.batch, 2, nb, a.reps, wire_dtype="uint8")
+    rows.append(("B decode+augment+batch -> null (2 thr, uint8)", None,
+                 _fmt(*b_u)))
+
+    # C: + host->device transfer (no-op consumer)
+    c_f = bench_transfer(rec, a.size, a.batch, 2, ctx, nb, a.reps)
+    c_u = bench_transfer(rec, a.size, a.batch, 2, ctx, nb, a.reps,
+                         wire_dtype="uint8")
+    fp32_mb = a.batch * a.size * a.size * 3 * 4 / 1e6
+    rows.append(("C + host->device upload (fp32, %.1f MB/batch)" % fp32_mb,
+                 None, _fmt(*c_f)))
+    rows.append(("C + host->device upload (uint8, %.1f MB/batch)"
+                 % (fp32_mb / 4), None, _fmt(*c_u)))
+
+    # D: the full train step
+    telemetry.reset()
+    telemetry.enable()
+    d_f = bench_overlapped(rec, a.size, a.batch, 2, a.reps)
+    emit("stage_p50s_fp32", 0, "s", {"p50": _stage_p50s()})
+    telemetry.reset()
+    telemetry.enable()
+    d_u = bench_overlapped(rec, a.size, a.batch, 2, a.reps,
+                           wire_dtype="uint8")
+    emit("stage_p50s_uint8", 0, "s", {"p50": _stage_p50s()})
+    telemetry.reset()
+    telemetry.enable()
+    d_uf = bench_overlapped(rec, a.size, a.batch, 2, a.reps,
+                            wire_dtype="uint8", feed_depth=2)
+    emit("stage_p50s_uint8_feed", 0, "s", {"p50": _stage_p50s()})
+    rows.append(("D full train step (fp32 wire)", None, _fmt(*d_f)))
+    rows.append(("D full train step (uint8 wire)", None, _fmt(*d_u)))
+    rows.append(("D full train step (uint8 wire + feed depth 2)", None,
+                 _fmt(*d_uf)))
+
+    print("\n### attribution ladder (paste into docs/perf.md)\n")
+    print("| ladder rung | img/s (median, band) |")
+    print("|---|---|")
+    for name, _, val in rows:
+        print("| %s | %s |" % (name, val))
 
 
 if __name__ == "__main__":
