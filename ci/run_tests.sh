@@ -6,6 +6,7 @@
 #   faults    fault-injection / robustness suite (fast, host-only)
 #   telemetry runtime-telemetry suite: registry/exposition/fit metrics (fast, host-only)
 #   pipeline  input-pipeline feed suite: uint8 wire + async device feed (fast, host-only)
+#   guard     training health-guard suite: sentinel/rollback/stall/resume (fast, host-only)
 #   deep      (opt-in, non-blocking) slow-marked deep-model compiles
 #   predict   C predict shim build + compiled-client test
 #   entry     driver contract: graft entry compile + multichip dryrun
@@ -180,6 +181,16 @@ run_pipeline() {
     -q -m "not slow"
 }
 
+run_guard() {
+  # training health-guard tier (docs/fault_tolerance.md §health-guard):
+  # NaN/stall sentinel, skip/rollback/abort policy ladder, iterator position
+  # protocol, exact mid-epoch resume determinism — all via fault injection.
+  # Host-only (no accelerator); the multi-rollback end-to-end case is
+  # slow-marked and stays out of the blocking tier's timing budget.
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_guard.py \
+    -q -m "not slow"
+}
+
 run_deep() {
   # non-blocking deep stage: the slow-marked deep-model one-step compiles
   # (e.g. Inception-ResNet-v2) — ~15 min of XLA compile wall on a 1-core
@@ -293,6 +304,7 @@ case "$stage" in
   faults) run_faults ;;
   telemetry) run_telemetry ;;
   pipeline) run_pipeline ;;
+  guard) run_guard ;;
   deep) run_deep ;;
   predict) run_predict ;;
   predict_native) run_predict_native ;;
@@ -302,9 +314,9 @@ case "$stage" in
   examples) run_examples ;;
   package) run_package ;;
   all) run_native; run_predict; run_predict_native; run_entry; run_package;
-       run_faults; run_telemetry; run_pipeline;
+       run_faults; run_telemetry; run_pipeline; run_guard;
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
                 --ignore=tests/test_train_native.py ;;
-  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|guard|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
 esac

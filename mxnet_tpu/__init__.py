@@ -42,6 +42,7 @@ from . import executor_manager
 from . import model
 from .model import FeedForward
 from . import fault
+from . import guard
 from . import telemetry
 from . import rnn
 from . import visualization

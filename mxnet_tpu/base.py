@@ -97,6 +97,35 @@ def env_flag(name, default="0"):
         "0", "", "false", "no", "off")
 
 
+def _env_number(name, default, cast):
+    import os
+
+    raw = os.environ.get(name, "")
+    if not raw.strip():
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        import logging
+
+        logging.warning("ignoring unparseable %s=%r (using %r)",
+                        name, raw, default)
+        return default
+
+
+def env_int(name, default=None):
+    """Integer MXNET_*-style env var; unset/empty or unparseable values fall
+    back to ``default`` (with a warning for garbage — a typo'd tuning knob
+    should degrade to the documented default, not crash the job)."""
+    return _env_number(name, default, int)
+
+
+def env_float(name, default=None):
+    """Float MXNET_*-style env var; same fallback contract as
+    :func:`env_int`."""
+    return _env_number(name, default, float)
+
+
 def parse_int_or_none(s):
     if s is None or (isinstance(s, str) and s.strip() in ("None", "")):
         return None

@@ -15,6 +15,14 @@ recovery path is testable in a single process, byte-for-byte reproducibly:
   attempt, ``delay_ms=N`` stalls it) to exercise retry/backoff.
 * ``server_updater`` — the PS server's optimizer application (``raise=1``)
   to exercise the server's failure counting and threshold.
+* ``nan`` — the health guard's sentinel (guard.py): poisons the next step's
+  gradients with NaN (``target=loss`` flags the loss scalar instead), so
+  skip/rollback/abort are testable without real divergence.
+* ``stall`` — the device-feed transfer stage (io.DeviceFeedIter._stage):
+  ``delay_ms=N`` sleeps it past the guard's watchdog deadline.
+* ``bad_record`` — ImageRecordIter's per-record decode: makes the record
+  undecodable to exercise the quarantine/budget path
+  (``MXNET_IO_MAX_BAD_RECORDS``).
 
 Faults are described by a spec string, either in ``MXNET_FAULT_SPEC`` (so a
 whole process tree — e.g. launched PS servers — inherits them) or pushed

@@ -1,0 +1,824 @@
+"""Training health guard: NaN/stall sentinel, policy ladder, exact rollback.
+
+PR 1 made crashes survivable (atomic checkpoints, auto_resume) and the
+telemetry registry made the runtime observable, but a job that silently goes
+BAD — a NaN loss at step 40k, a diverging spike, a wedged device feed — still
+burned the rest of its budget or died with all work since the last epoch
+boundary lost. The lineage treats these as first-class recoverable events
+(TensorFlow's supervisor/loss-scale machinery, arXiv 1605.08695; Pathways
+assumes the runtime heals them itself, arXiv 2203.12533). This module is that
+layer:
+
+* **Sentinel** — one fused on-device program per step reduces the executor
+  outputs and every gradient to two scalars (loss proxy, global grad-norm²);
+  one tiny host pull per step classifies them: non-finite values and
+  EWMA-relative spikes are *bad steps*. Gated exactly like telemetry: with
+  the guard off, ``fit`` pays one ``None`` check per batch.
+* **Policy ladder** (``MXNET_GUARD_POLICY`` / ``fit(guard=...)``) —
+  ``skip`` the bad update (on the classic executor path the gradients are
+  discarded with the parameters untouched; on the fused SPMD path detection
+  is post-step, so skip escalates to abort once bad steps persist — only
+  rollback can heal an already-applied update);
+  after ``MXNET_GUARD_MAX_BAD_STEPS`` consecutive bad steps ``rollback`` to
+  the last good snapshot (params + optimizer state + data-iterator position
+  + numpy RNG); past ``max_rollbacks`` — or with nothing to roll back to —
+  ``abort`` with a classified :class:`BadStepError`. ``abort`` alone raises
+  on the first bad step.
+* **Stall watchdog** — a daemon thread that fires when no step completes
+  within ``MXNET_GUARD_STALL_S``: it dumps the engine/pipeline/KV telemetry
+  state (the queues tell you WHICH stage wedged), then interrupts the
+  training thread so ``fit`` raises a classified :class:`StallError` instead
+  of hanging forever.
+* **Exact mid-epoch resume** — with ``checkpoint_every=N`` the guard writes
+  ordinary PR-1 checkpoints mid-epoch plus a ``prefix-EPOCH.resume`` sidecar
+  (iterator ``state_dict()``, numpy RNG, optimizer step counts, bound to the
+  params file's CRC). ``fit(auto_resume=...)`` consumes the sidecar and lands
+  on the exact next batch; checkpoints without one (every pre-existing file)
+  resume at the epoch boundary as before.
+
+Metrics (always-on, docs/observability.md): ``guard.bad_steps{reason=...}``,
+``guard.rollbacks``, ``guard.stalls``. Testing: the ``nan`` / ``stall``
+fault-injection points (docs/fault_tolerance.md) drive every path
+deterministically — suite in ``tests_tpu/test_guard.py``.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+
+from . import telemetry
+from .base import MXNetError, env_float as _env_float, env_int as _env_int
+
+__all__ = ["GuardError", "BadStepError", "StallError", "GuardPolicy",
+           "TrainingGuard", "Sentinel", "resolve"]
+
+
+class GuardError(MXNetError):
+    """Base class for health-guard failures."""
+
+
+class BadStepError(GuardError):
+    """Training aborted by the guard's policy ladder (non-finite or
+    anomalous loss/gradients that skip/rollback could not heal)."""
+
+
+class StallError(GuardError):
+    """No training step completed within the watchdog deadline."""
+
+
+POLICIES = ("off", "skip", "rollback", "abort")
+
+
+class GuardPolicy:
+    """Configuration for a :class:`TrainingGuard`.
+
+    Every argument defaults from its environment knob (docs/env_var.md), so
+    ``MXNET_GUARD_POLICY=rollback python train.py`` needs no code change;
+    ``fit(guard=GuardPolicy(policy="rollback", ...))`` overrides per-run.
+
+    * ``policy`` — ``off`` | ``skip`` | ``rollback`` | ``abort``
+      (``MXNET_GUARD_POLICY``, default ``off``).
+    * ``max_bad_steps`` — consecutive bad steps before the ladder escalates
+      from skip to rollback (``MXNET_GUARD_MAX_BAD_STEPS``, default 3).
+    * ``max_rollbacks`` — rollbacks before escalating to abort (default 2).
+    * ``stall_timeout_s`` — watchdog deadline; 0 disables it
+      (``MXNET_GUARD_STALL_S``, default 0). The watchdog arms after the
+      FIRST completed step, so one-off XLA compile walls don't false-fire.
+    * ``spike_factor`` — a step is bad when its loss/grad-norm exceeds
+      ``spike_factor`` × the EWMA of recent good steps; 0 disables spike
+      detection, leaving only the non-finite checks
+      (``MXNET_GUARD_SPIKE``, default 0).
+    * ``warmup_steps`` — good steps observed before spike detection can
+      fire (default 10; the EWMA needs a baseline).
+    * ``snapshot_every`` — good steps between in-memory rollback snapshots;
+      0 keeps only the epoch-start snapshot
+      (``MXNET_GUARD_SNAPSHOT_STEPS``, default 0).
+    * ``checkpoint_prefix`` / ``checkpoint_every`` — write a real PR-1
+      checkpoint (+ ``.resume`` sidecar) every N good steps, so a crash
+      mid-epoch resumes on the exact next batch. Default off; fit fills the
+      prefix from ``auto_resume`` when one was passed.
+    """
+
+    def __init__(self, policy=None, max_bad_steps=None, max_rollbacks=None,
+                 stall_timeout_s=None, spike_factor=None, warmup_steps=None,
+                 snapshot_every=None, checkpoint_prefix=None,
+                 checkpoint_every=None):
+        if policy is None:
+            policy = os.environ.get("MXNET_GUARD_POLICY", "off") or "off"
+        policy = str(policy).lower()
+        if policy not in POLICIES:
+            raise MXNetError("MXNET_GUARD_POLICY must be one of %s, got %r"
+                             % ("/".join(POLICIES), policy))
+        self.policy = policy
+        self.max_bad_steps = (max_bad_steps if max_bad_steps is not None
+                              else _env_int("MXNET_GUARD_MAX_BAD_STEPS", 3))
+        self.max_rollbacks = (max_rollbacks if max_rollbacks is not None
+                              else _env_int("MXNET_GUARD_MAX_ROLLBACKS", 2))
+        self.stall_timeout_s = (stall_timeout_s if stall_timeout_s is not None
+                                else _env_float("MXNET_GUARD_STALL_S", 0.0))
+        self.spike_factor = (spike_factor if spike_factor is not None
+                             else _env_float("MXNET_GUARD_SPIKE", 0.0))
+        self.warmup_steps = (warmup_steps if warmup_steps is not None
+                             else _env_int("MXNET_GUARD_WARMUP", 10))
+        self.snapshot_every = (snapshot_every if snapshot_every is not None
+                               else _env_int("MXNET_GUARD_SNAPSHOT_STEPS", 0))
+        self.checkpoint_prefix = checkpoint_prefix
+        self.checkpoint_every = (checkpoint_every if checkpoint_every
+                                 is not None
+                                 else _env_int("MXNET_GUARD_CKPT_STEPS", 0))
+
+    @property
+    def active(self):
+        return self.policy != "off" or self.stall_timeout_s > 0
+
+    def __repr__(self):
+        return ("GuardPolicy(policy=%r, max_bad_steps=%d, max_rollbacks=%d, "
+                "stall_timeout_s=%g, spike_factor=%g)"
+                % (self.policy, self.max_bad_steps, self.max_rollbacks,
+                   self.stall_timeout_s, self.spike_factor))
+
+
+def resolve(guard, checkpoint_prefix=None, logger=None):
+    """``fit``'s entry point: normalize its ``guard=`` argument.
+
+    Accepts ``None`` (build from the environment; returns ``None`` when no
+    guard knob is set — the zero-overhead default), a policy-name string, a
+    :class:`GuardPolicy`, or a ready :class:`TrainingGuard`. A guard that
+    can write checkpoints but has no prefix inherits ``checkpoint_prefix``
+    (fit passes its ``auto_resume`` prefix).
+    """
+    if isinstance(guard, TrainingGuard):
+        # per-fit default, NOT written into the caller's policy: a guard
+        # reused across fits with different auto_resume prefixes must
+        # follow each fit's prefix, and an explicit policy prefix wins
+        guard._default_prefix = checkpoint_prefix
+        return guard if guard.policy.active else None
+    if guard is None:
+        policy = GuardPolicy()
+    elif isinstance(guard, GuardPolicy):
+        policy = guard
+    elif isinstance(guard, str):
+        policy = GuardPolicy(policy=guard)
+    else:
+        raise TypeError("fit(guard=...) accepts None, a policy name, a "
+                        "GuardPolicy, or a TrainingGuard; got %r" % (guard,))
+    if not policy.active:
+        return None
+    obj = TrainingGuard(policy, logger=logger)
+    obj._default_prefix = checkpoint_prefix
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# sentinel
+# ---------------------------------------------------------------------------
+
+
+class Sentinel:
+    """Per-step health classifier.
+
+    :meth:`measure` fuses the step's observables (executor outputs, every
+    gradient array) into two scalars with ONE jitted program per device —
+    ``loss`` (sum of outputs: NaN/Inf anywhere poisons it) and the global
+    gradient norm — costing one two-float host pull per step.
+    :meth:`classify` flags non-finite values always, and EWMA-relative
+    spikes once ``warmup_steps`` good steps built a baseline. The EWMA
+    only absorbs GOOD steps, so a divergence can't drag the baseline up
+    after it starts.
+    """
+
+    EWMA_ALPHA = 0.1
+
+    def __init__(self, spike_factor=0.0, warmup_steps=10):
+        self.spike_factor = float(spike_factor)
+        self.warmup_steps = int(warmup_steps)
+        self._jitted = None
+        self._good_steps = 0
+        self._loss_ewma = None
+        self._gnorm_ewma = None
+
+    # ---- measurement -----------------------------------------------------
+    def _fn(self):
+        if self._jitted is None:
+            import jax
+            import jax.numpy as jnp
+
+            def health(outs, grads):
+                loss = jnp.float32(0.0)
+                for o in outs:
+                    loss = loss + jnp.sum(o.astype(jnp.float32))
+                gsq = jnp.float32(0.0)
+                for g in grads:
+                    g32 = g.astype(jnp.float32)
+                    gsq = gsq + jnp.vdot(g32, g32)
+                return jnp.stack([loss, gsq])
+
+            self._jitted = jax.jit(health)
+        return self._jitted
+
+    def measure(self, per_device):
+        """``[(outputs, grads), ...]`` (raw jax arrays, one entry per
+        device) -> ``(loss, grad_norm)`` floats. One program + one pull per
+        device; the cross-device sum happens on these host scalars."""
+        loss = 0.0
+        gsq = 0.0
+        fn = self._fn()
+        for outs, grads in per_device:
+            if not outs and not grads:
+                continue
+            vals = np.asarray(fn(list(outs), list(grads)))
+            loss += float(vals[0])
+            gsq += float(vals[1])
+        return loss, math.sqrt(gsq) if gsq >= 0 else float("nan")
+
+    # ---- classification --------------------------------------------------
+    def classify(self, loss, grad_norm):
+        """Bad-step reason for this measurement, or ``None`` if healthy.
+
+        A good step folds into the EWMA baselines; a bad one does not."""
+        if loss is not None and not math.isfinite(loss):
+            return "non_finite_loss"
+        if grad_norm is not None and not math.isfinite(grad_norm):
+            return "non_finite_grad"
+        if self.spike_factor > 0 and self._good_steps >= self.warmup_steps:
+            if (self._loss_ewma is not None and self._loss_ewma > 0
+                    and loss is not None
+                    and abs(loss) > self.spike_factor * self._loss_ewma):
+                return "loss_spike"
+            if (self._gnorm_ewma is not None and self._gnorm_ewma > 0
+                    and grad_norm is not None
+                    and grad_norm > self.spike_factor * self._gnorm_ewma):
+                return "grad_spike"
+        self._good_steps += 1
+        a = self.EWMA_ALPHA
+        if loss is not None:
+            prev = abs(loss) if self._loss_ewma is None else self._loss_ewma
+            self._loss_ewma = a * abs(loss) + (1 - a) * prev
+        if grad_norm is not None:
+            prev = (grad_norm if self._gnorm_ewma is None
+                    else self._gnorm_ewma)
+            self._gnorm_ewma = a * grad_norm + (1 - a) * prev
+        return None
+
+
+def _module_observables(module, want_grads=True):
+    """``[(outputs, grads), ...]`` raw jax arrays per device from a bound
+    module on the executor-group path; ``None`` when nothing is observable
+    yet (fused path with a staged-but-unexecuted batch)."""
+    fused = getattr(module, "_fused", None)
+    if fused is not None and fused.pending:
+        return None
+    eg = getattr(module, "_exec_group", None)
+    if fused is not None and fused.has_outputs:
+        # fused post-step: outputs live on the fused path, grads are folded
+        # into the one SPMD program and not observable
+        return [([o.data for o in fused.get_outputs()], [])]
+    if eg is None:
+        return None
+    per_device = []
+    for dev, exc in enumerate(eg.execs):
+        outs = [o.data for o in exc.outputs]
+        grads = []
+        if want_grads and eg.grad_arrays:
+            for per_param in eg.grad_arrays:
+                if per_param is None:
+                    continue
+                g = per_param[dev]
+                if g is not None:
+                    grads.append(g.data)
+        per_device.append((outs, grads))
+    return per_device
+
+
+def _poison_grads(module):
+    """The ``nan`` fault (target=grad, the default): overwrite one real
+    gradient array with NaNs so an unguarded update would genuinely corrupt
+    the weights — the tests prove skip/rollback PROTECT, not just detect."""
+    eg = getattr(module, "_exec_group", None)
+    if eg is None or not eg.grad_arrays:
+        return False
+    for per_param in eg.grad_arrays:
+        for g in per_param or []:
+            if g is not None:
+                g[:] = np.full(g.shape, np.nan, dtype=np.float32)
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# snapshots
+# ---------------------------------------------------------------------------
+
+
+class _Snapshot:
+    __slots__ = ("epoch", "nbatch", "arg", "aux", "opt_bytes", "opt_counts",
+                 "iter_state", "rng")
+
+    def __init__(self, epoch, nbatch, arg, aux, opt_bytes, opt_counts,
+                 iter_state, rng):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.arg = arg
+        self.aux = aux
+        self.opt_bytes = opt_bytes
+        self.opt_counts = opt_counts
+        self.iter_state = iter_state
+        self.rng = rng
+
+
+def _optimizer_counts(module):
+    """The schedule position (``num_update`` and friends) that pickled
+    updater states do NOT carry — captured so a rollback/resume keeps the
+    lr schedule and Adam bias-correction t where they were."""
+    opt = getattr(module, "_optimizer", None)
+    if opt is None:
+        return None
+    return {"num_update": opt.num_update,
+            "begin_num_update": opt.begin_num_update,
+            "index_update_count": dict(opt._index_update_count)}
+
+
+def _restore_optimizer_counts(module, counts):
+    opt = getattr(module, "_optimizer", None)
+    if opt is None or not counts:
+        return
+    opt.num_update = counts["num_update"]
+    opt.begin_num_update = counts["begin_num_update"]
+    opt._index_update_count = {
+        int(k): v for k, v in counts["index_update_count"].items()}
+
+
+def _opt_state_bytes(module):
+    """Optimizer state as bytes, or None when it lives on a kvstore (the
+    one configuration whose state is not process-local)."""
+    fused = getattr(module, "_fused", None)
+    if fused is not None:
+        return fused.get_states_bytes()
+    upd = getattr(module, "_updater", None)
+    if upd is not None:
+        return upd.get_states()
+    return None
+
+
+def _set_opt_state_bytes(module, data):
+    fused = getattr(module, "_fused", None)
+    if fused is not None:
+        fused.set_states_bytes(data)
+        return True
+    upd = getattr(module, "_updater", None)
+    if upd is not None:
+        upd.set_states(data)
+        return True
+    return False
+
+
+def _iter_state(train_data):
+    """``state_dict()`` of an iterator that supports it, else None."""
+    fn = getattr(train_data, "state_dict", None)
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception as exc:  # noqa: BLE001 — an unsupported iterator must
+        # degrade to position-less snapshots, not kill training
+        logging.getLogger(__name__).warning(
+            "guard: %s.state_dict() failed (%s); snapshots carry no "
+            "iterator position", type(train_data).__name__, exc)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+
+class _Watchdog:
+    """Daemon thread raising the alarm when no step completes in time.
+
+    Arms on the FIRST :meth:`beat` (so an initial XLA compile wall cannot
+    false-fire), then fires once when ``timeout_s`` passes without another
+    beat: dumps the engine/pipeline/KV telemetry state, counts
+    ``guard.stalls``, and interrupts the training thread. The interrupt is
+    ``pthread_kill(SIGINT)`` aimed at the thread that armed it — CPython
+    makes the main thread's blocking waits (queue pops, ``time.sleep``,
+    device syncs through the GIL) signal-interruptible, which is exactly
+    the set of places a stalled fit loop is stuck. When fit runs on a
+    non-main thread (or SIGINT has a custom handler) the watchdog degrades
+    to flag-only: fit checks :attr:`fired` at the top of every step, so the
+    stall still surfaces as soon as the loop moves again.
+    """
+
+    def __init__(self, timeout_s, logger=None):
+        self.timeout_s = float(timeout_s)
+        self.logger = logger or logging.getLogger(__name__)
+        self.fired = False
+        self._lock = threading.Lock()
+        self._last = None  # None until the first beat arms us
+        self._stopped = False
+        self._target = threading.current_thread()
+        self._thread = threading.Thread(
+            target=self._loop, name="mxnet-guard-watchdog", daemon=True)
+        self._thread.start()
+
+    def beat(self):
+        with self._lock:
+            self._last = time.monotonic()
+
+    GRACE = 10.0  # suspend() deadline multiplier
+
+    def suspend(self):
+        """Extend the deadline to ``GRACE × timeout`` from now — bracket
+        legitimately-long between-step work (rollback's iterator replay,
+        checkpoint writes, epoch-boundary validation) without going blind:
+        a genuine hang inside that work still fires, just later. A watchdog
+        that was never armed (no step yet) stays unarmed."""
+        with self._lock:
+            if self._last is not None:
+                self._last = time.monotonic() + (self.GRACE - 1.0) \
+                    * self.timeout_s
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+
+    def _can_interrupt(self):
+        if self._target is not threading.main_thread():
+            return False
+        try:
+            return signal.getsignal(signal.SIGINT) is signal.default_int_handler
+        except (ValueError, TypeError):
+            return False
+
+    def _loop(self):
+        poll = max(min(self.timeout_s / 4.0, 1.0), 0.01)
+        while True:
+            time.sleep(poll)
+            with self._lock:
+                if self._stopped:
+                    return
+                if self._last is None:  # not armed yet
+                    continue
+                if time.monotonic() - self._last <= self.timeout_s:
+                    continue
+                self.fired = True
+                self._stopped = True  # fire once
+                interrupt = self._can_interrupt()
+            telemetry.counter("guard.stalls").inc()
+            self._dump()
+            if interrupt:
+                try:
+                    signal.pthread_kill(self._target.ident, signal.SIGINT)
+                except (OSError, ValueError):  # thread gone: flag-only
+                    pass
+            return
+
+    def _dump(self):
+        """Log WHERE the runtime is stuck: the engine/pipeline/KV state."""
+        state = telemetry.state_summary(
+            ("engine.", "pipeline.", "io.", "kvstore.", "fit.", "guard."))
+        self.logger.error(
+            "guard: no training step completed in %.1fs — stall. "
+            "Runtime state: %s", self.timeout_s, state)
+        telemetry.event("guard_stall", timeout_s=self.timeout_s, state=state)
+
+
+# ---------------------------------------------------------------------------
+# the guard
+# ---------------------------------------------------------------------------
+
+
+class TrainingGuard:
+    """The fit loop's health supervisor (see module docstring).
+
+    One instance guards one ``fit`` call; constructing it is cheap and does
+    not start the watchdog — :meth:`start`/:meth:`close` bracket the loop
+    (fit does this). Step protocol, in loop order::
+
+        guard.check_stall()                      # top of step
+        reason = guard.step_check(module)        # after forward_backward
+        if reason is None: module.update()
+        reason = reason or guard.post_check(module)
+        if reason is None:
+            guard.good_step(module, it, epoch, nbatch, iter_state)
+        else:
+            action = guard.bad_step(reason, epoch, nbatch)  # skip/rollback/abort
+    """
+
+    def __init__(self, policy=None, logger=None):
+        self.policy = policy or GuardPolicy()
+        self.logger = logger or logging.getLogger(__name__)
+        self.sentinel = Sentinel(self.policy.spike_factor,
+                                 self.policy.warmup_steps)
+        self._watchdog = None
+        self._snapshot = None
+        self._consecutive_bad = 0
+        self._good_since_snapshot = 0
+        self._good_since_checkpoint = 0
+        self.bad_steps = 0
+        self.rollbacks = 0
+        self._stall_raised = False
+        self._default_prefix = None  # per-fit fallback, set by resolve()
+        self._ckpt_unsupported = False  # this module can't save_checkpoint
+
+    @property
+    def checkpoint_prefix(self):
+        """Where mid-epoch checkpoints go: the policy's explicit prefix,
+        else the current fit's ``auto_resume`` prefix."""
+        return self.policy.checkpoint_prefix or self._default_prefix
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self):
+        if self.policy.stall_timeout_s <= 0:
+            return
+        if self._watchdog is None or self._watchdog.fired:
+            # a fired watchdog from a previous fit is replaced (and its
+            # sticky stall state cleared) so the new fit gets live stall
+            # protection and a real Ctrl-C can't be misread as that old
+            # stall
+            self._watchdog = _Watchdog(self.policy.stall_timeout_s,
+                                       self.logger)
+            self._stall_raised = False
+
+    def close(self):
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            # a guard reused by a later fit() gets a fresh watchdog — but
+            # a fired one stays visible through stall_fired until then
+            if not self._watchdog.fired:
+                self._watchdog = None
+
+    def suspend_watchdog(self):
+        """Disarm the watchdog until the next completed step beats it —
+        fit brackets epoch-boundary work (validation, checkpoint callbacks,
+        iterator reset) with this so none of it can read as a stall."""
+        if self._watchdog is not None:
+            self._watchdog.suspend()
+
+    @property
+    def stall_fired(self):
+        return self._watchdog is not None and self._watchdog.fired
+
+    def check_stall(self):
+        """Raise :class:`StallError` when the watchdog has fired — the
+        flag-only delivery path for non-main fit threads (the signal path
+        raises through ``fit``'s KeyboardInterrupt translation)."""
+        if self.stall_fired and not self._stall_raised:
+            self._stall_raised = True
+            raise StallError(
+                "no training step completed within MXNET_GUARD_STALL_S="
+                "%gs (telemetry state was dumped to the log)"
+                % self.policy.stall_timeout_s)
+
+    def stall_error(self):
+        """The classified error fit raises when the watchdog's interrupt
+        surfaced as KeyboardInterrupt."""
+        self._stall_raised = True
+        return StallError(
+            "training stalled: no step completed within "
+            "MXNET_GUARD_STALL_S=%gs (telemetry state was dumped to the "
+            "log)" % self.policy.stall_timeout_s)
+
+    # ---- sentinel hooks --------------------------------------------------
+    def step_check(self, module):
+        """Pre-update sentinel: classify this step's loss/gradients. Returns
+        a bad-step reason or None. On the fused SPMD path nothing is
+        observable before update() — :meth:`post_check` covers it."""
+        if self.policy.policy == "off":
+            return None
+        fused = getattr(module, "_fused", None)
+        if fused is not None and fused.pending:
+            # fused path, step not executed yet: nothing observable, and
+            # the `nan` injection point must NOT be consumed here — its
+            # times= budget belongs to post_check, the hook that can
+            # actually classify on this path
+            return None
+        from . import fault
+
+        args = fault.hit("nan")
+        poisoned_marker = False
+        if args is not None:
+            target = args.get("target", "grad")
+            if target == "loss" or not _poison_grads(module):
+                poisoned_marker = True  # no grad to poison: flag the loss
+        obs = _module_observables(module)
+        if obs is None:
+            return None
+        loss, gnorm = self.sentinel.measure(obs)
+        if poisoned_marker:
+            loss = float("nan")
+        return self.sentinel.classify(loss, gnorm)
+
+    def post_check(self, module):
+        """Post-update sentinel for the fused path (fwd+bwd+update ran as
+        one program): checks the now-materialized outputs. A bad step here
+        already touched the params, so ``skip`` cannot protect — the ladder
+        escalates through rollback, which can."""
+        if self.policy.policy == "off":
+            return None
+        fused = getattr(module, "_fused", None)
+        if fused is None or not fused.has_outputs:
+            return None  # classic path: step_check already measured
+        from . import fault
+
+        obs = _module_observables(module, want_grads=False)
+        if not obs:
+            return None
+        loss, _ = self.sentinel.measure(obs)
+        if fault.hit("nan") is not None:
+            # the fused-path consumer of the `nan` injection point (grads
+            # are folded into the one SPMD program; flag the loss instead)
+            loss = float("nan")
+        return self.sentinel.classify(loss, None)
+
+    # ---- step outcomes ---------------------------------------------------
+    def good_step(self, module, train_data, epoch, nbatch, iter_state=None):
+        """Record a healthy step: heartbeat, ladder reset, and the periodic
+        snapshot/checkpoint cadence. ``iter_state`` is the iterator's
+        ``state_dict()`` captured when THIS step's batch was fetched."""
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        self._consecutive_bad = 0
+        p = self.policy
+        if p.policy == "rollback":
+            self._good_since_snapshot += 1
+            if p.snapshot_every and \
+                    self._good_since_snapshot >= p.snapshot_every:
+                self.take_snapshot(module, train_data, epoch, nbatch + 1,
+                                   iter_state)
+        if self.checkpoint_prefix and p.checkpoint_every \
+                and not self._ckpt_unsupported:
+            self._good_since_checkpoint += 1
+            if self._good_since_checkpoint >= p.checkpoint_every:
+                self._good_since_checkpoint = 0
+                self._write_checkpoint(module, epoch, nbatch + 1, iter_state)
+
+    def bad_step(self, reason, epoch, nbatch, applied=False):
+        """Count a bad step and decide the ladder action:
+        ``skip`` | ``rollback`` | ``abort``.
+
+        ``applied``: the bad update already reached the parameters (the
+        fused SPMD path, where detection is post-step). Skipping is
+        meaningless there — the params are poisoned and every later step
+        will classify bad — so the ``skip`` policy escalates to abort after
+        ``max_bad_steps`` consecutive applied-bad steps instead of burning
+        the budget (and overwriting good checkpoints) forever; ``rollback``
+        heals it through the normal ladder."""
+        if self._watchdog is not None:
+            # a bad step that COMPLETED is progress, not a stall: a long
+            # NaN streak under the skip policy must not trip the watchdog
+            self._watchdog.beat()
+        self.bad_steps += 1
+        self._consecutive_bad += 1
+        telemetry.counter("guard.bad_steps", reason=reason).inc()
+        telemetry.event("guard_bad_step", reason=reason, epoch=epoch,
+                        nbatch=nbatch, applied=bool(applied))
+        p = self.policy
+        if p.policy == "abort":
+            action = "abort"
+        elif p.policy == "skip":
+            if applied and self._consecutive_bad >= p.max_bad_steps:
+                self.logger.error(
+                    "guard: %d consecutive bad steps whose updates were "
+                    "already applied (fused path) — skip cannot protect "
+                    "the parameters here; aborting (use policy 'rollback' "
+                    "to heal applied bad updates)", self._consecutive_bad)
+                action = "abort"
+            else:
+                action = "skip"
+        elif self._consecutive_bad < p.max_bad_steps:
+            action = "skip"
+        elif self._snapshot is None:
+            self.logger.error(
+                "guard: %d consecutive bad steps and no snapshot to roll "
+                "back to — aborting", self._consecutive_bad)
+            action = "abort"
+        elif self.rollbacks >= p.max_rollbacks:
+            self.logger.error(
+                "guard: still diverging after %d rollbacks — aborting",
+                self.rollbacks)
+            action = "abort"
+        else:
+            action = "rollback"
+        self.logger.warning(
+            "guard: bad step at epoch %d batch %d (%s) — %s "
+            "(%d consecutive)", epoch, nbatch, reason, action,
+            self._consecutive_bad)
+        return action
+
+    def abort_error(self, reason, epoch, nbatch):
+        return BadStepError(
+            "training health guard aborted at epoch %d batch %d: %s "
+            "(%d bad steps total, %d rollbacks; policy %r)"
+            % (epoch, nbatch, reason, self.bad_steps, self.rollbacks,
+               self.policy.policy))
+
+    # ---- snapshots + rollback -------------------------------------------
+    def epoch_start(self, module, train_data, epoch, nbatch=0):
+        """Epoch-boundary snapshot (rollback policy) + cadence reset.
+        ``nbatch`` is nonzero when a mid-epoch resume fast-forwarded the
+        iterator before the epoch began."""
+        self._good_since_snapshot = 0
+        if self.policy.policy == "rollback":
+            self.take_snapshot(module, train_data, epoch, nbatch,
+                               _iter_state(train_data))
+
+    def take_snapshot(self, module, train_data, epoch, nbatch,
+                      iter_state=None):
+        """Capture the complete in-memory restore point: host copies of
+        every parameter, optimizer state bytes + schedule counts, the
+        iterator position, and the numpy RNG."""
+        arg, aux = module.get_params()
+        self._snapshot = _Snapshot(
+            epoch, nbatch,
+            {k: v.asnumpy().copy() for k, v in arg.items()},
+            {k: v.asnumpy().copy() for k, v in (aux or {}).items()},
+            _opt_state_bytes(module), _optimizer_counts(module),
+            iter_state if iter_state is not None else _iter_state(train_data),
+            np.random.get_state())
+        self._good_since_snapshot = 0
+
+    def rollback(self, module, train_data):
+        """Restore the last good snapshot. Returns ``(epoch, nbatch,
+        iter_restored)`` — fit restarts its inner loop there. When the
+        iterator cannot seek (no ``load_state``), params/optimizer still
+        roll back and training continues from the CURRENT position (the
+        skipped span is logged)."""
+        from . import ndarray as nd
+
+        snap = self._snapshot
+        assert snap is not None
+        if self._watchdog is not None:
+            # restoring params and replaying the iterator to the snapshot
+            # position can legitimately exceed the stall deadline; disarm
+            # until the first post-rollback step beats again
+            self._watchdog.suspend()
+        self.rollbacks += 1
+        telemetry.counter("guard.rollbacks").inc()
+        module.set_params(
+            {k: nd.array(v) for k, v in snap.arg.items()},
+            {k: nd.array(v) for k, v in snap.aux.items()},
+            force_init=True)
+        if snap.opt_bytes is not None:
+            if not _set_opt_state_bytes(module, snap.opt_bytes):
+                self.logger.warning(
+                    "guard: optimizer state lives on the kvstore — rollback "
+                    "restored parameters only")
+        _restore_optimizer_counts(module, snap.opt_counts)
+        iter_restored = False
+        if snap.iter_state is not None and \
+                getattr(train_data, "load_state", None) is not None:
+            try:
+                train_data.load_state(snap.iter_state)
+                iter_restored = True
+            except Exception as exc:  # noqa: BLE001 — a seek failure must
+                # degrade to forward-only recovery, not kill the rollback
+                self.logger.warning(
+                    "guard: iterator load_state failed (%s); continuing "
+                    "from the current position", exc)
+        np.random.set_state(snap.rng)
+        self._consecutive_bad = 0
+        self.logger.warning(
+            "guard: rolled back to epoch %d batch %d (rollback %d/%d, "
+            "iterator %s)", snap.epoch, snap.nbatch, self.rollbacks,
+            self.policy.max_rollbacks,
+            "restored" if iter_restored else "NOT restored")
+        telemetry.event("guard_rollback", epoch=snap.epoch,
+                        nbatch=snap.nbatch, iter_restored=iter_restored)
+        return snap.epoch, snap.nbatch, iter_restored
+
+    # ---- mid-epoch disk checkpoints -------------------------------------
+    def _write_checkpoint(self, module, epoch, nbatch, iter_state):
+        """An ordinary PR-1 checkpoint named with the COMPLETED-epoch count
+        plus the ``.resume`` sidecar that makes it land mid-epoch."""
+        from . import model as model_mod
+
+        if not hasattr(module, "save_checkpoint"):
+            # disable on THIS guard only — never by zeroing the caller's
+            # (possibly shared) policy object
+            self._ckpt_unsupported = True
+            self.logger.warning(
+                "guard: %s has no save_checkpoint — mid-epoch checkpoints "
+                "disabled", type(module).__name__)
+            return
+        if self._watchdog is not None:
+            # a large checkpoint write between steps is not a stall
+            self._watchdog.suspend()
+        prefix = self.checkpoint_prefix
+        try:
+            module.save_checkpoint(prefix, epoch, save_optimizer_states=True)
+            model_mod.save_resume_state(
+                prefix, epoch,
+                nbatch=nbatch, iter_state=iter_state,
+                numpy_rng=np.random.get_state(),
+                optimizer_counts=_optimizer_counts(module))
+        except Exception as exc:  # noqa: BLE001 — a failing checkpoint sink
+            # (disk full, prefix dir gone) must not kill a healthy training
+            # loop; the always-on counter + log make it visible
+            telemetry.counter("guard.checkpoint_errors").inc()
+            self.logger.error("guard: mid-epoch checkpoint failed: %s", exc)

@@ -161,8 +161,24 @@ def _observe_fetch(iterator, t0):
             time.perf_counter() - t0)
 
 
+def _state_of(data_iter):
+    """``state_dict()`` of an iterator, or ``None`` when unsupported."""
+    fn = getattr(data_iter, "state_dict", None)
+    return fn() if fn is not None else None
+
+
 class DataIter:
-    """Base iterator (reference: io.py:103)."""
+    """Base iterator (reference: io.py:103).
+
+    **Position protocol** (docs/fault_tolerance.md §health-guard):
+    ``state_dict()`` returns a JSON-able snapshot of the iterator's position
+    such that ``load_state(state)`` repositions it to yield EXACTLY the
+    batches that would have followed — the contract behind exact mid-epoch
+    resume and guard rollback. The convention: a state captured right after
+    ``next()`` returned batch *n* resumes at batch *n+1*. Iterators that
+    cannot seek return ``None`` (the base default); consumers degrade to
+    epoch-boundary positioning.
+    """
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
@@ -172,6 +188,16 @@ class DataIter:
 
     def reset(self):
         pass
+
+    def state_dict(self):
+        """Resumable position snapshot, or ``None`` when this iterator
+        cannot seek (see class docstring)."""
+        return None
+
+    def load_state(self, state):
+        """Reposition to ``state`` (from :meth:`state_dict`)."""
+        raise MXNetError("%s does not support load_state"
+                         % type(self).__name__)
 
     def next(self):
         tel = telemetry.enabled()
@@ -225,6 +251,17 @@ class ResizeIter(DataIter):
         if self.reset_internal:
             self.data_iter.reset()
 
+    def state_dict(self):
+        inner = _state_of(self.data_iter)
+        if inner is None:
+            return None
+        return {"type": "ResizeIter", "cur": self.cur, "inner": inner}
+
+    def load_state(self, state):
+        self.data_iter.load_state(state["inner"])
+        self.cur = int(state["cur"])
+        self.current_batch = None
+
     def iter_next(self):
         if self.cur == self.size:
             return False
@@ -275,6 +312,13 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        # position protocol (DataIter.state_dict): the producers run one
+        # batch ahead, so the inner state is snapshotted per PRODUCED batch
+        # and promoted to _delivered_states only when the consumer takes it
+        # — state_dict() then describes the batches actually delivered, not
+        # the prefetch horizon
+        self.next_state = [None for _ in range(self.n_iter)]
+        self._delivered_states = [_state_of(i) for i in self.iters]
 
         def prefetch_func(self, i):
             while True:
@@ -283,6 +327,7 @@ class PrefetchingIter(DataIter):
                     break
                 try:
                     self.next_batch[i] = self.iters[i].next()
+                    self.next_state[i] = _state_of(self.iters[i])
                 except StopIteration:
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
@@ -337,6 +382,27 @@ class PrefetchingIter(DataIter):
             e.wait()
         for i in self.iters:
             i.reset()
+        self._delivered_states = [_state_of(i) for i in self.iters]
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def state_dict(self):
+        states = list(self._delivered_states)
+        if any(s is None for s in states):
+            return None
+        return {"type": "PrefetchingIter", "inner": states}
+
+    def load_state(self, state):
+        # same dance as reset(): park the producers (data_ready set, taken
+        # clear), reposition the inner iterators, discard the prefetched
+        # batches (produced from the pre-restore position), release
+        for e in self.data_ready:
+            e.wait()
+        for it, s in zip(self.iters, state["inner"]):
+            it.load_state(s)
+        self._delivered_states = list(state["inner"])
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
@@ -359,6 +425,7 @@ class PrefetchingIter(DataIter):
             provide_data=self.provide_data,
             provide_label=self.provide_label,
         )
+        self._delivered_states = list(self.next_state)
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
@@ -444,6 +511,13 @@ class DeviceFeedIter(DataIter):
         blocks this (background) thread until the device owns the data."""
         import jax
 
+        from . import fault
+
+        # `stall` injection point (docs/fault_tolerance.md): delay_ms here
+        # wedges the transfer stage past the guard's watchdog deadline, so
+        # stall detection is testable without a real device hang
+        fault.hit("stall")
+
         def _up(arrs):
             if not arrs:
                 return arrs
@@ -477,6 +551,11 @@ class DeviceFeedIter(DataIter):
             while not stop.is_set():
                 try:
                     batch = self._iter.next()
+                    # inner position AFTER this batch, captured on the
+                    # producer side and promoted to _last_state when the
+                    # consumer takes the batch — state_dict() then reflects
+                    # delivered batches, not the in-flight queue depth
+                    inner_state = _state_of(self._iter)
                 except StopIteration:
                     break
                 tel = telemetry.enabled()
@@ -485,7 +564,7 @@ class DeviceFeedIter(DataIter):
                 if tel:
                     telemetry.pipeline_stage("upload").observe(
                             time.perf_counter() - t0)
-                if not self._put(q, stop, staged):
+                if not self._put(q, stop, ("batch", staged, inner_state)):
                     return
                 gauge.set(q.qsize())
         except Exception as e:  # noqa: BLE001 — surface on the consumer side
@@ -505,6 +584,9 @@ class DeviceFeedIter(DataIter):
 
     def _start(self):
         _LIVE_FEEDS.add(self)
+        # position of the inner iterator as of the batches DELIVERED so far;
+        # captured before the feeder starts pulling ahead of the consumer
+        self._last_state = _state_of(self._iter)
         self._q = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -529,7 +611,7 @@ class DeviceFeedIter(DataIter):
             except queue.Full:
                 pass
             raise StopIteration
-        if isinstance(item, tuple) and len(item) == 2 and item[0] == "error":
+        if item[0] == "error":
             # after surfacing the fault, later next() calls terminate instead
             # of blocking on a queue whose producer is gone
             try:
@@ -537,7 +619,23 @@ class DeviceFeedIter(DataIter):
             except queue.Full:
                 pass
             raise item[1]
-        return item
+        _, staged, inner_state = item
+        self._last_state = inner_state
+        return staged
+
+    def state_dict(self):
+        """Pass-through position: the INNER iterator's state as of the last
+        batch this feed delivered (in-flight queued batches — fetched ahead
+        but not yet consumed — are deliberately not counted; a resume
+        re-fetches them)."""
+        if self._last_state is None:
+            return None
+        return {"type": "DeviceFeedIter", "inner": self._last_state}
+
+    def load_state(self, state):
+        self.close()
+        self._iter.load_state(state["inner"])
+        self._start()
 
     def close(self):
         """Stop the transfer thread (terminal: ``next()`` raises)."""
@@ -697,6 +795,17 @@ class NDArrayIter(DataIter):
         else:
             self.cursor = -self.batch_size
 
+    def state_dict(self):
+        # the cursor IS the position: captured after batch n it sits at
+        # n*batch_size, and the next iter_next() advances to batch n+1 —
+        # exactly the resume contract. The backing arrays are the caller's;
+        # a restored process must rebuild them identically (same data, same
+        # shuffle seed) for byte-exact resume.
+        return {"type": "NDArrayIter", "cursor": int(self.cursor)}
+
+    def load_state(self, state):
+        self.cursor = int(state["cursor"])
+
     def iter_next(self):
         self.cursor += self.batch_size
         return self.cursor < self.num_data
@@ -811,6 +920,12 @@ class MNISTIter(DataIter):
     def reset(self):
         self._iter.reset()
 
+    def state_dict(self):
+        return _state_of(self._iter)
+
+    def load_state(self, state):
+        self._iter.load_state(state)
+
     def next(self):
         return self._iter.next()
 
@@ -858,6 +973,12 @@ class CSVIter(DataIter):
 
     def reset(self):
         self._iter.reset()
+
+    def state_dict(self):
+        return _state_of(self._iter)
+
+    def load_state(self, state):
+        self._iter.load_state(state)
 
     def next(self):
         return self._iter.next()

@@ -128,7 +128,16 @@ class ImageRecordIter(DataIter):
         else:
             self.provide_label = [DataDesc(label_name, (batch_size,))]
         self._epoch = 0
+        self._batches = 0  # batches emitted this epoch (the resume position)
         self._skipped = 0  # corrupt/undecodable records dropped (logged)
+        # bad-record budget (docs/env_var.md MXNET_IO_MAX_BAD_RECORDS):
+        # unset keeps the legacy skip-forever behavior; set to N, the
+        # iterator fails fast once more than N records were quarantined —
+        # a systematically-corrupt dataset should kill the job, not
+        # silently train on whatever still decodes
+        from .base import env_int
+
+        self._max_bad = env_int("MXNET_IO_MAX_BAD_RECORDS", None)
         self._start_pipeline()
 
     def _supports_wire(self):
@@ -146,6 +155,13 @@ class ImageRecordIter(DataIter):
         and flat label row). Runs on a decode worker thread (``rng``: that
         worker's seeded random.Random); ImageDetRecordIter overrides with
         the box-aware pipeline."""
+        from . import fault
+
+        # `bad_record` injection point (docs/fault_tolerance.md): makes this
+        # record undecodable so the quarantine/budget path is testable
+        # without shipping a corrupt .rec file
+        if fault.hit("bad_record") is not None:
+            raise MXNetError("injected bad record")
         header, img = recordio.unpack(s)
         if use_np:
             data = imdecode_np(img)
@@ -267,15 +283,25 @@ class ImageRecordIter(DataIter):
                             decode_hist.observe(time.perf_counter() - t0)
                         _put(self._decoded_q, (seq, arr, label))
                     except Exception as e:  # noqa: BLE001 — corrupt record:
-                        # skip, but still claim the seq so reassembly can't
-                        # stall; count + log so systematic failures (every
-                        # record bad -> empty iterator) are diagnosable
+                        # quarantine: skip, but still claim the seq so
+                        # reassembly can't stall; count + log so systematic
+                        # failures (every record bad -> empty iterator) are
+                        # diagnosable, and fail fast past the budget
                         n = self._skipped
                         self._skipped = n + 1
+                        telemetry.counter("io.bad_records",
+                                          source="decode").inc()
                         if n < 5 or n % 1000 == 0:
                             logging.warning(
                                 "ImageRecordIter: skipping record %d (%s: %s); "
                                 "%d skipped so far", seq, type(e).__name__, e, n + 1)
+                        if self._max_bad is not None and n + 1 > self._max_bad:
+                            _put(self._out_q, ("error", MXNetError(
+                                "ImageRecordIter: %d corrupt records exceed "
+                                "MXNET_IO_MAX_BAD_RECORDS=%d (last: %s: %s)"
+                                % (n + 1, self._max_bad,
+                                   type(e).__name__, e))))
+                            return
                         _put(self._decoded_q, (seq, None, None))
             finally:
                 # sentinel posts even if the thread dies, so the batcher's
@@ -436,13 +462,47 @@ class ImageRecordIter(DataIter):
     def reset(self):
         self.close()
         self._epoch += 1
+        self._batches = 0
         self._start_pipeline()
 
-    def next(self):
+    def _next_item(self):
+        """One raw ``(data, label, pad)`` from the pipeline; raises
+        StopIteration at end-of-stream and re-raises a pipeline error item
+        (bad-record budget exceeded) on the consumer thread."""
         item = self._out_q.get()
         if item is None:
             raise StopIteration
-        data, label, pad = item
+        if len(item) == 2 and item[0] == "error":
+            # terminal: later next() calls must stop, not block on a
+            # pipeline whose workers bailed out
+            try:
+                self._out_q.put_nowait(None)
+            except queue.Full:
+                pass
+            raise item[1]
+        self._batches += 1
+        return item
+
+    def state_dict(self):
+        """Resume position: the deterministic record stream is a function of
+        (seed, epoch); the batch count within it completes the address."""
+        return {"type": "ImageRecordIter", "epoch": self._epoch,
+                "batches": self._batches}
+
+    def load_state(self, state):
+        """Reposition by rebuilding the (seed, epoch) pipeline and
+        fast-forwarding ``batches`` batches through it. Decode-and-discard
+        is deliberate: skipping raw records instead would drift by however
+        many corrupt records the workers quarantined."""
+        self.close()
+        self._epoch = int(state["epoch"])
+        self._batches = 0
+        self._start_pipeline()
+        for _ in range(int(state["batches"])):
+            self.next()
+
+    def next(self):
+        data, label, pad = self._next_item()
         label_out = label if self.label_width > 1 else label[:, 0]
         # nd.array preserves numpy dtype: a wire batch ships uint8 over the
         # host->device link; provide_data stays the post-decode descriptor
@@ -567,10 +627,7 @@ class ImageDetRecordIter(ImageRecordIter):
         return arr, padded.reshape(-1)
 
     def next(self):
-        item = self._out_q.get()
-        if item is None:
-            raise StopIteration
-        data, label, pad = item
+        data, label, pad = self._next_item()
         boxes = label.reshape(label.shape[0], self.max_objects,
                               self.object_width)
         return DataBatch(

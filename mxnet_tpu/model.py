@@ -8,6 +8,7 @@ Checkpoint format preserved: ``prefix-symbol.json`` (Symbol.tojson) +
 from __future__ import annotations
 
 import logging
+import os
 from collections import namedtuple
 
 import numpy as np
@@ -21,7 +22,8 @@ from .base import MXNetError
 from .context import cpu, current_context
 
 __all__ = ["FeedForward", "save_checkpoint", "load_checkpoint",
-           "load_latest_valid_checkpoint", "BatchEndParam"]
+           "load_latest_valid_checkpoint", "save_resume_state",
+           "load_resume_state", "BatchEndParam"]
 
 BatchEndParam = namedtuple("BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
 
@@ -106,6 +108,10 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
     nd.save(param_name, save_dict)
+    # an epoch-boundary save over a guard mid-epoch checkpoint of the same
+    # epoch number must retire the stale .resume sidecar, or auto_resume
+    # would fast-forward into data these params never saw
+    clear_resume_state(prefix, epoch)
     logging.info('Saved checkpoint to "%s"', param_name)
 
 
@@ -184,6 +190,108 @@ def load_latest_valid_checkpoint(prefix):
     return None
 
 
+# ---------------------------------------------------------------------------
+# mid-epoch resume sidecar (docs/fault_tolerance.md §health-guard)
+#
+# A checkpoint file's epoch number counts COMPLETED epochs; the optional
+# `prefix-EPOCH.resume` sidecar adds the position WITHIN the epoch in
+# progress (batches consumed, iterator state_dict, numpy RNG, optimizer step
+# counts), so fit(auto_resume=...) lands on the exact next batch instead of
+# replaying the epoch. The format stays backward/forward compatible both
+# ways: old checkpoints have no sidecar and resume at the epoch boundary
+# exactly as before; the sidecar is JSON the reference never reads.
+# ---------------------------------------------------------------------------
+
+_RESUME_VERSION = 1
+
+
+def _resume_name(prefix, epoch):
+    return "%s-%04d.resume" % (prefix, epoch)
+
+
+def _encode_rng(state):
+    """np.random.get_state() tuple -> JSON-able dict (MT19937 only)."""
+    if state is None:
+        return None
+    algo, keys, pos, has_gauss, cached = state
+    return {"algo": str(algo), "keys": [int(k) for k in keys],
+            "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached": float(cached)}
+
+
+def decode_rng(enc):
+    """The inverse of the sidecar's RNG encoding, ready for
+    ``np.random.set_state``; ``None`` passes through."""
+    if enc is None:
+        return None
+    return (enc["algo"], np.array(enc["keys"], dtype=np.uint32),
+            int(enc["pos"]), int(enc["has_gauss"]), float(enc["cached"]))
+
+
+def save_resume_state(prefix, epoch, nbatch, iter_state=None, numpy_rng=None,
+                      optimizer_counts=None):
+    """Write the mid-epoch ``.resume`` sidecar next to ``prefix-EPOCH.params``.
+
+    Must be called AFTER the params file is written: the sidecar records the
+    params file's footer CRC, and a loader ignores any sidecar whose CRC
+    does not match the params beside it — so a crash between the two writes
+    degrades to epoch-boundary resume instead of fast-forwarding params
+    that never saw those batches."""
+    import json
+
+    from .utils.atomic_file import atomic_write, footer_crc
+
+    crc = footer_crc("%s-%04d.params" % (prefix, epoch))
+    rec = {"version": _RESUME_VERSION, "epoch": int(epoch),
+           "nbatch": int(nbatch), "params_crc": crc,
+           "iter_state": iter_state, "numpy_rng": _encode_rng(numpy_rng),
+           "optimizer_counts": optimizer_counts}
+    with atomic_write(_resume_name(prefix, epoch), checksum=False) as f:
+        f.write(json.dumps(rec))
+
+
+def load_resume_state(prefix, epoch):
+    """The validated mid-epoch resume dict for ``prefix-EPOCH.params``, or
+    ``None`` (no sidecar / unreadable / version or CRC mismatch — every
+    failure degrades to the epoch-boundary resume, logged)."""
+    import json
+
+    from .utils.atomic_file import footer_crc
+
+    name = _resume_name(prefix, epoch)
+    if not os.path.exists(name):
+        return None
+    try:
+        with open(name) as f:
+            rec = json.load(f)
+        if rec.get("version") != _RESUME_VERSION:
+            raise ValueError("unknown resume version %r" % rec.get("version"))
+        if int(rec["epoch"]) != int(epoch) or int(rec["nbatch"]) < 0:
+            raise ValueError("sidecar epoch/nbatch out of range")
+    except Exception as exc:  # noqa: BLE001 — any malformed sidecar degrades
+        logging.warning(
+            "auto-resume: ignoring unreadable resume sidecar %s (%s); "
+            "resuming at the epoch boundary", name, exc)
+        return None
+    crc = footer_crc("%s-%04d.params" % (prefix, epoch))
+    if rec.get("params_crc") is not None and rec["params_crc"] != crc:
+        logging.warning(
+            "auto-resume: resume sidecar %s does not match the params file "
+            "beside it (torn mid-epoch checkpoint?); resuming at the epoch "
+            "boundary", name)
+        return None
+    return rec
+
+
+def clear_resume_state(prefix, epoch):
+    """Delete a stale ``.resume`` sidecar (epoch-boundary saves call this so
+    the sidecar can never outlive the mid-epoch params it described)."""
+    try:
+        os.remove(_resume_name(prefix, epoch))
+    except OSError:
+        pass
+
+
 class FeedForward:
     """Legacy estimator API (reference: model.py:387). Thin adapter over
     Module — the reference keeps it for pre-Module scripts; so do we."""
@@ -242,10 +350,10 @@ class FeedForward:
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
             eval_end_callback=None, eval_batch_end_callback=None,
-            auto_resume=None):
+            auto_resume=None, guard=None):
         """(reference: model.py FeedForward.fit — delegates the loop to Module).
         ``auto_resume``: checkpoint prefix to continue from the newest intact
-        epoch (see BaseModule.fit)."""
+        epoch; ``guard``: training health guard policy (see BaseModule.fit)."""
         from .module import Module
 
         data = self._prepare_iter(X, y, is_train=True)
@@ -264,7 +372,7 @@ class FeedForward:
             arg_params=self.arg_params, aux_params=self.aux_params,
             allow_missing=True, begin_epoch=self.begin_epoch,
             num_epoch=self.num_epoch, monitor=monitor,
-            auto_resume=auto_resume,
+            auto_resume=auto_resume, guard=guard,
         )
         self.arg_params, self.aux_params = mod.get_params()
         self._module = mod

@@ -147,7 +147,7 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
-            auto_resume=None):
+            auto_resume=None, guard=None):
         """Train (reference: base_module.py:375-533).
 
         ``auto_resume`` is a checkpoint prefix (the one passed to
@@ -155,16 +155,32 @@ class BaseModule:
         the newest *intact* epoch under that prefix — corrupt or torn files
         from a crash mid-save are CRC-detected and skipped — loads its
         params, and fast-forwards ``begin_epoch``, so a killed-and-relaunched
-        training job continues instead of restarting. With no loadable
-        checkpoint it trains from scratch."""
+        training job continues instead of restarting. When the checkpoint
+        carries a ``.resume`` sidecar (written by the health guard's
+        mid-epoch checkpoints), the data iterator, numpy RNG, and optimizer
+        schedule are ALSO restored and training lands on the exact next
+        batch; checkpoints without one (every pre-guard file) resume at the
+        epoch boundary as before. With no loadable checkpoint it trains
+        from scratch.
+
+        ``guard`` enables the training health guard
+        (docs/fault_tolerance.md §health-guard): ``None`` defers to
+        ``MXNET_GUARD_POLICY``/``MXNET_GUARD_STALL_S`` (off when unset — the
+        zero-overhead default), or pass a policy name
+        (``'skip'``/``'rollback'``/``'abort'``), a ``guard_mod.GuardPolicy``,
+        or a ready ``TrainingGuard``. An active guard classifies each step's
+        loss/grad health, skips or rolls back bad updates per its ladder,
+        and its stall watchdog turns a hung step into a ``StallError``."""
+        from .. import guard as guard_mod
         from .. import initializer as init_mod
 
         assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
             initializer = init_mod.Uniform(0.01)
         resume_epoch = None
+        resume_state = None
         if auto_resume is not None:
-            from ..model import load_latest_valid_checkpoint
+            from ..model import load_latest_valid_checkpoint, load_resume_state
 
             ckpt = load_latest_valid_checkpoint(auto_resume)
             if ckpt is not None:
@@ -173,9 +189,22 @@ class BaseModule:
                 # (callback._every saves iter_no+1), so resuming at index
                 # resume_epoch repeats nothing and skips nothing
                 begin_epoch = max(begin_epoch, resume_epoch)
+                # mid-epoch sidecar (guard checkpoints): nbatch/iterator/RNG
+                # position within epoch `resume_epoch`; None for plain
+                # epoch-boundary checkpoints or any validation failure.
+                # Only meaningful when training actually restarts at that
+                # epoch — a caller-raised begin_epoch must not fast-forward
+                # a LATER epoch by the sidecar's batch count.
+                if begin_epoch == resume_epoch:
+                    resume_state = load_resume_state(auto_resume,
+                                                     resume_epoch)
                 self.logger.info(
                     "auto-resume: restored '%s' epoch %d, continuing at "
-                    "epoch %d", auto_resume, resume_epoch, begin_epoch)
+                    "epoch %d%s", auto_resume, resume_epoch, begin_epoch,
+                    " batch %d (exact mid-epoch resume)"
+                    % resume_state["nbatch"] if resume_state else "")
+        guard_obj = guard_mod.resolve(guard, checkpoint_prefix=auto_resume,
+                                      logger=self.logger)
         # opt-in double-buffered async device feed (docs/env_var.md
         # MXNET_FEED_DEPTH): a dedicated transfer thread keeps the next
         # batch(es) device-resident so the loop's data wait is a queue pop.
@@ -225,6 +254,18 @@ class BaseModule:
                         self.logger.warning(
                             "auto-resume: ignoring unloadable optimizer states "
                             "%s: %s", states, exc)
+            if resume_state is not None:
+                # exact mid-epoch resume: put the numpy RNG and the
+                # optimizer's schedule position (num_update, per-index t)
+                # back where the sidecar captured them — the .states file
+                # restored above carries the moments but not these counts
+                from ..model import decode_rng
+
+                rng = decode_rng(resume_state.get("numpy_rng"))
+                if rng is not None:
+                    np.random.set_state(rng)
+                guard_mod._restore_optimizer_counts(
+                    self, resume_state.get("optimizer_counts"))
             if validation_metric is None:
                 validation_metric = eval_metric
             if not isinstance(eval_metric, metric_mod.EvalMetric):
@@ -243,72 +284,144 @@ class BaseModule:
             fit_instruments = None  # stable handles, resolved once when enabled:
             # re-resolving through the registry every batch would take the
             # global lock and re-render keys 6x per step for nothing
+            if guard_obj is not None:
+                guard_obj.start()
+            # with a guard: remember the iterator position as of each
+            # fetched batch (the resume contract, io.DataIter.state_dict) so
+            # snapshots/checkpoints taken after step n restore to batch n+1
+            # even though the loop prefetches n+1 before step n finishes
+            _state_fn = getattr(train_data, "state_dict", None)
+            track_state = guard_obj is not None and _state_fn is not None
             for epoch in range(begin_epoch, num_epoch):
                 tic = time.time()
                 telemetry.event("epoch_start", epoch=epoch)
                 eval_metric.reset()
-                nbatch = 0
-                data_iter = iter(train_data)
-                end_of_batch = False
-                tel = telemetry.enabled()
-                t0 = time.perf_counter() if tel else 0.0
-                next_data_batch = next(data_iter)
-                if tel:
-                    telemetry.histogram("fit.data_wait_seconds").observe(
-                        time.perf_counter() - t0)
-                while not end_of_batch:
-                    data_batch = next_data_batch
+                start_nbatch = 0
+                if resume_state is not None and epoch == begin_epoch:
+                    start_nbatch = self._resume_fast_forward(
+                        train_data, resume_state)
+                    resume_state = None  # consumed: later epochs start fresh
+                if guard_obj is not None:
+                    guard_obj.epoch_start(self, train_data, epoch,
+                                          start_nbatch)
+                while True:  # restarted when the guard rolls back mid-epoch
+                    rolled_back = False
+                    nbatch = start_nbatch
+                    data_iter = iter(train_data)
+                    end_of_batch = False
                     tel = telemetry.enabled()
-                    if tel and fit_instruments is None:
-                        fit_instruments = (
-                            telemetry.histogram("fit.compute_seconds"),
-                            telemetry.histogram("fit.data_wait_seconds"),
-                            telemetry.histogram("fit.step_time_seconds"),
-                            telemetry.counter("fit.batches"),
-                            telemetry.counter("fit.samples"),
-                            telemetry.gauge("fit.imgs_per_sec"),
-                        )
-                    t_step = time.perf_counter() if tel else 0.0
-                    if monitor is not None:
-                        monitor.tic()
-                    # span, not gated on `tel`: with the profiler running but
-                    # telemetry off, fit.step must still land on the chrome
-                    # trace (span() itself no-ops when BOTH are off)
-                    with telemetry.span("fit.step", "fit"):
-                        self.forward_backward(data_batch)
-                        self.update()
-                    t_compute = time.perf_counter() if tel else 0.0
+                    t0 = time.perf_counter() if tel else 0.0
                     try:
-                        # pre-fetch next batch to overlap host IO with device work
                         next_data_batch = next(data_iter)
-                        self.prepare(next_data_batch)
                     except StopIteration:
-                        end_of_batch = True
-                    t_data = time.perf_counter() if tel else 0.0
-                    self.update_metric(eval_metric, data_batch.label)
+                        # a mid-epoch resume can land exactly on the epoch's
+                        # end: nothing left to train here
+                        break
+                    next_state = _state_fn() if track_state else None
                     if tel:
-                        h_comp, h_wait, h_step, c_batch, c_samp, g_ips = \
-                            fit_instruments
-                        now = time.perf_counter()
-                        step_s = now - t_step
-                        h_comp.observe(t_compute - t_step)
-                        h_wait.observe(t_data - t_compute)
-                        h_step.observe(step_s)
-                        n = _batch_samples(data_batch, train_data)
-                        c_batch.inc()
-                        if n:
-                            c_samp.inc(n)
-                            if step_s > 0:
-                                g_ips.set(n / step_s)
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if batch_end_callback is not None:
-                        batch_end_params = BatchEndParam(
-                            epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals()
-                        )
-                        for callback in _as_list(batch_end_callback):
-                            callback(batch_end_params)
-                    nbatch += 1
+                        telemetry.histogram("fit.data_wait_seconds").observe(
+                            time.perf_counter() - t0)
+                    while not end_of_batch:
+                        data_batch = next_data_batch
+                        cur_state = next_state  # position as of THIS batch
+                        if guard_obj is not None:
+                            guard_obj.check_stall()
+                        tel = telemetry.enabled()
+                        if tel and fit_instruments is None:
+                            fit_instruments = (
+                                telemetry.histogram("fit.compute_seconds"),
+                                telemetry.histogram("fit.data_wait_seconds"),
+                                telemetry.histogram("fit.step_time_seconds"),
+                                telemetry.counter("fit.batches"),
+                                telemetry.counter("fit.samples"),
+                                telemetry.gauge("fit.imgs_per_sec"),
+                            )
+                        t_step = time.perf_counter() if tel else 0.0
+                        if monitor is not None:
+                            monitor.tic()
+                        # span, not gated on `tel`: with the profiler running but
+                        # telemetry off, fit.step must still land on the chrome
+                        # trace (span() itself no-ops when BOTH are off)
+                        bad_reason = None
+                        bad_applied = False
+                        with telemetry.span("fit.step", "fit"):
+                            self.forward_backward(data_batch)
+                            if guard_obj is not None:
+                                # sentinel BEFORE update: a bad classic-path
+                                # step is discarded with the params untouched
+                                bad_reason = guard_obj.step_check(self)
+                            if bad_reason is None:
+                                self.update()
+                                if guard_obj is not None:
+                                    # fused path: fwd+bwd+update ran as one
+                                    # program — outputs observable only now,
+                                    # with the update already applied
+                                    bad_reason = guard_obj.post_check(self)
+                                    bad_applied = bad_reason is not None
+                        t_compute = time.perf_counter() if tel else 0.0
+                        if bad_reason is not None:
+                            action = guard_obj.bad_step(bad_reason, epoch,
+                                                        nbatch,
+                                                        applied=bad_applied)
+                            if action == "abort":
+                                raise guard_obj.abort_error(bad_reason, epoch,
+                                                            nbatch)
+                            if action == "rollback":
+                                _, r_nbatch, iter_restored = \
+                                    guard_obj.rollback(self, train_data)
+                                # metric counts from the undone span are
+                                # wrong either way; restart it clean
+                                eval_metric.reset()
+                                start_nbatch = (r_nbatch if iter_restored
+                                                else nbatch + 1)
+                                rolled_back = True
+                                break
+                            # action == "skip": fall through — the bad
+                            # gradients are dropped (no update ran), the
+                            # batch still advances
+                        try:
+                            # pre-fetch next batch to overlap host IO with device work
+                            next_data_batch = next(data_iter)
+                            next_state = _state_fn() if track_state else None
+                            self.prepare(next_data_batch)
+                        except StopIteration:
+                            end_of_batch = True
+                        t_data = time.perf_counter() if tel else 0.0
+                        if bad_reason is None:
+                            self.update_metric(eval_metric, data_batch.label)
+                            if guard_obj is not None:
+                                guard_obj.good_step(self, train_data, epoch,
+                                                    nbatch, cur_state)
+                        if tel:
+                            h_comp, h_wait, h_step, c_batch, c_samp, g_ips = \
+                                fit_instruments
+                            now = time.perf_counter()
+                            step_s = now - t_step
+                            h_comp.observe(t_compute - t_step)
+                            h_wait.observe(t_data - t_compute)
+                            h_step.observe(step_s)
+                            n = _batch_samples(data_batch, train_data)
+                            c_batch.inc()
+                            if n:
+                                c_samp.inc(n)
+                                if step_s > 0:
+                                    g_ips.set(n / step_s)
+                        if monitor is not None:
+                            monitor.toc_print()
+                        if batch_end_callback is not None:
+                            batch_end_params = BatchEndParam(
+                                epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals()
+                            )
+                            for callback in _as_list(batch_end_callback):
+                                callback(batch_end_params)
+                        nbatch += 1
+                    if not rolled_back:
+                        break
+                if guard_obj is not None:
+                    # epoch-boundary work (validation score, checkpoint
+                    # callbacks, iterator reset) is not a stall however long
+                    # it takes; the first step of the next epoch re-arms
+                    guard_obj.suspend_watchdog()
                 # one epoch of training is finished
                 for name, val in eval_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -342,7 +455,17 @@ class BaseModule:
                 # finally immediately discards.
                 if _owned_feed is None or epoch < num_epoch - 1:
                     train_data.reset()
+        except KeyboardInterrupt:
+            # the stall watchdog interrupts a wedged step via SIGINT (the
+            # only signal that reaches a main thread blocked in a queue pop
+            # or device sync); translate it back into the classified error.
+            # A real Ctrl-C (watchdog never fired) re-raises untouched.
+            if guard_obj is not None and guard_obj.stall_fired:
+                raise guard_obj.stall_error() from None
+            raise
         finally:
+            if guard_obj is not None:
+                guard_obj.close()
             if _owned_feed is not None:
                 # fit created the feed wrapper: stop its transfer thread on
                 # EVERY exit path (a crashed fit must not leave a thread
@@ -352,6 +475,40 @@ class BaseModule:
                 # iterator freshly reset.
                 _owned_feed.close()
                 _inner_iter.reset()
+
+    def _resume_fast_forward(self, train_data, resume_state):
+        """Position ``train_data`` at the mid-epoch batch a ``.resume``
+        sidecar recorded; returns the nbatch to continue from.
+
+        Prefers the iterator's exact ``load_state`` seek; an iterator
+        without one is drained batch-by-batch to the same position (slower,
+        same data alignment). Either way the post-resume batch stream is
+        identical to the uninterrupted run's."""
+        nbatch = int(resume_state.get("nbatch") or 0)
+        state = resume_state.get("iter_state")
+        if state is not None and \
+                getattr(train_data, "load_state", None) is not None:
+            try:
+                train_data.load_state(state)
+                self.logger.info(
+                    "auto-resume: iterator repositioned to batch %d "
+                    "(exact mid-epoch resume)", nbatch)
+                return nbatch
+            except Exception as exc:  # noqa: BLE001 — seek failure degrades
+                # to the drain fallback below, never kills the resume
+                self.logger.warning(
+                    "auto-resume: iterator load_state failed (%s); "
+                    "draining %d batches instead", exc, nbatch)
+        it = iter(train_data)
+        for done in range(nbatch):
+            try:
+                next(it)
+            except StopIteration:
+                self.logger.warning(
+                    "auto-resume: iterator exhausted after %d of %d "
+                    "skipped batches — epoch sizes changed?", done, nbatch)
+                break
+        return nbatch
 
     # ---- symbol ----------------------------------------------------------
     @property

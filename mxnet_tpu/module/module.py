@@ -97,6 +97,12 @@ class Module(BaseModule):
         fault.hit("checkpoint_between_files")
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
+        # retire any stale mid-epoch .resume sidecar for this epoch number:
+        # it described an older write of this params file (model.py
+        # save_resume_state re-binds one for guard mid-epoch checkpoints)
+        from ..model import clear_resume_state
+
+        clear_resume_state(prefix, epoch)
         logging.info('Saved checkpoint to "%s"', param_name)
         if save_optimizer_states:
             state_name = "%s-%04d.states" % (prefix, epoch)
@@ -625,7 +631,15 @@ class Module(BaseModule):
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
-        """(reference: module.py load_optimizer_states)"""
+        """(reference: module.py load_optimizer_states).
+
+        Restored states are validated against the BOUND parameter shapes
+        before they are accepted: a ``.states`` file written by a different
+        model (the symbol was edited between runs) used to load fine and
+        then die deep inside the first optimizer update — now it raises a
+        clear ``MXNetError`` here, which ``fit(auto_resume=...)`` catches
+        and degrades to a warm start (params restored, fresh optimizer
+        state) instead of dying."""
         from ..utils.atomic_file import read_verified
 
         assert self.optimizer_initialized
@@ -635,6 +649,19 @@ class Module(BaseModule):
             self._kvstore.load_optimizer_states(fname)
         else:
             self._updater.set_states(read_verified(fname))
+            self._updater.check_state_shapes(
+                self._expected_state_shapes(), source=fname)
+
+    def _expected_state_shapes(self):
+        """``{flat_index: weight_shape}`` in the classic Updater's index
+        layout (``param_idx * num_device + dev_idx``, model.py
+        ``_update_params``) — what restored optimizer states must match."""
+        shapes = {}
+        num_device = len(self._context)
+        for i, per_dev in enumerate(self._exec_group.param_arrays):
+            for k, w in enumerate(per_dev):
+                shapes[i * num_device + k] = tuple(w.shape)
+        return shapes
 
     def install_monitor(self, mon):
         assert self.binded

@@ -490,6 +490,42 @@ class Updater:
     def get_states(self):
         return pickle.dumps({k: self._to_np(v) for k, v in self.states.items()})
 
+    def check_state_shapes(self, shapes_by_index, source=None):
+        """Validate restored states against the weight shapes they will
+        update (every state leaf of the built-in optimizers is
+        weight-shaped). A ``.states`` file from a DIFFERENT model used to
+        pickle-load silently and explode later inside the first
+        ``optimizer.update`` — this surfaces the mismatch at load time and
+        leaves the updater empty (a clean warm start) instead of armed with
+        garbage."""
+        def _leaf_shapes(state):
+            if isinstance(state, NDArray):
+                return [tuple(state.shape)]
+            if isinstance(state, (tuple, list)):
+                return [s for part in state for s in _leaf_shapes(part)]
+            return []
+
+        bad = []
+        for idx, state in self.states.items():
+            expected = shapes_by_index.get(idx)
+            if expected is None:
+                bad.append("index %s not among the %d bound parameters"
+                           % (idx, len(shapes_by_index)))
+                continue
+            for shape in _leaf_shapes(state):
+                if shape != tuple(expected):
+                    bad.append("index %s: state shape %s != weight shape %s"
+                               % (idx, shape, tuple(expected)))
+        if bad:
+            self.states = {}
+            self.states_synced = {}
+            raise MXNetError(
+                "optimizer states%s do not match this model (%s) — "
+                "was the model edited between runs? Discarding them for a "
+                "warm start." % (
+                    " from %r" % source if source else "",
+                    "; ".join(bad[:4]) + ("; ..." if len(bad) > 4 else "")))
+
     @staticmethod
     def _to_np(state):
         if isinstance(state, NDArray):

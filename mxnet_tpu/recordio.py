@@ -32,15 +32,32 @@ def _decode_lrec(lrec):
 
 
 class MXRecordIO:
-    """Sequential .rec reader/writer (reference: recordio.py:19)."""
+    """Sequential .rec reader/writer (reference: recordio.py:19).
+
+    Corrupt-stream handling (docs/fault_tolerance.md): by default a bad
+    magic word or a truncated payload raises — strict, the reference's
+    behavior. With ``MXNET_IO_MAX_BAD_RECORDS=N`` the reader instead
+    quarantines up to N corrupt records per file: it scans forward to the
+    next magic-aligned record boundary, counts the loss in the always-on
+    ``io.bad_records{source=stream}`` telemetry counter, and keeps
+    serving; past the budget it fails fast.
+    """
 
     def __init__(self, uri, flag):
+        from .base import env_int
+
         self.uri = uri
         self.flag = flag
         self.fid = None
+        # unset behaves as 0 here (strict — the legacy stream behavior);
+        # ImageRecordIter's decode layer maps unset to unlimited instead
+        # (its legacy behavior): see docs/env_var.md
+        self._max_bad = env_int("MXNET_IO_MAX_BAD_RECORDS", 0) or 0
+        self._bad = 0
         self.open()
 
     def open(self):
+        self._bad = 0  # the quarantine budget is per pass over the file
         if self.flag == "w":
             self.fid = open(self.uri, "wb")
             self.writable = True
@@ -96,6 +113,46 @@ class MXRecordIO:
             self.fid.write(b"\x00" * pad)
             off += len(chunk)
 
+    def _bad_record(self, why):
+        """Count one corrupt record against the budget and try to resync,
+        or raise when strict / budget exhausted. Returns True when the
+        stream is positioned at a plausible next record."""
+        self._bad += 1
+        from . import telemetry
+
+        telemetry.counter("io.bad_records", source="stream").inc()
+        if self._bad > self._max_bad:
+            raise MXNetError(
+                "Corrupt record in %s (%s): %d bad record(s) exceed "
+                "MXNET_IO_MAX_BAD_RECORDS=%d"
+                % (self.uri, why, self._bad, self._max_bad))
+        import logging
+
+        logging.warning("MXRecordIO: skipping corrupt record in %s (%s); "
+                        "%d quarantined so far", self.uri, why, self._bad)
+        return self._resync()
+
+    def _resync(self):
+        """Scan forward (4-byte aligned, the writer's padding grid) for the
+        next magic word and position the stream on it. False at EOF."""
+        magic_bytes = struct.pack("<I", _kMagic)
+        pos = self.fid.tell()
+        pos += (4 - pos % 4) % 4
+        self.fid.seek(pos)
+        window = b""
+        while True:
+            chunk = self.fid.read(1 << 16)
+            if not chunk:
+                return False
+            window += chunk
+            for off in range(0, len(window) - 3, 4):
+                if window[off:off + 4] == magic_bytes:
+                    self.fid.seek(pos + off)
+                    return True
+            keep = len(window) % 4 + 4
+            pos += len(window) - keep
+            window = window[-keep:]
+
     def read(self):
         assert not self.writable
         parts = []
@@ -105,9 +162,21 @@ class MXRecordIO:
                 return None if not parts else b"".join(parts)
             magic, lrec = struct.unpack("<II", header)
             if magic != _kMagic:
-                raise MXNetError("Invalid RecordIO magic in %s" % self.uri)
+                if not self._bad_record("invalid magic"):
+                    return None  # resync hit EOF
+                parts = []  # drop any half-assembled multi-chunk record
+                continue
             cflag, length = _decode_lrec(lrec)
             data = self.fid.read(length)
+            if len(data) < length:
+                # truncated payload: strict mode raises (silently returning
+                # the short record was never loadable downstream anyway)
+                if not self._bad_record(
+                        "truncated payload: %d of %d bytes"
+                        % (len(data), length)):
+                    return None
+                parts = []
+                continue
             pad = (4 - length % 4) % 4
             if pad:
                 self.fid.read(pad)
@@ -128,6 +197,11 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def open(self):
         super().open()
+        # random access must stay strict regardless of the quarantine
+        # budget: a resync past a corrupt record would silently return the
+        # NEXT physical record's bytes as if they were the requested index
+        # (and serve that record twice). Only sequential streams can skip.
+        self._max_bad = 0
         self.idx = {}
         self.keys = []
         if not self.writable and os.path.isfile(self.idx_path):

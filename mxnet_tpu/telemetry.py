@@ -52,7 +52,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram",
     "counter", "gauge", "histogram", "span", "event",
     "enable", "disable", "enabled",
-    "dump", "prometheus_text", "reset",
+    "dump", "prometheus_text", "reset", "state_summary",
     "flush", "start_flusher", "stop_flusher",
     "pipeline_stage", "PIPELINE_STAGES",
 ]
@@ -447,6 +447,30 @@ def dump(include_events=True):
         out[kind[type(m)]][key] = m.snapshot()
     if evs is not None:
         out["events"] = evs
+    return out
+
+
+def state_summary(prefixes=()):
+    """Compact ``{metric_key: value}`` snapshot of the registry, filtered to
+    metric names starting with any of ``prefixes`` (all when empty).
+
+    Counters/gauges render their value; histograms render ``count`` and
+    ``p99``. This is the one-line runtime state the guard's stall watchdog
+    dumps (docs/fault_tolerance.md §health-guard): queue depths and stage
+    latencies point at WHICH stage wedged without shipping the full
+    ``dump()`` blob into a log line.
+    """
+    with _lock:
+        items = sorted(_metrics.items())
+    out = {}
+    for key, m in items:
+        if prefixes and not any(m.name.startswith(p) for p in prefixes):
+            continue
+        if isinstance(m, Histogram):
+            snap = m.snapshot()
+            out[key] = {"count": snap["count"], "p99": snap.get("p99")}
+        else:
+            out[key] = m.snapshot()
     return out
 
 

@@ -40,7 +40,7 @@ from ..base import MXNetError
 
 __all__ = ["atomic_write", "ChecksumError", "ChecksummingReader",
            "PushbackReader", "verify_and_strip", "read_verified",
-           "FOOTER_LEN"]
+           "footer_crc", "FOOTER_LEN"]
 
 _FOOTER_MAGIC = b"MXCR"
 FOOTER_LEN = 16  # magic(4) + crc32(4) + payload_len(8)
@@ -173,6 +173,28 @@ def verify_and_strip(data):
             "checksum mismatch: footer says crc32=0x%08x over %d bytes, "
             "payload has crc32=0x%08x — file is corrupt" % (crc, length, actual))
     return payload
+
+
+def footer_crc(path):
+    """The CRC32 recorded in ``path``'s footer, or ``None`` for legacy
+    (footer-less) files. Reads 16 bytes — cheap enough to use as a binding
+    token between a checkpoint and its sidecar files (model.py's
+    ``.resume`` mid-epoch state): a sidecar that names a different CRC
+    belongs to an older write of the same path and must be ignored."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size < FOOTER_LEN:
+                return None
+            f.seek(size - FOOTER_LEN)
+            tail = f.read(FOOTER_LEN)
+    except OSError:
+        return None
+    magic, crc, length = struct.unpack("<4sIQ", tail)
+    if magic != _FOOTER_MAGIC or length != size - FOOTER_LEN:
+        return None
+    return crc
 
 
 def read_verified(path):
