@@ -7,6 +7,7 @@
 #   telemetry runtime-telemetry suite: registry/exposition/fit metrics (fast, host-only)
 #   pipeline  input-pipeline feed suite: uint8 wire + async device feed (fast, host-only)
 #   guard     training health-guard suite: sentinel/rollback/stall/resume (fast, host-only)
+#   lint      fwlint invariant analyzer (ratchets on ci/fwlint_baseline.json) + analysis suite
 #   deep      (opt-in, non-blocking) slow-marked deep-model compiles
 #   predict   C predict shim build + compiled-client test
 #   entry     driver contract: graft entry compile + multichip dryrun
@@ -191,6 +192,19 @@ run_guard() {
     -q -m "not slow"
 }
 
+run_lint() {
+  # framework-invariant analyzer (docs/static_analysis.md): AST checkers for
+  # the repo's hard-won invariants (env parsing, thread/lock hygiene,
+  # swallowed exceptions, host syncs in the step path). Ratchet: the
+  # committed baseline freezes existing debt; only NEW violations fail.
+  # Prints per-rule counts. Stdlib-only (no jax import) and <5s.
+  python tools/fwlint.py --baseline ci/fwlint_baseline.json
+  # the analysis suite: checker positives/negatives, suppression + ratchet
+  # semantics, engine dependency-sanitizer warn/strict modes
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_analysis.py \
+    -q -m "not slow"
+}
+
 run_deep() {
   # non-blocking deep stage: the slow-marked deep-model one-step compiles
   # (e.g. Inception-ResNet-v2) — ~15 min of XLA compile wall on a 1-core
@@ -305,6 +319,7 @@ case "$stage" in
   telemetry) run_telemetry ;;
   pipeline) run_pipeline ;;
   guard) run_guard ;;
+  lint) run_lint ;;
   deep) run_deep ;;
   predict) run_predict ;;
   predict_native) run_predict_native ;;
@@ -313,10 +328,10 @@ case "$stage" in
   tpu) run_tpu ;;
   examples) run_examples ;;
   package) run_package ;;
-  all) run_native; run_predict; run_predict_native; run_entry; run_package;
-       run_faults; run_telemetry; run_pipeline; run_guard;
+  all) run_lint; run_native; run_predict; run_predict_native; run_entry;
+       run_package; run_faults; run_telemetry; run_pipeline; run_guard;
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
                 --ignore=tests/test_train_native.py ;;
-  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|guard|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|guard|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
 esac

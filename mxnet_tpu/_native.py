@@ -109,7 +109,9 @@ def get_lib():
             return _lib
         _tried = True
         if not os.path.exists(_LIB_PATH):
-            if os.environ.get("MXNET_TPU_NO_NATIVE"):
+            from .base import env_flag
+
+            if env_flag("MXNET_TPU_NO_NATIVE"):
                 return None
             if not _build():
                 return None

@@ -1,0 +1,35 @@
+"""Static analysis + runtime sanitizers encoding this repo's invariants.
+
+Two halves (docs/static_analysis.md):
+
+* ``fwlint`` — an AST lint engine whose checkers each encode a bug class
+  that actually shipped here (raw ``MXNET_*`` env parsing, fire-and-forget
+  threads, swallowed exceptions, lock discipline, host syncs in the step
+  path). CLI: ``tools/fwlint.py``; CI ratchets on ``ci/fwlint_baseline.json``
+  so existing debt is frozen and only *new* violations fail.
+* ``sanitizer`` — a runtime checker for the engine's dependency contracts
+  (``MXNET_ENGINE_SANITIZER=warn|strict``): pushed functions are wrapped and
+  their actual NDArray reads/writes compared against the declared
+  ``const_vars``/``mutable_vars``.
+
+This package deliberately imports only the standard library at import time
+(no jax, no numpy): ``tools/fwlint.py`` loads it standalone so linting a
+tree never pays the accelerator-runtime import cost. The sanitizer pulls
+its framework dependencies lazily, at enable time.
+"""
+from .fwlint import Finding, RULES, lint_paths, lint_source, run_lint
+
+__all__ = ["Finding", "RULES", "lint_paths", "lint_source", "run_lint",
+           "sanitizer"]
+
+
+def __getattr__(name):
+    # lazy: the sanitizer submodule is runtime wiring (engine/ndarray); the
+    # lint half must stay importable standalone (see module docstring)
+    if name == "sanitizer":
+        import importlib
+
+        # NOT `from . import sanitizer`: the fromlist machinery consults
+        # this very __getattr__ while the submodule is mid-import → recursion
+        return importlib.import_module(__name__ + ".sanitizer")
+    raise AttributeError(name)

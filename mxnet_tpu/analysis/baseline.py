@@ -1,0 +1,56 @@
+"""Baseline ratchet — freeze existing debt, fail only on new findings.
+
+The committed baseline (``ci/fwlint_baseline.json``) maps each finding's
+drift-stable fingerprint to a human-readable record. CI re-lints and fails
+iff a fingerprint appears that the baseline does not carry; paying debt
+down only ever shrinks the file (``tools/fwlint.py --update-baseline``).
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["load", "save", "diff"]
+
+_VERSION = 1
+
+
+def load(path):
+    """Read a baseline file into ``{fingerprint: record}`` (missing file →
+    empty baseline, so bootstrapping is just running with ``--update``)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    if doc.get("version") != _VERSION:
+        raise ValueError("unsupported fwlint baseline version %r in %s"
+                         % (doc.get("version"), path))
+    return doc.get("findings", {})
+
+
+def save(path, findings):
+    """Write ``findings`` as the new baseline (sorted keys → stable diffs)."""
+    recs = {}
+    for f in findings:
+        recs[f.fingerprint] = {"rule": f.rule, "path": f.path,
+                               "line": f.line, "context": f.context,
+                               "text": f.text}
+    doc = {"version": _VERSION,
+           "comment": "fwlint debt freeze — regenerate with "
+                      "`python tools/fwlint.py --update-baseline`; "
+                      "this file must only ever shrink (docs/static_analysis.md)",
+           "findings": {k: recs[k] for k in sorted(recs)}}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def diff(findings, baseline):
+    """Split ``findings`` against ``baseline`` → ``(new, known, stale)``."""
+    new, known = [], []
+    live = set()
+    for f in findings:
+        live.add(f.fingerprint)
+        (known if f.fingerprint in baseline else new).append(f)
+    stale = sorted(set(baseline) - live)
+    return new, known, stale

@@ -1,0 +1,379 @@
+"""fwlint checkers — each rule encodes a bug class this repo has shipped.
+
+Rule catalog (rationale + examples: docs/static_analysis.md):
+
+* ``env-raw-read``        raw ``MXNET_*`` env reads crash on garbage values;
+                          PR 4 fixed this ad-hoc via ``base.env_int`` — the
+                          helpers are now mandatory outside ``base.py``.
+* ``bare-except``         ``except:`` catches KeyboardInterrupt/SystemExit.
+* ``swallowed-exception`` a broad handler whose body is only ``pass``/
+                          ``continue`` drops the only trace of a failure;
+                          route through logging/telemetry or suppress with a
+                          reason (engine error-slot precedent).
+* ``thread-hygiene``      every ``threading.Thread`` must be named (stall
+                          dumps and py-spy output are useless otherwise) and
+                          daemonized-or-joined (the DeviceFeedIter teardown
+                          precedent: a forgotten non-daemon thread hangs
+                          interpreter exit).
+* ``lock-discipline``     attributes annotated ``# guarded-by: <lock>`` must
+                          be touched under ``with self.<lock>``.
+* ``host-sync-in-hot-path`` ``.asnumpy()``/``.asscalar()``/``np.asarray`` in
+                          the module/executor step path blocks on device
+                          transfer (docs/perf.md §pipeline measured ~10ms/img
+                          of exactly this).
+* ``mutable-default-arg`` the classic shared-default footgun.
+
+Checkers are plain callables ``(FileContext) -> [Finding]`` with a ``rules``
+attribute; ``CHECKERS`` is the registry the driver iterates.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .fwlint import Finding
+
+__all__ = ["CHECKERS"]
+
+# the one module allowed to touch os.environ for MXNET_* keys: it hosts the
+# env_* helpers themselves
+ENV_HELPER_FILE = "mxnet_tpu/base.py"
+
+# the training step path: Module forward/backward/update + executor plumbing
+# (docs/perf.md §pipeline attributes real throughput loss to host syncs here)
+HOT_PATH_PREFIXES = ("mxnet_tpu/module/",)
+HOT_PATH_FILES = ("mxnet_tpu/executor.py", "mxnet_tpu/executor_manager.py")
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
+
+
+def _checker(*rules):
+    def deco(fn):
+        fn.rules = rules
+        return fn
+    return deco
+
+
+def _name_of(node):
+    """Best-effort dotted name of an expression (``os.environ`` →
+    'os.environ')."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _name_of(node.value)
+        return base + "." + node.attr if base else node.attr
+    return ""
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# env-raw-read
+# ---------------------------------------------------------------------------
+
+def _is_environ(node):
+    return _name_of(node) in ("os.environ", "environ")
+
+
+@_checker("env-raw-read")
+def check_env_raw_read(ctx):
+    if ctx.path == ENV_HELPER_FILE:
+        return []
+    out = []
+
+    def flag(node, key):
+        out.append(Finding(
+            "env-raw-read", ctx.path, node.lineno, node.col_offset,
+            "raw read of %s: use base.env_int/env_float/env_bool/env_str "
+            "(garbage values must warn + default, not crash)" % key,
+            context=ctx.qualnames.get(node, "")))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            fname = _name_of(node.func)
+            key = None
+            if fname in ("os.environ.get", "environ.get", "os.getenv",
+                         "getenv") and node.args:
+                key = _const_str(node.args[0])
+            if key and key.startswith("MXNET_"):
+                flag(node, key)
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx,
+                                                            ast.Load):
+            if _is_environ(node.value):
+                key = _const_str(node.slice)
+                if key and key.startswith("MXNET_"):
+                    flag(node, key)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bare-except / swallowed-exception
+# ---------------------------------------------------------------------------
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad_handler(handler):
+    if handler.type is None:
+        return True
+    if isinstance(handler.type, ast.Tuple):
+        return any(_name_of(e).split(".")[-1] in _BROAD
+                   for e in handler.type.elts)
+    return _name_of(handler.type).split(".")[-1] in _BROAD
+
+
+def _body_swallows(body):
+    return all(isinstance(s, (ast.Pass, ast.Continue)) for s in body)
+
+
+def _has_raise(body):
+    return any(isinstance(n, ast.Raise)
+               for s in body for n in ast.walk(s))
+
+
+@_checker("bare-except", "swallowed-exception")
+def check_excepts(ctx):
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        qn = ctx.qualnames.get(node, "")
+        if _is_broad_handler(node) and _body_swallows(node.body):
+            out.append(Finding(
+                "swallowed-exception", ctx.path, node.lineno,
+                node.col_offset,
+                "broad except whose body is only pass/continue drops the "
+                "only trace of a failure: narrow the clause, log, or count "
+                "it in telemetry (suppress with a reason if intentional)",
+                context=qn))
+        elif node.type is None and not _has_raise(node.body):
+            out.append(Finding(
+                "bare-except", ctx.path, node.lineno, node.col_offset,
+                "bare except catches KeyboardInterrupt/SystemExit: catch "
+                "Exception (or narrower), or re-raise",
+                context=qn))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# thread-hygiene
+# ---------------------------------------------------------------------------
+
+def _is_thread_ctor(node):
+    return isinstance(node, ast.Call) and _name_of(node.func) in (
+        "threading.Thread", "Thread")
+
+
+def _assign_targets_of(ctx, node):
+    """Names the Thread() value ends up bound to: climbs through list/tuple
+    displays and comprehensions to the enclosing Assign, and recognizes
+    ``xs.append(Thread(...))``."""
+    names = set()
+    cur = node
+    for parent in ctx.ancestors(node):
+        if isinstance(parent, ast.Call) and cur is not node:
+            break  # the value was consumed by some other call — give up
+        if isinstance(parent, ast.Call) and _name_of(parent.func).endswith(
+                ".append"):
+            owner = parent.func.value
+            names.add(owner.attr if isinstance(owner, ast.Attribute)
+                      else _name_of(owner))
+            break
+        if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (parent.targets if isinstance(parent, ast.Assign)
+                       else [parent.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    names.add(t.attr)
+                elif isinstance(t, ast.Name):
+                    names.add(t.id)
+            break
+        if not isinstance(parent, (ast.List, ast.Tuple, ast.ListComp,
+                                   ast.GeneratorExp, ast.comprehension,
+                                   ast.IfExp, ast.Starred)):
+            break
+        cur = parent
+    return names
+
+
+@_checker("thread-hygiene")
+def check_thread_hygiene(ctx):
+    joined, daemonized = set(), set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _name_of(node.func).endswith(
+                ".join"):
+            owner = node.func.value
+            joined.add(owner.attr if isinstance(owner, ast.Attribute)
+                       else _name_of(owner))
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                    owner = t.value
+                    daemonized.add(owner.attr
+                                   if isinstance(owner, ast.Attribute)
+                                   else _name_of(owner))
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not _is_thread_ctor(node):
+            continue
+        qn = ctx.qualnames.get(node, "")
+        kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+        if "name" not in kwargs:
+            out.append(Finding(
+                "thread-hygiene", ctx.path, node.lineno, node.col_offset,
+                "threading.Thread without name=: stall dumps and py-spy "
+                "output cannot attribute an anonymous thread",
+                context=qn))
+        daemon = kwargs.get("daemon")
+        is_daemon = daemon is not None and not (
+            isinstance(daemon, ast.Constant) and daemon.value is False)
+        if not is_daemon:
+            targets = _assign_targets_of(ctx, node)
+            if not (targets & (joined | daemonized)):
+                out.append(Finding(
+                    "thread-hygiene", ctx.path, node.lineno,
+                    node.col_offset,
+                    "non-daemon threading.Thread that is never joined (and "
+                    "never set .daemon): a forgotten one hangs interpreter "
+                    "exit — pass daemon=True or join it",
+                    context=qn))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+def _with_locks(ctx, node):
+    """Lock names held at ``node``: every lexical ancestor ``with`` item of
+    the form ``self.<lock>`` or ``<lock>``."""
+    held = set()
+    for parent in ctx.ancestors(node):
+        if isinstance(parent, ast.With):
+            for item in parent.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Attribute):
+                    held.add(expr.attr)
+                elif isinstance(expr, ast.Name):
+                    held.add(expr.id)
+    return held
+
+
+@_checker("lock-discipline")
+def check_lock_discipline(ctx):
+    out = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded = {}  # attr -> (lock, {declaration lines})
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            m = _GUARDED_BY_RE.search(ctx.comments.get(node.lineno, ""))
+            if not m:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and _name_of(t.value) == "self"):
+                    lock, lines = guarded.setdefault(
+                        t.attr, (m.group(1), set()))
+                    if lock != m.group(1):
+                        out.append(Finding(
+                            "lock-discipline", ctx.path, node.lineno,
+                            node.col_offset,
+                            "self.%s re-annotated with a different lock "
+                            "(%s vs %s)" % (t.attr, m.group(1), lock),
+                            context=ctx.qualnames.get(node, "")))
+                    lines.add(node.lineno)
+        if not guarded:
+            continue
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Attribute)
+                    and _name_of(node.value) == "self"
+                    and node.attr in guarded):
+                continue
+            lock, decl_lines = guarded[node.attr]
+            if node.lineno in decl_lines:
+                continue
+            if lock not in _with_locks(ctx, node):
+                out.append(Finding(
+                    "lock-discipline", ctx.path, node.lineno,
+                    node.col_offset,
+                    "self.%s is annotated guarded-by: %s but accessed "
+                    "outside `with self.%s`" % (node.attr, lock, lock),
+                    context=ctx.qualnames.get(node, "")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+@_checker("host-sync-in-hot-path")
+def check_host_sync(ctx):
+    if not (ctx.path in HOT_PATH_FILES
+            or any(ctx.path.startswith(p) for p in HOT_PATH_PREFIXES)):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        sync = None
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("asnumpy", "asscalar")):
+            sync = node.func.attr + "()"
+        elif _name_of(node.func) in ("np.asarray", "numpy.asarray",
+                                     "np.array", "numpy.array"):
+            sync = _name_of(node.func)
+        if sync:
+            out.append(Finding(
+                "host-sync-in-hot-path", ctx.path, node.lineno,
+                node.col_offset,
+                "%s in the module/executor step path forces a device->host "
+                "sync (docs/perf.md §pipeline); keep the step on-device or "
+                "move the sync out of the per-batch path" % sync,
+                context=ctx.qualnames.get(node, "")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# mutable-default-arg
+# ---------------------------------------------------------------------------
+
+_MUTABLE_CTORS = ("list", "dict", "set", "defaultdict", "OrderedDict")
+
+
+def _is_mutable_default(node):
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    return (isinstance(node, ast.Call)
+            and _name_of(node.func).split(".")[-1] in _MUTABLE_CTORS)
+
+
+@_checker("mutable-default-arg")
+def check_mutable_default(ctx):
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None]
+        for d in defaults:
+            if _is_mutable_default(d):
+                name = getattr(node, "name", "<lambda>")
+                out.append(Finding(
+                    "mutable-default-arg", ctx.path, d.lineno, d.col_offset,
+                    "mutable default argument on %s(): shared across calls "
+                    "— default to None and construct inside" % name,
+                    context=ctx.qualnames.get(node, "")))
+    return out
+
+
+CHECKERS = (check_env_raw_read, check_excepts, check_thread_hygiene,
+            check_lock_discipline, check_host_sync, check_mutable_default)

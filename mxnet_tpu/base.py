@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["MXNetError", "string_types", "numeric_types"]
+__all__ = ["MXNetError", "string_types", "numeric_types",
+           "env_int", "env_float", "env_bool", "env_str", "env_flag"]
 
 
 class MXNetError(Exception):
@@ -124,6 +125,55 @@ def env_float(name, default=None):
     """Float MXNET_*-style env var; same fallback contract as
     :func:`env_int`."""
     return _env_number(name, default, float)
+
+
+_BOOL_TOKENS = {"1": True, "true": True, "yes": True, "on": True,
+                "0": False, "false": False, "no": False, "off": False}
+
+
+def env_bool(name, default=False):
+    """Strict boolean MXNET_*-style env var: accepts 1/0, true/false, yes/no,
+    on/off (case-insensitive). Unset/empty falls back to ``default``;
+    anything else warns and falls back — unlike :func:`env_flag` (the dmlc
+    convention), a typo like ``MXNET_X=treu`` degrades to the documented
+    default instead of silently flipping the knob on."""
+    import os
+
+    raw = os.environ.get(name, "")
+    if not raw.strip():
+        return default
+    val = _BOOL_TOKENS.get(raw.strip().lower())
+    if val is None:
+        import logging
+
+        logging.warning("ignoring unparseable %s=%r (using %r)",
+                        name, raw, default)
+        return default
+    return val
+
+
+def env_str(name, default=None, choices=None):
+    """String MXNET_*-style env var. Unset/empty falls back to ``default``.
+    With ``choices``, a value outside the set warns and falls back (the
+    same degrade-don't-crash contract as :func:`env_int`); the comparison
+    is case-insensitive and the matching choice is returned as spelled in
+    ``choices``."""
+    import os
+
+    raw = os.environ.get(name, "")
+    if not raw.strip():
+        return default
+    raw = raw.strip()
+    if choices is None:
+        return raw
+    for c in choices:
+        if raw.lower() == str(c).lower():
+            return c
+    import logging
+
+    logging.warning("ignoring %s=%r (not one of %s; using %r)",
+                    name, raw, "/".join(str(c) for c in choices), default)
+    return default
 
 
 def parse_int_or_none(s):

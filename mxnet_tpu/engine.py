@@ -23,19 +23,26 @@ import os
 import threading
 import time
 
-from . import telemetry
+from . import base, telemetry
 from ._native import ENGINE_FN, get_lib
+from .analysis import sanitizer as _sanitizer
 
 __all__ = ["Engine", "NaiveEngine", "ThreadedEngine", "get_engine", "Var"]
 
 
 class Var:
-    """Opaque dependency token (reference: engine.h VarHandle)."""
+    """Opaque dependency token (reference: engine.h VarHandle).
 
-    __slots__ = ("handle",)
+    ``deleted`` is set by ``delete_variable`` so the dependency sanitizer
+    (analysis/sanitizer.py) can flag use-after-free; the scheduler itself
+    never reads it.
+    """
+
+    __slots__ = ("handle", "deleted")
 
     def __init__(self, handle):
         self.handle = handle
+        self.deleted = False
 
 
 class Engine:
@@ -48,7 +55,7 @@ class Engine:
 
     def __init__(self):
         self._err_lock = threading.Lock()
-        self._first_error = None
+        self._first_error = None  # guarded-by: _err_lock
 
     def _record_error(self, exc):
         import logging
@@ -108,6 +115,11 @@ class NaiveEngine(Engine):
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
         from . import fault
 
+        if _sanitizer.active():
+            # strict mode raises HERE on a deleted declared var (caller bug,
+            # synchronous by design); in-fn checks ride inside the wrapper
+            _sanitizer.check_declared(const_vars, mutable_vars)
+            fn = _sanitizer.wrap_push(fn, const_vars, mutable_vars)
         tel = telemetry.enabled()
         if tel:
             telemetry.counter("engine.pushes").inc()
@@ -135,7 +147,7 @@ class NaiveEngine(Engine):
         self._raise_pending()
 
     def delete_variable(self, var):
-        pass
+        var.deleted = True
 
 
 class ThreadedEngine(Engine):
@@ -154,13 +166,13 @@ class ThreadedEngine(Engine):
             raise RuntimeError("native runtime unavailable (no g++?); "
                                "set MXNET_ENGINE_TYPE=NaiveEngine")
         if num_workers is None:
-            num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS",
-                                             str(min(8, os.cpu_count() or 1))))
+            num_workers = base.env_int("MXNET_CPU_WORKER_NTHREADS",
+                                       min(8, os.cpu_count() or 1))
         self._lib = lib
         self._handle = lib.mxt_engine_create(num_workers)
-        self._pending = {}
         self._pending_lock = threading.Lock()
-        self._next_id = [1]
+        self._pending = {}  # guarded-by: _pending_lock
+        self._next_id = [1]  # guarded-by: _pending_lock
         self._ctypes = ctypes
 
         def _trampoline(arg):
@@ -197,6 +209,9 @@ class ThreadedEngine(Engine):
         return arr
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        if _sanitizer.active():
+            _sanitizer.check_declared(const_vars, mutable_vars)
+            fn = _sanitizer.wrap_push(fn, const_vars, mutable_vars)
         with self._pending_lock:
             key = self._next_id[0]
             self._next_id[0] += 1
@@ -230,14 +245,15 @@ class ThreadedEngine(Engine):
         self._raise_pending()
 
     def delete_variable(self, var):
+        var.deleted = True
         self._lib.mxt_engine_delete_var(self._handle, var.handle)
 
     def __del__(self):
         try:
             self._lib.mxt_engine_wait_all(self._handle)
             self._lib.mxt_engine_destroy(self._handle)
-        except Exception:
-            pass
+        except Exception:  # fwlint: disable=swallowed-exception — interpreter
+            pass  # teardown: the lib/ctypes globals may already be gone
 
 
 _engine = None
@@ -249,7 +265,7 @@ def get_engine():
     global _engine
     with _engine_lock:
         if _engine is None:
-            kind = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+            kind = base.env_str("MXNET_ENGINE_TYPE", "ThreadedEngine")
             if kind == "NaiveEngine":
                 _engine = NaiveEngine()
             else:

@@ -48,7 +48,7 @@ import threading
 import time
 from contextlib import contextmanager
 
-from .base import MXNetError
+from .base import MXNetError, env_str as _env_str
 
 __all__ = ["InjectedFault", "InjectedCrash", "hit", "inject", "reset",
            "crash_after_bytes"]
@@ -97,7 +97,7 @@ def _active_rules():
         if _spec_stack:
             return _spec_stack[-1]
         if _rules is None:
-            _rules = _parse(os.environ.get("MXNET_FAULT_SPEC", ""))
+            _rules = _parse(_env_str("MXNET_FAULT_SPEC", ""))
         return _rules
 
 

@@ -45,7 +45,6 @@ from __future__ import annotations
 
 import logging
 import math
-import os
 import signal
 import threading
 import time
@@ -53,7 +52,8 @@ import time
 import numpy as np
 
 from . import telemetry
-from .base import MXNetError, env_float as _env_float, env_int as _env_int
+from .base import (MXNetError, env_float as _env_float, env_int as _env_int,
+                   env_str as _env_str)
 
 __all__ = ["GuardError", "BadStepError", "StallError", "GuardPolicy",
            "TrainingGuard", "Sentinel", "resolve"]
@@ -110,7 +110,7 @@ class GuardPolicy:
                  snapshot_every=None, checkpoint_prefix=None,
                  checkpoint_every=None):
         if policy is None:
-            policy = os.environ.get("MXNET_GUARD_POLICY", "off") or "off"
+            policy = _env_str("MXNET_GUARD_POLICY", "off")
         policy = str(policy).lower()
         if policy not in POLICIES:
             raise MXNetError("MXNET_GUARD_POLICY must be one of %s, got %r"

@@ -16,7 +16,7 @@ import random as pyrandom
 import numpy as np
 
 from . import ndarray as nd
-from .base import MXNetError
+from .base import MXNetError, env_str as _env_str
 from .io import DataBatch, DataDesc, DataIter
 from . import recordio
 
@@ -44,7 +44,7 @@ def imdecode_np(buf, to_rgb=True, flag=1):
         buf = buf.asnumpy().tobytes()
     elif isinstance(buf, np.ndarray):
         buf = buf.tobytes()
-    if os.environ.get("MXNET_IMAGE_DECODE_BACKEND", "").lower() != "pil":
+    if _env_str("MXNET_IMAGE_DECODE_BACKEND", "").lower() != "pil":
         try:
             import cv2
         except ImportError:
@@ -96,7 +96,7 @@ def imresize_np(arr, w, h, interp=2):
     """
     arr = np.asarray(arr)
     squeeze = arr.ndim == 3 and arr.shape[2] == 1
-    if os.environ.get("MXNET_IMAGE_DECODE_BACKEND", "").lower() != "pil":
+    if _env_str("MXNET_IMAGE_DECODE_BACKEND", "").lower() != "pil":
         try:
             import cv2
         except ImportError:

@@ -22,7 +22,7 @@ from collections import namedtuple
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, env_int as _env_int
 from . import ndarray as nd
 from . import telemetry
 from .ndarray import NDArray, array
@@ -334,10 +334,11 @@ class PrefetchingIter(DataIter):
                 self.data_ready[i].set()
 
         self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i]) for i in range(self.n_iter)
+            threading.Thread(target=prefetch_func, args=[self, i],
+                             name="mxnet-prefetch-%d" % i, daemon=True)
+            for i in range(self.n_iter)
         ]
         for thread in self.prefetch_threads:
-            thread.setDaemon(True)
             thread.start()
 
     def __del__(self):
@@ -464,8 +465,8 @@ def _close_live_feeds():
     for it in list(_LIVE_FEEDS):
         try:
             it.close()
-        except Exception:  # noqa: BLE001 — interpreter is going down
-            pass
+        except Exception:  # fwlint: disable=swallowed-exception —
+            pass  # interpreter is going down; nowhere left to report
 
 
 class DeviceFeedIter(DataIter):
@@ -487,7 +488,7 @@ class DeviceFeedIter(DataIter):
     def __init__(self, data_iter, ctx=None, depth=None):
         super().__init__(getattr(data_iter, "batch_size", 0))
         if depth is None:
-            depth = int(os.environ.get("MXNET_FEED_DEPTH", "2") or 2)
+            depth = _env_int("MXNET_FEED_DEPTH", 2)
         self._iter = data_iter
         self._ctx = ctx
         self.depth = max(1, int(depth))
@@ -689,7 +690,7 @@ def maybe_device_feed(data_iter, contexts):
     via ``MXNET_FEED_DEPTH`` (fit calls this; returns the iter unchanged when
     the env var is unset/0 or the iter already is a feed). Target device per
     :func:`wire_decode_ctx`."""
-    depth = int(os.environ.get("MXNET_FEED_DEPTH", "0") or 0)
+    depth = _env_int("MXNET_FEED_DEPTH", 0)
     if depth <= 0 or isinstance(data_iter, DeviceFeedIter):
         return data_iter
     return DeviceFeedIter(data_iter, ctx=wire_decode_ctx(contexts),

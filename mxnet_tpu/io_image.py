@@ -26,7 +26,7 @@ import numpy as np
 
 from . import ndarray as nd
 from . import telemetry
-from .base import MXNetError
+from .base import MXNetError, env_bool
 from .image import CreateAugmenter, imdecode, imdecode_np
 from .io import DataBatch, DataDesc, DataIter, WireSpec
 from . import recordio
@@ -43,8 +43,8 @@ def _close_live_iters():
     for it in list(_LIVE_ITERS):
         try:
             it.close()
-        except Exception:  # noqa: BLE001 — interpreter is going down
-            pass
+        except Exception:  # fwlint: disable=swallowed-exception —
+            pass  # interpreter is going down; nowhere left to report
 
 
 def _mean_std(mean_r, mean_g, mean_b, std_r, std_g, std_b):
@@ -85,7 +85,7 @@ class ImageRecordIter(DataIter):
         # to one on-device program at the executor boundary (io.WireSpec).
         # provide_data keeps advertising the POST-decode fp32 NCHW desc.
         explicit = wire_dtype is not None
-        if wire_dtype is None and os.environ.get("MXNET_WIRE_UINT8", "") == "1":
+        if wire_dtype is None and env_bool("MXNET_WIRE_UINT8"):
             wire_dtype = "uint8"
         if wire_dtype not in (None, "float32", "uint8"):
             raise MXNetError("wire_dtype must be 'float32' or 'uint8', got %r"
@@ -407,12 +407,15 @@ class ImageRecordIter(DataIter):
             _put(self._out_q, None)
 
         self._decoded_q = queue.Queue(maxsize=self.preprocess_threads * 8)
-        self._threads = [threading.Thread(target=reader, daemon=True)]
+        self._threads = [threading.Thread(target=reader, daemon=True,
+                                          name="mxnet-rec-reader")]
         self._threads += [
-            threading.Thread(target=worker, args=(i,), daemon=True)
+            threading.Thread(target=worker, args=(i,), daemon=True,
+                             name="mxnet-rec-decode-%d" % i)
             for i in range(self.preprocess_threads)
         ]
-        self._threads.append(threading.Thread(target=batcher, daemon=True))
+        self._threads.append(threading.Thread(target=batcher, daemon=True,
+                                              name="mxnet-rec-batcher"))
         for t in self._threads:
             t.start()
 

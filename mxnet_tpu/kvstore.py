@@ -26,7 +26,7 @@ import time
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, env_int as _env_int
 from . import ndarray as nd
 from . import telemetry
 from .ndarray import NDArray
@@ -337,8 +337,8 @@ class KVStoreDist(KVStore):
         """MXNET_KV_RETRIES extra attempts after the first failure (0 turns
         retry off); MXNET_KV_TIMEOUT_MS bounds the liveness probe that
         classifies each failure."""
-        return (int(os.environ.get("MXNET_KV_RETRIES", "3")),
-                max(int(os.environ.get("MXNET_KV_TIMEOUT_MS", "10000")), 1))
+        return (_env_int("MXNET_KV_RETRIES", 3),
+                max(_env_int("MXNET_KV_TIMEOUT_MS", 10000), 1))
 
     def _with_retry(self, what, ikey, attempt_fn):
         """Run ``attempt_fn`` with bounded retry + exponential backoff.
@@ -606,7 +606,8 @@ class KVStoreDist(KVStore):
         def probe(i, host, port):
             results[i] = self._lib.mxt_ps_probe(host.encode(), port, timeout_ms)
 
-        threads = [threading.Thread(target=probe, args=(i, h, p), daemon=True)
+        threads = [threading.Thread(target=probe, args=(i, h, p), daemon=True,
+                                    name="mxnet-kv-probe-%d" % i)
                    for i, (h, p) in enumerate(addrs)]
         for t in threads:
             t.start()
@@ -687,7 +688,8 @@ class KVStoreDist(KVStore):
                     buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                     STATS_VEC_LEN)
 
-            t = threading.Thread(target=pull, daemon=True)
+            t = threading.Thread(target=pull, daemon=True,
+                                 name="mxnet-kv-stats-pull")
             t.start()
             t.join(timeout_ms / 1000.0)
             got = result[0]
@@ -712,8 +714,8 @@ class KVStoreDist(KVStore):
         try:
             for c in self._clients:
                 self._lib.mxt_ps_client_destroy(c)
-        except Exception:
-            pass
+        except Exception:  # fwlint: disable=swallowed-exception — interpreter
+            pass  # teardown: the ctypes lib global may already be gone
 
 
 def _process_index():

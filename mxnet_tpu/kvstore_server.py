@@ -89,8 +89,10 @@ class KVStoreServer:
         self._update_failures = 0
         self._updates_applied = 0
         self._last_update_error = None
-        self._max_update_failures = int(os.environ.get(
-            "MXNET_KV_SERVER_MAX_UPDATE_FAILURES", "10"))
+        from .base import env_int
+
+        self._max_update_failures = env_int(
+            "MXNET_KV_SERVER_MAX_UPDATE_FAILURES", 10)
 
         # ALL python work (optimizer unpickle + update) runs on the server's
         # MAIN thread via this queue — the reference's single-threaded
@@ -281,7 +283,8 @@ class KVStoreServer:
             self._lib.mxt_ps_server_wait(self._handle)
             self._exec_q.put(None)
 
-        t = threading.Thread(target=waiter, daemon=True)
+        t = threading.Thread(target=waiter, daemon=True,
+                             name="mxnet-kv-server-waiter")
         t.start()
         while True:
             task = self._exec_q.get()
@@ -307,7 +310,8 @@ class KVStoreServer:
                 if task is not None:
                     task()
 
-        d = threading.Thread(target=drainer)
+        d = threading.Thread(target=drainer,
+                             name="mxnet-kv-server-drainer")
         d.start()
         with self._self_client_lock:
             if self._self_client is not None:

@@ -105,7 +105,7 @@ class FusedFitPath:
             mod = self._mod
             for n in missing:
                 st.params[n] = jax.device_put(
-                    mod._arg_params[n].asnumpy().astype(tr.dtype),
+                    mod._arg_params[n].asnumpy().astype(tr.dtype),  # fwlint: disable=host-sync-in-hot-path
                     tr.param_shardings[n])
                 st.states[n] = tuple(
                     jax.device_put(s, tr.param_shardings[n])
@@ -113,7 +113,7 @@ class FusedFitPath:
             for n in tr.aux_names:
                 if n not in st.auxs:
                     st.auxs[n] = jax.device_put(
-                        mod._aux_params[n].asnumpy().astype(np.float32),
+                        mod._aux_params[n].asnumpy().astype(np.float32),  # fwlint: disable=host-sync-in-hot-path
                         tr.repl)
             return
         mod = self._mod
@@ -129,12 +129,12 @@ class FusedFitPath:
             mod._exec_group.get_params(mod._arg_params, mod._aux_params)
         st.params = {
             n: jax.device_put(
-                mod._arg_params[n].asnumpy().astype(tr.dtype), tr.param_shardings[n]
+                mod._arg_params[n].asnumpy().astype(tr.dtype), tr.param_shardings[n]  # fwlint: disable=host-sync-in-hot-path
             )
             for n in tr.param_names
         }
         st.auxs = {
-            n: jax.device_put(mod._aux_params[n].asnumpy().astype(np.float32), tr.repl)
+            n: jax.device_put(mod._aux_params[n].asnumpy().astype(np.float32), tr.repl)  # fwlint: disable=host-sync-in-hot-path
             for n in tr.aux_names
         }
         if st.host_states is not None:

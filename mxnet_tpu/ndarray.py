@@ -159,7 +159,11 @@ def imperative_invoke(op_name, ndargs, attrs, out=None):
 class NDArray:
     """An n-dimensional array on a device context."""
 
-    __slots__ = ("_data", "_ctx", "_base", "_index", "writable")
+    # _engine_var: optional engine.Var this buffer is tracked by — set via
+    # analysis.sanitizer.attach() so the dependency sanitizer can compare a
+    # pushed fn's actual reads/writes against its declared vars
+    __slots__ = ("_data", "_ctx", "_base", "_index", "writable",
+                 "_engine_var")
 
     def __init__(self, data, ctx=None, base=None, index=None):
         self._data = data
@@ -167,6 +171,7 @@ class NDArray:
         self._base = base
         self._index = index
         self.writable = True
+        self._engine_var = None
 
     # ---- buffer access --------------------------------------------------
     @property
@@ -404,6 +409,7 @@ class NDArray:
         self._base = None
         self._index = None
         self.writable = True
+        self._engine_var = None
         self._data = jax.device_put(state["data"], ctx.jax_device)
 
 

@@ -50,7 +50,9 @@ def profiler_set_state(state="stop"):
         if state == "run" and not _state["running"]:
             _state["running"] = True
             _state["events"] = []
-            trace_dir = os.environ.get("MXNET_PROFILER_TRACE_DIR")
+            from .base import env_str
+
+            trace_dir = env_str("MXNET_PROFILER_TRACE_DIR")
             if trace_dir:
                 import jax
 
@@ -153,7 +155,7 @@ def dump_profile():
 def _maybe_autostart():
     import atexit
 
-    from .base import env_flag
+    from .base import env_flag, env_str
 
     if env_flag("MXNET_PROFILER_AUTOSTART"):
         # default filename is pid-suffixed: launched clusters (tools/launch.py)
@@ -161,8 +163,8 @@ def _maybe_autostart():
         # only the last exiter's trace
         profiler_set_config(
             mode="all",
-            filename=os.environ.get("MXNET_PROFILER_FILENAME",
-                                    "profile.%d.json" % os.getpid()))
+            filename=env_str("MXNET_PROFILER_FILENAME",
+                             "profile.%d.json" % os.getpid()))
         profiler_set_state("run")
 
         def _dump_at_exit():

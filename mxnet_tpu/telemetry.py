@@ -43,10 +43,11 @@ from __future__ import annotations
 import bisect
 import json
 import math
-import os
 import threading
 import time
 from collections import deque
+
+from .base import env_float as _env_float, env_str as _env_str
 
 __all__ = [
     "Counter", "Gauge", "Histogram",
@@ -410,7 +411,7 @@ def event(name, **fields):
     rec.update(fields)
     with _lock:
         _events.append(rec)
-        sink = _flusher[2] if _flusher else os.environ.get("MXNET_TELEMETRY_FILE")
+        sink = _flusher[2] if _flusher else _env_str("MXNET_TELEMETRY_FILE")
     if sink:
         _append_line(sink, rec)
     return rec
@@ -573,7 +574,7 @@ def _append_line(path, rec):
 def flush(path=None):
     """Append one snapshot record to the JSON-lines sink now."""
     path = path or (_flusher[2] if _flusher else
-                    os.environ.get("MXNET_TELEMETRY_FILE"))
+                    _env_str("MXNET_TELEMETRY_FILE"))
     if not path:
         return
     rec = dump(include_events=False)
@@ -589,12 +590,12 @@ def start_flusher(path=None, interval_s=None):
     flushing-but-disabled registry would record empty snapshots forever.
     """
     global _flusher
-    path = path or os.environ.get("MXNET_TELEMETRY_FILE")
+    path = path or _env_str("MXNET_TELEMETRY_FILE")
     if not path:
         raise ValueError("no telemetry file: pass path= or set "
                          "MXNET_TELEMETRY_FILE")
     if interval_s is None:
-        interval_s = float(os.environ.get("MXNET_TELEMETRY_INTERVAL_S", "60"))
+        interval_s = _env_float("MXNET_TELEMETRY_INTERVAL_S", 60.0)
     interval_s = max(float(interval_s), 0.05)
     with _lock:
         if _flusher is not None:
@@ -631,7 +632,7 @@ def _maybe_autostart():
 
     from .base import env_flag
 
-    if os.environ.get("MXNET_TELEMETRY_FILE"):
+    if _env_str("MXNET_TELEMETRY_FILE"):
         start_flusher()
         atexit.register(stop_flusher)
     elif env_flag("MXNET_TELEMETRY"):

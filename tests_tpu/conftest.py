@@ -22,7 +22,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 # runtime (ci/run_tests.sh faults / telemetry) but exercise no accelerator:
 # they run on CPU-only hosts and are exempt from the hardware gate below.
 _HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
-                    "test_pipeline_feed.py", "test_guard.py"}
+                    "test_pipeline_feed.py", "test_guard.py",
+                    "test_analysis.py"}
 
 
 def pytest_configure(config):
@@ -34,6 +35,8 @@ def pytest_configure(config):
         "markers", "pipeline: input-pipeline wire/feed tests (host-only)")
     config.addinivalue_line(
         "markers", "guard: training health-guard tests (host-only)")
+    config.addinivalue_line(
+        "markers", "analysis: fwlint / engine-sanitizer tests (host-only)")
     config.addinivalue_line("markers", "slow: long-running tests")
 
 

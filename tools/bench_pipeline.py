@@ -219,7 +219,9 @@ def bench_overlapped(rec, size, batch, threads, reps=5, wire_dtype=None,
     def cb(param):
         epoch_marks.append((param.epoch, time.perf_counter()))
 
-    old_depth = os.environ.get("MXNET_FEED_DEPTH")
+    # verbatim save/restore of the caller's env (None means "was unset"), not
+    # a parse — the env_* helpers would normalize the restored value
+    old_depth = os.environ.get("MXNET_FEED_DEPTH")  # fwlint: disable=env-raw-read
     if feed_depth:
         os.environ["MXNET_FEED_DEPTH"] = str(feed_depth)
     try:
