@@ -7,6 +7,7 @@
 #   telemetry runtime-telemetry suite: registry/exposition/fit metrics (fast, host-only)
 #   pipeline  input-pipeline feed suite: uint8 wire + async device feed (fast, host-only)
 #   guard     training health-guard suite: sentinel/rollback/stall/resume (fast, host-only)
+#   elastic   elastic-membership suite incl. the slow kill/rejoin e2e (host-only CPU mesh)
 #   lint      fwlint invariant analyzer (ratchets on ci/fwlint_baseline.json) + analysis suite
 #   deep      (opt-in, non-blocking) slow-marked deep-model compiles
 #   predict   C predict shim build + compiled-client test
@@ -192,6 +193,20 @@ run_guard() {
     -q -m "not slow"
 }
 
+run_elastic() {
+  # elastic-membership tier (docs/distributed.md §elasticity): membership
+  # epoch rejection, registry formation/lapse/rejoin, deterministic
+  # epoch-scoped resharding, launcher exit-code/supervisor contract. The
+  # kill→reconfigure→rejoin end-to-end cycle (multi-process CPU mesh under
+  # tools/launch.py --elastic) is slow-marked; "all" runs the fast set and
+  # this stage runs BOTH when invoked directly.
+  make -C mxnet_tpu/src
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_elastic.py \
+    -q -m "not slow"
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_elastic.py \
+    -q -m "slow and elastic"
+}
+
 run_lint() {
   # framework-invariant analyzer (docs/static_analysis.md): AST checkers for
   # the repo's hard-won invariants (env parsing, thread/lock hygiene,
@@ -319,6 +334,7 @@ case "$stage" in
   telemetry) run_telemetry ;;
   pipeline) run_pipeline ;;
   guard) run_guard ;;
+  elastic) run_elastic ;;
   lint) run_lint ;;
   deep) run_deep ;;
   predict) run_predict ;;
@@ -330,8 +346,9 @@ case "$stage" in
   package) run_package ;;
   all) run_lint; run_native; run_predict; run_predict_native; run_entry;
        run_package; run_faults; run_telemetry; run_pipeline; run_guard;
+       JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_elastic.py -q -m "not slow";
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
                 --ignore=tests/test_train_native.py ;;
-  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|guard|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|guard|elastic|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
 esac

@@ -80,6 +80,12 @@ def _declare(lib):
     lib.mxt_ps_client_push.restype = c.c_int
     lib.mxt_ps_client_push.argtypes = [
         c.c_void_p, c.c_int, c.POINTER(c.c_float), c.c_ulonglong]
+    lib.mxt_ps_client_init.restype = c.c_int
+    lib.mxt_ps_client_init.argtypes = [
+        c.c_void_p, c.c_int, c.POINTER(c.c_float), c.c_ulonglong]
+    lib.mxt_ps_client_set_epoch.argtypes = [c.c_void_p, c.c_longlong]
+    lib.mxt_ps_client_get_epoch.restype = c.c_longlong
+    lib.mxt_ps_client_get_epoch.argtypes = [c.c_void_p]
     lib.mxt_ps_client_pull.restype = c.c_longlong
     lib.mxt_ps_client_pull.argtypes = [
         c.c_void_p, c.c_int, c.POINTER(c.c_float), c.c_ulonglong]

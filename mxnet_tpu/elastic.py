@@ -1,0 +1,345 @@
+"""Elastic multi-host training — worker-side membership session.
+
+The PS tier owns cluster membership (kvstore_server.MembershipRegistry on
+server rank 0): a monotonically increasing **membership epoch** is stamped
+on every push/pull/barrier (src/ps.cc MsgHeader.mepoch) once a job runs
+elastic, and any request from a departed membership view is rejected with a
+classified :class:`~mxnet_tpu.kvstore.KVMembershipError` — no gradient from
+a dead or stale worker can land.
+
+This module is the worker half (docs/distributed.md §elasticity):
+
+* :class:`ElasticSession` registers the worker with the registry
+  (``mb_join``), heartbeats it on a background thread, and owns the two
+  recovery transitions the fit loop drives:
+
+  - :meth:`ElasticSession.reconfigure` — a *survivor* hit a
+    ``KVMembershipError`` (a peer was lost, or a replacement joined). It
+    drains the engine under the old epoch, adopts the registry's current
+    epoch, **deterministically reshards** the data (``num_workers``/``rank``
+    become epoch-scoped through ``DataIter.set_partition`` + the
+    ``state_dict()`` position protocol), rolls back through the PR-4 guard
+    snapshot to the last consistent step, and — on the lowest surviving
+    rank — re-seeds the server weights from that snapshot (kInit bypasses
+    merge + optimizer) and publishes the restart position for joiners.
+
+  - :meth:`ElasticSession.join` — a relaunched worker
+    (``DMLC_PS_RECOVERY=1``, set by ``tools/launch.py --elastic``) waits for
+    the coordinator's published position, adopts epoch + shard, pulls the
+    current parameters, and enters the training loop at the same boundary
+    the survivors rolled back to.
+
+Knobs (docs/env_var.md): ``MXNET_ELASTIC`` switches the whole path on,
+``MXNET_ELASTIC_HEARTBEAT_S`` / ``MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S`` pace
+failure detection, ``MXNET_ELASTIC_JOIN_TIMEOUT_S`` bounds a joiner's wait,
+``MXNET_ELASTIC_MAX_RESTARTS`` caps relaunches (enforced by the launcher).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+from . import telemetry
+from .base import (MXNetError, env_bool as _env_bool,
+                   env_float as _env_float)
+
+__all__ = ["ElasticSession", "enabled", "prepare"]
+
+
+def enabled():
+    """Whether this process runs elastic (``MXNET_ELASTIC``, set for the
+    whole tree by ``tools/launch.py --elastic``)."""
+    return _env_bool("MXNET_ELASTIC")
+
+
+def prepare(kvstore, logger=None):
+    """Resolve fit's ``kvstore`` argument for an elastic job: returns
+    ``(kvstore, session_or_None)``. A ``dist_*`` type string is resolved to
+    the real store here (the session must exist — and flip the servers into
+    elastic mode — before ``init_optimizer`` touches the PS); anything that
+    is not a distributed PS-backed store trains as usual with no session.
+    """
+    logger = logger or logging.getLogger(__name__)
+    from . import kvstore as kvs
+
+    if isinstance(kvstore, str) and "dist" in kvstore:
+        kvstore = kvs.create(kvstore)
+    if isinstance(kvstore, kvs.KVStoreDist):
+        session = ElasticSession(kvstore, logger=logger)
+        session.start()
+        return kvstore, session
+    logger.warning(
+        "MXNET_ELASTIC is set but kvstore %r is not a distributed PS "
+        "store — training continues without elasticity", kvstore)
+    return kvstore, None
+
+
+class ElasticSession:
+    """One worker's membership session (see module docstring)."""
+
+    def __init__(self, kv, logger=None):
+        self._kv = kv
+        self.rank = kv.rank
+        self.logger = logger or logging.getLogger(__name__)
+        self._hb_interval = _env_float("MXNET_ELASTIC_HEARTBEAT_S", 1.0)
+        self._join_timeout = _env_float("MXNET_ELASTIC_JOIN_TIMEOUT_S", 300.0)
+        self.joining = bool(kv.is_recovery)
+        # effective (num_workers, rank) under the current membership —
+        # epoch-scoped: reconfigure()/join() move it, the data partition
+        # follows it
+        self.effective = (kv.num_workers, kv.rank)
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._closed = False
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self):
+        """Flip the servers into elastic mode, register with the registry,
+        and start heartbeating. Idempotent per process."""
+        if self._hb_thread is not None:
+            return
+        self._kv.elastic_enable()
+        if not self._kv.registry_command("mb_join:%d" % self.rank):
+            raise MXNetError(
+                "elastic: membership registry (server 0) did not "
+                "acknowledge the join — is the cluster up?")
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, daemon=True,
+            name="mxnet-elastic-heartbeat")
+        self._hb_thread.start()
+
+    def _hb_loop(self):
+        while not self._stop.wait(self._hb_interval):
+            if not self._kv.registry_command("mb_hb:%d" % self.rank):
+                # bounded probe already timed out; count it (always-on) so a
+                # flapping registry is visible — the registry treats the
+                # missing beat as lapse evidence, which is the correct
+                # failure semantics for an unreachable worker anyway
+                telemetry.counter("kv.membership.heartbeat_failures").inc()
+
+    def close(self, done=True):
+        """Stop heartbeating; ``done=True`` additionally reports graceful
+        end-of-training (the registry stops lapse-monitoring and tells any
+        late-relaunched worker to exit instead of waiting to join)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if done:
+            self._kv.registry_command("mb_done:%d" % self.rank)
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+
+    # ---- registry views --------------------------------------------------
+    def sync(self, timeout_s=None):
+        """Fetch the membership table, retrying within ``timeout_s``."""
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self._hb_interval * 10)
+        while True:
+            raw = self._kv.registry_fetch("mb_get")
+            if raw:
+                try:
+                    return json.loads(raw.decode())
+                except ValueError:
+                    pass  # torn publish: retry below
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    "elastic: membership registry unreachable (no table "
+                    "within the deadline)")
+            time.sleep(min(self._hb_interval / 2.0, 0.2))
+
+    def _shard_of(self, table):
+        workers = table["workers"]
+        if self.rank not in workers:
+            return None
+        return (len(workers), workers.index(self.rank))
+
+    # ---- survivor path ---------------------------------------------------
+    def reconfigure(self, module, train_data, guard):
+        """Recover from a ``KVMembershipError``: adopt the new membership,
+        reshard, roll back to the guard's last snapshot, and (on the
+        coordinator — the lowest surviving rank) re-seed the servers and
+        publish the restart position. Returns ``(epoch, nbatch,
+        iter_restored)`` exactly like ``guard.rollback`` — fit resumes its
+        inner loop there."""
+        kv = self._kv
+        if guard is None or guard.last_snapshot is None:
+            raise MXNetError(
+                "elastic: membership changed but no guard snapshot exists "
+                "to roll back to (fit enables a rollback guard "
+                "automatically in elastic mode — was the guard disabled?)")
+        guard.suspend_watchdog()
+        # 1. drain the engine UNDER THE OLD EPOCH: every in-flight async
+        # push either completed in the old membership or was rejected; run
+        # it twice so an error recorded during the first wait's own drain
+        # cannot survive into the post-reconfiguration stream
+        from .kvstore import KVMembershipError
+
+        for _ in range(2):
+            try:
+                kv._engine.wait_all()
+            except KVMembershipError:
+                pass  # expected: that is the event being recovered from
+        # 2. adopt the registry's current membership (rejoin if the
+        # registry presumed US dead — e.g. a long stall outlived the
+        # heartbeat timeout while the process stayed alive)
+        for attempt in range(10):
+            table = self.sync()
+            shard = self._shard_of(table)
+            if shard is not None:
+                break
+            self.logger.warning(
+                "elastic: registry evicted this worker (rank %d) — "
+                "rejoining", self.rank)
+            kv.registry_command("mb_join:%d" % self.rank)
+        else:
+            raise MXNetError(
+                "elastic: could not rejoin the membership after eviction")
+        epoch = int(table["epoch"])
+        kv.set_membership_epoch(epoch)
+        new_nw, new_rank = shard
+        old_nw, old_rank = self.effective
+        # 3. epoch-scoped reshard: the survivors repartition the data over
+        # the new membership; the guard rollback below repositions the
+        # resharded stream to the snapshot's batch via the iterator
+        # position protocol (state_dict/load_state)
+        if (new_nw, new_rank) != (old_nw, old_rank):
+            set_part = getattr(train_data, "set_partition", None)
+            if set_part is not None:
+                set_part(new_nw, new_rank)
+                telemetry.event("reshard", num_workers=new_nw,
+                                rank=new_rank, epoch=epoch)
+            else:
+                self.logger.warning(
+                    "elastic: %s has no set_partition — continuing on the "
+                    "old shard (duplicate/missing samples until the next "
+                    "restart)", type(train_data).__name__)
+        self.effective = (new_nw, new_rank)
+        # 4. roll back params/optimizer-counts/RNG/iterator to the last
+        # consistent step (every survivor holds the SAME snapshot: BSP
+        # lockstep + a shared snapshot cadence)
+        r_epoch, r_nbatch, iter_restored = guard.rollback(module, train_data)
+        # 5. BSP arithmetic follows the membership: grads are summed over
+        # new_nw workers now, so the effective batch changed by
+        # old_nw/new_nw — keep the update scale invariant
+        self._rescale_optimizer(module, old_nw, new_nw,
+                                resend=new_rank == 0)
+        # 6. the coordinator makes the server tier consistent with the
+        # snapshot (a half-merged round was flushed server-side; some keys
+        # may have committed a round the survivors rolled back past) and
+        # publishes where training restarts so a joiner can enter
+        if new_rank == 0:
+            self._reinit_server_params(module)
+            self._publish_pos(epoch, r_epoch, r_nbatch,
+                              guard.last_snapshot.iter_state)
+        telemetry.event(
+            "elastic_reconfigured", epoch=epoch, num_workers=new_nw,
+            rank=new_rank, resume_epoch=r_epoch, resume_nbatch=r_nbatch)
+        self.logger.warning(
+            "elastic: reconfigured to membership epoch %d (%d worker(s), "
+            "this rank shard %d/%d) — resuming at epoch %d batch %d",
+            epoch, new_nw, new_rank, new_nw, r_epoch, r_nbatch)
+        return r_epoch, r_nbatch, iter_restored
+
+    def _rescale_optimizer(self, module, old_nw, new_nw, resend):
+        opt = getattr(module, "_optimizer", None)
+        if opt is None or old_nw == new_nw or not old_nw or not new_nw:
+            return
+        opt.rescale_grad = opt.rescale_grad * float(old_nw) / float(new_nw)
+        if resend and getattr(module, "_update_on_kvstore", False):
+            import pickle
+
+            # replaces the server-side updater: per-key slots (momentum,
+            # Adam moments) restart empty — a warm restart within guard
+            # tolerance, same trade the stale-.states path makes
+            self._kv._send_command_to_servers(0, pickle.dumps(opt))
+            self.logger.warning(
+                "elastic: optimizer rescaled for %d->%d workers and "
+                "re-sent to the servers (server-side optimizer state "
+                "restarts empty)", old_nw, new_nw)
+
+    def _reinit_server_params(self, module):
+        """kInit every param key from the (post-rollback) module params —
+        direct overwrite, never a merge or an optimizer step."""
+        kv = self._kv
+        names = module._exec_group.param_names
+        arg, _ = module.get_params()
+        for idx, name in enumerate(names):
+            kv._zinit(idx, arg[name].asnumpy())
+        self.logger.info(
+            "elastic: re-seeded %d server keys from the rollback snapshot",
+            len(names))
+
+    def _publish_pos(self, mepoch, epoch, nbatch, iter_state):
+        import base64
+
+        payload = json.dumps({
+            "mepoch": mepoch,   # joiners ignore a pos from an older epoch
+            "epoch": epoch,
+            "nbatch": nbatch,
+            "iter_state": iter_state,
+        }).encode()
+        self._kv.registry_command(
+            b"mb_pos:" + base64.b64encode(payload))
+
+    # ---- joiner path -----------------------------------------------------
+    def join(self, module, train_data):
+        """Relaunched-worker entry: wait for the coordinator's published
+        restart position, adopt epoch + shard, pull the current parameters,
+        and return ``(begin_epoch, resume_state)`` for fit's resume
+        machinery — or ``None`` when the registry reports training already
+        finished (the process should exit cleanly instead of waiting for a
+        rendezvous that will never come)."""
+        kv = self._kv
+        deadline = time.monotonic() + self._join_timeout
+        while True:
+            table = self.sync()
+            if table.get("done"):
+                self.logger.info(
+                    "elastic: training already finished — nothing to rejoin")
+                return None
+            pos = table.get("pos")
+            shard = self._shard_of(table)
+            if pos is not None and shard is not None and \
+                    int(pos.get("mepoch", -1)) == int(table["epoch"]):
+                break
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    "elastic: join timed out waiting for the survivors' "
+                    "restart position (MXNET_ELASTIC_JOIN_TIMEOUT_S)")
+            time.sleep(min(self._hb_interval / 2.0, 0.2))
+        epoch = int(table["epoch"])
+        kv.set_membership_epoch(epoch)
+        new_nw, new_rank = shard
+        old_nw = self.effective[0]
+        self.effective = (new_nw, new_rank)
+        set_part = getattr(train_data, "set_partition", None)
+        if set_part is not None:
+            set_part(new_nw, new_rank)
+            telemetry.event("reshard", num_workers=new_nw, rank=new_rank,
+                            epoch=epoch)
+        # current params: the coordinator re-seeded the servers from its
+        # snapshot before publishing pos, so this pull IS the snapshot
+        self._pull_params(module)
+        self._rescale_optimizer(module, old_nw, new_nw, resend=False)
+        telemetry.event(
+            "worker_rejoined", epoch=epoch, num_workers=new_nw,
+            rank=new_rank, resume_epoch=pos["epoch"],
+            resume_nbatch=pos["nbatch"])
+        self.logger.warning(
+            "elastic: joined membership epoch %d as shard %d/%d — entering "
+            "at epoch %d batch %d", epoch, new_rank, new_nw,
+            pos["epoch"], pos["nbatch"])
+        return int(pos["epoch"]), {"nbatch": int(pos["nbatch"]),
+                                   "iter_state": pos.get("iter_state")}
+
+    def _pull_params(self, module):
+        kv = self._kv
+        group = module._exec_group
+        for idx, arrs in enumerate(group.param_arrays):
+            kv.pull(idx, arrs, priority=-idx)
+        # refresh the host dicts so checkpoints/fused uploads see the
+        # pulled weights, not this process's fresh random init
+        group.get_params(module._arg_params, module._aux_params)

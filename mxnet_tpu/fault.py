@@ -23,6 +23,13 @@ recovery path is testable in a single process, byte-for-byte reproducibly:
 * ``bad_record`` — ImageRecordIter's per-record decode: makes the record
   undecodable to exercise the quarantine/budget path
   (``MXNET_IO_MAX_BAD_RECORDS``).
+* ``kill_worker`` — the fit loop's per-batch seam (base_module.py): SIGKILLs
+  this process — no exit hooks, no final flush, the closest in-process
+  analog of a machine loss. The optional ``rank=N`` arg targets one worker
+  of a launched cluster (every process inherits the same
+  ``MXNET_FAULT_SPEC``); combine with ``after=K`` to die mid-epoch at batch
+  K. Drives the elastic kill→reconfigure→rejoin cycle
+  (docs/distributed.md §elasticity, tools/launch.py --elastic).
 
 Faults are described by a spec string, either in ``MXNET_FAULT_SPEC`` (so a
 whole process tree — e.g. launched PS servers — inherits them) or pushed
@@ -51,7 +58,7 @@ from contextlib import contextmanager
 from .base import MXNetError, env_str as _env_str
 
 __all__ = ["InjectedFault", "InjectedCrash", "hit", "inject", "reset",
-           "crash_after_bytes"]
+           "crash_after_bytes", "kill_worker"]
 
 
 class InjectedFault(MXNetError):
@@ -123,16 +130,25 @@ def inject(spec):
             _spec_stack.remove(rules)
 
 
-def _arm(name, require=None):
+def _arm(name, require=None, match=None):
     """Shared after/times gating (caller holds ``_lock``): find ``name``'s
-    rule (with arg ``require``, when given), count the hit, and return the
-    rule if it should fire — NOT yet marked fired, so the caller decides
-    whether firing happens now (:func:`hit`) or when a stream wrapper later
-    exhausts its budget (:func:`crash_after_bytes` → :func:`consume`)."""
+    rule (with arg ``require``, when given; with every ``match`` item equal
+    to the rule's same-named arg when that arg is present), count the hit,
+    and return the rule if it should fire — NOT yet marked fired, so the
+    caller decides whether firing happens now (:func:`hit`) or when a
+    stream wrapper later exhausts its budget (:func:`crash_after_bytes` →
+    :func:`consume`)."""
     for r in _active_rules():
         if r["point"] != name:
             continue
         if require is not None and require not in r["args"]:
+            continue
+        if match is not None and any(
+                k in r["args"] and r["args"][k] != str(v)
+                for k, v in match.items()):
+            # the rule targets a different value (e.g. rank=1 on rank 0):
+            # not this caller's rule — and not a counted hit either, so the
+            # target's after=/times= budget is untouched
             continue
         args = r["args"]
         r["hits"] += 1
@@ -187,6 +203,26 @@ def crash_after_bytes(name):
         if rule is None:
             return None
         return int(rule["args"]["crash_after_bytes"])
+
+
+def kill_worker(rank=None):
+    """Injection point for elastic training tests: when a ``kill_worker``
+    rule fires — and its ``rank=`` arg (if any) matches ``rank`` — SIGKILL
+    this process. No exit hooks run and nothing is flushed: everything
+    except the supervising launcher sees a machine loss. Called from the
+    fit loop once per batch (``after=K`` dies mid-epoch at batch K)."""
+    with _lock:
+        rule = _arm("kill_worker",
+                    match=None if rank is None else {"rank": int(rank)})
+        if rule is None:
+            return
+        rule["fired"] += 1
+    from . import telemetry
+
+    telemetry.counter("fault.injections", point="kill_worker").inc()
+    import signal
+
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 def consume(name):

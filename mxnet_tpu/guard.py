@@ -532,6 +532,13 @@ class TrainingGuard:
         else the current fit's ``auto_resume`` prefix."""
         return self.policy.checkpoint_prefix or self._default_prefix
 
+    @property
+    def last_snapshot(self):
+        """The last in-memory restore point (or None) — elastic
+        reconfiguration reads its position/iterator state to publish the
+        cluster-wide restart point (elastic.py)."""
+        return self._snapshot
+
     # ---- lifecycle -------------------------------------------------------
     def start(self):
         if self.policy.stall_timeout_s <= 0:

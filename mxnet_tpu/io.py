@@ -262,6 +262,17 @@ class ResizeIter(DataIter):
         self.cur = int(state["cur"])
         self.current_batch = None
 
+    def set_partition(self, num_parts, part_index):
+        """Elastic reshard passthrough (the resized length in batches is a
+        consumer-side bound and does not change with the shard)."""
+        inner = getattr(self.data_iter, "set_partition", None)
+        if inner is None:
+            raise MXNetError("%s does not support set_partition"
+                             % type(self.data_iter).__name__)
+        inner(num_parts, part_index)
+        self.cur = 0
+        self.current_batch = None
+
     def iter_next(self):
         if self.cur == self.size:
             return False
@@ -638,6 +649,17 @@ class DeviceFeedIter(DataIter):
         self._iter.load_state(state["inner"])
         self._start()
 
+    def set_partition(self, num_parts, part_index):
+        """Elastic reshard passthrough: park the transfer thread, reshard
+        the inner iterator, restart the feed over the new shard."""
+        inner = getattr(self._iter, "set_partition", None)
+        if inner is None:
+            raise MXNetError("%s does not support set_partition"
+                             % type(self._iter).__name__)
+        self.close()
+        inner(num_parts, part_index)
+        self._start()
+
     def close(self):
         """Stop the transfer thread (terminal: ``next()`` raises)."""
         if not hasattr(self, "_stop"):
@@ -734,26 +756,64 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data", label_name="softmax_label",
-                 wire=None):
+                 wire=None, num_parts=1, part_index=0, seed=None):
         super().__init__(batch_size)
         self._wire = wire
-        self.data = _init_data(data, allow_empty=False, default_name=data_name)
-        self.label = _init_data(label, allow_empty=True, default_name=label_name)
-        self.idx = np.arange(self.data[0][1].shape[0])
-        if shuffle:
-            np.random.shuffle(self.idx)
-            self.data = [(k, array(v.asnumpy()[self.idx], v.context)) for k, v in self.data]
-            self.label = [(k, array(v.asnumpy()[self.idx], v.context)) for k, v in self.label]
-        if last_batch_handle == "discard":
-            new_n = self.data[0][1].shape[0] - self.data[0][1].shape[0] % batch_size
-            data_dict = dict(self.data)
-            label_dict = dict(self.label)
-            for k, _ in self.data:
-                data_dict[k] = data_dict[k][:new_n]
-            for k, _ in self.label:
-                label_dict[k] = label_dict[k][:new_n]
-            self.data = [(k, data_dict[k]) for k, _ in self.data]
-            self.label = [(k, label_dict[k]) for k, _ in self.label]
+        # the FULL arrays are kept: elastic resharding (set_partition)
+        # re-slices them under a new (num_parts, part_index)
+        self._full_data = _init_data(data, allow_empty=False,
+                                     default_name=data_name)
+        self._full_label = _init_data(label, allow_empty=True,
+                                      default_name=label_name)
+        self._shuffle = shuffle
+        self._seed = seed
+        self.batch_size = batch_size
+        self.last_batch_handle = last_batch_handle
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
+        self._apply_partition()
+        if self._shuffle and self._seed is None:
+            # set_partition refuses unseeded shuffles (irreproducible), so
+            # the originals would never be re-sliced: don't pin a second
+            # copy of the dataset for the legacy shuffle=True path
+            self._full_data, self._full_label = self.data, self.label
+
+    def _apply_partition(self):
+        """(Re)build the iteration arrays for the current partition:
+        contiguous part ``part_index`` of ``num_parts`` (the dmlc
+        InputSplit contract), then the optional shuffle — seeded when
+        ``seed=`` was given (reproducible: the elastic reshard and the
+        dist-determinism tests rely on it), else the legacy global-RNG
+        shuffle."""
+        assert 0 <= self.part_index < self.num_parts
+        data, label = self._full_data, self._full_label
+        n_total = data[0][1].shape[0]
+        lo, hi = 0, n_total
+        if self.num_parts > 1:
+            n = n_total // self.num_parts
+            lo, hi = self.part_index * n, (self.part_index + 1) * n
+
+        def cut(pairs):
+            if (lo, hi) == (0, n_total):
+                return list(pairs)
+            return [(k, array(v.asnumpy()[lo:hi], v.context))
+                    for k, v in pairs]
+
+        data, label = cut(data), cut(label)
+        self.idx = np.arange(hi - lo)
+        if self._shuffle:
+            rng = (np.random.RandomState(self._seed)
+                   if self._seed is not None else np.random)
+            rng.shuffle(self.idx)
+            data = [(k, array(v.asnumpy()[self.idx], v.context))
+                    for k, v in data]
+            label = [(k, array(v.asnumpy()[self.idx], v.context))
+                     for k, v in label]
+        if self.last_batch_handle == "discard":
+            new_n = (hi - lo) - (hi - lo) % self.batch_size
+            data = [(k, v[:new_n]) for k, v in data]
+            label = [(k, v[:new_n]) for k, v in label]
+        self.data, self.label = data, label
         self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
         # host-side mirrors for batch slicing: slicing the NDArray per batch
         # would fetch the WHOLE backing array from device every batch (the
@@ -762,10 +822,25 @@ class NDArrayIter(DataIter):
         self._host_cache = {}
         self.num_source = len(self.data_list)
         self.num_data = self.data_list[0].shape[0]
-        assert self.num_data >= batch_size, "batch_size needs to be smaller than data size."
-        self.cursor = -batch_size
-        self.batch_size = batch_size
-        self.last_batch_handle = last_batch_handle
+        assert self.num_data >= self.batch_size, \
+            "batch_size needs to be smaller than data size."
+        self.cursor = -self.batch_size
+
+    def set_partition(self, num_parts, part_index):
+        """Epoch-scoped reshard (elastic training, docs/distributed.md
+        §elasticity): re-slice the ORIGINAL arrays into the new partition
+        and rewind to its start. Deterministic — the same (arrays, seed,
+        partition) always yields the same stream; follow with
+        :meth:`load_state` to fast-forward to a mid-epoch position.
+        ``shuffle=True`` without ``seed=`` is rejected: an irreproducible
+        reshuffle would desync the workers' shards."""
+        if self._shuffle and self._seed is None:
+            raise MXNetError(
+                "NDArrayIter.set_partition with shuffle=True requires "
+                "seed= (the reshuffle must be reproducible)")
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
+        self._apply_partition()
 
     @property
     def provide_data(self):

@@ -486,6 +486,21 @@ class ImageRecordIter(DataIter):
         self._batches += 1
         return item
 
+    def set_partition(self, num_parts, part_index):
+        """Epoch-scoped reshard (elastic training, docs/distributed.md
+        §elasticity): rebuild the decode pipeline over part ``part_index``
+        of ``num_parts`` of the record stream, at the start of the current
+        (seed, epoch) — the shard order stays a pure function of
+        (seed, epoch, partition), so every worker's post-reshard stream is
+        deterministic. Follow with :meth:`load_state` to fast-forward to a
+        mid-epoch batch."""
+        assert 0 <= int(part_index) < int(num_parts)
+        self.close()
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
+        self._batches = 0
+        self._start_pipeline()
+
     def state_dict(self):
         """Resume position: the deterministic record stream is a function of
         (seed, epoch); the batch count within it completes the address."""

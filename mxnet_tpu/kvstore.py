@@ -267,6 +267,30 @@ class KVProtocolError(MXNetError):
     mismatch): not a transient transport failure, never retried."""
 
 
+class KVMembershipError(MXNetError):
+    """This worker's membership epoch is stale: the cluster reconfigured
+    (a worker was lost or joined) and the server rejected the request so no
+    gradient from a departed membership view can land. Deterministic —
+    never retried; the elastic session resyncs with the registry, rolls
+    back, reshards, and continues (docs/distributed.md §elasticity)."""
+
+    def __init__(self, msg, op=None, key=None):
+        super().__init__(msg)
+        self.op = op
+        self.key = key
+
+
+def _membership_reject(op, key):
+    """Build + count a membership rejection (always-on counter: a later
+    telemetry dump must show the reconfiguration history even with timing
+    capture off)."""
+    telemetry.counter("kv.membership.rejected", op=op).inc()
+    return KVMembershipError(
+        "kvstore %s rejected for key %s: this worker's membership epoch is "
+        "stale (the cluster reconfigured); resync with the registry before "
+        "retrying" % (op, key), op=op, key=key)
+
+
 class KVStoreDist(KVStore):
     """Multi-process distributed store over the native PS transport
     (reference: src/kvstore/kvstore_dist.h — push = local Comm.Reduce then
@@ -313,6 +337,9 @@ class KVStoreDist(KVStore):
         self._engine = get_engine()
         self._key_vars = {}
         self._update_on_kvstore = True
+        self._elastic = False  # flipped by elastic_enable()
+        self._mepoch = 0
+        self._reserved_seq = 0  # fresh reserved keys (stats + membership)
 
     # ---- helpers --------------------------------------------------------
     def _ikey(self, k):
@@ -375,10 +402,11 @@ class KVStoreDist(KVStore):
         while True:
             try:
                 return attempt_fn()
-            except KVProtocolError:
-                # deterministic disagreement (e.g. pull size mismatch), not
-                # a network blip: retrying can't change the answer and only
-                # buries the root cause under backoff noise
+            except (KVProtocolError, KVMembershipError):
+                # deterministic disagreement (pull size mismatch / stale
+                # membership epoch), not a network blip: retrying can't
+                # change the answer and only buries the root cause under
+                # backoff noise
                 raise
             except MXNetError as err:
                 # failure/retry counters are always-on (rare path): a later
@@ -441,6 +469,8 @@ class KVStoreDist(KVStore):
             rc = self._lib.mxt_ps_client_push(
                 self._client_for(ikey), ikey,
                 flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), flat.size)
+            if rc == -2:
+                raise _membership_reject("push", ikey)
             if rc != 0:
                 raise MXNetError("push rpc failed for key %d" % ikey)
 
@@ -473,6 +503,8 @@ class KVStoreDist(KVStore):
             got = self._lib.mxt_ps_client_pull(
                 self._client_for(ikey), ikey,
                 out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+            if got == -2:
+                raise _membership_reject("pull", ikey)
             if got < 0:  # transport failure (PSClient::Pull returns -1)
                 raise MXNetError("pull rpc failed for key %d" % ikey)
             if got != n:
@@ -493,8 +525,135 @@ class KVStoreDist(KVStore):
                         time.perf_counter() - t0)
         return self._with_retry("pull", ikey, attempt)
 
+    # ---- elastic membership (docs/distributed.md §elasticity) -----------
+    def elastic_enable(self):
+        """Switch every server into elastic mode: from now on push/pull/
+        barrier/init requests are membership-epoch-checked (idempotent;
+        every elastic worker sends it at session start)."""
+        self._elastic = True
+        for c in self._clients:
+            self._lib.mxt_ps_client_command(c, b"elastic:1")
+
+    @property
+    def membership_epoch(self):
+        """The epoch this worker stamps on every request."""
+        return self._mepoch
+
+    @property
+    def _elastic_join(self):
+        """True on a relaunched elastic worker before it has joined: init
+        traffic is skipped (the servers hold the trained state) and the
+        rendezvous happens in elastic.py, not the init barrier."""
+        return self._elastic and self.is_recovery
+
+    def set_membership_epoch(self, epoch):
+        """Adopt ``epoch``: every later RPC from this worker carries it.
+        Called by the elastic session after a registry sync — never
+        directly, or this worker's traffic would land in a membership view
+        it has not actually reconciled with (rollback + reshard first)."""
+        epoch = int(epoch)
+        self._mepoch = epoch
+        for c in self._clients:
+            self._lib.mxt_ps_client_set_epoch(c, epoch)
+        telemetry.gauge("kv.membership.epoch").set(epoch)
+
+    def _zinit(self, ikey, arr_np):
+        """Direct server-side weight overwrite (kInit): bypasses the BSP
+        merge AND the optimizer — the elastic coordinator re-seeds server
+        state from the survivors' rollback snapshot through this."""
+        import ctypes
+
+        flat = np.ascontiguousarray(np.asarray(arr_np).reshape(-1),
+                                    np.float32)
+
+        def attempt():
+            rc = self._lib.mxt_ps_client_init(
+                self._client_for(ikey), ikey,
+                flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                flat.size)
+            if rc == -2:
+                raise _membership_reject("init", ikey)
+            if rc != 0:
+                raise MXNetError("init rpc failed for key %d" % ikey)
+
+        self._with_retry("init", ikey, attempt)
+
+    def registry_command(self, cmd, timeout_ms=None):
+        """Deadline-bounded command to the membership registry (server 0).
+        Returns True when the registry acknowledged. Used for heartbeats
+        and membership transitions — a wedged registry must cost a bounded
+        wait, never a hang in the heartbeat thread."""
+        if timeout_ms is None:
+            _, timeout_ms = self._retry_config()
+        if isinstance(cmd, str):
+            cmd = cmd.encode()
+        return self._lib.mxt_ps_client_probe(
+            self._clients[0], cmd, timeout_ms) == 0
+
+    def _fresh_reserved_key(self):
+        """A negative key unique across workers AND calls (user keys are
+        always >= 0): the publish channel for server-pushed payloads —
+        stats vectors and the membership table. Never reused, so the
+        server-side entry is always fresh (first-push init path) and the
+        server erases it after serving the one pull (src/ps.cc kPull)."""
+        self._reserved_seq += 1
+        return -(2 + self._rank + self._reserved_seq * max(self._nw, 1))
+
+    def _bounded_pull(self, client, key, cap, timeout_ms):
+        """Pull ``key`` into a fresh ``cap``-float buffer with a deadline:
+        PSClient::Pull itself has no timeout, so it runs on a daemon thread
+        abandoned on expiry — a server that wedges after acknowledging a
+        command yields ``(None, buf)``, never a hang. The buffer stays
+        referenced by the thread's closure, so a late response writes into
+        live memory, never freed memory. Returns ``(got_floats, buf)``."""
+        import ctypes
+        import threading
+
+        buf = np.zeros(cap, np.float32)
+        result = [None]
+
+        def pull():
+            result[0] = self._lib.mxt_ps_client_pull(
+                client, key,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), cap)
+
+        t = threading.Thread(target=pull, daemon=True,
+                             name="mxnet-kv-reserved-pull")
+        t.start()
+        t.join(timeout_ms / 1000.0)
+        if t.is_alive():
+            return None, buf
+        return result[0], buf
+
+    def registry_fetch(self, cmd_prefix, timeout_ms=None):
+        """Fetch a byte payload the registry publishes on demand: sends
+        ``<cmd_prefix>:<reserved key>`` to server 0, then pulls that key.
+        Same reserved-negative-key transport as request_server_stats (the
+        command channel itself carries no payload); returns the raw bytes
+        or None when the registry did not answer in time."""
+        from .kvstore_server import decode_bytes_vec
+
+        if timeout_ms is None:
+            _, timeout_ms = self._retry_config()
+        key = self._fresh_reserved_key()
+        cmd = ("%s:%d" % (cmd_prefix, key)).encode()
+        if self._lib.mxt_ps_client_probe(self._clients[0], cmd,
+                                         timeout_ms) != 0:
+            return None
+        cap = 65536
+        got, buf = self._bounded_pull(self._clients[0], key, cap, timeout_ms)
+        if got is None or got <= 0 or got > cap:
+            return None
+        return decode_bytes_vec(buf[:got])
+
     # ---- API ------------------------------------------------------------
     def init(self, key, value):
+        if self._elastic_join:
+            # elastic rejoin: the servers already hold the trained weights —
+            # pushing this process's fresh random init would feed the BSP
+            # merge, and the survivors' rendezvous happens at the elastic
+            # session layer, not here
+            return
         keys, single = _key_list(key)
         if single:
             values = [[value]] if isinstance(value, NDArray) else [list(value)]
@@ -539,6 +698,12 @@ class KVStoreDist(KVStore):
             self._comm.broadcast(src, os_)
 
     def set_optimizer(self, optimizer):
+        if self._elastic_join:
+            # elastic rejoin: the servers kept their optimizer; re-sending
+            # would reset server-side state, and the barrier would desync
+            # the survivors (their single rendezvous is the elastic join)
+            self._optimizer = optimizer
+            return
         if self._rank == 0:
             # default protocol (the reference used 0 for py2 bindings; some
             # of our optimizer attrs are __slots__ classes protocol 0 rejects)
@@ -568,7 +733,10 @@ class KVStoreDist(KVStore):
         self._engine.wait_all()
 
         def attempt():
-            if self._lib.mxt_ps_client_barrier(self._clients[0]) != 0:
+            rc = self._lib.mxt_ps_client_barrier(self._clients[0])
+            if rc == -2:
+                raise _membership_reject("barrier", 0)
+            if rc != 0:
                 raise MXNetError("barrier rpc failed")
 
         # barrier synchronizes against the whole server group: probe every
@@ -644,18 +812,12 @@ class KVStoreDist(KVStore):
         Transport: the command channel carries no payload (src/ps.cc
         responds to kCommand with an empty body), so each server PUBLISHES
         its counters into its own store under a caller-chosen reserved key
-        (negative — user keys are always >= 0) via a loopback self-push,
-        and this worker pulls that key. The key is fresh per call+server, so
-        the self-push always takes the first-push init path — it can never
-        enter the BSP merge or touch the optimizer — and the server erases
-        negative-key entries after serving the pull (src/ps.cc kPull), so a
-        monitoring loop polling stats forever does not grow server memory.
-        Every round-trip is deadline-bounded (MXNET_KV_TIMEOUT_MS): a WEDGED
+        (:meth:`_fresh_reserved_key`) via a loopback self-push, and this
+        worker pulls that key back with :meth:`_bounded_pull`. Every
+        round-trip is deadline-bounded (MXNET_KV_TIMEOUT_MS): a WEDGED
         server — open socket, no replies — must produce a ``None`` entry,
         not a hang."""
-        import ctypes
         import logging
-        import threading
 
         from .kvstore_server import STATS_VEC_LEN, decode_stats_vec
 
@@ -663,10 +825,7 @@ class KVStoreDist(KVStore):
         out = {}
         for i, c in enumerate(self._clients):
             addr = "%s:%d" % self._server_addrs[i]
-            # unique across workers and calls: never reuses a key, so the
-            # server-side entry is always fresh (see docstring)
-            self._stats_seq = getattr(self, "_stats_seq", 0) + 1
-            key = -(2 + self._rank + self._stats_seq * max(self._nw, 1))
+            key = self._fresh_reserved_key()
             cmd = ("stats_to:%d" % key).encode()
             if self._lib.mxt_ps_client_probe(c, cmd, timeout_ms) != 0:
                 logging.warning(
@@ -674,31 +833,13 @@ class KVStoreDist(KVStore):
                     "command (dead or wedged?)", addr)
                 out[addr] = None
                 continue
-            # bounded pull: PSClient::Pull itself has no deadline, so it
-            # runs on a daemon thread we abandon on timeout — a server that
-            # wedges AFTER acking the command yields a None entry, not a
-            # hang. The buffer stays referenced by the thread's closure, so
-            # a late response writes into live memory, never a freed one.
-            buf = np.zeros(STATS_VEC_LEN, np.float32)
-            result = [None]
-
-            def pull(c=c, key=key, buf=buf, result=result):
-                result[0] = self._lib.mxt_ps_client_pull(
-                    c, key,
-                    buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                    STATS_VEC_LEN)
-
-            t = threading.Thread(target=pull, daemon=True,
-                                 name="mxnet-kv-stats-pull")
-            t.start()
-            t.join(timeout_ms / 1000.0)
-            got = result[0]
-            if t.is_alive() or got != STATS_VEC_LEN:
+            got, buf = self._bounded_pull(c, key, STATS_VEC_LEN, timeout_ms)
+            if got != STATS_VEC_LEN:
                 logging.warning(
                     "kvstore: server %s acknowledged stats but the pull %s "
                     "(want %d values) — wedged or mixed-version cluster?",
                     addr,
-                    "timed out" if t.is_alive() else "returned %s" % got,
+                    "timed out" if got is None else "returned %s" % got,
                     STATS_VEC_LEN)
                 out[addr] = None
                 continue

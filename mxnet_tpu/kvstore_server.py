@@ -18,14 +18,17 @@ import logging
 import os
 import pickle
 import threading
+import time
 
 import numpy as np
 
 from . import telemetry
 from ._native import COMMAND_FN, UPDATER_FN, get_lib
 
-__all__ = ["KVStoreServer", "_init_kvstore_server_module",
-           "STATS_VEC_LEN", "encode_stats_vec", "decode_stats_vec"]
+__all__ = ["KVStoreServer", "MembershipRegistry",
+           "_init_kvstore_server_module",
+           "STATS_VEC_LEN", "encode_stats_vec", "decode_stats_vec",
+           "encode_bytes_vec", "decode_bytes_vec"]
 
 # Wire format of the vector a server publishes under a reserved key when a
 # worker sends ``stats_to:<key>`` (kvstore.request_server_stats decodes it
@@ -56,6 +59,203 @@ def decode_stats_vec(arr):
         out[f] = vals[2 * i] | (vals[2 * i + 1] << 24)
     out["has_optimizer"] = bool(vals[2 * len(_STATS_COUNTER_FIELDS)])
     return out
+
+
+def encode_bytes_vec(payload):
+    """Arbitrary bytes -> float32 wire vector ``[len, b0, b1, ...]`` for the
+    reserved-key publish channel (the membership table travels as JSON this
+    way — float32 represents 0..255 and lengths to 2^24 exactly)."""
+    vec = np.empty(len(payload) + 1, np.float32)
+    vec[0] = len(payload)
+    if payload:
+        vec[1:] = np.frombuffer(payload, np.uint8)
+    return vec
+
+
+def decode_bytes_vec(arr):
+    """Inverse of :func:`encode_bytes_vec`; tolerates a buffer longer than
+    the encoded payload (pulls hand over a fixed-cap buffer)."""
+    n = int(round(float(arr[0])))
+    if n < 0 or n > len(arr) - 1:
+        return None
+    return bytes(np.asarray(np.round(arr[1:1 + n]), np.uint8))
+
+
+class MembershipRegistry:
+    """PS-coordinated cluster membership for elastic training — lives on
+    server rank 0 (docs/distributed.md §elasticity).
+
+    Workers register (``mb_join``), heartbeat (``mb_hb``), and read the
+    table (``mb_get`` + reserved-key pull). The registry owns the
+    monotonically increasing **membership epoch**: it bumps on every
+    membership change after initial formation (heartbeat lapse, explicit
+    leave, rejoin) and synchronously broadcasts ``mepoch:<epoch>:<workers>``
+    to EVERY server before the new epoch becomes visible to workers — so by
+    the time any worker adopts an epoch from the table, every server
+    already rejects the previous one. Initial formation (the first
+    ``num_workers`` joins) keeps epoch 0: a normal start must not churn.
+
+    ``broadcast`` is injectable for tests; the default sends the command to
+    each server on a deadline-bounded probe (a wedged sibling server costs
+    one timeout, never wedges the registry)."""
+
+    def __init__(self, num_workers, heartbeat_timeout_s=None,
+                 broadcast=None, logger=None):
+        from .base import env_float
+
+        self._target = int(num_workers)
+        self._timeout_s = (heartbeat_timeout_s if heartbeat_timeout_s
+                           is not None
+                           else env_float("MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S",
+                                          5.0))
+        self._logger = logger or logging.getLogger(__name__)
+        self._broadcast = (broadcast if broadcast is not None
+                           else self._broadcast_to_servers)
+        self._lock = threading.Lock()
+        self._alive = {}   # rank -> last-heartbeat monotonic time
+        self._epoch = 0
+        self._formed = False
+        self._done = False
+        self._pos = None   # restart position published by the coordinator
+        self._bcast_clients = None  # lazy: one per server, incl. loopback
+        self._stop = threading.Event()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="mxnet-kv-membership-monitor")
+        self._monitor.start()
+
+    # ---- worker-facing transitions (conn handler threads) ---------------
+    def join(self, rank):
+        """Register ``rank``; counts as its first heartbeat. Bumps the
+        epoch whenever the cluster was already formed — including a rank
+        that is still listed as alive: a rejoin of a known rank means its
+        previous incarnation died (possibly faster than the heartbeat
+        lapse could notice), and any round it half-pushed must be flushed
+        before the replacement's traffic lands."""
+        rank = int(rank)
+        with self._lock:
+            self._alive[rank] = time.monotonic()
+            if not self._formed:
+                if len(self._alive) >= self._target:
+                    self._formed = True
+                    self._logger.info(
+                        "membership: formed with workers %s (epoch %d)",
+                        sorted(self._alive), self._epoch)
+                return self._epoch
+            telemetry.event("worker_joined", rank=rank,
+                            epoch=self._epoch + 1)
+            self._bump_locked("worker %d joined" % rank)
+            return self._epoch
+
+    def heartbeat(self, rank):
+        with self._lock:
+            # only known members refresh: a heartbeat racing the lapse that
+            # evicted its sender must not resurrect it without a join (the
+            # eviction already reconfigured the cluster past it)
+            if int(rank) in self._alive:
+                self._alive[int(rank)] = time.monotonic()
+
+    def leave(self, rank):
+        """Graceful mid-training departure: same reconfiguration as a
+        lapse, minus the detection latency."""
+        with self._lock:
+            if int(rank) in self._alive:
+                del self._alive[int(rank)]
+                if self._formed:
+                    telemetry.event("worker_lost", rank=int(rank),
+                                    reason="leave", epoch=self._epoch + 1)
+                    self._bump_locked("worker %s left" % rank)
+
+    def done(self, rank):
+        """Training reached its end on ``rank``: removed WITHOUT an epoch
+        bump (every worker finishes the same boundary; reconfiguring here
+        would churn the shutdown), and the table's ``done`` flag tells any
+        late-relaunched worker to exit instead of waiting to join. Lapse
+        monitoring continues for the ranks that have NOT reported done —
+        a worker killed between a peer's completion and its own must still
+        bump the epoch, or the peer's trailing barrier would wait on it
+        forever."""
+        with self._lock:
+            self._alive.pop(int(rank), None)
+            self._done = True
+
+    def set_pos(self, payload):
+        """Record the restart position the reconfiguration coordinator
+        publishes (training epoch, nbatch, iterator state, mepoch) — the
+        joiner reads it from the table to enter at the same boundary."""
+        with self._lock:
+            self._pos = payload
+
+    def table(self):
+        """The membership table workers consume (JSON-able)."""
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "workers": sorted(self._alive),
+                "target": self._target,
+                "formed": self._formed,
+                "done": self._done,
+                "pos": self._pos,
+            }
+
+    def close(self):
+        self._stop.set()
+        self._monitor.join(timeout=5)
+
+    # ---- internals -------------------------------------------------------
+    def _bump_locked(self, why):
+        """Caller holds ``_lock``. Bump + broadcast synchronously: the new
+        epoch must be live on every server before any worker can read it."""
+        self._epoch += 1
+        # a position from the previous membership is stale — the coordinator
+        # republishes after reconfiguring under the new epoch
+        self._pos = None
+        workers = len(self._alive)
+        telemetry.counter("kv.membership.reconfigures").inc()
+        telemetry.gauge("kv.membership.epoch").set(self._epoch)
+        self._logger.warning(
+            "membership: epoch %d (%s) — %d worker(s): %s",
+            self._epoch, why, workers, sorted(self._alive))
+        self._broadcast("mepoch:%d:%d" % (self._epoch, max(workers, 1)))
+
+    def _broadcast_to_servers(self, cmd):
+        lib = get_lib()
+        if self._bcast_clients is None:
+            host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+            port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+            n = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+            self._bcast_clients = []
+            for s in range(n):
+                c = lib.mxt_ps_client_create(host.encode(), port + s)
+                self._bcast_clients.append((("%s:%d" % (host, port + s)), c))
+        timeout_ms = max(int(self._timeout_s * 1000), 1)
+        for addr, c in self._bcast_clients:
+            if not c or lib.mxt_ps_client_probe(c, cmd.encode(),
+                                                timeout_ms) != 0:
+                self._logger.error(
+                    "membership: server %s did not acknowledge %r — a stale "
+                    "epoch may briefly survive there", addr, cmd)
+
+    def _monitor_loop(self):
+        while not self._stop.wait(max(self._timeout_s / 4.0, 0.1)):
+            now = time.monotonic()
+            with self._lock:
+                # done-reported ranks were removed from _alive by done();
+                # everyone still listed is monitored even after the first
+                # mb_done (see done())
+                if not self._formed:
+                    continue
+                expired = [r for r, t in self._alive.items()
+                           if now - t > self._timeout_s]
+                for r in expired:
+                    del self._alive[r]
+                if expired:
+                    for r in expired:
+                        telemetry.event("worker_lost", rank=r,
+                                        reason="heartbeat_lapse",
+                                        epoch=self._epoch + 1)
+                    self._bump_locked(
+                        "heartbeat lapse: worker(s) %s" % sorted(expired))
 
 
 class KVStoreServer:
@@ -93,6 +293,16 @@ class KVStoreServer:
 
         self._max_update_failures = env_int(
             "MXNET_KV_SERVER_MAX_UPDATE_FAILURES", 10)
+
+        # elastic membership: server rank 0 hosts the registry
+        # (docs/distributed.md §elasticity); siblings only apply the
+        # registry's mepoch broadcasts inside the native layer
+        from .base import env_bool
+
+        self._registry = None
+        if env_bool("MXNET_ELASTIC") and \
+                int(os.environ.get("DMLC_SERVER_ID", "0")) == 0:
+            self._registry = MembershipRegistry(num_workers)
 
         # ALL python work (optimizer unpickle + update) runs on the server's
         # MAIN thread via this queue — the reference's single-threaded
@@ -172,6 +382,14 @@ class KVStoreServer:
                     # take down the conn handler; the worker sees a short
                     # pull and warns
                     logging.exception("kvstore-server: stats publish failed")
+            elif cmd.startswith(b"mb_"):
+                try:
+                    self._handle_membership(cmd)
+                except Exception:  # noqa: BLE001 — a malformed membership
+                    # command must not take down the conn handler; the
+                    # worker's bounded probe/fetch surfaces the silence
+                    logging.exception(
+                        "kvstore-server: membership command %r failed", cmd)
 
         self._apply_cb = UPDATER_FN(_apply)        # keep refs alive
         self._command_cb = COMMAND_FN(_command)
@@ -214,6 +432,33 @@ class KVStoreServer:
 
             self._exec_q.put(die)
 
+    def _handle_membership(self, cmd):
+        """Dispatch a worker's ``mb_*`` command to the registry (conn
+        handler thread). Only server 0 hosts one; a sibling or non-elastic
+        server ignores the traffic (the worker's bounded fetch times out
+        and it retries against the registry's real address)."""
+        if self._registry is None:
+            return
+        name, _, arg = cmd.decode().partition(":")
+        if name == "mb_join":
+            self._registry.join(int(arg))
+        elif name == "mb_hb":
+            self._registry.heartbeat(int(arg))
+        elif name == "mb_leave":
+            self._registry.leave(int(arg))
+        elif name == "mb_done":
+            self._registry.done(int(arg))
+        elif name == "mb_pos":
+            import json
+
+            self._registry.set_pos(
+                json.loads(base64.b64decode(arg).decode()))
+        elif name == "mb_get":
+            import json
+
+            payload = json.dumps(self._registry.table()).encode()
+            self._publish_vec(int(arg), encode_bytes_vec(payload))
+
     def _publish_stats(self, key):
         """Push this server's counters into its OWN store under ``key``
         (runs on a conn handler thread, before the command response is sent,
@@ -230,9 +475,14 @@ class KVStoreServer:
         a stats request racing a stop can never push on a freed handle —
         teardown waits for the in-flight publish (the server is still alive
         at that point, so the publish completes promptly)."""
+        self._publish_vec(key, encode_stats_vec(self.stats()))
+
+    def _publish_vec(self, key, vec):
+        """Loopback self-push of ``vec`` under reserved key ``key`` (the
+        payload channel for stats and the membership table — see
+        :meth:`_publish_stats` for the locking contract)."""
         import ctypes
 
-        vec = encode_stats_vec(self.stats())
         with self._self_client_lock:
             if self._self_client is None:
                 c = self._lib.mxt_ps_client_create(b"127.0.0.1", self._port)
@@ -245,7 +495,7 @@ class KVStoreServer:
                 self._self_client, key,
                 vec.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), vec.size)
         if rc != 0:
-            raise RuntimeError("loopback stats push failed (key %d)" % key)
+            raise RuntimeError("loopback publish push failed (key %d)" % key)
 
     def stats(self):
         """Health counters (also printed by the ``b"stats"`` client command)."""
@@ -313,6 +563,8 @@ class KVStoreServer:
         d = threading.Thread(target=drainer,
                              name="mxnet-kv-server-drainer")
         d.start()
+        if self._registry is not None:
+            self._registry.close()
         with self._self_client_lock:
             if self._self_client is not None:
                 self._lib.mxt_ps_client_destroy(self._self_client)

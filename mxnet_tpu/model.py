@@ -55,6 +55,11 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_on_kvstore):
     """(reference: model.py:79)"""
+    if getattr(kvstore, "_elastic_join", False):
+        # elastic rejoin: the running cluster's membership epoch is not
+        # adopted yet, so these pulls would be rejected — the elastic join
+        # (elastic.py) pulls the params once the restart position is known
+        return
     for idx, param_on_devs in enumerate(param_arrays):
         kvstore.init(idx, arg_params[param_names[idx]])
         if update_on_kvstore:

@@ -112,15 +112,22 @@ class BaseModule:
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
+        from .. import context as ctx_mod
+
         output_list = []
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
             pad = eval_batch.pad
-            outputs = [
-                nd.array(out.asnumpy()[0 : out.shape[0] - pad]) for out in self.get_outputs()
-            ]
+            # one bounded host materialization per batch, pinned to the cpu
+            # context: predictions must reach the host anyway, and keeping
+            # every batch device-resident until the end would grow HBM
+            # residency with dataset size — while the old default-context
+            # nd.array() wrap re-STAGED each batch on the accelerator
+            outputs = [nd.array(out[0 : out.shape[0] - pad].asnumpy(),  # fwlint: disable=host-sync-in-hot-path — result materialization (bounded, cpu-pinned): predict outputs leave the device here by design
+                                ctx=ctx_mod.cpu())
+                       for out in self.get_outputs()]
             output_list.append(outputs)
         if len(output_list) == 0:
             return output_list
@@ -132,7 +139,7 @@ class BaseModule:
                     + "in mini-batches. Maybe bucketing is used?"
                 )
             output_list2 = [
-                nd.array(np.concatenate([out[i].asnumpy() for out in output_list]))
+                nd.array(np.concatenate([out[i].asnumpy() for out in output_list]))  # fwlint: disable=host-sync-in-hot-path — merging host-resident batch results, no device sync
                 for i in range(num_outputs)
             ]
             if num_outputs == 1 and not always_output_list:
@@ -205,6 +212,27 @@ class BaseModule:
                     % resume_state["nbatch"] if resume_state else "")
         guard_obj = guard_mod.resolve(guard, checkpoint_prefix=auto_resume,
                                       logger=self.logger)
+        # elastic membership (docs/distributed.md §elasticity): resolve the
+        # kvstore + register with the PS membership registry BEFORE the
+        # first PS traffic, and make sure a rollback-capable guard exists —
+        # survivors recover from a lost worker by rolling back to its last
+        # snapshot instead of dying
+        from .. import elastic as elastic_mod
+        from .. import fault as fault_mod
+        from ..kvstore import KVMembershipError
+
+        elastic_session = None
+        if elastic_mod.enabled():
+            kvstore, elastic_session = elastic_mod.prepare(
+                kvstore, logger=self.logger)
+            if elastic_session is not None and guard_obj is None:
+                guard_obj = guard_mod.resolve(
+                    "rollback", checkpoint_prefix=auto_resume,
+                    logger=self.logger)
+        import os as _os
+
+        _fault_rank = int(_os.environ.get("DMLC_WORKER_ID", 0) or 0)
+        _fit_completed = False
         # opt-in double-buffered async device feed (docs/env_var.md
         # MXNET_FEED_DEPTH): a dedicated transfer thread keeps the next
         # batch(es) device-resident so the loop's data wait is a queue pop.
@@ -266,6 +294,17 @@ class BaseModule:
                     np.random.set_state(rng)
                 guard_mod._restore_optimizer_counts(
                     self, resume_state.get("optimizer_counts"))
+            if elastic_session is not None and elastic_session.joining:
+                # relaunched worker: rendezvous with the survivors — adopt
+                # the current membership epoch + shard, pull the server's
+                # params, and enter the loop at the published restart point
+                join_res = elastic_session.join(self, train_data)
+                if join_res is None:
+                    self.logger.info(
+                        "elastic: training already complete — nothing to do")
+                    _fit_completed = True
+                    return
+                begin_epoch, resume_state = join_res
             if validation_metric is None:
                 validation_metric = eval_metric
             if not isinstance(eval_metric, metric_mod.EvalMetric):
@@ -324,6 +363,10 @@ class BaseModule:
                     while not end_of_batch:
                         data_batch = next_data_batch
                         cur_state = next_state  # position as of THIS batch
+                        # `kill_worker` injection point (fault.py): the
+                        # machine-loss seam the elastic kill→reconfigure→
+                        # rejoin cycle is tested through
+                        fault_mod.kill_worker(_fault_rank)
                         if guard_obj is not None:
                             guard_obj.check_stall()
                         tel = telemetry.enabled()
@@ -344,21 +387,69 @@ class BaseModule:
                         # trace (span() itself no-ops when BOTH are off)
                         bad_reason = None
                         bad_applied = False
+                        membership_changed = False
                         with telemetry.span("fit.step", "fit"):
-                            self.forward_backward(data_batch)
-                            if guard_obj is not None:
-                                # sentinel BEFORE update: a bad classic-path
-                                # step is discarded with the params untouched
-                                bad_reason = guard_obj.step_check(self)
-                            if bad_reason is None:
-                                self.update()
+                            try:
+                                self.forward_backward(data_batch)
                                 if guard_obj is not None:
-                                    # fused path: fwd+bwd+update ran as one
-                                    # program — outputs observable only now,
-                                    # with the update already applied
-                                    bad_reason = guard_obj.post_check(self)
-                                    bad_applied = bad_reason is not None
+                                    # sentinel BEFORE update: a bad
+                                    # classic-path step is discarded with
+                                    # the params untouched
+                                    bad_reason = guard_obj.step_check(self)
+                                if bad_reason is None:
+                                    self.update()
+                                    if guard_obj is not None:
+                                        # fused path: fwd+bwd+update ran as
+                                        # one program — outputs observable
+                                        # only now, with the update already
+                                        # applied
+                                        bad_reason = guard_obj.post_check(
+                                            self)
+                                        bad_applied = bad_reason is not None
+                            except KVMembershipError:
+                                # the cluster reconfigured under this step
+                                # (a worker was lost or joined); without an
+                                # elastic session this stays what it was —
+                                # fatal
+                                if elastic_session is None:
+                                    raise
+                                membership_changed = True
                         t_compute = time.perf_counter() if tel else 0.0
+                        if membership_changed:
+                            # staggered failures: if ANOTHER membership
+                            # change lands while this one is being
+                            # recovered (the coordinator's re-seed or the
+                            # post-adopt traffic gets rejected), restart
+                            # recovery against the newest epoch instead of
+                            # dying mid-reconfiguration
+                            for _attempt in range(5):
+                                try:
+                                    r_epoch, r_nbatch, iter_restored = \
+                                        elastic_session.reconfigure(
+                                            self, train_data, guard_obj)
+                                    break
+                                except KVMembershipError:
+                                    self.logger.warning(
+                                        "elastic: membership changed again "
+                                        "during reconfiguration (attempt "
+                                        "%d/5) — restarting recovery",
+                                        _attempt + 1)
+                            else:
+                                raise MXNetError(
+                                    "elastic: membership kept changing "
+                                    "through 5 reconfiguration attempts — "
+                                    "giving up (the cluster is flapping)")
+                            if r_epoch != epoch:
+                                self.logger.warning(
+                                    "elastic: snapshot epoch %d != current "
+                                    "epoch %d — resuming within the current "
+                                    "epoch at its batch position", r_epoch,
+                                    epoch)
+                            eval_metric.reset()
+                            start_nbatch = (r_nbatch if iter_restored
+                                            else nbatch + 1)
+                            rolled_back = True
+                            break
                         if bad_reason is not None:
                             action = guard_obj.bad_step(bad_reason, epoch,
                                                         nbatch,
@@ -455,6 +546,7 @@ class BaseModule:
                 # finally immediately discards.
                 if _owned_feed is None or epoch < num_epoch - 1:
                     train_data.reset()
+            _fit_completed = True
         except KeyboardInterrupt:
             # the stall watchdog interrupts a wedged step via SIGINT (the
             # only signal that reaches a main thread blocked in a queue pop
@@ -464,6 +556,12 @@ class BaseModule:
                 raise guard_obj.stall_error() from None
             raise
         finally:
+            if elastic_session is not None:
+                # graceful end-of-training deregisters from the registry;
+                # a FAILED fit only stops heartbeating — the registry's
+                # lapse detection reconfigures the survivors, and the
+                # launcher's relaunch rejoins this rank
+                elastic_session.close(done=_fit_completed)
             if guard_obj is not None:
                 guard_obj.close()
             if _owned_feed is not None:

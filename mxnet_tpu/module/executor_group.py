@@ -323,7 +323,8 @@ class DataParallelExecutorGroup:
                 out_grads_slice = []
                 for grad, axis in zip(out_grads, self.output_layouts):
                     if axis >= 0:
-                        og = nd.array(grad.asnumpy()[islice], ctx=self.contexts[i])
+                        # device-side slice + transfer: no host round trip
+                        og = grad[islice].as_in_context(self.contexts[i])
                     else:
                         og = grad.copyto(self.contexts[i])
                     out_grads_slice.append(og)

@@ -256,15 +256,18 @@ class FusedFitPath:
             kv.pull(idx, out=out_arr, priority=-idx)
             pulled[name] = out_arr
         if update_on_kv:
-            # server applied its optimizer: pulled values are the new weights
+            # server applied its optimizer: pulled values are the new
+            # weights. device_put straight from the pull's backing array —
+            # the old asnumpy().astype() staged TWO host copies per key per
+            # step before every upload
             for name, arr in pulled.items():
                 st.params[name] = jax.device_put(
-                    arr.asnumpy().astype(tr.dtype), tr.param_shardings[name])
+                    arr.data, tr.param_shardings[name]).astype(tr.dtype)
         else:
             # pulled values are the globally summed grads: fused local update
             gdev = {
                 name: jax.device_put(
-                    arr.asnumpy().astype(tr.dtype), tr.param_shardings[name])
+                    arr.data, tr.param_shardings[name]).astype(tr.dtype)
                 for name, arr in pulled.items()
             }
             new_p, new_s = tr.apply_grads(

@@ -31,6 +31,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <ctime>
 #include <memory>
@@ -50,6 +51,10 @@ enum MsgType : uint32_t {
   kCommand = 6,
   kStop = 7,
   kPushPull = 8,
+  kInit = 9,         // direct weight overwrite: no merge, no optimizer —
+                     // elastic reconfiguration re-seeds server state from the
+                     // survivors' rollback snapshot through this
+  kRejectEpoch = 10, // response: request carried a stale membership epoch
 };
 
 #pragma pack(push, 1)
@@ -61,6 +66,12 @@ struct MsgHeader {
                     // blocking per-connection RPCs head-of-line-deadlock BSP
                     // rounds across keys)
   uint64_t nbytes;
+  int64_t mepoch;   // membership epoch (elastic training): the client stamps
+                    // its current epoch on every request; once the server is
+                    // in elastic mode a mismatch is answered kRejectEpoch so
+                    // no traffic from a departed membership view can land.
+                    // Last field: aggregate inits without it zero it, and 0
+                    // always matches a non-elastic server.
 };
 #pragma pack(pop)
 
@@ -189,19 +200,39 @@ class PSServer {
 
   // First push for a key initializes the weight (reference: kv.init goes
   // through the same DataHandle path, kvstore_dist_server.h:149-160).
-  void HandlePush(int key, Entry* e, const float* data, uint64_t n) {
+  // Returns false when the push's membership epoch is stale, or when a
+  // membership reconfiguration flushed the partial BSP round this push was
+  // merged into: the contribution was discarded, and the caller answers
+  // kRejectEpoch so the worker rolls back instead of believing its
+  // gradient landed.
+  bool HandlePush(int key, Entry* e, const float* data, uint64_t n,
+                  int64_t mepoch) {
     std::unique_lock<std::mutex> lk(e->mu);
+    // flush_gen_ is captured FIRST, before the epoch gate: a Reconfigure()
+    // racing this push (it stores epoch_/flush_gen_ under mu_, not e->mu)
+    // either bumps flush_gen_ before this read — then the wait below (or
+    // the gate) rejects — or after it, in which case the wait's
+    // flush_gen_ != fg comparison still rejects. Capturing it after the
+    // merge would let a flushed-and-discarded push be confirmed once the
+    // NEW membership's round commits.
+    int64_t fg = flush_gen_;
+    // re-check under the entry lock: the dispatch-time gate in Handle()
+    // and this merge are not atomic — Reconfigure() stores epoch_ before
+    // it flushes entries, so a stale push that slipped past the gate while
+    // a reconfiguration ran must be rejected HERE, or an old-membership
+    // gradient could join the fresh round
+    if (elastic_ && key >= 0 && mepoch != epoch_) return false;
     if (!e->inited) {
       e->weight.assign(data, data + n);
       e->inited = true;
       e->version++;
       e->cv.notify_all();
-      return;
+      return true;
     }
     if (e->weight.size() != n) e->weight.resize(n, 0.f);
     if (!sync_) {  // async: apply immediately (dist_server.h:199-207)
       ApplyLocked(key, e, data, n);
-      return;
+      return true;
     }
     // sync: merge; the worker completing the round applies + commits
     if (e->merged.size() != n) e->merged.assign(n, 0.f);
@@ -213,9 +244,40 @@ class PSServer {
       e->pending = 0;
       e->version++;
       e->cv.notify_all();
-    } else {
-      int64_t v = e->version;
-      e->cv.wait(lk, [&] { return e->version != v || stopping_; });
+      return true;
+    }
+    int64_t v = e->version;
+    e->cv.wait(lk, [&] {
+      return e->version != v || flush_gen_ != fg || stopping_;
+    });
+    return flush_gen_ == fg;
+  }
+
+  // Elastic membership reconfiguration (command "mepoch:<epoch>:<workers>",
+  // sent by the membership registry to every server): adopt the new epoch +
+  // worker count, discard every partially merged BSP round, and wake blocked
+  // pushers/barrier-waiters with a rejection — the survivors roll back to a
+  // consistent step and re-push, so a half-merged round from the old
+  // membership must never commit.
+  void Reconfigure(int64_t epoch, int workers) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      epoch_ = epoch;
+      if (workers > 0) num_workers_ = workers;
+      flush_gen_++;
+      for (auto& kv : entries_) {
+        Entry* e = kv.second.get();
+        std::unique_lock<std::mutex> elk(e->mu);
+        e->merged.assign(e->merged.size(), 0.f);
+        e->pending = 0;
+        e->cv.notify_all();
+      }
+    }
+    {
+      std::unique_lock<std::mutex> lk(barrier_mu_);
+      barrier_count_ = 0;
+      barrier_flush_++;
+      barrier_cv_.notify_all();
     }
   }
 
@@ -248,11 +310,48 @@ class PSServer {
   }
 
   void Handle(Conn* c, MsgHeader h, std::vector<float> buf, std::string cmd) {
+    // membership-epoch gate (elastic mode only; negative keys are the
+    // reserved diagnostic slots — stats/membership self-publish — and stay
+    // reachable from any epoch, or a stale worker could never resync)
+    if (elastic_ && h.key >= 0 &&
+        (h.type == kPush || h.type == kPull || h.type == kPushPull ||
+         h.type == kBarrier || h.type == kInit) &&
+        h.mepoch != epoch_) {
+      Respond(c, MsgHeader{kRejectEpoch, h.key, h.req_id, 0, epoch_},
+              nullptr);
+      std::unique_lock<std::mutex> lk(c->hmu);
+      if (--c->inflight == 0) c->hcv.notify_all();
+      return;
+    }
     switch (h.type) {
       case kPush: {
         Entry* e = GetEntry(h.key);
-        HandlePush(h.key, e, buf.data(), buf.size());
-        Respond(c, MsgHeader{kResp, h.key, h.req_id, 0}, nullptr);
+        bool ok = HandlePush(h.key, e, buf.data(), buf.size(), h.mepoch);
+        Respond(c, MsgHeader{ok ? kResp : kRejectEpoch, h.key, h.req_id, 0,
+                             epoch_},
+                nullptr);
+        break;
+      }
+      case kInit: {
+        // direct overwrite: no merge/optimizer and — deliberately — no
+        // version bump or notify. A pending partial round keeps waiting:
+        // the elastic protocol sends kInit after the reconfigure flush and
+        // before the coordinator's first push, so waking merged-but-blocked
+        // pushers here would return their pushes before a round committed.
+        Entry* e = GetEntry(h.key);
+        std::unique_lock<std::mutex> lk(e->mu);
+        if (elastic_ && h.key >= 0 && h.mepoch != epoch_) {
+          // same lock-held re-check as HandlePush: an overwrite from a
+          // membership that ended mid-dispatch must not land
+          lk.unlock();
+          Respond(c, MsgHeader{kRejectEpoch, h.key, h.req_id, 0, epoch_},
+                  nullptr);
+          break;
+        }
+        e->weight.assign(buf.data(), buf.data() + buf.size());
+        e->inited = true;
+        lk.unlock();
+        Respond(c, MsgHeader{kResp, h.key, h.req_id, 0, epoch_}, nullptr);
         break;
       }
       case kPull: {
@@ -264,7 +363,7 @@ class PSServer {
         std::vector<float> w = e->weight;  // copy under lock, send outside
         lk.unlock();
         Respond(c, MsgHeader{kResp, h.key, h.req_id,
-                             static_cast<uint64_t>(w.size() * sizeof(float))},
+                             static_cast<uint64_t>(w.size() * sizeof(float)), 0},
                 w.data());
         if (h.key < 0) {
           // negative keys are reserved single-shot diagnostic slots (the
@@ -278,34 +377,62 @@ class PSServer {
       }
       case kPushPull: {
         Entry* e = GetEntry(h.key);
-        HandlePush(h.key, e, buf.data(), buf.size());
+        if (!HandlePush(h.key, e, buf.data(), buf.size(), h.mepoch)) {
+          Respond(c, MsgHeader{kRejectEpoch, h.key, h.req_id, 0, epoch_},
+                  nullptr);
+          break;
+        }
         std::unique_lock<std::mutex> lk(e->mu);
         std::vector<float> w = e->weight;
         lk.unlock();
         Respond(c, MsgHeader{kResp, h.key, h.req_id,
-                             static_cast<uint64_t>(w.size() * sizeof(float))},
+                             static_cast<uint64_t>(w.size() * sizeof(float)), 0},
                 w.data());
         break;
       }
       case kBarrier: {
         std::unique_lock<std::mutex> lk(barrier_mu_);
+        // lock-held epoch re-check (see HandlePush): a stale arrival after
+        // Reconfigure() reset barrier_count_ must not count toward — or
+        // prematurely release — the new membership's smaller rendezvous
+        if (elastic_ && h.mepoch != epoch_) {
+          lk.unlock();
+          Respond(c, MsgHeader{kRejectEpoch, 0, h.req_id, 0, epoch_},
+                  nullptr);
+          break;
+        }
         int64_t gen = barrier_gen_;
+        int64_t bfg = barrier_flush_;
+        bool ok = true;
         if (++barrier_count_ >= num_workers_) {
           barrier_count_ = 0;
           barrier_gen_++;
           barrier_cv_.notify_all();
         } else {
-          barrier_cv_.wait(lk,
-                           [&] { return barrier_gen_ != gen || stopping_; });
+          barrier_cv_.wait(lk, [&] {
+            return barrier_gen_ != gen || barrier_flush_ != bfg || stopping_;
+          });
+          // a reconfiguration flushed this rendezvous: the membership the
+          // waiter was synchronizing with no longer exists
+          ok = barrier_flush_ == bfg;
         }
         lk.unlock();
-        Respond(c, MsgHeader{kResp, 0, h.req_id, 0}, nullptr);
+        Respond(c, MsgHeader{ok ? kResp : kRejectEpoch, 0, h.req_id, 0,
+                             epoch_},
+                nullptr);
         break;
       }
       case kCommand: {
         if (cmd.rfind("sync:", 0) == 0) sync_ = cmd[5] == '1';
+        if (cmd.rfind("elastic:", 0) == 0) elastic_ = cmd[8] == '1';
+        if (cmd.rfind("mepoch:", 0) == 0) {
+          long long e = 0;
+          int w = 0;
+          if (sscanf(cmd.c_str() + 7, "%lld:%d", &e, &w) == 2)
+            Reconfigure(e, w);
+        }
         if (cmd_handler_) cmd_handler_(cmd.data(), cmd.size());
-        Respond(c, MsgHeader{kResp, 0, h.req_id, 0}, nullptr);
+        Respond(c, MsgHeader{kResp, 0, h.req_id, 0, 0}, nullptr);
         break;
       }
       default:
@@ -322,7 +449,7 @@ class PSServer {
       MsgHeader h;
       if (!ReadAll(fd, &h, sizeof(h))) break;
       if (h.type == kStop) {
-        Respond(&conn, MsgHeader{kResp, 0, h.req_id, 0}, nullptr);
+        Respond(&conn, MsgHeader{kResp, 0, h.req_id, 0, 0}, nullptr);
         std::unique_lock<std::mutex> lk(stop_mu_);
         stop_requested_ = true;
         stop_cv_.notify_all();
@@ -330,7 +457,7 @@ class PSServer {
       }
       std::vector<float> buf;
       std::string cmd;
-      if (h.type == kPush || h.type == kPushPull) {
+      if (h.type == kPush || h.type == kPushPull || h.type == kInit) {
         buf.resize(h.nbytes / sizeof(float));
         if (h.nbytes && !ReadAll(fd, buf.data(), h.nbytes)) break;
       } else if (h.type == kCommand) {
@@ -357,9 +484,16 @@ class PSServer {
   }
 
   int listen_fd_ = -1;
-  int num_workers_;
+  std::atomic<int> num_workers_;
   std::atomic<bool> sync_{true};
   std::atomic<bool> stopping_{false};
+  // elastic membership: epoch checked on data-path requests once elastic_
+  // is switched on; flush_gen_/barrier_flush_ invalidate in-flight BSP
+  // rounds and barriers across a reconfiguration
+  std::atomic<bool> elastic_{false};
+  std::atomic<int64_t> epoch_{0};
+  std::atomic<int64_t> flush_gen_{0};
+  int64_t barrier_flush_ = 0;  // guarded by barrier_mu_
   bool failed_ = false;
   std::thread accept_thread_;
   std::mutex mu_;
@@ -416,10 +550,26 @@ class PSClient {
 
   bool ok() const { return fd_ >= 0; }
 
-  bool Push(int key, const float* data, uint64_t n) {
+  // Membership epoch stamped on every subsequent request (elastic mode);
+  // adopted by the Python tier after a registry sync.
+  void SetEpoch(int64_t e) { epoch_ = e; }
+  int64_t GetEpoch() const { return epoch_; }
+
+  // 0 ok, -1 transport failure, -2 stale membership epoch
+  int Push(int key, const float* data, uint64_t n) {
     Pending p;
-    if (!Send(kPush, key, &p, data, n * sizeof(float))) return false;
-    return Await(&p) >= 0;
+    if (!Send(kPush, key, &p, data, n * sizeof(float))) return -1;
+    int64_t r = Await(&p);
+    return r >= 0 ? 0 : static_cast<int>(r);
+  }
+
+  // Direct weight overwrite (kInit): bypasses merge + optimizer. Same
+  // result convention as Push.
+  int Init(int key, const float* data, uint64_t n) {
+    Pending p;
+    if (!Send(kInit, key, &p, data, n * sizeof(float))) return -1;
+    int64_t r = Await(&p);
+    return r >= 0 ? 0 : static_cast<int>(r);
   }
 
   // Pull into caller buffer of capacity cap floats; returns #floats or -1.
@@ -440,10 +590,12 @@ class PSClient {
     return Await(&p);
   }
 
-  bool Barrier() {
+  // 0 ok, -1 transport failure, -2 membership reconfiguration flushed it
+  int Barrier() {
     Pending p;
-    if (!Send(kBarrier, 0, &p, nullptr, 0)) return false;
-    return Await(&p) >= 0;
+    if (!Send(kBarrier, 0, &p, nullptr, 0)) return -1;
+    int64_t r = Await(&p);
+    return r >= 0 ? 0 : static_cast<int>(r);
   }
 
   bool Command(const char* cmd) {
@@ -506,7 +658,7 @@ class PSClient {
       pending_[id] = p;
     }
     if (out_id) *out_id = id;
-    MsgHeader h{type, key, id, nbytes};
+    MsgHeader h{type, key, id, nbytes, epoch_.load()};
     std::unique_lock<std::mutex> lk(wmu_);
     if (!WriteAll(fd_, &h, sizeof(h)) ||
         (nbytes && !WriteAll(fd_, payload, nbytes))) {
@@ -539,7 +691,10 @@ class PSClient {
         }
       }
       uint64_t n = h.nbytes / sizeof(float);
-      int64_t result = static_cast<int64_t>(n);
+      // kRejectEpoch carries no payload: -2 distinguishes a membership
+      // rejection (deterministic, never retried) from a transport -1
+      int64_t result =
+          h.type == kRejectEpoch ? -2 : static_cast<int64_t>(n);
       bool read_ok = true;
       if (p && p->out && n) {
         if (n <= p->cap) {
@@ -576,6 +731,7 @@ class PSClient {
   }
 
   int fd_ = -1;
+  std::atomic<int64_t> epoch_{0};
   std::thread reader_;
   std::mutex wmu_;   // serializes frame writes
   std::mutex pmu_;   // guards pending_/next_id_/dead_
@@ -617,7 +773,19 @@ void* mxt_ps_client_create(const char* host, int port) {
 }
 int mxt_ps_client_push(void* h, int key, const float* data,
                        unsigned long long n) {
-  return static_cast<mxt::PSClient*>(h)->Push(key, data, n) ? 0 : -1;
+  return static_cast<mxt::PSClient*>(h)->Push(key, data, n);
+}
+// Elastic membership surface: direct weight overwrite (reconfiguration
+// re-seed), and the epoch stamped on every request from this client.
+int mxt_ps_client_init(void* h, int key, const float* data,
+                       unsigned long long n) {
+  return static_cast<mxt::PSClient*>(h)->Init(key, data, n);
+}
+void mxt_ps_client_set_epoch(void* h, long long epoch) {
+  static_cast<mxt::PSClient*>(h)->SetEpoch(epoch);
+}
+long long mxt_ps_client_get_epoch(void* h) {
+  return static_cast<mxt::PSClient*>(h)->GetEpoch();
 }
 long long mxt_ps_client_pull(void* h, int key, float* out,
                              unsigned long long cap) {
@@ -629,7 +797,7 @@ long long mxt_ps_client_pushpull(void* h, int key, const float* data,
   return static_cast<mxt::PSClient*>(h)->PushPull(key, data, n, out, cap);
 }
 int mxt_ps_client_barrier(void* h) {
-  return static_cast<mxt::PSClient*>(h)->Barrier() ? 0 : -1;
+  return static_cast<mxt::PSClient*>(h)->Barrier();
 }
 int mxt_ps_client_command(void* h, const char* cmd) {
   return static_cast<mxt::PSClient*>(h)->Command(cmd) ? 0 : -1;
@@ -672,7 +840,7 @@ int mxt_ps_probe(const char* host, int port, int timeout_ms) {
     }
   }
   const char ping[] = "ping";
-  mxt::MsgHeader h{mxt::kCommand, 0, 1, sizeof(ping) - 1};
+  mxt::MsgHeader h{mxt::kCommand, 0, 1, sizeof(ping) - 1, 0};
   char buf[sizeof(h) + sizeof(ping) - 1];
   memcpy(buf, &h, sizeof(h));
   memcpy(buf + sizeof(h), ping, sizeof(ping) - 1);

@@ -23,7 +23,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 # they run on CPU-only hosts and are exempt from the hardware gate below.
 _HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
                     "test_pipeline_feed.py", "test_guard.py",
-                    "test_analysis.py"}
+                    "test_analysis.py", "test_elastic.py"}
 
 
 def pytest_configure(config):
@@ -37,6 +37,8 @@ def pytest_configure(config):
         "markers", "guard: training health-guard tests (host-only)")
     config.addinivalue_line(
         "markers", "analysis: fwlint / engine-sanitizer tests (host-only)")
+    config.addinivalue_line(
+        "markers", "elastic: elastic-membership / reshard tests (host-only)")
     config.addinivalue_line("markers", "slow: long-running tests")
 
 
