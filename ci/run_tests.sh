@@ -4,7 +4,9 @@
 #   unit      python unit tests on the virtual 8-device CPU mesh (not slow)
 #   native    C++ runtime build + native-path tests
 #   faults    fault-injection / robustness suite (fast, host-only)
-#   telemetry runtime-telemetry suite: registry/exposition/fit metrics (fast, host-only)
+#   telemetry runtime-telemetry + cluster-observability suite: registry/exposition/
+#             fit metrics/trace identity/straggler/trace_merge (host-only; slow e2e
+#             acceptance cases run when invoked directly)
 #   pipeline  input-pipeline feed suite: uint8 wire + async device feed (fast, host-only)
 #   guard     training health-guard suite: sentinel/rollback/stall/resume (fast, host-only)
 #   elastic   elastic-membership suite incl. the slow kill/rejoin e2e (host-only CPU mesh)
@@ -168,11 +170,21 @@ run_faults() {
 
 run_telemetry() {
   # runtime-telemetry tier (docs/observability.md): registry semantics under
-  # concurrent writers, Prometheus/chrome-trace exposition, fit-loop
-  # step/data-wait metrics, KV retry counters under fault injection.
-  # Host-only (no accelerator) and fast.
+  # concurrent writers, Prometheus/chrome-trace exposition (incl. the
+  # metric/doc drift gate + trace-event schema validation), fit-loop
+  # step/data-wait metrics, KV retry counters under fault injection, the
+  # MXNET_TELEMETRY_FILE end-to-end flusher case, and the cluster
+  # observability plane (trace identity, cluster_stats, straggler, mxtop,
+  # trace_merge smoke). The two slow e2e acceptance scenarios (merged
+  # multi-lane trace from a killed-worker run; delayed worker named within
+  # 5 steps) run only when this stage is invoked directly, like `elastic`.
   JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_telemetry.py \
-    -q -m "not slow"
+    tests_tpu/test_cluster_obs.py -q -m "not slow"
+  if [ "${1:-}" = "with_slow" ]; then
+    make -C mxnet_tpu/src
+    JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_cluster_obs.py \
+      -q -m "slow and telemetry"
+  fi
 }
 
 run_pipeline() {
@@ -331,7 +343,7 @@ case "$stage" in
   unit) run_unit ;;
   native) run_native ;;
   faults) run_faults ;;
-  telemetry) run_telemetry ;;
+  telemetry) run_telemetry with_slow ;;
   pipeline) run_pipeline ;;
   guard) run_guard ;;
   elastic) run_elastic ;;

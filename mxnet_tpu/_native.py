@@ -74,6 +74,9 @@ def _declare(lib):
     lib.mxt_ps_server_set_updater.argtypes = [c.c_void_p, c.c_void_p]
     lib.mxt_ps_server_set_command_handler.argtypes = [c.c_void_p, c.c_void_p]
     lib.mxt_ps_server_wait.argtypes = [c.c_void_p]
+    lib.mxt_ps_server_trace_stats.restype = c.c_int
+    lib.mxt_ps_server_trace_stats.argtypes = [
+        c.c_void_p, c.POINTER(c.c_double), c.c_int]
     lib.mxt_ps_server_destroy.argtypes = [c.c_void_p]
     lib.mxt_ps_client_create.restype = c.c_void_p
     lib.mxt_ps_client_create.argtypes = [c.c_char_p, c.c_int]
@@ -84,6 +87,8 @@ def _declare(lib):
     lib.mxt_ps_client_init.argtypes = [
         c.c_void_p, c.c_int, c.POINTER(c.c_float), c.c_ulonglong]
     lib.mxt_ps_client_set_epoch.argtypes = [c.c_void_p, c.c_longlong]
+    lib.mxt_ps_client_set_identity.argtypes = [c.c_void_p, c.c_int]
+    lib.mxt_ps_client_set_step.argtypes = [c.c_void_p, c.c_longlong]
     lib.mxt_ps_client_get_epoch.restype = c.c_longlong
     lib.mxt_ps_client_get_epoch.argtypes = [c.c_void_p]
     lib.mxt_ps_client_pull.restype = c.c_longlong

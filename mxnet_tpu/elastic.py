@@ -101,7 +101,8 @@ class ElasticSession:
         if self._hb_thread is not None:
             return
         self._kv.elastic_enable()
-        if not self._kv.registry_command("mb_join:%d" % self.rank):
+        if not self._kv.registry_command(
+                "mb_join:%d:%d" % (self.rank, self._kv.step_id)):
             raise MXNetError(
                 "elastic: membership registry (server 0) did not "
                 "acknowledge the join — is the cluster up?")
@@ -112,7 +113,11 @@ class ElasticSession:
 
     def _hb_loop(self):
         while not self._stop.wait(self._hb_interval):
-            if not self._kv.registry_command("mb_hb:%d" % self.rank):
+            # the heartbeat carries this worker's current step (trace
+            # identity): registry-side membership events can then name the
+            # training step a lapse/reconfiguration landed at
+            if not self._kv.registry_command(
+                    "mb_hb:%d:%d" % (self.rank, self._kv.step_id)):
                 # bounded probe already timed out; count it (always-on) so a
                 # flapping registry is visible — the registry treats the
                 # missing beat as lapse evidence, which is the correct
@@ -193,7 +198,7 @@ class ElasticSession:
             self.logger.warning(
                 "elastic: registry evicted this worker (rank %d) — "
                 "rejoining", self.rank)
-            kv.registry_command("mb_join:%d" % self.rank)
+            kv.registry_command("mb_join:%d:%d" % (self.rank, kv.step_id))
         else:
             raise MXNetError(
                 "elastic: could not rejoin the membership after eviction")
@@ -236,7 +241,8 @@ class ElasticSession:
                               guard.last_snapshot.iter_state)
         telemetry.event(
             "elastic_reconfigured", epoch=epoch, num_workers=new_nw,
-            rank=new_rank, resume_epoch=r_epoch, resume_nbatch=r_nbatch)
+            rank=new_rank, resume_epoch=r_epoch, resume_nbatch=r_nbatch,
+            step_id=kv.step_id)
         self.logger.warning(
             "elastic: reconfigured to membership epoch %d (%d worker(s), "
             "this rank shard %d/%d) — resuming at epoch %d batch %d",
@@ -327,7 +333,7 @@ class ElasticSession:
         telemetry.event(
             "worker_rejoined", epoch=epoch, num_workers=new_nw,
             rank=new_rank, resume_epoch=pos["epoch"],
-            resume_nbatch=pos["nbatch"])
+            resume_nbatch=pos["nbatch"], step_id=kv.step_id)
         self.logger.warning(
             "elastic: joined membership epoch %d as shard %d/%d — entering "
             "at epoch %d batch %d", epoch, new_rank, new_nw,

@@ -58,6 +58,15 @@ from .base import (MXNetError, env_float as _env_float, env_int as _env_int,
 __all__ = ["GuardError", "BadStepError", "StallError", "GuardPolicy",
            "TrainingGuard", "Sentinel", "resolve"]
 
+# Metric-name prefixes the stall watchdog's state dump covers: the runtime
+# subsystems a step can wedge in. "kv." adds the elastic-membership and
+# cluster-observability metrics (kv.membership.*, kv.straggler.*) so a
+# stall DURING a reconfiguration is self-diagnosing — the dump shows the
+# membership epoch, rejection counts, and dead-node gauge next to the
+# engine/pipeline state.
+STATE_SUMMARY_PREFIXES = ("engine.", "pipeline.", "io.", "kvstore.", "kv.",
+                          "fit.", "guard.")
+
 
 class GuardError(MXNetError):
     """Base class for health-guard failures."""
@@ -480,8 +489,7 @@ class _Watchdog:
 
     def _dump(self):
         """Log WHERE the runtime is stuck: the engine/pipeline/KV state."""
-        state = telemetry.state_summary(
-            ("engine.", "pipeline.", "io.", "kvstore.", "fit.", "guard."))
+        state = telemetry.state_summary(STATE_SUMMARY_PREFIXES)
         self.logger.error(
             "guard: no training step completed in %.1fs — stall. "
             "Runtime state: %s", self.timeout_s, state)

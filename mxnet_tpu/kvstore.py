@@ -20,8 +20,11 @@ TPU design (SURVEY §7 step 5-6):
 """
 from __future__ import annotations
 
+import json
+import logging
 import os
 import pickle
+import threading
 import time
 
 import numpy as np
@@ -32,7 +35,73 @@ from . import telemetry
 from .ndarray import NDArray
 from . import optimizer as opt
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "create", "TELEMETRY_KEY_BASE", "telemetry_slot"]
+
+# ---------------------------------------------------------------------------
+# cluster observability plane (docs/observability.md §cluster)
+# ---------------------------------------------------------------------------
+# Persistent reserved-key range on the PS tier: keys <= TELEMETRY_KEY_BASE
+# survive pulls (src/ps.cc kPersistentKeyMax — ordinary negative keys are
+# single-shot diagnostic slots erased after one read). Each worker owns ONE
+# slot and kInit-overwrites it with a compact JSON telemetry snapshot, so
+# any number of observers (`cluster_stats()`, tools/mxtop.py) can poll the
+# whole cluster's state from server 0 without touching the workers.
+TELEMETRY_KEY_BASE = -(1 << 20)
+
+
+def telemetry_slot(rank):
+    """The persistent reserved key worker ``rank`` publishes snapshots on."""
+    return TELEMETRY_KEY_BASE - int(rank)
+
+
+def _pick_straggler(snaps, factor=2.0, max_age_s=None, now=None):
+    """Name the straggling rank from per-rank snapshot windows, or None.
+
+    ``snaps`` is ``{rank: snapshot_dict_or_None}`` as published by the
+    cluster-stats publisher: each snapshot's ``window`` holds the per-stage
+    wall (data_wait / compute / kv_sync / guard — ``compute`` already net
+    of kv_sync, see ``_ClusterStatsPublisher._window``) and the step count
+    since that rank's previous publish.
+
+    Under BSP the RAW step time equalizes — every peer waits for the
+    slowest rank inside kv_sync — so ranks are compared on their SELF time
+    per step (data_wait + compute + guard, i.e. step wall minus parameter
+    sync). A rank is the straggler when its self time exceeds ``factor`` ×
+    the cluster median; its dominant stage is its largest per-step self
+    stage. Pure function: unit-testable without a cluster."""
+    per = {}
+    now = now if now is not None else time.time()
+    for r, s in snaps.items():
+        if not s:
+            continue
+        if max_age_s is not None and now - float(s.get("ts", 0)) > max_age_s:
+            continue  # stale slot: a dead/partitioned rank's frozen window
+            # must not be re-judged forever
+        w = s.get("window") or {}
+        n = w.get("steps") or 0
+        if n <= 0:
+            continue
+        stages = {k: float(w.get(k, 0.0)) / n
+                  for k in ("data_wait", "compute", "kv_sync", "guard")}
+        self_time = stages["data_wait"] + stages["compute"] + stages["guard"]
+        per[int(r)] = (self_time, stages,
+                       float(w.get("step_time", 0.0)) / n)
+    if len(per) < 2:
+        return None
+    times = sorted(t for t, _, _ in per.values())
+    # LOWER median: with an even rank count the upper median is (or ties)
+    # the straggler's own time — e.g. on 2 ranks the slow one could never
+    # exceed factor × itself, and the detector would be structurally blind
+    median = times[(len(times) - 1) // 2]
+    worst = max(per, key=lambda r: per[r][0])
+    self_time, stages, step_time = per[worst]
+    if median <= 0 or self_time < factor * median:
+        return None
+    stage = max(("data_wait", "compute", "guard"), key=lambda k: stages[k])
+    return {"rank": worst, "stage": stage,
+            "self_time": round(self_time, 6), "median": round(median, 6),
+            "ratio": round(self_time / median, 3),
+            "step_time": round(step_time, 6), "stages": stages}
 
 
 def _key_list(key):
@@ -340,6 +409,19 @@ class KVStoreDist(KVStore):
         self._elastic = False  # flipped by elastic_enable()
         self._mepoch = 0
         self._reserved_seq = 0  # fresh reserved keys (stats + membership)
+        # trace identity (docs/observability.md §cluster): every RPC from
+        # this worker carries (rank, step_id) so server-side handling can
+        # be attributed to the worker step that caused it; loopback and
+        # observer clients stay unidentified (-1) and are never recorded
+        telemetry.set_rank(self._rank)
+        for c in self._clients:
+            self._lib.mxt_ps_client_set_identity(c, self._rank)
+        self._step = 0
+        self._barrier_seq = 0
+        self._bsp_synced_step = None  # last step a bsp_sync event fired for
+        self._cluster = None  # _ClusterStatsPublisher once started
+        self._publish_inflight = None  # snapshot publish blocked on a
+        # wedged server (abandoned bounded thread; later publishes drop)
 
     # ---- helpers --------------------------------------------------------
     def _ikey(self, k):
@@ -486,6 +568,7 @@ class KVStoreDist(KVStore):
                 telemetry.histogram(
                     "kvstore.push_latency_seconds", key=ikey).observe(
                         time.perf_counter() - t0)
+            self._maybe_emit_bsp_sync()
             return
         self._with_retry("push", ikey, attempt)
 
@@ -556,6 +639,37 @@ class KVStoreDist(KVStore):
         for c in self._clients:
             self._lib.mxt_ps_client_set_epoch(c, epoch)
         telemetry.gauge("kv.membership.epoch").set(epoch)
+        # annotation for the merged timeline (tools/trace_merge.py): the
+        # instant this worker's traffic moved to the new membership view,
+        # and the step it happened at
+        telemetry.event("mepoch_adopted", epoch=epoch, step_id=self._step)
+
+    def set_step(self, step_id):
+        """Stamp ``step_id`` on every subsequent RPC from this worker (the
+        fit loop calls this each batch with ``epoch << 32 | nbatch``): the
+        servers record per-rank last-seen steps, and the chrome-trace /
+        straggler tooling correlates cross-worker activity by it."""
+        step_id = int(step_id)
+        self._step = step_id
+        for c in self._clients:
+            self._lib.mxt_ps_client_set_step(c, step_id)
+
+    @property
+    def step_id(self):
+        """The step this worker currently stamps on its RPCs."""
+        return self._step
+
+    def _maybe_emit_bsp_sync(self):
+        """One ``bsp_sync`` event per step, fired when this step's FIRST
+        push response arrives: the server releases a merged BSP round to
+        every worker within microseconds, so the event's wall timestamp is
+        a cross-worker sync point trace_merge estimates clock offsets from.
+        Runs on engine threads — the check-and-set races benignly (a rare
+        duplicate event for one step; trace_merge keeps the first)."""
+        step = self._step
+        if step != self._bsp_synced_step:
+            self._bsp_synced_step = step
+            telemetry.event("bsp_sync", step_id=step)
 
     def _zinit(self, ikey, arr_np):
         """Direct server-side weight overwrite (kInit): bypasses the BSP
@@ -591,13 +705,21 @@ class KVStoreDist(KVStore):
             self._clients[0], cmd, timeout_ms) == 0
 
     def _fresh_reserved_key(self):
-        """A negative key unique across workers AND calls (user keys are
-        always >= 0): the publish channel for server-pushed payloads —
-        stats vectors and the membership table. Never reused, so the
-        server-side entry is always fresh (first-push init path) and the
-        server erases it after serving the one pull (src/ps.cc kPull)."""
+        """A negative key unique across workers and recent calls (user
+        keys are always >= 0): the publish channel for server-pushed
+        payloads — stats vectors and the membership table. The server
+        erases the entry after serving the one pull (src/ps.cc kPull), and
+        the sequence WRAPS before drifting into the observer band at
+        -(1<<19) (tools/mxtop.py) or the persistent telemetry slots at
+        TELEMETRY_KEY_BASE — reuse after a wrap is safe because negative-
+        key pushes always take the server's overwrite path, never a BSP
+        merge (src/ps.cc HandlePush)."""
         self._reserved_seq += 1
-        return -(2 + self._rank + self._reserved_seq * max(self._nw, 1))
+        key = -(2 + self._rank + self._reserved_seq * max(self._nw, 1))
+        if key <= -(1 << 19):
+            self._reserved_seq = 1
+            key = -(2 + self._rank + max(self._nw, 1))
+        return key
 
     def _bounded_pull(self, client, key, cap, timeout_ms):
         """Pull ``key`` into a fresh ``cap``-float buffer with a deadline:
@@ -742,7 +864,26 @@ class KVStoreDist(KVStore):
         # barrier synchronizes against the whole server group: probe every
         # server (ikey=None), not just shard 0, so a dead non-zero server
         # fails fast with its own name instead of burning retries
-        self._with_retry("barrier", None, attempt)
+        from . import profiler
+
+        if not telemetry.enabled() and not profiler.is_running():
+            self._with_retry("barrier", None, attempt)
+            return
+        # barrier release is simultaneous across the whole membership: the
+        # seq-stamped event (and the span's end on the chrome timeline) is
+        # the strongest cross-worker sync point trace_merge aligns clocks
+        # from. BSP issues the same barrier sequence on every rank, but seq
+        # restarts in a RELAUNCHED worker — so the step id rides along and
+        # trace_merge keys sync points by (seq, step), which a replacement
+        # incarnation's restarted numbering cannot falsely collide with.
+        self._barrier_seq += 1
+        t0 = time.perf_counter()
+        with telemetry.span("kv.barrier", "kvstore", seq=self._barrier_seq,
+                            step_id=self._step):
+            self._with_retry("barrier", None, attempt)
+        telemetry.event("barrier", seq=self._barrier_seq,
+                        step_id=self._step,
+                        wait_s=round(time.perf_counter() - t0, 6))
 
     def get_num_dead_node(self, node_id=0, timeout=120):
         """Probe each PS server on a FRESH deadline-bounded connection —
@@ -846,6 +987,191 @@ class KVStoreDist(KVStore):
             out[addr] = decode_stats_vec(buf)
         return out
 
+    # ---- cluster observability (docs/observability.md §cluster) ----------
+    def _snapshot_cumulative(self):
+        """Cumulative per-stage walls + step count from the LOCAL registry
+        (label sets rolled up via :func:`telemetry.totals`). ``kv_sync`` is
+        everything spent synchronizing parameters: push + pull latency and
+        barrier waits."""
+        steps, step_sum = telemetry.totals("fit.step_time_seconds")
+        _, data_wait = telemetry.totals("fit.data_wait_seconds")
+        _, compute = telemetry.totals("fit.compute_seconds")
+        _, guard = telemetry.totals("fit.guard_seconds")
+        _, push = telemetry.totals("kvstore.push_latency_seconds")
+        _, pull = telemetry.totals("kvstore.pull_latency_seconds")
+        _, barrier = telemetry.totals("kv.barrier")
+        return {"steps": steps, "step_time": step_sum,
+                "data_wait": data_wait, "compute": compute,
+                "kv_sync": push + pull + barrier, "guard": guard}
+
+    def build_cluster_snapshot(self, window=None, cum=None):
+        """This worker's compact telemetry snapshot (JSON-able): identity
+        (rank / step / membership epoch), throughput, queue depths, key
+        always-on counters, the cumulative per-step split, and — when the
+        publisher provides one — the ``window`` delta since the previous
+        publish that straggler attribution compares across ranks."""
+        snap = {
+            "rank": self._rank,
+            "ts": time.time(),
+            "step_id": self._step,
+            "mepoch": self._mepoch,
+            "imgs_per_sec": telemetry.totals("fit.imgs_per_sec")[1],
+            "queues": {
+                "engine": telemetry.totals("engine.queue_depth")[1],
+                "feed": telemetry.totals("pipeline.feed_depth")[1],
+            },
+            "counters": {
+                "rejected": telemetry.totals("kv.membership.rejected")[1],
+                "rpc_failures": telemetry.totals("kvstore.rpc_failures")[1],
+                "dead_nodes": telemetry.totals("kvstore.dead_nodes")[1],
+                "bad_steps": telemetry.totals("guard.bad_steps")[1],
+            },
+            "cum": cum if cum is not None else self._snapshot_cumulative(),
+        }
+        if window is not None:
+            snap["window"] = window
+        return snap
+
+    def publish_cluster_snapshot(self, snap=None):
+        """kInit this worker's snapshot into its persistent telemetry slot
+        on server 0 (:func:`telemetry_slot` — overwrite semantics, no BSP
+        merge, readable from any membership epoch). Advisory: a failed
+        publish is counted, never raised into training — including against
+        a WEDGED server: the init runs deadline-bounded on an abandoned
+        daemon thread (same contract as :meth:`_bounded_pull`), and while
+        one publish is still in flight later ones are dropped instead of
+        stacking blocked threads. Returns the snapshot, or None when the
+        publish failed."""
+        import ctypes
+
+        from .kvstore_server import encode_bytes_vec
+
+        if snap is None:
+            snap = self.build_cluster_snapshot()
+        if self._publish_inflight is not None \
+                and self._publish_inflight.is_alive():
+            telemetry.counter("kv.cluster.publish_failures").inc()
+            return None
+        vec = encode_bytes_vec(json.dumps(snap).encode())
+        result = [None]
+
+        def init():
+            # vec stays referenced by this closure: a late response from a
+            # recovering server writes into live memory, never freed memory
+            result[0] = self._lib.mxt_ps_client_init(
+                self._clients[0], telemetry_slot(self._rank),
+                vec.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), vec.size)
+
+        _, timeout_ms = self._retry_config()
+        t = threading.Thread(target=init, daemon=True,
+                             name="mxnet-kv-snapshot-publish")
+        t.start()
+        t.join(timeout_ms / 1000.0)
+        if t.is_alive():
+            self._publish_inflight = t
+            telemetry.counter("kv.cluster.publish_failures").inc()
+            return None
+        self._publish_inflight = None
+        if result[0] != 0:
+            telemetry.counter("kv.cluster.publish_failures").inc()
+            return None
+        return snap
+
+    def _pull_published_json(self, client, key, timeout_ms, cap=65536):
+        """Deadline-bounded pull of a bytes-vec-encoded JSON payload under
+        ``key``, or None on timeout / short read / undecodable payload —
+        the shared tail of every published-table fetch (snapshots, server
+        traces)."""
+        from .kvstore_server import decode_bytes_vec
+
+        got, buf = self._bounded_pull(client, key, cap, timeout_ms)
+        if got is None or got <= 0 or got > cap:
+            return None
+        raw = decode_bytes_vec(buf[:got])
+        if not raw:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except ValueError:
+            return None
+
+    def fetch_cluster_snapshot(self, rank, timeout_ms=None):
+        """Pull rank ``rank``'s last published snapshot from server 0, or
+        None when the slot is empty / unreadable / the pull timed out."""
+        if timeout_ms is None:
+            _, timeout_ms = self._retry_config()
+        return self._pull_published_json(self._clients[0],
+                                         telemetry_slot(rank), timeout_ms)
+
+    def cluster_stats(self, timeout_ms=None, max_age_s=30.0):
+        """Merged per-rank telemetry tables for the whole cluster
+        (docs/observability.md §cluster): ``{"workers": {rank:
+        snapshot|None}, "mepoch": max adopted epoch, "straggler":
+        attribution|None}``. Any process that can reach server 0 — a
+        worker, or an observer like ``tools/mxtop.py`` — gets the same
+        view, because the data is the workers' published slots, not local
+        state. ``max_age_s`` keeps a dead rank's frozen slot out of the
+        straggler verdict (its last snapshot persists server-side)."""
+        from .base import env_float
+
+        workers = {r: self.fetch_cluster_snapshot(r, timeout_ms)
+                   for r in range(self._nw)}
+        mepochs = [s["mepoch"] for s in workers.values() if s]
+        return {
+            "workers": workers,
+            "mepoch": max(mepochs) if mepochs else self._mepoch,
+            "straggler": _pick_straggler(
+                workers, env_float("MXNET_STRAGGLER_FACTOR", 2.0),
+                max_age_s=max_age_s),
+        }
+
+    def request_server_trace(self):
+        """Per-rank RPC attribution from every server (trace identity on
+        the wire): ``{"host:port": {"per_rank": {rank: {"last_step": ...,
+        "last_mepoch": ..., "pushes": ..., "pulls": ..., "barriers": ...,
+        "inits": ...}}} | None}`` — None for a server that did not answer
+        within the deadline. Same reserved-key transport as
+        :meth:`request_server_stats`."""
+        _, timeout_ms = self._retry_config()
+        out = {}
+        for i, c in enumerate(self._clients):
+            addr = "%s:%d" % self._server_addrs[i]
+            key = self._fresh_reserved_key()
+            cmd = ("trace_to:%d" % key).encode()
+            if self._lib.mxt_ps_client_probe(c, cmd, timeout_ms) != 0:
+                out[addr] = None
+                continue
+            out[addr] = self._pull_published_json(c, key, timeout_ms)
+        return out
+
+    def start_cluster_stats(self, interval_s=None):
+        """Start this worker's cluster-stats publisher (idempotent; the fit
+        loop calls this on dist runs). Every interval the worker publishes
+        its snapshot; rank 0 additionally merges all ranks' windows and
+        runs straggler attribution. Enables telemetry — the per-step split
+        needs timing capture, and cluster observability is on by default
+        for distributed runs (opt out with ``MXNET_CLUSTER_STATS=0``).
+        Returns the publisher, or None when disabled."""
+        from .base import env_bool, env_float
+
+        if self._cluster is not None:
+            return self._cluster
+        if not env_bool("MXNET_CLUSTER_STATS", True):
+            return None
+        if interval_s is None:
+            interval_s = env_float("MXNET_CLUSTER_STATS_INTERVAL_S", 1.0)
+        telemetry.enable()
+        self._cluster = _ClusterStatsPublisher(
+            self, interval_s, env_float("MXNET_STRAGGLER_FACTOR", 2.0))
+        self._cluster.start()
+        return self._cluster
+
+    def stop_cluster_stats(self):
+        """Stop the publisher thread (fit's exit path; idempotent)."""
+        if self._cluster is not None:
+            self._cluster.close()
+            self._cluster = None
+
     def _stop_servers(self):
         """Shut down server processes (rank 0, exit path)."""
         for c in self._clients:
@@ -857,6 +1183,108 @@ class KVStoreDist(KVStore):
                 self._lib.mxt_ps_client_destroy(c)
         except Exception:  # fwlint: disable=swallowed-exception — interpreter
             pass  # teardown: the ctypes lib global may already be gone
+
+
+class _ClusterStatsPublisher:
+    """Worker-side cluster observability daemon (docs/observability.md
+    §cluster). Every ``interval_s`` it publishes this worker's compact
+    snapshot into its persistent telemetry slot on server 0; on rank 0 of
+    a multi-worker run it ALSO merges every rank's published window and
+    runs straggler attribution: the ``kv.straggler.rank`` gauge tracks the
+    currently named rank (-1 = none) every round, and one ``kv.straggler``
+    event fires per naming (re-fires when the named rank or its dominant
+    stage changes — not every round, or the event stream would drown the
+    signal it exists to surface)."""
+
+    def __init__(self, kv, interval_s, factor):
+        self._kv = kv
+        self._interval = max(float(interval_s), 0.05)
+        self._factor = float(factor)
+        self._stop = threading.Event()
+        self._last_cum = None
+        self._named = None  # last (rank, stage) announced
+        self._logged_failure = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mxnet-kv-cluster-stats")
+
+    def start(self):
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _window(self, cum):
+        if self._last_cum is None:
+            self._last_cum = cum
+            return {k: 0.0 for k in cum}
+        d = {k: max(cum[k] - self._last_cum.get(k, 0.0), 0.0) for k in cum}
+        if d["steps"] > 0:
+            # the baseline only advances once a window carries a step: a
+            # publish interval shorter than a slow rank's step time would
+            # otherwise alternate empty/populated windows, making every
+            # other detector round inconclusive (and naming latency a
+            # phase-luck lottery) — instead an empty delta just extends
+            # into the next publish
+            self._last_cum = cum
+        # compute is reported net of parameter sync: on the classic dist
+        # path update() blocks inside pull, so the raw compute timing
+        # double-counts the kv wait and would mask the true dominant stage
+        d["compute"] = max(d["compute"] - d["kv_sync"], 0.0)
+        return d
+
+    def _loop(self):
+        kv = self._kv
+        while not self._stop.wait(self._interval):
+            try:
+                cum = kv._snapshot_cumulative()
+                kv.publish_cluster_snapshot(
+                    kv.build_cluster_snapshot(window=self._window(cum),
+                                              cum=cum))
+                if kv.rank == 0 and kv.num_workers > 1:
+                    self._attribute()
+                self._logged_failure = False
+            except Exception:
+                # advisory plane: a wedged server must degrade observability,
+                # never training. Counted always-on; logged once per outage.
+                telemetry.counter("kv.cluster.publish_failures").inc()
+                if not self._logged_failure:
+                    self._logged_failure = True
+                    logging.getLogger(__name__).warning(
+                        "kvstore: cluster-stats publish failed (will keep "
+                        "retrying quietly)", exc_info=True)
+
+    def _attribute(self):
+        kv = self._kv
+        snaps = {r: kv.fetch_cluster_snapshot(r)
+                 for r in range(kv.num_workers)}
+        max_age = max(5 * self._interval, 5.0)
+        res = _pick_straggler(snaps, self._factor, max_age_s=max_age)
+        if res is None:
+            # all-clear only when the round could actually judge: at least
+            # two fresh populated windows. An inconclusive round (ranks
+            # between steps) must neither clear the gauge nor re-arm the
+            # naming event, or the event would re-fire every other round.
+            now = time.time()
+            populated = sum(
+                1 for s in snaps.values()
+                if s and (s.get("window") or {}).get("steps", 0) > 0
+                and now - float(s.get("ts", 0)) <= max_age)
+            if populated >= 2:
+                telemetry.gauge("kv.straggler.rank").set(-1)
+                self._named = None
+            return
+        telemetry.gauge("kv.straggler.rank").set(res["rank"])
+        key = (res["rank"], res["stage"])
+        if key == self._named:
+            return
+        self._named = key
+        fields = {k: v for k, v in res.items() if k != "stages"}
+        telemetry.event("kv.straggler", step_id=kv.step_id, **fields)
+        logging.getLogger(__name__).warning(
+            "kvstore: straggler — rank %d, dominant stage %s "
+            "(%.1fx the cluster-median self time)",
+            res["rank"], res["stage"], res["ratio"])
 
 
 def _process_index():

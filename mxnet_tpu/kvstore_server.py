@@ -113,6 +113,10 @@ class MembershipRegistry:
                            else self._broadcast_to_servers)
         self._lock = threading.Lock()
         self._alive = {}   # rank -> last-heartbeat monotonic time
+        self._last_step = {}  # rank -> last training step it reported:
+        # membership events name the step a reconfiguration landed at, so
+        # a post-mortem can line the epoch bump up with the training
+        # timeline (workers report it on joins/heartbeats)
         self._epoch = 0
         self._formed = False
         self._done = False
@@ -125,7 +129,7 @@ class MembershipRegistry:
         self._monitor.start()
 
     # ---- worker-facing transitions (conn handler threads) ---------------
-    def join(self, rank):
+    def join(self, rank, step=None):
         """Register ``rank``; counts as its first heartbeat. Bumps the
         epoch whenever the cluster was already formed — including a rank
         that is still listed as alive: a rejoin of a known rank means its
@@ -135,6 +139,8 @@ class MembershipRegistry:
         rank = int(rank)
         with self._lock:
             self._alive[rank] = time.monotonic()
+            if step is not None:
+                self._last_step[rank] = int(step)
             if not self._formed:
                 if len(self._alive) >= self._target:
                     self._formed = True
@@ -143,17 +149,20 @@ class MembershipRegistry:
                         sorted(self._alive), self._epoch)
                 return self._epoch
             telemetry.event("worker_joined", rank=rank,
-                            epoch=self._epoch + 1)
+                            epoch=self._epoch + 1,
+                            last_step=self._last_step.get(rank))
             self._bump_locked("worker %d joined" % rank)
             return self._epoch
 
-    def heartbeat(self, rank):
+    def heartbeat(self, rank, step=None):
         with self._lock:
             # only known members refresh: a heartbeat racing the lapse that
             # evicted its sender must not resurrect it without a join (the
             # eviction already reconfigured the cluster past it)
             if int(rank) in self._alive:
                 self._alive[int(rank)] = time.monotonic()
+                if step is not None:
+                    self._last_step[int(rank)] = int(step)
 
     def leave(self, rank):
         """Graceful mid-training departure: same reconfiguration as a
@@ -163,7 +172,8 @@ class MembershipRegistry:
                 del self._alive[int(rank)]
                 if self._formed:
                     telemetry.event("worker_lost", rank=int(rank),
-                                    reason="leave", epoch=self._epoch + 1)
+                                    reason="leave", epoch=self._epoch + 1,
+                                    last_step=self._last_step.get(int(rank)))
                     self._bump_locked("worker %s left" % rank)
 
     def done(self, rank):
@@ -196,6 +206,10 @@ class MembershipRegistry:
                 "formed": self._formed,
                 "done": self._done,
                 "pos": self._pos,
+                # rank -> last training step it reported (joins/heartbeats):
+                # observability only — mxtop shows where each worker is, and
+                # reconfigure post-mortems line the bump up with the steps
+                "steps": dict(self._last_step),
             }
 
     def close(self):
@@ -253,7 +267,8 @@ class MembershipRegistry:
                     for r in expired:
                         telemetry.event("worker_lost", rank=r,
                                         reason="heartbeat_lapse",
-                                        epoch=self._epoch + 1)
+                                        epoch=self._epoch + 1,
+                                        last_step=self._last_step.get(r))
                     self._bump_locked(
                         "heartbeat lapse: worker(s) %s" % sorted(expired))
 
@@ -382,6 +397,22 @@ class KVStoreServer:
                     # take down the conn handler; the worker sees a short
                     # pull and warns
                     logging.exception("kvstore-server: stats publish failed")
+            elif cmd.startswith(b"trace_to:"):
+                # per-rank RPC attribution (trace identity on the wire):
+                # publish the native transport's rank table as JSON under
+                # the worker-chosen reserved key
+                # (kvstore.request_server_trace pulls it back)
+                try:
+                    import json
+
+                    payload = json.dumps(
+                        {"per_rank": self.trace_stats()}).encode()
+                    self._publish_vec(int(cmd[9:]),
+                                      encode_bytes_vec(payload))
+                except Exception:  # noqa: BLE001 — same contract as
+                    # stats_to: a failed publish degrades to a short pull
+                    # on the worker, never a dead conn handler
+                    logging.exception("kvstore-server: trace publish failed")
             elif cmd.startswith(b"mb_"):
                 try:
                     self._handle_membership(cmd)
@@ -441,9 +472,13 @@ class KVStoreServer:
             return
         name, _, arg = cmd.decode().partition(":")
         if name == "mb_join":
-            self._registry.join(int(arg))
+            # "mb_join:<rank>[:<step>]" — the optional step (elastic.py
+            # appends it) timestamps membership events in training steps
+            rank, _, step = arg.partition(":")
+            self._registry.join(int(rank), int(step) if step else None)
         elif name == "mb_hb":
-            self._registry.heartbeat(int(arg))
+            rank, _, step = arg.partition(":")
+            self._registry.heartbeat(int(rank), int(step) if step else None)
         elif name == "mb_leave":
             self._registry.leave(int(arg))
         elif name == "mb_done":
@@ -496,6 +531,29 @@ class KVStoreServer:
                 vec.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), vec.size)
         if rc != 0:
             raise RuntimeError("loopback publish push failed (key %d)" % key)
+
+    def trace_stats(self):
+        """Per-rank RPC attribution from the native transport (trace
+        identity on the wire, docs/observability.md §cluster): ``{rank:
+        {"last_step": ..., "last_mepoch": ..., "pushes": ..., "pulls": ...,
+        "barriers": ..., "inits": ...}}`` — which worker step each rank's
+        traffic last carried, and how much data-path handling this shard
+        has done for it. Served over the command channel as
+        ``trace_to:<key>``."""
+        import ctypes
+
+        cap = 7 * 256  # 256 ranks — far beyond any PS-tier deployment here
+        buf = (ctypes.c_double * cap)()
+        n = self._lib.mxt_ps_server_trace_stats(self._handle, buf, cap)
+        out = {}
+        for i in range(0, max(n, 0), 7):
+            rank, step, mepoch, pushes, pulls, barriers, inits = buf[i:i + 7]
+            out[int(rank)] = {
+                "last_step": int(step), "last_mepoch": int(mepoch),
+                "pushes": int(pushes), "pulls": int(pulls),
+                "barriers": int(barriers), "inits": int(inits),
+            }
+        return out
 
     def stats(self):
         """Health counters (also printed by the ``b"stats"`` client command)."""

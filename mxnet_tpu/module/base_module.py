@@ -233,6 +233,14 @@ class BaseModule:
 
         _fault_rank = int(_os.environ.get("DMLC_WORKER_ID", 0) or 0)
         _fit_completed = False
+        # cluster observability (docs/observability.md §cluster): resolved
+        # after init_optimizer — a PS-backed dist store gets per-batch
+        # (rank, step_id) stamping + the cluster-stats publisher; on
+        # single-process stores both stay None and the step path pays one
+        # None-check per batch, nothing more
+        _kv_obj = None
+        _kv_set_step = None
+        _kv_started_cluster = False
         # opt-in double-buffered async device feed (docs/env_var.md
         # MXNET_FEED_DEPTH): a dedicated transfer thread keeps the next
         # batch(es) device-resident so the loop's data wait is a queue pop.
@@ -258,6 +266,14 @@ class BaseModule:
                 force_init=force_init or resume_epoch is not None,
             )
             self.init_optimizer(kvstore=kvstore, optimizer=optimizer, optimizer_params=optimizer_params)
+            _kv_obj = getattr(self, "_kvstore", None)
+            _kv_set_step = getattr(_kv_obj, "set_step", None)
+            if getattr(_kv_obj, "start_cluster_stats", None) is not None \
+                    and getattr(_kv_obj, "_cluster", None) is None:
+                # fit owns the publisher only when it started it — a
+                # user-started one (idempotent start) outlives this fit
+                _kv_started_cluster = (
+                    _kv_obj.start_cluster_stats() is not None)
             if resume_epoch is not None:
                 # checkpoints written with save_optimizer_states=True also carry
                 # momentum/Adam state — restore it so the resumed run tracks the
@@ -363,6 +379,11 @@ class BaseModule:
                     while not end_of_batch:
                         data_batch = next_data_batch
                         cur_state = next_state  # position as of THIS batch
+                        if _kv_set_step is not None:
+                            # one step id across the cluster — BSP ranks run
+                            # the same (epoch, nbatch) sequence, so every PS
+                            # RPC this step issues is attributable to it
+                            _kv_set_step((epoch << 32) | nbatch)
                         # `kill_worker` injection point (fault.py): the
                         # machine-loss seam the elastic kill→reconfigure→
                         # rejoin cycle is tested through
@@ -378,6 +399,7 @@ class BaseModule:
                                 telemetry.counter("fit.batches"),
                                 telemetry.counter("fit.samples"),
                                 telemetry.gauge("fit.imgs_per_sec"),
+                                telemetry.histogram("fit.guard_seconds"),
                             )
                         t_step = time.perf_counter() if tel else 0.0
                         if monitor is not None:
@@ -388,14 +410,22 @@ class BaseModule:
                         bad_reason = None
                         bad_applied = False
                         membership_changed = False
-                        with telemetry.span("fit.step", "fit"):
+                        # epoch/nbatch args let trace_merge match the same
+                        # BSP step across worker lanes in the merged trace
+                        with telemetry.span("fit.step", "fit",
+                                            epoch=epoch, nbatch=nbatch):
                             try:
                                 self.forward_backward(data_batch)
                                 if guard_obj is not None:
                                     # sentinel BEFORE update: a bad
                                     # classic-path step is discarded with
                                     # the params untouched
+                                    t_guard = (time.perf_counter() if tel
+                                               else 0.0)
                                     bad_reason = guard_obj.step_check(self)
+                                    if tel:
+                                        fit_instruments[6].observe(
+                                            time.perf_counter() - t_guard)
                                 if bad_reason is None:
                                     self.update()
                                     if guard_obj is not None:
@@ -403,8 +433,13 @@ class BaseModule:
                                         # one program — outputs observable
                                         # only now, with the update already
                                         # applied
+                                        t_guard = (time.perf_counter() if tel
+                                                   else 0.0)
                                         bad_reason = guard_obj.post_check(
                                             self)
+                                        if tel:
+                                            fit_instruments[6].observe(
+                                                time.perf_counter() - t_guard)
                                         bad_applied = bad_reason is not None
                             except KVMembershipError:
                                 # the cluster reconfigured under this step
@@ -485,7 +520,7 @@ class BaseModule:
                                                     nbatch, cur_state)
                         if tel:
                             h_comp, h_wait, h_step, c_batch, c_samp, g_ips = \
-                                fit_instruments
+                                fit_instruments[:6]
                             now = time.perf_counter()
                             step_s = now - t_step
                             h_comp.observe(t_compute - t_step)
@@ -556,6 +591,10 @@ class BaseModule:
                 raise guard_obj.stall_error() from None
             raise
         finally:
+            if _kv_started_cluster:
+                # fit started the publisher; a finished (or crashed) fit
+                # must not leave a daemon thread polling the PS tier
+                _kv_obj.stop_cluster_stats()
             if elastic_session is not None:
                 # graceful end-of-training deregisters from the registry;
                 # a FAILED fit only stops heartbeating — the registry's

@@ -74,27 +74,31 @@ def is_running():
     return _state["running"]
 
 
-def emit_span(name, category, wall_t0, dur_s):
+def emit_span(name, category, wall_t0, dur_s, args=None):
     """Append one complete span to the chrome-trace buffer if the profiler
     runs — the hook `telemetry.span` uses, so runtime-phase spans (the fit
     loop's `fit.step`, any user-opened span) land in the same timeline as
-    the op/executor spans this module records itself."""
+    the op/executor spans this module records itself. ``args`` (a
+    JSON-able dict) becomes the trace event's ``args`` — the fit loop
+    stamps epoch/nbatch so tools/trace_merge.py can match the same BSP
+    step across worker lanes."""
     if not _state["running"]:
         return
+    ev = {
+        "name": name,
+        "cat": category,
+        "ph": "X",
+        "ts": wall_t0 * 1e6,
+        "dur": dur_s * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() % (1 << 16),
+    }
+    if args:
+        ev["args"] = dict(args)
     with _state["lock"]:
         if not _state["running"]:
             return
-        _state["events"].append(
-            {
-                "name": name,
-                "cat": category,
-                "ph": "X",
-                "ts": wall_t0 * 1e6,
-                "dur": dur_s * 1e6,
-                "pid": os.getpid(),
-                "tid": threading.get_ident() % (1 << 16),
-            }
-        )
+        _state["events"].append(ev)
 
 
 class _NullSpan:
@@ -142,10 +146,27 @@ def dump_profile():
     """Write accumulated spans as chrome://tracing JSON
     (reference: MXDumpProfile → Profiler::DumpProfile, profiler.h:88).
     The event list is snapshotted under the lock so a span completing on a
-    worker thread during the dump cannot mutate the list mid-serialization."""
+    worker thread during the dump cannot mutate the list mid-serialization.
+
+    Events are sorted by (tid, ts) — spans are appended at COMPLETION, so a
+    long outer span lands after the short inner spans it encloses, and the
+    raw append order would violate the per-tid start-time monotonicity the
+    trace-schema regression test (and some viewers) expect. A distributed
+    process also emits a ``process_name`` metadata row naming its rank, so
+    ``tools/trace_merge.py`` can assign the file to a lane without
+    guessing from pids."""
     with _state["lock"]:
-        events = list(_state["events"])
+        events = sorted(_state["events"],
+                        key=lambda e: (e.get("tid", 0), e.get("ts", 0)))
         filename = _state["filename"]
+    from . import telemetry
+
+    rank = telemetry.get_rank()
+    if rank is not None:
+        events.insert(0, {
+            "name": "process_name", "ph": "M", "pid": os.getpid(),
+            "tid": 0, "args": {"name": "rank %d" % rank, "rank": rank},
+        })
     with open(filename, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
 

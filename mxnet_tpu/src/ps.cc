@@ -57,6 +57,13 @@ enum MsgType : uint32_t {
   kRejectEpoch = 10, // response: request carried a stale membership epoch
 };
 
+// Reserved-negative-key split (cluster observability plane): keys in
+// (kPersistentKeyMax, 0) are single-shot diagnostic slots erased after one
+// pull (stats/membership publishes); keys <= kPersistentKeyMax are
+// persistent per-rank telemetry slots overwritten in place and pulled by
+// any number of observers. Mirrored by kvstore.py TELEMETRY_KEY_BASE.
+constexpr int kPersistentKeyMax = -(1 << 20);
+
 #pragma pack(push, 1)
 struct MsgHeader {
   uint32_t type;
@@ -70,8 +77,17 @@ struct MsgHeader {
                     // its current epoch on every request; once the server is
                     // in elastic mode a mismatch is answered kRejectEpoch so
                     // no traffic from a departed membership view can land.
-                    // Last field: aggregate inits without it zero it, and 0
-                    // always matches a non-elastic server.
+                    // 0 always matches a non-elastic server.
+  // Trace identity (cluster observability plane): every request carries the
+  // sending worker's rank and its training step at send time, so server-side
+  // per-key push/pull handling can be attributed to the worker step that
+  // caused it. rank -1 = unidentified (loopback publishers, probes, the
+  // registry's broadcast clients) — never recorded. Trailing fields:
+  // aggregate inits that stop at mepoch zero rank/step_id, and a zero rank
+  // would masquerade as worker 0, so every raw header build must set rank
+  // explicitly (Send() stamps it; mxt_ps_probe sets -1).
+  int32_t rank;
+  int64_t step_id;
 };
 #pragma pack(pop)
 
@@ -165,7 +181,38 @@ class PSServer {
 
   bool failed() const { return failed_; }
 
+  // Per-rank trace attribution snapshot, serialized as flat doubles
+  // (exact to 2^53 — a direct C call, not the float32 wire):
+  //   [rank, last_step, last_mepoch, pushes, pulls, barriers, inits] x N
+  // Returns the number of doubles written (<= cap; ranks past the cap are
+  // dropped — pass 7 * max_expected_ranks).
+  int TraceStats(double* out, int cap) {
+    std::unique_lock<std::mutex> lk(tmu_);
+    int n = 0;
+    for (auto& kv : trace_) {
+      if (n + 7 > cap) break;
+      const RankTrace& t = kv.second;
+      out[n++] = static_cast<double>(kv.first);
+      out[n++] = static_cast<double>(t.last_step);
+      out[n++] = static_cast<double>(t.last_mepoch);
+      out[n++] = static_cast<double>(t.pushes);
+      out[n++] = static_cast<double>(t.pulls);
+      out[n++] = static_cast<double>(t.barriers);
+      out[n++] = static_cast<double>(t.inits);
+    }
+    return n;
+  }
+
  private:
+  struct RankTrace {
+    int64_t last_step = 0;
+    int64_t last_mepoch = 0;
+    uint64_t pushes = 0;
+    uint64_t pulls = 0;
+    uint64_t barriers = 0;
+    uint64_t inits = 0;
+  };
+
   struct Entry {
     std::mutex mu;
     std::condition_variable cv;
@@ -222,7 +269,12 @@ class PSServer {
     // a reconfiguration ran must be rejected HERE, or an old-membership
     // gradient could join the fresh round
     if (elastic_ && key >= 0 && mepoch != epoch_) return false;
-    if (!e->inited) {
+    if (!e->inited || key < 0) {
+      // first push initializes; negative (diagnostic) keys ALWAYS take
+      // this overwrite path — BSP merge semantics never apply to reserved
+      // slots, so a reused or stale diagnostic key can neither join a
+      // merge round nor block its publisher waiting for num_workers_
+      // pushes (reserved-key sequences wrap, kvstore.py/mxtop.py)
       e->weight.assign(data, data + n);
       e->inited = true;
       e->version++;
@@ -309,7 +361,47 @@ class PSServer {
     if (h.nbytes && payload) WriteAll(c->fd, payload, h.nbytes);
   }
 
+  // Trace identity: per-rank attribution of data-path handling. Recorded
+  // BEFORE the epoch gate so a rejected request still updates the rank's
+  // last-seen step — the whole point is knowing where a worker WAS when
+  // its traffic stopped landing. Diagnostic traffic (negative keys) is not
+  // counted: a stats poll must not read as training progress.
+  void RecordTrace(const MsgHeader& h) {
+    if (h.rank < 0) return;
+    bool data_key = h.key >= 0;
+    std::unique_lock<std::mutex> lk(tmu_);
+    RankTrace& t = trace_[h.rank];
+    t.last_step = h.step_id;
+    t.last_mepoch = h.mepoch;
+    switch (h.type) {
+      case kPush:
+        if (data_key) t.pushes++;
+        break;
+      case kPull:
+        if (data_key) t.pulls++;
+        break;
+      case kPushPull:
+        if (data_key) {
+          t.pushes++;
+          t.pulls++;
+        }
+        break;
+      case kInit:
+        if (data_key) t.inits++;
+        break;
+      case kBarrier:
+        t.barriers++;
+        break;
+      default:
+        break;
+    }
+  }
+
   void Handle(Conn* c, MsgHeader h, std::vector<float> buf, std::string cmd) {
+    if (h.type == kPush || h.type == kPull || h.type == kPushPull ||
+        h.type == kBarrier || h.type == kInit) {
+      RecordTrace(h);
+    }
     // membership-epoch gate (elastic mode only; negative keys are the
     // reserved diagnostic slots — stats/membership self-publish — and stay
     // reachable from any epoch, or a stale worker could never resync)
@@ -317,7 +409,7 @@ class PSServer {
         (h.type == kPush || h.type == kPull || h.type == kPushPull ||
          h.type == kBarrier || h.type == kInit) &&
         h.mepoch != epoch_) {
-      Respond(c, MsgHeader{kRejectEpoch, h.key, h.req_id, 0, epoch_},
+      Respond(c, MsgHeader{kRejectEpoch, h.key, h.req_id, 0, epoch_, -1, 0},
               nullptr);
       std::unique_lock<std::mutex> lk(c->hmu);
       if (--c->inflight == 0) c->hcv.notify_all();
@@ -328,7 +420,7 @@ class PSServer {
         Entry* e = GetEntry(h.key);
         bool ok = HandlePush(h.key, e, buf.data(), buf.size(), h.mepoch);
         Respond(c, MsgHeader{ok ? kResp : kRejectEpoch, h.key, h.req_id, 0,
-                             epoch_},
+                             epoch_, -1, 0},
                 nullptr);
         break;
       }
@@ -344,14 +436,14 @@ class PSServer {
           // same lock-held re-check as HandlePush: an overwrite from a
           // membership that ended mid-dispatch must not land
           lk.unlock();
-          Respond(c, MsgHeader{kRejectEpoch, h.key, h.req_id, 0, epoch_},
+          Respond(c, MsgHeader{kRejectEpoch, h.key, h.req_id, 0, epoch_, -1, 0},
                   nullptr);
           break;
         }
         e->weight.assign(buf.data(), buf.data() + buf.size());
         e->inited = true;
         lk.unlock();
-        Respond(c, MsgHeader{kResp, h.key, h.req_id, 0, epoch_}, nullptr);
+        Respond(c, MsgHeader{kResp, h.key, h.req_id, 0, epoch_, -1, 0}, nullptr);
         break;
       }
       case kPull: {
@@ -363,13 +455,19 @@ class PSServer {
         std::vector<float> w = e->weight;  // copy under lock, send outside
         lk.unlock();
         Respond(c, MsgHeader{kResp, h.key, h.req_id,
-                             static_cast<uint64_t>(w.size() * sizeof(float)), 0},
+                             static_cast<uint64_t>(w.size() * sizeof(float)),
+                             0, -1, 0},
                 w.data());
-        if (h.key < 0) {
+        if (h.key < 0 && h.key > kPersistentKeyMax) {
           // negative keys are reserved single-shot diagnostic slots (the
           // stats_to self-publish, kvstore_server.py): exactly one reader
           // pulls each once, so erase after serving — without this every
-          // stats poll would permanently leak one Entry per server
+          // stats poll would permanently leak one Entry per server.
+          // Keys at or below kPersistentKeyMax are PERSISTENT telemetry
+          // slots (one per worker rank — bounded by cluster size, kvstore.py
+          // TELEMETRY_KEY_BASE): each worker kInit-overwrites its own slot
+          // periodically and any number of observers (cluster_stats,
+          // tools/mxtop.py) pull it repeatedly, so these survive the pull.
           std::unique_lock<std::mutex> mlk(mu_);
           entries_.erase(h.key);
         }
@@ -378,7 +476,7 @@ class PSServer {
       case kPushPull: {
         Entry* e = GetEntry(h.key);
         if (!HandlePush(h.key, e, buf.data(), buf.size(), h.mepoch)) {
-          Respond(c, MsgHeader{kRejectEpoch, h.key, h.req_id, 0, epoch_},
+          Respond(c, MsgHeader{kRejectEpoch, h.key, h.req_id, 0, epoch_, -1, 0},
                   nullptr);
           break;
         }
@@ -386,7 +484,8 @@ class PSServer {
         std::vector<float> w = e->weight;
         lk.unlock();
         Respond(c, MsgHeader{kResp, h.key, h.req_id,
-                             static_cast<uint64_t>(w.size() * sizeof(float)), 0},
+                             static_cast<uint64_t>(w.size() * sizeof(float)),
+                             0, -1, 0},
                 w.data());
         break;
       }
@@ -397,7 +496,7 @@ class PSServer {
         // prematurely release — the new membership's smaller rendezvous
         if (elastic_ && h.mepoch != epoch_) {
           lk.unlock();
-          Respond(c, MsgHeader{kRejectEpoch, 0, h.req_id, 0, epoch_},
+          Respond(c, MsgHeader{kRejectEpoch, 0, h.req_id, 0, epoch_, -1, 0},
                   nullptr);
           break;
         }
@@ -418,7 +517,7 @@ class PSServer {
         }
         lk.unlock();
         Respond(c, MsgHeader{ok ? kResp : kRejectEpoch, 0, h.req_id, 0,
-                             epoch_},
+                             epoch_, -1, 0},
                 nullptr);
         break;
       }
@@ -432,7 +531,7 @@ class PSServer {
             Reconfigure(e, w);
         }
         if (cmd_handler_) cmd_handler_(cmd.data(), cmd.size());
-        Respond(c, MsgHeader{kResp, 0, h.req_id, 0, 0}, nullptr);
+        Respond(c, MsgHeader{kResp, 0, h.req_id, 0, 0, -1, 0}, nullptr);
         break;
       }
       default:
@@ -449,7 +548,7 @@ class PSServer {
       MsgHeader h;
       if (!ReadAll(fd, &h, sizeof(h))) break;
       if (h.type == kStop) {
-        Respond(&conn, MsgHeader{kResp, 0, h.req_id, 0, 0}, nullptr);
+        Respond(&conn, MsgHeader{kResp, 0, h.req_id, 0, 0, -1, 0}, nullptr);
         std::unique_lock<std::mutex> lk(stop_mu_);
         stop_requested_ = true;
         stop_cv_.notify_all();
@@ -498,6 +597,8 @@ class PSServer {
   std::thread accept_thread_;
   std::mutex mu_;
   std::map<int, std::unique_ptr<Entry>> entries_;
+  std::mutex tmu_;  // guards trace_ (bumped on conn handler threads)
+  std::map<int, RankTrace> trace_;
   std::vector<std::thread> conn_threads_;
   std::mutex barrier_mu_;
   std::condition_variable barrier_cv_;
@@ -554,6 +655,13 @@ class PSClient {
   // adopted by the Python tier after a registry sync.
   void SetEpoch(int64_t e) { epoch_ = e; }
   int64_t GetEpoch() const { return epoch_; }
+
+  // Trace identity stamped on every subsequent request: the worker's rank
+  // (set once at store construction; stays -1 = unidentified on loopback/
+  // observer clients so they never pollute per-rank attribution) and its
+  // current training step (the fit loop bumps it each batch).
+  void SetIdentity(int rank) { rank_ = rank; }
+  void SetStep(int64_t s) { step_ = s; }
 
   // 0 ok, -1 transport failure, -2 stale membership epoch
   int Push(int key, const float* data, uint64_t n) {
@@ -658,7 +766,8 @@ class PSClient {
       pending_[id] = p;
     }
     if (out_id) *out_id = id;
-    MsgHeader h{type, key, id, nbytes, epoch_.load()};
+    MsgHeader h{type, key, id, nbytes, epoch_.load(), rank_.load(),
+                step_.load()};
     std::unique_lock<std::mutex> lk(wmu_);
     if (!WriteAll(fd_, &h, sizeof(h)) ||
         (nbytes && !WriteAll(fd_, payload, nbytes))) {
@@ -732,6 +841,8 @@ class PSClient {
 
   int fd_ = -1;
   std::atomic<int64_t> epoch_{0};
+  std::atomic<int> rank_{-1};
+  std::atomic<int64_t> step_{0};
   std::thread reader_;
   std::mutex wmu_;   // serializes frame writes
   std::mutex pmu_;   // guards pending_/next_id_/dead_
@@ -761,6 +872,12 @@ void mxt_ps_server_set_command_handler(void* h, mxt::CommandFn fn) {
 void mxt_ps_server_wait(void* h) {
   static_cast<mxt::PSServer*>(h)->WaitStopped();
 }
+// Per-rank trace attribution (cluster observability): flat doubles
+// [rank, last_step, last_mepoch, pushes, pulls, barriers, inits] x N;
+// returns the number of doubles written.
+int mxt_ps_server_trace_stats(void* h, double* out, int cap) {
+  return static_cast<mxt::PSServer*>(h)->TraceStats(out, cap);
+}
 void mxt_ps_server_destroy(void* h) { delete static_cast<mxt::PSServer*>(h); }
 
 void* mxt_ps_client_create(const char* host, int port) {
@@ -783,6 +900,14 @@ int mxt_ps_client_init(void* h, int key, const float* data,
 }
 void mxt_ps_client_set_epoch(void* h, long long epoch) {
   static_cast<mxt::PSClient*>(h)->SetEpoch(epoch);
+}
+// Trace identity (cluster observability): rank set once per worker store,
+// step bumped by the fit loop each batch.
+void mxt_ps_client_set_identity(void* h, int rank) {
+  static_cast<mxt::PSClient*>(h)->SetIdentity(rank);
+}
+void mxt_ps_client_set_step(void* h, long long step) {
+  static_cast<mxt::PSClient*>(h)->SetStep(step);
 }
 long long mxt_ps_client_get_epoch(void* h) {
   return static_cast<mxt::PSClient*>(h)->GetEpoch();
@@ -840,7 +965,7 @@ int mxt_ps_probe(const char* host, int port, int timeout_ms) {
     }
   }
   const char ping[] = "ping";
-  mxt::MsgHeader h{mxt::kCommand, 0, 1, sizeof(ping) - 1, 0};
+  mxt::MsgHeader h{mxt::kCommand, 0, 1, sizeof(ping) - 1, 0, -1, 0};
   char buf[sizeof(h) + sizeof(ping) - 1];
   memcpy(buf, &h, sizeof(h));
   memcpy(buf + sizeof(h), ping, sizeof(ping) - 1);

@@ -53,9 +53,10 @@ __all__ = [
     "Counter", "Gauge", "Histogram",
     "counter", "gauge", "histogram", "span", "event",
     "enable", "disable", "enabled",
-    "dump", "prometheus_text", "reset", "state_summary",
+    "dump", "prometheus_text", "reset", "state_summary", "totals",
     "flush", "start_flusher", "stop_flusher",
-    "pipeline_stage", "PIPELINE_STAGES",
+    "set_rank", "get_rank",
+    "pipeline_stage", "PIPELINE_STAGES", "METRIC_HELP",
 ]
 
 # ---------------------------------------------------------------------------
@@ -251,6 +252,23 @@ _events = deque(maxlen=1024)
 _enabled = False
 _flusher = None  # (thread, stop_event, path, interval)
 _file_lock = threading.Lock()  # serializes sink appends (flusher vs events)
+_rank = None  # this process's worker rank (distributed runs); None = unset
+
+
+def set_rank(rank):
+    """Tag this process with its worker rank (distributed runs): every
+    structured event and snapshot record from now on carries a ``rank``
+    field, so merged JSON-lines streams from multiple workers stay
+    distinguishable and ``tools/trace_merge.py`` can assign each file to
+    its lane. Set automatically by the dist KVStore and by the launcher's
+    DMLC env at import; pass ``None`` to clear (test isolation)."""
+    global _rank
+    _rank = None if rank is None else int(rank)
+
+
+def get_rank():
+    """The rank set via :func:`set_rank`, or None outside distributed runs."""
+    return _rank
 
 
 def _key(name, labels):
@@ -355,11 +373,12 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "category", "_t0", "_wall0")
+    __slots__ = ("name", "category", "args", "_t0", "_wall0")
 
-    def __init__(self, name, category):
+    def __init__(self, name, category, args=None):
         self.name = name
         self.category = category
+        self.args = args
 
     def __enter__(self):
         self._wall0 = time.time()
@@ -372,11 +391,12 @@ class _Span:
             histogram(self.name).observe(dur)
         from . import profiler
 
-        profiler.emit_span(self.name, self.category, self._wall0, dur)
+        profiler.emit_span(self.name, self.category, self._wall0, dur,
+                           self.args)
         return False
 
 
-def span(name, category="telemetry"):
+def span(name, category="telemetry", **args):
     """Context manager timing one named span.
 
     While telemetry is enabled the duration lands in histogram ``name``;
@@ -384,13 +404,19 @@ def span(name, category="telemetry"):
     appended to the chrome-trace event buffer, so `dump_profile()` timelines
     show runtime phases next to op/executor spans. When neither is active a
     shared no-op is returned (the near-zero disabled path).
+
+    Extra keyword ``args`` become the chrome-trace event's ``args`` dict —
+    the fit loop stamps ``epoch``/``nbatch`` on ``fit.step`` so
+    ``tools/trace_merge.py`` can match the same BSP step across worker
+    lanes. They do not label the histogram (per-step label sets would grow
+    without bound).
     """
     if not _enabled:
         from . import profiler
 
         if not profiler.is_running():
             return _NULL_SPAN
-    return _Span(name, category)
+    return _Span(name, category, args or None)
 
 
 # ---------------------------------------------------------------------------
@@ -408,10 +434,14 @@ def event(name, **fields):
     if not _enabled:
         return None
     rec = {"ts": time.time(), "type": "event", "event": name}
+    if _rank is not None:
+        rec["rank"] = _rank  # fields may override (e.g. registry-side
+        # worker_lost events name the LOST worker's rank, not the host's)
     rec.update(fields)
     with _lock:
         _events.append(rec)
-        sink = _flusher[2] if _flusher else _env_str("MXNET_TELEMETRY_FILE")
+        sink = (_flusher[2] if _flusher
+                else _expand_sink_path(_env_str("MXNET_TELEMETRY_FILE")))
     if sink:
         _append_line(sink, rec)
     return rec
@@ -475,6 +505,98 @@ def state_summary(prefixes=()):
     return out
 
 
+def totals(name):
+    """Aggregate every instrument sharing bare metric ``name`` across its
+    label sets: histograms return ``(count, sum)``; counters and gauges
+    return ``(n_instruments, value_sum)``. ``(0, 0.0)`` when nothing is
+    registered under the name. This is the cheap cross-label rollup the
+    cluster-stats snapshot builder uses (e.g. ``kvstore.push_latency_seconds``
+    is labeled per key — the per-step split wants the whole sync wall)."""
+    with _lock:
+        ms = [m for m in _metrics.values() if m.name == name]
+    count, total = 0, 0.0
+    for m in ms:
+        if isinstance(m, Histogram):
+            with m._lock:
+                count += m._count
+                total += m._sum
+        else:
+            count += 1
+            total += m.value
+    return count, total
+
+
+# ---------------------------------------------------------------------------
+# metric-description catalog
+# ---------------------------------------------------------------------------
+# One row per metric NAME the runtime registers (docs/observability.md keeps
+# the operator-facing table; tests_tpu/test_telemetry.py asserts every name
+# registered anywhere in mxnet_tpu/ appears both HERE and in the docs, so
+# neither can drift from the code). Prometheus exposition emits each entry
+# as a ``# HELP`` line.
+METRIC_HELP = {
+    "fit.step_time_seconds": "full fit-loop batch wall time",
+    "fit.compute_seconds":
+        "forward_backward+update dispatch time (XLA executes async)",
+    "fit.data_wait_seconds": "time blocked on the data iterator",
+    "fit.guard_seconds": "health-guard sentinel checks per step",
+    "fit.batches": "fit-loop batches completed",
+    "fit.samples": "fit-loop samples trained (net of batch padding)",
+    "fit.epochs": "fit-loop epochs completed",
+    "fit.imgs_per_sec": "instantaneous per-batch throughput",
+    "fit.step": "fit.step span durations (chrome-trace timeline twin)",
+    "speedometer.samples_per_sec": "last Speedometer window sample",
+    "io.batch_fetch_seconds": "per-iterator batch fetch latency",
+    "io.bad_records": "corrupt records quarantined by source",
+    "pipeline.stage_seconds": "input-pipeline stage wall by stage label",
+    "pipeline.feed_depth": "batches parked device-resident in the feed queue",
+    "engine.pushes": "host-side ops pushed to the engine",
+    "engine.push_latency_seconds": "pushed-fn execution time",
+    "engine.queue_depth": "engine ops accepted but not yet started",
+    "engine.push_errors": "pushed-fn exceptions (always-on)",
+    "engine.sanitizer.undeclared_mutation":
+        "sanitizer: pushed fn wrote an undeclared var (always-on)",
+    "engine.sanitizer.const_write":
+        "sanitizer: pushed fn wrote a declared-const var (always-on)",
+    "engine.sanitizer.use_after_free":
+        "sanitizer: pushed fn touched a deleted var (always-on)",
+    "engine.sanitizer.undeclared_read":
+        "sanitizer: pushed fn read an undeclared var (always-on)",
+    "kvstore.push_latency_seconds":
+        "per-key push latency incl. retries/backoff",
+    "kvstore.pull_latency_seconds": "per-key pull latency",
+    "kv.barrier":
+        "worker wall blocked in the PS barrier rendezvous (span histogram)",
+    "kvstore.rpc_failures": "failed RPC attempts by op (always-on)",
+    "kvstore.retries": "RPC retry attempts by op (always-on)",
+    "kvstore.backoff_ms": "cumulative scheduled RPC backoff (always-on)",
+    "kvstore.dead_nodes":
+        "servers the last liveness probe found unreachable (always-on)",
+    "kv.membership.epoch": "current membership epoch (always-on)",
+    "kv.membership.rejected":
+        "requests rejected for a stale membership epoch (always-on)",
+    "kv.membership.reconfigures":
+        "registry-side membership epoch bumps (always-on)",
+    "kv.membership.heartbeat_failures":
+        "worker heartbeats the registry missed the deadline on (always-on)",
+    "kv.straggler.rank":
+        "rank the straggler detector last named (-1 = none) (always-on)",
+    "kv.cluster.publish_failures":
+        "failed cluster-stats snapshot publishes (always-on)",
+    "kvstore_server.updates_applied":
+        "server-side optimizer updates applied (always-on)",
+    "kvstore_server.update_failures":
+        "server-side optimizer failures (always-on)",
+    "guard.bad_steps": "health-guard bad steps by reason (always-on)",
+    "guard.rollbacks": "guard snapshot restores (always-on)",
+    "guard.stalls": "stall-watchdog firings (always-on)",
+    "guard.checkpoint_errors":
+        "failed guard mid-epoch checkpoint writes (always-on)",
+    "fault.injections": "fired fault-injection rules by point (always-on)",
+    "bench.imgs_per_sec": "bench.py headline throughput",
+}
+
+
 def _prom_name(name):
     import re
 
@@ -518,6 +640,10 @@ def prometheus_text():
     for name in sorted(by_name):
         group = by_name[name]
         pname = _prom_name(name)
+        help_text = METRIC_HELP.get(name)
+        if help_text:
+            lines.append("# HELP %s %s" % (
+                pname, help_text.replace("\\", "\\\\").replace("\n", "\\n")))
         if isinstance(group[0], Counter):
             lines.append("# TYPE %s counter" % pname)
             for m in group:
@@ -555,6 +681,27 @@ def prometheus_text():
 # ---------------------------------------------------------------------------
 
 
+def _expand_sink_path(path):
+    """Expand ``{pid}`` / ``{rank}`` placeholders in a sink path. Every
+    process of a launched cluster inherits the same ``MXNET_TELEMETRY_FILE``
+    and appends are only serialized within one process — a literally shared
+    file would tear multi-chunk snapshot appends across processes. ``{rank}``
+    resolves to the worker rank (server processes get ``s<id>``; processes
+    outside a launch fall back to the pid so two of them never collide)."""
+    if not path or "{" not in path:
+        return path
+    import os
+
+    rank = _rank
+    if rank is None:
+        if os.environ.get("DMLC_ROLE") == "server":
+            rank = "s%s" % os.environ.get("DMLC_SERVER_ID", "0")
+        else:
+            rank = os.environ.get("DMLC_WORKER_ID", str(os.getpid()))
+    return (path.replace("{pid}", str(os.getpid()))
+            .replace("{rank}", str(rank)))
+
+
 def _append_line(path, rec):
     # one writer at a time: a multi-chunk snapshot append racing an event
     # append would interleave buffered chunks and tear the JSON lines
@@ -574,11 +721,13 @@ def _append_line(path, rec):
 def flush(path=None):
     """Append one snapshot record to the JSON-lines sink now."""
     path = path or (_flusher[2] if _flusher else
-                    _env_str("MXNET_TELEMETRY_FILE"))
+                    _expand_sink_path(_env_str("MXNET_TELEMETRY_FILE")))
     if not path:
         return
     rec = dump(include_events=False)
     rec["type"] = "snapshot"
+    if _rank is not None:
+        rec["rank"] = _rank
     _append_line(path, rec)
 
 
@@ -590,7 +739,7 @@ def start_flusher(path=None, interval_s=None):
     flushing-but-disabled registry would record empty snapshots forever.
     """
     global _flusher
-    path = path or _env_str("MXNET_TELEMETRY_FILE")
+    path = _expand_sink_path(path or _env_str("MXNET_TELEMETRY_FILE"))
     if not path:
         raise ValueError("no telemetry file: pass path= or set "
                          "MXNET_TELEMETRY_FILE")
@@ -629,9 +778,16 @@ def stop_flusher(final_flush=True):
 
 def _maybe_autostart():
     import atexit
+    import os
 
     from .base import env_flag
 
+    # worker identity from the launcher env (tools/launch.py DMLC contract):
+    # set BEFORE the flusher starts so {rank} sink expansion and every
+    # event/snapshot record see it
+    if os.environ.get("DMLC_ROLE", "worker") == "worker" and \
+            os.environ.get("DMLC_WORKER_ID"):
+        set_rank(os.environ["DMLC_WORKER_ID"])
     if _env_str("MXNET_TELEMETRY_FILE"):
         start_flusher()
         atexit.register(stop_flusher)

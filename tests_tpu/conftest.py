@@ -23,7 +23,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 # they run on CPU-only hosts and are exempt from the hardware gate below.
 _HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
                     "test_pipeline_feed.py", "test_guard.py",
-                    "test_analysis.py", "test_elastic.py"}
+                    "test_analysis.py", "test_elastic.py",
+                    "test_cluster_obs.py"}
 
 
 def pytest_configure(config):

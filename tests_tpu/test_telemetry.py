@@ -561,3 +561,217 @@ def test_request_server_stats_returns_parsed_dict():
     assert proc.returncode == 0, (out, err)
     assert "STATS_DICT_OK" in out, (out, err)
     assert "WORKER_OK" in out, (out, err)
+
+
+# ---------------------------------------------------------------------------
+# metric catalog: HELP lines + doc-drift killer (docs/observability.md)
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_help_lines_emitted():
+    telemetry.counter("fit.batches").inc()
+    telemetry.histogram("fit.step_time_seconds").observe(0.1)
+    text = telemetry.prometheus_text()
+    lines = text.splitlines()
+    for pname in ("mxnet_fit_batches", "mxnet_fit_step_time_seconds"):
+        help_idx = [i for i, l in enumerate(lines)
+                    if l.startswith("# HELP %s " % pname)]
+        type_idx = [i for i, l in enumerate(lines)
+                    if l.startswith("# TYPE %s " % pname)]
+        assert help_idx and type_idx, text
+        assert help_idx[0] == type_idx[0] - 1  # HELP directly above TYPE
+
+
+def _registered_metric_names():
+    """Every metric name registered with a string literal anywhere in
+    mxnet_tpu/ (counter/gauge/histogram/span/pipeline_stage first args).
+    AST-based so multi-line calls and aliased imports are all caught."""
+    import ast
+
+    pkg = os.path.join(ROOT, "mxnet_tpu")
+    names = {}
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                tree = ast.parse(f.read(), path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                attr = (func.attr if isinstance(func, ast.Attribute)
+                        else func.id if isinstance(func, ast.Name) else None)
+                if attr not in ("counter", "gauge", "histogram", "span",
+                                "pipeline_stage"):
+                    continue
+                if not node.args or not isinstance(node.args[0], ast.Constant) \
+                        or not isinstance(node.args[0].value, str):
+                    continue
+                name = node.args[0].value
+                if attr == "pipeline_stage":
+                    name = "pipeline.stage_seconds"
+                if "." not in name:
+                    continue  # not a metric name (e.g. a span category)
+                names.setdefault(name, os.path.relpath(path, ROOT))
+    assert len(names) > 25, "scanner broke: found only %s" % sorted(names)
+    return names
+
+
+def test_every_registered_metric_is_documented():
+    """Kills doc drift permanently: every metric name registered anywhere
+    in mxnet_tpu/ must have a row in docs/observability.md AND an entry in
+    the telemetry.METRIC_HELP catalog (which feeds # HELP exposition)."""
+    with open(os.path.join(ROOT, "docs", "observability.md")) as f:
+        docs = f.read()
+    missing_docs, missing_help = [], []
+    for name, where in sorted(_registered_metric_names().items()):
+        if "`%s`" % name not in docs and "`%s" % name not in docs:
+            missing_docs.append("%s (registered in %s)" % (name, where))
+        if name not in telemetry.METRIC_HELP:
+            missing_help.append("%s (registered in %s)" % (name, where))
+    assert not missing_docs, \
+        "metrics missing a docs/observability.md row: %s" % missing_docs
+    assert not missing_help, \
+        "metrics missing a telemetry.METRIC_HELP entry: %s" % missing_help
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace schema regression (tools/trace_merge.validate_trace)
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_trace_passes_schema_validation(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import trace_merge
+
+    out = str(tmp_path / "trace.json")
+    profiler.profiler_set_config(mode="all", filename=out)
+    profiler.profiler_set_state("run")
+    try:
+        # nested + sequential spans across the runtime's emitters: the
+        # nesting and per-tid monotonicity rules must hold in the dump
+        with telemetry.span("outer.phase", "test", epoch=0):
+            with telemetry.span("inner.phase", "test"):
+                time.sleep(0.002)
+            time.sleep(0.001)
+        with telemetry.span("fit.step", "fit", epoch=0, nbatch=1):
+            time.sleep(0.001)
+    finally:
+        profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+    with open(out) as f:
+        trace = json.load(f)
+    assert trace_merge.validate_trace(trace) == []
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) == 3
+    # required fields on every span
+    for ev in evs:
+        for field in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert field in ev, ev
+    # span args survive the dump (trace_merge matches steps by them)
+    step = [e for e in evs if e["name"] == "fit.step"][0]
+    assert step["args"] == {"epoch": 0, "nbatch": 1}
+    # ts monotonic per tid in FILE ORDER (dump_profile sorts: spans are
+    # appended at completion, inner-before-outer)
+    per_tid = {}
+    for ev in evs:
+        per_tid.setdefault(ev["tid"], []).append(ev["ts"])
+    for tid, series in per_tid.items():
+        assert series == sorted(series), (tid, series)
+
+
+def test_profiler_dump_carries_rank_metadata(tmp_path):
+    telemetry.set_rank(3)
+    out = str(tmp_path / "trace.json")
+    profiler.profiler_set_config(mode="all", filename=out)
+    profiler.profiler_set_state("run")
+    with telemetry.span("x.y", "test"):
+        pass
+    profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+    with open(out) as f:
+        trace = json.load(f)
+    meta = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+    assert meta and meta[0]["args"]["rank"] == 3, trace["traceEvents"][:3]
+
+
+# ---------------------------------------------------------------------------
+# CI satellites: end-to-end flusher JSON + trace_merge smoke
+# ---------------------------------------------------------------------------
+
+FLUSHER_E2E = r"""
+import numpy as np
+import mxnet_tpu as mx
+
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+rng = np.random.RandomState(0)
+X = rng.rand(64, 10).astype(np.float32)
+y = rng.randint(0, 8, 64).astype(np.float32)
+it = mx.io.NDArrayIter(X, y, batch_size=16)
+mod = mx.mod.Module(net, context=mx.cpu())
+mod.fit(it, num_epoch=2)
+print("FIT_OK")
+"""
+
+
+def test_telemetry_file_end_to_end_fit(tmp_path):
+    """The background flusher, driven only by MXNET_TELEMETRY_FILE, must
+    produce parseable JSON lines from a real fit: periodic + final
+    snapshots with the fit metrics, and structured events interleaved."""
+    sink = str(tmp_path / "telemetry.{rank}.jsonl")
+    env = dict(os.environ)
+    env.pop("DMLC_ROLE", None)
+    env.update({"JAX_PLATFORMS": "cpu", "MXNET_TELEMETRY_FILE": sink,
+                "MXNET_TELEMETRY_INTERVAL_S": "0.2", "DMLC_WORKER_ID": "4",
+                "PYTHONPATH": ROOT + os.pathsep + env.get("PYTHONPATH", "")})
+    r = subprocess.run([sys.executable, "-c", FLUSHER_E2E], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    resolved = str(tmp_path / "telemetry.4.jsonl")  # {rank} expanded
+    with open(resolved) as f:
+        recs = [json.loads(line) for line in f]  # every line parses
+    snaps = [x for x in recs if x["type"] == "snapshot"]
+    events = [x for x in recs if x["type"] == "event"]
+    assert snaps, "flusher produced no snapshots"
+    assert snaps[-1]["counters"]["fit.epochs"] == 2
+    assert snaps[-1]["rank"] == 4
+    assert any(e["event"] == "epoch_end" for e in events)
+    assert all(e["rank"] == 4 for e in events)
+
+
+def test_trace_merge_smoke_two_workers(tmp_path):
+    """CI smoke (docs/observability.md §cluster): merge two synthetic
+    worker traces -> one valid chrome trace with two pid lanes."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import trace_merge
+
+    for rank, skew in ((0, 0.0), (1, 1.25)):
+        evs = [{"name": "process_name", "ph": "M", "pid": 100 + rank,
+                "tid": 0, "args": {"name": "rank %d" % rank, "rank": rank}},
+               {"name": "kv.barrier", "ph": "X", "cat": "kvstore",
+                "ts": (50.0 + skew) * 1e6, "dur": 1e5,
+                "pid": 100 + rank, "tid": 1, "args": {"seq": 1}},
+               {"name": "fit.step", "ph": "X", "cat": "fit",
+                "ts": (51.0 + skew) * 1e6, "dur": 5e5,
+                "pid": 100 + rank, "tid": 1,
+                "args": {"epoch": 0, "nbatch": 0}}]
+        with open(tmp_path / ("w%d.json" % rank), "w") as f:
+            json.dump({"traceEvents": evs}, f)
+    out = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "trace_merge.py"),
+         "-o", str(out), "--validate",
+         str(tmp_path / "w0.json"), str(tmp_path / "w1.json")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    merged = json.loads(out.read_text())
+    assert trace_merge.validate_trace(merged) == []
+    assert trace_merge.lane_pids(merged) == [0, 1]
+    # the skew was recovered from the barrier sync point
+    offs = merged["otherData"]["clock_offsets"]
+    assert abs(offs["w1.json"]["offset_s"] + 1.25) < 1e-6, offs

@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""mxtop — live terminal dashboard for a distributed training cluster.
+
+Attaches to the PS tier as a read-only OBSERVER (docs/observability.md
+§cluster): every worker publishes a compact telemetry snapshot into its
+persistent reserved key on server 0 (`kvstore.telemetry_slot`), so this
+tool needs nothing from the workers themselves — point it at server 0 and
+it renders, per rank:
+
+* training position (epoch/batch decoded from the stamped step id),
+  imgs/sec, and step time;
+* the per-step split (data-wait / compute / kv-sync / guard percent);
+* queue depths (engine, device feed), membership-rejection and RPC-failure
+  counters, and snapshot age (a stale row = a dead or wedged worker);
+
+plus the cluster header: membership epoch + table (elastic runs), and the
+straggler attribution computed from the same published windows the rank-0
+detector uses (`kvstore._pick_straggler` — one code path, two consumers).
+
+Usage::
+
+    python tools/mxtop.py --host 127.0.0.1 --port 9091 -n 4
+    python tools/mxtop.py --once        # single frame, no screen control
+    python tools/mxtop.py --trace      # also dump per-server rank traces
+
+Defaults come from the launcher's DMLC_* env when present, so running it
+on a cluster host needs no flags.
+"""
+from __future__ import annotations
+
+import argparse
+import ctypes
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu._native import get_lib  # noqa: E402
+from mxnet_tpu.kvstore import _pick_straggler, telemetry_slot  # noqa: E402
+from mxnet_tpu.kvstore_server import decode_bytes_vec  # noqa: E402
+
+# observer-side single-shot reserved keys (mb_get / trace_to publishes):
+# far below the workers' small-negative stats keys, far above the
+# persistent telemetry range, erased by the server after one pull
+_OBS_KEY_BASE = -(1 << 19)
+
+
+class Observer:
+    """Read-only PS-tier client: pulls snapshot slots and registry tables."""
+
+    def __init__(self, host, port, num_servers=1, timeout_ms=2000):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError("native runtime (libmxtpu) unavailable")
+        self._timeout_ms = int(timeout_ms)
+        self._seq = 0
+        self._clients = []
+        for s in range(num_servers):
+            c = self._lib.mxt_ps_client_create(host.encode(), port + s)
+            if not c and s == 0:
+                raise RuntimeError("cannot reach PS server %s:%d"
+                                   % (host, port))
+            self._clients.append(c)
+        # identity deliberately NOT set: an observer's pulls must stay
+        # rank -1 so they never pollute per-rank trace attribution
+
+    def _bounded_pull(self, client, key, cap):
+        buf = np.zeros(cap, np.float32)
+        result = [None]
+
+        def pull():
+            result[0] = self._lib.mxt_ps_client_pull(
+                client, key,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), cap)
+
+        t = threading.Thread(target=pull, daemon=True, name="mxtop-pull")
+        t.start()
+        t.join(self._timeout_ms / 1000.0)
+        if t.is_alive():
+            return None, buf
+        return result[0], buf
+
+    def _fetch_json(self, client, cmd_prefix):
+        """Command-then-pull fetch of a JSON payload a server publishes on
+        demand (mb_get / trace_to), or None when it does not answer. The
+        key sequence wraps before reaching the persistent telemetry range
+        at -(1<<20) — a long-attached observer must never alias a worker's
+        snapshot slot (reuse is safe: negative-key pushes always take the
+        server's overwrite path, src/ps.cc)."""
+        self._seq = self._seq % ((1 << 19) - 1) + 1
+        key = _OBS_KEY_BASE - self._seq
+        cmd = ("%s:%d" % (cmd_prefix, key)).encode()
+        if self._lib.mxt_ps_client_probe(client, cmd, self._timeout_ms) != 0:
+            return None
+        cap = 65536
+        got, buf = self._bounded_pull(client, key, cap)
+        if got is None or got <= 0 or got > cap:
+            return None
+        raw = decode_bytes_vec(buf[:got])
+        if not raw:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except ValueError:
+            return None
+
+    def snapshot(self, rank):
+        """Rank ``rank``'s last published telemetry snapshot, or None."""
+        cap = 65536
+        got, buf = self._bounded_pull(self._clients[0],
+                                      telemetry_slot(rank), cap)
+        if got is None or got <= 0 or got > cap:
+            return None
+        raw = decode_bytes_vec(buf[:got])
+        if not raw:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except ValueError:
+            return None
+
+    def membership(self):
+        """The membership registry's table (elastic runs), or None."""
+        return self._fetch_json(self._clients[0], "mb_get")
+
+    def server_traces(self):
+        """Per-server per-rank RPC attribution tables."""
+        out = {}
+        for i, c in enumerate(self._clients):
+            out[i] = self._fetch_json(c, "trace_to") if c else None
+        return out
+
+
+def _decode_step(step_id):
+    if not step_id:
+        return "-"
+    return "e%d/b%d" % (int(step_id) >> 32, int(step_id) & 0xFFFFFFFF)
+
+
+def _pct(part, whole):
+    return "%3.0f" % (100.0 * part / whole) if whole > 0 else "  -"
+
+
+def render(snaps, membership=None, straggler_factor=2.0, now=None):
+    """One dashboard frame as a string (pure: unit-testable)."""
+    now = now if now is not None else time.time()
+    lines = []
+    mep = max([s.get("mepoch", 0) for s in snaps.values() if s] or [0])
+    head = "mxtop  mepoch=%d  workers=%d/%d" % (
+        mep, sum(1 for s in snaps.values() if s), len(snaps))
+    if membership:
+        head += "  registry=%s%s" % (
+            membership.get("workers"),
+            " DONE" if membership.get("done") else "")
+    straggler = _pick_straggler(snaps, straggler_factor, max_age_s=30.0,
+                                now=now)
+    if straggler:
+        head += "  STRAGGLER: rank %d (%s, %.1fx)" % (
+            straggler["rank"], straggler["stage"], straggler["ratio"])
+    lines.append(head)
+    lines.append("%-5s %-12s %9s %9s %6s %6s %6s %7s %5s %5s %5s %6s"
+                 % ("rank", "step", "imgs/s", "step_ms", "data%", "comp%",
+                    "kv%", "guard%", "engq", "feedq", "rej", "age"))
+    for rank in sorted(snaps):
+        s = snaps[rank]
+        if not s:
+            lines.append("%-5d %-12s %s" % (rank, "-", "(no snapshot)"))
+            continue
+        w = s.get("window") or {}
+        steps = w.get("steps") or 0
+        wall = w.get("step_time", 0.0)
+        q = s.get("queues") or {}
+        c = s.get("counters") or {}
+        age = now - float(s.get("ts", now))
+        lines.append(
+            "%-5d %-12s %9.1f %9.1f %6s %6s %6s %7s %5d %5d %5d %5.1fs"
+            % (rank, _decode_step(s.get("step_id")),
+               float(s.get("imgs_per_sec", 0.0)),
+               (wall / steps * 1000.0) if steps else 0.0,
+               _pct(w.get("data_wait", 0.0), wall),
+               _pct(w.get("compute", 0.0), wall),
+               _pct(w.get("kv_sync", 0.0), wall),
+               _pct(w.get("guard", 0.0), wall),
+               int(q.get("engine", 0)), int(q.get("feed", 0)),
+               int(c.get("rejected", 0)), age))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="live cluster dashboard over "
+                                             "the PS telemetry plane")
+    ap.add_argument("--host",
+                    default=os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"))
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")))
+    ap.add_argument("-n", "--num-workers", type=int,
+                    default=int(os.environ.get("DMLC_NUM_WORKER", "1")))
+    ap.add_argument("-s", "--num-servers", type=int,
+                    default=int(os.environ.get("DMLC_NUM_SERVER", "1")))
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen control)")
+    ap.add_argument("--trace", action="store_true",
+                    help="also print per-server per-rank RPC attribution")
+    ap.add_argument("--factor", type=float, default=2.0,
+                    help="straggler threshold vs cluster-median self time")
+    args = ap.parse_args(argv)
+    obs = Observer(args.host, args.port, args.num_servers)
+    while True:
+        snaps = {r: obs.snapshot(r) for r in range(args.num_workers)}
+        frame = render(snaps, obs.membership(), args.factor)
+        if args.trace:
+            frame += "\nserver traces: %s" % json.dumps(obs.server_traces())
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[H\x1b[2J" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
