@@ -190,9 +190,20 @@ run_telemetry() {
 run_pipeline() {
   # input-pipeline feed tier (docs/perf.md §pipeline): uint8-wire numeric
   # parity vs fp32 wire, double-buffer teardown safety, MXNET_FEED_DEPTH,
-  # pipeline stage telemetry. Host-only (no accelerator) and fast.
+  # pipeline stage telemetry, and the native C++ decode stage (PIL-oracle
+  # parity, quarantine budget, resume/reshard round-trips, fallback
+  # counters). Host-only (no accelerator) and fast.
+  #
+  # The native build gets a graceful skip: on a bare container (no
+  # toolchain / no libjpeg) the suite still runs — the stage-specific
+  # cases skip themselves and the fallback-counter cases prove the Python
+  # path takes over (io.native_decode_fallback stays always-on).
+  if ! make -C mxnet_tpu/src >/tmp/mxtpu_pipeline_build.log 2>&1; then
+    echo "pipeline tier: native build unavailable (see" \
+         "/tmp/mxtpu_pipeline_build.log); running Python-path cases only"
+  fi
   JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_pipeline_feed.py \
-    -q -m "not slow"
+    tests_tpu/test_native_decode.py -q -m "not slow"
 }
 
 run_guard() {

@@ -68,6 +68,37 @@ def _declare(lib):
         c.c_void_p, c.POINTER(c.POINTER(c.c_char)), c.POINTER(c.c_size_t)]
     lib.mxt_rec_free.argtypes = [c.POINTER(c.c_char), c.c_size_t]
     lib.mxt_rec_reader_close.argtypes = [c.c_void_p]
+    # decode pipeline (src/pipe.cc; a library built before the stage existed
+    # simply reports no pipe support instead of failing the whole load)
+    try:
+        lib.mxt_pipe_create.restype = c.c_void_p
+        lib.mxt_pipe_create.argtypes = [c.POINTER(MXTPipeConfig)]
+        lib.mxt_pipe_next.restype = c.c_int
+        lib.mxt_pipe_next.argtypes = [
+            c.c_void_p, c.POINTER(c.c_uint8), c.POINTER(c.c_float),
+            c.POINTER(c.c_int)]
+        lib.mxt_pipe_pop.restype = c.c_int
+        lib.mxt_pipe_pop.argtypes = [
+            c.c_void_p, c.POINTER(c.POINTER(c.c_uint8)),
+            c.POINTER(c.POINTER(c.c_float)), c.POINTER(c.c_int)]
+        lib.mxt_pipe_release.argtypes = [
+            c.c_void_p, c.POINTER(c.c_uint8), c.POINTER(c.c_float)]
+        lib.mxt_pipe_error.restype = c.c_char_p
+        lib.mxt_pipe_error.argtypes = [c.c_void_p]
+        lib.mxt_pipe_stats.argtypes = [c.c_void_p, c.POINTER(c.c_double),
+                                       c.c_int]
+        lib.mxt_pipe_close.argtypes = [c.c_void_p]
+        lib.mxt_pipe_decode_available.restype = c.c_int
+        lib.mxt_decode_jpeg.restype = c.c_int
+        lib.mxt_decode_jpeg.argtypes = [
+            c.c_char_p, c.c_size_t, c.POINTER(c.POINTER(c.c_uint8)),
+            c.POINTER(c.c_int), c.POINTER(c.c_int)]
+        lib.mxt_resize_bilinear.argtypes = [
+            c.c_char_p, c.c_int, c.c_int, c.c_int, c.POINTER(c.c_uint8),
+            c.c_int, c.c_int]
+        lib._mxt_has_pipe = True
+    except AttributeError:
+        lib._mxt_has_pipe = False
     # ps
     lib.mxt_ps_server_create.restype = c.c_void_p
     lib.mxt_ps_server_create.argtypes = [c.c_int, c.c_int, c.c_int]
@@ -131,6 +162,30 @@ def get_lib():
         except OSError:
             _lib = None
         return _lib
+
+
+class MXTPipeConfig(ctypes.Structure):
+    """Mirror of src/include/pipe_api.h MXTPipeConfig (the native
+    decode->augment->batch stage's construction parameters)."""
+
+    _fields_ = [
+        ("path", ctypes.c_char_p),
+        ("part_index", ctypes.c_int),
+        ("num_parts", ctypes.c_int),
+        ("num_threads", ctypes.c_int),
+        ("batch_size", ctypes.c_int),
+        ("out_h", ctypes.c_int),
+        ("out_w", ctypes.c_int),
+        ("out_c", ctypes.c_int),
+        ("label_width", ctypes.c_int),
+        ("seed", ctypes.c_longlong),
+        ("epoch", ctypes.c_longlong),
+        ("resize", ctypes.c_int),
+        ("crop", ctypes.c_int),
+        ("mirror_prob", ctypes.c_double),
+        ("max_bad", ctypes.c_longlong),
+        ("prefetch", ctypes.c_int),
+    ]
 
 
 # C callback signatures

@@ -72,12 +72,26 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=0.0, std_g=0.0, std_b=0.0,
                  max_random_contrast=0.0, max_random_illumination=0.0,
                  brightness=0.0, contrast=0.0, saturation=0.0, pca_noise=0.0,
-                 wire_dtype=None,
+                 wire_dtype=None, backend=None,
                  **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(int(x) for x in data_shape)
         self.label_width = label_width
         self.batch_size = batch_size
+        # decode backend (docs/env_var.md MXNET_NATIVE_DECODE): 'native'
+        # requests the C++ decode->augment->batch stage (src/pipe.cc),
+        # 'python' pins the threaded PIL/numpy pipeline, None defers to the
+        # env var. The native stage produces uint8-HWC wire batches, so an
+        # explicit backend='native' implies the uint8 wire unless the caller
+        # pinned wire_dtype themselves. Configs the native stage cannot
+        # express fall back to the Python path (counted always-on in
+        # io.native_decode_fallback{reason=...}).
+        if backend not in (None, "python", "native"):
+            raise MXNetError("backend must be 'python' or 'native', got %r"
+                             % (backend,))
+        self._backend = backend
+        if backend == "native" and wire_dtype is None and self._supports_wire():
+            wire_dtype = "uint8"
         mean, std = _mean_std(mean_r, mean_g, mean_b, std_r, std_g, std_b)
         # uint8 wire (default off; docs/env_var.md MXNET_WIRE_UINT8): batches
         # stay uint8 HWC end-to-end on the host — 4x less host->device wire
@@ -182,6 +196,150 @@ class ImageRecordIter(DataIter):
             arr = arr.transpose(2, 0, 1)  # HWC -> CHW
         return arr, np.asarray(header.label).reshape(-1)
 
+    # ---- native decode stage (src/pipe.cc) -------------------------------
+    def _native_requested(self):
+        if self._backend == "native":
+            return True
+        return self._backend is None and env_bool("MXNET_NATIVE_DECODE")
+
+    def _native_aug_plan(self):
+        """Map ``auglist`` onto the native stage's fixed resize->crop->flip
+        chain: ``(resize, crop_mode, mirror_prob)`` or None when any
+        augmenter (or ordering) is outside what augment.cc implements.
+        Interp must be the PIL-bilinear family — the native resampler is
+        bit-identical to PIL's BILINEAR, which is what imresize_np's PIL
+        branch uses for every nonzero interp code."""
+        from .image import (CenterCropAug, HorizontalFlipAug, RandomCropAug,
+                            ResizeAug)
+
+        resize, crop, mirror = 0, None, 0.0
+        stage = 0  # 0: want resize/crop, 1: want crop, 2: want flip, 3: done
+        for aug in self.auglist:
+            t = type(aug)
+            if t is ResizeAug and stage == 0 and aug.interp:
+                resize, stage = int(aug.size), 1
+            elif (t in (RandomCropAug, CenterCropAug) and stage <= 1
+                  and aug.interp
+                  and tuple(aug.size) == (self.data_shape[2],
+                                          self.data_shape[1])):
+                crop = 1 if t is RandomCropAug else 0
+                stage = 2
+            elif t is HorizontalFlipAug and stage == 2:
+                mirror, stage = float(aug.p), 3
+            else:
+                return None
+        if crop is None:
+            return None
+        return resize, crop, mirror
+
+    def _native_eligibility(self):
+        """Reason label when this config cannot run on the native stage
+        (io.native_decode_fallback{reason=...}), else None."""
+        from ._native import get_lib
+
+        if type(self)._process_record is not ImageRecordIter._process_record:
+            return "subclass"
+        if self._wire is None:
+            return "wire"
+        if self.data_shape[0] != 3:
+            return "shape"
+        if self.path_imgidx:
+            return "indexed"
+        if self.shuffle:
+            return "shuffle"
+        if self._native_aug_plan() is None:
+            return "augmenters"
+        lib = get_lib()
+        if lib is None or not getattr(lib, "_mxt_has_pipe", False):
+            return "no_lib"
+        if not lib.mxt_pipe_decode_available():
+            return "no_jpeg"
+        return None
+
+    def _start_native(self):
+        import ctypes
+
+        from ._native import MXTPipeConfig, get_lib
+        from .base import env_int
+
+        lib = get_lib()
+        resize, crop, mirror = self._native_aug_plan()
+        threads = env_int("MXNET_DECODE_THREADS", 0) or self.preprocess_threads
+        c, h, w = self.data_shape
+        cfg = MXTPipeConfig(
+            path=self.path_imgrec.encode(),
+            part_index=int(self.part_index), num_parts=int(self.num_parts),
+            num_threads=max(1, int(threads)), batch_size=int(self.batch_size),
+            out_h=h, out_w=w, out_c=c, label_width=int(self.label_width),
+            seed=int(self.seed), epoch=int(self._epoch),
+            resize=resize, crop=crop, mirror_prob=mirror,
+            max_bad=-1 if self._max_bad is None else int(self._max_bad),
+            prefetch=int(self.prefetch_buffer))
+        handle = lib.mxt_pipe_create(ctypes.byref(cfg))
+        if not handle:
+            return False
+        self._native = handle
+        self._native_lib = lib
+        self._native_polled = [0.0] * 6  # cumulative stats at the last poll
+        self._native_held = None  # zero-copy batch awaiting release
+        _LIVE_ITERS.add(self)
+        return True
+
+    def _native_release_held(self):
+        """Release the previous zero-copy batch. Deferred one call: by the
+        time the NEXT batch is popped, ``next()`` has device_put the
+        previous one, so its stage-owned buffers are dead."""
+        if self._native_held is not None:
+            d, l = self._native_held
+            self._native_held = None
+            self._native_lib.mxt_pipe_release(self._native, d, l)
+
+    def _poll_native_stats(self):
+        """Fold the native stage's cumulative counters into telemetry as
+        deltas: bad records always-on, per-batch stage walls when enabled."""
+        import ctypes
+
+        raw = (ctypes.c_double * 6)()
+        self._native_lib.mxt_pipe_stats(self._native, raw, 6)
+        prev, cur = self._native_polled, list(raw)
+        self._native_polled = cur
+        bad = int(cur[0] - prev[0])
+        if bad > 0:
+            telemetry.counter("io.bad_records", source="decode").inc(bad)
+            logging.warning(
+                "ImageRecordIter[native]: %d corrupt record(s) quarantined "
+                "(%d total)", bad, int(cur[0]))
+        if telemetry.enabled():
+            for i, stage in ((1, "decode_native"), (2, "augment_native"),
+                             (3, "assemble_native")):
+                if cur[i] > prev[i]:
+                    telemetry.pipeline_stage(stage).observe(cur[i] - prev[i])
+
+    def _native_next(self):
+        import ctypes
+
+        self._native_release_held()
+        c, h, w = self.data_shape
+        dptr = ctypes.POINTER(ctypes.c_uint8)()
+        lptr = ctypes.POINTER(ctypes.c_float)()
+        pad = ctypes.c_int(0)
+        rc = self._native_lib.mxt_pipe_pop(
+            self._native, ctypes.byref(dptr), ctypes.byref(lptr),
+            ctypes.byref(pad))
+        self._poll_native_stats()
+        if rc == 0:
+            raise StopIteration
+        if rc < 0:
+            msg = self._native_lib.mxt_pipe_error(self._native)
+            raise MXNetError((msg or b"native decode stage failed").decode())
+        self._native_held = (dptr, lptr)
+        # zero-copy views over the stage's batch buffers: valid until the
+        # next pop, by which point next() has device_put both arrays
+        data = np.ctypeslib.as_array(dptr, shape=(self.batch_size, h, w, c))
+        label = np.ctypeslib.as_array(
+            lptr, shape=(self.batch_size, self.label_width))
+        return data, label, pad.value
+
     # ---- pipeline --------------------------------------------------------
     def _record_stream(self):
         """Yield raw records for this worker's shard."""
@@ -208,6 +366,19 @@ class ImageRecordIter(DataIter):
             rec.close()
 
     def _start_pipeline(self):
+        self._native = None
+        if self._native_requested():
+            why = self._native_eligibility()
+            if why is None and self._start_native():
+                return
+            why = why or "create"
+            # always-on: a production job that silently lost its native
+            # stage must be diagnosable from metrics alone
+            telemetry.counter("io.native_decode_fallback", reason=why).inc()
+            if self._backend == "native":
+                logging.warning(
+                    "ImageRecordIter: native decode backend unavailable "
+                    "(%s); falling back to the Python pipeline", why)
         _LIVE_ITERS.add(self)
         self._raw_q = queue.Queue(maxsize=self.preprocess_threads * 8)
         self._out_q = queue.Queue(maxsize=self.prefetch_buffer)
@@ -428,6 +599,16 @@ class ImageRecordIter(DataIter):
         forced unwind crossing noexcept C++ frames), so live iterators must
         wind down BEFORE CPython tears daemon threads down.
         """
+        if getattr(self, "_native", None) is not None:
+            self._native_release_held()
+            self._poll_native_stats()
+            self._native_lib.mxt_pipe_close(self._native)
+            self._native = None
+            # keep close()'s contract on the native path too: next() after
+            # close() raises StopIteration instead of AttributeError
+            self._out_q = queue.Queue()
+            self._out_q.put_nowait(None)
+            return
         if not hasattr(self, "_stop"):
             return
         self._stop.set()
@@ -472,6 +653,10 @@ class ImageRecordIter(DataIter):
         """One raw ``(data, label, pad)`` from the pipeline; raises
         StopIteration at end-of-stream and re-raises a pipeline error item
         (bad-record budget exceeded) on the consumer thread."""
+        if self._native is not None:
+            item = self._native_next()
+            self._batches += 1
+            return item
         item = self._out_q.get()
         if item is None:
             raise StopIteration

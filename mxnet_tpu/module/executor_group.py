@@ -56,20 +56,30 @@ def _load_general(data, targets, major_axis):
                 d_src = d_src.astype(d_targets.dtype)
             d_src.copyto(d_targets)
         else:
-            src_np = d_src.asnumpy() if isinstance(d_src, nd.NDArray) else np.asarray(d_src)
+            # device-side slice per target: an NDArray source scatters
+            # without a host round trip (the full-slice __setitem__ casts
+            # to the bound dtype on device); host sources slice in numpy
+            if not isinstance(d_src, (nd.NDArray, np.ndarray)):
+                # fwlint: disable=host-sync-in-hot-path — host list/tuple input: construction, not a device sync
+                d_src = np.array(d_src)
             for sl, d_dst in d_targets:
-                d_dst[:] = src_np[sl]
+                d_dst[:] = d_src[sl]
 
 
 def _merge_multi_context(outputs, major_axis):
     """Concat per-device outputs along the batch axis (reference:
-    executor_group.py _merge_multi_context)."""
+    executor_group.py _merge_multi_context). Device-side Concat: merging
+    N per-device outputs used to stage N host downloads + one upload per
+    output PER STEP; the compiled op keeps the merge on device and the
+    consumer decides if/when to sync."""
     rets = []
     for tensors, axis in zip(outputs, major_axis):
         if axis >= 0 and len(tensors) > 1:
-            rets.append(
-                nd.array(np.concatenate([t.asnumpy() for t in tensors], axis=axis))
-            )
+            # device-to-device gather onto the first shard's device, then
+            # one compiled Concat there (jit refuses mixed-device args)
+            ctx0 = tensors[0].context
+            rets.append(nd.concatenate(
+                [t.as_in_context(ctx0) for t in tensors], axis=axis))
         else:
             rets.append(tensors[0])
     return rets
@@ -332,12 +342,21 @@ class DataParallelExecutorGroup:
 
     def update_metric(self, eval_metric, labels):
         """(reference: executor_group.py:530)"""
-        for texec, islice in zip(self.execs, self.slices):
+        for i, (texec, islice) in enumerate(zip(self.execs, self.slices)):
             labels_slice = []
             for label, axis in zip(labels, self.label_layouts if labels else []):
                 if axis == 0:
-                    label_np = label.asnumpy() if isinstance(label, nd.NDArray) else label
-                    labels_slice.append(nd.array(label_np[islice]))
+                    # device-side slice + device-to-device move (the
+                    # backward() idiom): this runs every batch, and the old
+                    # asnumpy() synced the whole label batch per executor.
+                    # The move matters — metric ops jit over (label, output)
+                    # pairs, which must share the executor's device.
+                    if isinstance(label, nd.NDArray):
+                        labels_slice.append(
+                            label[islice].as_in_context(self.contexts[i]))
+                    else:
+                        labels_slice.append(nd.array(label[islice],
+                                                     ctx=self.contexts[i]))
                 else:
                     labels_slice.append(label)
             eval_metric.update(labels_slice, texec.outputs)

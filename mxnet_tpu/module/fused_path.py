@@ -205,11 +205,23 @@ class FusedFitPath:
 
     def stage(self, data_batch):
         self._ensure_device_state()
+
+        def as_input(arr):
+            # NDArrays hand over their device buffer (no sync); host numpy
+            # passes through; only exotic list/tuple inputs pay a construction
+            # (jit would otherwise flatten a list into a pytree of scalars)
+            if isinstance(arr, nd.NDArray):
+                return arr.data
+            if isinstance(arr, np.ndarray):
+                return arr
+            # fwlint: disable=host-sync-in-hot-path — host list/tuple input: construction, not a device sync
+            return np.array(arr)
+
         inputs = {}
         for (name, _), arr in zip(self._data_shapes, data_batch.data):
-            inputs[name] = arr.data if isinstance(arr, nd.NDArray) else np.asarray(arr)
+            inputs[name] = as_input(arr)
         for (name, _), arr in zip(self._label_shapes, data_batch.label or []):
-            inputs[name] = arr.data if isinstance(arr, nd.NDArray) else np.asarray(arr)
+            inputs[name] = as_input(arr)
         self._pending = inputs
         self.staged_batch = data_batch  # kept for classic-path replay
         self._outs = None

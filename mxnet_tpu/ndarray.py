@@ -433,7 +433,10 @@ def array(source_array, ctx=None, dtype=None):
         src = np.asarray(source_array)
         if dtype is None:
             dtype = np.float32
-    src = np.asarray(src).astype(dtype)
+    # copy=False: device_put below copies host memory into the device buffer
+    # anyway, so an eager astype copy would stage every batch TWICE (4.8 MB
+    # extra per uint8-wire batch at 32x224^2 — docs/perf.md §pipeline)
+    src = np.asarray(src).astype(dtype, copy=False)
     return NDArray(jax.device_put(src, ctx.jax_device), ctx=ctx)
 
 

@@ -1,0 +1,205 @@
+// Native JPEG decode for the input-pipeline stage (reference:
+// iter_image_recordio_2.cc decodes with cv::imdecode inside the OMP pool;
+// here the backend is libjpeg bound at build time — the Makefile probes for
+// a linkable -ljpeg and compiles this with MXT_HAS_LIBJPEG when found, so a
+// bare container still builds the rest of the runtime and python's PIL path
+// stays the fallback and correctness oracle).
+//
+// Output contract matches image.py imdecode_np's PIL branch: RGB, HWC,
+// uint8; grayscale sources expand to RGB (PIL's convert("RGB")). Exotic
+// color spaces libjpeg cannot convert to RGB (e.g. CMYK from Adobe
+// markers) fail with -1 and are quarantined by the caller like any other
+// corrupt record.
+
+#include <cstddef>
+#include <cstdint>
+
+#include "include/pipe_api.h"
+
+extern "C" {
+void* mxt_alloc(size_t nbytes);
+void mxt_free(void* p, size_t nbytes);
+}
+
+#ifdef MXT_HAS_LIBJPEG
+
+#include <csetjmp>
+#include <cstdio>
+#include <cstring>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void on_error_exit(j_common_ptr cinfo) {
+  // corrupt records are expected input here: recover via longjmp instead of
+  // libjpeg's default exit()
+  longjmp(reinterpret_cast<ErrorMgr*>(cinfo->err)->setjmp_buffer, 1);
+}
+
+void on_output_message(j_common_ptr) {}  // keep warnings off stderr
+
+// Version-independent memory source (jpeg_mem_src is libjpeg8+/turbo-only;
+// the 62 ABI needs a hand-rolled source manager).
+struct MemSrc {
+  jpeg_source_mgr pub;
+  const uint8_t* data;
+  size_t len;
+};
+
+void src_init(j_decompress_ptr) {}
+
+boolean src_fill(j_decompress_ptr cinfo) {
+  // past the end of the buffer: feed a fake EOI so truncated files error
+  // out through the normal header/marker checks instead of hanging
+  static const JOCTET kEoi[2] = {0xFF, JPEG_EOI};
+  cinfo->src->next_input_byte = kEoi;
+  cinfo->src->bytes_in_buffer = 2;
+  return TRUE;
+}
+
+void src_skip(j_decompress_ptr cinfo, long n) {
+  if (n <= 0) return;
+  jpeg_source_mgr* src = cinfo->src;
+  while (static_cast<size_t>(n) > src->bytes_in_buffer) {
+    n -= static_cast<long>(src->bytes_in_buffer);
+    src_fill(cinfo);
+  }
+  src->next_input_byte += n;
+  src->bytes_in_buffer -= n;
+}
+
+void src_term(j_decompress_ptr) {}
+
+void set_mem_src(j_decompress_ptr cinfo, MemSrc* src, const uint8_t* buf,
+                 size_t len) {
+  src->pub.init_source = src_init;
+  src->pub.fill_input_buffer = src_fill;
+  src->pub.skip_input_data = src_skip;
+  src->pub.resync_to_restart = jpeg_resync_to_restart;
+  src->pub.term_source = src_term;
+  src->pub.next_input_byte = buf;
+  src->pub.bytes_in_buffer = len;
+  src->data = buf;
+  src->len = len;
+  cinfo->src = &src->pub;
+}
+
+}  // namespace
+
+extern "C" int mxt_decode_jpeg(const uint8_t* buf, size_t len, uint8_t** out,
+                               int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  MemSrc src;
+  // volatile: both are written after setjmp and read in the longjmp error
+  // path — without it the compiler may keep them in registers and the
+  // handler would free a stale pointer (or leak) on every corrupt record
+  uint8_t* volatile mem = nullptr;
+  volatile size_t nbytes = 0;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = on_error_exit;
+  jerr.pub.output_message = on_output_message;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    if (mem) mxt_free(mem, nbytes);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  set_mem_src(&cinfo, &src, buf, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = JCS_RGB;  // YCbCr + grayscale both convert
+  jpeg_start_decompress(&cinfo);
+  if (cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  *h = static_cast<int>(cinfo.output_height);
+  *w = static_cast<int>(cinfo.output_width);
+  nbytes = static_cast<size_t>(*h) * *w * 3;
+  mem = static_cast<uint8_t*>(mxt_alloc(nbytes));
+  if (!mem) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  size_t stride = static_cast<size_t>(*w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = mem + cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *out = mem;
+  return 0;
+}
+
+/* Decode directly into a caller buffer when the source dimensions equal
+ * (h, w) exactly — the packed-dataset fast path: no intermediate image,
+ * no copy. Returns 1 = decoded into dst, 0 = dimensions differ (caller
+ * takes the resize path), -1 = corrupt. */
+extern "C" int mxt_decode_jpeg_direct(const uint8_t* buf, size_t len,
+                                      uint8_t* dst, int h, int w) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  MemSrc src;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = on_error_exit;
+  jerr.pub.output_message = on_output_message;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  set_mem_src(&cinfo, &src, buf, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  if (static_cast<int>(cinfo.image_height) != h ||
+      static_cast<int>(cinfo.image_width) != w) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  if (cinfo.output_components != 3 ||
+      static_cast<int>(cinfo.output_height) != h ||
+      static_cast<int>(cinfo.output_width) != w) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  size_t stride = static_cast<size_t>(w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = dst + cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 1;
+}
+
+extern "C" int mxt_pipe_decode_available(void) { return 1; }
+
+#else  // !MXT_HAS_LIBJPEG
+
+extern "C" int mxt_decode_jpeg(const uint8_t*, size_t, uint8_t**, int*,
+                               int*) {
+  return -2;
+}
+
+extern "C" int mxt_decode_jpeg_direct(const uint8_t*, size_t, uint8_t*, int,
+                                      int) {
+  return -1;
+}
+
+extern "C" int mxt_pipe_decode_available(void) { return 0; }
+
+#endif  // MXT_HAS_LIBJPEG

@@ -321,7 +321,12 @@ def histogram(name, buckets=None, **labels):
 #   upload    per-batch host->device transfer + on-device wire decode
 #             (DeviceFeedIter transfer thread)
 #   feed_wait per-batch consumer wait on the device feed queue
-PIPELINE_STAGES = ("decode", "assemble", "upload", "feed_wait")
+#   decode_native / augment_native / assemble_native
+#             the same splits inside the native C++ stage
+#             (ImageRecordIter(backend='native'), src/pipe.cc — observed
+#             per batch as thread-summed deltas)
+PIPELINE_STAGES = ("decode", "assemble", "upload", "feed_wait",
+                   "decode_native", "augment_native", "assemble_native")
 
 
 def pipeline_stage(stage):
@@ -548,6 +553,9 @@ METRIC_HELP = {
     "speedometer.samples_per_sec": "last Speedometer window sample",
     "io.batch_fetch_seconds": "per-iterator batch fetch latency",
     "io.bad_records": "corrupt records quarantined by source",
+    "io.native_decode_fallback":
+        "native decode stage fallbacks to the Python pipeline by reason "
+        "(always-on)",
     "pipeline.stage_seconds": "input-pipeline stage wall by stage label",
     "pipeline.feed_depth": "batches parked device-resident in the feed queue",
     "engine.pushes": "host-side ops pushed to the engine",

@@ -1,0 +1,394 @@
+"""Native decode+augment stage (ci/run_tests.sh pipeline; docs/perf.md
+§pipeline): ImageRecordIter(backend='native') — the C++ decode->augment->
+batch pipeline (src/decode.cc + augment.cc + pipe.cc) against its Python/PIL
+correctness oracle.
+
+Host-only (tests_tpu/conftest.py exempts this file from the hardware gate).
+When the native library or its JPEG backend is unavailable (bare container),
+the stage-specific cases skip and the fallback cases still run — the
+always-on ``io.native_decode_fallback`` counter is itself under test.
+
+Parity contract (docs/perf.md §pipeline): the native resampler reproduces
+PIL's BILINEAR bit-for-bit (fixed-point two-pass, augment.cc), and decode
+goes through libjpeg(-turbo) on both sides, so batches match the PIL oracle
+within ±1/pixel (exactly 0 observed when both link libjpeg-turbo; the ±1
+allowance covers containers pairing IJG libjpeg with Pillow's bundled
+turbo).
+"""
+import ctypes
+import io as _io
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import recordio, telemetry  # noqa: E402
+from mxnet_tpu._native import get_lib  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+
+pytestmark = pytest.mark.pipeline
+
+
+def _native_lib():
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_mxt_has_pipe", False):
+        return None
+    return lib
+
+
+def _decode_ready():
+    lib = _native_lib()
+    return lib is not None and lib.mxt_pipe_decode_available()
+
+
+needs_native = pytest.mark.skipif(
+    _native_lib() is None, reason="native runtime unavailable")
+needs_jpeg = pytest.mark.skipif(
+    not _decode_ready(), reason="native JPEG backend unavailable")
+
+
+def _jpeg(arr, quality=90):
+    from PIL import Image
+
+    bio = _io.BytesIO()
+    Image.fromarray(arr).save(bio, format="JPEG", quality=quality)
+    return bio.getvalue()
+
+
+def _photo(rng, h, w):
+    """Blocky texture + noise: compresses (and decodes) like a photo."""
+    base = rng.rand((h + 7) // 8, (w + 7) // 8, 3) * 255
+    arr = np.kron(base, np.ones((8, 8, 1)))[:h, :w]
+    return np.clip(arr + rng.randn(h, w, 3) * 8, 0, 255).astype(np.uint8)
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    """48 mixed-geometry records; labels are the record index."""
+    path = str(tmp_path_factory.mktemp("native_io") / "data.rec")
+    rng = np.random.RandomState(7)
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(48):
+        h, w = [(96, 128), (80, 80), (150, 100), (64, 96)][i % 4]
+        rec.write(recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), _jpeg(_photo(rng, h, w))))
+    rec.close()
+    return path
+
+
+def _make(rec, backend, **kw):
+    args = dict(path_imgrec=rec, data_shape=(3, 48, 48), batch_size=8,
+                preprocess_threads=2, shuffle=False, resize=56,
+                wire_dtype="uint8", backend=backend)
+    args.update(kw)
+    return mx.io_image.ImageRecordIter(**args)
+
+
+def _drain(it, limit=None):
+    out = []
+    while limit is None or len(out) < limit:
+        try:
+            b = it.next()
+        except StopIteration:
+            break
+        out.append((b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy(),
+                    b.pad))
+    return out
+
+
+# ------------------------------------------------------------ kernel parity
+@needs_native
+def test_resize_bilinear_matches_pil_bitwise():
+    from PIL import Image
+
+    lib = _native_lib()
+    rng = np.random.RandomState(0)
+    for (sh, sw), (dh, dw) in [((100, 140), (48, 48)), ((48, 48), (100, 70)),
+                               ((57, 91), (91, 57)), ((64, 64), (63, 65)),
+                               ((80, 48), (40, 48)), ((48, 80), (48, 96))]:
+        src = rng.randint(0, 256, (sh, sw, 3), np.uint8)
+        pil = np.asarray(
+            Image.fromarray(src).resize((dw, dh), Image.BILINEAR))
+        dst = np.zeros((dh, dw, 3), np.uint8)
+        lib.mxt_resize_bilinear(
+            src.tobytes(), sh, sw, 3,
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), dh, dw)
+        assert (pil == dst).all(), ((sh, sw), (dh, dw))
+
+
+@needs_jpeg
+def test_decode_matches_pil():
+    from PIL import Image
+
+    lib = _native_lib()
+    rng = np.random.RandomState(1)
+    for quality, gray in [(50, False), (90, False), (95, True)]:
+        arr = _photo(rng, 72, 96)
+        im = Image.fromarray(arr)
+        if gray:
+            im = im.convert("L")
+        bio = _io.BytesIO()
+        im.save(bio, format="JPEG", quality=quality)
+        blob = bio.getvalue()
+        oracle = np.asarray(Image.open(_io.BytesIO(blob)).convert("RGB"))
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        h, w = ctypes.c_int(), ctypes.c_int()
+        assert lib.mxt_decode_jpeg(blob, len(blob), ctypes.byref(out),
+                                   ctypes.byref(h), ctypes.byref(w)) == 0
+        got = np.ctypeslib.as_array(out, shape=(h.value, w.value, 3)).copy()
+        lib.mxt_rec_free(ctypes.cast(out, ctypes.POINTER(ctypes.c_char)),
+                         h.value * w.value * 3)
+        assert got.shape == oracle.shape
+        # ±1: IJG-vs-turbo IDCT rounding; 0 when both sides are turbo
+        assert np.abs(got.astype(int) - oracle.astype(int)).max() <= 1
+
+
+@needs_jpeg
+def test_decode_rejects_corrupt():
+    lib = _native_lib()
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    h, w = ctypes.c_int(), ctypes.c_int()
+    assert lib.mxt_decode_jpeg(b"\xff\xd8garbage", 9, ctypes.byref(out),
+                               ctypes.byref(h), ctypes.byref(w)) == -1
+
+
+# ------------------------------------------------------------- batch parity
+@needs_jpeg
+def test_batch_stream_matches_pil_oracle(rec_file, monkeypatch):
+    """Same records -> same uint8 batches, labels, and pad as the Python
+    pipeline on its PIL (oracle) backend, across two epochs."""
+    monkeypatch.setenv("MXNET_IMAGE_DECODE_BACKEND", "pil")
+    it_py = _make(rec_file, "python")
+    it_nat = _make(rec_file, "native")
+    assert it_nat._native is not None
+    for epoch in range(2):
+        a, b = _drain(it_py), _drain(it_nat)
+        assert len(a) == len(b) == 6
+        for i, ((da, la, pa), (db, lb, pb)) in enumerate(zip(a, b)):
+            assert da.dtype == db.dtype == np.uint8
+            assert np.abs(da.astype(int) - db.astype(int)).max() <= 1, \
+                (epoch, i)
+            assert (la == lb).all() and pa == pb
+        it_py.reset()
+        it_nat.reset()
+    it_py.close()
+    it_nat.close()
+
+
+@needs_jpeg
+def test_batch_wire_contract(rec_file):
+    """Native batches carry the uint8-HWC wire: WireSpec attached, HWC
+    layout, and the on-device decode restores the advertised fp32 NCHW."""
+    it = _make(rec_file, "native")
+    assert it._native is not None
+    b = it.next()
+    assert b.wire is not None
+    assert b.data[0].dtype == np.uint8
+    assert b.data[0].shape == (8, 48, 48, 3)
+    decoded = mx.io.apply_wire(b)
+    assert decoded.data[0].shape == tuple(it.provide_data[0].shape)
+    assert decoded.data[0].dtype == np.float32
+    it.close()
+
+
+@needs_jpeg
+def test_final_batch_pad(tmp_path):
+    """21 records at batch 8 -> pads like the Python batcher (wraparound)."""
+    path = str(tmp_path / "pad.rec")
+    rng = np.random.RandomState(3)
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(21):
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                _jpeg(_photo(rng, 64, 64))))
+    rec.close()
+    it = _make(path, "native")
+    assert it._native is not None
+    batches = _drain(it)
+    it.close()
+    assert [p for _, _, p in batches] == [0, 0, 3]
+    data, label, _ = batches[-1]
+    # wraparound padding repeats the filled prefix
+    assert (data[5] == data[0]).all() and label[5] == label[0]
+
+
+# ------------------------------------------------- determinism / RNG stream
+@needs_jpeg
+def test_native_random_augs_deterministic(rec_file):
+    """Per-worker seeded streams: same (seed, epoch, threads=1) -> identical
+    random crops/flips; a different seed diverges. (The native stream is
+    deterministic like the Python contract but is NOT the same sequence —
+    docs/env_var.md MXNET_NATIVE_DECODE.)"""
+    kw = dict(rand_crop=True, rand_mirror=True, preprocess_threads=1)
+    a = _drain(_make(rec_file, "native", seed=5, **kw))
+    b = _drain(_make(rec_file, "native", seed=5, **kw))
+    c = _drain(_make(rec_file, "native", seed=6, **kw))
+    assert all((x[0] == y[0]).all() for x, y in zip(a, b))
+    assert any((x[0] != y[0]).any() for x, y in zip(a, c))
+
+
+# --------------------------------------------------------------- quarantine
+@pytest.fixture
+def corrupt_rec(tmp_path):
+    path = str(tmp_path / "bad.rec")
+    rng = np.random.RandomState(9)
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(24):
+        if i % 8 == 2:  # 3 corrupt records
+            rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                    b"\xff\xd8not-a-jpeg"))
+        else:
+            rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                    _jpeg(_photo(rng, 64, 64))))
+    rec.close()
+    return path
+
+
+@needs_jpeg
+def test_quarantine_unbounded_skips_and_counts(corrupt_rec):
+    c0 = telemetry.counter("io.bad_records", source="decode").value
+    it = _make(corrupt_rec, "native", batch_size=7)
+    assert it._native is not None
+    batches = _drain(it)
+    it.close()
+    assert len(batches) == 3  # 21 good records / 7
+    assert telemetry.counter("io.bad_records", source="decode").value - c0 == 3
+    # skipped records drop out without reordering the survivors
+    labels = np.concatenate([lab for _, lab, _ in batches])
+    assert 2.0 not in labels and 10.0 not in labels and 18.0 not in labels
+
+
+@needs_jpeg
+def test_quarantine_budget_fails_fast(corrupt_rec, monkeypatch):
+    monkeypatch.setenv("MXNET_IO_MAX_BAD_RECORDS", "1")
+    it = _make(corrupt_rec, "native")
+    assert it._native is not None
+    with pytest.raises(MXNetError, match="MXNET_IO_MAX_BAD_RECORDS"):
+        _drain(it)
+    it.close()
+
+
+# ------------------------------------------------- resume / elastic reshard
+@needs_jpeg
+def test_state_dict_roundtrip(rec_file):
+    it = _make(rec_file, "native")
+    ref = _drain(it, limit=3)
+    state = it.state_dict()
+    assert state["batches"] == 3
+    it2 = _make(rec_file, "native")
+    it2.load_state(state)
+    a, b = it.next(), it2.next()
+    assert (a.data[0].asnumpy() == b.data[0].asnumpy()).all()
+    assert (a.label[0].asnumpy() == b.label[0].asnumpy()).all()
+    assert ref  # silence unused
+    it.close()
+    it2.close()
+
+
+@needs_jpeg
+def test_set_partition_matches_fresh_iterator(rec_file):
+    it = _make(rec_file, "native")
+    it.next()
+    it.set_partition(2, 1)
+    fresh = _make(rec_file, "native", part_index=1, num_parts=2)
+    a, b = _drain(it), _drain(fresh)
+    assert len(a) == len(b) and len(a) >= 1
+    for (da, la, _), (db, lb, _) in zip(a, b):
+        assert (da == db).all() and (la == lb).all()
+    it.close()
+    fresh.close()
+
+
+# ------------------------------------------------------- fallback discipline
+def _fallback_count(reason):
+    return telemetry.counter("io.native_decode_fallback", reason=reason).value
+
+
+def test_python_backend_never_native(rec_file):
+    it = _make(rec_file, "python")
+    assert it._native is None
+    it.close()
+
+
+def test_fallback_on_unsupported_augmenter(rec_file):
+    before = _fallback_count("augmenters")
+    it = _make(rec_file, "native", brightness=0.2)
+    assert it._native is None  # fell back
+    assert _fallback_count("augmenters") == before + 1
+    b = it.next()  # python pipeline still serves batches
+    assert b.data[0].shape == (8, 48, 48, 3)
+    it.close()
+
+
+def test_fallback_on_shuffle(rec_file):
+    before = _fallback_count("shuffle")
+    it = _make(rec_file, "native", shuffle=True)
+    assert it._native is None
+    assert _fallback_count("shuffle") == before + 1
+    it.close()
+
+
+def test_fallback_on_fp32_wire(rec_file):
+    before = _fallback_count("wire")
+    it = _make(rec_file, "native", wire_dtype="float32")
+    assert it._native is None
+    assert _fallback_count("wire") == before + 1
+    it.close()
+
+
+@needs_jpeg
+def test_env_var_opt_in(rec_file, monkeypatch):
+    """MXNET_NATIVE_DECODE=1 engages the stage without code changes — but
+    only on the uint8 wire (the env default never changes numerics)."""
+    monkeypatch.setenv("MXNET_NATIVE_DECODE", "1")
+    it = _make(rec_file, None)
+    assert it._native is not None
+    it.close()
+    before = _fallback_count("wire")
+    it = _make(rec_file, None, wire_dtype=None)
+    assert it._native is None  # fp32 wire: native not eligible
+    assert _fallback_count("wire") == before + 1
+    it.close()
+
+
+@needs_jpeg
+def test_native_stage_telemetry(rec_file):
+    telemetry.enable()
+    try:
+        telemetry.pipeline_stage("decode_native")  # ensure registered
+        it = _make(rec_file, "native")
+        _drain(it, limit=2)
+        it.close()
+        snap = telemetry.dump(include_events=False)
+        hists = [k for k in snap.get("histograms", {})
+                 if "decode_native" in k]
+        assert hists and all(
+            snap["histograms"][k]["count"] >= 1 for k in hists)
+    finally:
+        telemetry.disable()
+
+
+# ------------------------------------------------------------------ fit e2e
+@needs_jpeg
+def test_fit_trains_on_native_stage(rec_file):
+    d = mx.sym.Variable("data")
+    n = mx.sym.Convolution(d, num_filter=4, kernel=(3, 3), stride=(2, 2),
+                           name="c1")
+    n = mx.sym.Flatten(n)
+    n = mx.sym.FullyConnected(n, num_hidden=48, name="fc")
+    net = mx.sym.SoftmaxOutput(n, name="softmax")
+    # mean/std ride the WireSpec: the host stage stays pure-uint8 and the
+    # normalize runs fused on device (_image_wire_normalize)
+    it = _make(rec_file, "native", mean_r=123.7, mean_g=116.3, mean_b=103.5,
+               std_r=58.4, std_g=57.1, std_b=57.4)
+    assert it._native is not None
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), force_init=True)
+    it.close()
+    arg, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in arg.values())

@@ -9,10 +9,14 @@ gap stage by stage:
   A  raw JPEG decode cost per image (PIL vs cv2 backends)
   B  `ImageRecordIter` into a null consumer (decode + augment + batch +
      prefetch), fp32 wire vs uint8 wire
+  B' the same rung on the NATIVE decode stage (backend='native':
+     C++ decode+augment+batch, src/pipe.cc) — the B/B' delta is pure
+     Python-pipeline overhead at equal thread count
   C  the same batches through a no-op device consumer (host->device
      transfer + on-device wire decode, nothing else) — isolates the wire
-  D  the full `Module.fit` train step: fp32 wire, uint8 wire, and uint8
-     wire + the double-buffered async device feed (MXNET_FEED_DEPTH)
+  D  the full `Module.fit` train step: fp32 wire, uint8 wire, uint8
+     wire + the double-buffered async device feed (MXNET_FEED_DEPTH),
+     and uint8 wire + native decode stage
 
 Every ladder rung reports the MEDIAN over --reps measurement windows with
 its min-max band, and the per-stage `pipeline.stage_seconds` telemetry
@@ -125,10 +129,11 @@ def bench_decode(img_dir, n_meas=200):
     return pil_rate, cv_rate
 
 
-def _make_iter(rec, size, batch, threads, wire_dtype=None):
+def _make_iter(rec, size, batch, threads, wire_dtype=None, backend=None):
     return mx.io_image.ImageRecordIter(
         path_imgrec=rec, data_shape=(3, size, size), batch_size=batch,
-        preprocess_threads=threads, shuffle=False, wire_dtype=wire_dtype)
+        preprocess_threads=threads, shuffle=False, wire_dtype=wire_dtype,
+        backend=backend)
 
 
 def _windows(it, batch, n_batches, reps, consume):
@@ -155,16 +160,22 @@ def _windows(it, batch, n_batches, reps, consume):
 
 
 def bench_iter(rec, size, batch, threads, n_batches=30, reps=5,
-               wire_dtype=None):
-    """Ladder rung B: decode+augment+batch into a NULL consumer."""
-    it = _make_iter(rec, size, batch, threads, wire_dtype)
+               wire_dtype=None, backend=None):
+    """Ladder rung B (and B' with ``backend='native'``):
+    decode+augment+batch into a NULL consumer."""
+    it = _make_iter(rec, size, batch, threads, wire_dtype, backend)
+    if backend == "native" and it._native is None:
+        # the fallback would silently re-measure rung B as B'
+        emit("recorditer_native_unavailable", 1, "flag")
+        it.close()
+        return None
     next(iter(it))  # warm one batch (thread spin-up)
     rates = _windows(it, batch, n_batches, reps, None)
     it.close()
     med, lo, hi = _emit_band(
         "recorditer_imgs_per_sec", rates, "img/s",
         {"threads": threads, "batch": batch, "size": size,
-         "wire": wire_dtype or "float32"})
+         "wire": wire_dtype or "float32", "backend": backend or "python"})
     return med, lo, hi
 
 
@@ -199,11 +210,16 @@ def bench_transfer(rec, size, batch, threads, ctx, n_batches=30, reps=5,
 
 
 def bench_overlapped(rec, size, batch, threads, reps=5, wire_dtype=None,
-                     feed_depth=0):
+                     feed_depth=0, backend=None):
     """Ladder rung D: ImageRecordIter driving a small conv net fit — the full
     host-produce / device-consume overlap. Rate is measured PER EPOCH (first
     epoch dropped: compile) so one fit yields ``reps`` median windows."""
-    it = _make_iter(rec, size, batch, threads, wire_dtype)
+    it = _make_iter(rec, size, batch, threads, wire_dtype, backend)
+    if backend == "native" and it._native is None:
+        # the fallback would silently measure the Python path as "native"
+        emit("rec_training_native_unavailable", 1, "flag")
+        it.close()
+        return None
     data = mx.sym.Variable("data")
     net = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
                              stride=(2, 2), name="c1")
@@ -246,7 +262,8 @@ def bench_overlapped(rec, size, batch, threads, reps=5, wire_dtype=None,
     med, lo, hi = _emit_band(
         "rec_training_imgs_per_sec", rates, "img/s",
         {"threads": threads, "batch": batch, "device": str(ctx),
-         "wire": wire_dtype or "float32", "feed_depth": feed_depth})
+         "wire": wire_dtype or "float32", "feed_depth": feed_depth,
+         "backend": backend or "python"})
     return med, lo, hi
 
 
@@ -311,6 +328,19 @@ def main():
     rows.append(("B decode+augment+batch -> null (2 thr, uint8)", None,
                  _fmt(*b_u)))
 
+    # B': the native C++ decode stage at the SAME thread count — the ratio
+    # vs B is the acceptance bar (>= 2x, ISSUE 8 / docs/perf.md)
+    b_n = bench_iter(rec, a.size, a.batch, 2, nb, a.reps,
+                     wire_dtype="uint8", backend="native")
+    if b_n is not None:
+        rows.append(("B' NATIVE decode+augment+batch -> null (2 thr, uint8)",
+                     None, _fmt(*b_n)))
+        emit("native_vs_python_b_speedup", b_n[0] / b_u[0], "x",
+             {"b_python": round(b_u[0], 1), "b_native": round(b_n[0], 1)})
+    else:
+        rows.append(("B' NATIVE decode+augment+batch -> null (2 thr, uint8)",
+                     None, "unavailable (no native lib / JPEG backend)"))
+
     # C: + host->device transfer (no-op consumer)
     c_f = bench_transfer(rec, a.size, a.batch, 2, ctx, nb, a.reps)
     c_u = bench_transfer(rec, a.size, a.batch, 2, ctx, nb, a.reps,
@@ -336,10 +366,18 @@ def main():
     d_uf = bench_overlapped(rec, a.size, a.batch, 2, a.reps,
                             wire_dtype="uint8", feed_depth=2)
     emit("stage_p50s_uint8_feed", 0, "s", {"p50": _stage_p50s()})
+    telemetry.reset()
+    telemetry.enable()
+    d_un = bench_overlapped(rec, a.size, a.batch, 2, a.reps,
+                            wire_dtype="uint8", backend="native")
+    emit("stage_p50s_uint8_native", 0, "s", {"p50": _stage_p50s()})
     rows.append(("D full train step (fp32 wire)", None, _fmt(*d_f)))
     rows.append(("D full train step (uint8 wire)", None, _fmt(*d_u)))
     rows.append(("D full train step (uint8 wire + feed depth 2)", None,
                  _fmt(*d_uf)))
+    rows.append(("D full train step (uint8 wire + NATIVE decode)", None,
+                 _fmt(*d_un) if d_un is not None
+                 else "unavailable (no native lib / JPEG backend)"))
 
     print("\n### attribution ladder (paste into docs/perf.md)\n")
     print("| ladder rung | img/s (median, band) |")
