@@ -33,7 +33,7 @@ BASELINE = 181.53  # P100 fp32 train img/s (BASELINE.md)
 
 
 def _emit(imgs_per_sec):
-    from mxnet_tpu import telemetry
+    from mxnet_tpu import compileobs, telemetry
 
     # the registry is the single source of truth for the headline number:
     # the gauge is set, then read back for the JSON line, so CLI output and
@@ -48,6 +48,10 @@ def _emit(imgs_per_sec):
         "value": value,
         "unit": "images/sec",
         "vs_baseline": round(value / BASELINE, 3),
+        # compile accounting is always-on (compileobs): the perf trajectory
+        # can separate compile wall from steady-state throughput, and a
+        # recompile sneaking into the timed window is visible in the record
+        "compile": compileobs.summary(),
     }
     if telemetry.enabled():
         rec["telemetry"] = telemetry.dump(include_events=False)

@@ -4,9 +4,11 @@
 #   unit      python unit tests on the virtual 8-device CPU mesh (not slow)
 #   native    C++ runtime build + native-path tests
 #   faults    fault-injection / robustness suite (fast, host-only)
-#   telemetry runtime-telemetry + cluster-observability suite: registry/exposition/
-#             fit metrics/trace identity/straggler/trace_merge (host-only; slow e2e
-#             acceptance cases run when invoked directly)
+#   telemetry runtime-telemetry + cluster-observability + compile-observability
+#             suite: registry/exposition/fit metrics/trace identity/straggler/
+#             trace_merge/compile accounting + recompile attribution + OOM
+#             forensics (host-only; slow e2e acceptance cases run when invoked
+#             directly)
 #   pipeline  input-pipeline feed suite: uint8 wire + async device feed (fast, host-only)
 #   guard     training health-guard suite: sentinel/rollback/stall/resume (fast, host-only)
 #   elastic   elastic-membership suite incl. the slow kill/rejoin e2e (host-only CPU mesh)
@@ -179,7 +181,8 @@ run_telemetry() {
   # multi-lane trace from a killed-worker run; delayed worker named within
   # 5 steps) run only when this stage is invoked directly, like `elastic`.
   JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_telemetry.py \
-    tests_tpu/test_cluster_obs.py -q -m "not slow"
+    tests_tpu/test_cluster_obs.py tests_tpu/test_compileobs.py \
+    -q -m "not slow"
   if [ "${1:-}" = "with_slow" ]; then
     make -C mxnet_tpu/src
     JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_cluster_obs.py \

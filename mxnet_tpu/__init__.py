@@ -41,6 +41,7 @@ from . import module as mod
 from . import executor_manager
 from . import model
 from .model import FeedForward
+from . import compileobs
 from . import fault
 from . import guard
 from . import telemetry

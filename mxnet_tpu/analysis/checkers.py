@@ -22,6 +22,14 @@ Rule catalog (rationale + examples: docs/static_analysis.md):
                           transfer (docs/perf.md §pipeline measured ~10ms/img
                           of exactly this).
 * ``mutable-default-arg`` the classic shared-default footgun.
+* ``untracked-jit``       any reference to ``jax.jit`` / ``jax.export.export``
+                          (call, ``@jax.jit`` decorator, ``partial(jax.jit)``)
+                          outside ``mxnet_tpu/compileobs.py`` compiles an
+                          XLA program the compile-observability registry
+                          never sees — no compile accounting, no recompile
+                          attribution, invisible in ``tools/mxtop.py`` and
+                          ``tools/compile_report.py``. Route through
+                          ``compileobs.jit`` / ``compileobs.raw_jit``.
 
 Checkers are plain callables ``(FileContext) -> [Finding]`` with a ``rules``
 attribute; ``CHECKERS`` is the registry the driver iterates.
@@ -341,6 +349,53 @@ def check_host_sync(ctx):
 
 
 # ---------------------------------------------------------------------------
+# untracked-jit
+# ---------------------------------------------------------------------------
+
+# the one module allowed to call jax.jit: it IS the registry wrapper
+COMPILEOBS_FILE = "mxnet_tpu/compileobs.py"
+
+
+@_checker("untracked-jit")
+def check_untracked_jit(ctx):
+    if ctx.path == COMPILEOBS_FILE:
+        return []
+    # names `jit` bound from jax in this file (`from jax import jit`)
+    bare_jit_names = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "jit":
+                    bare_jit_names.add(alias.asname or alias.name)
+    out = []
+    # flag every REFERENCE to the jit entry points, not just call
+    # expressions: `@jax.jit` decorators and `partial(jax.jit, ...)` compile
+    # programs just as invisibly as a direct call, and both put jax.jit in
+    # the tree as a bare Attribute/Name rather than a Call's func
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute):
+            fname = _name_of(node)
+            if fname not in ("jax.jit", "jax.export.export"):
+                continue
+        elif isinstance(node, ast.Name):
+            if node.id not in bare_jit_names \
+                    or not isinstance(node.ctx, ast.Load):
+                continue
+            fname = node.id
+        else:
+            continue
+        out.append(Finding(
+            "untracked-jit", ctx.path, node.lineno, node.col_offset,
+            "%s outside the compileobs registry: this program gets no "
+            "compile accounting or recompile attribution — route "
+            "through mxnet_tpu.compileobs.jit (dispatching sites) or "
+            "compileobs.raw_jit + record_compile (export/AOT sites)"
+            % (fname or "jit"),
+            context=ctx.qualnames.get(node, "")))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # mutable-default-arg
 # ---------------------------------------------------------------------------
 
@@ -376,4 +431,5 @@ def check_mutable_default(ctx):
 
 
 CHECKERS = (check_env_raw_read, check_excepts, check_thread_hygiene,
-            check_lock_discipline, check_host_sync, check_mutable_default)
+            check_lock_discipline, check_host_sync, check_untracked_jit,
+            check_mutable_default)

@@ -29,6 +29,7 @@ import os
 
 import numpy as np
 
+from . import compileobs as _compileobs
 from . import profiler as _profiler
 from . import random as _random
 from .base import MXNetError
@@ -159,6 +160,11 @@ class Executor:
         self._cast_exempt = frozenset(cast_exempt) | _index_like_inputs(symbol)
 
         self._graph_fn, self._arg_names, self._aux_names = build_graph_fn(symbol)
+        # graph identity for compile attribution: shared by every executor
+        # bound over this graph, so a reshape/rebind's compile is diffed
+        # against the graph's previous signature (compileobs recompile
+        # events name the changed axis instead of looking like new programs)
+        self._graph_digest = _compileobs.symbol_digest(symbol)
 
         # ---- normalize arg arrays (reference: CheckArguments in Bind) ----
         if isinstance(args, dict):
@@ -341,8 +347,6 @@ class Executor:
         ]
 
     def _get_jit_fwd(self, is_train):
-        import jax
-
         fn = self._jit_fwd.get(is_train)
         if fn is None:
             if self._placed is not None:
@@ -357,7 +361,11 @@ class Executor:
                     new_aux = [na.astype(a.dtype) for na, a in zip(new_aux, auxs)]
                     return outs, new_aux
 
-                fn = jax.jit(run)
+                fn = _compileobs.jit(
+                    run,
+                    "executor.fwd_train" if is_train else "executor.fwd_eval",
+                    site="mxnet_tpu/executor.py:Executor._get_jit_fwd",
+                    graph_key=self._graph_digest)
             self._jit_fwd[is_train] = fn
         return fn
 
@@ -365,7 +373,8 @@ class Executor:
         return "executor_%s[%s]" % (kind, getattr(self._symbol, "name", None) or "graph")
 
     def _run_forward(self, is_train, rng):
-        with _profiler.record_span(self._profile_name("forward"), "executor"):
+        with _profiler.record_span(self._profile_name("forward"), "executor"), \
+                _compileobs.oom_guard("executor.fwd"):
             outs, new_aux = self._get_jit_fwd(is_train)(self._arg_data, self._aux_data, rng)
         if is_train:
             for arr, new in zip(self.aux_arrays, new_aux):
@@ -460,7 +469,10 @@ class Executor:
             grads = vjp_fn(list(out_grads))[0]
             return outs, grads, new_aux
 
-        self._jit_fwd_bwd = jax.jit(run)
+        self._jit_fwd_bwd = _compileobs.jit(
+            run, "executor.fwd_bwd",
+            site="mxnet_tpu/executor.py:Executor._build_fwd_bwd",
+            graph_key=self._graph_digest)
         return self._jit_fwd_bwd
 
     def memory_analysis(self):
@@ -517,7 +529,8 @@ class Executor:
             # bf16; cast user-supplied fp32 head grads to match
             ogs = [g.astype(sd.dtype) for g, sd in
                    zip(ogs, self._eval_out_shapes(args, auxs))]
-        with _profiler.record_span(self._profile_name("fwd_bwd"), "executor"):
+        with _profiler.record_span(self._profile_name("fwd_bwd"), "executor"), \
+                _compileobs.oom_guard("executor.fwd_bwd"):
             outs, grads, new_aux = self._build_fwd_bwd()(args, auxs, ogs, rng)
         self._outputs_cache = outs
         self._pending = None

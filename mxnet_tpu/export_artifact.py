@@ -31,6 +31,7 @@ import struct
 
 import numpy as np
 
+from . import compileobs as _compileobs
 from . import ndarray as nd
 from .base import MXNetError
 from .executor import build_graph_fn
@@ -140,9 +141,16 @@ def export_predict_artifact(symbol, arg_params, aux_params, input_shapes,
                                         aux_params[n].dtype)
                    for n in aux_names])
 
-    with jax.default_matmul_precision(matmul_precision):
-        exported = jax.export.export(jax.jit(fwd), platforms=[platform])(
-            *in_specs)
+    with jax.default_matmul_precision(matmul_precision), \
+            _compileobs.record_compile(
+                "export.predict",
+                site="mxnet_tpu/export_artifact.py:export_predict_artifact"):
+        # fwlint: disable=untracked-jit — the lowering wall is charged via the record_compile scope above
+        exported = jax.export.export(
+            _compileobs.raw_jit(
+                fwd, "export.predict",
+                site="mxnet_tpu/export_artifact.py:export_predict_artifact"),
+            platforms=[platform])(*in_specs)
     # Re-serialize the StableHLO at the MAXIMUM backward-compatibility
     # target (oldest VHLO version) instead of jax.export's 12-week window:
     # a deployment artifact must load into whatever PJRT plugin the serving
@@ -425,9 +433,16 @@ def export_train_artifact(symbol, input_shapes, path, optimizer="sgd",
                           out_shardings=tuple(out_shardings))
         compile_options_b64 = _spmd_compile_options_b64(num_devices)
 
-    with jax.default_matmul_precision(matmul_precision):
+    with jax.default_matmul_precision(matmul_precision), \
+            _compileobs.record_compile(
+                "export.train_step",
+                site="mxnet_tpu/export_artifact.py:export_train_artifact"):
+        # fwlint: disable=untracked-jit — the lowering wall is charged via the record_compile scope above
         exported = jax.export.export(
-            jax.jit(flat_step, **jit_kwargs),
+            _compileobs.raw_jit(
+                flat_step, "export.train_step",
+                site="mxnet_tpu/export_artifact.py:export_train_artifact",
+                **jit_kwargs),
             platforms=[platform])(*in_specs)
     program = _serialize_max_compat(exported)
     kept = set(exported.module_kept_var_idx)

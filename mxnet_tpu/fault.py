@@ -23,6 +23,11 @@ recovery path is testable in a single process, byte-for-byte reproducibly:
 * ``bad_record`` — ImageRecordIter's per-record decode: makes the record
   undecodable to exercise the quarantine/budget path
   (``MXNET_IO_MAX_BAD_RECORDS``).
+* ``oom`` — the executor boundary (compileobs.oom_guard): a firing rule
+  synthesizes a ``RESOURCE_EXHAUSTED`` failure there, exercising the OOM
+  forensics dump (top live allocations + program table) without needing a
+  real device out-of-memory. Spec ``oom:`` alone fires every step;
+  ``oom:after=K,times=1`` dies once at step K.
 * ``kill_worker`` — the fit loop's per-batch seam (base_module.py): SIGKILLs
   this process — no exit hooks, no final flush, the closest in-process
   analog of a machine loss. The optional ``rank=N`` arg targets one worker

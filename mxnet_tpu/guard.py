@@ -64,8 +64,12 @@ __all__ = ["GuardError", "BadStepError", "StallError", "GuardPolicy",
 # stall DURING a reconfiguration is self-diagnosing — the dump shows the
 # membership epoch, rejection counts, and dead-node gauge next to the
 # engine/pipeline state.
+# "compile." / "device." make a stall self-diagnosing when the wedged step
+# is really an XLA recompile wall or memory pressure: the dump shows
+# compile counts/seconds per program and device bytes next to the
+# engine/pipeline state.
 STATE_SUMMARY_PREFIXES = ("engine.", "pipeline.", "io.", "kvstore.", "kv.",
-                          "fit.", "guard.")
+                          "fit.", "guard.", "compile.", "device.")
 
 
 class GuardError(MXNetError):
@@ -215,8 +219,9 @@ class Sentinel:
     # ---- measurement -----------------------------------------------------
     def _fn(self):
         if self._jitted is None:
-            import jax
             import jax.numpy as jnp
+
+            from . import compileobs
 
             def health(outs, grads):
                 loss = jnp.float32(0.0)
@@ -228,7 +233,9 @@ class Sentinel:
                     gsq = gsq + jnp.vdot(g32, g32)
                 return jnp.stack([loss, gsq])
 
-            self._jitted = jax.jit(health)
+            self._jitted = compileobs.jit(
+                health, "guard.sentinel",
+                site="mxnet_tpu/guard.py:Sentinel._fn")
         return self._jitted
 
     def measure(self, per_device):

@@ -1004,6 +1004,25 @@ class KVStoreDist(KVStore):
                 "data_wait": data_wait, "compute": compute,
                 "kv_sync": push + pull + barrier, "guard": guard}
 
+    def _snapshot_compile(self):
+        """Compact compile-observability summary for the published snapshot
+        (docs/observability.md §compile): program count, total compiles and
+        compile seconds, recompile count, and the most recent recompile
+        attribution — enough for ``kv.cluster_stats()`` consumers and
+        ``tools/mxtop.py`` to spot a rank silently recompiling every step
+        without shipping the whole program table over the PS tier."""
+        from . import compileobs
+
+        s = compileobs.summary(include_recompiles=False)
+        out = {"programs": s["programs"], "count": s["compile_count"],
+               "seconds": round(s["compile_seconds"], 3),
+               "recompiles": s["recompile_count"]}
+        last = compileobs.last_recompile()
+        if last:
+            out["last_recompile"] = {
+                "program": last.get("program"), "cause": last.get("cause")}
+        return out
+
     def build_cluster_snapshot(self, window=None, cum=None):
         """This worker's compact telemetry snapshot (JSON-able): identity
         (rank / step / membership epoch), throughput, queue depths, key
@@ -1026,6 +1045,7 @@ class KVStoreDist(KVStore):
                 "dead_nodes": telemetry.totals("kvstore.dead_nodes")[1],
                 "bad_steps": telemetry.totals("guard.bad_steps")[1],
             },
+            "compile": self._snapshot_compile(),
             "cum": cum if cum is not None else self._snapshot_cumulative(),
         }
         if window is not None:

@@ -163,7 +163,12 @@ class _DeferredCountMetric(EvalMetric):
 
         fn = self._count_fns.get(key)
         if fn is None:
-            fn = jax.jit(build_fn, donate_argnums=(0,))
+            from . import compileobs
+
+            fn = compileobs.jit(
+                build_fn, "metric.count",
+                site="mxnet_tpu/metric.py:_DeferredCountMetric._accumulate",
+                graph_key=(type(self).__name__, key), donate_argnums=(0,))
             self._count_fns[key] = fn
         ref = arrays[0]
         ref_devs = ref.devices()
@@ -505,7 +510,12 @@ class Perplexity(EvalMetric):
                 n = jnp.maximum(n, 1).astype(jnp.float32)
                 return acc + jnp.stack([jnp.exp(nll / n) * n, n])
 
-            fn = jax.jit(stat, donate_argnums=(0,))
+            from . import compileobs
+
+            fn = compileobs.jit(
+                stat, "metric.perplexity",
+                site="mxnet_tpu/metric.py:Perplexity.update",
+                graph_key=key, donate_argnums=(0,))
             self._stat_fns[key] = fn
         acc = self._dev_acc.get(dev_key)
         if acc is None:

@@ -44,6 +44,51 @@ def _check_input_names(symbol, names, typename, throw):
         logging.warning(msg)
 
 
+class _EvalStepMeter:
+    """Step-split telemetry for the eval/serving loops — the same data-wait
+    vs compute attribution ``fit`` records, labeled by path
+    (``eval.*{path=score|predict}``), so a slow evaluation can be blamed on
+    the iterator or the model instead of guessed at. Instrument handles are
+    resolved once; with telemetry disabled every call is one flag check."""
+
+    __slots__ = ("_path", "_inst")
+
+    def __init__(self, path):
+        self._path = path
+        self._inst = None
+
+    def start(self):
+        return time.perf_counter() if telemetry.enabled() else 0.0
+
+    def step(self, t0, t_data, data_batch, source_iter):
+        """Record one eval step: ``t0``..``t_data`` waited on the iterator,
+        ``t_data``..now computed (dispatch + metric/output handling)."""
+        if not telemetry.enabled():
+            return
+        if self._inst is None:
+            p = self._path
+            self._inst = (
+                telemetry.histogram("eval.data_wait_seconds", path=p),
+                telemetry.histogram("eval.compute_seconds", path=p),
+                telemetry.histogram("eval.step_time_seconds", path=p),
+                telemetry.counter("eval.batches", path=p),
+                telemetry.counter("eval.samples", path=p),
+                telemetry.gauge("eval.imgs_per_sec", path=p),
+            )
+        h_wait, h_comp, h_step, c_batch, c_samp, g_ips = self._inst
+        now = time.perf_counter()
+        step_s = now - t0
+        h_wait.observe(t_data - t0)
+        h_comp.observe(now - t_data)
+        h_step.observe(step_s)
+        c_batch.inc()
+        n = _batch_samples(data_batch, source_iter)
+        if n:
+            c_samp.inc(n)
+            if step_s > 0:
+                g_ips.set(n / step_s)
+
+
 class BaseModule:
     """The base class of a module (reference: base_module.py:79)."""
 
@@ -73,11 +118,21 @@ class BaseModule:
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
         actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
+        meter = _EvalStepMeter("score")
+        data_iter = iter(eval_data)
+        nbatch = 0
+        while True:
             if num_batch is not None and nbatch == num_batch:
                 break
+            t0 = meter.start()
+            try:
+                eval_batch = next(data_iter)
+            except StopIteration:
+                break
+            t_data = meter.start()
             self.forward(eval_batch, is_train=False)
             self.update_metric(eval_metric, eval_batch.label)
+            meter.step(t0, t_data, eval_batch, eval_data)
             if batch_end_callback is not None:
                 batch_end_params = BatchEndParam(
                     epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals()
@@ -85,6 +140,7 @@ class BaseModule:
                 for callback in _as_list(batch_end_callback):
                     callback(batch_end_params)
             actual_num_batch += 1
+            nbatch += 1
         if score_end_callback:
             params = BatchEndParam(
                 epoch=epoch, nbatch=actual_num_batch, eval_metric=eval_metric, locals=locals()
@@ -115,9 +171,18 @@ class BaseModule:
         from .. import context as ctx_mod
 
         output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
+        meter = _EvalStepMeter("predict")
+        data_iter = iter(eval_data)
+        nbatch = 0
+        while True:
             if num_batch is not None and nbatch == num_batch:
                 break
+            t0 = meter.start()
+            try:
+                eval_batch = next(data_iter)
+            except StopIteration:
+                break
+            t_data = meter.start()
             self.forward(eval_batch, is_train=False)
             pad = eval_batch.pad
             # one bounded host materialization per batch, pinned to the cpu
@@ -129,6 +194,8 @@ class BaseModule:
                                 ctx=ctx_mod.cpu())
                        for out in self.get_outputs()]
             output_list.append(outputs)
+            meter.step(t0, t_data, eval_batch, eval_data)
+            nbatch += 1
         if len(output_list) == 0:
             return output_list
         if merge_batches:

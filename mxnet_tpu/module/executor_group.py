@@ -14,7 +14,6 @@ import logging
 
 import numpy as np
 
-from .. import context as ctx_mod
 from .. import ndarray as nd
 from ..base import MXNetError
 from ..io import DataDesc
@@ -267,13 +266,30 @@ class DataParallelExecutorGroup:
 
     def get_params(self, arg_params, aux_params):
         """Average params over devices into the given dicts
-        (reference: executor_group.py get_params — 'weight averaged over devices')."""
+        (reference: executor_group.py get_params — 'weight averaged over
+        devices'). The average runs DEVICE-side — replicas gather to device
+        0 over d2d transfers, one mean program, one transfer into the host
+        dict — where the old per-replica ``copyto(cpu).asnumpy()`` paid N
+        blocking host pulls per parameter."""
+        import jax
+
+        def _merge_into(block, dst):
+            if len(block) == 1:
+                merged = block[0].data
+            else:
+                dev0 = block[0].context.jax_device
+                acc = block[0].data
+                for w in block[1:]:
+                    acc = acc + jax.device_put(w.data, dev0)
+                merged = acc / len(block)
+            dst._set_data(
+                jax.device_put(merged.astype(dst.dtype),
+                               dst.context.jax_device))
+
         for name, block in zip(self.param_names, self.param_arrays):
-            weight = sum(w.copyto(ctx_mod.cpu()).asnumpy() for w in block) / len(block)
-            arg_params[name][:] = weight.astype(arg_params[name].dtype)
+            _merge_into(block, arg_params[name])
         for name, block in zip(self.aux_names, self.aux_arrays):
-            weight = sum(w.copyto(ctx_mod.cpu()).asnumpy() for w in block) / len(block)
-            aux_params[name][:] = weight.astype(aux_params[name].dtype)
+            _merge_into(block, aux_params[name])
 
     def forward(self, data_batch, is_train=None):
         """Scatter + per-exec forward (reference: executor_group.py:369)."""

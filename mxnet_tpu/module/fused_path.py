@@ -27,6 +27,7 @@ import pickle
 
 import numpy as np
 
+from .. import compileobs as _compileobs
 from .. import ndarray as nd
 from ..io import DataDesc
 
@@ -296,13 +297,18 @@ class FusedFitPath:
         st = self.state
         tr = self.trainer
         kv = self._dist_kv()
+        # OOM forensics at the executor boundary (compileobs.oom_guard): a
+        # RESOURCE_EXHAUSTED from the fused program dumps the live-allocation
+        # and program tables before propagating
         if kv is not None:
-            return self._step_dist(kv)
+            with _compileobs.oom_guard("fused.step"):
+                return self._step_dist(kv)
         if (len(st.params) == len(tr.param_names)
                 and len(st.auxs) == len(tr.aux_names)):
-            st.params, st.auxs, st.states, self._outs = tr.step(
-                st.params, st.auxs, st.states, self._pending
-            )
+            with _compileobs.oom_guard("fused.step"):
+                st.params, st.auxs, st.states, self._outs = tr.step(
+                    st.params, st.auxs, st.states, self._pending
+                )
         else:
             # shared-state bucketing where this bucket's symbol uses a param
             # subset: step over the subset, merge back (donation consumed the

@@ -216,13 +216,29 @@ class ParallelLMModule(BaseModule):
 
     # ---- step ------------------------------------------------------------
     def _tokens_labels(self, data_batch):
-        tok = data_batch.data[0]
-        tok = tok.asnumpy() if hasattr(tok, "asnumpy") else np.asarray(tok)
-        tok = tok.astype(np.int32)
+        def as_i32(x):
+            if hasattr(x, "data") and hasattr(x, "context"):
+                # NDArray: cast on device — the old asnumpy() pulled every
+                # token batch to the host just to re-upload it into the step
+                x = x.data.astype(np.int32)
+            else:
+                # fwlint: disable=host-sync-in-hot-path — host list/ndarray input: a construction, not a device sync
+                x = np.asarray(x, np.int32)
+            if self.mode == "dense":
+                return x
+            # mesh trainers: replicate onto the trainer mesh — a batch
+            # committed to one device would collide with the shard_map
+            # device set (GSPMD reshards it to the step's layout in-graph)
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(
+                x, NamedSharding(self._ensure_mesh(), PartitionSpec()))
+
+        tok = as_i32(data_batch.data[0])
         labels = data_batch.label[0] if data_batch.label else None
         if labels is not None:
-            labels = (labels.asnumpy() if hasattr(labels, "asnumpy")
-                      else np.asarray(labels)).astype(np.int32)
+            labels = as_i32(labels)
         if self.mode == "pp":
             m = self._microbatches
             b, t = tok.shape

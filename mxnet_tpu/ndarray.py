@@ -26,9 +26,12 @@ import builtins
 import collections
 import struct
 import sys
+import threading
+import weakref
 
 import numpy as np
 
+from . import compileobs as _compileobs
 from . import profiler as _profiler
 from . import random as _random
 from .base import MXNetError, _DTYPE_MX_TO_NP, _DTYPE_NP_TO_MX
@@ -41,6 +44,26 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange", "conc
 # ring of recently produced arrays so waitall() can block on outstanding work
 # (reference: Engine::WaitForAll, include/mxnet/engine.h:176)
 _RECENT = collections.deque(maxlen=4096)
+
+# every live NDArray, weakly held — the allocation registry behind
+# compileobs.live_ndarray_report(): on backends without Device.memory_stats
+# (CPU) this is the only device-byte accounting, and it names the TOP live
+# buffers in the OOM forensics dump. One locked WeakSet.add per
+# construction; the lock serializes adds against live_arrays() snapshots
+# (WeakSet only guards gc-driven removals, not concurrent adds — an
+# unsynchronized walk from the telemetry flusher could die mid-iteration
+# exactly when the OOM dump needs it most).
+_LIVE = weakref.WeakSet()
+_LIVE_LOCK = threading.Lock()
+
+
+def live_arrays():
+    """Snapshot of every live (non-collected) NDArray. Views are dropped —
+    their base carries the buffer."""
+    with _LIVE_LOCK:
+        arrs = list(_LIVE)
+    return [a for a in arrs if a._base is None]
+
 
 _JIT_CACHE = {}
 
@@ -57,8 +80,6 @@ def _freeze_attrs(attrs):
 
 
 def _get_jitted(op, attrs, n_args, n_aux, is_train):
-    import jax
-
     key = (op.name, _freeze_attrs(attrs), n_args, n_aux, is_train, op.stochastic)
     fn = _JIT_CACHE.get(key)
     if fn is None:
@@ -68,7 +89,13 @@ def _get_jitted(op, attrs, n_args, n_aux, is_train):
             outs, new_auxs = op.forward(octx, attrs, list(args), list(auxs))
             return list(outs), list(new_auxs)
 
-        fn = jax.jit(run)
+        # program per OP name (compile.count{program=op.relu}); the frozen
+        # attrs key is the graph identity, so the same op re-jitted under
+        # new attrs registers as a fresh graph, not a recompile
+        fn = _compileobs.jit(
+            run, "op.%s" % op.name,
+            site="mxnet_tpu/ndarray.py:imperative_invoke",
+            graph_key=key)
         _JIT_CACHE[key] = fn
     return fn
 
@@ -163,7 +190,7 @@ class NDArray:
     # analysis.sanitizer.attach() so the dependency sanitizer can compare a
     # pushed fn's actual reads/writes against its declared vars
     __slots__ = ("_data", "_ctx", "_base", "_index", "writable",
-                 "_engine_var")
+                 "_engine_var", "__weakref__")
 
     def __init__(self, data, ctx=None, base=None, index=None):
         self._data = data
@@ -172,6 +199,8 @@ class NDArray:
         self._index = index
         self.writable = True
         self._engine_var = None
+        with _LIVE_LOCK:  # allocation registry (compileobs accounting)
+            _LIVE.add(self)
 
     # ---- buffer access --------------------------------------------------
     @property

@@ -639,8 +639,6 @@ class FusedUpdater(Updater):
 
     def update_all(self, pairs):
         """pairs: list of (index, grad NDArray, weight NDArray)."""
-        import jax
-
         builder = self._builder()
         if builder is None:
             for index, g, w in pairs:
@@ -653,7 +651,13 @@ class FusedUpdater(Updater):
             key = (p[2].context.device_typeid, p[2].context.device_id)
             by_dev.setdefault(key, []).append(p)
         if self._jitted is None:
-            self._jitted = jax.jit(builder)
+            from . import compileobs
+
+            # wrapper-scoped (no graph_key): per-device call groups of one
+            # updater legitimately hold one signature each
+            self._jitted = compileobs.jit(
+                builder, "optimizer.fused_update",
+                site="mxnet_tpu/optimizer.py:FusedUpdater.update_all")
         for dev_pairs in by_dev.values():
             self._update_one_device(dev_pairs)
 

@@ -175,6 +175,19 @@ def _xent(logits, labels):
     return -jnp.mean(ll)
 
 
+def _obs_jit(fn, program, trainer, cfg, **jit_kwargs):
+    """compileobs-registered jit for the LM trainers: (trainer class, frozen
+    config) is the graph identity, so a re-built trainer over the same
+    config diffs against its predecessor's compiled signature while a
+    different depth/width registers as a fresh graph."""
+    from .. import compileobs
+
+    key = (trainer, tuple(sorted((k, str(v)) for k, v in cfg.items())))
+    return compileobs.jit(
+        fn, program, site="mxnet_tpu/parallel/lm.py:%s" % trainer,
+        graph_key=key, **jit_kwargs)
+
+
 class _LMTrainerBase:
     """Shared optimizer plumbing: in-graph fused update via fused_opt rules."""
 
@@ -239,8 +252,10 @@ class DenseLMTrainer(_LMTrainerBase):
             params, opt_state = self._apply_updates(params, grads, opt_state, lr, t)
             return params, opt_state, loss
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
-        self._fwd = jax.jit(lambda p, tok: lm_forward_dense(p, tok, L, H))
+        self._step = _obs_jit(step, "lm.step", "DenseLMTrainer",
+                              self.cfg, donate_argnums=(0, 1))
+        self._fwd = _obs_jit(lambda p, tok: lm_forward_dense(p, tok, L, H),
+                             "lm.fwd", "DenseLMTrainer", self.cfg)
 
     def step(self, params, opt_state, tokens, labels):
         if self._step is None:
@@ -331,13 +346,14 @@ class SPLMTrainer(_LMTrainerBase):
             params, opt_state = self._apply_updates(params, grads, opt_state, lr, t)
             return params, opt_state, loss
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._step = _obs_jit(step, "lm.step", "SPLMTrainer",
+                              self.cfg, donate_argnums=(0, 1))
         fwd_local = shard_map(
             lambda p, tok: self._local_forward(p, tok),
             mesh=self.mesh, in_specs=(pspec, tok_spec),
             out_specs=P(None, axis, None), check_rep=False,
         )
-        self._fwd = jax.jit(fwd_local)
+        self._fwd = _obs_jit(fwd_local, "lm.fwd", "SPLMTrainer", self.cfg)
 
     def step(self, params, opt_state, tokens, labels):
         if self._step is None:
@@ -438,7 +454,8 @@ class PPLMTrainer(_LMTrainerBase):
             params, opt_state = self._apply_updates(params, grads, opt_state, lr, t)
             return params, opt_state, loss
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._step = _obs_jit(step, "lm.step", "PPLMTrainer",
+                              self.cfg, donate_argnums=(0, 1))
 
         def fwd(params, tokens_mb):
             stage_params = [params] * S
@@ -450,7 +467,7 @@ class PPLMTrainer(_LMTrainerBase):
             x = _ln(acts, params["final_ln_gamma"], params["final_ln_beta"])
             return jnp.einsum("mbtd,dv->mbtv", x, params["lm_head_weight"], precision=_prec(x))
 
-        self._fwd = jax.jit(fwd)
+        self._fwd = _obs_jit(fwd, "lm.fwd", "PPLMTrainer", self.cfg)
 
     def step(self, params, opt_state, tokens_mb, labels_mb):
         if self._step is None:
@@ -546,12 +563,13 @@ class MoELMTrainer(_LMTrainerBase):
             params, opt_state = self._apply_updates(params, grads, opt_state, lr, t)
             return params, opt_state, loss
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
-        self._fwd = jax.jit(shard_map(
+        self._step = _obs_jit(step, "lm.step", "MoELMTrainer",
+                              self.cfg, donate_argnums=(0, 1))
+        self._fwd = _obs_jit(shard_map(
             lambda p, tok: self._local_forward(p, tok),
             mesh=self.mesh, in_specs=(pspec, tok_spec),
             out_specs=P(axis, None, None), check_rep=False,
-        ))
+        ), "lm.fwd", "MoELMTrainer", self.cfg)
 
     def step(self, params, opt_state, tokens, labels):
         if self._step is None:

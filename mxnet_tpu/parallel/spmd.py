@@ -20,6 +20,7 @@ import re
 
 import numpy as np
 
+from .. import compileobs as _compileobs
 from ..executor import build_graph_fn
 from ..ops.registry import get_op
 from . import fused_opt
@@ -104,6 +105,10 @@ class SPMDTrainer:
         }
         self._step_fn = None
         self._donate = donate
+        # graph identity for compile attribution (compileobs): every
+        # trainer over this symbol shares it, so a bucket/rebind compile is
+        # diffed against the graph's previous signature
+        self._graph_digest = _compileobs.symbol_digest(symbol)
 
     def _spec_for(self, name):
         for prog, spec in self._param_rules:
@@ -229,7 +234,10 @@ class SPMDTrainer:
         # params, auxs (BN stats), and optimizer slots all move every step —
         # donate all three so XLA reuses their buffers in place
         donate = (0, 1, 2) if self._donate else ()
-        self._step_fn = jax.jit(step, donate_argnums=donate)
+        self._step_fn = _compileobs.jit(
+            step, "fused.step",
+            site="mxnet_tpu/parallel/spmd.py:SPMDTrainer._build_step",
+            graph_key=self._graph_digest, donate_argnums=donate)
         return self._step_fn
 
     def step(self, params, auxs, states, inputs_np, rng=None):
@@ -276,8 +284,11 @@ class SPMDTrainer:
 
         # auxs move every step; params do NOT (apply comes later) — donate
         # only the aux argument (and only when donation is enabled at all)
-        self._grad_fn = jax.jit(
-            gstep, donate_argnums=(1,) if self._donate else ())
+        self._grad_fn = _compileobs.jit(
+            gstep, "fused.grad_step",
+            site="mxnet_tpu/parallel/spmd.py:SPMDTrainer._build_grad_step",
+            graph_key=self._graph_digest,
+            donate_argnums=(1,) if self._donate else ())
         return self._grad_fn
 
     def grad_step(self, params, auxs, inputs_np, rng=None):
@@ -317,8 +328,11 @@ class SPMDTrainer:
                     lr * lr_mult[n], base_wd * wd_mult[n], t)
             return new_p, new_s
 
-        self._apply_fn = jax.jit(
-            apply, donate_argnums=(0, 1) if self._donate else ())
+        self._apply_fn = _compileobs.jit(
+            apply, "fused.apply_grads",
+            site="mxnet_tpu/parallel/spmd.py:SPMDTrainer._build_apply_step",
+            graph_key=self._graph_digest,
+            donate_argnums=(0, 1) if self._donate else ())
         return self._apply_fn
 
     def apply_grads(self, params, states, grads):
@@ -352,4 +366,7 @@ class SPMDTrainer:
             outs, _ = graph_fn(args, aux_list, None, False)
             return outs
 
-        return jax.jit(fwd)
+        return _compileobs.jit(
+            fwd, "fused.eval",
+            site="mxnet_tpu/parallel/spmd.py:SPMDTrainer.eval_step_fn",
+            graph_key=self._graph_digest)

@@ -204,17 +204,22 @@ class PlacedGraph:
         return seg_fn, aux_ids
 
     def _seg_fwd_jit(self, seg, is_train):
-        import jax
+        from . import compileobs
 
         cache = seg.fwd_jit or {}
         if is_train not in cache:
             seg_fn, aux_ids = self._make_seg_fwd(seg, is_train)
-            cache[is_train] = (jax.jit(seg_fn), aux_ids, seg_fn)
+            cache[is_train] = (
+                compileobs.jit(seg_fn, "placed.seg_fwd",
+                               site="mxnet_tpu/placed.py:PlacedGraph._seg_fwd_jit"),
+                aux_ids, seg_fn)
             seg.fwd_jit = cache
         return cache[is_train]
 
     def _seg_bwd_jit(self, seg):
         import jax
+
+        from . import compileobs
 
         if seg.bwd_jit is None:
             seg_fn, aux_ids = self._make_seg_fwd(seg, True)
@@ -228,7 +233,10 @@ class PlacedGraph:
                 in_cts = vjp_fn(list(out_cts))[0]
                 return outs, in_cts, new_aux
 
-            seg.bwd_jit = (jax.jit(bwd), aux_ids)
+            seg.bwd_jit = (
+                compileobs.jit(bwd, "placed.seg_bwd",
+                               site="mxnet_tpu/placed.py:PlacedGraph._seg_bwd_jit"),
+                aux_ids)
         return seg.bwd_jit
 
     # ------------------------------------------------------------------

@@ -74,16 +74,38 @@ def is_running():
     return _state["running"]
 
 
-def emit_span(name, category, wall_t0, dur_s, args=None):
+_reserved = None
+
+
+def _reserved_tid():
+    """compileobs.COMPILE_TRACE_TID, cached (lazy import breaks the cycle)."""
+    global _reserved
+    if _reserved is None:
+        from .compileobs import COMPILE_TRACE_TID
+        _reserved = COMPILE_TRACE_TID
+    return _reserved
+
+
+def emit_span(name, category, wall_t0, dur_s, args=None, tid=None):
     """Append one complete span to the chrome-trace buffer if the profiler
     runs — the hook `telemetry.span` uses, so runtime-phase spans (the fit
     loop's `fit.step`, any user-opened span) land in the same timeline as
     the op/executor spans this module records itself. ``args`` (a
     JSON-able dict) becomes the trace event's ``args`` — the fit loop
     stamps epoch/nbatch so tools/trace_merge.py can match the same BSP
-    step across worker lanes."""
+    step across worker lanes. ``tid`` pins the span to a synthetic lane
+    instead of the emitting thread (compileobs routes every compile span
+    onto one dedicated ``compile`` row this way)."""
     if not _state["running"]:
         return
+    if tid is None:
+        tid = threading.get_ident() % (1 << 16)
+        if tid == _reserved_tid():
+            # a thread whose hashed ident lands on the dedicated compile
+            # lane would interleave unserialized spans with compileobs'
+            # (overlaps the span-nesting checker rejects) and get its real
+            # work labeled "compile" — shift it off the reserved row
+            tid += 1
     ev = {
         "name": name,
         "cat": category,
@@ -91,7 +113,7 @@ def emit_span(name, category, wall_t0, dur_s, args=None):
         "ts": wall_t0 * 1e6,
         "dur": dur_s * 1e6,
         "pid": os.getpid(),
-        "tid": threading.get_ident() % (1 << 16),
+        "tid": int(tid),
     }
     if args:
         ev["args"] = dict(args)
@@ -164,8 +186,17 @@ def dump_profile():
     rank = telemetry.get_rank()
     if rank is not None:
         events.insert(0, {
-            "name": "process_name", "ph": "M", "pid": os.getpid(),
-            "tid": 0, "args": {"name": "rank %d" % rank, "rank": rank},
+            "name": "process_name", "cat": "__metadata", "ph": "M",
+            "pid": os.getpid(), "tid": 0,
+            "args": {"name": "rank %d" % rank, "rank": rank},
+        })
+    # name the dedicated compile lane when any compile span landed on it
+    compile_tid = _reserved_tid()
+    if any(e.get("tid") == compile_tid for e in events):
+        events.insert(0, {
+            "name": "thread_name", "cat": "__metadata", "ph": "M",
+            "pid": os.getpid(), "tid": compile_tid,
+            "args": {"name": "compile"},
         })
     with open(filename, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)

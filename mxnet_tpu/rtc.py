@@ -60,7 +60,12 @@ class Rtc:
             exec(compile(fn_src, "<mx.rtc:%s>" % self.name, "exec"), scope)
         except SyntaxError as e:
             raise MXNetError("Rtc kernel '%s' failed to compile: %s" % (self.name, e)) from e
-        self._compiled = jax.jit(scope["__kernel__"])
+        from . import compileobs
+
+        self._compiled = compileobs.jit(
+            scope["__kernel__"], "rtc.%s" % self.name,
+            site="mxnet_tpu/rtc.py:Rtc._compile",
+            graph_key=self._source)
 
     def push(self, inputs, outputs, grid_dims=None, block_dims=None):
         """Run the kernel (reference: rtc.py push → MXRtcPush). grid/block dims

@@ -54,7 +54,7 @@ __all__ = [
     "counter", "gauge", "histogram", "span", "event",
     "enable", "disable", "enabled",
     "dump", "prometheus_text", "reset", "state_summary", "totals",
-    "flush", "start_flusher", "stop_flusher",
+    "flush", "start_flusher", "stop_flusher", "register_collector",
     "set_rank", "get_rank",
     "pipeline_stage", "PIPELINE_STAGES", "METRIC_HELP",
 ]
@@ -253,6 +253,32 @@ _enabled = False
 _flusher = None  # (thread, stop_event, path, interval)
 _file_lock = threading.Lock()  # serializes sink appends (flusher vs events)
 _rank = None  # this process's worker rank (distributed runs); None = unset
+_collectors = []  # read-time refresh hooks (compileobs memory gauges)
+
+
+def register_collector(fn):
+    """Register a nullary hook run at the top of every registry READ
+    (``dump`` / ``prometheus_text`` / ``state_summary``) to refresh
+    derived gauges — e.g. compileobs re-reads device memory stats so a
+    scrape always sees current bytes-in-use, without any per-step cost.
+    Collectors must be cheap and must never raise (failures are logged and
+    swallowed; a broken collector cannot take down a scrape)."""
+    with _lock:
+        if fn not in _collectors:
+            _collectors.append(fn)
+
+
+def _run_collectors():
+    with _lock:
+        hooks = list(_collectors)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "telemetry collector %r failed", fn, exc_info=True)
 
 
 def set_rank(rank):
@@ -468,6 +494,7 @@ def events(name=None):
 
 def dump(include_events=True):
     """JSON-serializable snapshot of the whole registry."""
+    _run_collectors()
     with _lock:
         items = sorted(_metrics.items())
         evs = list(_events) if include_events else None
@@ -496,6 +523,7 @@ def state_summary(prefixes=()):
     latencies point at WHICH stage wedged without shipping the full
     ``dump()`` blob into a log line.
     """
+    _run_collectors()
     with _lock:
         items = sorted(_metrics.items())
     out = {}
@@ -550,6 +578,33 @@ METRIC_HELP = {
     "fit.epochs": "fit-loop epochs completed",
     "fit.imgs_per_sec": "instantaneous per-batch throughput",
     "fit.step": "fit.step span durations (chrome-trace timeline twin)",
+    "eval.step_time_seconds":
+        "score/predict per-batch wall time by path label",
+    "eval.data_wait_seconds":
+        "score/predict time blocked on the data iterator by path",
+    "eval.compute_seconds":
+        "score/predict forward+output dispatch time by path",
+    "eval.batches": "score/predict batches completed by path",
+    "eval.samples": "score/predict samples evaluated by path",
+    "eval.imgs_per_sec": "instantaneous score/predict throughput by path",
+    "compile.count": "XLA programs compiled per logical program (always-on)",
+    "compile.seconds":
+        "compile wall per program: trace+XLA compile+first dispatch "
+        "(always-on)",
+    "compile.run_seconds":
+        "cumulative post-compile dispatch seconds per program "
+        "(refreshed at read time)",
+    "compile.recompile":
+        "recompiles per program attributed by cause: batch/seq_len/axisN/"
+        "dtype/rank/structure/placement (always-on)",
+    "device.bytes_in_use":
+        "live device bytes per device (backend stats, NDArray-registry "
+        "fallback)",
+    "device.peak_bytes":
+        "peak device bytes per device (backends exposing memory_stats)",
+    "device.oom_events":
+        "RESOURCE_EXHAUSTED failures caught at the executor boundary, by "
+        "program (always-on; each dumps OOM forensics)",
     "speedometer.samples_per_sec": "last Speedometer window sample",
     "io.batch_fetch_seconds": "per-iterator batch fetch latency",
     "io.bad_records": "corrupt records quarantined by source",
@@ -639,6 +694,7 @@ def prometheus_text():
     with cumulative ``le`` buckets. Serve this from any HTTP handler to make
     a training job scrapeable (docs/observability.md has a ready example).
     """
+    _run_collectors()
     with _lock:
         items = sorted(_metrics.items())
     by_name = {}

@@ -36,8 +36,13 @@ def test_profile_imperative_and_executor(tmp_path):
     cats = {e["cat"] for e in events}
     assert any("dot" in n for n in names), names
     assert "operator" in cats
-    for e in events:  # chrome-trace complete events
-        assert e["ph"] == "X" and "ts" in e and "dur" in e
+    for e in events:
+        if e["ph"] == "M":
+            # metadata rows (compile-lane thread_name, rank process_name)
+            # carry no ts/dur by the chrome-trace spec
+            assert e["cat"] == "__metadata" and "pid" in e
+            continue
+        assert e["ph"] == "X" and "ts" in e and "dur" in e  # complete events
 
 
 def test_symbolic_mode_filters_imperative_spans(tmp_path):

@@ -24,7 +24,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
                     "test_pipeline_feed.py", "test_guard.py",
                     "test_analysis.py", "test_elastic.py",
-                    "test_cluster_obs.py", "test_native_decode.py"}
+                    "test_cluster_obs.py", "test_native_decode.py",
+                    "test_compileobs.py"}
 
 
 def pytest_configure(config):

@@ -195,6 +195,85 @@ def test_host_sync_hot_path_scoping():
     assert cold == []
 
 
+def test_untracked_jit_positive():
+    fs = lint(
+        """
+        import jax
+
+        def build(fn):
+            return jax.jit(fn, donate_argnums=(0,))
+        """, select=["untracked-jit"])
+    assert rules_of(fs) == ["untracked-jit"]
+    fs = lint(
+        """
+        import jax
+
+        def export(fn, specs):
+            return jax.export.export(jax.jit(fn))(*specs)
+        """, select=["untracked-jit"])
+    assert len(fs) == 2  # the export AND the inner jit
+
+
+def test_untracked_jit_bare_import_form():
+    fs = lint(
+        """
+        from jax import jit
+
+        def build(fn):
+            return jit(fn)
+        """, select=["untracked-jit"])
+    assert rules_of(fs) == ["untracked-jit"]
+
+
+def test_untracked_jit_decorator_and_partial_forms():
+    # `@jax.jit` puts jax.jit in the tree as a bare Attribute (decorator),
+    # `partial(jax.jit, ...)` as a Call ARGUMENT — neither is a Call whose
+    # func is jax.jit, and both compile untracked programs
+    fs = lint(
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x
+        """, select=["untracked-jit"])
+    assert rules_of(fs) == ["untracked-jit"]
+    fs = lint(
+        """
+        import functools
+        import jax
+
+        def build(fn):
+            return functools.partial(jax.jit, donate_argnums=(0,))(fn)
+        """, select=["untracked-jit"])
+    assert rules_of(fs) == ["untracked-jit"]
+
+
+def test_untracked_jit_negative_registry_forms():
+    fs = lint(
+        """
+        from mxnet_tpu import compileobs
+
+        def build(fn, other):
+            a = compileobs.jit(fn, "fused.step")
+            b = compileobs.raw_jit(fn, "export.x")
+            c = other.jit(fn)  # not jax's
+            return a, b, c
+        """, select=["untracked-jit"])
+    assert fs == []
+
+
+def test_untracked_jit_exempt_in_compileobs():
+    fs = lint(
+        """
+        import jax
+
+        def wrap(fn):
+            return jax.jit(fn)
+        """, path="mxnet_tpu/compileobs.py", select=["untracked-jit"])
+    assert fs == []
+
+
 def test_mutable_default_arg():
     src = """
     def f(a, b=[], c={}, d=dict()):

@@ -1,0 +1,402 @@
+"""Compile & device-memory observability suite (docs/observability.md
+§compile; ci/run_tests.sh telemetry tier).
+
+Covers the program registry's compile accounting (one compile per
+signature, cache-growth detection), recompile attribution (batch axis /
+seq-len / dtype / cross-wrapper graph identity), the two fit-level
+acceptance criteria — a fixed-shape fit's compile.count is flat after
+warmup, and a deliberately shape-varying run emits `compile.recompile`
+events naming the batch axis and call site — the OOM forensics dump under
+fault injection, the NDArray allocation registry, the score/predict
+step-split telemetry, and `tools/compile_report.py` rendering from a real
+telemetry JSONL. Host-side only (CPU jax backend)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import compileobs, fault, guard, telemetry  # noqa: E402
+from mxnet_tpu import ndarray as nd  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import compile_report  # noqa: E402
+import mxtop  # noqa: E402
+
+pytestmark = pytest.mark.telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    """Fresh telemetry + program registries per test; telemetry enabled so
+    compile/recompile/oom events are observable."""
+    telemetry.reset()
+    compileobs.reset()
+    telemetry.enable()
+    yield
+    telemetry.stop_flusher(final_flush=False)
+    telemetry.disable()
+    telemetry.reset()
+    compileobs.reset()
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _fit_module(batch, n=48, num_epoch=1, epoch_cb=None, mod=None,
+                force_rebind=False):
+    X = np.random.RandomState(7).rand(n, 6).astype(np.float32)
+    y = (np.arange(n) % 4).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+    if mod is None:
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, force_rebind=force_rebind,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.01},
+            epoch_end_callback=epoch_cb)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# wrapper accounting
+# ---------------------------------------------------------------------------
+
+
+def test_one_compile_per_signature_and_run_accounting():
+    import jax.numpy as jnp
+
+    f = compileobs.jit(lambda x: jnp.sum(x * 2), "t.prog", site="here")
+    a = np.ones((4, 3), np.float32)
+    f(a)
+    f(a)
+    f(a)
+    rows = {r["program"]: r for r in compileobs.program_table()}
+    r = rows["t.prog"]
+    assert r["compile_count"] == 1
+    assert r["run_count"] == 2
+    assert r["compile_seconds"] > 0
+    assert r["site"] == "here"
+    assert r["arg_bytes"] == a.nbytes
+    # always-on metrics, even though they also work with telemetry off
+    assert telemetry.counter("compile.count", program="t.prog").value == 1
+
+
+def test_batch_axis_recompile_attribution():
+    import jax.numpy as jnp
+
+    f = compileobs.jit(lambda x: x + 1, "t.batch", site="s")
+    f(np.ones((4, 3), np.float32))
+    f(np.ones((8, 3), np.float32))
+    evs = telemetry.events("compile.recompile")
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["program"] == "t.batch"
+    assert ev["cause"] == "batch"
+    assert ev["axis"] == 0
+    assert ev["old_shape"] == [4, 3] and ev["new_shape"] == [8, 3]
+    assert ev["site"] == "s"
+    c = telemetry.counter("compile.recompile", program="t.batch",
+                          cause="batch")
+    assert c.value == 1
+    del jnp
+
+
+def test_seq_len_and_dtype_causes():
+    f = compileobs.jit(lambda x: x * 1, "t.seq")
+    f(np.ones((4, 16), np.float32))
+    f(np.ones((4, 32), np.float32))
+    assert telemetry.events("compile.recompile")[-1]["cause"] == "seq_len"
+    g = compileobs.jit(lambda x: x * 1, "t.dtype")
+    g(np.ones((4, 16), np.float32))
+    g(np.ones((4, 16), np.int32))
+    assert telemetry.events("compile.recompile")[-1]["cause"] == "dtype"
+
+
+def test_rank4_axis1_is_not_seq_len():
+    # axis 1 of an NCHW image tensor is channels — "seq_len" is reserved
+    # for token-shaped (B,T) / (B,T,D) inputs
+    f = compileobs.jit(lambda x: x * 1, "t.nchw")
+    f(np.ones((2, 3, 8, 8), np.float32))
+    f(np.ones((2, 4, 8, 8), np.float32))
+    assert telemetry.events("compile.recompile")[-1]["cause"] == "axis1"
+
+
+def test_graph_key_attributes_across_wrappers():
+    # same program + same graph identity, a REBUILT wrapper (bind/reshape):
+    # its first compile diffs against the graph's previous signature
+    f1 = compileobs.jit(lambda x: x + 1, "t.rebind", graph_key="g1")
+    f1(np.ones((4, 2), np.float32))
+    f2 = compileobs.jit(lambda x: x + 1, "t.rebind", graph_key="g1")
+    f2(np.ones((6, 2), np.float32))
+    assert [e["cause"] for e in telemetry.events("compile.recompile")] == \
+        ["batch"]
+    # DIFFERENT graph identity under the same program name: a fresh graph,
+    # not a recompile
+    f3 = compileobs.jit(lambda x: x + 2, "t.rebind", graph_key="g2")
+    f3(np.ones((10, 2), np.float32))
+    assert len(telemetry.events("compile.recompile")) == 1
+
+
+def test_wrapper_scoped_without_graph_key():
+    # two instances without graph identity never cross-attribute
+    f1 = compileobs.jit(lambda x: x + 1, "t.inst")
+    f1(np.ones((4, 2), np.float32))
+    f2 = compileobs.jit(lambda x: x + 1, "t.inst")
+    f2(np.ones((6, 2), np.float32))
+    assert telemetry.events("compile.recompile") == []
+    assert telemetry.counter("compile.count", program="t.inst").value == 2
+
+
+def test_structure_cause_and_summary():
+    f = compileobs.jit(lambda *xs: sum(x.sum() for x in xs), "t.struct")
+    f(np.ones((2,), np.float32))
+    f(np.ones((2,), np.float32), np.ones((2,), np.float32))
+    assert telemetry.events("compile.recompile")[-1]["cause"] == "structure"
+    s = compileobs.summary()
+    assert s["compile_count"] == 2 and s["recompile_count"] == 1
+    assert s["recompiles"][-1]["program"] == "t.struct"
+
+
+def test_record_compile_scope_and_lower_passthrough():
+    import jax.numpy as jnp
+
+    with compileobs.record_compile("t.export", site="x"):
+        pass
+    rows = {r["program"]: r for r in compileobs.program_table()}
+    assert rows["t.export"]["compile_count"] == 1
+    f = compileobs.jit(lambda x: jnp.sum(x), "t.lower")
+    lowered = f.lower(np.ones((2, 2), np.float32))
+    assert hasattr(lowered, "compile")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: fixed-shape fit is compile-flat; shape-varying fit attributes
+# ---------------------------------------------------------------------------
+
+
+def test_fixed_shape_fit_flat_compile_count():
+    per_epoch = []
+
+    def cb(epoch, *_):
+        s = compileobs.summary()
+        per_epoch.append((s["compile_count"], s["recompile_count"]))
+
+    _fit_module(batch=16, num_epoch=3, epoch_cb=cb)
+    assert len(per_epoch) == 3
+    # every program compiled during epoch 0; epochs 1/2 add NOTHING
+    assert per_epoch[0][0] == per_epoch[2][0], per_epoch
+    assert [r for _, r in per_epoch] == [0, 0, 0]
+    assert telemetry.events("compile.recompile") == []
+    # the step programs are in the table exactly once each
+    rows = {r["program"]: r for r in compileobs.program_table()}
+    assert rows["executor.fwd_bwd"]["compile_count"] == 1
+    assert rows["optimizer.fused_update"]["compile_count"] == 1
+
+
+def test_shape_varying_fit_attributes_batch_axis():
+    mod = _fit_module(batch=16, num_epoch=1)
+    # same module, same graph, rebound at a new batch size: the executor's
+    # first compile after the rebind must read as a RECOMPILE of the graph,
+    # attributed to the batch axis with the owning call site
+    _fit_module(batch=24, num_epoch=1, mod=mod, force_rebind=True)
+    evs = telemetry.events("compile.recompile")
+    assert evs, "shape change produced no recompile events"
+    by_prog = {e["program"]: e for e in evs}
+    ev = by_prog["executor.fwd_bwd"]
+    assert ev["cause"] == "batch" and ev["axis"] == 0
+    assert "executor.py" in ev["site"]
+    assert telemetry.counter("compile.recompile",
+                             program="executor.fwd_bwd",
+                             cause="batch").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# device-memory accounting + OOM forensics
+# ---------------------------------------------------------------------------
+
+
+def test_live_ndarray_report_and_gauges():
+    keep = nd.array(np.ones((128, 64), np.float32))  # 32 KiB, the top entry
+    small = nd.array(np.ones((2, 2), np.float32))
+    rep = compileobs.live_ndarray_report(top=3)
+    ctx = str(keep.context)
+    assert rep["by_device"][ctx]["bytes"] >= keep.data.nbytes
+    assert rep["top"][0]["bytes"] >= keep.data.nbytes
+    assert rep["top"][0]["shape"] == [128, 64]
+    stats = compileobs.device_memory_stats()
+    assert any(s["bytes_in_use"] for s in stats.values())
+    # the telemetry collector refreshes the gauges on every dump
+    snap = telemetry.dump(include_events=False)
+    assert any(k.startswith("device.bytes_in_use")
+               for k in snap["gauges"]), snap["gauges"].keys()
+    del small
+
+
+def test_oom_injection_dumps_forensics():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))], for_training=False)
+    mod.init_params()
+    batch = mx.io.DataBatch(
+        data=[nd.array(np.ones((8, 6), np.float32))],
+        label=[nd.array(np.zeros((8,), np.float32))], pad=0)
+    with fault.inject("oom:"):
+        with pytest.raises(MXNetError, match="RESOURCE_EXHAUSTED"):
+            mod.forward(batch, is_train=False)
+    assert telemetry.counter("device.oom_events",
+                             program="executor.fwd").value == 1
+    evs = telemetry.events("oom")
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["program"] == "executor.fwd"
+    assert "RESOURCE_EXHAUSTED" in ev["error"]
+    assert ev["top_allocations"], "dump carries no live allocations"
+    assert any(p["program"] for p in ev["programs"])
+    assert telemetry.counter("fault.injections", point="oom").value == 1
+
+
+def test_is_oom_error_matches_xla_and_injected():
+    assert compileobs.is_oom_error(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating ..."))
+    assert not compileobs.is_oom_error(ValueError("shape mismatch"))
+
+
+def test_oom_guard_catches_real_resource_exhausted():
+    # the catch-at-boundary path: an OOM raised INSIDE the guarded block
+    # (what a real XLA RESOURCE_EXHAUSTED looks like) dumps and re-raises
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        with compileobs.oom_guard("t.real"):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory ...")
+    assert telemetry.counter("device.oom_events",
+                             program="t.real").value == 1
+    assert telemetry.events("oom")[-1]["program"] == "t.real"
+    # a non-OOM failure passes through untouched, no dump
+    with pytest.raises(ValueError):
+        with compileobs.oom_guard("t.real"):
+            raise ValueError("nope")
+    assert telemetry.counter("device.oom_events",
+                             program="t.real").value == 1
+
+
+def test_stall_dump_surfaces_compile_state():
+    f = compileobs.jit(lambda x: x + 1, "t.dump")
+    f(np.ones((2,), np.float32))
+    state = telemetry.state_summary(guard.STATE_SUMMARY_PREFIXES)
+    assert any(k.startswith("compile.count") for k in state), state.keys()
+
+
+# ---------------------------------------------------------------------------
+# score/predict step-split telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_score_and_predict_step_split():
+    mod = _fit_module(batch=16, num_epoch=1)
+    X = np.random.rand(32, 6).astype(np.float32)
+    y = (np.arange(32) % 4).astype(np.float32)
+    mod.score(mx.io.NDArrayIter(X, y, batch_size=16), "acc")
+    mod.predict(mx.io.NDArrayIter(X, y, batch_size=16))
+    snap = telemetry.dump(include_events=False)
+    h = snap["histograms"]
+    assert h["eval.step_time_seconds{path=score}"]["count"] == 2
+    assert h["eval.data_wait_seconds{path=score}"]["count"] == 2
+    assert h["eval.compute_seconds{path=predict}"]["count"] == 2
+    c = snap["counters"]
+    assert c["eval.batches{path=predict}"] == 2
+    assert c["eval.samples{path=score}"] == 32
+    assert snap["gauges"]["eval.imgs_per_sec{path=score}"] > 0
+
+
+# ---------------------------------------------------------------------------
+# surfacing: mxtop row + offline compile report from a real JSONL
+# ---------------------------------------------------------------------------
+
+
+def test_mxtop_renders_compile_columns():
+    import time as _time
+
+    now = _time.time()
+    snap = {"rank": 0, "ts": now, "step_id": (1 << 32) | 3, "mepoch": 0,
+            "imgs_per_sec": 100.0, "queues": {"engine": 0, "feed": 0},
+            "counters": {"rejected": 0}, "cum": {},
+            "window": {"steps": 5, "step_time": 0.5, "data_wait": 0.1,
+                       "compute": 0.3, "kv_sync": 0.1, "guard": 0.0},
+            "compile": {"programs": 4, "count": 9, "seconds": 12.5,
+                        "recompiles": 3,
+                        "last_recompile": {"program": "fused.step",
+                                           "cause": "batch"}}}
+    frame = mxtop.render({0: snap, 1: None}, now=now)
+    assert "cmpl_s" in frame and "rcmp" in frame
+    assert "12.5" in frame
+    assert "last recompile: fused.step (batch)" in frame
+
+
+def test_compile_report_from_real_jsonl(tmp_path, capsys):
+    sink = str(tmp_path / "telemetry.jsonl")
+    telemetry.start_flusher(path=sink, interval_s=3600)
+    mod = _fit_module(batch=16, num_epoch=1)
+    _fit_module(batch=24, num_epoch=1, mod=mod, force_rebind=True)
+    telemetry.stop_flusher(final_flush=True)
+
+    report = compile_report.analyze(compile_report.load_records([sink]))
+    assert report["totals"]["compiles"] >= 2
+    assert report["totals"]["recompiles"] >= 1
+    progs = {p["program"] for p in report["programs"]}
+    assert "executor.fwd_bwd" in progs
+    causes = {(c["program"], c["cause"])
+              for c in report["recompile_causes"]}
+    assert ("executor.fwd_bwd", "batch") in causes
+
+    # the CLI renders the same file end-to-end (what CI exercises)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "compile_report.py"),
+         sink], capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "compile timeline" in r.stdout
+    assert "recompile causes" in r.stdout
+    assert "executor.fwd_bwd" in r.stdout
+    assert "batch" in r.stdout
+
+
+def test_compile_lane_in_profiler_trace(tmp_path):
+    import trace_merge
+
+    from mxnet_tpu import profiler
+
+    profiler.profiler_set_config(mode="all", filename=str(tmp_path / "p.json"))
+    profiler.profiler_set_state("run")
+    try:
+        f = compileobs.jit(lambda x: x + 1, "t.lane")
+        f(np.ones((3,), np.float32))
+    finally:
+        profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+    with open(tmp_path / "p.json") as fh:
+        trace = json.load(fh)
+    lane = [e for e in trace["traceEvents"]
+            if e.get("tid") == compileobs.COMPILE_TRACE_TID]
+    assert any(e.get("ph") == "M" and e["args"]["name"] == "compile"
+               for e in lane), "compile lane is unnamed"
+    spans = [e for e in lane if e.get("ph") == "X"]
+    assert any(e["name"] == "compile[t.lane]" for e in spans)
+    assert spans[0]["args"]["program"] == "t.lane"
+    assert trace_merge.validate_trace(trace) == []
+
+
+def test_bench_summary_shape():
+    s = compileobs.summary()
+    assert set(s) == {"programs", "compile_count", "compile_seconds",
+                      "run_seconds", "recompile_count", "recompiles"}

@@ -164,6 +164,11 @@ def test_prometheus_text_parses():
             _, _, name, kind = line.split()
             assert kind in ("counter", "gauge", "histogram")
             types[name] = kind
+        elif line.startswith("# HELP "):
+            # cataloged metrics registered by OTHER tests in the same
+            # process (e.g. compileobs gauges) legitimately carry free-text
+            # HELP lines — this test only checks the sample format
+            continue
         else:
             assert _PROM_LINE.match(line), "unparseable line: %r" % line
             name, _, value = line.rpartition(" ")

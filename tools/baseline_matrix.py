@@ -473,7 +473,12 @@ def run_dcgan_fused(quick=False, steps=None, loss_every=10):
                                         lr, 0.0, t)
         return gp1, gs1, ga1, dp1, ds1, da3, 0.5 * (ce_r + ce_f), g_ce
 
-    step_jit = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
+    from mxnet_tpu import compileobs
+
+    step_jit = compileobs.jit(
+        step, "bench.dcgan_fused",
+        site="tools/baseline_matrix.py:dcgan_fused",
+        donate_argnums=(0, 1, 2, 3, 4, 5))
 
     # the same device-resident real pool the host-orchestrated run builds
     rng = np.random.RandomState(0)

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""compile_report — offline compile-observability view from telemetry JSONL.
+
+A training run with a telemetry sink (``MXNET_TELEMETRY_FILE=run.jsonl``)
+leaves the whole compile story on disk: every ``compile`` event (program,
+wall seconds, call site), every attributed ``compile.recompile`` (the axis
+that changed and where), any ``oom`` forensics record, and periodic
+registry snapshots carrying the per-program ``compile.count`` /
+``compile.seconds`` / ``compile.run_seconds`` metrics. This tool renders
+them into the three views ROADMAP #3's compile-cache work will be judged
+against (docs/observability.md §compile):
+
+* **compile timeline** — when each program compiled, and for how long;
+* **recompile causes ranked by cost** — total seconds burned per
+  (program, cause), i.e. what a shape-bucketing pass would save;
+* **top programs** — by compile seconds and by cumulative run seconds,
+  the compile-wall vs steady-state split.
+
+Usage::
+
+    python tools/compile_report.py run.jsonl [more.jsonl ...]
+    python tools/compile_report.py --json run.jsonl   # machine-readable
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+_KEY_RE = re.compile(r"^(?P<name>[^{]+)\{(?P<labels>.*)\}$")
+
+
+def _parse_key(key):
+    """'compile.seconds{program=x}' -> ('compile.seconds', {'program': 'x'})."""
+    m = _KEY_RE.match(key)
+    if not m:
+        return key, {}
+    labels = {}
+    for part in m.group("labels").split(","):
+        k, _, v = part.partition("=")
+        if k:
+            labels[k.strip()] = v.strip()
+    return m.group("name"), labels
+
+
+def load_records(paths):
+    """Every parseable JSON line from ``paths`` (torn tails tolerated —
+    a SIGKILLed worker leaves one). Each record is tagged with the index of
+    the file it came from (``_src``) so rank-less multi-file inputs still
+    aggregate per sink instead of collapsing onto one."""
+    records = []
+    for i, path in enumerate(paths):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    rec.setdefault("_src", i)
+                    records.append(rec)
+    return records
+
+
+def analyze(records):
+    """Pure analysis: records -> the report dict (unit-testable)."""
+    compiles = []
+    recompiles = []
+    ooms = []
+    # latest snapshot PER WRITER (rank when tagged, else source file): a
+    # distributed run leaves one sink per rank and each rank's registry is
+    # cumulative for that rank only — keeping a single global latest would
+    # silently drop every other rank's programs from the table
+    last_snapshots = {}
+    for rec in records:
+        kind = rec.get("type")
+        if kind == "snapshot":
+            writer = rec.get("rank", "_src:%s" % rec.get("_src"))
+            prev = last_snapshots.get(writer)
+            if prev is None or rec.get("ts", 0) >= prev.get("ts", 0):
+                last_snapshots[writer] = rec
+        elif kind == "event":
+            ev = rec.get("event")
+            if ev == "compile":
+                compiles.append(rec)
+            elif ev == "compile.recompile":
+                recompiles.append(rec)
+            elif ev == "oom":
+                ooms.append(rec)
+
+    # recompile causes ranked by total seconds burned
+    by_cause = {}
+    for r in recompiles:
+        key = (r.get("program", "?"), r.get("cause", "?"))
+        slot = by_cause.setdefault(
+            key, {"program": key[0], "cause": key[1], "count": 0,
+                  "seconds": 0.0, "example": None})
+        slot["count"] += 1
+        slot["seconds"] += float(r.get("seconds", 0.0))
+        if slot["example"] is None and r.get("arg"):
+            slot["example"] = "%s %s->%s" % (
+                r.get("arg"), r.get("old_shape"), r.get("new_shape"))
+    causes = sorted(by_cause.values(), key=lambda s: -s["seconds"])
+
+    # per-program totals: prefer the registry metrics from each writer's
+    # last snapshot (authoritative cumulative view, summed across writers);
+    # fall back to summing events when the run died before a snapshot flushed
+    programs = {}
+    for snapshot in last_snapshots.values():
+        for key, snap in (snapshot.get("histograms") or {}).items():
+            name, labels = _parse_key(key)
+            if name == "compile.seconds" and "program" in labels:
+                slot = programs.setdefault(
+                    labels["program"],
+                    {"program": labels["program"], "compile_count": 0,
+                     "compile_seconds": 0.0, "run_seconds": 0.0})
+                slot["compile_count"] += int(snap.get("count", 0))
+                slot["compile_seconds"] += float(snap.get("sum", 0.0))
+        for key, val in (snapshot.get("gauges") or {}).items():
+            name, labels = _parse_key(key)
+            if name == "compile.run_seconds" and "program" in labels:
+                slot = programs.setdefault(
+                    labels["program"],
+                    {"program": labels["program"], "compile_count": 0,
+                     "compile_seconds": 0.0, "run_seconds": 0.0})
+                slot["run_seconds"] += float(val)
+    if not programs:
+        for r in compiles:
+            slot = programs.setdefault(
+                r.get("program", "?"),
+                {"program": r.get("program", "?"), "compile_count": 0,
+                 "compile_seconds": 0.0, "run_seconds": 0.0})
+            slot["compile_count"] += 1
+            slot["compile_seconds"] += float(r.get("seconds", 0.0))
+
+    compiles.sort(key=lambda r: r.get("ts", 0))
+    prog_rows = sorted(programs.values(), key=lambda p: -p["compile_seconds"])
+    # headline totals come from the event stream; a snapshot-only file (no
+    # event lines flushed) still carries the cumulative registry view, so
+    # fall back to it rather than contradicting the table below with zeros
+    totals = {
+        "compiles": len(compiles),
+        "compile_seconds": round(
+            sum(float(r.get("seconds", 0.0)) for r in compiles), 3),
+        "recompiles": len(recompiles),
+    }
+    if not compiles and prog_rows:
+        totals["compiles"] = sum(p["compile_count"] for p in prog_rows)
+        totals["compile_seconds"] = round(
+            sum(p["compile_seconds"] for p in prog_rows), 3)
+    return {
+        "timeline": compiles,
+        "recompile_causes": causes,
+        "programs": prog_rows,
+        "ooms": ooms,
+        "totals": totals,
+    }
+
+
+def render(report):
+    """The report dict as a human-readable text block."""
+    lines = []
+    t = report["totals"]
+    lines.append("compile report: %d compiles, %.2fs compile wall, "
+                 "%d recompiles" % (t["compiles"], t["compile_seconds"],
+                                    t["recompiles"]))
+    tl = report["timeline"]
+    if tl:
+        t0 = tl[0].get("ts", 0)
+        lines.append("")
+        lines.append("## compile timeline")
+        lines.append("%8s  %-28s %8s  %s"
+                     % ("t+s", "program", "seconds", "site"))
+        for r in tl:
+            lines.append("%8.2f  %-28s %8.3f  %s"
+                         % (r.get("ts", 0) - t0, r.get("program", "?"),
+                            float(r.get("seconds", 0.0)),
+                            r.get("site", "")))
+    if report["recompile_causes"]:
+        lines.append("")
+        lines.append("## recompile causes (ranked by cost)")
+        lines.append("%-28s %-10s %6s %9s  %s"
+                     % ("program", "cause", "count", "seconds", "example"))
+        for c in report["recompile_causes"]:
+            lines.append("%-28s %-10s %6d %9.3f  %s"
+                         % (c["program"], c["cause"], c["count"],
+                            c["seconds"], c["example"] or ""))
+    if report["programs"]:
+        lines.append("")
+        lines.append("## programs (compile wall vs steady-state run)")
+        lines.append("%-28s %9s %12s %12s"
+                     % ("program", "compiles", "compile_s", "run_s"))
+        for p in report["programs"]:
+            lines.append("%-28s %9d %12.3f %12.3f"
+                         % (p["program"], p["compile_count"],
+                            p["compile_seconds"], p["run_seconds"]))
+    for oom in report["ooms"]:
+        lines.append("")
+        lines.append("## OOM at program %r" % oom.get("program"))
+        lines.append("error: %s" % oom.get("error"))
+        lines.append("device memory: %s" % json.dumps(
+            oom.get("device_memory", {})))
+        for a in oom.get("top_allocations", []):
+            lines.append("  %12d bytes  %-20s %-10s %s"
+                         % (a.get("bytes", 0), a.get("shape"),
+                            a.get("dtype"), a.get("context")))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render the compile-observability report from telemetry "
+                    "JSONL sinks")
+    ap.add_argument("paths", nargs="+", help="telemetry JSONL file(s)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the report as JSON instead of text")
+    args = ap.parse_args(argv)
+    report = analyze(load_records(args.paths))
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

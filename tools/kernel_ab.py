@@ -71,7 +71,10 @@ def device_time_us(fn, args, iters=20, warmup=3, drop=None):
     predicate on kernel names to exclude (e.g. input-convert kernels that a
     real pipeline would amortize).
     """
-    jf = jax.jit(fn)
+    from mxnet_tpu import compileobs
+
+    jf = compileobs.jit(fn, "bench.kernel_ab",
+                        site="tools/kernel_ab.py:device_time_us")
     out = jf(*args)
     for _ in range(warmup):
         out = jf(*args)
@@ -123,7 +126,10 @@ def device_time_us_chained(body_fn, args, iters=30):
             return body_fn(i, *a[:-1], carry)
         return lax.fori_loop(0, iters, body, a[-1])
 
-    jf = jax.jit(looped)
+    from mxnet_tpu import compileobs
+
+    jf = compileobs.jit(looped, "bench.kernel_ab_loop",
+                        site="tools/kernel_ab.py:device_time_us_looped")
     out = jf(*args)
     np.asarray(out).ravel()[0]
     tmp = tempfile.mkdtemp(prefix="kab_")

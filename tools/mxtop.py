@@ -163,9 +163,10 @@ def render(snaps, membership=None, straggler_factor=2.0, now=None):
         head += "  STRAGGLER: rank %d (%s, %.1fx)" % (
             straggler["rank"], straggler["stage"], straggler["ratio"])
     lines.append(head)
-    lines.append("%-5s %-12s %9s %9s %6s %6s %6s %7s %5s %5s %5s %6s"
+    lines.append("%-5s %-12s %9s %9s %6s %6s %6s %7s %5s %5s %5s %7s %5s %6s"
                  % ("rank", "step", "imgs/s", "step_ms", "data%", "comp%",
-                    "kv%", "guard%", "engq", "feedq", "rej", "age"))
+                    "kv%", "guard%", "engq", "feedq", "rej", "cmpl_s",
+                    "rcmp", "age"))
     for rank in sorted(snaps):
         s = snaps[rank]
         if not s:
@@ -176,9 +177,14 @@ def render(snaps, membership=None, straggler_factor=2.0, now=None):
         wall = w.get("step_time", 0.0)
         q = s.get("queues") or {}
         c = s.get("counters") or {}
+        # compile observability (compileobs summary published per rank): a
+        # rank whose recompile count keeps climbing is paying an XLA
+        # compile wall inside its steps — the classic silent-retrace bug
+        comp = s.get("compile") or {}
         age = now - float(s.get("ts", now))
         lines.append(
-            "%-5d %-12s %9.1f %9.1f %6s %6s %6s %7s %5d %5d %5d %5.1fs"
+            "%-5d %-12s %9.1f %9.1f %6s %6s %6s %7s %5d %5d %5d %7.1f %5d "
+            "%5.1fs"
             % (rank, _decode_step(s.get("step_id")),
                float(s.get("imgs_per_sec", 0.0)),
                (wall / steps * 1000.0) if steps else 0.0,
@@ -187,7 +193,15 @@ def render(snaps, membership=None, straggler_factor=2.0, now=None):
                _pct(w.get("kv_sync", 0.0), wall),
                _pct(w.get("guard", 0.0), wall),
                int(q.get("engine", 0)), int(q.get("feed", 0)),
-               int(c.get("rejected", 0)), age))
+               int(c.get("rejected", 0)),
+               float(comp.get("seconds", 0.0)),
+               int(comp.get("recompiles", 0)), age))
+        last = (comp.get("last_recompile") or {}) \
+            if comp.get("recompiles") else {}
+        if last:
+            lines.append(
+                "      last recompile: %s (%s)"
+                % (last.get("program"), last.get("cause")))
     return "\n".join(lines)
 
 

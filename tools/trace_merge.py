@@ -55,6 +55,12 @@ ANNOTATION_EVENTS = (
     "elastic_reconfigured", "reshard", "kv.straggler",
     "guard_rollback", "guard_stall", "guard_bad_step",
     "epoch_start", "epoch_end",
+    # compile observability (mxnet_tpu/compileobs.py): a compile or an
+    # attributed recompile landing mid-timeline explains a step-time spike
+    # on that worker's lane; an oom marks where forensics were dumped.
+    # (Chrome-trace files additionally carry the per-process "compile" lane
+    # spans the profiler records — those merge as ordinary events.)
+    "compile", "compile.recompile", "oom",
 )
 # annotation events whose `rank` field names the SUBJECT worker's lane
 RANKED_ANNOTATIONS = ("worker_lost", "worker_joined", "worker_rejoined")
