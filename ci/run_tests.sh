@@ -12,6 +12,9 @@
 #   pipeline  input-pipeline feed suite: uint8 wire + async device feed (fast, host-only)
 #   guard     training health-guard suite: sentinel/rollback/stall/resume (fast, host-only)
 #   elastic   elastic-membership suite incl. the slow kill/rejoin e2e (host-only CPU mesh)
+#   serving   paged-KV serving engine: kernel numerics/allocator/scheduler/
+#             engine-vs-sequential equality (fast, host-only; the slow >=32-
+#             stream HTTP e2e runs when invoked directly)
 #   lint      fwlint invariant analyzer (ratchets on ci/fwlint_baseline.json) + analysis suite
 #   deep      (opt-in, non-blocking) slow-marked deep-model compiles
 #   predict   C predict shim build + compiled-client test
@@ -190,6 +193,22 @@ run_telemetry() {
   fi
 }
 
+run_serving() {
+  # serving tier (docs/serving.md): paged-attention numerics vs the
+  # contiguous-cache decoder (Pallas kernel in interpret mode = the same
+  # program the TPU runs), KV block-pool alloc/free/OOM invariants,
+  # continuous-batching FCFS fairness + recompute preemption, the
+  # graph-level cache-overflow contract on both decode paths, and the
+  # compile-flat-after-warmup gate. The slow case (>=32 concurrent
+  # variable-length HTTP streams through tools/serve.py, outputs
+  # bit-identical to sequential decoding) runs only when this stage is
+  # invoked directly, like `elastic`.
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_serving.py -q -m "not slow"
+  if [ "${1:-}" = "with_slow" ]; then
+    JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_serving.py -q -m slow
+  fi
+}
+
 run_pipeline() {
   # input-pipeline feed tier (docs/perf.md §pipeline): uint8-wire numeric
   # parity vs fp32 wire, double-buffer teardown safety, MXNET_FEED_DEPTH,
@@ -361,6 +380,7 @@ case "$stage" in
   pipeline) run_pipeline ;;
   guard) run_guard ;;
   elastic) run_elastic ;;
+  serving) run_serving with_slow ;;
   lint) run_lint ;;
   deep) run_deep ;;
   predict) run_predict ;;
@@ -372,9 +392,10 @@ case "$stage" in
   package) run_package ;;
   all) run_lint; run_native; run_predict; run_predict_native; run_entry;
        run_package; run_faults; run_telemetry; run_pipeline; run_guard;
+       run_serving;
        JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_elastic.py -q -m "not slow";
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
                 --ignore=tests/test_train_native.py ;;
-  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|guard|elastic|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|guard|elastic|serving|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
 esac

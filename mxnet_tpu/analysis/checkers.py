@@ -48,8 +48,10 @@ __all__ = ["CHECKERS"]
 ENV_HELPER_FILE = "mxnet_tpu/base.py"
 
 # the training step path: Module forward/backward/update + executor plumbing
-# (docs/perf.md §pipeline attributes real throughput loss to host syncs here)
-HOT_PATH_PREFIXES = ("mxnet_tpu/module/",)
+# (docs/perf.md §pipeline attributes real throughput loss to host syncs here),
+# plus the serving engine's prefill/decode loop (docs/serving.md — seeded at
+# 0 debt; the sole token-egress sync is inline-suppressed with a reason)
+HOT_PATH_PREFIXES = ("mxnet_tpu/module/", "mxnet_tpu/serving/")
 HOT_PATH_FILES = ("mxnet_tpu/executor.py", "mxnet_tpu/executor_manager.py")
 
 _GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")

@@ -72,9 +72,11 @@ def get_decode_symbol(vocab_size=32000, num_layers=4, model_dim=256,
     per-length recompilation.
 
     data: (batch, 1) token ids; position: (1,) step index, which MUST stay
-    below ``seq_len`` — the op clips out-of-range positions inside the jitted
-    graph (no data-dependent errors under XLA), so stepping past the cache
-    silently overwrites the last slot: guard host-side (``decode_step`` does).
+    below ``seq_len`` — XLA admits no data-dependent errors, so in-graph an
+    out-of-range position DROPS the cache write (both caches pass through
+    unchanged) and poisons the op's output to NaN: stepping past the cache
+    can never corrupt it, and the overflow fails loudly at the consumer.
+    ``decode_step`` still raises host-side before dispatch.
     Step through ``decode_step`` (or call forward(is_train=True) AND read the
     outputs every step: executor forwards are deferred, so skipping the read
     would drop the cache write-back).
@@ -109,8 +111,9 @@ def decode_step(executor, tokens, position, max_len):
     probabilities (numpy, (batch, vocab)).
 
     Encapsulates the two contract points a raw executor user can get wrong:
-    the host-side max_len guard (the jitted op clips silently) and the output
-    read that materializes the deferred forward so the KV-cache aux write-back
+    the host-side max_len guard (in-graph an overflow is a dropped write +
+    NaN output, never a corrupted cache) and the output read that
+    materializes the deferred forward so the KV-cache aux write-back
     actually happens."""
     import numpy as _np
 

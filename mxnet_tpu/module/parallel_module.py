@@ -297,14 +297,19 @@ class ParallelLMModule(BaseModule):
         import jax
 
         if self._outs is None:
+            import jax.numpy as jnp
+
             tok = (self._staged[0] if self._staged is not None
                    else getattr(self, "_metric_tokens", None))
             assert tok is not None, "call forward first"
             logits = self._forward_trainer().forward(self._params, tok)
-            probs = jax.nn.softmax(np.asarray(logits, np.float32), axis=-1)
+            # softmax + reshape stay ON DEVICE: the only host transfer is
+            # the consumer's eventual asnumpy (metric update), one pull of
+            # the (B*T, V) probs instead of logits-pull + host softmax
+            probs = jax.nn.softmax(jnp.asarray(logits, jnp.float32), axis=-1)
             V = self._cfg["vocab_size"]
-            self._outs = np.asarray(probs).reshape(-1, V)
-        return [nd.array(self._outs)]
+            self._outs = probs.reshape(-1, V)
+        return [nd.NDArray(self._outs)]
 
     def update_metric(self, eval_metric, labels):
         eval_metric.update(list(labels), self.get_outputs())

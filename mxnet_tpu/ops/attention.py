@@ -35,13 +35,26 @@ from jax import lax
 
 from .registry import Param, fp32_precision, register
 
-__all__ = ["flash_attention", "attention_reference"]
+__all__ = ["flash_attention", "attention_reference", "paged_attention",
+           "paged_attention_reference"]
 
 _NEG_INF = -1e30
 
 
 def _scale(sm_scale, d):
     return 1.0 / np.sqrt(d) if sm_scale is None else sm_scale
+
+
+def _tpu_in_process():
+    """Whether a TPU backend exists in this process. Gates the Pallas
+    branch at TRACE time: ``lax.platform_dependent`` still picks the
+    platform at LOWERING time, but on this jax version it lowers every
+    offered branch — offering the Pallas kernel to a CPU-only process
+    fails its lowering outright ("Only interpret mode is supported on CPU
+    backend"), so a process without a TPU must not offer it at all."""
+    import jax
+
+    return jax.default_backend() == "tpu"
 
 
 def attention_reference(q, k, v, causal=False, sm_scale=None):
@@ -436,7 +449,7 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_k=256):
 
 def _forward_impl(q, k, v, causal, sm_scale, block_k):
     sm_scale = _scale(sm_scale, q.shape[-1])
-    if _pallas_shapes_ok(q, k):
+    if _pallas_shapes_ok(q, k) and _tpu_in_process():
         # platform selected at LOWERING time, not trace time: the same traced
         # function may compile for the TPU (Pallas kernel) or for CPU (scan) —
         # an array's placement isn't knowable from a tracer
@@ -459,7 +472,7 @@ def _fa_fwd(q, k, v, causal, sm_scale, block_k):
 def _fa_bwd(causal, sm_scale, block_k, res, g):
     q, k, v, out, lse = res
     scale = _scale(sm_scale, q.shape[-1])
-    if _pallas_shapes_ok(q, k):
+    if _pallas_shapes_ok(q, k) and _tpu_in_process():
         return lax.platform_dependent(
             q, k, v, out, lse, g,
             tpu=functools.partial(_pallas_backward, causal=causal, sm_scale=scale),
@@ -556,6 +569,14 @@ def _cached_mha_op(octx, attrs, args, auxs):
     data: (B, 1, model) — the current token's hidden state;
     position: (1,) float — the step index t (tokens 0..t-1 already cached).
     Returns (B, 1, model); writes the step's k/v into the caches at t.
+
+    Graph-level overflow contract: a position >= max_len can NEVER corrupt
+    the cache — the write is dropped (both caches pass through unchanged)
+    and the op's output is poisoned to NaN so the overflow fails loudly at
+    the consumer instead of silently rereading a clobbered slot. (XLA admits
+    no data-dependent errors, so in-graph the hazard lowers to
+    drop-write + poison; ``transformer_lm.decode_step`` still raises
+    host-side before dispatch.)
     """
     x, w_in, w_out, position = args
     cache_k, cache_v = auxs
@@ -563,7 +584,9 @@ def _cached_mha_op(octx, attrs, args, auxs):
     heads = attrs["num_heads"]
     max_len = attrs["max_len"]
     hd = model // heads
-    pos = jnp.clip(position.reshape(()).astype(jnp.int32), 0, max_len - 1)
+    pos_raw = position.reshape(()).astype(jnp.int32)
+    in_range = (pos_raw >= 0) & (pos_raw < max_len)
+    pos = jnp.clip(pos_raw, 0, max_len - 1)  # safe index for the dropped write
 
     prec = fp32_precision(x.dtype)
     qkv = jnp.einsum("bsm,nm->bsn", x, w_in, precision=prec)  # (B, 1, 3*model)
@@ -577,6 +600,9 @@ def _cached_mha_op(octx, attrs, args, auxs):
                                          (0, 0, pos, 0))
     new_v = jax.lax.dynamic_update_slice(cache_v, v_new.astype(cache_v.dtype),
                                          (0, 0, pos, 0))
+    # overflow contract: out-of-range positions drop the write entirely
+    new_k = jnp.where(in_range, new_k, cache_k)
+    new_v = jnp.where(in_range, new_v, cache_v)
     # attend q over positions <= t
     s = jnp.einsum("bhqd,bhkd->bhqk", q, new_k,
                    preferred_element_type=jnp.float32,
@@ -587,6 +613,9 @@ def _cached_mha_op(octx, attrs, args, auxs):
     out = jnp.einsum("bhqk,bhkd->bhqd", p, new_v, precision=prec)  # (B,H,1,hd)
     out = out.transpose(0, 2, 1, 3).reshape(bsz, 1, model)
     out = jnp.einsum("bsm,nm->bsn", out, w_out, precision=prec)
+    # overflow contract: poison the output so an out-of-range step fails
+    # loudly downstream instead of returning stale-slot attention
+    out = jnp.where(in_range, out, jnp.asarray(np.nan, out.dtype))
     return [out], [new_k, new_v]
 
 
@@ -609,3 +638,189 @@ def _cached_mha_infer(attrs, in_shapes, aux_shapes):
 
 
 get_op("_contrib_CachedMultiHeadAttention")._infer_shape = _cached_mha_infer
+
+
+# ------------------------------------------------------- paged (ragged) decode
+def paged_attention_reference(q, k_pages, v_pages, block_tables, context_lens,
+                              sm_scale=None):
+    """Pure-XLA paged decode attention — the numeric oracle and the CPU/CI
+    lowering of the Pallas kernel below.
+
+    One query token per sequence attends over a block-paged ragged KV cache
+    (the "Ragged Paged Attention" serving layout, PAPERS.md): sequences own
+    fixed-size blocks of a shared pool, named by a per-sequence block table.
+
+    q:            (B, H, D)        — this step's query, one token per stream
+    k_pages:      (N, bs, H, D)    — the shared K pool: N blocks of bs slots
+    v_pages:      (N, bs, H, D)    — the shared V pool
+    block_tables: (B, nb) int32    — block ids per sequence, in position
+                                     order; unused tail entries may point at
+                                     any block (masked by context_lens)
+    context_lens: (B,) int32       — valid tokens per sequence (<= nb*bs)
+
+    Returns (B, H, D) in q.dtype. Positions >= context_len contribute
+    EXACTLY zero: their scores are pinned to -1e30, which underflows to
+    p = 0.0 in float32 — garbage in masked slots cannot leak in. A row
+    with context_len == 0 returns all zeros (softmax over an all-masked
+    row would otherwise go uniform and average the garbage), matching
+    the Pallas kernel's empty-stream output.
+    """
+    sm_scale = _scale(sm_scale, q.shape[-1])
+    b, h, d = q.shape
+    bs = k_pages.shape[1]
+    nb = block_tables.shape[1]
+    t = nb * bs
+    k = jnp.take(k_pages, block_tables, axis=0)  # (B, nb, bs, H, D)
+    v = jnp.take(v_pages, block_tables, axis=0)
+    k = k.reshape(b, t, h, d).astype(jnp.float32)
+    v = v.reshape(b, t, h, d).astype(jnp.float32)
+    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32), k,
+                   precision=lax.Precision.HIGHEST) * sm_scale
+    valid = jnp.arange(t)[None, :] < context_lens[:, None]  # (B, T)
+    s = jnp.where(valid[:, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # an all-masked row (context_len == 0) softmaxes to uniform 1/T and
+    # would average the gathered garbage — pin the whole row to zero, the
+    # kernel's empty-stream output
+    p = jnp.where((context_lens > 0)[:, None, None], p, 0.0)
+    out = jnp.einsum("bht,bthd->bhd", p, v, precision=lax.Precision.HIGHEST)
+    return out.astype(q.dtype)
+
+
+def _paged_pallas(q, k_pages, v_pages, block_tables, context_lens, sm_scale,
+                  interpret=False):
+    """Pallas TPU ragged-paged-attention decode kernel.
+
+    Grid (B, nb) with the block axis innermost; the block TABLE and context
+    lengths ride in as scalar-prefetch args (``PrefetchScalarGridSpec``) so
+    the index_map can steer each step's K/V DMA straight at the sequence's
+    i-th pool block — the gather never materialises per-sequence contiguous
+    KV. Online-softmax state (m, l, acc) lives in VMEM scratch carried
+    across block steps; blocks wholly past context_len skip compute via
+    ``pl.when`` (ragged early-out). VMEM per core is O(bs·H·D), independent
+    of both sequence length and pool size.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    bs = k_pages.shape[1]
+    nb = block_tables.shape[1]
+
+    def kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+               m_ref, l_ref, acc_ref):
+        i = pl.program_id(0)  # sequence
+        j = pl.program_id(1)  # block-table slot (innermost)
+
+        @pl.when(j == 0)
+        def _init():
+            m_ref[:] = jnp.full((h,), _NEG_INF, jnp.float32)
+            l_ref[:] = jnp.zeros((h,), jnp.float32)
+            acc_ref[:] = jnp.zeros((h, d), jnp.float32)
+
+        ctx = cl_ref[i]
+
+        @pl.when(j * bs < ctx)  # ragged early-out past the context
+        def _step():
+            qv = q_ref[0].astype(jnp.float32)   # (H, D)
+            kv = k_ref[0].astype(jnp.float32)   # (bs, H, D)
+            vv = v_ref[0].astype(jnp.float32)
+            s = jnp.sum(qv[None] * kv, axis=-1) * sm_scale  # (bs, H)
+            pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, h), 0)
+            s = jnp.where(pos < ctx, s, _NEG_INF)
+            m = m_ref[:]
+            m_new = jnp.maximum(m, jnp.max(s, axis=0))
+            p = jnp.exp(s - m_new[None, :])
+            scale = jnp.exp(m - m_new)
+            m_ref[:] = m_new
+            l_ref[:] = l_ref[:] * scale + jnp.sum(p, axis=0)
+            acc_ref[:] = (acc_ref[:] * scale[:, None]
+                          + jnp.sum(p[:, :, None] * vv, axis=0))
+
+        @pl.when(j == nb - 1)
+        def _finish():
+            l = jnp.maximum(l_ref[:], 1e-30)
+            o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, j, bt, cl: (i, 0, 0)),
+            pl.BlockSpec((1, bs, h, d), lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d), lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, j, bt, cl: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h,), jnp.float32),
+            pltpu.VMEM((h,), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def _paged_shapes_ok(q, k_pages):
+    # Mosaic pads sublanes/lanes of the trailing (H, D) tile; keep D
+    # lane-aligned. bs and nb are free (ragged tails are masked in-kernel).
+    return q.shape[-1] % 8 == 0 and q.shape[-1] >= 8
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    sm_scale=None):
+    """Paged ragged decode attention over a shared KV block pool.
+
+    Platform selected at LOWERING time (like :func:`flash_attention`): the
+    Pallas kernel on TPU, the pure-XLA gather reference everywhere else —
+    identical outputs, so a CPU CI run proves the math the TPU kernel runs.
+    Serving-only (no vjp): the decode path never differentiates.
+    """
+    sm_scale = _scale(sm_scale, q.shape[-1])
+    if _paged_shapes_ok(q, k_pages) and _tpu_in_process():
+        return lax.platform_dependent(
+            q, k_pages, v_pages, block_tables, context_lens,
+            tpu=functools.partial(_paged_pallas, sm_scale=sm_scale),
+            default=functools.partial(paged_attention_reference,
+                                      sm_scale=sm_scale),
+        )
+    return paged_attention_reference(q, k_pages, v_pages, block_tables,
+                                     context_lens, sm_scale=sm_scale)
+
+
+@register(
+    "_contrib_PagedAttention",
+    arg_names=("query", "key_pages", "value_pages", "block_table",
+               "context_len"),
+    params={
+        "sm_scale": Param.float(-1.0),
+    },
+)
+def _paged_attention_op(octx, attrs, args, auxs):
+    """Paged decode attention (serving): one query token per sequence over a
+    block-paged shared KV pool. query: (B, heads, head_dim); key_pages/
+    value_pages: (num_blocks, block_size, heads, head_dim); block_table:
+    (B, nb); context_len: (B,). The serving engine drives the jax-level
+    :func:`paged_attention` directly; this registration keeps the kernel
+    reachable from nd/sym like every other op."""
+    q, kp, vp, bt, cl = args
+    scale = attrs["sm_scale"]
+    out = paged_attention(q, kp, vp, bt.astype(jnp.int32),
+                          cl.astype(jnp.int32),
+                          None if scale <= 0 else scale)
+    return [out], []
+
+
+def _paged_infer_shape(attrs, in_shapes, aux_shapes):
+    qs = in_shapes[0]
+    if qs is None:
+        raise ValueError("PagedAttention: query shape required")
+    return in_shapes, [tuple(qs)], []
+
+
+get_op("_contrib_PagedAttention")._infer_shape = _paged_infer_shape

@@ -1,0 +1,34 @@
+"""LLM serving engine — paged KV-cache attention + continuous batching.
+
+The "millions of users" workload the substrate exists for (ROADMAP #1): a
+standing inference engine over the Transformer-LM zoo model. Sequences share
+one device's KV memory through a block-paged ragged cache (per "Ragged Paged
+Attention", PAPERS.md) and a continuous-batching scheduler mixes prefill and
+decode into padded shape buckets, so the decode step compiles once per
+bucket (provable via compileobs) and thousands of variable-length streams
+multiplex one set of weights.
+
+Layers:
+
+* :mod:`.kv_cache`  — the device block pool + host allocator
+  (``serving.kv_blocks_*`` accounting).
+* :mod:`.model`     — the functional Transformer-LM forward sharing
+  ``models/transformer_lm.py`` parameter names: full-sequence prefill
+  (flash attention) and the fused one-token paged decode step
+  (``ops.attention.paged_attention``).
+* :mod:`.scheduler` — admission queue, per-request state machine,
+  FCFS continuous batching, block-exhaustion preemption.
+* :mod:`.engine`    — :class:`ServingEngine`: the Python API
+  (``submit``/``step``/``generate``) with per-request TTFT / latency /
+  tokens-per-sec flowing through the telemetry registry.
+
+Front ends: ``tools/serve.py`` (HTTP/JSON standing server with live stat
+columns) and ``tools/bench_serving.py`` (offline BENCH headline). See
+docs/serving.md.
+"""
+from .engine import ServingConfig, ServingEngine
+from .kv_cache import KVBlockPool, KVCacheOOM
+from .scheduler import Request, Scheduler
+
+__all__ = ["ServingConfig", "ServingEngine", "KVBlockPool", "KVCacheOOM",
+           "Request", "Scheduler"]

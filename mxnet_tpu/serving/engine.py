@@ -1,0 +1,513 @@
+"""ServingEngine — the standing inference engine's Python API.
+
+One engine owns: the weights (a trained checkpoint's ``arg_params`` or
+deterministic ``random_params``), one :class:`~.kv_cache.KVBlockPool`, one
+:class:`~.scheduler.Scheduler`, and exactly TWO compileobs-tracked XLA
+programs — ``serving.prefill`` and ``serving.decode`` — each compiled once
+per padded shape bucket (prompt-length buckets for prefill, batch-size
+buckets for decode) and replayed forever after: ``compileobs`` showing a
+flat compile count after bucket warmup is the engine's no-recompile
+acceptance gate.
+
+Each :meth:`step` runs the scheduler's plan: admitted prompts prefill into
+the shared block pool (one call per request at its length bucket), then
+every decoding stream advances one token through the fused paged decode
+step at the batch bucket. The ONLY device->host sync per step is the tiny
+next-token vector — that read IS the product (tokens leave for clients);
+everything else stays device-resident, pool pages donated call to call.
+
+Thread model: ``submit()`` is safe from any thread (HTTP handlers);
+``step()``/``run_loop()`` must run on one driver thread. Per-request
+latency metrics (TTFT, end-to-end, tokens/sec) flow through the telemetry
+registry — ``serving.*`` in docs/observability.md — and render live in
+``tools/serve.py``'s stat columns.
+"""
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .. import compileobs, telemetry
+from ..base import env_int
+from . import model as _model
+from .kv_cache import KVBlockPool
+from .scheduler import DECODING, FAILED, FINISHED, Request, Scheduler
+
+_SITE = "serving/engine.py"
+
+_engine_ids = itertools.count()
+
+
+class ServingConfig(_model.ModelConfig):
+    """Model shape + engine knobs. Engine knobs default from the
+    ``MXNET_SERVING_*`` environment (docs/env_var.md)."""
+
+    __slots__ = ("block_size", "num_blocks", "max_batch",
+                 "prefills_per_step", "kv_dtype")
+
+    def __init__(self, vocab_size=32000, num_layers=4, model_dim=256,
+                 num_heads=4, ffn_dim=1024, max_len=128,
+                 block_size=None, num_blocks=None, max_batch=None,
+                 prefills_per_step=None, kv_dtype=np.float32):
+        super().__init__(vocab_size, num_layers, model_dim, num_heads,
+                         ffn_dim, max_len)
+        self.block_size = int(block_size if block_size is not None
+                              else env_int("MXNET_SERVING_BLOCK_SIZE", 16))
+        self.num_blocks = int(num_blocks if num_blocks is not None
+                              else env_int("MXNET_SERVING_NUM_BLOCKS", 257))
+        self.max_batch = int(max_batch if max_batch is not None
+                             else env_int("MXNET_SERVING_MAX_BATCH", 32))
+        self.prefills_per_step = int(
+            prefills_per_step if prefills_per_step is not None
+            else env_int("MXNET_SERVING_PREFILLS_PER_STEP", 4))
+        self.kv_dtype = np.dtype(kv_dtype)
+        if self.max_len % self.block_size:
+            raise ValueError(
+                "max_len (%d) must be a multiple of block_size (%d): "
+                "prefill buckets and the decode block table are sized in "
+                "whole blocks" % (self.max_len, self.block_size))
+
+    def decode_buckets(self):
+        """Padded decode batch sizes: powers of two up to max_batch."""
+        out = []
+        b = 1
+        while b < self.max_batch:
+            out.append(b)
+            b *= 2
+        out.append(self.max_batch)
+        return out
+
+    def prefill_buckets(self):
+        """Padded prompt lengths: block_size doublings up to max_len."""
+        out = []
+        s = self.block_size
+        while s < self.max_len:
+            out.append(s)
+            s *= 2
+        out.append(self.max_len)
+        return out
+
+
+def _bucket_for(n, buckets):
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError("no bucket holds %d (buckets %s)" % (n, buckets))
+
+
+class ServingEngine:
+    """Continuous-batching inference over the Transformer-LM zoo model."""
+
+    def __init__(self, config, arg_params=None, seed=0, device=None,
+                 enable_telemetry=True):
+        if enable_telemetry:
+            telemetry.enable()
+        self.config = cfg = config
+        if arg_params is None:
+            arg_params = _model.random_params(cfg, seed=seed)
+        self.params = _model.as_device_params(arg_params, cfg, device=device)
+        self.pool = KVBlockPool(cfg.num_layers, cfg.num_blocks,
+                                cfg.block_size, cfg.num_heads,
+                                cfg.model_dim // cfg.num_heads,
+                                dtype=cfg.kv_dtype, device=device)
+        self.scheduler = Scheduler(self.pool, max_batch=cfg.max_batch,
+                                   prefills_per_step=cfg.prefills_per_step)
+        self._nb_max = cfg.max_len // cfg.block_size
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        # retired requests awaiting pop_finished(), BOUNDED: a driver
+        # that consumes done_events instead (serve.py) would otherwise
+        # leak one Request per call served for the life of the server.
+        # A polling driver draining every step never hits the cap — a
+        # step retires at most max_batch streams plus a handful of
+        # admission failures; only a mass abort can shed the oldest
+        # entries, and those waiters were already woken via done_event.
+        self._finished = deque(maxlen=max(256, 8 * cfg.max_batch))
+        self._aborted = None
+        self._steps = 0
+        # per-engine tallies: the registry counters with the same names
+        # are process-global and would attribute a previous engine's
+        # traffic to this one in stats()
+        self._n_completed = 0
+        self._n_failed = 0
+        self._token_window = []   # one timestamp per token, for tokens/sec
+        self._t_started = time.time()
+        self._tokens_total = 0
+
+        # donation frees the pool's previous pages the moment the step
+        # consumes them — without it every step would briefly double the
+        # pool's device footprint (CPU backends ignore donation; harmless)
+        import jax
+
+        donate = {} if jax.default_backend() == "cpu" else \
+            {"donate_argnums": (4, 5)}
+        # the engine nonce is part of the graph identity: a second engine
+        # in the same process (even one with an IDENTICAL config) holds
+        # fresh function objects, so its bucket warmup compiles again —
+        # under a shared graph key that warmup would diff against the
+        # first engine's signatures and misreport as compile.recompile
+        # (cause=placement; cause=dtype when only kv_dtype differs)
+        gkey = ("serving", next(_engine_ids)) + cfg.key() + (
+            cfg.block_size, cfg.num_blocks, str(cfg.kv_dtype))
+
+        # fresh function objects per bucket (factories, not one shared
+        # closure): jax's tracing cache is keyed on the wrapped function,
+        # so bucket wrappers sharing one function would share one cache
+        # and each wrapper's cache-size delta would misfire on the
+        # others' compiles
+        def _mk_prefill():
+            def _prefill(params, tokens, length, block_table,
+                         k_pages, v_pages):
+                return _model.prefill(params, tokens, length, block_table,
+                                      k_pages, v_pages, cfg)
+            return _prefill
+
+        def _mk_decode():
+            def _decode(params, tokens, positions, block_tables,
+                        context_lens, k_pages, v_pages):
+                return _model.decode(params, tokens, positions,
+                                     block_tables, context_lens,
+                                     k_pages, v_pages, cfg)
+            return _decode
+
+        if donate:
+            decode_donate = {"donate_argnums": (5, 6)}
+        else:
+            decode_donate = {}
+        # one wrapper per shape bucket: buckets are DESIGNED to each
+        # compile once, so a bucket's first compile must not diff against
+        # another bucket's signature under a shared graph key — that would
+        # report routine warmup as compile.recompile (the counter
+        # operators alarm on) with a WARNING per bucket. Per-bucket keys
+        # reserve the recompile stream for a bucket compiling AGAIN.
+        self._prefill_jits = {
+            S: compileobs.jit(_mk_prefill(), "serving.prefill", site=_SITE,
+                              graph_key=gkey + ("prefill", S), **donate)
+            for S in cfg.prefill_buckets()}
+        self._decode_jits = {
+            B: compileobs.jit(_mk_decode(), "serving.decode", site=_SITE,
+                              graph_key=gkey + ("decode", B),
+                              **decode_donate)
+            for B in cfg.decode_buckets()}
+        # bucket dispatch: call sites pad to an exact bucket shape, so the
+        # padded dims index the wrapper table directly
+        self._prefill_fn = lambda params, toks, L, table, kp, vp: \
+            self._prefill_jits[toks.shape[1]](params, toks, L, table,
+                                              kp, vp)
+        self._decode_fn = lambda params, toks, poss, tables, ctx, kp, vp: \
+            self._decode_jits[toks.shape[0]](params, toks, poss, tables,
+                                             ctx, kp, vp)
+
+    # ------------------------------------------------------------------ API
+    def submit(self, prompt, max_new_tokens, eos_id=None):
+        """Enqueue a request; returns the :class:`Request` (its
+        ``done_event`` is set when it finishes — block on it from serving
+        threads, or drive :meth:`step` yourself)."""
+        req = Request(prompt, max_new_tokens, eos_id=eos_id)
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.config.max_len:
+            raise ValueError(
+                "request needs %d total positions > max_len %d (the "
+                "position-embedding table bounds every stream)"
+                % (total, self.config.max_len))
+        if self.pool.blocks_for(total) > self.pool.num_usable:
+            raise ValueError(
+                "request needs %d KV blocks > pool capacity %d"
+                % (self.pool.blocks_for(total), self.pool.num_usable))
+        req.done_event = threading.Event()
+        with self._work:
+            # checked under the lock: an abort() racing an unlocked check
+            # could drain the queues first, leaving this request enqueued
+            # behind a dead driver with a done_event nobody will ever set
+            if self._aborted is not None:
+                raise RuntimeError(self._aborted)
+            self.scheduler.add(req)
+            self._work.notify_all()
+        return req
+
+    def has_work(self):
+        with self._lock:
+            return self.scheduler.has_work()
+
+    def step(self):
+        """One engine iteration: schedule, prefill admissions, fused decode,
+        retire finished requests. Returns the requests that finished.
+
+        A failure escaping the step (device error, XLA crash) aborts the
+        engine before re-raising — the pool pages may have been donated
+        into the failed dispatch and cannot be trusted, so EVERY driver
+        (run_loop, :meth:`generate`, bench/step-polling loops) gets the
+        same contract: pending requests fail loudly, waiters wake, later
+        submits refuse."""
+        try:
+            with self._lock, telemetry.span("serving.step"):
+                plan = self.scheduler.schedule()
+                failed = self._drain_failed()
+                if plan.empty():
+                    return failed
+                for req in plan.prefills:
+                    self._run_prefill(req)
+                if plan.prefills:
+                    # a prompt that exactly filled its blocks writes its
+                    # first decode token at a fresh block boundary — back
+                    # that slot with a real block NOW or the write lands in
+                    # trash and the position's K/V is silently lost
+                    self.scheduler.ensure_decode_headroom()
+                    failed += self._drain_failed()
+                decodes = self.scheduler.decodable()
+                if decodes:
+                    self._run_decode(decodes)
+                finished = [r for r in list(self.scheduler.running)
+                            if r.finished()]
+                for req in finished:
+                    self.scheduler.finish(req)
+                    self._retire(req)
+                self._steps += 1
+                self._refresh_throughput()
+                return finished + failed
+        except Exception as exc:
+            self.abort(exc)
+            raise
+
+    def run_loop(self, stop_event=None, idle_wait_s=0.05):
+        """Drive :meth:`step` until ``stop_event`` is set, sleeping on the
+        submit condition while idle (the serve.py driver thread).
+
+        A step failure must not strand clients blocked on their
+        ``done_event`` forever behind a silently dead driver: ``step()``
+        itself aborts the engine — every queued + running request is
+        FAILED with the error and woken, later submits refuse — and the
+        re-raise propagates here so the driver thread's death is
+        observable (``Thread.is_alive()`` backs serve.py's
+        ``/healthz``)."""
+        while stop_event is None or not stop_event.is_set():
+            with self._work:
+                if not self.scheduler.has_work():
+                    # idle steps never run, so decay the sliding
+                    # tokens/sec window here or it freezes at its last
+                    # loaded value on a quiet server
+                    self._refresh_throughput()
+                    self._work.wait(timeout=idle_wait_s)
+                    if not self.scheduler.has_work():
+                        continue
+            self.step()
+
+    def abort(self, exc):
+        """Fail every queued and running request (the driver died mid-
+        step, or the caller is shutting down hard). After an abort the
+        engine refuses new submits — the pool pages may have been donated
+        into the failed dispatch and cannot be trusted."""
+        msg = "serving engine aborted: %r" % (exc,)
+        with self._lock:
+            self._aborted = msg
+            self._drain_failed()   # scheduler failures the step never saw
+            reqs = list(self.scheduler.running) + list(self.scheduler.waiting)
+            self.scheduler.running.clear()
+            self.scheduler.waiting.clear()
+            for req in reqs:
+                req.blocks = []   # pool accounting is moot post-abort
+                req.state = FAILED
+                req.error = msg
+                req.finish_t = time.time()
+                telemetry.counter("serving.requests_failed").inc()
+                if req.done_event is not None:
+                    req.done_event.set()
+            self._finished.extend(reqs)
+            self._n_failed += len(reqs)
+
+    def warmup(self):
+        """Compile every prefill length bucket and decode batch bucket in
+        one pass (one throwaway dispatch each, all-trash block tables, no
+        requests involved) so the first real traffic pays zero compile
+        wall and the steady-state compile count is flat from step one."""
+        cfg = self.config
+        with self._lock:
+            for S in cfg.prefill_buckets():
+                toks = np.zeros((1, S), np.int32)
+                table = np.zeros(S // cfg.block_size, np.int32)
+                _t, _l, kp, vp = self._prefill_fn(
+                    self.params, toks, np.int32(1), table,
+                    self.pool.k_pages, self.pool.v_pages)
+                self.pool.k_pages, self.pool.v_pages = kp, vp
+            for B in cfg.decode_buckets():
+                toks = np.zeros(B, np.int32)
+                poss = np.zeros(B, np.int32)
+                tables = np.zeros((B, self._nb_max), np.int32)
+                ctx = np.ones(B, np.int32)
+                _t, _l, kp, vp = self._decode_fn(
+                    self.params, toks, poss, tables, ctx,
+                    self.pool.k_pages, self.pool.v_pages)
+                self.pool.k_pages, self.pool.v_pages = kp, vp
+
+    def generate(self, prompts, max_new_tokens, eos_id=None):
+        """Convenience batch API: submit every prompt, drive steps until
+        all finish, return each request's generated tokens (in input
+        order). Raises if any request failed."""
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * len(prompts)
+        reqs = [self.submit(p, n, eos_id=eos_id)
+                for p, n in zip(prompts, max_new_tokens)]
+        while any(not r.finished() for r in reqs):
+            self.step()
+        failed = [r for r in reqs if r.state == FAILED]
+        if failed:
+            raise RuntimeError("requests failed: %s"
+                               % [(r.rid, r.error) for r in failed])
+        return [list(r.generated) for r in reqs]
+
+    def pop_finished(self):
+        """Drain every request retired since the last call — FINISHED and
+        FAILED both (check ``req.state``/``req.error``); a polling driver
+        must never lose a request to a silent scheduler-side failure.
+        The backlog is bounded (``max(256, 8 * max_batch)``) so drivers
+        that consume ``done_event`` instead of polling don't accumulate
+        one retired Request per call served — drain at least once per
+        step to observe every retiree."""
+        with self._lock:
+            out = list(self._finished)
+            self._finished.clear()
+            return out
+
+    def _drain_failed(self):
+        """Scheduler-failed requests surface through the same channels as
+        successes: appended to the ``pop_finished()`` queue and returned
+        from :meth:`step`. ``_fail`` already stamped ``finish_t``, bumped
+        ``serving.requests_failed`` and woke the ``done_event``."""
+        failed = self.scheduler.pop_failed()
+        self._finished.extend(failed)
+        self._n_failed += len(failed)
+        return failed
+
+    # ------------------------------------------------------------ internals
+    def _table_row(self, req, width):
+        # the admission grant includes the first decode slot's headroom
+        # block, so a boundary-length replay holds one block more than its
+        # prefill bucket's table width — clip; prefill never reads it
+        row = np.zeros(width, np.int32)
+        n = min(len(req.blocks), width)
+        row[:n] = req.blocks[:n]
+        return row
+
+    def _run_prefill(self, req):
+        cfg = self.config
+        replay = req.replay_tokens()
+        L = len(replay)
+        S = _bucket_for(L, cfg.prefill_buckets())
+        toks = np.zeros((1, S), np.int32)
+        toks[0, :L] = replay
+        table = self._table_row(req, S // cfg.block_size)
+        t0 = time.time()
+        tok, _logits, kp, vp = self._prefill_fn(
+            self.params, toks, np.int32(L), table,
+            self.pool.k_pages, self.pool.v_pages)
+        self.pool.k_pages, self.pool.v_pages = kp, vp
+        # the per-step token egress: serving's output IS this transfer
+        tok = int(np.asarray(tok)[0])  # fwlint: disable=host-sync-in-hot-path — token egress to the client is the product, one scalar per prefill
+        telemetry.histogram("serving.prefill_seconds").observe(
+            time.time() - t0)
+        telemetry.counter("serving.prefill_tokens").inc(L)
+        req.context_len = L
+        req.state = DECODING
+        if req.pending_token is None:
+            # fresh prompt: the prefill's greedy token is the first output
+            self._note_token(req, tok)
+        # else: preemption replay — the pending token was already produced
+        # (greedy replay recomputes the same cache; tok == pending_token)
+
+    def _run_decode(self, reqs):
+        cfg = self.config
+        B = _bucket_for(len(reqs), cfg.decode_buckets())
+        toks = np.zeros(B, np.int32)
+        poss = np.zeros(B, np.int32)
+        tables = np.zeros((B, self._nb_max), np.int32)
+        ctx = np.ones(B, np.int32)
+        for i, req in enumerate(reqs):
+            toks[i] = req.pending_token
+            poss[i] = req.context_len
+            tables[i] = self._table_row(req, self._nb_max)
+            ctx[i] = req.context_len + 1
+        nxt, _logits, kp, vp = self._decode_fn(
+            self.params, toks, poss, tables, ctx,
+            self.pool.k_pages, self.pool.v_pages)
+        self.pool.k_pages, self.pool.v_pages = kp, vp
+        # the fused step's single device->host sync: the next-token vector
+        nxt = np.asarray(nxt)  # fwlint: disable=host-sync-in-hot-path — token egress to clients is the product, B int32s per step
+        telemetry.histogram("serving.decode_batch").observe(len(reqs))
+        for i, req in enumerate(reqs):
+            req.context_len += 1
+            self._note_token(req, int(nxt[i]))
+
+    def _note_token(self, req, tok):
+        now = time.time()
+        if req.first_token_t is None:
+            req.first_token_t = now
+            telemetry.histogram("serving.ttft_seconds").observe(
+                now - req.arrival_t)
+        req.generated.append(tok)
+        req.pending_token = tok
+        self._tokens_total += 1
+        self._token_window.append(now)
+        telemetry.counter("serving.generated_tokens").inc()
+        if (len(req.generated) >= req.max_new_tokens
+                or (req.eos_id is not None and tok == req.eos_id)):
+            req.state = FINISHED
+            req.pending_token = None
+
+    def _retire(self, req):
+        req.finish_t = time.time()
+        telemetry.histogram("serving.request_latency_seconds").observe(
+            req.finish_t - req.arrival_t)
+        telemetry.counter("serving.requests_completed").inc()
+        self._n_completed += 1
+        self._finished.append(req)
+        if req.done_event is not None:
+            req.done_event.set()
+
+    def _refresh_throughput(self, window_s=10.0):
+        now = time.time()
+        cut = now - window_s
+        w = self._token_window = [t for t in self._token_window if t >= cut]
+        span = now - max(cut, self._t_started)
+        telemetry.gauge("serving.tokens_per_sec").set(
+            len(w) / span if span > 0 else 0.0)
+
+    # ------------------------------------------------------------ stats
+    def stats(self):
+        """One dashboard snapshot (serve.py columns, /stats endpoint).
+
+        Counts (completed/failed/preemptions) are THIS engine's; the
+        latency/TTFT percentiles read the process-global registry
+        histograms, which merge traffic across engines when several share
+        a process (one engine per process in every shipped front end)."""
+        with self._lock:
+            self._refresh_throughput()   # a stale window must read as 0
+            lat = telemetry.histogram("serving.request_latency_seconds")
+            ttft = telemetry.histogram("serving.ttft_seconds")
+            prog = {p["program"]: p for p in compileobs.program_table()
+                    if p["program"].startswith("serving.")}
+            return {
+                "steps": self._steps,
+                "waiting": len(self.scheduler.waiting),
+                "active": len(self.scheduler.running),
+                "kv_blocks_total": self.pool.num_usable,
+                "kv_blocks_used": self.pool.used(),
+                "kv_blocks_frag_slots": int(telemetry.gauge(
+                    "serving.kv_blocks_frag_slots").value),
+                "kv_pool_bytes": self.pool.nbytes(),
+                "tokens_total": self._tokens_total,
+                "tokens_per_sec":
+                    telemetry.gauge("serving.tokens_per_sec").value,
+                "latency_p50_s": lat.percentile(50),
+                "latency_p99_s": lat.percentile(99),
+                "ttft_p50_s": ttft.percentile(50),
+                "ttft_p99_s": ttft.percentile(99),
+                "preemptions": self.scheduler.preempt_count,
+                "completed": self._n_completed,
+                "failed": self._n_failed,
+                "compiles": {n: {"count": p["compile_count"],
+                                 "seconds": round(p["compile_seconds"], 3),
+                                 "runs": p["run_count"]}
+                             for n, p in prog.items()},
+            }

@@ -1,0 +1,135 @@
+"""Block-paged KV-cache pool: the serving-side replacement for the
+per-executor contiguous cache.
+
+The contiguous cached decoder (``_contrib_CachedMultiHeadAttention``) gives
+every stream a private ``(max_len, heads, head_dim)`` cache per layer —
+serving N streams costs N full-length caches whether a stream holds 4 tokens
+or 4096. Here all streams share ONE device pool of fixed-size blocks
+(``block_size`` token slots each); a per-request block table names which
+pool blocks hold the request's tokens, in position order. Device memory
+scales with tokens actually cached, admission is a free-list pop, and
+release is O(blocks) with zero copying.
+
+Layout (one pool per engine): ``(num_layers, num_blocks, block_size,
+num_heads, head_dim)`` for K and V. Block 0 is the reserved TRASH block —
+padded table entries and padded batch rows point at it, so masked lanes of
+a bucketed step scatter their garbage somewhere no reader ever trusts
+(readers mask by context length; the pool hands block 0 to no request).
+
+Fragmentation accounting: fixed-size blocks make external fragmentation
+impossible by construction (any free block serves any request), so "defrag"
+reduces to accounting for INTERNAL fragmentation — allocated-but-unused
+slots in each request's tail block — exposed as the
+``serving.kv_blocks_frag_slots`` gauge (the engine refreshes it each step).
+"""
+import threading
+
+import numpy as np
+
+from .. import telemetry
+from ..base import MXNetError
+
+
+class KVCacheOOM(MXNetError):
+    """The block pool cannot satisfy an allocation (classified so the
+    scheduler can preempt / the engine can fail the request instead of
+    dying inside a step)."""
+
+
+class KVBlockPool:
+    """Device KV block pool + thread-safe host-side free-list allocator."""
+
+    def __init__(self, num_layers, num_blocks, block_size, num_heads,
+                 head_dim, dtype=np.float32, device=None):
+        if num_blocks < 2:
+            raise ValueError("KVBlockPool needs >= 2 blocks (block 0 is the "
+                             "reserved trash block)")
+        import jax.numpy as jnp
+
+        self.num_layers = int(num_layers)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = np.dtype(dtype)
+        shape = (self.num_layers, self.num_blocks, self.block_size,
+                 self.num_heads, self.head_dim)
+        k = jnp.zeros(shape, self.dtype)
+        v = jnp.zeros(shape, self.dtype)
+        if device is not None:
+            import jax
+
+            k = jax.device_put(k, device)
+            v = jax.device_put(v, device)
+        #: the device pages; the engine REPLACES these after every jitted
+        #: prefill/decode call (the arrays are donated into the step)
+        self.k_pages = k
+        self.v_pages = v
+        self._lock = threading.Lock()
+        # LIFO free list, block 0 excluded (trash)
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        telemetry.gauge("serving.kv_blocks_total").set(self.num_usable)
+        self._refresh_gauges_locked()
+
+    # ---- capacity -------------------------------------------------------
+    @property
+    def num_usable(self):
+        """Allocatable blocks (pool size minus the trash block)."""
+        return self.num_blocks - 1
+
+    def available(self):
+        with self._lock:
+            return len(self._free)
+
+    def used(self):
+        with self._lock:
+            return self.num_usable - len(self._free)
+
+    def nbytes(self):
+        """Device bytes the pool pins (K + V)."""
+        per = (self.num_layers * self.num_blocks * self.block_size
+               * self.num_heads * self.head_dim * self.dtype.itemsize)
+        return 2 * per
+
+    def blocks_for(self, num_tokens):
+        """Blocks needed to hold ``num_tokens`` cache slots."""
+        return -(-int(num_tokens) // self.block_size)
+
+    # ---- alloc / free ---------------------------------------------------
+    def alloc(self, n):
+        """Pop ``n`` blocks off the free list; raises :class:`KVCacheOOM`
+        (allocating nothing) when fewer than ``n`` are free."""
+        n = int(n)
+        with self._lock:
+            if n > len(self._free):
+                telemetry.counter("serving.kv_blocks_alloc_failures").inc()
+                raise KVCacheOOM(
+                    "KV block pool exhausted: want %d blocks, %d free of %d "
+                    "usable (%d-token slots each)"
+                    % (n, len(self._free), self.num_usable, self.block_size))
+            got = [self._free.pop() for _ in range(n)]
+            telemetry.counter("serving.kv_blocks_allocs").inc(n)
+            self._refresh_gauges_locked()
+            return got
+
+    def free(self, blocks):
+        """Return blocks to the pool. Double-free and trash-free are hard
+        errors — the accounting gauges must never drift."""
+        blocks = list(blocks)
+        with self._lock:
+            freed = set(self._free)
+            for b in blocks:
+                b = int(b)
+                if b <= 0 or b >= self.num_blocks:
+                    raise ValueError("free of invalid block id %d" % b)
+                if b in freed:
+                    raise ValueError("double free of block %d" % b)
+                self._free.append(b)
+                freed.add(b)
+            telemetry.counter("serving.kv_blocks_frees").inc(len(blocks))
+            self._refresh_gauges_locked()
+
+    def _refresh_gauges_locked(self):
+        telemetry.gauge("serving.kv_blocks_used").set(
+            self.num_usable - len(self._free))
+        telemetry.gauge("serving.kv_blocks_free").set(len(self._free))

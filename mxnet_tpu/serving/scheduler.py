@@ -1,0 +1,290 @@
+"""Continuous-batching scheduler: admission queue, per-request state
+machine, FCFS prefill/decode mixing, block-exhaustion preemption.
+
+State machine (one :class:`Request` each)::
+
+    WAITING --admit/alloc--> PREFILL --first token--> DECODING
+       ^                                                 |
+       |<------------- preempt (blocks exhausted) -------|
+                                                         v
+                                      FINISHED (len/eos) or FAILED
+
+Each engine step the scheduler produces one :class:`StepPlan`:
+
+* **ensure** — every DECODING request gets a pool block for its next slot;
+  when the pool is dry the LATEST-admitted decoding request is preempted
+  (its blocks freed, its tokens-so-far requeued at the HEAD of the waiting
+  queue for deterministic re-prefill) until the older ones fit. FCFS both
+  ways: oldest requests never starve behind younger ones.
+* **admit** — waiting requests are admitted head-first while the batch cap,
+  the per-step prefill budget, and the free list allow; the queue head
+  blocks admission when its prompt doesn't fit (no skip-ahead — a short
+  prompt can never overtake a long one, which is the fairness contract
+  tests pin down).
+
+Preemption is recompute-style (vLLM's recompute mode): a victim's
+generated-so-far tokens become its new prompt; greedy decoding makes the
+replay bit-deterministic, so preemption is invisible in the output stream.
+"""
+import itertools
+import time
+from collections import deque
+
+from .. import telemetry
+from .kv_cache import KVCacheOOM
+
+WAITING = "waiting"
+PREFILL = "prefill"
+DECODING = "decoding"
+FINISHED = "finished"
+FAILED = "failed"
+
+_rid_counter = itertools.count()
+
+
+class Request:
+    """One generation request and its serving-side state."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "state",
+                 "blocks", "context_len", "generated", "pending_token",
+                 "arrival_t", "admitted_t", "first_token_t", "finish_t",
+                 "preemptions", "error", "done_event")
+
+    def __init__(self, prompt, max_new_tokens, eos_id=None, rid=None):
+        self.rid = rid if rid is not None else next(_rid_counter)
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError("empty prompt (the decoder needs a seed token)")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.state = WAITING
+        self.blocks = []          # pool block ids, position order
+        self.context_len = 0      # tokens currently cached in the pool
+        self.generated = []       # tokens produced so far (output stream)
+        self.pending_token = None  # last generated token, not yet cached
+        self.arrival_t = time.time()
+        self.admitted_t = None
+        self.first_token_t = None
+        self.finish_t = None
+        self.preemptions = 0
+        self.error = None
+        self.done_event = None    # engine attaches for blocking consumers
+
+    # tokens that must be in the KV cache for the next decode step
+    def replay_tokens(self):
+        """Prompt + generated-but-cached tokens: re-prefilling exactly these
+        reconstructs the preempted request's cache state."""
+        gen_cached = self.generated[:-1] if self.pending_token is not None \
+            else self.generated
+        return self.prompt + gen_cached
+
+    @property
+    def num_new_tokens(self):
+        return len(self.generated)
+
+    def finished(self):
+        return self.state in (FINISHED, FAILED)
+
+    def __repr__(self):
+        return ("Request(rid=%s, state=%s, prompt=%d, generated=%d, ctx=%d, "
+                "blocks=%d)" % (self.rid, self.state, len(self.prompt),
+                                len(self.generated), self.context_len,
+                                len(self.blocks)))
+
+
+class StepPlan:
+    """One engine step's work: requests to prefill (newly admitted or
+    preempt-replayed) and requests to run the fused decode over."""
+
+    __slots__ = ("prefills", "decodes", "preempted")
+
+    def __init__(self, prefills, decodes, preempted):
+        self.prefills = prefills
+        self.decodes = decodes
+        self.preempted = preempted
+
+    def empty(self):
+        return not (self.prefills or self.decodes)
+
+
+class Scheduler:
+    """FCFS continuous-batching scheduler over one :class:`KVBlockPool`."""
+
+    def __init__(self, pool, max_batch=32, prefills_per_step=4):
+        self.pool = pool
+        self.max_batch = int(max_batch)
+        self.prefills_per_step = int(prefills_per_step)
+        self.waiting = deque()
+        self.running = []          # admission order (oldest first)
+        self.failed = []           # _fail victims awaiting engine drain
+        self.preempt_count = 0     # this scheduler only (the registry
+                                   # counter is process-global)
+
+    # ---- intake ---------------------------------------------------------
+    def add(self, req):
+        """Enqueue a WAITING request (engine validates capacity first)."""
+        self.waiting.append(req)
+        self._refresh_gauges()
+
+    def has_work(self):
+        return bool(self.waiting or self.running)
+
+    # ---- the per-step plan ---------------------------------------------
+    def schedule(self):
+        """Build this step's :class:`StepPlan`; mutates request states and
+        the pool free list (alloc for admissions and next-slot headroom,
+        free for preemption victims)."""
+        preempted = self.ensure_decode_headroom()
+        prefills = self._admit(preempted)
+        self._refresh_gauges()
+        return StepPlan(prefills, self.decodable(), preempted)
+
+    def decodable(self):
+        """Streams the fused decode step advances this iteration. The
+        engine re-reads this AFTER running prefills (fresh admissions
+        become decodable mid-step) — one definition, two call points."""
+        return [r for r in self.running if r.state == DECODING
+                and r.pending_token is not None]
+
+    def ensure_decode_headroom(self):
+        """Every DECODING request needs its next write slot backed by a
+        block. Pool dry -> preempt youngest-admitted victims (never a
+        request older than the one we are ensuring).
+
+        Called twice per engine step: inside :meth:`schedule` for streams
+        already decoding, and again by the engine after prefills — a
+        prompt that exactly fills its blocks writes its FIRST decode
+        token at a fresh block boundary, and without the second pass that
+        write would land in the trash block and the position's K/V would
+        be silently lost (outputs then drift from sequential decoding)."""
+        preempted = []
+        for req in list(self.running):
+            # a victim preempted earlier this pass is WAITING now, so the
+            # state check also skips members the loop snapshot still holds
+            if req.state != DECODING or req.pending_token is None:
+                continue
+            need_idx = req.context_len // self.pool.block_size
+            while need_idx >= len(req.blocks):
+                try:
+                    req.blocks.extend(self.pool.alloc(1))
+                except KVCacheOOM:
+                    # evict the YOUNGEST decoding stream — possibly req
+                    # itself (a younger request never steals blocks from
+                    # an older one: FCFS both ways)
+                    victim = self._pick_victim()
+                    if victim is None or (victim is req
+                                          and len(self.running) == 1):
+                        # alone and still dry: the pool cannot hold this
+                        # request at all — fail it, never wedge the engine
+                        self._fail(req, "KV pool too small for request: "
+                                        "%d blocks held, next slot needs "
+                                        "one more and nothing is evictable"
+                                   % len(req.blocks))
+                        break
+                    self._preempt(victim)
+                    preempted.append(victim)
+                    if victim is req:
+                        break
+        return preempted
+
+    def _pick_victim(self):
+        for req in reversed(self.running):   # youngest admission first
+            if req.state == DECODING:
+                return req
+        return None
+
+    def _preempt(self, req):
+        """Recompute-style preemption: free the blocks, requeue at the
+        HEAD of the waiting queue with tokens-so-far as the new replay
+        prompt (greedy decode makes the replay deterministic)."""
+        self.running.remove(req)
+        if req.blocks:
+            self.pool.free(req.blocks)
+            req.blocks = []
+        req.context_len = 0
+        req.state = WAITING
+        req.preemptions += 1
+        self.preempt_count += 1
+        telemetry.counter("serving.preemptions").inc()
+        self.waiting.appendleft(req)
+
+    def _fail(self, req, msg):
+        if req in self.running:   # admission-time failures never joined
+            self.running.remove(req)
+        if req.blocks:
+            self.pool.free(req.blocks)
+            req.blocks = []
+        req.state = FAILED
+        req.error = msg
+        req.finish_t = time.time()
+        telemetry.counter("serving.requests_failed").inc()
+        self.failed.append(req)
+        if req.done_event is not None:
+            req.done_event.set()
+
+    def _admit(self, preempted=()):
+        """FCFS head-first admission into PREFILL, bounded by the batch
+        cap, the per-step prefill budget, and the free list. The
+        admission grant covers the replay tokens PLUS the first decode
+        token's write slot — without that headroom a boundary-length
+        prompt prefills, loses the decode-slot race to the next
+        admission, and thrashes prefill->preempt every step on a tight
+        pool. The head blocks the queue when it doesn't fit: no
+        skip-ahead. A head the pool could never hold even when empty is
+        failed outright (wedging the queue behind it forever serves no
+        one). A request preempted THIS pass sits the step out —
+        re-admitting it at once would re-grab the blocks the eviction
+        just reclaimed."""
+        prefills = []
+        while (self.waiting and len(self.running) < self.max_batch
+               and len(prefills) < self.prefills_per_step):
+            req = self.waiting[0]
+            if req in preempted:
+                break
+            replay = req.replay_tokens()
+            need = self.pool.blocks_for(len(replay) + 1)
+            if need > self.pool.num_usable:
+                self.waiting.popleft()
+                self._fail(req, "KV pool too small for request: needs %d "
+                                "blocks (replay + first decode slot), pool "
+                                "holds %d usable"
+                           % (need, self.pool.num_usable))
+                continue
+            if need > self.pool.available():
+                break
+            self.waiting.popleft()
+            req.blocks = self.pool.alloc(need)
+            req.state = PREFILL
+            req.admitted_t = time.time()
+            self.running.append(req)
+            telemetry.counter("serving.requests_admitted").inc()
+            prefills.append(req)
+        return prefills
+
+    def pop_failed(self):
+        """Drain requests FAILED by the scheduler itself (pool too small,
+        nothing evictable). The engine routes these through the same
+        public completion channels as successes — ``step()``'s return and
+        ``pop_finished()`` — so a polling driver can't miss a failure."""
+        out, self.failed = self.failed, []
+        return out
+
+    # ---- completion (engine calls after a step's device work) ----------
+    def finish(self, req):
+        """Retire a FINISHED/FAILED request and release its blocks."""
+        if req in self.running:
+            self.running.remove(req)
+        if req.blocks:
+            self.pool.free(req.blocks)
+            req.blocks = []
+        self._refresh_gauges()
+
+    def _refresh_gauges(self):
+        telemetry.gauge("serving.queue_depth").set(len(self.waiting))
+        telemetry.gauge("serving.active_requests").set(len(self.running))
+        # internal fragmentation: allocated-but-unused tail-block slots
+        frag = sum(len(r.blocks) * self.pool.block_size - r.context_len
+                   for r in self.running)
+        telemetry.gauge("serving.kv_blocks_frag_slots").set(frag)

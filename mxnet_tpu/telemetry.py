@@ -657,6 +657,36 @@ METRIC_HELP = {
         "failed guard mid-epoch checkpoint writes (always-on)",
     "fault.injections": "fired fault-injection rules by point (always-on)",
     "bench.imgs_per_sec": "bench.py headline throughput",
+    "serving.kv_blocks_total": "usable KV pool blocks (pool size minus the "
+                               "reserved trash block)",
+    "serving.kv_blocks_used": "KV pool blocks currently allocated to "
+                              "requests",
+    "serving.kv_blocks_free": "KV pool blocks on the free list",
+    "serving.kv_blocks_frag_slots":
+        "internal fragmentation: allocated-but-unused tail-block token "
+        "slots across running requests",
+    "serving.kv_blocks_allocs": "KV pool blocks handed out (cumulative)",
+    "serving.kv_blocks_frees": "KV pool blocks returned (cumulative)",
+    "serving.kv_blocks_alloc_failures":
+        "KV pool allocations refused for exhaustion (each triggers "
+        "preemption or request failure) (always-on)",
+    "serving.queue_depth": "requests waiting for admission",
+    "serving.active_requests": "requests admitted and holding KV blocks",
+    "serving.requests_admitted": "requests admitted into prefill",
+    "serving.requests_completed": "requests finished successfully",
+    "serving.requests_failed":
+        "requests failed (pool too small / engine error) (always-on)",
+    "serving.preemptions":
+        "recompute-style evictions under KV-block exhaustion (always-on)",
+    "serving.step": "serving engine step wall (span histogram)",
+    "serving.prefill_seconds": "per-request prefill dispatch wall",
+    "serving.prefill_tokens": "prompt+replay tokens prefilled",
+    "serving.decode_batch": "live streams per fused decode step",
+    "serving.generated_tokens": "tokens generated across all streams",
+    "serving.ttft_seconds": "request time-to-first-token",
+    "serving.request_latency_seconds": "request end-to-end latency",
+    "serving.tokens_per_sec":
+        "generated tokens/sec over a sliding 10s window",
 }
 
 

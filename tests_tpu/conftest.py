@@ -25,7 +25,7 @@ _HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
                     "test_pipeline_feed.py", "test_guard.py",
                     "test_analysis.py", "test_elastic.py",
                     "test_cluster_obs.py", "test_native_decode.py",
-                    "test_compileobs.py"}
+                    "test_compileobs.py", "test_serving.py"}
 
 
 def pytest_configure(config):
@@ -41,6 +41,8 @@ def pytest_configure(config):
         "markers", "analysis: fwlint / engine-sanitizer tests (host-only)")
     config.addinivalue_line(
         "markers", "elastic: elastic-membership / reshard tests (host-only)")
+    config.addinivalue_line(
+        "markers", "serving: paged-KV serving-engine tests (host-only)")
     config.addinivalue_line("markers", "slow: long-running tests")
 
 

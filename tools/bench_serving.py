@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Serving benchmark: the inference headline beside bench.py's training one.
+
+Drives the paged-KV continuous-batching engine (docs/serving.md) offline —
+no HTTP, no network jitter — over a seeded synthetic workload of
+variable-length prompts, and emits ONE JSON record (BENCH idiom):
+
+* ``decode_tokens_per_sec`` — generated tokens per second of engine wall
+  (headline; read back from the ``serving.tokens_per_sec``-adjacent
+  counters so the registry and the record can never disagree)
+* request latency p50/p99 and TTFT p50/p99 (telemetry histograms)
+* ``max_concurrent_streams`` — how many average-length streams the KV
+  block pool can hold at the configured HBM budget (pool bytes), plus the
+  measured peak in-flight count
+* the compileobs summary: bucket-warmup compiles vs steady-state runs —
+  a recompile sneaking into the timed window is visible in the record
+
+Example (CPU smoke):
+
+    JAX_PLATFORMS=cpu python tools/bench_serving.py \\
+        --requests 16 --max-new 8 --num-layers 2 --model-dim 64
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    import numpy as np
+
+    ap = argparse.ArgumentParser(description="paged-serving benchmark")
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--model-dim", type=int, default=64)
+    ap.add_argument("--num-heads", type=int, default=2)
+    ap.add_argument("--ffn-dim", type=int, default=128)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--block-size", type=int, default=None)
+    ap.add_argument("--num-blocks", type=int, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--kv-dtype", default="float32")
+    ap.add_argument("--requests", type=int, default=32,
+                    help="concurrent variable-length requests")
+    ap.add_argument("--max-new", type=int, default=16,
+                    help="tokens generated per request")
+    ap.add_argument("--prompt-min", type=int, default=1)
+    ap.add_argument("--prompt-max", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu import compileobs, telemetry
+    from mxnet_tpu.serving import ServingConfig, ServingEngine
+
+    cfg = ServingConfig(
+        vocab_size=args.vocab, num_layers=args.num_layers,
+        model_dim=args.model_dim, num_heads=args.num_heads,
+        ffn_dim=args.ffn_dim, max_len=args.max_len,
+        block_size=args.block_size, num_blocks=args.num_blocks,
+        max_batch=args.max_batch, kv_dtype=np.dtype(args.kv_dtype))
+    engine = ServingEngine(cfg, seed=args.seed)
+
+    rng = np.random.RandomState(args.seed)
+    if args.prompt_min < 1:
+        ap.error("--prompt-min must be >= 1 (the decoder needs a seed token)")
+    pmax = min(args.prompt_max, cfg.max_len - args.max_new)
+    if pmax < args.prompt_min:
+        ap.error(
+            "--max-new %d leaves room for prompts of at most %d tokens "
+            "(--max-len %d bounds prompt+generation), below --prompt-min %d"
+            % (args.max_new, max(cfg.max_len - args.max_new, 0),
+               cfg.max_len, args.prompt_min))
+    prompts = [[int(t) for t in rng.randint(0, cfg.vocab_size,
+                                            rng.randint(args.prompt_min,
+                                                        pmax + 1))]
+               for _ in range(args.requests)]
+
+    # warmup: compile EVERY shape bucket outside the timed window, without
+    # submitting requests — the latency/TTFT histograms the record reads
+    # must hold only timed-window samples, never the compile wall
+    t0 = time.time()
+    engine.warmup()
+    warmup_s = time.time() - t0
+
+    reqs = [engine.submit(p, args.max_new) for p in prompts]
+    peak_inflight = 0
+    t0 = time.time()
+    while any(not r.finished() for r in reqs):
+        engine.step()
+        peak_inflight = max(peak_inflight, len(engine.scheduler.running))
+    wall = time.time() - t0
+
+    gen_tokens = sum(len(r.generated) for r in reqs)
+    lat = telemetry.histogram("serving.request_latency_seconds")
+    ttft = telemetry.histogram("serving.ttft_seconds")
+    pool = engine.pool
+    avg_stream_tokens = (sum(len(p) for p in prompts) / len(prompts)
+                         + args.max_new)
+    rec = {
+        "metric": "serving_decode_tokens_per_sec",
+        "value": round(gen_tokens / wall, 2),
+        "unit": "tokens/sec",
+        "requests": args.requests,
+        "generated_tokens": gen_tokens,
+        "wall_s": round(wall, 3),
+        "warmup_s": round(warmup_s, 3),
+        "latency_p50_s": round(lat.percentile(50), 4),
+        "latency_p99_s": round(lat.percentile(99), 4),
+        "ttft_p50_s": round(ttft.percentile(50), 4),
+        "ttft_p99_s": round(ttft.percentile(99), 4),
+        "preemptions": telemetry.counter("serving.preemptions").value,
+        "kv_pool_bytes": pool.nbytes(),
+        "kv_blocks": pool.num_usable,
+        "block_size": pool.block_size,
+        # capacity at this HBM budget: blocks bound the streams the pool
+        # can hold at once (avg prompt + full generation per stream;
+        # blocks_for truncates fractional tokens, so ceil first or the
+        # headline overstates capacity past every block boundary)
+        "max_concurrent_streams":
+            int(pool.num_usable
+                // pool.blocks_for(int(np.ceil(avg_stream_tokens)))),
+        "peak_inflight": peak_inflight,
+        "compile": compileobs.summary(include_recompiles=False),
+    }
+    print(json.dumps(rec))
+    return rec
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Standing HTTP/JSON inference server over mxnet_tpu.serving.
+
+The minimal front end for the paged-KV continuous-batching engine
+(docs/serving.md): one engine-driver thread runs the step loop, HTTP
+handler threads submit requests and block on their completion events —
+continuous batching means N in-flight requests share every decode step.
+
+    python tools/serve.py --num-layers 2 --model-dim 64 --vocab 256 &
+    curl -d '{"tokens": [5, 6, 7], "max_new_tokens": 8}' \\
+        http://127.0.0.1:8090/generate
+
+Endpoints:
+  POST /generate  {"tokens": [int...], "max_new_tokens": N,
+                   "eos_id": optional int}
+                  -> {"tokens": [int...], "ttft_s": float,
+                      "latency_s": float, "preemptions": int}
+  GET  /stats     engine snapshot (queue/blocks/latency/compiles) as JSON
+  GET  /metrics   Prometheus text exposition of the telemetry registry
+  GET  /healthz   {"ok": true}
+
+Weights come from --checkpoint PREFIX --epoch N (a trained Transformer-LM
+checkpoint; shapes must match the --num-layers/--model-dim/... flags) or,
+when omitted, from the deterministic seeded initializer — byte-identical
+across processes for a given --seed, which is what the serving e2e test
+leans on to compare this server against an in-process oracle.
+
+--top renders mxtop-style live stat columns to stderr once a second:
+
+    reqs  act wait |  kv blocks used/total  frag | tok/s  ttft p50/p99  lat p50/p99
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_engine(args):
+    import numpy as np
+
+    from mxnet_tpu.serving import ServingConfig, ServingEngine
+
+    cfg = ServingConfig(
+        vocab_size=args.vocab, num_layers=args.num_layers,
+        model_dim=args.model_dim, num_heads=args.num_heads,
+        ffn_dim=args.ffn_dim, max_len=args.max_len,
+        block_size=args.block_size, num_blocks=args.num_blocks,
+        max_batch=args.max_batch,
+        kv_dtype=np.dtype(args.kv_dtype))
+    arg_params = None
+    if args.checkpoint:
+        from mxnet_tpu import model as mxmodel
+
+        _sym, arg_params, _aux = mxmodel.load_checkpoint(args.checkpoint,
+                                                         args.epoch)
+    return ServingEngine(cfg, arg_params=arg_params, seed=args.seed)
+
+
+def _columns(stats):
+    def ms(v):
+        return "--" if v is None else "%.0f" % (v * 1000.0)
+
+    return ("reqs %3d | act %3d wait %3d | kv %4d/%-4d frag %5d | "
+            "%6.1f tok/s | ttft %s/%s ms | lat %s/%s ms | steps %d"
+            % (stats["active"] + stats["waiting"], stats["active"],
+               stats["waiting"], stats["kv_blocks_used"],
+               stats["kv_blocks_total"],
+               int(stats.get("kv_blocks_frag_slots", 0)),
+               stats["tokens_per_sec"], ms(stats["ttft_p50_s"]),
+               ms(stats["ttft_p99_s"]), ms(stats["latency_p50_s"]),
+               ms(stats["latency_p99_s"]), stats["steps"]))
+
+
+def make_server(engine, host, port, driver=None):
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from mxnet_tpu import telemetry
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):  # quiet: telemetry is the log
+            pass
+
+        def _reply(self, code, body, ctype="application/json"):
+            data = body if isinstance(body, bytes) else \
+                json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                # a dead engine driver means every /generate would hang on
+                # its done_event — report it, don't claim healthy
+                ok = driver is None or driver.is_alive()
+                self._reply(200 if ok else 503, {"ok": ok})
+            elif self.path == "/stats":
+                self._reply(200, engine.stats())
+            elif self.path == "/metrics":
+                self._reply(200, telemetry.prometheus_text().encode(),
+                            ctype="text/plain; version=0.0.4")
+            else:
+                self._reply(404, {"error": "unknown path %s" % self.path})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._reply(404, {"error": "unknown path %s" % self.path})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                tokens = body["tokens"]
+                max_new = int(body["max_new_tokens"])
+                eos_id = body.get("eos_id")
+                req = engine.submit(tokens, max_new, eos_id=eos_id)
+            except (KeyError, TypeError, ValueError) as e:
+                self._reply(400, {"error": str(e)})
+                return
+            except RuntimeError as e:   # engine aborted: driver died
+                self._reply(503, {"error": str(e)})
+                return
+            req.done_event.wait()
+            if req.error is not None:
+                self._reply(503, {"error": req.error,
+                                  "preemptions": req.preemptions})
+                return
+            self._reply(200, {
+                "tokens": list(req.generated),
+                "ttft_s": round(req.first_token_t - req.arrival_t, 6),
+                "latency_s": round(req.finish_t - req.arrival_t, 6),
+                "preemptions": req.preemptions,
+            })
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None):
+    from mxnet_tpu.base import env_int
+
+    ap = argparse.ArgumentParser(
+        description="paged-KV continuous-batching LLM server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int,
+                    default=env_int("MXNET_SERVING_PORT", 8090))
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--model-dim", type=int, default=64)
+    ap.add_argument("--num-heads", type=int, default=2)
+    ap.add_argument("--ffn-dim", type=int, default=128)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--block-size", type=int, default=None)
+    ap.add_argument("--num-blocks", type=int, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--kv-dtype", default="float32")
+    ap.add_argument("--checkpoint", default=None,
+                    help="checkpoint prefix to serve (with --epoch)")
+    ap.add_argument("--epoch", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="deterministic init seed when no checkpoint")
+    ap.add_argument("--warmup", action="store_true",
+                    help="compile the shape buckets before listening "
+                         "(first real requests pay no compile wall)")
+    ap.add_argument("--top", action="store_true",
+                    help="render live stat columns to stderr")
+    args = ap.parse_args(argv)
+
+    engine = build_engine(args)
+    if args.warmup:
+        t0 = time.time()
+        engine.warmup()   # every prefill/decode shape bucket, one dispatch each
+        print("warmup: %.1fs" % (time.time() - t0), file=sys.stderr)
+
+    stop = threading.Event()
+    driver = threading.Thread(target=engine.run_loop, args=(stop,),
+                              name="serving-engine-driver", daemon=True)
+    driver.start()
+    if args.top:
+        def top():
+            while not stop.wait(1.0):
+                print(_columns(engine.stats()), file=sys.stderr)
+        threading.Thread(target=top, name="serving-top",
+                         daemon=True).start()
+
+    httpd = make_server(engine, args.host, args.port, driver=driver)
+    print("serving on http://%s:%d (pool: %d blocks x %d slots)"
+          % (args.host, args.port, engine.pool.num_usable,
+             engine.pool.block_size), flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()
+        httpd.server_close()
+
+
+if __name__ == "__main__":
+    main()
