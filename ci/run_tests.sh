@@ -253,14 +253,18 @@ run_elastic() {
 }
 
 run_lint() {
-  # framework-invariant analyzer (docs/static_analysis.md): AST checkers for
-  # the repo's hard-won invariants (env parsing, thread/lock hygiene,
-  # swallowed exceptions, host syncs in the step path). Ratchet: the
+  # framework-invariant analyzer (docs/static_analysis.md): AST + dataflow
+  # checkers for the repo's hard-won invariants (env parsing, thread/lock
+  # hygiene, swallowed exceptions, device escapes in the step path, trace
+  # purity, recompile hazards, whole-repo lock ordering). Ratchet: the
   # committed baseline freezes existing debt; only NEW violations fail.
-  # Prints per-rule counts. Stdlib-only (no jax import) and <5s.
-  python tools/fwlint.py --baseline ci/fwlint_baseline.json
-  # the analysis suite: checker positives/negatives, suppression + ratchet
-  # semantics, engine dependency-sanitizer warn/strict modes
+  # Prints per-rule counts; the machine-readable report lands at
+  # /tmp/fwlint_report.json (the CI artifact). Stdlib-only (no jax
+  # import) and <10s.
+  python tools/fwlint.py --baseline ci/fwlint_baseline.json \
+    --json-out /tmp/fwlint_report.json
+  # the analysis suite: checker positives/negatives, dataflow propagation,
+  # suppression + ratchet semantics, engine dependency-sanitizer modes
   JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_analysis.py \
     -q -m "not slow"
 }

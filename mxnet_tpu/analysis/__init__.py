@@ -4,9 +4,13 @@ Two halves (docs/static_analysis.md):
 
 * ``fwlint`` — an AST lint engine whose checkers each encode a bug class
   that actually shipped here (raw ``MXNET_*`` env parsing, fire-and-forget
-  threads, swallowed exceptions, lock discipline, host syncs in the step
-  path). CLI: ``tools/fwlint.py``; CI ratchets on ``ci/fwlint_baseline.json``
-  so existing debt is frozen and only *new* violations fail.
+  threads, swallowed exceptions, lock discipline/ordering, device escapes
+  in the step path, trace purity, recompile hazards). The dataflow-aware
+  checkers ride on ``dataflow.py`` (per-function device/per-step value
+  tracking with ``--explain``-able provenance chains) and ``lockgraph.py``
+  (the whole-repo lock-acquisition graph). CLI: ``tools/fwlint.py``; CI
+  ratchets on ``ci/fwlint_baseline.json`` so existing debt is frozen and
+  only *new* violations fail.
 * ``sanitizer`` — a runtime checker for the engine's dependency contracts
   (``MXNET_ENGINE_SANITIZER=warn|strict``): pushed functions are wrapped and
   their actual NDArray reads/writes compared against the declared

@@ -15,12 +15,35 @@ Rule catalog (rationale + examples: docs/static_analysis.md):
                           daemonized-or-joined (the DeviceFeedIter teardown
                           precedent: a forgotten non-daemon thread hangs
                           interpreter exit).
-* ``lock-discipline``     attributes annotated ``# guarded-by: <lock>`` must
-                          be touched under ``with self.<lock>``.
-* ``host-sync-in-hot-path`` ``.asnumpy()``/``.asscalar()``/``np.asarray`` in
-                          the module/executor step path blocks on device
-                          transfer (docs/perf.md §pipeline measured ~10ms/img
-                          of exactly this).
+* ``lock-discipline``     attributes annotated ``# guarded-by: <lock>``
+                          (class-level ``self.<attr>`` AND module-level
+                          names) must be touched under ``with <lock>`` —
+                          local aliases of the lock resolve before
+                          matching.
+* ``device-escape``       dataflow-aware successor of PR 5's
+                          ``host-sync-in-hot-path`` name-grep: any host
+                          materialization of a device value in hot-path
+                          code — the explicit forms (``.asnumpy()`` /
+                          ``.asscalar()`` / ``np.asarray``) plus the
+                          implicit syncs the grep was blind to
+                          (``float()``/``int()``/``bool()``/``len()`` on a
+                          tracked device value, ``np.*`` ufuncs over one,
+                          truthiness/comparison in ``if``/``while``,
+                          f-string / ``%`` formatting, ``.tolist()`` /
+                          ``.item()``).
+* ``trace-impure``        Python side effects or traced-value control flow
+                          inside a function that reaches ``compileobs.jit``
+                          — each silently bakes a trace-time constant and
+                          would poison the planned on-disk compile cache
+                          (ROADMAP #2).
+* ``recompile-hazard``    a jitted wrapper called with an argument derived
+                          from a per-step Python scalar or un-bucketed
+                          ``len()``/``.shape`` — the statically-predictable
+                          recompiles compileobs can only attribute after
+                          the fact.
+* ``lock-order``          whole-repo lock-acquisition graph (lockgraph.py):
+                          cycles (potential deadlock) and blocking calls
+                          made under a lock other threads also take.
 * ``mutable-default-arg`` the classic shared-default footgun.
 * ``untracked-jit``       any reference to ``jax.jit`` / ``jax.export.export``
                           (call, ``@jax.jit`` decorator, ``partial(jax.jit)``)
@@ -32,16 +55,23 @@ Rule catalog (rationale + examples: docs/static_analysis.md):
                           ``compileobs.jit`` / ``compileobs.raw_jit``.
 
 Checkers are plain callables ``(FileContext) -> [Finding]`` with a ``rules``
-attribute; ``CHECKERS`` is the registry the driver iterates.
+attribute; ``CHECKERS`` is the registry the driver iterates. Repo-scope
+checkers (``(list[FileContext]) -> [Finding]``) live in ``REPO_CHECKERS``
+— they see every file at once (lock-order's acquisition graph,
+trace-impure's cross-file call closure).
 """
 from __future__ import annotations
 
 import ast
 import re
 
-from .fwlint import Finding
+from .fwlint import Finding, import_alias_map as _import_alias_map
+from .dataflow import DEVICE, HOST, FunctionFlow, dotted_name, \
+    analyze as _analyze
+from .lockgraph import build as _build_lock_graph, \
+    _lock_ctor
 
-__all__ = ["CHECKERS"]
+__all__ = ["CHECKERS", "REPO_CHECKERS"]
 
 # the one module allowed to touch os.environ for MXNET_* keys: it hosts the
 # env_* helpers themselves
@@ -64,15 +94,9 @@ def _checker(*rules):
     return deco
 
 
-def _name_of(node):
-    """Best-effort dotted name of an expression (``os.environ`` →
-    'os.environ')."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        base = _name_of(node.value)
-        return base + "." + node.attr if base else node.attr
-    return ""
+# the one shared name resolver (dataflow.dotted_name) under the package's
+# historical local alias
+_name_of = dotted_name
 
 
 def _const_str(node):
@@ -102,7 +126,7 @@ def check_env_raw_read(ctx):
             "(garbage values must warn + default, not crash)" % key,
             context=ctx.qualnames.get(node, "")))
 
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.Call):
             fname = _name_of(node.func)
             key = None
@@ -148,7 +172,7 @@ def _has_raise(body):
 @_checker("bare-except", "swallowed-exception")
 def check_excepts(ctx):
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.ExceptHandler):
             continue
         qn = ctx.qualnames.get(node, "")
@@ -213,7 +237,7 @@ def _assign_targets_of(ctx, node):
 @_checker("thread-hygiene")
 def check_thread_hygiene(ctx):
     joined, daemonized = set(), set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.Call) and _name_of(node.func).endswith(
                 ".join"):
             owner = node.func.value
@@ -227,7 +251,7 @@ def check_thread_hygiene(ctx):
                                    if isinstance(owner, ast.Attribute)
                                    else _name_of(owner))
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not _is_thread_ctor(node):
             continue
         qn = ctx.qualnames.get(node, "")
@@ -258,95 +282,368 @@ def check_thread_hygiene(ctx):
 # lock-discipline
 # ---------------------------------------------------------------------------
 
-def _with_locks(ctx, node):
-    """Lock names held at ``node``: every lexical ancestor ``with`` item of
-    the form ``self.<lock>`` or ``<lock>``."""
-    held = set()
+def _lock_aliases(ctx, node):
+    """Local names aliasing a lock at ``node``'s scope: for every simple
+    ``alias = self.<lock>`` / ``alias = <lock>`` / ``alias = mod.<lock>``
+    assignment in the enclosing function, map alias -> lock's bare name.
+    PR 5's checker missed these — ``lk = self._lock; with lk:`` escaped
+    checking entirely."""
+    fn = None
     for parent in ctx.ancestors(node):
-        if isinstance(parent, ast.With):
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = parent
+            break
+    if fn is None:
+        return {}
+    aliases = {}
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Assign):
+            continue
+        src = n.value
+        ent = None
+        if isinstance(src, ast.Attribute):
+            # the source KIND travels with the alias: `lk = self._lock`
+            # must never satisfy a module-level guarded-by "_lock"
+            kind = "self" if _name_of(src.value) == "self" else "mod"
+            ent = (kind, src.attr)
+        elif isinstance(src, ast.Name):
+            ent = ("bare", src.id)
+        if ent is None:
+            continue
+        # no name-shape filter: an alias of ANY attr resolves — a bogus
+        # entry can only ever name the wrong lock (no match), never
+        # invent a held lock the source didn't reference
+        for t in n.targets:
+            if isinstance(t, ast.Name):
+                aliases[t.id] = ent
+    return aliases
+
+
+def _with_locks(ctx, node):
+    """``(kind, name)`` pairs held at ``node`` — kind ``self`` for
+    ``with self.<lock>``, ``mod`` for ``with other.<lock>``, ``bare``
+    for ``with <lock>`` — with local aliases resolved to their SOURCE
+    kind (:func:`_lock_aliases`)."""
+    held = set()
+    aliases = None
+    for parent in ctx.ancestors(node):
+        if isinstance(parent, (ast.With, ast.AsyncWith)):
             for item in parent.items:
                 expr = item.context_expr
                 if isinstance(expr, ast.Attribute):
-                    held.add(expr.attr)
+                    kind = "self" if _name_of(expr.value) == "self" \
+                        else "mod"
+                    held.add((kind, expr.attr))
                 elif isinstance(expr, ast.Name):
-                    held.add(expr.id)
+                    if aliases is None:
+                        aliases = _lock_aliases(ctx, node)
+                    held.add(aliases.get(expr.id, ("bare", expr.id)))
     return held
+
+
+def _check_guarded_set(ctx, guarded, nodes, describe, module_scope=False,
+                       self_owned=()):
+    out = []
+    for node, name in nodes:
+        lock, decl_lines = guarded[name]
+        if node.lineno in decl_lines:
+            continue
+        held = _with_locks(ctx, node)
+        if module_scope:
+            # a module-level guarded name needs the MODULE lock: an
+            # unrelated class's same-named `with self._lock:` must not
+            # satisfy it
+            ok = any(n_ == lock and k != "self" for k, n_ in held)
+        elif lock in self_owned:
+            # ... and symmetrically, a class-OWNED lock (self.<lock>
+            # constructed in the class) is only satisfied by the
+            # instance lock, not a same-named module-level `with _lock:`
+            ok = ("self", lock) in held
+        else:
+            ok = any(n_ == lock for _k, n_ in held)
+        if not ok:
+            out.append(Finding(
+                "lock-discipline", ctx.path, node.lineno,
+                node.col_offset,
+                "%s is annotated guarded-by: %s but accessed outside "
+                "`with %s`" % (describe % name, lock, lock),
+                context=ctx.qualnames.get(node, "")))
+    return out
+
+
+def _collect_guarded(ctx, scope, target_pred):
+    """{name: (lock, {declaration lines})} for guarded-by-annotated
+    assignments under ``scope`` whose targets satisfy ``target_pred``;
+    re-annotation conflicts come back as findings."""
+    guarded, out = {}, []
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Assign):
+            continue
+        m = _GUARDED_BY_RE.search(ctx.comments.get(node.lineno, ""))
+        if not m:
+            continue
+        for t in node.targets:
+            name = target_pred(t, node)
+            if name is None:
+                continue
+            lock, lines = guarded.setdefault(name, (m.group(1), set()))
+            if lock != m.group(1):
+                out.append(Finding(
+                    "lock-discipline", ctx.path, node.lineno,
+                    node.col_offset,
+                    "%s re-annotated with a different lock (%s vs %s)"
+                    % (name, m.group(1), lock),
+                    context=ctx.qualnames.get(node, "")))
+            lines.add(node.lineno)
+    return guarded, out
 
 
 @_checker("lock-discipline")
 def check_lock_discipline(ctx):
     out = []
-    for cls in ast.walk(ctx.tree):
+    # class half: self.<attr> annotations checked across the class
+    for cls in ctx.nodes:
         if not isinstance(cls, ast.ClassDef):
             continue
-        guarded = {}  # attr -> (lock, {declaration lines})
-        for node in ast.walk(cls):
-            if not isinstance(node, ast.Assign):
-                continue
-            m = _GUARDED_BY_RE.search(ctx.comments.get(node.lineno, ""))
-            if not m:
-                continue
-            for t in node.targets:
-                if (isinstance(t, ast.Attribute)
-                        and _name_of(t.value) == "self"):
-                    lock, lines = guarded.setdefault(
-                        t.attr, (m.group(1), set()))
-                    if lock != m.group(1):
-                        out.append(Finding(
-                            "lock-discipline", ctx.path, node.lineno,
-                            node.col_offset,
-                            "self.%s re-annotated with a different lock "
-                            "(%s vs %s)" % (t.attr, m.group(1), lock),
-                            context=ctx.qualnames.get(node, "")))
-                    lines.add(node.lineno)
+        guarded, conflicts = _collect_guarded(
+            ctx, cls,
+            lambda t, node: t.attr if isinstance(t, ast.Attribute)
+            and _name_of(t.value) == "self" else None)
+        out.extend(conflicts)
         if not guarded:
             continue
-        for node in ast.walk(cls):
-            if not (isinstance(node, ast.Attribute)
-                    and _name_of(node.value) == "self"
-                    and node.attr in guarded):
-                continue
-            lock, decl_lines = guarded[node.attr]
-            if node.lineno in decl_lines:
-                continue
-            if lock not in _with_locks(ctx, node):
-                out.append(Finding(
-                    "lock-discipline", ctx.path, node.lineno,
-                    node.col_offset,
-                    "self.%s is annotated guarded-by: %s but accessed "
-                    "outside `with self.%s`" % (node.attr, lock, lock),
-                    context=ctx.qualnames.get(node, "")))
+        # locks the class itself CONSTRUCTS (self._lock = Lock()) can
+        # only be satisfied by the instance lock
+        self_owned = set()
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Assign) and _lock_ctor(n.value):
+                # lockgraph's detector, so lock-discipline and the
+                # lock-order graph can never disagree on what is a lock
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and _name_of(t.value) == "self":
+                        self_owned.add(t.attr)
+        accesses = [(n, n.attr) for n in ast.walk(cls)
+                    if isinstance(n, ast.Attribute)
+                    and _name_of(n.value) == "self" and n.attr in guarded]
+        out.extend(_check_guarded_set(ctx, guarded, accesses, "self.%s",
+                                      self_owned=self_owned))
+    # module half (the PR 5 gap): module-level names annotated beside
+    # their declaration — telemetry-style `_STATE = {}  # guarded-by: _lock`
+    def _module_target(t, node):
+        if isinstance(t, ast.Name) and ctx.qualnames.get(node) == \
+                "<module>":
+            return t.id
+        return None
+
+    guarded, conflicts = _collect_guarded(ctx, ctx.tree, _module_target)
+    out.extend(conflicts)
+    if guarded:
+        # Python scoping, not bare-name matching: a function that BINDS
+        # the name locally (and doesn't declare it global) shadows the
+        # guarded module global — its accesses are a different variable
+        shadow_cache = {}
+
+        def _shadowed(node, name):
+            fn = None
+            for parent in ctx.ancestors(node):
+                if isinstance(parent, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    fn = parent
+                    break
+            if fn is None:
+                return False
+            key = id(fn)
+            if key not in shadow_cache:
+                bound, globals_ = set(), set()
+                args = fn.args
+                for a in (list(getattr(args, "posonlyargs", ()))
+                          + list(args.args) + list(args.kwonlyargs)):
+                    bound.add(a.arg)
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Name) \
+                            and isinstance(n.ctx, (ast.Store, ast.Del)):
+                        bound.add(n.id)
+                    elif isinstance(n, ast.Global):
+                        globals_.update(n.names)
+                shadow_cache[key] = (bound, globals_)
+            bound, globals_ = shadow_cache[key]
+            return name in bound and name not in globals_
+
+        accesses = [(n, n.id) for n in ctx.nodes
+                    if isinstance(n, ast.Name) and n.id in guarded
+                    and ctx.qualnames.get(n) != "<module>"
+                    and not _shadowed(n, n.id)]
+        out.extend(_check_guarded_set(ctx, guarded, accesses, "%s",
+                                      module_scope=True))
     return out
 
 
 # ---------------------------------------------------------------------------
-# host-sync-in-hot-path
+# device-escape (dataflow-aware successor of PR 5's host-sync name-grep)
 # ---------------------------------------------------------------------------
 
-@_checker("host-sync-in-hot-path")
-def check_host_sync(ctx):
-    if not (ctx.path in HOT_PATH_FILES
-            or any(ctx.path.startswith(p) for p in HOT_PATH_PREFIXES)):
+# scalar builtins that force a device value onto the host when applied to
+# array data (float(arr) is jnp.ndarray.__float__ = blocking transfer)
+_ESCAPE_BUILTINS = ("float", "int", "bool", "str", "len")
+# explicit sync spellings (the legacy rule's whole vocabulary)
+_EXPLICIT_NP_SYNCS = ("np.asarray", "numpy.asarray", "np.array",
+                      "numpy.array")
+
+
+def _hot_path(ctx):
+    return (ctx.path in HOT_PATH_FILES
+            or any(ctx.path.startswith(p) for p in HOT_PATH_PREFIXES))
+
+
+def _esc(ctx, node, what, chain):
+    return Finding(
+        "device-escape", ctx.path, node.lineno, node.col_offset,
+        "%s in hot-path code forces a device->host sync (docs/perf.md "
+        "§pipeline measured ~10ms/img of exactly this); keep the step "
+        "on-device, or suppress with a reason for honest host egress"
+        % what,
+        context=ctx.qualnames.get(node, ""), chain=chain)
+
+
+def _dev(val):
+    return val is not None and val.dev == DEVICE
+
+
+@_checker("device-escape")
+def check_device_escape(ctx):
+    if not _hot_path(ctx):
         return []
+    flow = _analyze(ctx)
     out = []
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call):
-            continue
-        sync = None
-        if (isinstance(node.func, ast.Attribute)
-                and node.func.attr in ("asnumpy", "asscalar")):
-            sync = node.func.attr + "()"
-        elif _name_of(node.func) in ("np.asarray", "numpy.asarray",
-                                     "np.array", "numpy.array"):
-            sync = _name_of(node.func)
-        if sync:
-            out.append(Finding(
-                "host-sync-in-hot-path", ctx.path, node.lineno,
-                node.col_offset,
-                "%s in the module/executor step path forces a device->host "
-                "sync (docs/perf.md §pipeline); keep the step on-device or "
-                "move the sync out of the per-batch path" % sync,
-                context=ctx.qualnames.get(node, "")))
+    # truthiness contexts whose test forcing a device boolean is a sync
+    tests = {}  # id(expr) -> description
+    def _test(expr, where):
+        # a BoolOp/`not` test is covered operand-by-operand (the BoolOp
+        # and UnaryOp branches below) — registering the join too would
+        # double-report one sync
+        if isinstance(expr, ast.BoolOp) or (
+                isinstance(expr, ast.UnaryOp)
+                and isinstance(expr.op, ast.Not)):
+            return
+        tests[id(expr)] = where
+
+    for node in ctx.nodes:
+        if isinstance(node, ast.If):
+            _test(node.test, "if")
+        elif isinstance(node, ast.While):
+            _test(node.test, "while")
+        elif isinstance(node, ast.Assert):
+            _test(node.test, "assert")
+        elif isinstance(node, ast.IfExp):
+            _test(node.test, "conditional expression")
+        elif isinstance(node, ast.BoolOp):
+            for v in node.values:
+                _test(v, "and/or")
+        elif isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                          ast.Not):
+            _test(node.operand, "not")
+        elif isinstance(node, ast.comprehension):
+            for cond in node.ifs:
+                _test(cond, "comprehension filter")
+
+    for node in ctx.nodes:
+        if isinstance(node, ast.Call):
+            fname = _name_of(node.func)
+            val0 = flow.val(node.args[0]) if node.args else None
+            # explicit forms — the legacy vocabulary, kept so the migrated
+            # baseline stays meaningful; a provably-host arg is exempt
+            # (the dataflow upgrade over the name-grep)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("asnumpy", "asscalar"):
+                recv = flow.val(node.func.value)
+                if recv is None or recv.dev != HOST:
+                    out.append(_esc(ctx, node,
+                                    ".%s()" % node.func.attr,
+                                    recv.chain if recv else ()))
+                continue
+            if fname in _EXPLICIT_NP_SYNCS:
+                if val0 is None or val0.dev != HOST:
+                    out.append(_esc(ctx, node, fname,
+                                    val0.chain if val0 else ()))
+                continue
+            # implicit forms — need a POSITIVELY tracked device value
+            if fname in _ESCAPE_BUILTINS and node.args and _dev(val0):
+                if fname == "len" and val0.listy:
+                    # len() of the executor-outputs LIST (.outputs /
+                    # get_outputs() / a name holding either) counts
+                    # graph arity, a static property, not array
+                    # structure; an ELEMENT of one (outputs[0]) is a
+                    # plain device array and stays checked
+                    continue
+                if fname == "len":
+                    # len() is shape metadata, not a transfer — but it
+                    # pins per-batch Python control flow to array
+                    # structure and is the canonical un-bucketed-size
+                    # source; message says so instead of claiming a sync
+                    out.append(Finding(
+                        "device-escape", ctx.path, node.lineno,
+                        node.col_offset,
+                        "len() on a tracked device value in hot-path "
+                        "code: no transfer, but it ties per-batch Python "
+                        "control flow to array structure and feeds "
+                        "un-bucketed sizes onward (see recompile-hazard) "
+                        "— hoist the size to host-side metadata",
+                        context=ctx.qualnames.get(node, ""),
+                        chain=val0.chain))
+                else:
+                    out.append(_esc(ctx, node,
+                                    "%s() on a tracked device value"
+                                    % fname, val0.chain))
+                continue
+            if fname.startswith(("np.", "numpy.")) and any(
+                    _dev(flow.val(a)) for a in node.args):
+                bad = next(a for a in node.args if _dev(flow.val(a)))
+                out.append(_esc(ctx, node,
+                                "%s(...) over a tracked device value "
+                                "(host ufunc)" % fname,
+                                flow.val(bad).chain))
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("tolist", "item"):
+                recv = flow.val(node.func.value)
+                if _dev(recv):
+                    out.append(_esc(ctx, node,
+                                    ".%s() on a tracked device value"
+                                    % node.func.attr, recv.chain))
+                continue
+            # a Call that matched no explicit/implicit form can still be
+            # a truthiness test itself: `if arr.sum():` forces the device
+            # boolean exactly like `if arr > 0:`
+            if id(node) in tests:
+                val = flow.val(node)
+                if _dev(val):
+                    out.append(_esc(
+                        ctx, node,
+                        "truthiness/comparison of a tracked device value "
+                        "in `%s`" % tests[id(node)], val.chain))
+        elif id(node) in tests:
+            val = flow.val(node)
+            if _dev(val):
+                out.append(_esc(
+                    ctx, node,
+                    "truthiness/comparison of a tracked device value in "
+                    "`%s`" % tests[id(node)], val.chain))
+        elif isinstance(node, ast.FormattedValue):
+            val = flow.val(node.value)
+            if _dev(val):
+                out.append(_esc(ctx, node,
+                                "f-string formatting of a tracked device "
+                                "value", val.chain))
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+            left = node.left
+            if isinstance(left, ast.Constant) and isinstance(left.value,
+                                                             str):
+                val = flow.val(node.right)
+                if _dev(val):
+                    out.append(_esc(ctx, node,
+                                    "%-formatting of a tracked device "
+                                    "value", val.chain))
     return out
 
 
@@ -364,7 +661,7 @@ def check_untracked_jit(ctx):
         return []
     # names `jit` bound from jax in this file (`from jax import jit`)
     bare_jit_names = set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.ImportFrom) and node.module == "jax":
             for alias in node.names:
                 if alias.name == "jit":
@@ -374,7 +671,7 @@ def check_untracked_jit(ctx):
     # expressions: `@jax.jit` decorators and `partial(jax.jit, ...)` compile
     # programs just as invisibly as a direct call, and both put jax.jit in
     # the tree as a bare Attribute/Name rather than a Call's func
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.Attribute):
             fname = _name_of(node)
             if fname not in ("jax.jit", "jax.export.export"):
@@ -398,6 +695,290 @@ def check_untracked_jit(ctx):
 
 
 # ---------------------------------------------------------------------------
+# trace-impure (repo scope: functions reaching compileobs.jit)
+# ---------------------------------------------------------------------------
+
+# side-effecting call prefixes that bake trace-time state into the program
+_IMPURE_CALL_PREFIXES = ("telemetry.", "time.", "random.", "np.random.",
+                         "numpy.random.")
+_MUTATING_METHODS = ("append", "extend", "add", "update", "pop",
+                     "setdefault", "insert", "remove", "clear")
+
+
+def _is_compileobs_jit(node):
+    """Call node of ``compileobs.jit`` / ``compileobs.raw_jit`` (any
+    import alias ending in 'compileobs')."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("jit", "raw_jit")):
+        return False
+    return _name_of(node.func.value).split(".")[-1].endswith("compileobs")
+
+
+def _local_defs(ctx):
+    """bare name -> [FunctionDef] for every def in the file."""
+    defs = {}
+    for n in ctx.nodes:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(n.name, []).append(n)
+    return defs
+
+
+def _jit_roots(ctx):
+    """Functions in this file passed to compileobs.jit/raw_jit — directly
+    by name, or returned by a same-file factory called inline
+    (``compileobs.jit(_mk_prefill(), ...)``, the serving-engine idiom)."""
+    defs = _local_defs(ctx)
+    roots = []
+    for node in ctx.nodes:
+        if not _is_compileobs_jit(node) or not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Name):
+            roots.extend(defs.get(arg.id, ()))
+        elif isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name):
+            for factory in defs.get(arg.func.id, ()):
+                for r in ast.walk(factory):
+                    if isinstance(r, ast.Return) \
+                            and isinstance(r.value, ast.Name):
+                        roots.extend(
+                            d for d in defs.get(r.value.id, ())
+                            if any(a is d for a in ast.walk(factory)))
+    return roots
+
+
+def _reaching_jit(ctxs):
+    """BFS over the call graph from every jit root: yields
+    ``(ctx, fnode, root_name)`` for each function whose body runs under
+    trace. Callee resolution: bare names same-file, ``alias.fn`` through
+    imports (the serving engine -> serving/model.py hop)."""
+    by_path = {c.path: c for c in ctxs}
+    paths = set(by_path)
+    local_defs = {c.path: _local_defs(c) for c in ctxs}
+    imports = {c.path: _import_alias_map(c, paths) for c in ctxs}
+    seen = {}
+    work = []
+    for ctx in ctxs:
+        for root in _jit_roots(ctx):
+            if (ctx.path, id(root)) not in seen:
+                seen[(ctx.path, id(root))] = root.name
+                work.append((ctx, root, root.name))
+    i = 0
+    while i < len(work):
+        ctx, fnode, root_name = work[i]
+        i += 1
+        for node in ast.walk(fnode):
+            if not isinstance(node, ast.Call):
+                continue
+            targets = []
+            if isinstance(node.func, ast.Name):
+                targets = [(ctx, d) for d
+                           in local_defs[ctx.path].get(node.func.id, ())]
+            elif isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name):
+                tpath = imports[ctx.path].get(node.func.value.id)
+                if tpath:
+                    tctx = by_path[tpath]
+                    targets = [(tctx, d) for d in
+                               local_defs[tpath].get(node.func.attr, ())
+                               if tctx.qualnames[d] == d.name]
+            for tctx, d in targets:
+                key = (tctx.path, id(d))
+                if key not in seen:
+                    seen[key] = root_name
+                    work.append((tctx, d, root_name))
+    return work
+
+
+def _walk_own_body(fnode):
+    """Every node in ``fnode``'s body EXCLUDING nested function/class
+    scopes (those are separate trace units, reached via the worklist when
+    actually called)."""
+    stack = list(fnode.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _trace_impure_repo(ctxs):
+    out = []
+    for ctx, fnode, root in _reaching_jit(ctxs):
+        # params of a traced function are tracers at trace time
+        flow = FunctionFlow(ctx, fnode, seed_device_params=True)
+        local_names = {a.arg for a in
+                       list(getattr(fnode.args, "posonlyargs", ()))
+                       + list(fnode.args.args)
+                       + list(fnode.args.kwonlyargs)}
+        for n in ast.walk(fnode):
+            if isinstance(n, (ast.Assign, ast.For)):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            local_names.add(leaf.id)
+
+        def flag(node, what):
+            out.append(Finding(
+                "trace-impure", ctx.path, node.lineno,
+                getattr(node, "col_offset", 0),
+                "%s inside a function reaching compileobs.jit (via %r): "
+                "it runs at TRACE time only, silently baking a constant "
+                "into the compiled program — and poisons an on-disk "
+                "compile cache (ROADMAP #2)" % (what, root),
+                context=ctx.qualnames.get(node, "")))
+
+        for n in _walk_own_body(fnode):
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                flag(n, "global/nonlocal declaration (closure/module "
+                        "mutation)")
+            elif isinstance(n, ast.Call):
+                fname = _name_of(n.func)
+                if fname == "print" \
+                        or fname.startswith(_IMPURE_CALL_PREFIXES):
+                    flag(n, "call to %s (Python side effect)" % fname)
+                elif isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in _MUTATING_METHODS \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id not in local_names:
+                    flag(n, "mutation of closure/global %r via .%s()"
+                         % (n.func.value.id, n.func.attr))
+            elif isinstance(n, (ast.If, ast.While)):
+                val = flow.values.get(id(n.test))
+                if val is not None and val.dev == DEVICE:
+                    kind = "if" if isinstance(n, ast.If) else "while"
+                    f = Finding(
+                        "trace-impure", ctx.path, n.test.lineno,
+                        n.test.col_offset,
+                        "data-dependent Python `%s` on a traced value "
+                        "inside a function reaching compileobs.jit (via "
+                        "%r): the branch taken at trace time is baked "
+                        "into the program for every future call"
+                        % (kind, root),
+                        context=ctx.qualnames.get(n, ""), chain=val.chain)
+                    out.append(f)
+    return out
+
+
+@_checker("trace-impure")
+def check_trace_impure(ctxs):
+    return _trace_impure_repo(list(ctxs))
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+def _jit_wrapper_names(ctx):
+    """Names (bare locals and self-attributes) bound to compileobs-jitted
+    callables in this file — including dicts of per-bucket wrappers."""
+    names = set()
+    for node in ctx.nodes:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        if not any(_is_compileobs_jit(n) for n in ast.walk(node.value)):
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                names.add(t.attr)
+    return names
+
+
+@_checker("recompile-hazard")
+def check_recompile_hazard(ctx):
+    wrappers = _jit_wrapper_names(ctx)
+    if not wrappers:
+        return []
+    flow = _analyze(ctx)
+    out = []
+
+    def _wrapper_call(node):
+        f = node.func
+        # f(...) / self._fwd(...) / self._jits[bucket](...)
+        if isinstance(f, ast.Subscript):
+            f = f.value
+        if isinstance(f, ast.Name):
+            return f.id if f.id in wrappers else None
+        if isinstance(f, ast.Attribute):
+            return f.attr if f.attr in wrappers else None
+        return None
+
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        wname = _wrapper_call(node)
+        if wname is None:
+            continue
+        # positional AND keyword args; shape-ctor results reach here with
+        # the taint attached however many local names they passed through
+        # (dataflow.SHAPE_CTORS propagates it)
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            val = flow.val(arg)
+            if val is None or not val.step:
+                continue
+            out.append(Finding(
+                "recompile-hazard", ctx.path, node.lineno,
+                node.col_offset,
+                "argument to jitted wrapper %r derives from a per-step "
+                "Python scalar or un-bucketed size: every new value "
+                "compiles a fresh XLA program (compileobs will attribute "
+                "it after the fact — bucket it now: pad to a fixed set "
+                "of shapes, or pass it as a traced np scalar)"
+                % wname,
+                context=ctx.qualnames.get(node, ""), chain=val.schain))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lock-order (repo scope)
+# ---------------------------------------------------------------------------
+
+@_checker("lock-order")
+def check_lock_order(ctxs):
+    ctxs = list(ctxs)
+    graph = _build_lock_graph(ctxs)
+    out = []
+    for cycle in graph.cycles():
+        edges = graph.cycle_edges(cycle)
+        if not edges:
+            continue
+        # anchor at the lexically-first edge site so the finding (and its
+        # suppression) lives where a human can re-order the acquisitions
+        anchor = min(edges.values())
+        path, line, _txt = anchor
+        detail = "; ".join("%s->%s at %s:%d" % (s, d, p, ln)
+                           for (s, d), (p, ln, _t)
+                           in sorted(edges.items()))
+        out.append(Finding(
+            "lock-order", path, line, 0,
+            "lock-acquisition cycle %s: two threads taking these locks "
+            "in opposite orders deadlock — impose one global order or "
+            "split the critical sections (%s)"
+            % (" -> ".join(cycle + (cycle[0],)), detail)))
+    for held, kind, path, line in graph.blocking:
+        shared = [h for h in held
+                  if len(graph.acquire_fns.get(h, ())) > 1]
+        if not shared:
+            continue
+        out.append(Finding(
+            "lock-order", path, line, 0,
+            "blocking call %s while holding %s — other threads' paths "
+            "also take %s and will wedge behind this wait; drop the lock "
+            "first or bound the wait" % (kind, shared[0], shared[0])))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # mutable-default-arg
 # ---------------------------------------------------------------------------
 
@@ -415,7 +996,7 @@ def _is_mutable_default(node):
 @_checker("mutable-default-arg")
 def check_mutable_default(ctx):
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Lambda)):
             continue
@@ -433,5 +1014,8 @@ def check_mutable_default(ctx):
 
 
 CHECKERS = (check_env_raw_read, check_excepts, check_thread_hygiene,
-            check_lock_discipline, check_host_sync, check_untracked_jit,
+            check_lock_discipline, check_device_escape,
+            check_recompile_hazard, check_untracked_jit,
             check_mutable_default)
+
+REPO_CHECKERS = (check_trace_impure, check_lock_order)

@@ -23,7 +23,62 @@ import re
 import tokenize
 
 __all__ = ["Finding", "FileContext", "RULES", "lint_source", "lint_paths",
-           "run_lint", "iter_python_files"]
+           "run_lint", "iter_python_files", "load_contexts",
+           "import_alias_map"]
+
+
+def import_alias_map(ctx, known_paths):
+    """alias -> repo-relative path for every import in ``ctx`` that
+    resolves to a file in ``known_paths`` (absolute, relative, and
+    ``as``-renamed forms). THE shared resolver: lock-order's call-graph
+    and trace-impure's cross-file closure must agree on what an alias
+    means, so there is exactly one implementation. Cached per context +
+    path-set (lock-order and trace-impure resolve the same map)."""
+    import posixpath
+
+    key = frozenset(known_paths)
+    cached = getattr(ctx, "_alias_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    out = {}
+    pkg = posixpath.dirname(ctx.path)
+    for node in ctx.nodes:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    base = alias.name.replace(".", "/")
+                    for cand in (base + ".py", base + "/__init__.py"):
+                        if cand in known_paths:
+                            out[alias.asname] = cand
+                            break
+                else:
+                    # `import a.b` (no asname) binds the ROOT package
+                    # name `a`, not a.b — mapping `a` to a/b.py would
+                    # resolve `a.<attr>` against the wrong file
+                    root = alias.name.split(".")[0]
+                    for cand in (root + ".py", root + "/__init__.py"):
+                        if cand in known_paths:
+                            out.setdefault(root, cand)
+                            break
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = (node.module or "").replace(".", "/")
+            else:
+                base = pkg
+                for _ in range(node.level - 1):
+                    base = posixpath.dirname(base)
+                if node.module:
+                    base = posixpath.join(base,
+                                          node.module.replace(".", "/"))
+            for alias in node.names:
+                for cand in (posixpath.join(base, alias.name + ".py"),
+                             posixpath.join(base, alias.name,
+                                            "__init__.py")):
+                    if cand in known_paths:
+                        out[alias.asname or alias.name] = cand
+                        break
+    ctx._alias_cache = (key, out)
+    return out
 
 # rule tokens separated by commas; capture stops at the first token that is
 # not a rule name, so an ASCII-hyphen reason ("... disable=rule - why") does
@@ -34,14 +89,19 @@ _SUPPRESS_RE = re.compile(r"#\s*fwlint:\s*disable="
 
 class Finding:
     """One lint violation: ``rule`` at ``path:line``, with the enclosing
-    ``context`` (dotted class/function qualname) and a ``fingerprint`` that
+    ``context`` (dotted class/function qualname), a ``fingerprint`` that
     survives unrelated line drift (it hashes rule + path + context +
-    normalized source text + same-text ordinal, never the line number)."""
+    normalized source text + same-text ordinal, never the line number),
+    and an optional provenance ``chain`` — the dataflow steps that tainted
+    the flagged value (``tools/fwlint.py --explain <fingerprint>`` prints
+    it; never part of the fingerprint, so chain wording can improve
+    without churning baselines)."""
 
     __slots__ = ("rule", "path", "line", "col", "message", "context",
-                 "text", "fingerprint", "suppressed")
+                 "text", "fingerprint", "suppressed", "chain")
 
-    def __init__(self, rule, path, line, col, message, context="", text=""):
+    def __init__(self, rule, path, line, col, message, context="", text="",
+                 chain=()):
         self.rule = rule
         self.path = path
         self.line = line
@@ -51,6 +111,7 @@ class Finding:
         self.text = text
         self.fingerprint = None
         self.suppressed = False
+        self.chain = tuple(chain)
 
     def __repr__(self):
         return "%s:%d: [%s] %s" % (self.path, self.line, self.rule,
@@ -60,7 +121,8 @@ class Finding:
         return {"rule": self.rule, "path": self.path, "line": self.line,
                 "col": self.col, "message": self.message,
                 "context": self.context, "text": self.text,
-                "fingerprint": self.fingerprint}
+                "fingerprint": self.fingerprint,
+                "chain": list(self.chain)}
 
 
 class FileContext:
@@ -73,12 +135,16 @@ class FileContext:
         self.tree = ast.parse(source)
         self.parents = {}
         self.qualnames = {}
+        self.nodes = []  # every node, pre-order — checkers iterate this
+        # instead of re-running ast.walk (one tree traversal per file,
+        # however many rules consult it)
         self._link(self.tree, None, ())
         self.comments = self._comments(source)
         self.suppressions = self._suppressions()
 
     def _link(self, node, parent, stack):
         self.parents[node] = parent
+        self.nodes.append(node)
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             stack = stack + (node.name,)
@@ -152,9 +218,18 @@ def _checker_registry():
     return CHECKERS
 
 
+def _repo_checker_registry():
+    """Checkers that need EVERY file at once (lock-order's whole-repo
+    acquisition graph, trace-impure's cross-file call closure). Signature:
+    ``(list[FileContext]) -> [Finding]`` with a ``rules`` attribute."""
+    from .checkers import REPO_CHECKERS
+
+    return REPO_CHECKERS
+
+
 def _rules():
     rules = []
-    for chk in _checker_registry():
+    for chk in list(_checker_registry()) + list(_repo_checker_registry()):
         rules.extend(chk.rules)
     return tuple(sorted(set(rules)))
 
@@ -175,11 +250,45 @@ class _Rules:
 RULES = _Rules()
 
 
+def _file_findings(fctx, select):
+    findings = []
+    for chk in _checker_registry():
+        if select is not None and not (set(chk.rules) & set(select)):
+            continue
+        findings.extend(chk(fctx))
+    return findings
+
+
+def _repo_findings(fctxs, select):
+    findings = []
+    for chk in _repo_checker_registry():
+        if select is not None and not (set(chk.rules) & set(select)):
+            continue
+        findings.extend(chk(fctxs))
+    return findings
+
+
+def _resolve(findings, by_path):
+    """Fill context/text, apply each file's inline suppressions, and
+    fingerprint whatever survives."""
+    live = []
+    for f in findings:
+        fctx = by_path.get(f.path)
+        f.context = f.context or ""
+        if fctx is not None:
+            f.text = f.text or fctx.line_text(f.line)
+            f.suppressed = fctx.suppressed(f)
+        if not f.suppressed:
+            live.append(f)
+    return _finalize(live)
+
+
 def lint_source(source, path="<string>", select=None):
     """Lint one in-memory source blob; returns non-suppressed findings.
 
     The unit the tests drive: each checker gets a synthetic positive and
-    negative case through here.
+    negative case through here. Repo-scope rules (lock-order,
+    trace-impure) see a one-file repo.
     """
     try:
         fctx = FileContext(path, source)
@@ -187,16 +296,8 @@ def lint_source(source, path="<string>", select=None):
         f = Finding("parse-error", path, err.lineno or 1, 0,
                     "file does not parse: %s" % err.msg)
         return _finalize([f])
-    findings = []
-    for chk in _checker_registry():
-        if select is not None and not (set(chk.rules) & set(select)):
-            continue
-        findings.extend(chk(fctx))
-    for f in findings:
-        f.context = f.context or ""
-        f.text = f.text or fctx.line_text(f.line)
-        f.suppressed = fctx.suppressed(f)
-    return _finalize([f for f in findings if not f.suppressed])
+    findings = _file_findings(fctx, select) + _repo_findings([fctx], select)
+    return _resolve(findings, {path: fctx})
 
 
 def iter_python_files(paths, root):
@@ -222,15 +323,32 @@ def iter_python_files(paths, root):
                     yield rel.replace(os.sep, "/")
 
 
-def lint_paths(paths, root, select=None):
-    """Lint every .py file under ``paths`` (files or directories, relative
-    to ``root``); returns the combined non-suppressed findings."""
-    findings = []
+def load_contexts(paths, root):
+    """Parse every .py under ``paths`` into FileContexts; returns
+    ``(contexts, parse_error_findings)``."""
+    ctxs, errors = [], []
     for rel in iter_python_files(paths, root):
         with open(os.path.join(root, rel), "r", encoding="utf-8") as fh:
             source = fh.read()
-        findings.extend(lint_source(source, path=rel, select=select))
-    return findings
+        try:
+            ctxs.append(FileContext(rel, source))
+        except SyntaxError as err:
+            errors.append(Finding("parse-error", rel, err.lineno or 1, 0,
+                                  "file does not parse: %s" % err.msg))
+    return ctxs, errors
+
+
+def lint_paths(paths, root, select=None):
+    """Lint every .py file under ``paths`` (files or directories, relative
+    to ``root``); returns the combined non-suppressed findings. Per-file
+    checkers run per file; repo checkers (lock-order, trace-impure) run
+    once over the whole context set."""
+    ctxs, errors = load_contexts(paths, root)
+    findings = list(errors)
+    for fctx in ctxs:
+        findings.extend(_file_findings(fctx, select))
+    findings.extend(_repo_findings(ctxs, select))
+    return _resolve(findings, {c.path: c for c in ctxs})
 
 
 def run_lint(paths, root=None, select=None, baseline_path=None):

@@ -1,0 +1,491 @@
+"""Whole-repo lock-acquisition graph (the ``lock-order`` rule's engine).
+
+Builds one directed graph over every lock the repo creates —
+``threading.Lock`` / ``RLock`` / ``Condition`` / ``Semaphore`` assigned to
+a module-level name or a ``self.<attr>`` — and adds an edge ``A -> B``
+whenever B is acquired while A is held:
+
+* lexically, via nested ``with`` statements;
+* transitively, via calls made under a lock: ``self.method()`` resolves
+  within the class, ``alias.fn()`` through the file's imports,
+  ``self.obj.method()`` through constructor-assignment types
+  (``self.obj = SomeClass(...)``), and each resolved callee contributes
+  its own (transitive) acquisitions via a repo-wide fixpoint.
+
+Lock identity is **per declaration site** (``module.Class.attr``), not per
+instance: two instances of one class share a node. That over-approximates
+(instance-disjoint graphs can look cyclic) and under-approximates
+(dynamic dispatch is invisible) — lint-grade by design; suppress a false
+cycle with a written reason. ``Condition(lock)`` aliases the wrapped
+lock, so the condition-wait idiom never reports an ordering against its
+own lock; self-edges (reentrant re-acquisition) are dropped.
+
+Two failure families feed the ``lock-order`` checker:
+
+* **cycle** — a strongly-connected component in the graph: two threads
+  taking the locks in opposite orders deadlock.
+* **blocking-under-lock** — a blocking call (``queue.get``,
+  ``Event.wait``, ``Thread.join``, ``time.sleep``, KV RPC, ``urlopen``)
+  made while holding a lock that other functions also take: every one of
+  them wedges behind the sleeper (the serving engine's submit-vs-driver
+  split and telemetry's scrape path are exactly this shape).
+  ``Condition.wait`` on the held lock itself is the sanctioned idiom
+  (it releases the lock) and is exempt.
+
+``tools/fwlint.py --dump-lock-graph`` renders the graph as DOT.
+Stdlib-only.
+"""
+from __future__ import annotations
+
+import ast
+
+from .dataflow import dotted_name as _dotted
+from .fwlint import import_alias_map
+
+__all__ = ["LockGraph", "build"]
+
+_LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore")
+_QUEUE_CTORS = ("Queue", "LifoQueue", "PriorityQueue", "SimpleQueue")
+_RPC_ATTRS = ("pull", "push", "barrier", "request_server_stats")
+_RPC_RECV_HINTS = ("kv", "client", "store")
+
+
+def _modname(path):
+    return path[:-3].replace("/", ".") if path.endswith(".py") else path
+
+
+def _lock_ctor(call):
+    """('Lock'|'RLock'|..., wrapped_expr_or_None) when ``call`` constructs
+    a threading primitive; None otherwise."""
+    if not isinstance(call, ast.Call):
+        return None
+    name = _dotted(call.func)
+    base = name.rsplit(".", 1)[-1]
+    if base not in _LOCK_CTORS:
+        return None
+    if not (name == base or name.startswith("threading.")):
+        return None
+    wrapped = call.args[0] if base == "Condition" and call.args else None
+    return base, wrapped
+
+
+class _FileInfo:
+    """Per-file symbol tables: declared locks, imports, constructor-typed
+    attributes, def index."""
+
+    def __init__(self, ctx, known_paths, known_classes):
+        self.ctx = ctx
+        self.mod = _modname(ctx.path)
+        self.module_locks = {}   # bare name -> lock id
+        self.class_locks = {}    # (class, attr) -> lock id
+        self.attr_types = {}     # (class, attr) -> bare class name
+        self.imports = {}        # alias -> repo path
+        self.defs = {}           # qualname -> FunctionDef
+        self.class_names = {n.name for n in ctx.tree.body
+                            if isinstance(n, ast.ClassDef)}
+        for node in ctx.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[ctx.qualnames[node]] = node
+        self._scan_imports(known_paths)
+        self._scan_assigns(known_classes)
+
+    def _scan_imports(self, known_paths):
+        self.imports = import_alias_map(self.ctx, known_paths)
+
+    def _scan_assigns(self, known_classes):
+        ctx = self.ctx
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            qn = ctx.qualnames.get(node, "")
+            ctor = _lock_ctor(node.value)
+            callee = _dotted(node.value.func).rsplit(".", 1)[-1]
+            for t in node.targets:
+                if isinstance(t, ast.Name) and qn == "<module>" and ctor:
+                    kind, wrapped = ctor
+                    lid = (self._alias_of(wrapped, None) if wrapped
+                           is not None else None)
+                    self.module_locks[t.id] = lid or "%s.%s" % (self.mod,
+                                                                t.id)
+                elif isinstance(t, ast.Attribute) \
+                        and _dotted(t.value) == "self":
+                    cls = qn.split(".")[0]
+                    if cls not in self.class_names:
+                        continue
+                    if ctor:
+                        kind, wrapped = ctor
+                        lid = (self._alias_of(wrapped, cls) if wrapped
+                               is not None else None)
+                        self.class_locks[(cls, t.attr)] = \
+                            lid or "%s.%s.%s" % (self.mod, cls, t.attr)
+                    elif callee in known_classes:
+                        self.attr_types[(cls, t.attr)] = callee
+                    elif callee in _QUEUE_CTORS:
+                        self.attr_types[(cls, t.attr)] = "__queue__"
+                    elif callee == "Thread":
+                        self.attr_types[(cls, t.attr)] = "__thread__"
+                    elif callee == "Event":
+                        self.attr_types[(cls, t.attr)] = "__event__"
+
+    def _alias_of(self, wrapped, cls):
+        """``Condition(self._lock)`` / ``Condition(_lock)``: the condition
+        IS the wrapped lock — one graph node, not two."""
+        if isinstance(wrapped, ast.Attribute) \
+                and _dotted(wrapped.value) == "self" and cls:
+            return self.class_locks.get(
+                (cls, wrapped.attr),
+                "%s.%s.%s" % (self.mod, cls, wrapped.attr))
+        if isinstance(wrapped, ast.Name):
+            return self.module_locks.get(
+                wrapped.id, "%s.%s" % (self.mod, wrapped.id))
+        return None
+
+
+class LockGraph:
+    """``edges``: {(src, dst): (path, line, text)} example sites;
+    ``acquire_fns``: lock id -> set of function keys taking it directly;
+    ``blocking``: [(held tuple, kind, path, line)] candidates;
+    ``cycles()``: list of lock-id cycles (each a tuple)."""
+
+    def __init__(self, ctxs):
+        self.ctxs = {c.path: c for c in ctxs}
+        known_paths = set(self.ctxs)
+        known_classes = set()
+        for c in ctxs:
+            for node in c.nodes:
+                if isinstance(node, ast.ClassDef):
+                    known_classes.add(node.name)
+        self.infos = {c.path: _FileInfo(c, known_paths, known_classes)
+                      for c in ctxs}
+        self.edges = {}
+        self.acquire_fns = {}
+        self.blocking = []
+        self._direct = {}   # fnkey -> set(lock ids)
+        self._calls = {}    # fnkey -> [(held tuple, callee key, site)]
+        self._fn_blocking = {}  # fnkey -> [(kind, path, line)] own calls
+        for ctx in ctxs:
+            info = self.infos[ctx.path]
+            for qn, fnode in info.defs.items():
+                self._walk_fn(ctx, info, fnode, (ctx.path, qn))
+        self._apply_transitive()
+
+    # ------------------------------------------------------------- walking
+    def _walk_fn(self, ctx, info, fnode, key):
+        cls = None
+        head = key[1].split(".")[0]
+        if head in info.class_names and "." in key[1]:
+            cls = head
+        aliases = {}
+        direct = self._direct.setdefault(key, set())
+        calls = self._calls.setdefault(key, [])
+
+        def resolve_lock(expr):
+            if isinstance(expr, ast.Name):
+                if expr.id in aliases:
+                    return aliases[expr.id]
+                return info.module_locks.get(expr.id)
+            if isinstance(expr, ast.Attribute):
+                base = expr.value
+                if _dotted(base) == "self" and cls:
+                    return info.class_locks.get((cls, expr.attr))
+                if isinstance(base, ast.Name) and base.id in info.imports:
+                    tinfo = self.infos.get(info.imports[base.id])
+                    if tinfo:
+                        return tinfo.module_locks.get(expr.attr)
+                owner = self._typeof(info, cls, base)
+                if owner and owner != "__queue__":
+                    ent = self._class_lock(owner, expr.attr)
+                    if ent:
+                        return ent
+            return None
+
+        def resolve_call(call):
+            f = call.func
+            if isinstance(f, ast.Name):
+                if f.id in info.defs:
+                    return (ctx.path, f.id)
+                # nested def in the current function
+                nested = key[1] + "." + f.id
+                if nested in info.defs:
+                    return (ctx.path, nested)
+                return None
+            if isinstance(f, ast.Attribute):
+                base = f.value
+                if _dotted(base) == "self" and cls:
+                    qn = cls + "." + f.attr
+                    if qn in info.defs:
+                        return (ctx.path, qn)
+                    return None
+                if isinstance(base, ast.Name) and base.id in info.imports:
+                    tpath = info.imports[base.id]
+                    if f.attr in self.infos[tpath].defs:
+                        return (tpath, f.attr)
+                    return None
+                owner = self._typeof(info, cls, base)
+                if owner and owner != "__queue__":
+                    return self._class_method(owner, f.attr)
+            return None
+
+        def check_blocking(call, held):
+            f = call.func
+            name = _dotted(f)
+            kind = None
+            if name == "time.sleep":
+                kind = "time.sleep()"
+            elif "urlopen" in name:
+                kind = "urlopen()"
+            elif isinstance(f, ast.Attribute):
+                recv = _dotted(f.value).lower()
+                rtype = self._typeof(info, cls, f.value)
+                # receiver must LOOK like the blocking kind — a bare
+                # attr-name match would flag os.path.join / ", ".join /
+                # dict.get as deadlock-class findings
+                if f.attr == "join" and (
+                        rtype == "__thread__"
+                        or any(h in recv for h in ("thread", "worker",
+                                                   "flusher", "publisher",
+                                                   "proc"))
+                        or recv == "t"):
+                    kind = "Thread.join()"
+                elif f.attr == "wait":
+                    lid = resolve_lock(f.value)
+                    if lid is not None:
+                        # Condition.wait on the HELD lock releases it:
+                        # the sanctioned idiom; on an un-held condition
+                        # it is a blocking (mis)use
+                        if lid not in held:
+                            kind = "Condition.wait()"
+                    elif rtype == "__event__" or any(
+                            h in recv for h in ("event", "cond", "done",
+                                                "ready", "stop", "proc",
+                                                "_ev", "work")):
+                        kind = "Event.wait()"
+                elif f.attr == "get" and (
+                        "queue" in recv or recv.endswith("_q")
+                        or rtype == "__queue__"):
+                    kind = "queue.get()"
+                elif f.attr in _RPC_ATTRS and any(
+                        h in recv for h in _RPC_RECV_HINTS):
+                    kind = "KV RPC .%s()" % f.attr
+            if kind:
+                if held:
+                    self.blocking.append((tuple(held), kind, ctx.path,
+                                          call.lineno))
+                # remembered either way: a caller holding a lock around
+                # a call into THIS function inherits the blocking via
+                # the transitive pass. A Condition.wait records its lock
+                # so a caller HOLDING that lock stays exempt (the wait
+                # releases it even when split across functions).
+                wlid = resolve_lock(f.value) if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "wait") else None
+                self._fn_blocking.setdefault(key, []).append(
+                    (kind, ctx.path, call.lineno, wlid))
+
+        def scan_calls(expr, held):
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    callee = resolve_call(node)
+                    site = (ctx.path, node.lineno,
+                            ctx.line_text(node.lineno))
+                    if callee:
+                        calls.append((tuple(held), callee, site))
+                    check_blocking(node, held)
+
+        def stmt_walk(stmt, held):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return  # separate function keys
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                got = []
+                site = (ctx.path, stmt.lineno, ctx.line_text(stmt.lineno))
+                for item in stmt.items:
+                    lid = resolve_lock(item.context_expr)
+                    if lid:
+                        if isinstance(item.optional_vars, ast.Name):
+                            aliases[item.optional_vars.id] = lid
+                        direct.add(lid)
+                        self.acquire_fns.setdefault(lid, set()).add(key)
+                        for h in held + got:
+                            self._edge(h, lid, site)
+                        got.append(lid)
+                    else:
+                        scan_calls(item.context_expr, held)
+                for s in stmt.body:
+                    stmt_walk(s, held + got)
+                return
+            if isinstance(stmt, ast.Assign):
+                lid = resolve_lock(stmt.value) if isinstance(
+                    stmt.value, (ast.Name, ast.Attribute)) else None
+                if lid:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            aliases[t.id] = lid
+            # scan this statement's own expressions (not nested stmts)
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    scan_calls(child, held)
+                elif isinstance(child, ast.withitem):
+                    pass
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list):
+                    for s in sub:
+                        if isinstance(s, ast.stmt):
+                            stmt_walk(s, held)
+            for h in getattr(stmt, "handlers", ()):
+                for s in h.body:
+                    stmt_walk(s, held)
+
+        for stmt in fnode.body:
+            stmt_walk(stmt, [])
+
+    def _typeof(self, info, cls, expr):
+        if isinstance(expr, ast.Attribute) \
+                and _dotted(expr.value) == "self" and cls:
+            return info.attr_types.get((cls, expr.attr))
+        return None
+
+    def _class_lock(self, owner, attr):
+        for info in self.infos.values():
+            ent = info.class_locks.get((owner, attr))
+            if ent:
+                return ent
+        return None
+
+    def _class_method(self, owner, attr):
+        for path, info in self.infos.items():
+            if owner in info.class_names and (owner + "." + attr) \
+                    in info.defs:
+                return (path, owner + "." + attr)
+        return None
+
+    def _edge(self, src, dst, site):
+        if src == dst:
+            return  # reentrant re-acquisition, not an ordering
+        self.edges.setdefault((src, dst), site)
+
+    # ------------------------------------------------------------ fixpoint
+    def _apply_transitive(self):
+        acq = {k: set(v) for k, v in self._direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fn, records in self._calls.items():
+                mine = acq.setdefault(fn, set())
+                for _held, callee, _site in records:
+                    extra = acq.get(callee, ())
+                    if not set(extra) <= mine:
+                        mine |= set(extra)
+                        changed = True
+        self.acq = acq
+        # transitive BLOCKING too: the motivating shapes put the queue
+        # pop / event wait in a helper the lock-holder calls — lexical
+        # detection alone would miss the advertised bug class entirely
+        blk = {k: set(v) for k, v in self._fn_blocking.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fn, records in self._calls.items():
+                mine = blk.setdefault(fn, set())
+                for _held, callee, _site in records:
+                    extra = blk.get(callee, set())
+                    if not extra <= mine:
+                        mine |= extra
+                        changed = True
+        seen_blk = set(map(tuple, self.blocking))
+        for fn, records in self._calls.items():
+            for held, callee, site in records:
+                if not held:
+                    continue
+                for m in acq.get(callee, ()):
+                    for h in held:
+                        self._edge(h, m, site)
+                for kind, _bpath, _bline, wlid in sorted(
+                        blk.get(callee, ()), key=lambda r: r[:3]):
+                    if wlid is not None and wlid in held:
+                        continue  # condition-wait on a lock WE hold
+                    rec = (tuple(held),
+                           "%s (inside %s, reached from this call)"
+                           % (kind, callee[1]), site[0], site[1])
+                    if rec not in seen_blk:
+                        seen_blk.add(rec)
+                        self.blocking.append(rec)
+
+    # ------------------------------------------------------------- queries
+    def nodes(self):
+        out = set(self.acquire_fns)
+        for s, d in self.edges:
+            out.add(s)
+            out.add(d)
+        return sorted(out)
+
+    def cycles(self):
+        """Strongly-connected components with more than one node, each
+        returned as a canonically-rotated tuple of lock ids."""
+        adj = {}
+        for s, d in self.edges:
+            adj.setdefault(s, set()).add(d)
+        index, low, stack, on = {}, {}, [], set()
+        sccs, counter = [], [0]
+
+        def strong(v):
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            for w in adj.get(v, ()):
+                if w not in index:
+                    strong(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+
+        for v in sorted(set(adj) | {d for ds in adj.values()
+                                    for d in ds}):
+            if v not in index:
+                strong(v)
+        out = []
+        for comp in sccs:
+            comp = sorted(comp)
+            out.append(tuple(comp))
+        return sorted(out)
+
+    def cycle_edges(self, cycle):
+        """The example sites of the edges inside one cycle (for the
+        finding message and the DOT dump)."""
+        nodes = set(cycle)
+        return {(s, d): site for (s, d), site in sorted(self.edges.items())
+                if s in nodes and d in nodes}
+
+    def to_dot(self):
+        lines = ["digraph lock_order {", "  rankdir=LR;",
+                 '  node [shape=box, fontname="monospace"];']
+        cyc_nodes = {n for c in self.cycles() for n in c}
+        for n in self.nodes():
+            style = ', color=red, penwidth=2' if n in cyc_nodes else ""
+            lines.append('  "%s" [label="%s"%s];' % (n, n, style))
+        for (s, d), (path, line, _text) in sorted(self.edges.items()):
+            color = ', color=red' if s in cyc_nodes and d in cyc_nodes \
+                else ""
+            lines.append('  "%s" -> "%s" [label="%s:%d"%s];'
+                         % (s, d, path, line, color))
+        lines.append("}")
+        return "\n".join(lines)
+
+
+def build(ctxs):
+    """Construct the LockGraph for a list of FileContexts."""
+    return LockGraph(ctxs)

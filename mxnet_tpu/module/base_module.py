@@ -190,7 +190,7 @@ class BaseModule:
             # every batch device-resident until the end would grow HBM
             # residency with dataset size — while the old default-context
             # nd.array() wrap re-STAGED each batch on the accelerator
-            outputs = [nd.array(out[0 : out.shape[0] - pad].asnumpy(),  # fwlint: disable=host-sync-in-hot-path — result materialization (bounded, cpu-pinned): predict outputs leave the device here by design
+            outputs = [nd.array(out[0 : out.shape[0] - pad].asnumpy(),  # fwlint: disable=device-escape — result materialization (bounded, cpu-pinned): predict outputs leave the device here by design
                                 ctx=ctx_mod.cpu())
                        for out in self.get_outputs()]
             output_list.append(outputs)
@@ -206,7 +206,7 @@ class BaseModule:
                     + "in mini-batches. Maybe bucketing is used?"
                 )
             output_list2 = [
-                nd.array(np.concatenate([out[i].asnumpy() for out in output_list]))  # fwlint: disable=host-sync-in-hot-path — merging host-resident batch results, no device sync
+                nd.array(np.concatenate([out[i].asnumpy() for out in output_list]))  # fwlint: disable=device-escape — merging host-resident batch results, no device sync
                 for i in range(num_outputs)
             ]
             if num_outputs == 1 and not always_output_list:

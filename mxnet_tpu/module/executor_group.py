@@ -59,7 +59,7 @@ def _load_general(data, targets, major_axis):
             # without a host round trip (the full-slice __setitem__ casts
             # to the bound dtype on device); host sources slice in numpy
             if not isinstance(d_src, (nd.NDArray, np.ndarray)):
-                # fwlint: disable=host-sync-in-hot-path — host list/tuple input: construction, not a device sync
+                # fwlint: disable=device-escape — host list/tuple input: construction, not a device sync
                 d_src = np.array(d_src)
             for sl, d_dst in d_targets:
                 d_dst[:] = d_src[sl]

@@ -106,7 +106,7 @@ class FusedFitPath:
             mod = self._mod
             for n in missing:
                 st.params[n] = jax.device_put(
-                    mod._arg_params[n].asnumpy().astype(tr.dtype),  # fwlint: disable=host-sync-in-hot-path
+                    mod._arg_params[n].asnumpy().astype(tr.dtype),  # fwlint: disable=device-escape
                     tr.param_shardings[n])
                 st.states[n] = tuple(
                     jax.device_put(s, tr.param_shardings[n])
@@ -114,7 +114,7 @@ class FusedFitPath:
             for n in tr.aux_names:
                 if n not in st.auxs:
                     st.auxs[n] = jax.device_put(
-                        mod._aux_params[n].asnumpy().astype(np.float32),  # fwlint: disable=host-sync-in-hot-path
+                        mod._aux_params[n].asnumpy().astype(np.float32),  # fwlint: disable=device-escape
                         tr.repl)
             return
         mod = self._mod
@@ -130,12 +130,12 @@ class FusedFitPath:
             mod._exec_group.get_params(mod._arg_params, mod._aux_params)
         st.params = {
             n: jax.device_put(
-                mod._arg_params[n].asnumpy().astype(tr.dtype), tr.param_shardings[n]  # fwlint: disable=host-sync-in-hot-path
+                mod._arg_params[n].asnumpy().astype(tr.dtype), tr.param_shardings[n]  # fwlint: disable=device-escape
             )
             for n in tr.param_names
         }
         st.auxs = {
-            n: jax.device_put(mod._aux_params[n].asnumpy().astype(np.float32), tr.repl)  # fwlint: disable=host-sync-in-hot-path
+            n: jax.device_put(mod._aux_params[n].asnumpy().astype(np.float32), tr.repl)  # fwlint: disable=device-escape
             for n in tr.aux_names
         }
         if st.host_states is not None:
@@ -174,14 +174,13 @@ class FusedFitPath:
         mod = self._mod
         if not self.state.device_dirty or self.state.params is None:
             return
+        # full-slice NDArray assignment device_puts + casts itself: handing
+        # it the device array directly skips the numpy staging copy (and
+        # its blocking sync) the old np.asarray().astype() round-trip paid
         for n, arr in self.state.params.items():
-            mod._arg_params[n][:] = np.asarray(arr).astype(
-                mod._arg_params[n].dtype, copy=False
-            )
+            mod._arg_params[n][:] = arr
         for n, arr in self.state.auxs.items():
-            mod._aux_params[n][:] = np.asarray(arr).astype(
-                mod._aux_params[n].dtype, copy=False
-            )
+            mod._aux_params[n][:] = arr
         mod._exec_group.set_params(mod._arg_params, mod._aux_params)
         self.state.device_dirty = False
 
@@ -215,7 +214,7 @@ class FusedFitPath:
                 return arr.data
             if isinstance(arr, np.ndarray):
                 return arr
-            # fwlint: disable=host-sync-in-hot-path — host list/tuple input: construction, not a device sync
+            # fwlint: disable=device-escape — host list/tuple input: construction, not a device sync
             return np.array(arr)
 
         inputs = {}

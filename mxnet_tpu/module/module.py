@@ -201,9 +201,9 @@ class Module(BaseModule):
         }
         for name in self._arg_params:
             # checkpoint-boundary sync by design, not a per-batch path
-            self._arg_params[name][:] = self._exec_group.execs[0].arg_dict[name].asnumpy()  # fwlint: disable=host-sync-in-hot-path
+            self._arg_params[name][:] = self._exec_group.execs[0].arg_dict[name].asnumpy()  # fwlint: disable=device-escape
         for name in self._aux_params:
-            self._aux_params[name][:] = self._exec_group.execs[0].aux_dict[name].asnumpy()  # fwlint: disable=host-sync-in-hot-path
+            self._aux_params[name][:] = self._exec_group.execs[0].aux_dict[name].asnumpy()  # fwlint: disable=device-escape
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params)

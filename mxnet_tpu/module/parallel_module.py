@@ -222,7 +222,7 @@ class ParallelLMModule(BaseModule):
                 # token batch to the host just to re-upload it into the step
                 x = x.data.astype(np.int32)
             else:
-                # fwlint: disable=host-sync-in-hot-path — host list/ndarray input: a construction, not a device sync
+                # fwlint: disable=device-escape — host list/ndarray input: a construction, not a device sync
                 x = np.asarray(x, np.int32)
             if self.mode == "dense":
                 return x
@@ -318,7 +318,7 @@ class ParallelLMModule(BaseModule):
         assert self.params_initialized
         from .. import ndarray as nd
 
-        args = {n: nd.array(np.asarray(a)) for n, a in self._params.items()}
+        args = {n: nd.array(a) for n, a in self._params.items()}
         return args, {}
 
     def set_params(self, arg_params, aux_params=None, allow_missing=False,
@@ -330,7 +330,8 @@ class ParallelLMModule(BaseModule):
         for name, arr in (arg_params or {}).items():
             if name in self._params:
                 a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
-                self._params[name] = a.astype(np.asarray(self._params[name]).dtype)
+                # _params values are host numpy post-init: .dtype is direct
+                self._params[name] = a.astype(self._params[name].dtype)
             elif not allow_missing:
                 raise MXNetError("unknown parameter %s" % name)
 

@@ -461,7 +461,12 @@ def array(source_array, ctx=None, dtype=None):
     else:
         src = np.asarray(source_array)
         if dtype is None:
-            dtype = np.float32
+            # array-likes that carry a real dtype (jax device arrays) keep
+            # it, f64 narrowing as above; plain Python containers keep the
+            # framework-default fp32
+            sdt = getattr(source_array, "dtype", None)
+            dtype = (np.dtype(sdt) if sdt is not None
+                     and np.dtype(sdt) != np.float64 else np.float32)
     # copy=False: device_put below copies host memory into the device buffer
     # anyway, so an eager astype copy would stage every batch TWICE (4.8 MB
     # extra per uint8-wire batch at 32x224^2 — docs/perf.md §pipeline)

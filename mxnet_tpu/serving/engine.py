@@ -404,7 +404,7 @@ class ServingEngine:
             self.pool.k_pages, self.pool.v_pages)
         self.pool.k_pages, self.pool.v_pages = kp, vp
         # the per-step token egress: serving's output IS this transfer
-        tok = int(np.asarray(tok)[0])  # fwlint: disable=host-sync-in-hot-path — token egress to the client is the product, one scalar per prefill
+        tok = int(np.asarray(tok)[0])  # fwlint: disable=device-escape — token egress to the client is the product, one scalar per prefill
         telemetry.histogram("serving.prefill_seconds").observe(
             time.time() - t0)
         telemetry.counter("serving.prefill_tokens").inc(L)
@@ -433,7 +433,7 @@ class ServingEngine:
             self.pool.k_pages, self.pool.v_pages)
         self.pool.k_pages, self.pool.v_pages = kp, vp
         # the fused step's single device->host sync: the next-token vector
-        nxt = np.asarray(nxt)  # fwlint: disable=host-sync-in-hot-path — token egress to clients is the product, B int32s per step
+        nxt = np.asarray(nxt)  # fwlint: disable=device-escape — token egress to clients is the product, B int32s per step
         telemetry.histogram("serving.decode_batch").observe(len(reqs))
         for i, req in enumerate(reqs):
             req.context_len += 1

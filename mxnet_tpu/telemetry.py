@@ -250,10 +250,10 @@ _metrics = {}  # rendered key -> instrument
 _name_types = {}  # bare name -> instrument class (Prometheus: one type/name)
 _events = deque(maxlen=1024)
 _enabled = False
-_flusher = None  # (thread, stop_event, path, interval)
+_flusher = None  # guarded-by: _lock — (thread, stop_event, path, interval)
 _file_lock = threading.Lock()  # serializes sink appends (flusher vs events)
 _rank = None  # this process's worker rank (distributed runs); None = unset
-_collectors = []  # read-time refresh hooks (compileobs memory gauges)
+_collectors = []  # guarded-by: _lock — read-time refresh hooks (compileobs memory gauges)
 
 
 def register_collector(fn):
@@ -814,8 +814,10 @@ def _append_line(path, rec):
 
 def flush(path=None):
     """Append one snapshot record to the JSON-lines sink now."""
-    path = path or (_flusher[2] if _flusher else
-                    _expand_sink_path(_env_str("MXNET_TELEMETRY_FILE")))
+    if not path:
+        with _lock:
+            path = _flusher[2] if _flusher else None
+        path = path or _expand_sink_path(_env_str("MXNET_TELEMETRY_FILE"))
     if not path:
         return
     rec = dump(include_events=False)

@@ -60,6 +60,17 @@ def main(argv=None):
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
+    ap.add_argument("--json-out", default=None, metavar="PATH",
+                    help="ALSO write the machine-readable report to PATH "
+                         "(the CI artifact) while printing the normal "
+                         "table")
+    ap.add_argument("--dump-lock-graph", action="store_true",
+                    help="print the whole-repo lock-acquisition graph as "
+                         "DOT (cycle nodes/edges in red) and exit")
+    ap.add_argument("--explain", default=None, metavar="FINGERPRINT",
+                    help="print the dataflow chain behind one finding "
+                         "(fingerprint prefix accepted; lints the default "
+                         "scope to locate it)")
     ap.add_argument("--root", default=ROOT, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
@@ -67,6 +78,25 @@ def main(argv=None):
     if args.list_rules:
         for r in analysis.RULES:
             print(r)
+        return 0
+
+    if args.dump_lock_graph:
+        import importlib
+
+        lockgraph = importlib.import_module("mxnet_tpu.analysis.lockgraph")
+        paths = args.paths or list(DEFAULT_PATHS)
+        try:
+            ctxs, _errs = analysis.fwlint.load_contexts(paths, args.root)
+        except FileNotFoundError as err:
+            print(err, file=sys.stderr)
+            return 2
+        graph = lockgraph.build(ctxs)
+        print(graph.to_dot())
+        cycles = graph.cycles()
+        if cycles:
+            print("// %d cycle(s): %s" % (len(cycles), cycles),
+                  file=sys.stderr)
+            return 1
         return 0
 
     select = ([r.strip() for r in args.select.split(",") if r.strip()]
@@ -84,6 +114,39 @@ def main(argv=None):
     except FileNotFoundError as err:
         print(err, file=sys.stderr)
         return 2
+
+    # the artifact is written for EVERY successful lint, including the
+    # --explain and --update-baseline paths (their early returns must not
+    # silently drop a CI step's --json-out)
+    report = {"new": [f.as_dict() for f in new],
+              "baselined": [f.as_dict() for f in known],
+              "stale": stale}
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1)
+            fh.write("\n")
+
+    if args.explain:
+        want = args.explain.strip()
+        hits = [f for f in new + known
+                if f.fingerprint and f.fingerprint.startswith(want)]
+        if not hits:
+            print("fwlint: no current finding matches fingerprint %r "
+                  "(suppressed findings carry no fingerprint)" % want,
+                  file=sys.stderr)
+            return 2
+        for f in hits:
+            print("%s:%d: [%s] %s" % (f.path, f.line, f.rule, f.message))
+            print("  fingerprint: %s%s"
+                  % (f.fingerprint,
+                     "  (baselined)" if f in known else "  (NEW)"))
+            if f.chain:
+                print("  taint chain:")
+                for stepline in f.chain:
+                    print("    %s" % stepline)
+            else:
+                print("  no dataflow chain (syntactic finding)")
+        return 0
 
     if args.update_baseline:
         if not args.baseline:
@@ -113,10 +176,7 @@ def main(argv=None):
         return 0
 
     if args.as_json:
-        print(json.dumps({
-            "new": [f.as_dict() for f in new],
-            "baselined": [f.as_dict() for f in known],
-            "stale": stale}, indent=1))
+        print(json.dumps(report, indent=1))
         return 1 if new else 0
 
     # per-rule counts: the at-a-glance debt table CI prints on every run
