@@ -10,6 +10,9 @@
 #             forensics (host-only; slow e2e acceptance cases run when invoked
 #             directly)
 #   pipeline  input-pipeline feed suite: uint8 wire + async device feed (fast, host-only)
+#   perf      communication-overlap suite: bucket planner + 2-worker overlap
+#             smoke + bucketed-vs-monolithic bit-identity (fast, host-only;
+#             the slow elastic-rejoin A/B runs when invoked directly)
 #   guard     training health-guard suite: sentinel/rollback/stall/resume (fast, host-only)
 #   elastic   elastic-membership suite incl. the slow kill/rejoin e2e (host-only CPU mesh)
 #   serving   paged-KV serving engine: kernel numerics/allocator/scheduler/
@@ -228,6 +231,24 @@ run_pipeline() {
     tests_tpu/test_native_decode.py -q -m "not slow"
 }
 
+run_perf() {
+  # communication-overlap perf tier (docs/distributed.md
+  # §communication-overlap): the pure bucket-planner/meter units plus the
+  # fast overlap smoke — a 2-worker local dist fit asserting
+  # kv.overlap_seconds > 0, per-bucket push counters matching the bucket
+  # plan, and final params bit-identical to the monolithic
+  # MXNET_KV_BUCKET_MB=0 A/B (classic AND hybrid-fused dist step). The
+  # slow case (bit-identity through a mid-epoch worker kill + elastic
+  # rejoin) runs only when this stage is invoked directly, like `elastic`.
+  make -C mxnet_tpu/src
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_kv_overlap.py \
+    -q -m "not slow"
+  if [ "${1:-}" = "with_slow" ]; then
+    JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_kv_overlap.py \
+      -q -m "slow and perf"
+  fi
+}
+
 run_guard() {
   # training health-guard tier (docs/fault_tolerance.md §health-guard):
   # NaN/stall sentinel, skip/rollback/abort policy ladder, iterator position
@@ -382,6 +403,7 @@ case "$stage" in
   faults) run_faults ;;
   telemetry) run_telemetry with_slow ;;
   pipeline) run_pipeline ;;
+  perf) run_perf with_slow ;;
   guard) run_guard ;;
   elastic) run_elastic ;;
   serving) run_serving with_slow ;;
@@ -395,11 +417,11 @@ case "$stage" in
   examples) run_examples ;;
   package) run_package ;;
   all) run_lint; run_native; run_predict; run_predict_native; run_entry;
-       run_package; run_faults; run_telemetry; run_pipeline; run_guard;
-       run_serving;
+       run_package; run_faults; run_telemetry; run_pipeline; run_perf;
+       run_guard; run_serving;
        JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_elastic.py -q -m "not slow";
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
                 --ignore=tests/test_train_native.py ;;
-  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|guard|elastic|serving|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|perf|guard|elastic|serving|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
 esac

@@ -152,6 +152,27 @@ def env_bool(name, default=False):
     return val
 
 
+def env_opt_bool(name):
+    """Tri-state strict boolean MXNET_*-style env var: ``True``/``False``
+    when set to a valid :func:`env_bool` token, ``None`` when unset/empty
+    (or unparseable, which warns like env_bool) — for knobs whose default
+    is a *decision* (e.g. the native-decode auto mode) rather than a fixed
+    value, where "the user explicitly said no" must be distinguishable
+    from "the user said nothing"."""
+    import os
+
+    raw = os.environ.get(name, "")
+    if not raw.strip():
+        return None
+    val = _BOOL_TOKENS.get(raw.strip().lower())
+    if val is None:
+        import logging
+
+        logging.warning("ignoring unparseable %s=%r (leaving the default "
+                        "decision to the runtime)", name, raw)
+    return val
+
+
 def env_str(name, default=None, choices=None):
     """String MXNET_*-style env var. Unset/empty falls back to ``default``.
     With ``choices``, a value outside the set warns and falls back (the

@@ -26,7 +26,7 @@ import numpy as np
 
 from . import ndarray as nd
 from . import telemetry
-from .base import MXNetError, env_bool
+from .base import MXNetError, env_opt_bool
 from .image import CreateAugmenter, imdecode, imdecode_np
 from .io import DataBatch, DataDesc, DataIter, WireSpec
 from . import recordio
@@ -45,6 +45,25 @@ def _close_live_iters():
             it.close()
         except Exception:  # fwlint: disable=swallowed-exception —
             pass  # interpreter is going down; nowhere left to report
+
+
+_LEGACY_OPTOUT_WARNED = set()
+
+
+def _warn_legacy_optout(var):
+    """One-line deprecation-style warning when an env var explicitly forces
+    the legacy path the round-13 default-on flip replaced (once per
+    process per variable — a per-iterator warning would spam every epoch's
+    pipeline rebuild)."""
+    if var in _LEGACY_OPTOUT_WARNED:
+        return
+    _LEGACY_OPTOUT_WARNED.add(var)
+    logging.warning(
+        "%s=0 forces the legacy Python/fp32 input path; since round 13 the "
+        "native decode stage + uint8 wire are the default wherever the "
+        "eligibility gate passes, and the legacy opt-out is deprecated — "
+        "unset %s unless you depend on the old numerics (docs/env_var.md)",
+        var, var)
 
 
 def _mean_std(mean_r, mean_g, mean_b, std_r, std_g, std_b):
@@ -81,25 +100,44 @@ class ImageRecordIter(DataIter):
         # decode backend (docs/env_var.md MXNET_NATIVE_DECODE): 'native'
         # requests the C++ decode->augment->batch stage (src/pipe.cc),
         # 'python' pins the threaded PIL/numpy pipeline, None defers to the
-        # env var. The native stage produces uint8-HWC wire batches, so an
-        # explicit backend='native' implies the uint8 wire unless the caller
-        # pinned wire_dtype themselves. Configs the native stage cannot
-        # express fall back to the Python path (counted always-on in
-        # io.native_decode_fallback{reason=...}).
+        # env var — and since round 13 the env DEFAULT is on: with nothing
+        # pinned, the native stage + uint8 wire engage wherever the
+        # eligibility gate passes (the probe below), and every ineligible
+        # config falls back to the legacy path with the always-on
+        # io.native_decode_fallback{reason=...} counter naming why. An
+        # explicit backend='native' implies the uint8 wire unless the
+        # caller pinned wire_dtype themselves; an explicit
+        # MXNET_NATIVE_DECODE=0 / MXNET_WIRE_UINT8=0 forces the legacy
+        # path (deprecation-warned).
         if backend not in (None, "python", "native"):
             raise MXNetError("backend must be 'python' or 'native', got %r"
                              % (backend,))
         self._backend = backend
+        self._native_fallback_why = None
+        native_env = env_opt_bool("MXNET_NATIVE_DECODE")
+        if backend is None and native_env is False:
+            _warn_legacy_optout("MXNET_NATIVE_DECODE")
         if backend == "native" and wire_dtype is None and self._supports_wire():
             wire_dtype = "uint8"
         mean, std = _mean_std(mean_r, mean_g, mean_b, std_r, std_g, std_b)
-        # uint8 wire (default off; docs/env_var.md MXNET_WIRE_UINT8): batches
-        # stay uint8 HWC end-to-end on the host — 4x less host->device wire
-        # than fp32 — and the mean/std normalize + HWC->CHW transpose defer
-        # to one on-device program at the executor boundary (io.WireSpec).
+        # uint8 wire (docs/env_var.md MXNET_WIRE_UINT8): batches stay uint8
+        # HWC end-to-end on the host — 4x less host->device wire than fp32 —
+        # and the mean/std normalize + HWC->CHW transpose defer to one
+        # on-device program at the executor boundary (io.WireSpec).
         # provide_data keeps advertising the POST-decode fp32 NCHW desc.
         explicit = wire_dtype is not None
-        if wire_dtype is None and env_bool("MXNET_WIRE_UINT8"):
+        wire_env = env_opt_bool("MXNET_WIRE_UINT8")
+        if wire_dtype is None and wire_env is True:
+            wire_dtype = "uint8"
+        elif wire_dtype is None and wire_env is False and self._supports_wire():
+            _warn_legacy_optout("MXNET_WIRE_UINT8")
+        # round-13 auto mode: backend unpinned and not opted out — probe the
+        # native gate after the pipeline config is assembled; the uint8 wire
+        # rides along tentatively when nothing pinned it either
+        auto_backend = backend is None and native_env is not False
+        auto_wire = (auto_backend and wire_dtype is None and wire_env is None
+                     and self._supports_wire())
+        if auto_wire:
             wire_dtype = "uint8"
         if wire_dtype not in (None, "float32", "uint8"):
             raise MXNetError("wire_dtype must be 'float32' or 'uint8', got %r"
@@ -110,24 +148,31 @@ class ImageRecordIter(DataIter):
                     "%s does not support wire_dtype='uint8'"
                     % type(self).__name__)
             wire_dtype = None  # env-var default: fall back quietly
-        self._wire = WireSpec(mean, std, "NHWC") if wire_dtype == "uint8" else None
-        if self._wire is not None:
-            mean = std = None  # normalize moves on-device
-        self.auglist = self._build_auglist(
-            resize=resize, rand_crop=rand_crop,
-            rand_resize=rand_resize, rand_mirror=rand_mirror, mean=mean, std=std,
-            brightness=brightness or max_random_illumination / 255.0,
-            contrast=contrast or max_random_contrast,
-            saturation=saturation, pca_noise=pca_noise,
-        )
-        if self._wire is not None:
-            # drop the unconditional uint8->fp32 CastAug: the wire path stays
-            # uint8 end-to-end on the host (the cast happens on device), and
-            # keeping it would pay a float round-trip + rint per image
-            from .image import CastAug
 
-            self.auglist = [a for a in self.auglist
-                            if not isinstance(a, CastAug)]
+        def _config_wire(on):
+            self._wire = WireSpec(mean, std, "NHWC") if on else None
+            self.auglist = self._build_auglist(
+                resize=resize, rand_crop=rand_crop,
+                rand_resize=rand_resize, rand_mirror=rand_mirror,
+                # with the wire on, normalize moves on-device
+                mean=None if on else mean, std=None if on else std,
+                brightness=brightness or max_random_illumination / 255.0,
+                contrast=contrast or max_random_contrast,
+                saturation=saturation, pca_noise=pca_noise,
+            )
+            if on:
+                # drop the unconditional uint8->fp32 CastAug: the wire path
+                # stays uint8 end-to-end on the host (the cast happens on
+                # device), and keeping it would pay a float round-trip +
+                # rint per image
+                from .image import CastAug
+
+                self.auglist = [a for a in self.auglist
+                                if not isinstance(a, CastAug)]
+
+        _config_wire(wire_dtype == "uint8")
+        self._auto_backend = auto_backend
+        self._auto_wire = auto_wire
         self.path_imgrec = path_imgrec
         self.path_imgidx = path_imgidx
         self.shuffle = shuffle
@@ -152,6 +197,20 @@ class ImageRecordIter(DataIter):
         from .base import env_int
 
         self._max_bad = env_int("MXNET_IO_MAX_BAD_RECORDS", None)
+        if auto_backend:
+            # the default-on gate, decided ONCE per iterator: an ineligible
+            # config is counted with its true reason and reverted to the
+            # legacy pipeline (including the tentative uint8 wire — the
+            # flip never changes numerics where the native stage cannot
+            # run), so reset()/set_partition rebuilds never re-probe or
+            # double-count
+            why = self._native_eligibility()
+            if why is not None:
+                self._native_fallback_why = why
+                telemetry.counter("io.native_decode_fallback",
+                                  reason=why).inc()
+                if auto_wire:
+                    _config_wire(False)
         self._start_pipeline()
 
     def _supports_wire(self):
@@ -200,7 +259,17 @@ class ImageRecordIter(DataIter):
     def _native_requested(self):
         if self._backend == "native":
             return True
-        return self._backend is None and env_bool("MXNET_NATIVE_DECODE")
+        if self._backend is not None:
+            return False
+        if self._native_fallback_why is not None:
+            # the construction-time gate already reverted this config (and
+            # counted the reason) — a pipeline rebuild must not re-probe
+            return False
+        env = env_opt_bool("MXNET_NATIVE_DECODE")
+        if env is not None:
+            return env
+        # round-13 default-on: nothing pinned and the gate passed
+        return self._auto_backend
 
     def _native_aug_plan(self):
         """Map ``auglist`` onto the native stage's fixed resize->crop->flip

@@ -35,7 +35,8 @@ from . import telemetry
 from .ndarray import NDArray
 from . import optimizer as opt
 
-__all__ = ["KVStore", "create", "TELEMETRY_KEY_BASE", "telemetry_slot"]
+__all__ = ["KVStore", "create", "TELEMETRY_KEY_BASE", "telemetry_slot",
+           "plan_buckets", "DEFAULT_KV_BUCKET_MB"]
 
 # ---------------------------------------------------------------------------
 # cluster observability plane (docs/observability.md §cluster)
@@ -102,6 +103,92 @@ def _pick_straggler(snaps, factor=2.0, max_age_s=None, now=None):
             "self_time": round(self_time, 6), "median": round(median, 6),
             "ratio": round(self_time / median, 3),
             "step_time": round(step_time, 6), "stages": stages}
+
+
+# ---------------------------------------------------------------------------
+# gradient bucketing + communication overlap (docs/distributed.md
+# §communication-overlap)
+# ---------------------------------------------------------------------------
+DEFAULT_KV_BUCKET_MB = 4.0
+
+
+def plan_buckets(nbytes_list, bucket_bytes):
+    """Partition a FORWARD-topological list of gradient sizes into
+    size-bounded buckets, returned in REVERSE order (last layers first —
+    the order backward materializes gradients, so the first bucket's push
+    can leave the worker while earlier layers are still being staged).
+
+    Pure function over byte sizes: returns a list of index lists into
+    ``nbytes_list``. A bucket closes once its cumulative size reaches
+    ``bucket_bytes``; a single entry larger than the bound gets its own
+    bucket (it cannot be split — the per-key wire protocol is preserved,
+    bucketing only changes RPC *scheduling*, never key layout or server
+    arithmetic)."""
+    bucket_bytes = max(float(bucket_bytes), 1.0)
+    buckets = []
+    cur, cur_bytes = [], 0.0
+    for i in reversed(range(len(nbytes_list))):
+        sz = float(nbytes_list[i])
+        if cur and cur_bytes + sz > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0.0
+        cur.append(i)
+        cur_bytes += sz
+        if cur_bytes >= bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0.0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+class _StepSyncMeter:
+    """Attribution for one step's bucketed parameter sync: engine threads
+    accumulate each push/pull RPC's busy wall, the issuing thread records
+    its blocking harvest waits, and ``overlap_seconds`` is the busy wall
+    in excess of the wait — RPC time hidden behind compute/staging OR
+    behind other concurrent RPCs, either way communication the serialized
+    per-key baseline would have paid for in step wall and this step did
+    not. (Per-key latencies include server-side BSP peer-waits, so N
+    concurrent pulls inside one short harvest sum to N× that wait — the
+    excess-over-wait form attributes that parallelism correctly, where a
+    span-vs-window intersection would misread it as serialized.) The PR 7
+    cluster-stats ``kv_sync`` split reports the serialized remainder
+    (``docs/observability.md``)."""
+
+    __slots__ = ("_lock", "busy_seconds", "wait_seconds")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.busy_seconds = 0.0  # guarded-by: _lock
+        self.wait_seconds = 0.0
+
+    def add_busy(self, seconds):
+        with self._lock:
+            self.busy_seconds += seconds
+
+    def timed(self, fn):
+        """Wrap ``fn`` so its wall (on whatever thread runs it) lands in
+        this meter's busy total."""
+        def run():
+            t0 = time.perf_counter()
+            try:
+                return fn()
+            finally:
+                self.add_busy(time.perf_counter() - t0)
+        return run
+
+    def wait(self, fn):
+        """Run blocking harvest work, recording the wall it blocked for."""
+        t0 = time.perf_counter()
+        try:
+            return fn()
+        finally:
+            self.wait_seconds += time.perf_counter() - t0
+
+    def overlap_seconds(self):
+        with self._lock:
+            return max(self.busy_seconds - self.wait_seconds, 0.0)
 
 
 def _key_list(key):
@@ -786,7 +873,7 @@ class KVStoreDist(KVStore):
                 self._zpush(self._ikey(k), vs[0].asnumpy())
         self.barrier()
 
-    def push(self, key, value, priority=0):
+    def push(self, key, value, priority=0, _meter=None):
         keys, single = _key_list(key)
         if single:
             grouped = [[value]] if isinstance(value, NDArray) else [list(value)]
@@ -798,9 +885,11 @@ class KVStoreDist(KVStore):
                       else self._comm.reduce(vs))
             arr = merged.asnumpy()
             ikey = self._ikey(k)
-            self._engine.push(
-                lambda ikey=ikey, arr=arr: self._zpush(ikey, arr),
-                mutable_vars=[self._var(k)], priority=priority)
+            fn = lambda ikey=ikey, arr=arr: self._zpush(ikey, arr)  # noqa: E731
+            if _meter is not None:
+                fn = _meter.timed(fn)
+            self._engine.push(fn, mutable_vars=[self._var(k)],
+                              priority=priority)
 
     def pull(self, key, out=None, priority=0):
         assert out is not None
@@ -818,6 +907,127 @@ class KVStoreDist(KVStore):
             flat = self._zpull(self._ikey(k), n)
             src = NDArray(flat.reshape(os_[0].shape), ctx=os_[0].context)
             self._comm.broadcast(src, os_)
+
+    # ---- gradient bucketing / communication overlap ----------------------
+    # docs/distributed.md §communication-overlap: the distributed step
+    # issues its push per size-bounded bucket as gradients materialize
+    # (reverse-topological order) and runs each bucket's pull as an engine
+    # op ordered after that key's push — so RPC round-trips overlap the
+    # remaining staging/optimizer work instead of serializing at step end.
+    def bucket_bytes_limit(self):
+        """Configured bucket bound in BYTES (``MXNET_KV_BUCKET_MB``), or
+        None when bucketing is disabled (``MXNET_KV_BUCKET_MB=0``)."""
+        from .base import env_float
+
+        mb = env_float("MXNET_KV_BUCKET_MB", DEFAULT_KV_BUCKET_MB)
+        if mb is None or mb <= 0:
+            return None
+        return mb * (1 << 20)
+
+    def begin_step_sync(self):
+        """Start attribution for one step's bucketed parameter sync."""
+        return _StepSyncMeter()
+
+    def pull_async(self, key, out=None, priority=0, _meter=None):
+        """Schedule a pull as an engine op ordered after this key's pushes
+        (same per-key FIFO var), broadcasting into ``out`` on an engine
+        thread. The caller harvests with :meth:`wait_key` — until then the
+        RPC round-trip runs concurrently with whatever the caller does
+        next. A transport/membership failure is recorded in the engine's
+        error slot and re-raised from the harvest wait.
+
+        Only the ``_zpull`` RPC wall is charged to the meter — the same
+        window ``kvstore.pull_latency_seconds`` observes — so the overlap
+        subtracted from the push+pull totals in ``_snapshot_cumulative``
+        can never contain broadcast/staging wall those totals lack (which
+        would under-report ``kv_sync``)."""
+        assert out is not None
+        k = key
+        os_ = [out] if isinstance(out, NDArray) else list(out)
+        n = int(np.prod(os_[0].shape))
+        ikey = self._ikey(k)
+
+        def run():
+            zpull = (lambda: self._zpull(ikey, n)) if _meter is None \
+                else _meter.timed(lambda: self._zpull(ikey, n))
+            flat = zpull()
+            src = NDArray(flat.reshape(os_[0].shape), ctx=os_[0].context)
+            self._comm.broadcast(src, os_)
+
+        self._engine.push(run, mutable_vars=[self._var(k)], priority=priority)
+
+    def wait_key(self, key):
+        """Block until every scheduled push/pull for ``key`` completed; a
+        recorded engine error (failed push, stale membership epoch) is
+        re-raised here."""
+        self._engine.wait_for_var(self._var(key))
+
+    def note_buckets(self, nbuckets):
+        """Publish this step's bucket plan size (always-on: the overlap
+        smoke asserts per-bucket push counters match the plan)."""
+        telemetry.gauge("kv.buckets").set(nbuckets)
+
+    def note_bucket_pushed(self, nkeys):
+        """One bucket's pushes were issued (always-on counter)."""
+        del nkeys  # the counter counts bucket issues, not keys
+        telemetry.counter("kv.bucket_pushes").inc()
+
+    def finish_step_sync(self, meter):
+        """Close out a step's sync attribution: ``kv.overlap_seconds``
+        (always-on — the serialized-wait reduction must be provable from a
+        later telemetry dump) and the blocking-harvest histogram."""
+        overlap = meter.overlap_seconds()
+        if overlap > 0:
+            telemetry.counter("kv.overlap_seconds").inc(overlap)
+        if telemetry.enabled():
+            telemetry.histogram("kvstore.sync_wait_seconds").observe(
+                meter.wait_seconds)
+        return overlap
+
+    def bucketed_push_pull(self, pairs, on_bucket=None):
+        """The ONE bucketed parameter-sync driver both dist step paths run
+        (classic ``model._update_params_on_kvstore`` and the hybrid fused
+        ``fused_path._step_dist``): ``pairs`` is the FORWARD-topological
+        list of ``(int key, push value, pull out)`` — value/out in
+        whatever form :meth:`push`/:meth:`pull_async` accept (a merged
+        NDArray, or per-device lists). Issues each bucket's pushes as the
+        gradients materialize (reverse order — the local reduce + host
+        staging of key *k* overlaps the in-flight RPCs of the buckets
+        issued before it), schedules the bucket's pulls right behind them
+        on the engine, then harvests buckets in issue order;
+        ``on_bucket(bucket_pairs)`` — if given — consumes each bucket as
+        its outs complete, while later buckets' RPCs are still on the wire
+        (the fused path device_puts there). Everything is harvested before
+        returning, so the caller's next forward always reads fully-updated
+        params. Returns False when bucketing is disabled
+        (``MXNET_KV_BUCKET_MB=0``) and the caller should run the monolithic
+        per-key push→pull loop instead."""
+        limit = self.bucket_bytes_limit()
+        if limit is None:
+            return False
+
+        def _nbytes(value):
+            v0 = value[0] if isinstance(value, (list, tuple)) else value
+            return int(np.prod(v0.shape)) * 4  # fp32 wire
+
+        plan = plan_buckets([_nbytes(v) for _, v, _ in pairs], limit)
+        self.note_buckets(len(plan))
+        meter = self.begin_step_sync()
+        for bucket in plan:
+            for i in bucket:
+                key, value, _ = pairs[i]
+                self.push(key, value, priority=-key, _meter=meter)
+            for i in bucket:
+                key, _, out = pairs[i]
+                self.pull_async(key, out=out, priority=-key, _meter=meter)
+            self.note_bucket_pushed(len(bucket))
+        for bucket in plan:
+            meter.wait(lambda b=bucket: [self.wait_key(pairs[i][0])
+                                         for i in b])
+            if on_bucket is not None:
+                on_bucket([pairs[i] for i in bucket])
+        self.finish_step_sync(meter)
+        return True
 
     def set_optimizer(self, optimizer):
         if self._elastic_join:
@@ -991,8 +1201,11 @@ class KVStoreDist(KVStore):
     def _snapshot_cumulative(self):
         """Cumulative per-stage walls + step count from the LOCAL registry
         (label sets rolled up via :func:`telemetry.totals`). ``kv_sync`` is
-        everything spent synchronizing parameters: push + pull latency and
-        barrier waits."""
+        the SERIALIZED parameter-sync wait: push + pull latency and barrier
+        waits, NET of ``kv_overlap`` — the RPC time the bucketed step hid
+        behind compute/staging (docs/distributed.md §communication-overlap)
+        never stalled the step, so charging it would mask exactly the win
+        the split exists to measure."""
         steps, step_sum = telemetry.totals("fit.step_time_seconds")
         _, data_wait = telemetry.totals("fit.data_wait_seconds")
         _, compute = telemetry.totals("fit.compute_seconds")
@@ -1000,9 +1213,11 @@ class KVStoreDist(KVStore):
         _, push = telemetry.totals("kvstore.push_latency_seconds")
         _, pull = telemetry.totals("kvstore.pull_latency_seconds")
         _, barrier = telemetry.totals("kv.barrier")
+        _, overlap = telemetry.totals("kv.overlap_seconds")
         return {"steps": steps, "step_time": step_sum,
                 "data_wait": data_wait, "compute": compute,
-                "kv_sync": push + pull + barrier, "guard": guard}
+                "kv_sync": max(push + pull + barrier - overlap, 0.0),
+                "kv_overlap": overlap, "guard": guard}
 
     def _snapshot_compile(self):
         """Compact compile-observability summary for the published snapshot

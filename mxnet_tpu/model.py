@@ -67,11 +67,20 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_o
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """(reference: model.py:88)"""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
+    """(reference: model.py:88). A dist store runs the gradient-bucketed
+    overlapped sync (kvstore.bucketed_push_pull — pushes issue per bucket
+    in reverse-topological order, pulls ride the engine behind them, one
+    harvest at the end); zero-grad frozen params are skipped either way,
+    exactly like the monolithic loop. ``MXNET_KV_BUCKET_MB=0`` (or a
+    non-dist store) keeps the reference's per-key push→pull."""
+    pairs = [(index, grad_list, arg_list)
+             for index, (arg_list, grad_list)
+             in enumerate(zip(param_arrays, grad_arrays))
+             if grad_list[0] is not None]
+    bucketed = getattr(kvstore, "bucketed_push_pull", None)
+    if bucketed is not None and bucketed(pairs):
+        return
+    for index, grad_list, arg_list in pairs:
         kvstore.push(index, grad_list, priority=-index)
         kvstore.pull(index, arg_list, priority=-index)
 

@@ -259,20 +259,55 @@ class FusedFitPath:
         # classic key protocol: integer index in exec-group param order
         names = self._mod._exec_group.param_names
         update_on_kv = self._mod._update_on_kvstore
-        pulled = {}
-        for idx, name in enumerate(names):
-            if name not in grads:
-                continue
-            kv.push(idx, nd.NDArray(grads[name]), priority=-idx)
-            out_arr = nd.zeros(tuple(grads[name].shape), dtype=np.float32)
-            kv.pull(idx, out=out_arr, priority=-idx)
-            pulled[name] = out_arr
+        entries = [(idx, name) for idx, name in enumerate(names)
+                   if name in grads]
+        harvested = {}  # name -> pulled fp32 NDArray, in harvest order
+        used_bucketed = False
+        bucketed = getattr(kv, "bucketed_push_pull", None)
+        if bucketed is not None:
+            # gradient-bucketed overlap (docs/distributed.md
+            # §communication-overlap) through the ONE driver the classic
+            # path also runs: pushes issue per bucket in reverse-topological
+            # order (the first asnumpy blocks only on the fused program,
+            # every later bucket's host staging overlaps the RPCs already
+            # in flight), pulls ride the engine behind them, and the
+            # per-bucket harvest callback uploads bucket k's server-updated
+            # weights while bucket k+1's pulls are still on the wire.
+            name_of = {}
+            pairs = []
+            for idx, name in entries:
+                name_of[idx] = name
+                pairs.append((idx, nd.NDArray(grads[name]),
+                              nd.zeros(tuple(grads[name].shape),
+                                       dtype=np.float32)))
+
+            def consume(bucket_pairs):
+                for key, _, out_arr in bucket_pairs:
+                    name = name_of[key]
+                    if update_on_kv:
+                        st.params[name] = jax.device_put(
+                            out_arr.data,
+                            tr.param_shardings[name]).astype(tr.dtype)
+                    else:
+                        harvested[name] = out_arr
+
+            used_bucketed = bucketed(pairs, on_bucket=consume)
+        if not used_bucketed:
+            # monolithic legacy (MXNET_KV_BUCKET_MB=0, or a single-process
+            # dist fallback store): per-key push→pull, fully synchronized
+            for idx, name in entries:
+                kv.push(idx, nd.NDArray(grads[name]), priority=-idx)
+                out_arr = nd.zeros(tuple(grads[name].shape),
+                                   dtype=np.float32)
+                kv.pull(idx, out=out_arr, priority=-idx)
+                harvested[name] = out_arr
         if update_on_kv:
             # server applied its optimizer: pulled values are the new
             # weights. device_put straight from the pull's backing array —
-            # the old asnumpy().astype() staged TWO host copies per key per
-            # step before every upload
-            for name, arr in pulled.items():
+            # asnumpy().astype() would stage TWO host copies per key per
+            # step before every upload. (The bucketed path already uploaded
+            # per bucket above; only the monolithic fallback lands here.)
+            for name, arr in harvested.items():
                 st.params[name] = jax.device_put(
                     arr.data, tr.param_shardings[name]).astype(tr.dtype)
         else:
@@ -280,7 +315,7 @@ class FusedFitPath:
             gdev = {
                 name: jax.device_put(
                     arr.data, tr.param_shardings[name]).astype(tr.dtype)
-                for name, arr in pulled.items()
+                for name, arr in harvested.items()
             }
             new_p, new_s = tr.apply_grads(
                 {n: st.params[n] for n in tr.param_names},
@@ -375,9 +410,11 @@ class FusedFitPath:
                 st = tr.rule.from_serial(by_name[n], tr.arg_shapes[n], tr.dtype)
             else:
                 st = tr.rule.init_state(tr.arg_shapes[n], tr.dtype)
+            # from_serial/init_state hand back correctly-dtyped host numpy:
+            # device_put stages it directly (the old np.asarray wrap was a
+            # redundant copy the device-escape rule rightly flagged)
             out[n] = tuple(
-                jax.device_put(np.asarray(s, tr.dtype), tr.param_shardings[n])
-                for s in st
+                jax.device_put(s, tr.param_shardings[n]) for s in st
             )
         return out
 

@@ -45,6 +45,18 @@ from .base_module import BaseModule
 __all__ = ["ParallelLMModule"]
 
 
+def _as_array(arr):
+    """Param-dict value -> an array usable in the jax step WITHOUT a host
+    round trip: an NDArray hands over its device buffer (no sync), numpy
+    passes through, and only exotic list/tuple inputs pay a construction."""
+    if hasattr(arr, "data") and hasattr(arr, "context"):
+        return arr.data
+    if isinstance(arr, np.ndarray):
+        return arr
+    # fwlint: disable=device-escape — host list/tuple input: construction, not a device sync
+    return np.asarray(arr)
+
+
 class ParallelLMModule(BaseModule):
     def __init__(self, vocab_size, num_layers, model_dim, num_heads, ffn_dim,
                  seq_len, mode="dense", mesh=None, num_devices=None,
@@ -86,6 +98,20 @@ class ParallelLMModule(BaseModule):
         # devices when the default platform is a single chip
         self._mesh = build_mesh({self.mode: n})
         return self._mesh
+
+    def _placed(self, a):
+        """A device-resident param value the mode's step accepts: dense
+        keeps the array as-is (single-device jit), mesh modes replicate
+        onto the trainer mesh — a value committed to ONE device would
+        collide with the shard_map device set (the ``_tokens_labels``
+        placement rule, applied to params)."""
+        if self.mode == "dense":
+            return a
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            a, NamedSharding(self._ensure_mesh(), PartitionSpec()))
 
     # ---- Module protocol -------------------------------------------------
     @property
@@ -163,16 +189,22 @@ class ParallelLMModule(BaseModule):
             for name, arr in params.items():
                 host = nd.array(arr)
                 initializer(name, host)
-                params[name] = host.asnumpy().astype(arr.dtype)
+                # keep the initialized value device-resident (astype is a
+                # device op, _placed replicates mesh modes): the old
+                # asnumpy().astype() pulled every freshly-initialized param
+                # to the host only for the first step to re-upload it
+                params[name] = self._placed(host.data.astype(arr.dtype))
         if arg_params:
             for name, arr in arg_params.items():
                 if name in params:
-                    a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
-                    if a.shape != params[name].shape:
+                    a = _as_array(arr)
+                    if tuple(a.shape) != tuple(params[name].shape):
                         raise MXNetError(
                             "shape mismatch loading %s: %s vs %s"
-                            % (name, a.shape, params[name].shape))
-                    params[name] = a.astype(params[name].dtype)
+                            % (name, tuple(a.shape),
+                               tuple(params[name].shape)))
+                    params[name] = self._placed(
+                        a.astype(params[name].dtype))
                 elif not allow_missing:
                     raise MXNetError("unknown parameter %s" % name)
         self._params = params
@@ -329,9 +361,11 @@ class ParallelLMModule(BaseModule):
             return
         for name, arr in (arg_params or {}).items():
             if name in self._params:
-                a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
-                # _params values are host numpy post-init: .dtype is direct
-                self._params[name] = a.astype(self._params[name].dtype)
+                # NDArray sources stay on device (.data + device-side cast);
+                # _params values expose .dtype directly on either backing
+                a = _as_array(arr)
+                self._params[name] = self._placed(
+                    a.astype(self._params[name].dtype))
             elif not allow_missing:
                 raise MXNetError("unknown parameter %s" % name)
 

@@ -628,6 +628,13 @@ METRIC_HELP = {
     "kvstore.push_latency_seconds":
         "per-key push latency incl. retries/backoff",
     "kvstore.pull_latency_seconds": "per-key pull latency",
+    "kvstore.sync_wait_seconds":
+        "per-step blocking wait harvesting the bucketed push/pull",
+    "kv.overlap_seconds":
+        "RPC wall hidden behind compute by gradient bucketing (always-on)",
+    "kv.bucket_pushes":
+        "gradient buckets whose pushes were issued (always-on)",
+    "kv.buckets": "gradient buckets in the current step plan (always-on)",
     "kv.barrier":
         "worker wall blocked in the PS barrier rendezvous (span histogram)",
     "kvstore.rpc_failures": "failed RPC attempts by op (always-on)",

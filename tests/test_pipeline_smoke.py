@@ -114,9 +114,11 @@ def test_close_with_full_prefetch_queue(tmp_path):
     img_dir, lst = gen_dataset(str(tmp_path), n, size)
     rec = pack(str(tmp_path), img_dir, lst)
 
+    # pinned to the Python pipeline: the contract under test is ITS thread
+    # teardown (the native stage has no Python pipeline threads to leak)
     it = mx.io_image.ImageRecordIter(
         path_imgrec=rec, data_shape=(3, size, size), batch_size=4,
-        preprocess_threads=2, prefetch_buffer=1)
+        preprocess_threads=2, prefetch_buffer=1, backend="python")
     time.sleep(0.5)               # let the pipeline fill the 1-slot queue
     t0 = time.time()
     it.close()

@@ -25,7 +25,8 @@ _HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
                     "test_pipeline_feed.py", "test_guard.py",
                     "test_analysis.py", "test_elastic.py",
                     "test_cluster_obs.py", "test_native_decode.py",
-                    "test_compileobs.py", "test_serving.py"}
+                    "test_compileobs.py", "test_serving.py",
+                    "test_kv_overlap.py"}
 
 
 def pytest_configure(config):
@@ -43,6 +44,9 @@ def pytest_configure(config):
         "markers", "elastic: elastic-membership / reshard tests (host-only)")
     config.addinivalue_line(
         "markers", "serving: paged-KV serving-engine tests (host-only)")
+    config.addinivalue_line(
+        "markers", "perf: communication-overlap / perf-smoke tests "
+                   "(host-only)")
     config.addinivalue_line("markers", "slow: long-running tests")
 
 

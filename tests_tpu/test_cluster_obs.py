@@ -310,6 +310,8 @@ def test_mxtop_render_pure():
     assert "e2/b7" in frame
     assert "(no snapshot)" in frame
     assert "mepoch=1" in frame
+    # round 13: the communication-overlap column rides beside kv%
+    assert "ovl%" in frame
 
 
 @needs_native

@@ -357,9 +357,12 @@ def test_image_record_iter_state_fast_forward(small_rec):
 
 def test_image_record_iter_skips_bad_records_by_default(small_rec):
     before = telemetry.counter("io.bad_records", source="decode").value
+    # backend pinned: fault.inject('bad_record') hooks the PYTHON decode
+    # workers (the native stage's quarantine has its own suite in
+    # test_native_decode.py, driven by genuinely corrupt records)
     it = mx.io_image.ImageRecordIter(
         path_imgrec=small_rec, data_shape=(3, 32, 32), batch_size=4,
-        preprocess_threads=1)
+        preprocess_threads=1, backend="python")
     try:
         with fault.inject("bad_record:times=2"):
             n = len(_drain_hashes(it))
@@ -375,7 +378,7 @@ def test_image_record_iter_fails_fast_past_budget(small_rec, monkeypatch):
     monkeypatch.setenv("MXNET_IO_MAX_BAD_RECORDS", "1")
     it = mx.io_image.ImageRecordIter(
         path_imgrec=small_rec, data_shape=(3, 32, 32), batch_size=4,
-        preprocess_threads=1)
+        preprocess_threads=1, backend="python")
     try:
         with fault.inject("bad_record"):  # every record bad
             with pytest.raises(MXNetError, match="MXNET_IO_MAX_BAD_RECORDS"):

@@ -341,16 +341,83 @@ def test_fallback_on_fp32_wire(rec_file):
 
 @needs_jpeg
 def test_env_var_opt_in(rec_file, monkeypatch):
-    """MXNET_NATIVE_DECODE=1 engages the stage without code changes — but
-    only on the uint8 wire (the env default never changes numerics)."""
+    """MXNET_NATIVE_DECODE=1 engages the stage without code changes; with
+    the wire unpinned the uint8 wire rides along (round 13 flipped the
+    default — the stage no longer waits for a second opt-in), while an
+    explicit wire_dtype='float32' still falls back with the counter naming
+    why."""
     monkeypatch.setenv("MXNET_NATIVE_DECODE", "1")
     it = _make(rec_file, None)
     assert it._native is not None
     it.close()
-    before = _fallback_count("wire")
     it = _make(rec_file, None, wire_dtype=None)
-    assert it._native is None  # fp32 wire: native not eligible
+    assert it._native is not None  # wire unpinned: uint8 + native engage
+    assert it._wire is not None
+    it.close()
+    before = _fallback_count("wire")
+    it = _make(rec_file, None, wire_dtype="float32")
+    assert it._native is None  # fp32 wire pinned: native not eligible
     assert _fallback_count("wire") == before + 1
+    it.close()
+
+
+@needs_jpeg
+def test_default_on_flip_and_legacy_optout(rec_file, monkeypatch, caplog):
+    """Round-13 default flip: with backend, wire_dtype AND both env vars
+    unspecified, an eligible config engages the native stage + uint8 wire;
+    MXNET_NATIVE_DECODE=0 forces the legacy path with a one-line
+    deprecation-style warning (MXNET_WIRE_UINT8=0 likewise, killing the
+    wire too)."""
+    import logging as _logging
+
+    from mxnet_tpu import io_image
+
+    monkeypatch.delenv("MXNET_NATIVE_DECODE", raising=False)
+    monkeypatch.delenv("MXNET_WIRE_UINT8", raising=False)
+    it = _make(rec_file, None, wire_dtype=None)
+    assert it._native is not None and it._wire is not None
+    it.close()
+    # explicit opt-out: legacy pipeline + deprecation warning
+    monkeypatch.setenv("MXNET_NATIVE_DECODE", "0")
+    monkeypatch.setattr(io_image, "_LEGACY_OPTOUT_WARNED", set())
+    with caplog.at_level(_logging.WARNING):
+        it = _make(rec_file, None, wire_dtype=None)
+    assert it._native is None
+    assert any("MXNET_NATIVE_DECODE=0" in r.message and "deprecated"
+               in r.message for r in caplog.records)
+    # warned once per process, not once per iterator
+    n_warn = sum(1 for r in caplog.records
+                 if "MXNET_NATIVE_DECODE=0" in r.message)
+    with caplog.at_level(_logging.WARNING):
+        it2 = _make(rec_file, None, wire_dtype=None)
+    assert sum(1 for r in caplog.records
+               if "MXNET_NATIVE_DECODE=0" in r.message) == n_warn
+    it2.close()
+    it.close()
+    caplog.clear()
+    monkeypatch.delenv("MXNET_NATIVE_DECODE")
+    monkeypatch.setenv("MXNET_WIRE_UINT8", "0")
+    monkeypatch.setattr(io_image, "_LEGACY_OPTOUT_WARNED", set())
+    with caplog.at_level(_logging.WARNING):
+        it = _make(rec_file, None, wire_dtype=None)
+    assert it._native is None and it._wire is None
+    assert any("MXNET_WIRE_UINT8=0" in r.message for r in caplog.records)
+    it.close()
+
+
+@needs_jpeg
+def test_auto_fallback_counts_true_reason_once(rec_file, monkeypatch):
+    """The auto gate counts every ineligible default config with its TRUE
+    reason, exactly once per iterator — reset()/set_partition pipeline
+    rebuilds neither re-probe nor re-count."""
+    monkeypatch.delenv("MXNET_NATIVE_DECODE", raising=False)
+    before = _fallback_count("shuffle")
+    it = _make(rec_file, None, wire_dtype=None, shuffle=True, seed=3)
+    assert it._native is None
+    assert it._wire is None  # the tentative wire reverted with the stage
+    assert _fallback_count("shuffle") == before + 1
+    it.reset()
+    assert _fallback_count("shuffle") == before + 1
     it.close()
 
 

@@ -100,11 +100,14 @@ def test_imagerecorditer_uint8_wire(tmp_path):
     n, size = 16, 16
     img_dir, lst = gen_dataset(str(tmp_path), n, size)
     rec = pack(str(tmp_path), img_dir, lst)
+    # both backends pinned to the Python pipeline: this test is the
+    # python-path uint8-wire parity oracle (round 13 flipped the default
+    # to the native stage; its own parity suite is test_native_decode)
     kw = dict(path_imgrec=rec, data_shape=(3, size, size), batch_size=4,
-              preprocess_threads=1,
+              preprocess_threads=1, backend="python",
               mean_r=MEAN[0], mean_g=MEAN[1], mean_b=MEAN[2],
               std_r=STD[0], std_g=STD[1], std_b=STD[2])
-    it_f = mx.io_image.ImageRecordIter(**kw)
+    it_f = mx.io_image.ImageRecordIter(wire_dtype="float32", **kw)
     ref = next(iter(it_f)).data[0].asnumpy()
     it_f.close()
     it_u = mx.io_image.ImageRecordIter(wire_dtype="uint8", **kw)
@@ -238,7 +241,7 @@ def test_pipeline_stage_histograms_populate(tmp_path, monkeypatch):
     try:
         it = mx.io_image.ImageRecordIter(
             path_imgrec=rec, data_shape=(3, size, size), batch_size=4,
-            preprocess_threads=1, wire_dtype="uint8")
+            preprocess_threads=1, wire_dtype="uint8", backend="python")
         feed = mio.DeviceFeedIter(it, ctx=mx.cpu(), depth=2)
         assert sum(1 for _ in feed) == n // 4
         feed.close()

@@ -130,10 +130,13 @@ def bench_decode(img_dir, n_meas=200):
 
 
 def _make_iter(rec, size, batch, threads, wire_dtype=None, backend=None):
+    # every rung pins wire AND backend explicitly: since round 13 an
+    # unpinned iterator auto-engages the native stage + uint8 wire, which
+    # would silently re-measure the legacy fp32/python rungs as B'/D-native
     return mx.io_image.ImageRecordIter(
         path_imgrec=rec, data_shape=(3, size, size), batch_size=batch,
-        preprocess_threads=threads, shuffle=False, wire_dtype=wire_dtype,
-        backend=backend)
+        preprocess_threads=threads, shuffle=False,
+        wire_dtype=wire_dtype or "float32", backend=backend or "python")
 
 
 def _windows(it, batch, n_batches, reps, consume):
@@ -180,13 +183,19 @@ def bench_iter(rec, size, batch, threads, n_batches=30, reps=5,
 
 
 def bench_transfer(rec, size, batch, threads, ctx, n_batches=30, reps=5,
-                   wire_dtype=None):
-    """Ladder rung C: batches into a no-op device consumer — each batch is
-    uploaded to ``ctx`` (+ on-device wire decode) and synced, nothing else.
-    The delta vs rung B is pure host->device wire cost."""
+                   wire_dtype=None, backend=None):
+    """Ladder rung C (C' with ``backend='native'``): batches into a no-op
+    device consumer — each batch is uploaded to ``ctx`` (+ on-device wire
+    decode) and synced, nothing else. The delta vs rung B is pure
+    host->device wire cost; C' vs B' isolates the same wire on the native
+    stage (round 13's shared-core acceptance compares C' to B')."""
     import jax
 
-    it = _make_iter(rec, size, batch, threads, wire_dtype)
+    it = _make_iter(rec, size, batch, threads, wire_dtype, backend)
+    if backend == "native" and it._native is None:
+        emit("rec_device_put_native_unavailable", 1, "flag")
+        it.close()
+        return None
 
     def consume(b):
         staged = mx.io.DataBatch(
@@ -205,7 +214,8 @@ def bench_transfer(rec, size, batch, threads, ctx, n_batches=30, reps=5,
     med, lo, hi = _emit_band(
         "rec_device_put_imgs_per_sec", rates, "img/s",
         {"threads": threads, "batch": batch, "device": str(ctx),
-         "wire": wire_dtype or "float32", "wire_mb_per_batch": round(wire_mb, 2)})
+         "wire": wire_dtype or "float32", "backend": backend or "python",
+         "wire_mb_per_batch": round(wire_mb, 2)})
     return med, lo, hi
 
 
@@ -265,6 +275,31 @@ def bench_overlapped(rec, size, batch, threads, reps=5, wire_dtype=None,
          "wire": wire_dtype or "float32", "feed_depth": feed_depth,
          "backend": backend or "python"})
     return med, lo, hi
+
+
+def _kv_split():
+    """The kv_sync-vs-compute split for the rung that just ran, from the
+    registry totals — the SAME arithmetic as the cluster-stats snapshot
+    (`kvstore._snapshot_cumulative`): kv_sync is the serialized
+    parameter-sync wait (push + pull + barrier net of the bucketed
+    overlap), compute is the fit compute wall net of that wait. On a
+    single-process ladder the kv numbers are 0 by construction; the
+    columns exist so a dist A/B of the same rungs (docs/perf.md round 13)
+    is attributable in the same table."""
+    _, push = telemetry.totals("kvstore.push_latency_seconds")
+    _, pull = telemetry.totals("kvstore.pull_latency_seconds")
+    _, barrier = telemetry.totals("kv.barrier")
+    _, overlap = telemetry.totals("kv.overlap_seconds")
+    _, compute = telemetry.totals("fit.compute_seconds")
+    kv_sync = max(push + pull + barrier - overlap, 0.0)
+    return {"kv_sync_s": round(kv_sync, 3),
+            "kv_overlap_s": round(overlap, 3),
+            "compute_s": round(max(compute - kv_sync, 0.0), 3)}
+
+
+def _fmt_split(sp):
+    return "kv %.2f / ovl %.2f / comp %.2f" % (
+        sp["kv_sync_s"], sp["kv_overlap_s"], sp["compute_s"])
 
 
 def _stage_p50s():
@@ -341,49 +376,89 @@ def main():
         rows.append(("B' NATIVE decode+augment+batch -> null (2 thr, uint8)",
                      None, "unavailable (no native lib / JPEG backend)"))
 
-    # C: + host->device transfer (no-op consumer)
+    # C: + host->device transfer (no-op consumer). Each C/D rung resets the
+    # registry first so its kv_sync-vs-compute split (the round-13 overlap
+    # attribution) covers exactly that rung.
+    telemetry.reset()
+    telemetry.enable()
     c_f = bench_transfer(rec, a.size, a.batch, 2, ctx, nb, a.reps)
+    sp_cf = _kv_split()
+    emit("kv_split_c_fp32", 0, "s", sp_cf)
+    telemetry.reset()
+    telemetry.enable()
     c_u = bench_transfer(rec, a.size, a.batch, 2, ctx, nb, a.reps,
                          wire_dtype="uint8")
+    sp_cu = _kv_split()
+    emit("kv_split_c_uint8", 0, "s", sp_cu)
+    telemetry.reset()
+    telemetry.enable()
+    c_n = bench_transfer(rec, a.size, a.batch, 2, ctx, nb, a.reps,
+                         wire_dtype="uint8", backend="native")
+    sp_cn = _kv_split() if c_n is not None else None
+    if sp_cn is not None:
+        # no emit when the rung never ran: an all-zero split for a skipped
+        # native rung would be indistinguishable from a real zero
+        emit("kv_split_c_native", 0, "s", sp_cn)
     fp32_mb = a.batch * a.size * a.size * 3 * 4 / 1e6
     rows.append(("C + host->device upload (fp32, %.1f MB/batch)" % fp32_mb,
-                 None, _fmt(*c_f)))
+                 _fmt_split(sp_cf), _fmt(*c_f)))
     rows.append(("C + host->device upload (uint8, %.1f MB/batch)"
-                 % (fp32_mb / 4), None, _fmt(*c_u)))
+                 % (fp32_mb / 4), _fmt_split(sp_cu), _fmt(*c_u)))
+    rows.append(("C' + host->device upload (uint8, NATIVE decode)",
+                 _fmt_split(sp_cn) if c_n is not None else None,
+                 _fmt(*c_n) if c_n is not None
+                 else "unavailable (no native lib / JPEG backend)"))
+    if c_n is not None and b_n is not None:
+        # round-13 shared-core acceptance: the default-on native stage
+        # should make upload ~free relative to decode (C' -> B')
+        emit("native_c_vs_bprime", c_n[0] / b_n[0], "x",
+             {"c_native": round(c_n[0], 1), "b_native": round(b_n[0], 1)})
 
     # D: the full train step
     telemetry.reset()
     telemetry.enable()
     d_f = bench_overlapped(rec, a.size, a.batch, 2, a.reps)
+    sp_df = _kv_split()
     emit("stage_p50s_fp32", 0, "s", {"p50": _stage_p50s()})
+    emit("kv_split_d_fp32", 0, "s", sp_df)
     telemetry.reset()
     telemetry.enable()
     d_u = bench_overlapped(rec, a.size, a.batch, 2, a.reps,
                            wire_dtype="uint8")
+    sp_du = _kv_split()
     emit("stage_p50s_uint8", 0, "s", {"p50": _stage_p50s()})
+    emit("kv_split_d_uint8", 0, "s", sp_du)
     telemetry.reset()
     telemetry.enable()
     d_uf = bench_overlapped(rec, a.size, a.batch, 2, a.reps,
                             wire_dtype="uint8", feed_depth=2)
+    sp_duf = _kv_split()
     emit("stage_p50s_uint8_feed", 0, "s", {"p50": _stage_p50s()})
+    emit("kv_split_d_uint8_feed", 0, "s", sp_duf)
     telemetry.reset()
     telemetry.enable()
     d_un = bench_overlapped(rec, a.size, a.batch, 2, a.reps,
                             wire_dtype="uint8", backend="native")
+    sp_dun = _kv_split()
     emit("stage_p50s_uint8_native", 0, "s", {"p50": _stage_p50s()})
-    rows.append(("D full train step (fp32 wire)", None, _fmt(*d_f)))
-    rows.append(("D full train step (uint8 wire)", None, _fmt(*d_u)))
-    rows.append(("D full train step (uint8 wire + feed depth 2)", None,
-                 _fmt(*d_uf)))
-    rows.append(("D full train step (uint8 wire + NATIVE decode)", None,
+    emit("kv_split_d_uint8_native", 0, "s", sp_dun)
+    rows.append(("D full train step (fp32 wire)", _fmt_split(sp_df),
+                 _fmt(*d_f)))
+    rows.append(("D full train step (uint8 wire)", _fmt_split(sp_du),
+                 _fmt(*d_u)))
+    rows.append(("D full train step (uint8 wire + feed depth 2)",
+                 _fmt_split(sp_duf), _fmt(*d_uf)))
+    rows.append(("D full train step (uint8 wire + NATIVE decode)",
+                 _fmt_split(sp_dun) if d_un is not None else None,
                  _fmt(*d_un) if d_un is not None
                  else "unavailable (no native lib / JPEG backend)"))
 
     print("\n### attribution ladder (paste into docs/perf.md)\n")
-    print("| ladder rung | img/s (median, band) |")
-    print("|---|---|")
-    for name, _, val in rows:
-        print("| %s | %s |" % (name, val))
+    print("| ladder rung | img/s (median, band) | kv_sync / overlap / "
+          "compute (s) |")
+    print("|---|---|---|")
+    for name, split, val in rows:
+        print("| %s | %s | %s |" % (name, val, split or "—"))
 
 
 if __name__ == "__main__":

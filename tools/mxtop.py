@@ -163,10 +163,11 @@ def render(snaps, membership=None, straggler_factor=2.0, now=None):
         head += "  STRAGGLER: rank %d (%s, %.1fx)" % (
             straggler["rank"], straggler["stage"], straggler["ratio"])
     lines.append(head)
-    lines.append("%-5s %-12s %9s %9s %6s %6s %6s %7s %5s %5s %5s %7s %5s %6s"
+    lines.append("%-5s %-12s %9s %9s %6s %6s %6s %6s %7s %5s %5s %5s %7s "
+                 "%5s %6s"
                  % ("rank", "step", "imgs/s", "step_ms", "data%", "comp%",
-                    "kv%", "guard%", "engq", "feedq", "rej", "cmpl_s",
-                    "rcmp", "age"))
+                    "kv%", "ovl%", "guard%", "engq", "feedq", "rej",
+                    "cmpl_s", "rcmp", "age"))
     for rank in sorted(snaps):
         s = snaps[rank]
         if not s:
@@ -183,14 +184,19 @@ def render(snaps, membership=None, straggler_factor=2.0, now=None):
         comp = s.get("compile") or {}
         age = now - float(s.get("ts", now))
         lines.append(
-            "%-5d %-12s %9.1f %9.1f %6s %6s %6s %7s %5d %5d %5d %7.1f %5d "
-            "%5.1fs"
+            "%-5d %-12s %9.1f %9.1f %6s %6s %6s %6s %7s %5d %5d %5d %7.1f "
+            "%5d %5.1fs"
             % (rank, _decode_step(s.get("step_id")),
                float(s.get("imgs_per_sec", 0.0)),
                (wall / steps * 1000.0) if steps else 0.0,
                _pct(w.get("data_wait", 0.0), wall),
                _pct(w.get("compute", 0.0), wall),
                _pct(w.get("kv_sync", 0.0), wall),
+               # RPC wall the bucketed sync hid behind compute (can exceed
+               # the step wall on many-bucket plans; shown vs wall anyway —
+               # the interesting signal is kv% shrinking while ovl% carries
+               # the traffic)
+               _pct(w.get("kv_overlap", 0.0), wall),
                _pct(w.get("guard", 0.0), wall),
                int(q.get("engine", 0)), int(q.get("feed", 0)),
                int(c.get("rejected", 0)),
