@@ -3,6 +3,9 @@
 # docker-matrix build/test driver). One stage per reference CI axis:
 #   unit      python unit tests on the virtual 8-device CPU mesh (not slow)
 #   native    C++ runtime build + native-path tests
+#   compiler  graph-pass pipeline + persistent compile cache suite (fast in
+#             `all`; cross-process warm-start e2e + deep parity when invoked
+#             directly)
 #   faults    fault-injection / robustness suite (fast, host-only)
 #   telemetry runtime-telemetry + cluster-observability + compile-observability
 #             suite: registry/exposition/fit metrics/trace identity/straggler/
@@ -273,6 +276,23 @@ run_elastic() {
     -q -m "slow and elastic"
 }
 
+run_compiler() {
+  # compiler tier (docs/compiler.md): graph-pass golden semantics
+  # (identity/chain/const folding, CSE merge rules, fusion annotation,
+  # the MXNET_GRAPH_PASSES ladder, binding-surface fallback), pass-vs-
+  # no-pass numerical parity on zoo models, digest stability, and the
+  # compile-cache key/marker/artifact store incl. corrupt-entry
+  # fallback + the AOT wrapper lane. The slow cases (cross-process
+  # warm-start e2e over two fresh interpreters; resnet/transformer
+  # parity) run only when this stage is invoked directly, like `elastic`.
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_graphpass.py \
+    -q -m "not slow"
+  if [ "${1:-}" = "with_slow" ]; then
+    JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_graphpass.py \
+      -q -m "slow and compiler"
+  fi
+}
+
 run_lint() {
   # framework-invariant analyzer (docs/static_analysis.md): AST + dataflow
   # checkers for the repo's hard-won invariants (env parsing, thread/lock
@@ -400,6 +420,7 @@ run_examples() {
 case "$stage" in
   unit) run_unit ;;
   native) run_native ;;
+  compiler) run_compiler with_slow ;;
   faults) run_faults ;;
   telemetry) run_telemetry with_slow ;;
   pipeline) run_pipeline ;;
@@ -418,10 +439,10 @@ case "$stage" in
   package) run_package ;;
   all) run_lint; run_native; run_predict; run_predict_native; run_entry;
        run_package; run_faults; run_telemetry; run_pipeline; run_perf;
-       run_guard; run_serving;
+       run_guard; run_serving; run_compiler;
        JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_elastic.py -q -m "not slow";
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
                 --ignore=tests/test_train_native.py ;;
-  *) echo "unknown stage: $stage (unit|native|faults|telemetry|pipeline|perf|guard|elastic|serving|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|compiler|faults|telemetry|pipeline|perf|guard|elastic|serving|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
 esac

@@ -42,6 +42,8 @@ from . import executor_manager
 from . import model
 from .model import FeedForward
 from . import compileobs
+from . import compile_cache
+from . import graphpass
 from . import fault
 from . import guard
 from . import telemetry
@@ -79,6 +81,11 @@ from .export_artifact import export_predict_artifact, export_train_artifact
 # functions at import — generate its wrappers explicitly
 symbol.Custom = symbol._make_symbol_function("Custom")
 ndarray.Custom = ndarray._make_ndarray_function("Custom")
+
+# persistent cross-process compile cache (docs/compiler.md): wired at import
+# when MXNET_COMPILE_CACHE_DIR is set — jax's persistent-cache config must
+# land before the process's first compile
+compile_cache.maybe_enable_from_env()
 
 # server-role processes block here until the cluster shuts down
 # (reference: python/mxnet/__init__.py → kvstore_server._init_kvstore_server_module)

@@ -76,6 +76,9 @@ COMPILE_TRACE_TID = 59999
 _lane_lock = threading.Lock()
 _lane_last_end = 0.0
 
+# sentinel: the AOT lane declined this call — take the normal jit path
+_AOT_FELL_BACK = object()
+
 
 def _emit_compile_span(name, wall0, dur, args):
     """One span on the compile lane. Placement is serialized: two threads
@@ -169,22 +172,44 @@ def reset():
 
 def symbol_digest(symbol):
     """Stable digest of a Symbol's computation graph: the topo-ordered op
-    sequence with attrs and output arity, independent of bind shapes and of
-    node identity. Two Executors bound over the same graph share it, so a
-    reshape/rebind's first compile is correctly attributed as a RECOMPILE of
-    that graph rather than a fresh program."""
+    sequence with attrs AND the full edge wiring (which node output feeds
+    which input slot), independent of bind shapes and of node identity/
+    names. Two Executors bound over the same graph share it, so a
+    reshape/rebind's first compile is correctly attributed as a RECOMPILE
+    of that graph rather than a fresh program — and, run after the
+    graphpass canonicalize pass, digest-equal means structurally-equal:
+    the property the persistent compile cache keys on.
+
+    Variables hash by ROLE AND SLOT (``a<i>`` = i-th argument, ``x<j>`` =
+    j-th aux state, in this symbol's own ordering), never by name: names
+    are cosmetic, but WHICH slot feeds which input is semantics —
+    ``(a+b)-a`` and ``(a+p)-p`` are different positional functions and
+    must never share a digest (a shared persistent-cache key would serve
+    one of them the other's executable)."""
     from .symbol import _topo_order
 
+    order = _topo_order(symbol._entries)
+    idx = {id(n): i for i, n in enumerate(order)}
+    _, aux_vars = symbol._arg_aux_split()
     h = hashlib.sha1()
-    for node in _topo_order(symbol._entries):
+    n_arg = n_aux = 0
+    for node in order:
         if node.is_variable:
-            h.update(b"var|")
+            if id(node) in aux_vars:
+                h.update(("var:x%d|" % n_aux).encode())
+                n_aux += 1
+            else:
+                h.update(("var:a%d|" % n_arg).encode())
+                n_arg += 1
             continue
         h.update(node.op.encode())
         for k, v in sorted(node.attrs.items()):
             h.update(("|%s=%s" % (k, v)).encode())
-        h.update(("|#%d;" % len(node.inputs)).encode())
-    h.update(("out:%d" % len(symbol._entries)).encode())
+        for inp, k in node.inputs:
+            h.update(("|@%d.%d" % (idx[id(inp)], k)).encode())
+        h.update(b";")
+    h.update(("out:" + ",".join(
+        "%d.%d" % (idx[id(n)], k) for n, k in symbol._entries)).encode())
     return h.hexdigest()[:16]
 
 
@@ -277,7 +302,8 @@ def _arg_nbytes(sig):
 
 
 class ObservedJit:
-    """``jax.jit`` with compile accounting.
+    """``jax.jit`` with compile accounting and an optional persistent-cache
+    fast lane.
 
     Dispatch is jax's own (placement, retracing, donation — untouched); this
     wrapper only watches the executable-cache size across each call. Growth
@@ -285,14 +311,27 @@ class ObservedJit:
     compile seconds (trace + XLA compile + the first dispatch), a span lands
     on the chrome-trace compile lane, and — when the program's graph was
     compiled before — the old/new input signatures are diffed into a
-    ``compile.recompile`` attribution.
+    ``compile.recompile`` attribution. When the persistent compile cache is
+    enabled (``mxnet_tpu/compile_cache.py``), every compile event is also
+    classified cold-vs-disk-hit (``compile.cache_misses`` vs
+    ``compile.cache_hits``) via the cache's marker index.
+
+    ``aot=True`` marks a **single-signature** site (each executor instance,
+    each serving shape bucket): with the cache enabled, the first dispatch
+    resolves the call's key and either loads the serialized executable from
+    disk (no trace, no compile) or AOT-compiles via ``lower().compile()``
+    and serializes it for the next process; every later call dispatches the
+    executable directly. A call whose signature drifts raises inside the
+    executable's argument check and falls back to normal jit dispatch —
+    never wrong numerics, at worst the seed's compile behavior.
     """
 
     __slots__ = ("_jitted", "_record", "_graph_key", "_cache_seen",
-                 "_own_sigs", "_acct_lock")
+                 "_own_sigs", "_acct_lock", "_aot_mode", "_aot_state",
+                 "_aot_exe", "_aot_drifts", "_cache_identity")
 
     def __init__(self, fn, program, site=None, graph_key=None, digest=None,
-                 **jit_kwargs):
+                 aot=False, cache_key=None, **jit_kwargs):
         import jax
 
         self._jitted = jax.jit(fn, **jit_kwargs)  # fwlint: disable=untracked-jit — the registry wrapper itself
@@ -303,6 +342,21 @@ class ObservedJit:
         # wrapper-scoped (per-instance programs like the fused updater whose
         # per-device call groups legitimately hold several signatures)
         self._graph_key = graph_key if graph_key is not None else id(self)
+        # disk-cache identity: must be stable ACROSS processes (a bare
+        # graph_key qualifies when the caller passed one — process-local
+        # id(self) defaults never do). None → no hit/miss classification
+        # and no AOT lane for this wrapper (jax's persistent cache still
+        # serves it transparently underneath).
+        if cache_key is not None:
+            self._cache_identity = cache_key
+        elif graph_key is not None:
+            self._cache_identity = graph_key
+        else:
+            self._cache_identity = None
+        self._aot_mode = bool(aot)
+        self._aot_state = "init"  # init -> on|off (decided at first call)
+        self._aot_exe = None
+        self._aot_drifts = 0
         self._cache_seen = self._cache_size()
         self._own_sigs = None  # fallback signature cache when _cache_size
         # is unavailable (counts first compiles per signature, like jit)
@@ -344,6 +398,22 @@ class ObservedJit:
 
     # -- dispatch -------------------------------------------------------
     def __call__(self, *args, **kwargs):
+        exe = self._aot_exe
+        if exe is not None:
+            out = self._aot_dispatch(exe, args, kwargs)
+            if out is not _AOT_FELL_BACK:
+                return out
+        elif self._aot_state == "init":
+            from . import compile_cache as _cc
+
+            self._aot_state = (
+                "on" if (self._aot_mode and self._cache_identity is not None
+                         and _cc.aot_enabled())
+                else "off")
+        if self._aot_state == "on" and self._aot_exe is None:
+            out = self._aot_first_call(args, kwargs)
+            if out is not _AOT_FELL_BACK:
+                return out
         t0 = time.perf_counter()
         try:
             out = self._jitted(*args, **kwargs)
@@ -356,6 +426,101 @@ class ObservedJit:
             raise
         # keyword leaves ride the signature as one trailing dict group
         return self._account(args + (kwargs,) if kwargs else args, out, t0)
+
+    # -- the AOT persistent-cache lane ----------------------------------
+    def _aot_dispatch(self, exe, args, kwargs):
+        """Steady-state dispatch through the resident executable. A
+        signature drift (rebound shapes) raises inside the executable's
+        argument check — fall back to jit dispatch; after two drifts the
+        lane shuts off for good (an alternating-shape site belongs on
+        jax's multi-signature cache, not here)."""
+        t0 = time.perf_counter()
+        try:
+            out = exe(*args, **kwargs)
+        except Exception as exc:
+            if is_oom_error(exc):
+                dump_oom_report(self._rec().name, exc)
+                raise
+            with self._acct_lock:
+                self._aot_exe = None
+                self._aot_drifts += 1
+                if self._aot_drifts >= 2:
+                    self._aot_state = "off"
+            _log.warning(
+                "compile cache: program %r AOT executable rejected a "
+                "dispatch (%s: %s) — falling back to jit dispatch",
+                self._rec().name, type(exc).__name__, str(exc)[:200])
+            return _AOT_FELL_BACK
+        dt = time.perf_counter() - t0
+        rec = self._rec()
+        with rec.lock:
+            rec.run_count += 1
+            rec.run_seconds += dt
+        return out
+
+    def _aot_first_call(self, args, kwargs):
+        """Resolve this site's cache key from the first call's signature,
+        then load-or-compile the executable. Any cache-layer failure falls
+        back to plain jit dispatch (``compile.cache_errors`` counts it) —
+        the lane is an optimization, never a correctness dependency."""
+        from . import compile_cache as _cc
+
+        rec = self._rec()
+        t0 = time.perf_counter()
+        wall0 = time.time()
+        sig_args = args + (kwargs,) if kwargs else args
+        try:
+            sig = _signature(sig_args)
+            key = _cc.make_key(rec.name, self._cache_identity, sig)
+        except Exception:
+            telemetry.counter("compile.cache_errors").inc()
+            _log.warning("compile cache: could not key program %r — AOT "
+                         "lane off", rec.name, exc_info=True)
+            with self._acct_lock:
+                self._aot_state = "off"
+            return _AOT_FELL_BACK
+        exe = _cc.load_executable(key, rec.name)
+        if exe is not None:
+            try:
+                out = exe(*args, **kwargs)
+            except Exception as exc:
+                if is_oom_error(exc):
+                    dump_oom_report(rec.name, exc)
+                    raise
+                # loads but won't run here (e.g. topology drift the
+                # fingerprint missed): treat as corrupt, compile cold
+                telemetry.counter("compile.cache_errors").inc()
+                _log.warning(
+                    "compile cache: loaded AOT executable for %r failed "
+                    "to dispatch (%s) — compiling cold", rec.name,
+                    type(exc).__name__)
+                exe = None
+        if exe is None:
+            try:
+                compiled = self._jitted.lower(*args, **kwargs).compile()
+                out = compiled(*args, **kwargs)
+            except Exception as exc:
+                if is_oom_error(exc):
+                    dump_oom_report(rec.name, exc)
+                    raise
+                # AOT compilation path unsupported here: shut the lane off
+                # and let the normal jit dispatch (re)do the work
+                telemetry.counter("compile.cache_errors").inc()
+                _log.warning("compile cache: AOT lower/compile failed for "
+                             "%r — falling back to jit dispatch", rec.name,
+                             exc_info=True)
+                with self._acct_lock:
+                    self._aot_state = "off"
+                return _AOT_FELL_BACK
+            _cc.save_executable(key, compiled, rec.name)
+            exe = compiled
+        with self._acct_lock:
+            self._aot_exe = exe
+        # the whole resolve wall (deserialize on a hit, trace+XLA cold) is
+        # a compile event; classification below splits hit from miss
+        self._note_compile(sig_args, time.perf_counter() - t0, wall0,
+                           sig=sig, cache_key=key)
+        return out
 
     def _resync_cache(self):
         n = self._cache_size()
@@ -391,12 +556,13 @@ class ObservedJit:
                 rec.run_seconds += dt
         return out
 
-    def _note_compile(self, args, dt, wall0):
+    def _note_compile(self, args, dt, wall0, sig=None, cache_key=None):
         rec = self._rec()
-        try:
-            sig = _signature(args)
-        except Exception:  # never let accounting break dispatch
-            sig = ()
+        if sig is None:
+            try:
+                sig = _signature(args)
+            except Exception:  # never let accounting break dispatch
+                sig = ()
         nbytes = _arg_nbytes(sig)
         prev = None
         with rec.lock:
@@ -409,14 +575,36 @@ class ObservedJit:
             rec.last_compile_ts = now
             prev = rec.signatures.get(self._graph_key)
             rec.signatures[self._graph_key] = sig
+        # persistent-cache classification: was this "compile" wall a cold
+        # XLA compile or a disk hit underneath? (compile.cache_hits vs
+        # compile.cache_misses — what tools/compile_report.py's warm-vs-
+        # cold comparison and the "zero cold compiles" gate read)
+        cached = None
+        cls = None
+        if self._cache_identity is not None:
+            from . import compile_cache as _cc
+
+            if _cc.enabled():
+                try:
+                    if cache_key is None:
+                        cache_key = _cc.make_key(rec.name,
+                                                 self._cache_identity, sig)
+                    cls = _cc.classify_compile(rec.name, cache_key, dt)
+                except Exception:
+                    telemetry.counter("compile.cache_errors").inc()
+                if cls is not None:
+                    cached = (cls == "hit")
         # always-on metrics + the chrome-trace compile lane
         telemetry.counter("compile.count", program=rec.name).inc()
         telemetry.histogram("compile.seconds", program=rec.name).observe(dt)
         _emit_compile_span("compile[%s]" % rec.name, wall0, dt,
                            {"program": rec.name, "site": rec.site})
-        telemetry.event("compile", program=rec.name, site=rec.site,
-                        seconds=round(dt, 6), count=rec.compile_count,
-                        arg_bytes=nbytes)
+        ev = {"program": rec.name, "site": rec.site,
+              "seconds": round(dt, 6), "count": rec.compile_count,
+              "arg_bytes": nbytes}
+        if cached is not None:
+            ev["cached"] = cached
+        telemetry.event("compile", **ev)
         peak = _backend_peak_bytes()
         if peak is not None:
             with rec.lock:
@@ -457,7 +645,8 @@ class ObservedJit:
             rec.site or "<unknown site>", dt)
 
 
-def jit(fn, program, site=None, graph_key=None, **jit_kwargs):
+def jit(fn, program, site=None, graph_key=None, aot=False, cache_key=None,
+        **jit_kwargs):
     """The registry's ``jax.jit``: every runtime jit site routes through
     here (enforced by the ``untracked-jit`` fwlint rule).
 
@@ -466,10 +655,15 @@ def jit(fn, program, site=None, graph_key=None, **jit_kwargs):
     attribution messages; ``graph_key`` (hashable) identifies the traced
     GRAPH across wrapper rebuilds — pass :func:`symbol_digest` output for
     symbol-derived programs so rebind/reshape compiles diff against the
-    graph's previous signature. Remaining kwargs go to ``jax.jit``.
+    graph's previous signature. ``aot=True`` opts a single-signature site
+    into the persistent cache's AOT executable lane; ``cache_key``
+    overrides the cross-process disk-cache identity when ``graph_key``
+    carries process-local parts (e.g. a per-engine nonce) — it must encode
+    EVERYTHING that shapes the traced program beyond the input signature.
+    Remaining kwargs go to ``jax.jit``.
     """
     return ObservedJit(fn, program, site=site, graph_key=graph_key,
-                       **jit_kwargs)
+                       aot=aot, cache_key=cache_key, **jit_kwargs)
 
 
 def raw_jit(fn, program, site=None, **jit_kwargs):
@@ -556,6 +750,12 @@ def summary(include_recompiles=True):
         "run_seconds": round(sum(r["run_seconds"] for r in rows), 6),
         "recompile_count": sum(r["recompile_count"] for r in rows),
     }
+    from . import compile_cache as _cc
+
+    if _cc.enabled():
+        out["cache_hits"] = telemetry.totals("compile.cache_hits")[1]
+        out["cache_misses"] = telemetry.totals("compile.cache_misses")[1]
+        out["cache_errors"] = telemetry.totals("compile.cache_errors")[1]
     if include_recompiles:
         out["recompiles"] = recompile_log()
     return out

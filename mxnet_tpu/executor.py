@@ -30,6 +30,7 @@ import os
 import numpy as np
 
 from . import compileobs as _compileobs
+from . import graphpass as _graphpass
 from . import profiler as _profiler
 from . import random as _random
 from .base import MXNetError
@@ -39,13 +40,21 @@ from .symbol import _topo_order
 __all__ = ["Executor"]
 
 
-def build_graph_fn(symbol, node_callback=None):
+def build_graph_fn(symbol, node_callback=None, arg_names=None,
+                   aux_names=None):
     """Build ``fn(arg_list, aux_list, rng, is_train) -> (outputs, new_auxs)``
     plus the metadata needed to bind arrays (arg names, aux names).
 
     This is the trace target: pure, shape-stable, jit-friendly. Stochastic ops
     get per-node keys folded from the step key so two dropout layers never share
     a mask.
+
+    ``arg_names`` / ``aux_names`` — when given, variables bind to the slots
+    of those lists BY NAME instead of by this symbol's own topo order. This
+    is how the executor runs a graphpass-optimized graph against arrays
+    bound in the ORIGINAL symbol's order: canonicalization may reorder the
+    topo walk (and folding may orphan a variable entirely — its slot is
+    simply never read), but the caller's binding contract stays fixed.
 
     ``node_callback(name, value)`` — when given, invoked with every
     non-variable node's visible outputs as they are computed (names
@@ -58,16 +67,20 @@ def build_graph_fn(symbol, node_callback=None):
 
     order = _topo_order(symbol._entries)
     arg_vars, aux_vars = symbol._arg_aux_split()
-    arg_names = symbol.list_arguments()
-    aux_names = symbol.list_auxiliary_states()
+    if arg_names is None:
+        arg_names = symbol.list_arguments()
+    if aux_names is None:
+        aux_names = symbol.list_auxiliary_states()
+    arg_slot = {n: i for i, n in enumerate(arg_names)}
+    aux_slot = {n: i for i, n in enumerate(aux_names)}
     arg_index = {}
     aux_index = {}
     for node in order:
         if node.is_variable:
             if id(node) in aux_vars:
-                aux_index[id(node)] = len(aux_index)
+                aux_index[id(node)] = aux_slot[node.name]
             else:
-                arg_index[id(node)] = len(arg_index)
+                arg_index[id(node)] = arg_slot[node.name]
 
     def graph_fn(arg_list, aux_list, rng, is_train):
         vals = {}
@@ -159,12 +172,39 @@ class Executor:
         self._compute_dtype = np.dtype(compute_dtype) if compute_dtype else None
         self._cast_exempt = frozenset(cast_exempt) | _index_like_inputs(symbol)
 
-        self._graph_fn, self._arg_names, self._aux_names = build_graph_fn(symbol)
+        # ---- graph-pass pipeline (docs/compiler.md): canonicalize / fold /
+        # CSE / fusion-group the Symbol graph before lowering. The optimized
+        # graph is what gets traced; binding stays keyed to the ORIGINAL
+        # symbol's arg/aux order (name-keyed slots in build_graph_fn).
+        # The multi-device group2ctx path keeps the unoptimized graph — the
+        # segment cutter consumes the original node structure.
+        multi_dev = False
+        if group2ctx:
+            devs = {c.jax_device for c in group2ctx.values()}
+            devs.add(ctx.jax_device if not isinstance(ctx, (list, tuple))
+                     else ctx[0].jax_device)
+            multi_dev = len(devs) > 1
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._opt_symbol = symbol if multi_dev else _graphpass.optimize(symbol)
+        self._graph_fn, _, _ = build_graph_fn(
+            self._opt_symbol, arg_names=self._arg_names,
+            aux_names=self._aux_names)
         # graph identity for compile attribution: shared by every executor
         # bound over this graph, so a reshape/rebind's compile is diffed
         # against the graph's previous signature (compileobs recompile
-        # events name the changed axis instead of looking like new programs)
-        self._graph_digest = _compileobs.symbol_digest(symbol)
+        # events name the changed axis instead of looking like new programs).
+        # Post-pass: canonicalization makes the digest construction-order
+        # independent — the stable half of the persistent compile-cache key.
+        self._graph_digest = _compileobs.symbol_digest(self._opt_symbol)
+        # the ORIGINAL graph's digest rides the disk-cache key too: the
+        # traced function binds arrays in the ORIGINAL symbol's slot order,
+        # so two sources whose optimized forms coincide but whose original
+        # slot wiring differs must never share an executable (equal
+        # original digests imply equal pass output AND equal binding)
+        self._orig_digest = (self._graph_digest
+                             if self._opt_symbol is symbol
+                             else _compileobs.symbol_digest(symbol))
 
         # ---- normalize arg arrays (reference: CheckArguments in Bind) ----
         if isinstance(args, dict):
@@ -226,10 +266,7 @@ class Executor:
         # (ICI between chips). See mxnet_tpu/placed.py.
         self._placed = None
         if group2ctx:
-            devs = {c.jax_device for c in group2ctx.values()}
-            devs.add(ctx.jax_device if not isinstance(ctx, (list, tuple))
-                     else ctx[0].jax_device)
-            if len(devs) > 1:
+            if multi_dev:
                 from .placed import PlacedGraph
 
                 base_ctx = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
@@ -365,9 +402,25 @@ class Executor:
                     run,
                     "executor.fwd_train" if is_train else "executor.fwd_eval",
                     site="mxnet_tpu/executor.py:Executor._get_jit_fwd",
-                    graph_key=self._graph_digest)
+                    graph_key=self._graph_digest, aot=True,
+                    cache_key=self._cache_key("fwd", bool(is_train)))
             self._jit_fwd[is_train] = fn
         return fn
+
+    def _cache_key(self, kind, *extra):
+        """Cross-process disk-cache identity for this executor's programs:
+        the post-pass graph digest plus every static knob that shapes the
+        traced function beyond the input signature (compute dtype, cast
+        exemptions, which args differentiate, the mirror-recompute flag).
+        One missing knob here would serve a WRONG executable warm — when
+        in doubt, widen the key (a spurious miss costs one compile)."""
+        from .base import env_flag
+
+        return ("executor", kind, self._graph_digest, self._orig_digest,
+                str(self._compute_dtype),
+                tuple(sorted(self._cast_exempt)),
+                tuple(self._diff_idx),
+                bool(env_flag("MXNET_BACKWARD_DO_MIRROR"))) + extra
 
     def _profile_name(self, kind):
         return "executor_%s[%s]" % (kind, getattr(self._symbol, "name", None) or "graph")
@@ -472,7 +525,8 @@ class Executor:
         self._jit_fwd_bwd = _compileobs.jit(
             run, "executor.fwd_bwd",
             site="mxnet_tpu/executor.py:Executor._build_fwd_bwd",
-            graph_key=self._graph_digest)
+            graph_key=self._graph_digest, aot=True,
+            cache_key=self._cache_key("fwd_bwd"))
         return self._jit_fwd_bwd
 
     def memory_analysis(self):

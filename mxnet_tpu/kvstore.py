@@ -1232,6 +1232,11 @@ class KVStoreDist(KVStore):
         out = {"programs": s["programs"], "count": s["compile_count"],
                "seconds": round(s["compile_seconds"], 3),
                "recompiles": s["recompile_count"]}
+        if "cache_hits" in s:
+            # persistent compile cache active: the cold-vs-warm split rides
+            # the snapshot so mxtop can show which ranks started warm
+            out["cache_hits"] = int(s["cache_hits"])
+            out["cache_misses"] = int(s["cache_misses"])
         last = compileobs.last_recompile()
         if last:
             out["last_recompile"] = {

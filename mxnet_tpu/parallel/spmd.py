@@ -21,6 +21,7 @@ import re
 import numpy as np
 
 from .. import compileobs as _compileobs
+from .. import graphpass as _graphpass
 from ..executor import build_graph_fn
 from ..ops.registry import get_op
 from . import fused_opt
@@ -40,7 +41,16 @@ class SPMDTrainer:
         self.symbol = symbol
         self.mesh = mesh
         self.batch_axis = batch_axis
-        self._graph_fn, self.arg_names, self.aux_names = build_graph_fn(symbol)
+        # graph-pass pipeline (docs/compiler.md) ahead of the fused-step
+        # trace, same as the classic executor: the trainer's public
+        # arg/aux order stays the ORIGINAL symbol's (checkpoints, shape
+        # maps) and the optimized graph binds those slots by name
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self._opt_symbol = _graphpass.optimize(symbol)
+        self._graph_fn, _, _ = build_graph_fn(
+            self._opt_symbol, arg_names=self.arg_names,
+            aux_names=self.aux_names)
         self.data_names = [n for n, _ in data_shapes]
         self.label_names = [n for n, _ in (label_shapes or [])]
         self.param_names = [
@@ -107,8 +117,11 @@ class SPMDTrainer:
         self._donate = donate
         # graph identity for compile attribution (compileobs): every
         # trainer over this symbol shares it, so a bucket/rebind compile is
-        # diffed against the graph's previous signature
-        self._graph_digest = _compileobs.symbol_digest(symbol)
+        # diffed against the graph's previous signature. Post-pass: the
+        # canonical digest is also the fused step's persistent-cache
+        # classification key (Layer A — the AOT lane stays off for the
+        # sharded step; jax's disk cache serves it transparently)
+        self._graph_digest = _compileobs.symbol_digest(self._opt_symbol)
 
     def _spec_for(self, name):
         for prog, spec in self._param_rules:

@@ -29,7 +29,7 @@ from collections import deque
 
 import numpy as np
 
-from .. import compileobs, telemetry
+from .. import compile_cache, compileobs, telemetry
 from ..base import env_int
 from . import model as _model
 from .kv_cache import KVBlockPool
@@ -182,14 +182,27 @@ class ServingEngine:
         # report routine warmup as compile.recompile (the counter
         # operators alarm on) with a WARNING per bucket. Per-bucket keys
         # reserve the recompile stream for a bucket compiling AGAIN.
+        #
+        # cache_key drops the per-engine NONCE from the graph key: the
+        # persistent compile cache must hit across processes (and across
+        # engines of identical config), so its identity is pure content —
+        # model shape + pool geometry + bucket. aot=True: each bucket is a
+        # single-signature site, the serialized-executable fast lane — a
+        # warm replica's warmup() loads every bucket from disk instead of
+        # compiling it (tools/serve.py --warmup, bench_serving warmup_s).
+        ckey_base = cfg.key() + (cfg.block_size, cfg.num_blocks,
+                                 str(cfg.kv_dtype))
         self._prefill_jits = {
             S: compileobs.jit(_mk_prefill(), "serving.prefill", site=_SITE,
-                              graph_key=gkey + ("prefill", S), **donate)
+                              graph_key=gkey + ("prefill", S), aot=True,
+                              cache_key=("serving.prefill",) + ckey_base
+                              + (S,), **donate)
             for S in cfg.prefill_buckets()}
         self._decode_jits = {
             B: compileobs.jit(_mk_decode(), "serving.decode", site=_SITE,
-                              graph_key=gkey + ("decode", B),
-                              **decode_donate)
+                              graph_key=gkey + ("decode", B), aot=True,
+                              cache_key=("serving.decode",) + ckey_base
+                              + (B,), **decode_donate)
             for B in cfg.decode_buckets()}
         # bucket dispatch: call sites pad to an exact bucket shape, so the
         # padded dims index the wrapper table directly
@@ -510,4 +523,5 @@ class ServingEngine:
                                  "seconds": round(p["compile_seconds"], 3),
                                  "runs": p["run_count"]}
                              for n, p in prog.items()},
+                "compile_cache": compile_cache.stats(),
             }

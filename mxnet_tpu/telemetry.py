@@ -597,6 +597,34 @@ METRIC_HELP = {
     "compile.recompile":
         "recompiles per program attributed by cause: batch/seq_len/axisN/"
         "dtype/rank/structure/placement (always-on)",
+    "compile.cache_hits":
+        "compiles served warm by the persistent compile cache per program "
+        "(AOT artifact or jax disk cache underneath; always-on)",
+    "compile.cache_misses":
+        "genuinely cold XLA compiles per program while the persistent "
+        "cache is enabled (always-on)",
+    "compile.cache_errors":
+        "persistent-cache faults: corrupt/stale artifacts, serialization "
+        "refusals, IO failures — each falls back to a cold compile "
+        "(always-on)",
+    "compile.cache_evictions":
+        "cache entries evicted to fit MXNET_COMPILE_CACHE_MAX_MB "
+        "(always-on)",
+    "graphpass.pass_seconds":
+        "per-pass graph-optimization wall at bind time, labeled pass",
+    "graphpass.nodes_eliminated":
+        "graph nodes removed per pass (fold_constants/CSE; always-on)",
+    "graphpass.nodes_fused":
+        "pointwise nodes annotated into fusion groups (always-on)",
+    "graphpass.shapes_bucketed":
+        "declared batch dims padded by the opt-in bucket_shapes pass "
+        "(always-on)",
+    "graphpass.errors":
+        "graph passes that raised and were skipped, labeled pass "
+        "(always-on; the bind continues on the unoptimized graph)",
+    "graphpass.fallbacks":
+        "pipelines discarded for breaking the arg/aux/output binding "
+        "surface (always-on; the unoptimized graph is used)",
     "device.bytes_in_use":
         "live device bytes per device (backend stats, NDArray-registry "
         "fallback)",

@@ -26,7 +26,7 @@ _HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
                     "test_analysis.py", "test_elastic.py",
                     "test_cluster_obs.py", "test_native_decode.py",
                     "test_compileobs.py", "test_serving.py",
-                    "test_kv_overlap.py"}
+                    "test_kv_overlap.py", "test_graphpass.py"}
 
 
 def pytest_configure(config):
@@ -47,6 +47,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "perf: communication-overlap / perf-smoke tests "
                    "(host-only)")
+    config.addinivalue_line(
+        "markers", "compiler: graph-pass pipeline / persistent compile "
+                   "cache tests (host-only)")
     config.addinivalue_line("markers", "slow: long-running tests")
 
 

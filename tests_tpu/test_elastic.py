@@ -407,6 +407,34 @@ def test_launch_forwards_signal_once_and_exits():
     assert rc == 128 + signal.SIGTERM
 
 
+def test_elastic_sets_default_compile_cache_dir():
+    """--elastic defaults MXNET_COMPILE_CACHE_DIR for every child (a
+    relaunch must start warm — docs/compiler.md); an explicit value (or
+    explicit empty = opt-out) wins over the default."""
+    script = ("import os; print('CACHE_DIR=%s' % "
+              "os.environ.get('MXNET_COMPILE_CACHE_DIR', ''))")
+    rc, out, err = _run_cluster(script, n_workers=1, timeout=60,
+                                launch_args=("--elastic",))
+    assert rc == 0, (rc, out, err)
+    line = [l for l in out.splitlines() if l.startswith("CACHE_DIR=")][0]
+    assert "mxnet-compile-cache-" in line, out
+    # explicit value wins
+    rc, out, err = _run_cluster(
+        script, n_workers=1, timeout=60,
+        env_extra={"MXNET_COMPILE_CACHE_DIR": "/tmp/explicit-cc"},
+        launch_args=("--elastic",))
+    assert rc == 0, (rc, out, err)
+    assert "CACHE_DIR=/tmp/explicit-cc" in out, out
+
+
+def test_non_elastic_leaves_compile_cache_unset():
+    script = ("import os; print('CACHE_DIR=%s' % "
+              "os.environ.get('MXNET_COMPILE_CACHE_DIR', 'UNSET'))")
+    rc, out, err = _run_cluster(script, n_workers=1, timeout=60)
+    assert rc == 0, (rc, out, err)
+    assert "CACHE_DIR=UNSET" in out, out
+
+
 def test_elastic_worker_exceeding_restart_budget_fails_job():
     script = "import sys; sys.exit(3)"  # every incarnation dies at once
     t0 = time.monotonic()
@@ -481,8 +509,12 @@ mod.fit(it, num_epoch=NUM_EPOCH, kvstore=kv, optimizer="sgd",
 arg, _ = mod.get_params()
 sig = float(sum(float(np.abs(v.asnumpy()).sum()) for v in arg.values()))
 last = [c for e, c in stream if e == NUM_EPOCH - 1][-8:]
-os.write(1, ("ELASTIC_DONE rank=%d recovered=%s sig=%.4f last=%s\n"
+from mxnet_tpu import compileobs
+cs = compileobs.summary(include_recompiles=False)
+os.write(1, ("ELASTIC_DONE rank=%d recovered=%s sig=%.4f cmpl=%.3f "
+             "cold=%d last=%s\n"
              % (rank, os.environ.get("DMLC_PS_RECOVERY", "0"), sig,
+                cs["compile_seconds"], int(cs.get("cache_misses", -1)),
                 ",".join("%.3f" % c for c in last))).encode())
 kv.barrier()
 if rank == 0:
@@ -493,14 +525,17 @@ print("WORKER_OK", rank)
 
 @needs_native
 @pytest.mark.slow
-def test_elastic_kill_rejoin_end_to_end():
+def test_elastic_kill_rejoin_end_to_end(tmp_path):
     """Acceptance scenario: fault.py SIGKILLs worker 1 mid-epoch under
     ``launch.py --elastic``; the survivor reconfigures (epoch bump, reshard,
     guard rollback) instead of dying, the launcher relaunches the worker,
     it rejoins through the registry, and the job completes with final
     params BIT-IDENTICAL across workers and a post-reconfiguration batch
     stream that is exactly the pure function of (seed, partition,
-    position) the iterator-position protocol promises."""
+    position) the iterator-position protocol promises. The relaunched
+    incarnation also starts WARM off the persistent compile cache its
+    first launch populated: its compile seconds must drop well below the
+    cold worker's (docs/compiler.md)."""
     rc, out, err = _run_cluster(
         ELASTIC_FIT, n_workers=2, timeout=420,
         env_extra={
@@ -509,6 +544,9 @@ def test_elastic_kill_rejoin_end_to_end():
             "MXNET_FAULT_SPEC": "kill_worker:rank=1,after=20,times=1",
             "MXNET_ELASTIC_HEARTBEAT_S": "0.5",
             "MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S": "2",
+            # a per-test cache dir: the first incarnations start cold by
+            # construction, the relaunch finds a populated cache
+            "MXNET_COMPILE_CACHE_DIR": str(tmp_path / "cc"),
         },
         launch_args=("--elastic",))
     assert rc == 0, (rc, out, err)
@@ -522,6 +560,12 @@ def test_elastic_kill_rejoin_end_to_end():
     # the dead worker really was relaunched into the job
     assert info[1]["recovered"] == "1", (out, err)
     assert info[0]["recovered"] == "0", (out, err)
+    # warm restart: the relaunched incarnation compiled against the cache
+    # its first launch (and rank 0) populated — its compile wall must be a
+    # fraction of the cold worker's (the tentpole's elastic payoff)
+    cold_s = float(info[0]["cmpl"])
+    warm_s = float(info[1]["cmpl"])
+    assert warm_s < 0.6 * cold_s, info
     # the full cycle is visible: reconfiguration AND rejoin happened
     assert "elastic: reconfigured to membership epoch" in err, err
     assert "elastic: joined membership epoch" in err, err

@@ -1,0 +1,713 @@
+"""Graph-pass pipeline + persistent compile cache suite (docs/compiler.md).
+
+Covers: per-pass golden semantics (identity elimination, scalar-chain and
+init-constant folding, CSE merge rules, fusion-group annotation, opt-in
+shape bucketing), the MXNET_GRAPH_PASSES ladder, the binding-surface
+safety fallback, pass-vs-no-pass numerical parity (fwd AND bwd) on zoo
+models, digest stability under operand reorder and across process
+restarts, the compile-cache key/marker/artifact store (corrupt-entry
+fallback with the always-on ``compile.cache_errors`` counter), the AOT
+wrapper lane (round-trip, signature-drift fallback), and the slow-marked
+cross-process warm-start e2e (second process: zero cold compiles, big
+compile-wall reduction, one ``tools/compile_report.py --compare`` away).
+
+Host-side only (tests_tpu/conftest.py exempts this file from the hardware
+gate). ``ci/run_tests.sh compiler`` is the CI tier.
+"""
+import importlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import compile_cache, compileobs, graphpass, telemetry  # noqa: E402
+from mxnet_tpu.name import NameManager  # noqa: E402
+from mxnet_tpu.symbol import _topo_order  # noqa: E402
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import compile_report  # noqa: E402
+
+pytestmark = pytest.mark.compiler
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    """An enabled compile cache rooted in tmp, torn down afterwards.
+    ``wire_jax=False``: the artifact/marker stores are under test, not
+    jax's process-global persistent-cache config."""
+    d = str(tmp_path / "cc")
+    assert compile_cache.enable(d, wire_jax=False)
+    yield d
+    compile_cache.disable()
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_cache():
+    """These tests assert exact hit/miss/error counts — an ambient
+    MXNET_COMPILE_CACHE_DIR from the invoking shell must not leak in."""
+    was = compile_cache.cache_dir()
+    compile_cache.disable()
+    yield
+    if was and not compile_cache.enabled():
+        compile_cache.enable(was, wire_jax=False)
+
+
+def _nodes(sym):
+    return _topo_order(sym._entries)
+
+
+def _n_nodes(sym):
+    return len(_nodes(sym))
+
+
+# ---------------------------------------------------------------------------
+# canonicalize: digest stability
+# ---------------------------------------------------------------------------
+
+def test_canonicalize_makes_operand_order_irrelevant():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    lhs = (a * 2.0) + (b * 3.0)
+    rhs = (b * 3.0) + (a * 2.0)
+    assert compileobs.symbol_digest(
+        graphpass.run_pass("canonicalize", lhs)) == \
+        compileobs.symbol_digest(graphpass.run_pass("canonicalize", rhs))
+    # and the full default pipeline agrees
+    assert compileobs.symbol_digest(graphpass.optimize(lhs)) == \
+        compileobs.symbol_digest(graphpass.optimize(rhs))
+
+
+def test_canonicalize_preserves_numerics_exactly():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    s = mx.sym.elemwise_add(b, a)  # will be re-sorted
+    c = graphpass.run_pass("canonicalize", s)
+    ex1 = s.bind(mx.cpu(), {"a": mx.nd.array([1.5, 2.0]),
+                            "b": mx.nd.array([3.25, -1.0])})
+    ex2 = c.bind(mx.cpu(), {"a": mx.nd.array([1.5, 2.0]),
+                            "b": mx.nd.array([3.25, -1.0])})
+    np.testing.assert_array_equal(ex1.forward()[0].asnumpy(),
+                                  ex2.forward()[0].asnumpy())
+
+
+def test_digest_distinguishes_variable_slot_wiring():
+    # review regression: (a+b)-a and (a+p)-p are DIFFERENT positional
+    # functions (the subtrahend is slot 0 vs slot 1) — name-free hashing
+    # that anonymized variables collided them, and a shared persistent-
+    # cache key would have served one the other's executable
+    a, b, p = (mx.sym.Variable(n) for n in "abp")
+    s1 = (a + b) - a
+    s2 = (a + p) - p
+    assert compileobs.symbol_digest(s1) != compileobs.symbol_digest(s2)
+    # while pure renames still share one digest (same slot wiring)
+    s3 = (p + b) - p
+    assert compileobs.symbol_digest(s1) == compileobs.symbol_digest(s3)
+    # canonicalize MAY normalize the two post-pass graphs onto one digest
+    # (operand sorting) — the executor's disk key therefore carries the
+    # ORIGINAL digest too, so the two never share an executable
+    ex1 = s1.bind(mx.cpu(), {"a": mx.nd.array([1.0]),
+                             "b": mx.nd.array([2.0])})
+    ex2 = s2.bind(mx.cpu(), {"a": mx.nd.array([1.0]),
+                             "p": mx.nd.array([5.0])})
+    assert ex1._cache_key("fwd") != ex2._cache_key("fwd")
+
+
+def test_aot_lane_never_serves_the_wrong_executable(cache_dir):
+    # end-to-end form of the collision above, THROUGH the AOT lane:
+    # (a+b)-a computes b, (a+p)-p computes a — run both with the cache
+    # enabled and assert each returns its own math
+    a, b, p = (mx.sym.Variable(n) for n in "abp")
+    ex1 = ((a + b) - a).bind(mx.cpu(), {"a": mx.nd.array([1.0]),
+                                        "b": mx.nd.array([2.0])})
+    np.testing.assert_array_equal(ex1.forward()[0].asnumpy(), [2.0])
+    ex2 = ((a + p) - p).bind(mx.cpu(), {"a": mx.nd.array([1.0]),
+                                        "p": mx.nd.array([5.0])})
+    np.testing.assert_array_equal(ex2.forward()[0].asnumpy(), [1.0])
+
+
+def test_digest_includes_edge_wiring():
+    # sub(a, b) vs sub(b, a): same op multiset, different wiring — the
+    # digest must tell them apart (pre-PR it only counted inputs)
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    d1 = compileobs.symbol_digest(mx.sym.elemwise_sub(a, b))
+    d2 = compileobs.symbol_digest(mx.sym.elemwise_sub(b, a))
+    # both graphs have identical node sequences (var, var, sub) — only the
+    # input order distinguishes them; names are excluded by design, so the
+    # two ARE structurally equal here. Use an asymmetric consumer instead:
+    s1 = mx.sym.elemwise_sub(a * 2.0, b)
+    s2 = mx.sym.elemwise_sub(b, a * 2.0)
+    assert compileobs.symbol_digest(s1) != compileobs.symbol_digest(s2)
+    assert d1 == d2  # documents the name-free equivalence above
+
+
+# ---------------------------------------------------------------------------
+# fold_constants
+# ---------------------------------------------------------------------------
+
+def test_identity_scalar_ops_eliminated():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(((x * 1.0) + 0.0) ** 1.0, num_hidden=4,
+                              name="fc")
+    opt = graphpass.run_pass("fold_constants", y)
+    assert _n_nodes(opt) == _n_nodes(y) - 3
+    ops = [n.op for n in _nodes(opt) if not n.is_variable]
+    assert ops == ["FullyConnected"]
+
+
+def test_scalar_chains_fold():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x * 2.0 * 3.0 + 1.0 - 4.0, num_hidden=4)
+    opt = graphpass.run_pass("fold_constants", y)
+    scalars = [(n.op, n.attrs.get("scalar")) for n in _nodes(opt)
+               if not n.is_variable and "scalar" in n.attrs]
+    assert (("_mul_scalar", 6.0) in scalars)
+    assert (("_plus_scalar", -3.0) in scalars)
+    assert len(scalars) == 2
+
+
+def test_init_constants_fold_to_full():
+    z = mx.sym.ones((3, 4)) * 2.5
+    out = mx.sym.FullyConnected(z, num_hidden=2)
+    opt = graphpass.run_pass("fold_constants", out)
+    inits = [n for n in _nodes(opt)
+             if not n.is_variable and n.op in ("_ones", "_full")]
+    assert len(inits) == 1
+    assert inits[0].op == "_full"
+    assert inits[0].attrs["value"] == 2.5
+    # numerics: the folded graph computes the same tensor
+    ex = graphpass.optimize(z).bind(mx.cpu(), {})
+    np.testing.assert_array_equal(ex.forward()[0].asnumpy(),
+                                  np.full((3, 4), 2.5, np.float32))
+
+
+def test_output_nodes_never_eliminated():
+    # the head IS an identity op: its name is the output surface, so the
+    # pass must keep it even though it is a no-op
+    x = mx.sym.Variable("x")
+    y = x * 1.0
+    opt = graphpass.run_pass("fold_constants", y)
+    assert opt.list_outputs() == y.list_outputs()
+    assert _n_nodes(opt) == _n_nodes(y)
+
+
+# ---------------------------------------------------------------------------
+# CSE
+# ---------------------------------------------------------------------------
+
+def test_cse_merges_identical_subtrees():
+    x = mx.sym.Variable("x")
+    fc = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    s = mx.sym.Activation(fc, act_type="relu") + \
+        mx.sym.Activation(fc, act_type="relu")
+    opt = graphpass.run_pass("eliminate_common_subexpr", s)
+    assert _n_nodes(opt) == _n_nodes(s) - 1
+    relus = [n for n in _nodes(opt) if n.op == "Activation"]
+    assert len(relus) == 1
+
+
+def test_cse_never_merges_stochastic_or_stateful():
+    x = mx.sym.Variable("x")
+    d = mx.sym.Dropout(x, p=0.5, name="d1") + \
+        mx.sym.Dropout(x, p=0.5, name="d2")
+    assert _n_nodes(graphpass.run_pass(
+        "eliminate_common_subexpr", d)) == _n_nodes(d)
+    bn = mx.sym.BatchNorm(x, name="bn1") + mx.sym.BatchNorm(x, name="bn2")
+    assert _n_nodes(graphpass.run_pass(
+        "eliminate_common_subexpr", bn)) == _n_nodes(bn)
+
+
+# ---------------------------------------------------------------------------
+# fuse_elemwise / bucket_shapes
+# ---------------------------------------------------------------------------
+
+def test_fuse_elemwise_annotates_chains():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(
+        mx.sym.Activation(x * 2.0 + 1.0, act_type="relu"), num_hidden=4)
+    opt = graphpass.run_pass("fuse_elemwise", y)
+    groups = {n.name: n._extra_attrs.get("__fuse_group__")
+              for n in _nodes(opt) if not n.is_variable}
+    chain = [g for name, g in groups.items() if g is not None]
+    assert len(chain) == 3 and len(set(chain)) == 1
+    assert groups[[n for n in groups if "fullyconnected" in n][0]] is None
+    # annotation-only: the digest (op+attrs+wiring) is untouched
+    assert compileobs.symbol_digest(opt) == compileobs.symbol_digest(y)
+
+
+def test_bucket_shapes_is_opt_in_and_pads_batch():
+    assert "bucket_shapes" not in graphpass.DEFAULT_PIPELINE
+    x = mx.sym.Variable("x", shape=(13, 7))
+    opt = graphpass.run_pass("bucket_shapes", x)
+    node = opt._entries[0][0]
+    assert node._extra_attrs["__shape__"] == str((16, 7))
+
+
+# ---------------------------------------------------------------------------
+# the ladder + the safety fallback
+# ---------------------------------------------------------------------------
+
+def test_env_ladder(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "none")
+    assert graphpass.active_passes() == ()
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "off")
+    assert graphpass.active_passes() == ()
+    monkeypatch.delenv("MXNET_GRAPH_PASSES", raising=False)
+    assert graphpass.active_passes() == graphpass.DEFAULT_PIPELINE
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "default,-cse")
+    assert graphpass.active_passes() == tuple(
+        p for p in graphpass.DEFAULT_PIPELINE
+        if p != "eliminate_common_subexpr")
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "canonicalize,cse")
+    assert graphpass.active_passes() == ("canonicalize",
+                                         "eliminate_common_subexpr")
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "default,bucket_shapes")
+    assert graphpass.active_passes() == graphpass.DEFAULT_PIPELINE + (
+        "bucket_shapes",)
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "no_such_pass")
+    assert graphpass.active_passes() == ()
+
+
+def test_optimize_falls_back_when_surface_breaks():
+    def evil(sym):  # drops an argument: breaks the binding surface
+        g = sym.__copy__()
+        for node in _topo_order(g._entries):
+            node.inputs = [(i, k) for i, k in node.inputs
+                           if not (i.is_variable and i.name.endswith("bias"))]
+        return g
+
+    graphpass.PASS_REGISTRY["_evil"] = evil
+    try:
+        x = mx.sym.Variable("x")
+        y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+        before = telemetry.counter("graphpass.fallbacks").value
+        out = graphpass.optimize(y, passes=("_evil",))
+        assert out is y
+        assert telemetry.counter("graphpass.fallbacks").value == before + 1
+    finally:
+        del graphpass.PASS_REGISTRY["_evil"]
+
+
+def test_optimize_survives_raising_pass():
+    def bomb(sym):
+        raise RuntimeError("boom")
+
+    graphpass.PASS_REGISTRY["_bomb"] = bomb
+    try:
+        x = mx.sym.Variable("x")
+        y = mx.sym.FullyConnected(x * 1.0, num_hidden=4)
+        before = telemetry.counter("graphpass.errors",
+                                   **{"pass": "_bomb"}).value
+        out = graphpass.optimize(y, passes=("_bomb", "fold_constants"))
+        # the bomb is skipped, the rest of the pipeline still runs
+        assert _n_nodes(out) == _n_nodes(y) - 1
+        assert telemetry.counter("graphpass.errors",
+                                 **{"pass": "_bomb"}).value == before + 1
+    finally:
+        del graphpass.PASS_REGISTRY["_bomb"]
+
+
+# ---------------------------------------------------------------------------
+# zoo sweep: binding surface + digest determinism on EVERY digest-tested
+# builder (numerics on representatives below — eval parity for the giants
+# would re-pay their multi-minute XLA walls in every CI run)
+# ---------------------------------------------------------------------------
+
+_ZOO = [
+    ("resnet", "get_symbol",
+     dict(num_classes=10, num_layers=20, image_shape="3,28,28")),
+    ("resnext", "get_symbol",
+     dict(num_classes=10, num_layers=50, num_group=32)),
+    ("inception_v3", "get_symbol", dict(num_classes=10)),
+    ("inception_bn", "get_symbol", dict(num_classes=10)),
+    ("googlenet", "get_symbol", dict(num_classes=10)),
+    ("alexnet", "get_symbol", dict(num_classes=10)),
+    ("vgg", "get_symbol", dict(num_classes=10)),
+    ("lenet", "get_symbol", dict(num_classes=10)),
+    ("mlp", "get_symbol", dict(num_classes=10)),
+    ("transformer_lm", "get_symbol", dict()),
+    ("ssd", "get_symbol", dict()),
+    ("dcgan", "make_generator", dict()),
+    ("dcgan", "make_discriminator", dict()),
+    ("inception_resnet_v2", "get_symbol", dict(num_classes=10)),
+    ("lstm_lm", "get_symbol", dict()),
+]
+
+
+@pytest.mark.parametrize("model,fn,kw", _ZOO,
+                         ids=["%s.%s" % (m, f) for m, f, _ in _ZOO])
+def test_zoo_passes_preserve_binding_surface(model, fn, kw):
+    mod = importlib.import_module("mxnet_tpu.models." + model)
+    with NameManager():
+        sym = getattr(mod, fn)(**kw)
+        if model == "lstm_lm":
+            sym = sym(16)[0]
+    opt = graphpass.optimize(sym)
+    # arg/aux NAME SETS are the contract (canonicalization may reorder the
+    # topo walk — the executor binds slots by name); output order is exact
+    assert sorted(opt.list_arguments()) == sorted(sym.list_arguments())
+    assert sorted(opt.list_auxiliary_states()) == \
+        sorted(sym.list_auxiliary_states())
+    assert opt.list_outputs() == sym.list_outputs()
+    # canonical digest is a pure function of the graph: two pipeline runs
+    # agree (and, per the cross-process e2e below, so do two processes)
+    assert compileobs.symbol_digest(opt) == \
+        compileobs.symbol_digest(graphpass.optimize(sym))
+
+
+# ---------------------------------------------------------------------------
+# numerical parity: passed vs unpassed graphs, fwd AND bwd
+# ---------------------------------------------------------------------------
+
+def _bind_seeded(sym, shapes, seed=7, passes_off=False,
+                 monkeypatch=None):
+    if passes_off:
+        monkeypatch.setenv("MXNET_GRAPH_PASSES", "none")
+    else:
+        monkeypatch.delenv("MXNET_GRAPH_PASSES", raising=False)
+    ex = sym.simple_bind(ctx=mx.cpu(), **shapes)
+    rs = np.random.RandomState(seed)
+    for name in sorted(ex.arg_dict):
+        a = ex.arg_dict[name]
+        if name.endswith("label"):
+            a[:] = (rs.rand(*a.shape) * 4).astype(a.dtype)
+        elif name == "data":
+            a[:] = rs.randn(*a.shape).astype(a.dtype)
+        else:
+            a[:] = (rs.randn(*a.shape) * 0.1).astype(a.dtype)
+    for name in sorted(ex.aux_dict):
+        a = ex.aux_dict[name]
+        a[:] = np.abs(rs.randn(*a.shape)).astype(a.dtype) \
+            if "var" in name else rs.randn(*a.shape).astype(a.dtype) * 0.01
+    return ex
+
+
+def _parity_case(model, fn, kw, shapes, monkeypatch):
+    mod = importlib.import_module("mxnet_tpu.models." + model)
+    with NameManager():
+        sym = getattr(mod, fn)(**kw)
+    ex_on = _bind_seeded(sym, shapes, monkeypatch=monkeypatch)
+    ex_off = _bind_seeded(sym, shapes, passes_off=True,
+                          monkeypatch=monkeypatch)
+    for ex in (ex_on, ex_off):
+        ex.forward(is_train=True)
+        ex.backward()
+    for o1, o2 in zip(ex_on.outputs, ex_off.outputs):
+        np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
+    for name in ex_on.grad_dict:
+        g1, g2 = ex_on.grad_dict[name], ex_off.grad_dict[name]
+        if g1 is None:
+            assert g2 is None
+            continue
+        np.testing.assert_allclose(g1.asnumpy(), g2.asnumpy(),
+                                   rtol=1e-5, atol=1e-5, err_msg=name)
+
+
+def test_parity_mlp(monkeypatch):
+    _parity_case("mlp", "get_symbol", dict(num_classes=10),
+                 dict(data=(8, 784), softmax_label=(8,)), monkeypatch)
+
+
+def test_parity_lenet(monkeypatch):
+    _parity_case("lenet", "get_symbol", dict(num_classes=10),
+                 dict(data=(4, 1, 28, 28), softmax_label=(4,)), monkeypatch)
+
+
+@pytest.mark.slow
+def test_parity_resnet20(monkeypatch):
+    _parity_case("resnet", "get_symbol",
+                 dict(num_classes=10, num_layers=20, image_shape="3,28,28"),
+                 dict(data=(2, 3, 28, 28), softmax_label=(2,)), monkeypatch)
+
+
+@pytest.mark.slow
+def test_parity_transformer_lm(monkeypatch):
+    _parity_case("transformer_lm", "get_symbol",
+                 dict(vocab_size=128, num_layers=2, model_dim=32,
+                      num_heads=2, ffn_dim=64, seq_len=16),
+                 dict(data=(2, 16), softmax_label=(2, 16)), monkeypatch)
+
+
+# ---------------------------------------------------------------------------
+# compile cache: keys, markers, artifacts
+# ---------------------------------------------------------------------------
+
+def test_make_key_stable_and_sensitive(cache_dir):
+    sig = (("[0]", "", (4, 3), "float32"),)
+    k1 = compile_cache.make_key("p", ("d", 1), sig)
+    assert k1 == compile_cache.make_key("p", ("d", 1), sig)
+    assert k1 != compile_cache.make_key("p", ("d", 2), sig)
+    assert k1 != compile_cache.make_key("q", ("d", 1), sig)
+    assert k1 != compile_cache.make_key(
+        "p", ("d", 1), (("[0]", "", (8, 3), "float32"),))
+
+
+def test_classify_compile_miss_then_hit(cache_dir):
+    key = compile_cache.make_key("prog", "dig", ())
+    h0 = telemetry.counter("compile.cache_hits", program="prog").value
+    m0 = telemetry.counter("compile.cache_misses", program="prog").value
+    assert compile_cache.classify_compile("prog", key, 1.0) == "miss"
+    assert compile_cache.classify_compile("prog", key, 1.0) == "hit"
+    assert telemetry.counter("compile.cache_hits",
+                             program="prog").value == h0 + 1
+    assert telemetry.counter("compile.cache_misses",
+                             program="prog").value == m0 + 1
+
+
+def test_corrupt_artifact_counts_error_and_falls_back(cache_dir):
+    key = "deadbeef" * 5
+    with open(os.path.join(cache_dir, "aot", key), "wb") as f:
+        f.write(b"this is not an executable")
+    e0 = telemetry.totals("compile.cache_errors")[1]
+    assert compile_cache.load_executable(key, "prog") is None
+    assert telemetry.totals("compile.cache_errors")[1] == e0 + 1
+    # the bad file was removed so a cold compile can overwrite it
+    assert not os.path.exists(os.path.join(cache_dir, "aot", key))
+
+
+def test_aot_wrapper_round_trip_in_process(cache_dir):
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.sin(x) * 2.0
+
+    x = np.linspace(0, 1, 8, dtype=np.float32)
+    j1 = compileobs.jit(f, "test.aot", cache_key=("t", 1), aot=True)
+    m0 = telemetry.counter("compile.cache_misses", program="test.aot").value
+    y1 = np.asarray(j1(x))
+    assert telemetry.counter("compile.cache_misses",
+                             program="test.aot").value == m0 + 1
+    # a FRESH wrapper (new process stand-in) loads the artifact: hit, and
+    # the executable dispatches without jax.jit ever tracing
+    j2 = compileobs.jit(f, "test.aot", cache_key=("t", 1), aot=True)
+    h0 = telemetry.counter("compile.cache_hits", program="test.aot").value
+    y2 = np.asarray(j2(x))
+    assert telemetry.counter("compile.cache_hits",
+                             program="test.aot").value == h0 + 1
+    np.testing.assert_allclose(y1, y2, rtol=0, atol=0)
+    assert j2._aot_exe is not None
+    # steady state stays on the executable lane
+    np.testing.assert_allclose(np.asarray(j2(x)), y1, rtol=0, atol=0)
+
+
+def test_aot_signature_drift_falls_back(cache_dir):
+    import jax.numpy as jnp
+
+    def f(x):
+        return x + 1.0
+
+    j = compileobs.jit(f, "test.drift", cache_key=("drift",), aot=True)
+    a = np.zeros(4, np.float32)
+    b = np.zeros(6, np.float32)
+    np.testing.assert_array_equal(np.asarray(j(a)), a + 1.0)
+    assert j._aot_exe is not None
+    # drift 1: wrong shape for the resident executable -> jit fallback,
+    # correct result either way
+    np.testing.assert_array_equal(np.asarray(j(b)), b + 1.0)
+    # drift 2 shuts the lane for good; dispatch keeps working
+    np.testing.assert_array_equal(np.asarray(j(a)), a + 1.0)
+    np.testing.assert_array_equal(np.asarray(j(b)), b + 1.0)
+    assert j._aot_state == "off"
+    np.testing.assert_array_equal(np.asarray(j(a)), a + 1.0)
+
+
+def test_prune_evicts_oldest(cache_dir):
+    for i in range(4):
+        p = os.path.join(cache_dir, "aot", "k%d" % i)
+        with open(p, "wb") as f:
+            f.write(b"x" * (1 << 20))
+        os.utime(p, (i, i))
+    evicted = compile_cache.prune(2)
+    assert evicted == 2
+    left = sorted(os.listdir(os.path.join(cache_dir, "aot")))
+    assert left == ["k2", "k3"]
+
+
+def test_prune_spares_markers_and_unpairs_evicted_artifacts(cache_dir):
+    # review regression: markers are tiny write-once classification
+    # records — global-mtime eviction reaped them FIRST (corrupting the
+    # hit/miss split) while the payloads they classified survived
+    for i in range(4):
+        p = os.path.join(cache_dir, "aot", "k%d" % i)
+        with open(p, "wb") as f:
+            f.write(b"x" * (1 << 20))
+        os.utime(p, (10 + i, 10 + i))
+        m = os.path.join(cache_dir, "meta", "k%d" % i)
+        with open(m, "w") as f:
+            f.write("k%d" % i)
+        os.utime(m, (0, 0))  # markers are the OLDEST files by far
+    compile_cache.prune(3)
+    assert sorted(os.listdir(os.path.join(cache_dir, "aot"))) == \
+        ["k2", "k3"]
+    # surviving artifacts keep their markers; evicted ones lose theirs
+    assert sorted(os.listdir(os.path.join(cache_dir, "meta"))) == \
+        ["k2", "k3"]
+
+
+def test_fingerprint_pins_framework_identity(cache_dir):
+    fp = compile_cache.fingerprint()
+    assert "mxt=" in fp and "lowering=" in fp and "jax=" in fp
+
+
+# ---------------------------------------------------------------------------
+# compile_report: hit-rate column + --compare
+# ---------------------------------------------------------------------------
+
+def _ev(program, seconds, cached):
+    return {"type": "event", "event": "compile", "program": program,
+            "seconds": seconds, "cached": cached, "ts": 1.0}
+
+
+def test_compile_report_hit_rate_from_events():
+    rep = compile_report.analyze([
+        _ev("executor.fwd_bwd", 2.0, False),
+        _ev("executor.fwd_bwd", 0.1, True),
+        _ev("op.relu", 0.05, True),
+    ])
+    t = rep["totals"]
+    assert t["cache_hits"] == 2 and t["cache_misses"] == 1
+    assert t["cache_hit_rate"] == round(2 / 3, 4)
+    progs = {p["program"]: p for p in rep["programs"]}
+    assert progs["executor.fwd_bwd"]["cache_hits"] == 1
+    assert progs["executor.fwd_bwd"]["cache_misses"] == 1
+    text = compile_report.render(rep)
+    assert "hit-rate" in text and "cache 2/3 hit" in text
+
+
+def test_compile_report_hit_counters_from_snapshots():
+    snap = {"type": "snapshot", "ts": 2.0,
+            "histograms": {"compile.seconds{program=p}":
+                           {"count": 3, "sum": 1.5}},
+            "gauges": {"compile.run_seconds{program=p}": 0.7},
+            "counters": {"compile.cache_hits{program=p}": 2,
+                         "compile.cache_misses{program=p}": 1}}
+    rep = compile_report.analyze([snap])
+    p = rep["programs"][0]
+    assert (p["cache_hits"], p["cache_misses"]) == (2, 1)
+    assert rep["totals"]["cache_hit_rate"] == round(2 / 3, 4)
+
+
+def test_compile_report_compare():
+    cold = compile_report.analyze([
+        _ev("executor.fwd_bwd", 2.0, False), _ev("op.relu", 0.5, False)])
+    warm = compile_report.analyze([
+        _ev("executor.fwd_bwd", 0.2, True), _ev("op.relu", 0.1, True)])
+    cmp_rep = compile_report.compare(cold, warm)
+    t = cmp_rep["totals"]
+    assert t["cold_seconds"] == 2.5 and t["warm_seconds"] == pytest.approx(
+        0.3)
+    assert t["reduction_pct"] == 88.0
+    assert t["warm_cold_compiles"] == 0
+    assert t["warm_cache_hit_rate"] == 1.0
+    text = compile_report.render_compare(cmp_rep)
+    assert "88.0% reduction" in text
+    # the CLI form the acceptance criterion names
+    assert compile_report.main is not None
+
+
+def test_compile_report_compare_cli(tmp_path, capsys):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    with open(a, "w") as f:
+        f.write(json.dumps(_ev("p", 1.0, False)) + "\n")
+    with open(b, "w") as f:
+        f.write(json.dumps(_ev("p", 0.25, True)) + "\n")
+    assert compile_report.main(["--compare", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "75.0% reduction" in out and "warm hit rate 100%" in out
+
+
+# ---------------------------------------------------------------------------
+# cross-process warm start (slow: two fresh interpreters)
+# ---------------------------------------------------------------------------
+
+_WARM_SCRIPT = r"""
+import json, sys, time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import compileobs, compile_cache, telemetry
+
+telemetry.enable()
+data = mx.sym.Variable('data')
+x = data
+for i in range(3):
+    x = mx.sym.Convolution(x, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                           name='conv%d' % i)
+    x = mx.sym.Activation(x, act_type='relu')
+x = mx.sym.FullyConnected(mx.sym.Flatten(x), num_hidden=10)
+sym = mx.sym.SoftmaxOutput(x, name='softmax')
+ex = sym.simple_bind(ctx=mx.cpu(), data=(4, 3, 16, 16), softmax_label=(4,))
+for _ in range(3):
+    ex.forward(is_train=True)
+    ex.backward()
+[o.asnumpy() for o in ex.outputs]
+s = compileobs.summary(include_recompiles=False)
+execu = [r for r in compileobs.program_table()
+         if r['program'].startswith('executor.')]
+print(json.dumps({
+    'compile_seconds': s['compile_seconds'],
+    'compile_count': s['compile_count'],
+    'recompile_count': s['recompile_count'],
+    'hits': s.get('cache_hits'), 'misses': s.get('cache_misses'),
+    'errors': s.get('cache_errors'),
+    'executor_digests': sorted({r['digest'] for r in execu}),
+    'executor_compile_seconds': round(
+        sum(r['compile_seconds'] for r in execu), 6),
+}))
+"""
+
+
+def _run_warm_script(cache_dir_path, extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_COMPILE_CACHE_DIR"] = cache_dir_path
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    out = subprocess.run([sys.executable, "-c", _WARM_SCRIPT], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_cross_process_warm_start(tmp_path):
+    """The tentpole acceptance: an identical second process over the same
+    cache dir pays ZERO cold compiles for the cached programs and the
+    summed compile wall collapses (>=70 percent on real models; this
+    small CI graph still clears 50)."""
+    d = str(tmp_path / "cc")
+    cold = _run_warm_script(d)
+    warm = _run_warm_script(d)
+    assert cold["misses"] > 0 and cold["hits"] == 0
+    # zero cold compiles in the warm process — the cache layer itself
+    # also caused no recompile events
+    assert warm["misses"] == 0
+    assert warm["hits"] == warm["compile_count"]
+    assert warm["recompile_count"] == 0
+    assert warm["errors"] == 0
+    # pass-canonicalized digests are stable across process restarts
+    assert warm["executor_digests"] == cold["executor_digests"]
+    # the headline: summed compile seconds collapse for executor programs
+    assert warm["executor_compile_seconds"] < \
+        0.5 * cold["executor_compile_seconds"], (cold, warm)
+
+
+@pytest.mark.slow
+def test_cross_process_corrupt_cache_still_correct(tmp_path):
+    """Corrupting every artifact between runs: the second process falls
+    back to cold compiles (counted compile.cache_errors), still runs."""
+    d = str(tmp_path / "cc")
+    _run_warm_script(d)
+    aot = os.path.join(d, "aot")
+    for name in os.listdir(aot):
+        with open(os.path.join(aot, name), "wb") as f:
+            f.write(b"garbage")
+    warm = _run_warm_script(d)
+    assert warm["errors"] > 0
+    assert warm["compile_count"] > 0

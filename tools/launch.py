@@ -45,12 +45,28 @@ def run_local(args):
     max_restarts = int(os.environ.get(  # fwlint: disable=env-raw-read — see above
         "MXNET_ELASTIC_MAX_RESTARTS", "3"))
 
+    # elastic supervision exists to relaunch dead workers INTO a running
+    # job — and a relaunch re-pays the full XLA compile wall unless the
+    # compile cache persists across the incarnations. Default the cache
+    # dir on (per-user, stable across jobs so a second job also starts
+    # warm); an explicit MXNET_COMPILE_CACHE_DIR wins, and an explicit
+    # empty value ("") opts out.
+    elastic_cache_dir = None
+    if args.elastic and "MXNET_COMPILE_CACHE_DIR" not in os.environ:
+        import tempfile
+
+        elastic_cache_dir = os.path.join(
+            tempfile.gettempdir(),
+            "mxnet-compile-cache-%d" % os.getuid())
+
     def spawn(role, idx, recovery=False):
         env = dict(os.environ)
         env.update(base_env)
         env["DMLC_ROLE"] = role
         if args.elastic:
             env["MXNET_ELASTIC"] = "1"
+            if elastic_cache_dir:
+                env["MXNET_COMPILE_CACHE_DIR"] = elastic_cache_dir
         if role == "server":
             env["DMLC_SERVER_ID"] = str(idx)
         else:

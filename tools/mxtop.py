@@ -164,10 +164,10 @@ def render(snaps, membership=None, straggler_factor=2.0, now=None):
             straggler["rank"], straggler["stage"], straggler["ratio"])
     lines.append(head)
     lines.append("%-5s %-12s %9s %9s %6s %6s %6s %6s %7s %5s %5s %5s %7s "
-                 "%5s %6s"
+                 "%5s %6s %6s"
                  % ("rank", "step", "imgs/s", "step_ms", "data%", "comp%",
                     "kv%", "ovl%", "guard%", "engq", "feedq", "rej",
-                    "cmpl_s", "rcmp", "age"))
+                    "cmpl_s", "rcmp", "hit", "age"))
     for rank in sorted(snaps):
         s = snaps[rank]
         if not s:
@@ -183,9 +183,19 @@ def render(snaps, membership=None, straggler_factor=2.0, now=None):
         # compile wall inside its steps — the classic silent-retrace bug
         comp = s.get("compile") or {}
         age = now - float(s.get("ts", now))
+        # persistent-cache split per rank: "7/9" = 7 of 9 classified
+        # compiles were warm disk hits (a relaunched worker starting cold
+        # shows 0/N here while its peers ran warm)
+        hits = comp.get("cache_hits")
+        if hits is None:
+            hit_col = "-"
+        else:
+            hit_col = "%d/%d" % (int(hits),
+                                 int(hits) + int(comp.get("cache_misses",
+                                                          0)))
         lines.append(
             "%-5d %-12s %9.1f %9.1f %6s %6s %6s %6s %7s %5d %5d %5d %7.1f "
-            "%5d %5.1fs"
+            "%5d %6s %5.1fs"
             % (rank, _decode_step(s.get("step_id")),
                float(s.get("imgs_per_sec", 0.0)),
                (wall / steps * 1000.0) if steps else 0.0,
@@ -201,7 +211,7 @@ def render(snaps, membership=None, straggler_factor=2.0, now=None):
                int(q.get("engine", 0)), int(q.get("feed", 0)),
                int(c.get("rejected", 0)),
                float(comp.get("seconds", 0.0)),
-               int(comp.get("recompiles", 0)), age))
+               int(comp.get("recompiles", 0)), hit_col, age))
         last = (comp.get("last_recompile") or {}) \
             if comp.get("recompiles") else {}
         if last:

@@ -166,16 +166,32 @@ def main(argv=None):
                     help="deterministic init seed when no checkpoint")
     ap.add_argument("--warmup", action="store_true",
                     help="compile the shape buckets before listening "
-                         "(first real requests pay no compile wall)")
+                         "(first real requests pay no compile wall; with "
+                         "--cache-dir / MXNET_COMPILE_CACHE_DIR a warm "
+                         "replica LOADS them from disk instead)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="persistent compile-cache directory "
+                         "(docs/compiler.md; same as setting "
+                         "MXNET_COMPILE_CACHE_DIR)")
     ap.add_argument("--top", action="store_true",
                     help="render live stat columns to stderr")
     args = ap.parse_args(argv)
 
+    if args.cache_dir:
+        from mxnet_tpu import compile_cache
+
+        compile_cache.enable(args.cache_dir)
     engine = build_engine(args)
     if args.warmup:
+        from mxnet_tpu import compile_cache
+
         t0 = time.time()
         engine.warmup()   # every prefill/decode shape bucket, one dispatch each
-        print("warmup: %.1fs" % (time.time() - t0), file=sys.stderr)
+        cstats = compile_cache.stats()
+        print("warmup: %.1fs (compile cache: %s)"
+              % (time.time() - t0,
+                 "%d hits / %d misses" % (cstats["hits"], cstats["misses"])
+                 if cstats["enabled"] else "off"), file=sys.stderr)
 
     stop = threading.Event()
     driver = threading.Thread(target=engine.run_loop, args=(stop,),
