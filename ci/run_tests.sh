@@ -18,6 +18,8 @@
 #             the slow elastic-rejoin A/B runs when invoked directly)
 #   guard     training health-guard suite: sentinel/rollback/stall/resume (fast, host-only)
 #   elastic   elastic-membership suite incl. the slow kill/rejoin e2e (host-only CPU mesh)
+#   server_ha parameter-server HA suite: replicated groups / failover /
+#             durable slots incl. the slow kill-a-primary e2e (host-only CPU mesh)
 #   serving   paged-KV serving engine: kernel numerics/allocator/scheduler/
 #             engine-vs-sequential equality (fast, host-only; the slow >=32-
 #             stream HTTP e2e runs when invoked directly)
@@ -276,6 +278,22 @@ run_elastic() {
     -q -m "slow and elastic"
 }
 
+run_server_ha() {
+  # parameter-server HA tier (docs/distributed.md §server-HA): replicated
+  # group planning + routing, sticky primary promotion, stats wire v2,
+  # durable optimizer-slot checkpoints (CRC-corrupt cold start), registry
+  # failover off server 0, the dead-server stats penalty window, and the
+  # kill_server fault point. The SIGKILL-a-primary → promote-backup →
+  # relaunch-rejoins e2e (multi-process CPU mesh under launch.py
+  # --elastic) is slow-marked; "all" runs the fast set and this stage
+  # runs BOTH when invoked directly.
+  make -C mxnet_tpu/src
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_server_ha.py \
+    -q -m "not slow"
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_server_ha.py \
+    -q -m "slow and server_ha"
+}
+
 run_compiler() {
   # compiler tier (docs/compiler.md): graph-pass golden semantics
   # (identity/chain/const folding, CSE merge rules, fusion annotation,
@@ -427,6 +445,7 @@ case "$stage" in
   perf) run_perf with_slow ;;
   guard) run_guard ;;
   elastic) run_elastic ;;
+  server_ha) run_server_ha ;;
   serving) run_serving with_slow ;;
   lint) run_lint ;;
   deep) run_deep ;;
@@ -441,8 +460,9 @@ case "$stage" in
        run_package; run_faults; run_telemetry; run_pipeline; run_perf;
        run_guard; run_serving; run_compiler;
        JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_elastic.py -q -m "not slow";
+       JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_server_ha.py -q -m "not slow";
        run_unit --ignore=tests/test_native.py --ignore=tests/test_kvstore_dist.py \
                 --ignore=tests/test_c_predict.py --ignore=tests/test_predict_native.py \
                 --ignore=tests/test_train_native.py ;;
-  *) echo "unknown stage: $stage (unit|native|compiler|faults|telemetry|pipeline|perf|guard|elastic|serving|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
+  *) echo "unknown stage: $stage (unit|native|compiler|faults|telemetry|pipeline|perf|guard|elastic|server_ha|serving|lint|deep|predict|predict_native|entry|bench|tpu|examples|package|all)"; exit 2 ;;
 esac

@@ -111,6 +111,16 @@ def _declare(lib):
     lib.mxt_ps_server_destroy.argtypes = [c.c_void_p]
     lib.mxt_ps_client_create.restype = c.c_void_p
     lib.mxt_ps_client_create.argtypes = [c.c_char_p, c.c_int]
+    # server-HA surface (a library built before it existed reports no HA
+    # support instead of failing the whole load)
+    try:
+        lib.mxt_ps_client_create2.restype = c.c_void_p
+        lib.mxt_ps_client_create2.argtypes = [c.c_char_p, c.c_int, c.c_int]
+        lib.mxt_ps_client_is_dead.restype = c.c_int
+        lib.mxt_ps_client_is_dead.argtypes = [c.c_void_p]
+        lib._mxt_has_ps_ha = True
+    except AttributeError:
+        lib._mxt_has_ps_ha = False
     lib.mxt_ps_client_push.restype = c.c_int
     lib.mxt_ps_client_push.argtypes = [
         c.c_void_p, c.c_int, c.POINTER(c.c_float), c.c_ulonglong]

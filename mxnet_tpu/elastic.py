@@ -189,20 +189,44 @@ class ElasticSession:
                 pass  # expected: that is the event being recovered from
         # 2. adopt the registry's current membership (rejoin if the
         # registry presumed US dead — e.g. a long stall outlived the
-        # heartbeat timeout while the process stayed alive)
-        for attempt in range(10):
+        # heartbeat timeout while the process stayed alive). When WE
+        # detected a dead SERVER (consume_server_loss), the registry may
+        # not have noticed yet: wait for a table whose epoch moved PAST
+        # ours — resuming on the old epoch would re-route keys to the
+        # corpse and reject again (docs/distributed.md §server-HA)
+        server_loss = kv.consume_server_loss()
+        hb_timeout = _env_float("MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S", 5.0)
+        srv_deadline = time.monotonic() + max(30.0, hb_timeout * 6)
+        rejoins = 0
+        while True:
             table = self.sync()
             shard = self._shard_of(table)
-            if shard is not None:
-                break
-            self.logger.warning(
-                "elastic: registry evicted this worker (rank %d) — "
-                "rejoining", self.rank)
-            kv.registry_command("mb_join:%d:%d" % (self.rank, kv.step_id))
-        else:
-            raise MXNetError(
-                "elastic: could not rejoin the membership after eviction")
+            if shard is None:
+                rejoins += 1
+                if rejoins > 10:
+                    raise MXNetError(
+                        "elastic: could not rejoin the membership after "
+                        "eviction")
+                self.logger.warning(
+                    "elastic: registry evicted this worker (rank %d) — "
+                    "rejoining", self.rank)
+                kv.registry_command(
+                    "mb_join:%d:%d" % (self.rank, kv.step_id))
+                continue
+            if server_loss and int(table["epoch"]) <= kv.membership_epoch:
+                if time.monotonic() > srv_deadline:
+                    raise MXNetError(
+                        "elastic: a server is unreachable but the registry "
+                        "never promoted a backup (no epoch bump within the "
+                        "deadline) — is the whole group down?")
+                time.sleep(min(self._hb_interval / 2.0, 0.2))
+                continue
+            break
         epoch = int(table["epoch"])
+        # server map BEFORE epoch, matching the registry's own broadcast
+        # order: traffic stamped with the new epoch must already route to
+        # the promoted primaries
+        kv.adopt_server_map(table.get("smap") or [])
         kv.set_membership_epoch(epoch)
         new_nw, new_rank = shard
         old_nw, old_rank = self.effective
@@ -257,14 +281,14 @@ class ElasticSession:
         if resend and getattr(module, "_update_on_kvstore", False):
             import pickle
 
-            # replaces the server-side updater: per-key slots (momentum,
-            # Adam moments) restart empty — a warm restart within guard
-            # tolerance, same trade the stale-.states path makes
+            # replaces the server-side updater; per-key slots (momentum,
+            # Adam moments) are CARRIED OVER across the swap by the server
+            # (kvstore_server._set_optimizer), so no silent momentum reset
             self._kv._send_command_to_servers(0, pickle.dumps(opt))
             self.logger.warning(
                 "elastic: optimizer rescaled for %d->%d workers and "
-                "re-sent to the servers (server-side optimizer state "
-                "restarts empty)", old_nw, new_nw)
+                "re-sent to the servers (server-side per-key slots are "
+                "preserved across the resend)", old_nw, new_nw)
 
     def _reinit_server_params(self, module):
         """kInit every param key from the (post-rollback) module params —
@@ -317,6 +341,9 @@ class ElasticSession:
                     "restart position (MXNET_ELASTIC_JOIN_TIMEOUT_S)")
             time.sleep(min(self._hb_interval / 2.0, 0.2))
         epoch = int(table["epoch"])
+        # server map before epoch (same ordering as reconfigure): the
+        # parameter pull below must route to the promoted primaries
+        kv.adopt_server_map(table.get("smap") or [])
         kv.set_membership_epoch(epoch)
         new_nw, new_rank = shard
         old_nw = self.effective[0]

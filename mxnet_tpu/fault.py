@@ -35,6 +35,11 @@ recovery path is testable in a single process, byte-for-byte reproducibly:
   ``MXNET_FAULT_SPEC``); combine with ``after=K`` to die mid-epoch at batch
   K. Drives the elastic kill→reconfigure→rejoin cycle
   (docs/distributed.md §elasticity, tools/launch.py --elastic).
+* ``kill_server`` — the PS server's update-apply seam (kvstore_server.py):
+  SIGKILLs a *server* process the same way, driving the server-HA
+  promote→reconfigure path (docs/distributed.md §server-HA). The optional
+  ``server_id=N`` arg targets one server of a launched cluster; combine
+  with ``after=K`` to die after K applied updates (mid-epoch).
 
 Faults are described by a spec string, either in ``MXNET_FAULT_SPEC`` (so a
 whole process tree — e.g. launched PS servers — inherits them) or pushed
@@ -60,10 +65,16 @@ import threading
 import time
 from contextlib import contextmanager
 
+# telemetry is imported at module top, NOT lazily at the firing sites:
+# kill_server/consume fire on a PS server's conn-handler / checkpoint-
+# writer threads while the server's main thread never leaves ``import
+# mxnet_tpu`` — a package-relative import there would deadlock on the
+# import lock (kvstore_server.py's import-lock invariant)
+from . import telemetry
 from .base import MXNetError, env_str as _env_str
 
 __all__ = ["InjectedFault", "InjectedCrash", "hit", "inject", "reset",
-           "crash_after_bytes", "kill_worker"]
+           "crash_after_bytes", "kill_worker", "kill_server"]
 
 
 class InjectedFault(MXNetError):
@@ -181,8 +192,6 @@ def hit(name):
         args = rule["args"]
     # always-on counter (telemetry.py module doc): robustness tests assert
     # injected faults were actually exercised via the metrics dump
-    from . import telemetry
-
     telemetry.counter("fault.injections", point=name).inc()
     delay = args.get("delay_ms")
     if delay:
@@ -222,9 +231,28 @@ def kill_worker(rank=None):
         if rule is None:
             return
         rule["fired"] += 1
-    from . import telemetry
-
     telemetry.counter("fault.injections", point="kill_worker").inc()
+    import signal
+
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def kill_server(server_id=None):
+    """Injection point for server-HA tests, mirroring :func:`kill_worker`:
+    when a ``kill_server`` rule fires — and its ``server_id=`` arg (if
+    any) matches ``server_id`` — SIGKILL this *server* process. Called
+    from the PS server's update-apply path once per applied update
+    (``after=K`` dies mid-epoch after K updates), so the loss lands while
+    optimizer slots and replication are in flight — the worst case the
+    promote→reconfigure path must survive."""
+    with _lock:
+        rule = _arm("kill_server",
+                    match=None if server_id is None
+                    else {"server_id": int(server_id)})
+        if rule is None:
+            return
+        rule["fired"] += 1
+    telemetry.counter("fault.injections", point="kill_server").inc()
     import signal
 
     os.kill(os.getpid(), signal.SIGKILL)
@@ -245,6 +273,4 @@ def consume(name):
                 break
         else:
             return
-    from . import telemetry
-
     telemetry.counter("fault.injections", point=name).inc()

@@ -479,6 +479,26 @@ class KVStoreDist(KVStore):
         self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
         self._nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
         self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        # server HA (docs/distributed.md §server-HA): keys shard across
+        # replicated GROUPS; _smap maps each group to its current primary.
+        # With MXNET_KV_REPLICAS=0 (default) groups are singletons and the
+        # map is the identity — routing is exactly ikey % num_servers.
+        from .kvstore_server import plan_server_groups
+
+        self._replicas = _env_int("MXNET_KV_REPLICAS", 0)
+        try:
+            self._groups = plan_server_groups(self._num_servers,
+                                              self._replicas)
+        except ValueError as e:
+            raise MXNetError(str(e)) from e
+        self._ngroups = len(self._groups)
+        self._smap = [g[0] for g in self._groups]
+        self._registry_sid = self._groups[0][0]
+        self._ha = False        # armed by elastic_enable() when replicas>0
+        self._server_loss = False  # set when an RPC found a dead server
+        self._dead_clients = []  # replaced handles (never freed while live
+        # engine threads may still hold them; destroyed in __del__)
+        self._stats_skip = {}   # server addr -> monotonic skip-until
         self._clients = []
         for s in range(self._num_servers):
             h = self._lib.mxt_ps_client_create(host.encode(), port + s)
@@ -514,13 +534,19 @@ class KVStoreDist(KVStore):
     def _ikey(self, k):
         return k if isinstance(k, int) else _str_key_int(k)
 
+    def _sid_for(self, ikey):
+        # keys shard across GROUPS; _smap holds the current primary of each
+        # group (identity when MXNET_KV_REPLICAS=0, so this degenerates to
+        # the historical ikey % num_servers routing)
+        return self._smap[ikey % self._ngroups]
+
     def _client_for(self, ikey):
-        return self._clients[ikey % self._num_servers]
+        return self._clients[self._sid_for(ikey)]
 
     def _addr_for(self, ikey):
-        # same modulus as _client_for: the probe must target the exact
+        # same mapping as _client_for: the probe must target the exact
         # server the client RPC went to
-        return self._server_addrs[ikey % self._num_servers]
+        return self._server_addrs[self._sid_for(ikey)]
 
     def _var(self, k):
         if k not in self._key_vars:
@@ -560,10 +586,14 @@ class KVStoreDist(KVStore):
 
         retries, timeout_ms = self._retry_config()
         if ikey is None:
-            # barrier talks to the whole group but over client 0's
-            # connection, so that is the one whose health we can check
-            addrs, conn_addrs = self._server_addrs, [self._server_addrs[0]]
-            clients = [self._clients[0]]
+            # barrier talks to the whole group but over one primary's
+            # connection, so that is the one whose health we can check.
+            # Under HA only the mapped primaries matter — backups and
+            # evicted servers must not fail the barrier.
+            sids = sorted(set(self._smap))
+            addrs = [self._server_addrs[s] for s in sids]
+            conn_addrs = [self._server_addrs[self._smap[0]]]
+            clients = [self._clients[self._smap[0]]]
         else:
             addrs = conn_addrs = [self._addr_for(ikey)]
             clients = [self._client_for(ikey)]
@@ -589,6 +619,16 @@ class KVStoreDist(KVStore):
                     raise
                 dead = self._probe_dead(addrs, timeout_ms)
                 if dead:
+                    if self._ha:
+                        # a backup exists for every key range: report the
+                        # loss to the registry and take the elastic
+                        # reject->drain->adopt path instead of dying
+                        self._report_server_loss(dead, err)
+                        raise KVMembershipError(
+                            "kvstore %s failed: server(s) %s unreachable — "
+                            "reconfiguring onto backup(s) (cause: %s)"
+                            % (what, ", ".join("%s:%d" % a for a in dead),
+                               err)) from err
                     raise MXNetError(
                         "kvstore %s failed: server(s) %s unreachable "
                         "(dead node) — failing fast; restart and relaunch "
@@ -599,6 +639,19 @@ class KVStoreDist(KVStore):
                             if self._lib.mxt_ps_client_probe(
                                 c, b"ping", timeout_ms) != 0]
                 if bad_conn:
+                    if self._ha:
+                        # the server is alive but our socket died (server
+                        # restarted between probes, transient RST): under HA
+                        # adopt_server_map() rebuilds dead clients during
+                        # reconfigure, so route through the membership path
+                        # instead of condemning the whole worker
+                        self._report_server_loss(bad_conn, err)
+                        raise KVMembershipError(
+                            "kvstore %s failed: connection to server(s) %s "
+                            "lost (server alive) — reconfiguring with a "
+                            "fresh connection (cause: %s)"
+                            % (what, ", ".join("%s:%d" % a for a in bad_conn),
+                               err)) from err
                     # the SERVER is alive (fresh-socket probe above passed)
                     # but this worker's shared connection is dead — and
                     # PSClient never reconnects, so every retry would fail
@@ -623,6 +676,99 @@ class KVStoreDist(KVStore):
                 telemetry.counter("kvstore.backoff_ms", op=what).inc(
                     int(delay * 1000))
                 time.sleep(delay * (0.5 + random.random()))
+
+    # ---- server HA (docs/distributed.md §server-HA) ---------------------
+    def _report_server_loss(self, dead_addrs, err):
+        """Best-effort: tell the registry which server(s) we found dead so
+        it can promote a backup without waiting out the heartbeat lapse,
+        then flag the loss so the elastic session waits for the new map."""
+        self._server_loss = True
+        for a in dead_addrs:
+            try:
+                sid = self._server_addrs.index(tuple(a))
+            except ValueError:
+                continue
+            telemetry.counter("kvstore.server_loss_reports",
+                              server="%s:%d" % a).inc()
+            try:
+                self.registry_command("mb_srv_dead:%d" % sid,
+                                      timeout_ms=2000)
+            except Exception as e:  # noqa: BLE001 — the registry may be
+                # failing over too; heartbeat lapse detection is the
+                # backstop, so the hint's failure is only worth a breadcrumb
+                telemetry.counter("kv.membership.heartbeat_failures").inc()
+                logging.debug("kvstore rank %d: mb_srv_dead hint for "
+                              "server %d failed: %s", self._rank, sid, e)
+        logging.warning("kvstore rank %d: server(s) %s unreachable — "
+                        "reported to registry, awaiting new server map "
+                        "(cause: %s)", self._rank,
+                        ", ".join("%s:%d" % tuple(a) for a in dead_addrs),
+                        err)
+
+    def consume_server_loss(self):
+        """Return-and-clear the server-loss flag (elastic session uses it
+        to require a NEWER membership epoch before resuming)."""
+        loss, self._server_loss = self._server_loss, False
+        return loss
+
+    def _client_sid(self, sid):
+        """Client handle for server ``sid``, transparently rebuilding a
+        dead connection under HA (a promoted/relaunched server accepts
+        fresh sockets; PSClient itself never reconnects). Replaced handles
+        are kept in a graveyard — engine threads may still hold them —
+        and destroyed only in __del__."""
+        c = self._clients[sid]
+        if not getattr(self._lib, "_mxt_has_ps_ha", False):
+            return c
+        if c and not self._lib.mxt_ps_client_is_dead(c):
+            return c
+        host, port = self._server_addrs[sid]
+        fresh = self._lib.mxt_ps_client_create2(host.encode(), port, 50)
+        if not fresh:
+            return c  # still down; caller's probe/deadline handles it
+        self._lib.mxt_ps_client_set_identity(fresh, self._rank)
+        self._lib.mxt_ps_client_set_epoch(fresh, self._mepoch)
+        if c:
+            self._dead_clients.append(c)
+        self._clients[sid] = fresh
+        logging.info("kvstore rank %d: reconnected to server %d (%s:%d)",
+                     self._rank, sid, host, port)
+        return fresh
+
+    def adopt_server_map(self, smap):
+        """Adopt the registry's key-group → primary map (broadcast on
+        server failover). Rebuilds dead client connections for every
+        server we will talk to. A ``None``/missing entry (group fully
+        dead) keeps the old target — RPCs to it fail fast and surface
+        the outage instead of mis-routing keys."""
+        if not smap or not self._ha:
+            return
+        try:
+            smap = [None if s is None else int(s) for s in smap]
+        except (TypeError, ValueError):
+            logging.warning("kvstore: malformed server map %r ignored", smap)
+            return
+        if len(smap) != self._ngroups or any(
+                s is not None and not 0 <= s < self._num_servers
+                for s in smap):
+            logging.warning("kvstore: server map %r does not match %d "
+                            "groups over %d servers — ignored",
+                            smap, self._ngroups, self._num_servers)
+            return
+        new = [old if s is None else s
+               for s, old in zip(smap, self._smap)]
+        if new != self._smap:
+            # warning, like elastic's "reconfigured to membership epoch":
+            # a failover is rare and operators grep for it in worker logs
+            logging.warning("kvstore rank %d: adopting server map %s -> %s",
+                            self._rank, self._smap, new)
+            telemetry.event("server_map_adopted", rank=self._rank,
+                            smap=list(new))
+            self._smap = new
+        # reconnect everything routing now depends on (mapped primaries
+        # plus group 0, which hosts the registry and its standbys)
+        for sid in sorted(set(self._smap) | set(self._groups[0])):
+            self._client_sid(sid)
 
     def _zpush(self, ikey, arr_np):
         import ctypes
@@ -701,6 +847,10 @@ class KVStoreDist(KVStore):
         barrier/init requests are membership-epoch-checked (idempotent;
         every elastic worker sends it at session start)."""
         self._elastic = True
+        # server HA needs the elastic reconfigure machinery to act on a
+        # lost server; without --elastic a dead server still fails fast
+        self._ha = (self._replicas > 0
+                    and getattr(self._lib, "_mxt_has_ps_ha", False))
         for c in self._clients:
             self._lib.mxt_ps_client_command(c, b"elastic:1")
 
@@ -779,17 +929,48 @@ class KVStoreDist(KVStore):
 
         self._with_retry("init", ikey, attempt)
 
+    def _registry_client(self):
+        """Client for the server currently believed to host the registry
+        (group 0's primary; plain server 0 without HA)."""
+        if self._ha:
+            return self._client_sid(self._registry_sid)
+        return self._clients[self._registry_sid]
+
+    def _registry_probe(self, cmd, timeout_ms):
+        """Send ``cmd`` to the registry with failover: try the remembered
+        registry server first, then walk the rest of group 0 in standby
+        order (kvstore_server._standby_loop activates them in exactly this
+        order). Returns the client that acknowledged, or None. Sticky: a
+        successful fallback is memoized so later traffic goes straight to
+        the new registry host."""
+        cands = [self._registry_sid] + [s for s in self._groups[0]
+                                        if s != self._registry_sid]
+        for sid in cands:
+            c = (self._client_sid(sid) if self._ha else self._clients[sid])
+            if not c:
+                continue
+            if self._lib.mxt_ps_client_probe(c, cmd, timeout_ms) == 0:
+                if sid != self._registry_sid:
+                    logging.info("kvstore rank %d: registry moved to "
+                                 "server %d", self._rank, sid)
+                    telemetry.counter("kv.registry.failover_probes").inc()
+                    self._registry_sid = sid
+                return c
+            if not self._ha:
+                break  # no standbys to walk without HA
+        return None
+
     def registry_command(self, cmd, timeout_ms=None):
-        """Deadline-bounded command to the membership registry (server 0).
-        Returns True when the registry acknowledged. Used for heartbeats
-        and membership transitions — a wedged registry must cost a bounded
-        wait, never a hang in the heartbeat thread."""
+        """Deadline-bounded command to the membership registry (group 0's
+        primary; server 0 unless HA failed it over). Returns True when the
+        registry acknowledged. Used for heartbeats and membership
+        transitions — a wedged registry must cost a bounded wait, never a
+        hang in the heartbeat thread."""
         if timeout_ms is None:
             _, timeout_ms = self._retry_config()
         if isinstance(cmd, str):
             cmd = cmd.encode()
-        return self._lib.mxt_ps_client_probe(
-            self._clients[0], cmd, timeout_ms) == 0
+        return self._registry_probe(cmd, timeout_ms) is not None
 
     def _fresh_reserved_key(self):
         """A negative key unique across workers and recent calls (user
@@ -836,7 +1017,8 @@ class KVStoreDist(KVStore):
 
     def registry_fetch(self, cmd_prefix, timeout_ms=None):
         """Fetch a byte payload the registry publishes on demand: sends
-        ``<cmd_prefix>:<reserved key>`` to server 0, then pulls that key.
+        ``<cmd_prefix>:<reserved key>`` to the registry server (with
+        group-0 failover under HA), then pulls that key.
         Same reserved-negative-key transport as request_server_stats (the
         command channel itself carries no payload); returns the raw bytes
         or None when the registry did not answer in time."""
@@ -846,11 +1028,11 @@ class KVStoreDist(KVStore):
             _, timeout_ms = self._retry_config()
         key = self._fresh_reserved_key()
         cmd = ("%s:%d" % (cmd_prefix, key)).encode()
-        if self._lib.mxt_ps_client_probe(self._clients[0], cmd,
-                                         timeout_ms) != 0:
+        client = self._registry_probe(cmd, timeout_ms)
+        if client is None:
             return None
         cap = 65536
-        got, buf = self._bounded_pull(self._clients[0], key, cap, timeout_ms)
+        got, buf = self._bounded_pull(client, key, cap, timeout_ms)
         if got is None or got <= 0 or got > cap:
             return None
         return decode_bytes_vec(buf[:got])
@@ -1065,15 +1247,19 @@ class KVStoreDist(KVStore):
         self._engine.wait_all()
 
         def attempt():
-            rc = self._lib.mxt_ps_client_barrier(self._clients[0])
+            # all workers must count arrivals on the SAME server; under HA
+            # that is the current primary of group 0 (identical _smap on
+            # every worker after adopt_server_map), plain server 0 otherwise
+            rc = self._lib.mxt_ps_client_barrier(
+                self._clients[self._smap[0]])
             if rc == -2:
                 raise _membership_reject("barrier", 0)
             if rc != 0:
                 raise MXNetError("barrier rpc failed")
 
         # barrier synchronizes against the whole server group: probe every
-        # server (ikey=None), not just shard 0, so a dead non-zero server
-        # fails fast with its own name instead of burning retries
+        # mapped primary (ikey=None), not just shard 0, so a dead non-zero
+        # server fails fast with its own name instead of burning retries
         from . import profiler
 
         if not telemetry.enabled() and not profiler.is_running():
@@ -1167,7 +1353,11 @@ class KVStoreDist(KVStore):
         worker pulls that key back with :meth:`_bounded_pull`. Every
         round-trip is deadline-bounded (MXNET_KV_TIMEOUT_MS): a WEDGED
         server — open socket, no replies — must produce a ``None`` entry,
-        not a hang."""
+        not a hang. A server that just failed is SKIPPED (no wire traffic)
+        until its deadline-long penalty window expires, so a poller like
+        mxtop pays the timeout once per window, not once per poll — each
+        skip or fresh failure bumps the always-on ``kv.stats_unreachable``
+        counter."""
         import logging
 
         from .kvstore_server import STATS_VEC_LEN, decode_stats_vec
@@ -1176,12 +1366,16 @@ class KVStoreDist(KVStore):
         out = {}
         for i, c in enumerate(self._clients):
             addr = "%s:%d" % self._server_addrs[i]
+            if self._stats_skipped(addr):
+                out[addr] = None
+                continue
             key = self._fresh_reserved_key()
             cmd = ("stats_to:%d" % key).encode()
             if self._lib.mxt_ps_client_probe(c, cmd, timeout_ms) != 0:
                 logging.warning(
                     "kvstore: server %s did not acknowledge the stats "
                     "command (dead or wedged?)", addr)
+                self._stats_unreachable(addr, timeout_ms)
                 out[addr] = None
                 continue
             got, buf = self._bounded_pull(c, key, STATS_VEC_LEN, timeout_ms)
@@ -1192,10 +1386,27 @@ class KVStoreDist(KVStore):
                     addr,
                     "timed out" if got is None else "returned %s" % got,
                     STATS_VEC_LEN)
+                self._stats_unreachable(addr, timeout_ms)
                 out[addr] = None
                 continue
             out[addr] = decode_stats_vec(buf)
         return out
+
+    def _stats_skipped(self, addr):
+        """True while ``addr`` is inside its stats penalty window — the
+        poll skips it without wire traffic. Always-on counter either way
+        (rare path; a degraded cluster must show in `telemetry.dump()`)."""
+        if time.monotonic() < self._stats_skip.get(addr, 0.0):
+            telemetry.counter("kv.stats_unreachable", server=addr).inc()
+            return True
+        return False
+
+    def _stats_unreachable(self, addr, timeout_ms):
+        """Record a stats/trace failure for ``addr``: bump the always-on
+        counter and open a deadline-long penalty window during which polls
+        skip the server instead of re-paying the timeout."""
+        telemetry.counter("kv.stats_unreachable", server=addr).inc()
+        self._stats_skip[addr] = time.monotonic() + timeout_ms / 1000.0
 
     # ---- cluster observability (docs/observability.md §cluster) ----------
     def _snapshot_cumulative(self):
@@ -1299,7 +1510,7 @@ class KVStoreDist(KVStore):
             # vec stays referenced by this closure: a late response from a
             # recovering server writes into live memory, never freed memory
             result[0] = self._lib.mxt_ps_client_init(
-                self._clients[0], telemetry_slot(self._rank),
+                self._registry_client(), telemetry_slot(self._rank),
                 vec.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), vec.size)
 
         _, timeout_ms = self._retry_config()
@@ -1336,11 +1547,12 @@ class KVStoreDist(KVStore):
             return None
 
     def fetch_cluster_snapshot(self, rank, timeout_ms=None):
-        """Pull rank ``rank``'s last published snapshot from server 0, or
-        None when the slot is empty / unreadable / the pull timed out."""
+        """Pull rank ``rank``'s last published snapshot from the registry
+        server (server 0 unless HA failed it over), or None when the slot
+        is empty / unreadable / the pull timed out."""
         if timeout_ms is None:
             _, timeout_ms = self._retry_config()
-        return self._pull_published_json(self._clients[0],
+        return self._pull_published_json(self._registry_client(),
                                          telemetry_slot(rank), timeout_ms)
 
     def cluster_stats(self, timeout_ms=None, max_age_s=30.0):
@@ -1376,9 +1588,13 @@ class KVStoreDist(KVStore):
         out = {}
         for i, c in enumerate(self._clients):
             addr = "%s:%d" % self._server_addrs[i]
+            if self._stats_skipped(addr):
+                out[addr] = None
+                continue
             key = self._fresh_reserved_key()
             cmd = ("trace_to:%d" % key).encode()
             if self._lib.mxt_ps_client_probe(c, cmd, timeout_ms) != 0:
+                self._stats_unreachable(addr, timeout_ms)
                 out[addr] = None
                 continue
             out[addr] = self._pull_published_json(c, key, timeout_ms)
@@ -1413,13 +1629,17 @@ class KVStoreDist(KVStore):
             self._cluster = None
 
     def _stop_servers(self):
-        """Shut down server processes (rank 0, exit path)."""
-        for c in self._clients:
+        """Shut down server processes (rank 0, exit path). Under HA a
+        relaunched server sits behind a fresh socket — _client_sid
+        reconnects so the stop actually reaches it (otherwise the launcher
+        reaps it on a timeout)."""
+        for sid in range(self._num_servers):
+            c = self._client_sid(sid) if self._ha else self._clients[sid]
             self._lib.mxt_ps_client_stop(c)
 
     def __del__(self):
         try:
-            for c in self._clients:
+            for c in self._clients + self._dead_clients:
                 self._lib.mxt_ps_client_destroy(c)
         except Exception:  # fwlint: disable=swallowed-exception — interpreter
             pass  # teardown: the ctypes lib global may already be gone

@@ -22,22 +22,59 @@ import time
 
 import numpy as np
 
-from . import telemetry
+# NOTE (import-lock invariant): in a server process the MAIN thread never
+# leaves ``import mxnet_tpu`` (_init_kvstore_server_module serves inside
+# it), so it holds the package's import lock for the process lifetime.
+# Conn-handler / replication / checkpoint-writer / standby threads
+# therefore must NEVER execute a package-relative import — it would block
+# on that lock forever. Everything those threads need from the package is
+# imported HERE, at module top, on the importing thread itself.
+from . import fault, telemetry
 from ._native import COMMAND_FN, UPDATER_FN, get_lib
+from .base import env_float, env_int
+from .utils.atomic_file import atomic_write, read_verified
 
-__all__ = ["KVStoreServer", "MembershipRegistry",
+__all__ = ["KVStoreServer", "MembershipRegistry", "plan_server_groups",
            "_init_kvstore_server_module",
            "STATS_VEC_LEN", "encode_stats_vec", "decode_stats_vec",
            "encode_bytes_vec", "decode_bytes_vec"]
+
+
+def plan_server_groups(num_servers, replicas):
+    """Partition server ids into replicated groups of ``replicas + 1``.
+
+    Group g serves key range g (``ikey % num_groups``); the first member is
+    the boot-time primary, the rest are backups in deterministic failover
+    order. ``MXNET_KV_REPLICAS=0`` (the default) degenerates to one
+    singleton group per server — exactly the pre-HA ``ikey % num_servers``
+    sharding, so the HA machinery stays strictly additive."""
+    num_servers = int(num_servers)
+    replicas = int(replicas)
+    if replicas < 0:
+        raise ValueError("MXNET_KV_REPLICAS must be >= 0, got %d" % replicas)
+    width = replicas + 1
+    if num_servers % width:
+        raise ValueError(
+            "MXNET_KV_REPLICAS=%d needs a server count divisible by %d, "
+            "got %d server(s)" % (replicas, width, num_servers))
+    return [list(range(g * width, (g + 1) * width))
+            for g in range(num_servers // width)]
 
 # Wire format of the vector a server publishes under a reserved key when a
 # worker sends ``stats_to:<key>`` (kvstore.request_server_stats decodes it
 # back into a dict). The transport ships float32, which stops representing
 # consecutive integers past 2^24 (~16.7M updates — a few hours of real
 # training), so each counter travels as two 24-bit words: exact to 2^48.
-# Order is the wire contract — append fields, never reorder.
+# Order is the wire contract — append fields, never reorder. The HA
+# counters (_STATS_COUNTER_FIELDS_HA) were appended AFTER the original
+# has_optimizer flag so the flag keeps its wire position: a pre-HA decoder
+# reading its own prefix of the longer vector still parses correctly.
 _STATS_COUNTER_FIELDS = ("updates_applied", "update_failures")
-STATS_VEC_LEN = 2 * len(_STATS_COUNTER_FIELDS) + 1  # + has_optimizer flag
+_STATS_COUNTER_FIELDS_HA = (
+    "repl_forwards", "repl_acks", "repl_failures", "repl_lag_rounds",
+    "ckpt_writes", "ckpt_restores", "ckpt_bytes")
+STATS_VEC_LEN = (2 * len(_STATS_COUNTER_FIELDS) + 1  # + has_optimizer flag
+                 + 2 * len(_STATS_COUNTER_FIELDS_HA))
 
 
 def encode_stats_vec(stats):
@@ -48,6 +85,10 @@ def encode_stats_vec(stats):
         vec.append(float(v & 0xFFFFFF))
         vec.append(float(v >> 24))
     vec.append(1.0 if stats["has_optimizer"] else 0.0)
+    for f in _STATS_COUNTER_FIELDS_HA:
+        v = int(stats.get(f, 0))
+        vec.append(float(v & 0xFFFFFF))
+        vec.append(float(v >> 24))
     return np.array(vec, np.float32)
 
 
@@ -57,7 +98,13 @@ def decode_stats_vec(arr):
     out = {}
     for i, f in enumerate(_STATS_COUNTER_FIELDS):
         out[f] = vals[2 * i] | (vals[2 * i + 1] << 24)
-    out["has_optimizer"] = bool(vals[2 * len(_STATS_COUNTER_FIELDS)])
+    base = 2 * len(_STATS_COUNTER_FIELDS)
+    out["has_optimizer"] = bool(vals[base])
+    for i, f in enumerate(_STATS_COUNTER_FIELDS_HA):
+        lo = base + 1 + 2 * i
+        if lo + 1 >= len(vals):
+            break  # vector from a pre-HA server: HA counters absent
+        out[f] = vals[lo] | (vals[lo + 1] << 24)
     return out
 
 
@@ -97,12 +144,28 @@ class MembershipRegistry:
 
     ``broadcast`` is injectable for tests; the default sends the command to
     each server on a deadline-bounded probe (a wedged sibling server costs
-    one timeout, never wedges the registry)."""
+    one timeout, never wedges the registry).
+
+    **Server membership** (server HA, docs/distributed.md §server-HA): the
+    registry also tracks the PS tier itself. Servers heartbeat
+    (``mb_srv_hb``); a lapse — or a worker's probe-confirmed
+    ``mb_srv_dead`` hint — evicts the server, and if it was the primary of
+    its replication group the first alive backup is promoted: the new
+    key→server map (``smap``) is broadcast to every surviving server, then
+    the membership epoch bumps so workers take the same
+    reject→drain→adopt→continue path they take for worker loss. Server
+    lapse monitoring arms itself on the FIRST server heartbeat, so
+    registries in non-HA jobs (and unit tests) never see spurious server
+    evictions. The registry itself fails over: it periodically replicates
+    its own snapshot to the group-0 backups (``mb_sync``), and the first
+    alive group-0 member resumes it when every predecessor is dead
+    (deterministic failover order = group-0 member order)."""
 
     def __init__(self, num_workers, heartbeat_timeout_s=None,
-                 broadcast=None, logger=None):
-        from .base import env_float
-
+                 broadcast=None, logger=None, num_servers=None,
+                 replicas=None, probe=None, resume=None):
+        # no function-level package imports: a registry failover constructs
+        # this on the standby thread (see the import-lock note at the top)
         self._target = int(num_workers)
         self._timeout_s = (heartbeat_timeout_s if heartbeat_timeout_s
                            is not None
@@ -121,12 +184,68 @@ class MembershipRegistry:
         self._formed = False
         self._done = False
         self._pos = None   # restart position published by the coordinator
-        self._bcast_clients = None  # lazy: one per server, incl. loopback
+        self._bcast_clients = None  # lazy: sid -> (addr, client handle)
+        # ---- server membership (guarded-by: _lock) ----------------------
+        if num_servers is None:
+            num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+        if replicas is None:
+            replicas = env_int("MXNET_KV_REPLICAS", 0)
+        self._groups = plan_server_groups(num_servers, replicas)
+        now = time.monotonic()
+        self._srv_alive = {s: now for s in range(int(num_servers))}
+        self._smap = [g[0] for g in self._groups]  # group -> primary sid
+        self._srv_monitoring = False  # armed by the first server heartbeat
+        self._srv_probe = probe if probe is not None else self._probe_server
+        self._sync_at = now  # next mb_sync replication of the registry state
+        if resume:
+            self._resume_from(resume)
         self._stop = threading.Event()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True,
             name="mxnet-kv-membership-monitor")
         self._monitor.start()
+
+    def _resume_from(self, snap):
+        """Seed state from a predecessor's ``mb_sync`` snapshot (registry
+        failover onto a group-0 backup). Heartbeat timestamps travel as
+        ages so monotonic clocks never cross processes; the dead
+        predecessor's stale age then lapses here within one timeout and
+        the normal eviction path promotes this host's group."""
+        now = time.monotonic()
+        self._epoch = int(snap.get("epoch", 0))
+        self._formed = bool(snap.get("formed", False))
+        self._done = bool(snap.get("done", False))
+        self._pos = snap.get("pos")
+        self._last_step = {int(r): int(s)
+                           for r, s in (snap.get("steps") or {}).items()}
+        self._alive = {int(r): now - float(age)
+                       for r, age in (snap.get("workers") or {}).items()}
+        srv = snap.get("servers")
+        if srv is not None:
+            self._srv_alive = {int(s): now - float(age)
+                               for s, age in srv.items()}
+        if snap.get("smap"):
+            self._smap = [int(s) if s is not None else None
+                          for s in snap["smap"]]
+        self._srv_monitoring = bool(snap.get("srv_monitoring", False))
+
+    def snapshot(self):
+        """JSON-able full state for ``mb_sync`` standby replication
+        (inverse of :meth:`_resume_from`)."""
+        with self._lock:
+            now = time.monotonic()
+            return {
+                "epoch": self._epoch,
+                "formed": self._formed,
+                "done": self._done,
+                "pos": self._pos,
+                "steps": {str(r): s for r, s in self._last_step.items()},
+                "workers": {str(r): now - t for r, t in self._alive.items()},
+                "servers": {str(s): now - t
+                            for s, t in self._srv_alive.items()},
+                "smap": list(self._smap),
+                "srv_monitoring": self._srv_monitoring,
+            }
 
     # ---- worker-facing transitions (conn handler threads) ---------------
     def join(self, rank, step=None):
@@ -196,6 +315,57 @@ class MembershipRegistry:
         with self._lock:
             self._pos = payload
 
+    # ---- server-facing transitions (conn handler threads) ----------------
+    def server_heartbeat(self, sid):
+        """Refresh server ``sid``'s liveness; an unknown sid is a (re)join.
+
+        Unlike worker heartbeats, an unknown server heartbeat ALWAYS counts
+        as a join: a relaunched server slot is the same shard rejoining as
+        a backup of its group — there is no half-pushed-round hazard to
+        flush, so resurrecting it is always safe. The first heartbeat ever
+        seen arms server-lapse monitoring (and refreshes every seed
+        timestamp, so siblings that simply have not beaten yet are not
+        instantly evicted)."""
+        sid = int(sid)
+        with self._lock:
+            self._arm_srv_locked()
+            if sid in self._srv_alive:
+                self._srv_alive[sid] = time.monotonic()
+                return
+            self._srv_alive[sid] = time.monotonic()
+            telemetry.event("server_rejoined", sid=sid, epoch=self._epoch)
+            self._logger.warning(
+                "membership: server %d rejoined as a backup of its group "
+                "(smap %s)", sid, self._smap)
+            # a rejoin never steals primaryship back (sticky smap: churn-
+            # free, and the rejoiner's slots are stale) — but it CAN revive
+            # a group that lost every member
+            self._reconfigure_servers_locked(rejoined=sid)
+
+    server_join = server_heartbeat  # mb_srv_join and mb_srv_hb are the same
+
+    def server_suspect(self, sid):
+        """A worker reported server ``sid`` dead (its client socket
+        failed). Trust but verify: confirm with a deadline-bounded probe on
+        a fresh socket before evicting — a worker-side network blip must
+        not take down a healthy shard. Runs on a conn handler thread; the
+        probe happens OUTSIDE the lock."""
+        sid = int(sid)
+        with self._lock:
+            if sid not in self._srv_alive:
+                return  # already evicted
+        if self._srv_probe(sid):
+            self._logger.info(
+                "membership: server %d reported dead by a worker but "
+                "answers probes — keeping it", sid)
+            return
+        with self._lock:
+            if sid in self._srv_alive:
+                del self._srv_alive[sid]
+                telemetry.event("server_lost", sid=sid,
+                                reason="worker_report", epoch=self._epoch)
+                self._reconfigure_servers_locked(lost=sid)
+
     def table(self):
         """The membership table workers consume (JSON-able)."""
         with self._lock:
@@ -210,6 +380,10 @@ class MembershipRegistry:
                 # observability only — mxtop shows where each worker is, and
                 # reconfigure post-mortems line the bump up with the steps
                 "steps": dict(self._last_step),
+                # server HA: group -> primary sid (workers route by this)
+                # and the alive server set (observability)
+                "smap": list(self._smap),
+                "servers": sorted(self._srv_alive),
             }
 
     def close(self):
@@ -232,45 +406,176 @@ class MembershipRegistry:
             self._epoch, why, workers, sorted(self._alive))
         self._broadcast("mepoch:%d:%d" % (self._epoch, max(workers, 1)))
 
+    def _arm_srv_locked(self):
+        """First server heartbeat arms lapse monitoring; refresh every seed
+        so a sibling that has not beaten yet gets a full timeout to."""
+        if not self._srv_monitoring:
+            self._srv_monitoring = True
+            now = time.monotonic()
+            for s in self._srv_alive:
+                self._srv_alive[s] = now
+
+    def _recompute_smap_locked(self):
+        """Sticky primary recomputation: a group keeps its primary while it
+        is alive; a dead primary is replaced by the first alive member in
+        group order (deterministic failover). Returns ``[(group, old,
+        new), ...]`` for every group whose primary changed."""
+        changed = []
+        for gi, members in enumerate(self._groups):
+            cur = self._smap[gi]
+            if cur is not None and cur in self._srv_alive:
+                continue
+            new = next((s for s in members if s in self._srv_alive), None)
+            if new != cur:
+                self._smap[gi] = new
+                changed.append((gi, cur, new))
+        return changed
+
+    def _reconfigure_servers_locked(self, lost=None, rejoined=None):
+        """A server left or (re)joined: recompute the map, tell every
+        surviving server (they need it for replication targeting) and —
+        only when a primary actually changed — bump the membership epoch so
+        workers drain, adopt the new map, and re-seed the promoted
+        primaries. The smap broadcast goes out BEFORE the epoch bump:
+        by the time a worker reconfigures, every server already routes and
+        replicates on the new map."""
+        changed = self._recompute_smap_locked()
+        promotions = [(gi, old, new) for gi, old, new in changed
+                      if new is not None]
+        import json
+
+        self._broadcast("smap:" + json.dumps(
+            {"smap": self._smap, "alive": sorted(self._srv_alive)}))
+        for gi, old, new in changed:
+            if new is None:
+                self._logger.error(
+                    "membership: server group %d lost ALL members %s — its "
+                    "key range is unservable until one rejoins",
+                    gi, self._groups[gi])
+        if not promotions:
+            if lost is not None:
+                self._logger.warning(
+                    "membership: backup server %d lost — no promotion "
+                    "needed (smap %s)", lost, self._smap)
+            return
+        for gi, old, new in promotions:
+            telemetry.counter("kv.replication.failovers").inc()
+            telemetry.event("server_promoted", group=gi, old_primary=old,
+                            new_primary=new, epoch=self._epoch + 1)
+        why = ("server %s lost — promoted %s"
+               % (lost, ["group %d: %s->%s" % c for c in promotions])
+               if lost is not None else
+               "server %s rejoined — revived %s"
+               % (rejoined, ["group %d: %s->%s" % c for c in promotions]))
+        self._bump_locked(why)
+
+    def _probe_server(self, sid):
+        """Fresh-socket liveness probe of server ``sid`` with a deadline
+        (see mxt_ps_probe: cannot wedge on a shared client socket)."""
+        lib = get_lib()
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        timeout_ms = max(min(int(self._timeout_s * 1000), 2000), 100)
+        return lib.mxt_ps_probe(host.encode(), port + int(sid),
+                                timeout_ms) == 0
+
     def _broadcast_to_servers(self, cmd):
         lib = get_lib()
+        create2 = getattr(lib, "mxt_ps_client_create2", None)
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
         if self._bcast_clients is None:
-            host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-            port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
-            n = int(os.environ.get("DMLC_NUM_SERVER", "1"))
-            self._bcast_clients = []
-            for s in range(n):
-                c = lib.mxt_ps_client_create(host.encode(), port + s)
-                self._bcast_clients.append((("%s:%d" % (host, port + s)), c))
+            self._bcast_clients = {}
+            for s in range(sum(len(g) for g in self._groups)):
+                # bounded connect budget: dialing a dead sibling during a
+                # failover broadcast must cost seconds, not the 60s launch
+                # race budget
+                c = (create2(host.encode(), port + s, 30) if create2
+                     else lib.mxt_ps_client_create(host.encode(), port + s))
+                self._bcast_clients[s] = (("%s:%d" % (host, port + s)), c)
         timeout_ms = max(int(self._timeout_s * 1000), 1)
-        for addr, c in self._bcast_clients:
+        # only alive servers are told: an evicted server no longer needs
+        # epochs/maps (it re-learns on rejoin), and dialing it would cost a
+        # timeout per broadcast
+        alive = set(self._srv_alive)
+        for s, (addr, c) in self._bcast_clients.items():
+            if s not in alive:
+                continue
+            if (not c or lib.mxt_ps_client_is_dead(c)) and create2:
+                # reconnect (bounded): e.g. a relaunched server slot
+                if c:
+                    lib.mxt_ps_client_destroy(c)
+                c = create2(host.encode(), port + s, 30)
+                self._bcast_clients[s] = (addr, c)
             if not c or lib.mxt_ps_client_probe(c, cmd.encode(),
                                                 timeout_ms) != 0:
                 self._logger.error(
                     "membership: server %s did not acknowledge %r — a stale "
                     "epoch may briefly survive there", addr, cmd)
 
+    def _sync_standbys(self):
+        """Replicate the registry's own state to the group-0 backups
+        (``mb_sync``) so a standby can resume it if this host dies. Sent
+        through the normal broadcast channel: non-standby servers just
+        stash the snapshot harmlessly."""
+        if len(self._groups[0]) < 2:
+            return  # no standbys configured
+        import json
+
+        payload = base64.b64encode(
+            json.dumps(self.snapshot()).encode()).decode()
+        with self._lock:
+            self._broadcast("mb_sync:" + payload)
+
     def _monitor_loop(self):
         while not self._stop.wait(max(self._timeout_s / 4.0, 0.1)):
             now = time.monotonic()
+            sync_due = False
             with self._lock:
-                # done-reported ranks were removed from _alive by done();
-                # everyone still listed is monitored even after the first
-                # mb_done (see done())
-                if not self._formed:
-                    continue
-                expired = [r for r, t in self._alive.items()
-                           if now - t > self._timeout_s]
-                for r in expired:
-                    del self._alive[r]
-                if expired:
-                    for r in expired:
-                        telemetry.event("worker_lost", rank=r,
+                # server-lapse monitoring runs regardless of worker-side
+                # formation (servers heartbeat from process start), but only
+                # once armed by the first server heartbeat ever seen
+                if self._srv_monitoring:
+                    dead = [s for s, t in self._srv_alive.items()
+                            if now - t > self._timeout_s]
+                    for s in dead:
+                        del self._srv_alive[s]
+                        telemetry.event("server_lost", sid=s,
                                         reason="heartbeat_lapse",
-                                        epoch=self._epoch + 1,
-                                        last_step=self._last_step.get(r))
-                    self._bump_locked(
-                        "heartbeat lapse: worker(s) %s" % sorted(expired))
+                                        epoch=self._epoch)
+                    if dead:
+                        self._logger.warning(
+                            "membership: server heartbeat lapse: %s",
+                            sorted(dead))
+                        self._reconfigure_servers_locked(lost=sorted(dead))
+                    if now >= self._sync_at:
+                        self._sync_at = now + self._timeout_s
+                        sync_due = True
+                if self._formed:
+                    # done-reported ranks were removed from _alive by
+                    # done(); everyone still listed is monitored even after
+                    # the first mb_done (see done())
+                    expired = [r for r, t in self._alive.items()
+                               if now - t > self._timeout_s]
+                    for r in expired:
+                        del self._alive[r]
+                    if expired:
+                        for r in expired:
+                            telemetry.event("worker_lost", rank=r,
+                                            reason="heartbeat_lapse",
+                                            epoch=self._epoch + 1,
+                                            last_step=self._last_step.get(r))
+                        self._bump_locked(
+                            "heartbeat lapse: worker(s) %s" % sorted(expired))
+            if sync_due:
+                # outside the lock: snapshot() retakes it, and the
+                # broadcast is network I/O
+                try:
+                    self._sync_standbys()
+                except Exception:  # noqa: BLE001 — standby replication is
+                    # best-effort; a failed sync costs failover freshness,
+                    # never the registry itself
+                    self._logger.exception("membership: mb_sync failed")
 
 
 class KVStoreServer:
@@ -309,14 +614,86 @@ class KVStoreServer:
         self._max_update_failures = env_int(
             "MXNET_KV_SERVER_MAX_UPDATE_FAILURES", 10)
 
-        # elastic membership: server rank 0 hosts the registry
-        # (docs/distributed.md §elasticity); siblings only apply the
-        # registry's mepoch broadcasts inside the native layer
-        from .base import env_bool
+        # ---- server HA (docs/distributed.md §server-HA) ------------------
+        from .base import env_bool, env_flag, env_float
 
+        self._sid = int(os.environ.get("DMLC_SERVER_ID", "0"))
+        self._num_workers = int(num_workers)
+        self._elastic = env_bool("MXNET_ELASTIC")
+        self._replicas = env_int("MXNET_KV_REPLICAS", 0)
+        nservers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+        self._groups = plan_server_groups(nservers, self._replicas)
+        self._gi = next((i for i, g in enumerate(self._groups)
+                         if self._sid in g), None)
+        group = self._groups[self._gi] if self._gi is not None else [self._sid]
+        self._backups = [s for s in group if s != self._sid]
+        self._hb_timeout_s = env_float(
+            "MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S", 5.0)
+        self._ha_lock = threading.Lock()
+        # guarded-by: _ha_lock — smap/alive view from registry broadcasts,
+        # primary flag, and the standby's last mb_sync snapshot
+        self._alive_sids = set(range(nservers))
+        self._smap_view = [g[0] for g in self._groups]
+        self._primary = bool(group) and group[0] == self._sid
+        self._mb_sync = None
+        self._mepoch = 0
+        # guarded-by: _stats_lock — HA wire counters (stats vec fields)
+        self._ha_stats = dict.fromkeys(_STATS_COUNTER_FIELDS_HA, 0)
+        # optimizer objects live on the MAIN thread (exec loop); pending
+        # states hold restored/replicated slots until an updater exists
+        self._updater_obj = None
+        self._optimizer_obj = None
+        self._pending_states = None
+        self._repl_recv_epoch = {}  # key -> last replication seq received
+        # replication pipeline (guarded-by: _repl_cv's lock): at most one
+        # in-flight round per key — offering the next round for a key waits
+        # (bounded) for the previous forward to complete, which is what
+        # keeps every backup at most one BSP round behind its primary
+        self._repl_cv = threading.Condition()
+        self._repl_inflight = set()
+        self._repl_seq = 0
+        self._repl_done_seq = 0
+        self._repl_clients = {}  # sid -> client handle (repl thread + mepoch)
+        self._reg_clients = {}   # sid -> client (heartbeat thread only)
+        self._repl_wait_s = min(self._hb_timeout_s, 2.0)
+        self._nservers = nservers
+        self._ha_stop = threading.Event()
+        self._ha_threads = []
+        import queue as _queue
+
+        self._repl_q = _queue.Queue()
+
+        # durable optimizer slots: pickled {optimizer, states} written
+        # through utils/atomic_file (tmp+fsync+rename+CRC) every
+        # MXNET_KV_SERVER_CKPT_STEPS applied updates; a relaunched/promoted
+        # server warm-starts from it under DMLC_PS_RECOVERY=1
+        self._ckpt_steps = env_int("MXNET_KV_SERVER_CKPT_STEPS", 0)
+        from .base import env_str
+
+        ckpt_dir = env_str("MXNET_KV_SERVER_CKPT_DIR", "")
+        if not ckpt_dir:
+            import tempfile
+
+            ckpt_dir = os.path.join(
+                tempfile.gettempdir(),
+                "mxnet-kv-server-ckpt-%d" % os.getuid())
+        self._ckpt_path = os.path.join(
+            ckpt_dir, "kv_server_%d.optstate" % self._sid)
+        self._ckpt_count = 0  # applied rounds since start (main thread only)
+        import queue
+
+        self._ckpt_q = queue.Queue()
+        if self._ckpt_steps > 0:
+            os.makedirs(ckpt_dir, exist_ok=True)
+        if env_flag("DMLC_PS_RECOVERY"):
+            self._restore_checkpoint()
+
+        # elastic membership: the first group-0 member hosts the registry
+        # (docs/distributed.md §elasticity); its group siblings stand by to
+        # resume it (deterministic failover order = group-0 member order),
+        # and every elastic server heartbeats to it
         self._registry = None
-        if env_bool("MXNET_ELASTIC") and \
-                int(os.environ.get("DMLC_SERVER_ID", "0")) == 0:
+        if self._elastic and self._sid == self._groups[0][0]:
             self._registry = MembershipRegistry(num_workers)
 
         # ALL python work (optimizer unpickle + update) runs on the server's
@@ -345,6 +722,8 @@ class KVStoreServer:
             done.wait()
             return box.get("err")
 
+        self._on_main = _on_main
+
         def _apply(key, grad_ptr, weight_ptr, n):
             # flat fp32 views over the server's buffers; optimizer updates
             # in place (reference: DataHandle → updater_(key, merged, &stored);
@@ -359,14 +738,35 @@ class KVStoreServer:
                 ctypes.cast(weight_ptr, ctypes.POINTER(ctypes.c_float)), (n,))
             with self._updater_lock:
                 fn = self._updater
+            # fault seam (docs/fault_tolerance.md): SIGKILL this SERVER
+            # after K applied updates — lands while optimizer slots and
+            # replication are in flight, the worst case for promotion
+            fault.kill_server(self._sid)
+            # unlocked read: _primary only flips on registry smap
+            # broadcasts, and a one-round-late view just costs one
+            # forward/skip — never correctness (kInit carries full weights)
+            repl = self._replicas > 0 and self._primary and self._backups
             if fn is None:
                 weight[:] = grad
+                if repl:
+                    self._repl_offer(int(key), weight.copy(), None)
             else:
-                err = _on_main(lambda: fn(int(key), grad, weight))
+                box = {}
+
+                def work():
+                    fn(int(key), grad, weight)
+                    if repl:
+                        box["state"] = self._slot_state_blob(int(key))
+                    self._ckpt_tick_main()
+
+                err = _on_main(work)
                 if err is None:
                     with self._stats_lock:
                         self._updates_applied += 1
                     telemetry.counter("kvstore_server.updates_applied").inc()
+                    if repl:
+                        self._repl_offer(int(key), weight.copy(),
+                                         box.get("state"))
                 else:
                     self._note_update_failure(int(key), err)
 
@@ -381,6 +781,29 @@ class KVStoreServer:
                     import traceback
 
                     traceback.print_exception(err)
+            elif cmd.startswith(b"mepoch:"):
+                # the native layer already adopted the epoch (src/ps.cc
+                # forwards membership commands after handling them); track
+                # it here so replication clients stamp the CURRENT epoch —
+                # a forward stamped stale would be kRejectEpoch'd by the
+                # backup's own epoch gate
+                try:
+                    self._adopt_mepoch(int(cmd.split(b":")[1]))
+                except (IndexError, ValueError):
+                    logging.error("kvstore-server: malformed %r", cmd)
+            elif cmd.startswith(b"smap:"):
+                try:
+                    self._adopt_smap(cmd[5:])
+                except Exception:  # noqa: BLE001 — a malformed map must not
+                    # take down the conn handler
+                    logging.exception("kvstore-server: bad smap %r", cmd)
+            elif cmd.startswith(b"repl:"):
+                try:
+                    self._handle_repl(cmd)
+                except Exception:  # noqa: BLE001 — replication input is
+                    # best-effort on the receiver: reject, never crash
+                    logging.exception(
+                        "kvstore-server: replication payload failed")
             elif cmd.strip() == b"stats":
                 # operator-facing liveness/health line on the server log;
                 # in-process callers use .stats() directly
@@ -430,6 +853,399 @@ class KVStoreServer:
             self._handle, ctypes.cast(self._apply_cb, ctypes.c_void_p))
         lib.mxt_ps_server_set_command_handler(
             self._handle, ctypes.cast(self._command_cb, ctypes.c_void_p))
+        self._start_ha_threads()
+
+    # ---- server HA internals ---------------------------------------------
+    def _start_ha_threads(self):
+        def start(name, target):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._ha_threads.append(t)
+
+        if self._replicas > 0 and self._backups:
+            start("mxnet-kv-replication", self._repl_loop)
+        if self._ckpt_steps > 0:
+            start("mxnet-kv-server-ckpt-writer", self._ckpt_writer_loop)
+        if self._elastic and self._nservers > 1:
+            # any multi-server elastic job heartbeats the PS tier; a lone
+            # server has nobody to fail over to and skips the traffic
+            start("mxnet-kv-server-heartbeat", self._hb_loop)
+        if self._elastic and self._registry is None \
+                and self._gi == 0 and self._sid != self._groups[0][0]:
+            start("mxnet-kv-registry-standby", self._standby_loop)
+
+    def _adopt_mepoch(self, epoch):
+        self._mepoch = int(epoch)
+        with self._repl_cv:
+            clients = [c for c in self._repl_clients.values() if c]
+        for c in clients:
+            self._lib.mxt_ps_client_set_epoch(c, self._mepoch)
+
+    def _adopt_smap(self, payload):
+        """Registry broadcast of the key→server map + alive set (conn
+        handler thread): primaries use it to pick replication targets, and
+        a backup learns here that it was promoted."""
+        import json
+
+        m = json.loads(payload.decode())
+        with self._ha_lock:
+            self._alive_sids = {int(s) for s in m.get("alive", [])}
+            smap = [int(s) if s is not None else None
+                    for s in m.get("smap", [])]
+            if len(smap) == len(self._smap_view):
+                self._smap_view = smap
+            was = self._primary
+            self._primary = (self._gi is not None
+                             and self._smap_view[self._gi] == self._sid)
+            now_primary = self._primary
+        if now_primary and not was:
+            logging.warning(
+                "kvstore-server %d: PROMOTED to primary of group %d "
+                "(smap %s)", self._sid, self._gi, smap)
+        elif was and not now_primary:
+            logging.warning(
+                "kvstore-server %d: demoted to backup of group %d "
+                "(smap %s)", self._sid, self._gi, smap)
+
+    def _repl_targets(self):
+        with self._ha_lock:
+            return [s for s in self._backups if s in self._alive_sids]
+
+    def _repl_offer(self, key, weight_np, state_blob):
+        """Queue one applied round for forwarding (conn handler thread,
+        AFTER the round committed locally). Blocks — bounded by
+        ``_repl_wait_s`` — while the key's previous round is still being
+        forwarded: this backpressure is the replication-epoch guarantee
+        (backup at most one round behind). On timeout the round is queued
+        anyway; kInit carries the full weight, so a skipped wait can delay
+        a backup, never corrupt it."""
+        with self._repl_cv:
+            deadline = time.monotonic() + self._repl_wait_s
+            while key in self._repl_inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._repl_cv.wait(remaining)
+            self._repl_inflight.add(key)
+            self._repl_seq += 1
+            seq = self._repl_seq
+            lag = self._repl_seq - self._repl_done_seq
+        telemetry.gauge("kv.replication.lag_rounds").set(lag)
+        with self._stats_lock:
+            self._ha_stats["repl_lag_rounds"] = lag
+        self._repl_q.put((int(key), weight_np, state_blob, seq))
+
+    def _repl_loop(self):
+        import ctypes
+
+        lib = self._lib
+        while not self._ha_stop.is_set():
+            item = self._repl_q.get()
+            if item is None:
+                break
+            key, vec, state_blob, seq = item
+            forwards = acks = failures = 0
+            for sid in self._repl_targets():
+                forwards += 1
+                ok = False
+                try:
+                    c = self._repl_client(sid)
+                    if c is not None:
+                        rc = lib.mxt_ps_client_init(
+                            c, key,
+                            vec.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_float)), vec.size)
+                        if rc == 0:
+                            if state_blob is not None:
+                                cmd = b"repl:%d:%d:%s" % (
+                                    key, seq, base64.b64encode(state_blob))
+                                ok = lib.mxt_ps_client_probe(
+                                    c, cmd,
+                                    int(self._repl_wait_s * 1000)) == 0
+                            else:
+                                ok = True
+                except Exception:  # noqa: BLE001 — a sick backup must never
+                    # stall the primary's data path
+                    logging.exception(
+                        "kvstore-server %d: replication forward to %d "
+                        "failed", self._sid, sid)
+                if ok:
+                    acks += 1
+                else:
+                    failures += 1
+            telemetry.counter("kv.replication.forwards").inc(forwards)
+            if acks:
+                telemetry.counter("kv.replication.acks").inc(acks)
+            if failures:
+                telemetry.counter("kv.replication.errors").inc(failures)
+            with self._stats_lock:
+                self._ha_stats["repl_forwards"] += forwards
+                self._ha_stats["repl_acks"] += acks
+                self._ha_stats["repl_failures"] += failures
+            with self._repl_cv:
+                self._repl_inflight.discard(key)
+                self._repl_done_seq = seq
+                self._repl_cv.notify_all()
+
+    def _repl_client(self, sid):
+        """Lazy per-backup client on the replication thread; rebuilt
+        (bounded connect budget) after the backup restarts."""
+        lib = self._lib
+        create2 = getattr(lib, "mxt_ps_client_create2", None)
+        with self._repl_cv:
+            c = self._repl_clients.get(sid)
+        if c is not None and not lib.mxt_ps_client_is_dead(c):
+            return c
+        if c is not None:
+            lib.mxt_ps_client_destroy(c)
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        c = (create2(host.encode(), port + sid, 10) if create2
+             else lib.mxt_ps_client_create(host.encode(), port + sid))
+        if c:
+            lib.mxt_ps_client_set_epoch(c, self._mepoch)
+        with self._repl_cv:
+            self._repl_clients[sid] = c
+        return c
+
+    def _handle_repl(self, cmd):
+        """Backup side of a primary's forward: ``repl:<key>:<seq>:<b64
+        pickled np state>`` (the weight itself arrived just before as a
+        kInit on the same socket, so ordering is the transport's). Slot
+        install runs on the main thread — the states dict belongs to the
+        exec loop."""
+        body = cmd[5:]
+        key_s, _, rest = body.partition(b":")
+        seq_s, _, b64 = rest.partition(b":")
+        key, seq = int(key_s), int(seq_s)
+
+        def install():
+            state = pickle.loads(base64.b64decode(b64))
+            u = self._updater_obj
+            if u is not None:
+                from .optimizer import Updater
+
+                u.states[key] = Updater._from_np(state)
+                u.states_synced[key] = False
+            else:
+                if self._pending_states is None:
+                    self._pending_states = {}
+                self._pending_states[key] = state
+            self._repl_recv_epoch[key] = seq
+            self._ckpt_tick_main()
+
+        err = self._on_main(install)
+        if err is not None:
+            logging.error(
+                "kvstore-server %d: replicated slot install failed for "
+                "key %d: %r", self._sid, key, err)
+
+    def _slot_state_blob(self, key):
+        """Main thread only: the key's post-update optimizer slot as
+        pickled numpy, or None when there is nothing to replicate."""
+        u = self._updater_obj
+        if u is None:
+            return None
+        state = u.states.get(key)
+        if state is None:
+            return None
+        from .optimizer import Updater
+
+        return pickle.dumps(Updater._to_np(state))
+
+    # ---- durable optimizer slots -----------------------------------------
+    def _ckpt_tick_main(self):
+        """Main thread only: count an applied/replicated round; at the
+        MXNET_KV_SERVER_CKPT_STEPS cadence snapshot the slots (cheap —
+        pickling numpy) and hand the blob to the writer thread (fsync off
+        the update path)."""
+        if self._ckpt_steps <= 0:
+            return
+        self._ckpt_count += 1
+        if self._ckpt_count % self._ckpt_steps:
+            return
+        states = None
+        u = self._updater_obj
+        if u is not None and u.states:
+            from .optimizer import Updater
+
+            states = {k: Updater._to_np(v) for k, v in u.states.items()}
+        elif self._pending_states:
+            states = dict(self._pending_states)
+        if not states:
+            return
+        self._ckpt_q.put(pickle.dumps({
+            "optimizer": self._optimizer_obj,
+            "states": states,
+            "updates_applied": self._ckpt_count,
+        }))
+
+    def _ckpt_writer_loop(self):
+        import zlib
+
+        while not self._ha_stop.is_set():
+            blob = self._ckpt_q.get()
+            if blob is None:
+                break
+            try:
+                with atomic_write(self._ckpt_path,
+                                  fault_name="server_ckpt_write") as w:
+                    w.write(blob)
+                telemetry.counter("kv.server_ckpt.writes").inc()
+                telemetry.counter("kv.server_ckpt.bytes").inc(len(blob))
+                with self._stats_lock:
+                    self._ha_stats["ckpt_writes"] += 1
+                    self._ha_stats["ckpt_bytes"] += len(blob)
+                    first = self._ha_stats["ckpt_writes"] == 1
+                # first write at warning — visible confirmation that
+                # durability is live and where the file landed; the
+                # periodic rewrites stay at info
+                (logging.warning if first else logging.info)(
+                    "kvstore-server %d: optimizer-state checkpoint "
+                    "(%d bytes, states crc 0x%08x) -> %s",
+                    self._sid, len(blob), zlib.crc32(blob),
+                    self._ckpt_path)
+            except Exception:  # noqa: BLE001 — a failed write costs
+                # durability freshness, never the serving path
+                telemetry.counter("kv.server_ckpt.errors").inc()
+                logging.exception(
+                    "kvstore-server %d: optimizer-state checkpoint write "
+                    "failed", self._sid)
+
+    def _restore_checkpoint(self):
+        """Warm-start per-key optimizer slots from the last durable
+        checkpoint (DMLC_PS_RECOVERY=1: this process is a relaunched or
+        promoted server slot). Main thread, during __init__ — before the
+        transport serves anything. A corrupt file (CRC mismatch) is
+        counted and logged, and the server cold-starts; it NEVER crashes
+        the slot."""
+        import zlib
+
+        if not os.path.exists(self._ckpt_path):
+            return
+        try:
+            blob = read_verified(self._ckpt_path)
+            snap = pickle.loads(blob)
+            self._pending_states = dict(snap.get("states") or {})
+            optim = snap.get("optimizer")
+            if optim is not None:
+                self._set_optimizer(optim)
+            telemetry.counter("kv.server_ckpt.restores").inc()
+            with self._stats_lock:
+                self._ha_stats["ckpt_restores"] += 1
+            logging.warning(
+                "kvstore-server %d: restored optimizer state for %d "
+                "key(s) from %s (%d bytes, states crc 0x%08x) — warm "
+                "start", self._sid, len(snap.get("states") or {}),
+                self._ckpt_path, len(blob), zlib.crc32(blob))
+        except Exception:  # noqa: BLE001 — ChecksumError, torn pickle, a
+            # stale incompatible snapshot: all degrade to a cold start
+            telemetry.counter("kv.server_ckpt.errors").inc()
+            logging.exception(
+                "kvstore-server %d: optimizer-state checkpoint %s "
+                "unreadable — cold start (momentum resets)",
+                self._sid, self._ckpt_path)
+
+    # ---- PS-tier heartbeats + registry failover --------------------------
+    def _hb_loop(self):
+        """Every elastic server heartbeats the registry so a dead server
+        is noticed by lapse, exactly like a dead worker. When the registry
+        is in-process (we host it) the call is direct; otherwise the beat
+        walks the group-0 members in failover order until one acknowledges
+        — which is also how the beat finds a resumed registry after a
+        failover."""
+        period = max(self._hb_timeout_s / 3.0, 0.1)
+        target = [self._groups[0][0]]  # mutable current-registry memo
+        while not self._ha_stop.wait(period):
+            try:
+                reg = self._registry
+                if reg is not None:
+                    reg.server_heartbeat(self._sid)
+                    continue
+                self._send_registry_hb(target)
+            except Exception:  # noqa: BLE001 — heartbeat must never die
+                logging.exception(
+                    "kvstore-server %d: heartbeat failed", self._sid)
+
+    def _send_registry_hb(self, target):
+        lib = self._lib
+        create2 = getattr(lib, "mxt_ps_client_create2", None)
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        cmd = b"mb_srv_hb:%d" % self._sid
+        timeout_ms = max(int(self._hb_timeout_s * 500), 100)
+        cands = [target[0]] + [s for s in self._groups[0]
+                               if s != target[0] and s != self._sid]
+        for sid in cands:
+            c = self._reg_clients.get(sid)
+            if c is not None and lib.mxt_ps_client_is_dead(c):
+                lib.mxt_ps_client_destroy(c)
+                c = None
+            if c is None:
+                c = (create2(host.encode(), port + sid, 10) if create2
+                     else lib.mxt_ps_client_create(host.encode(),
+                                                   port + sid))
+                self._reg_clients[sid] = c
+            if c and lib.mxt_ps_client_probe(c, cmd, timeout_ms) == 0:
+                target[0] = sid
+                return
+        logging.warning(
+            "kvstore-server %d: no registry candidate %s acknowledged a "
+            "heartbeat", self._sid, cands)
+
+    def _standby_loop(self):
+        """Group-0 backup watching its predecessors: when every group-0
+        member before this one (deterministic failover order) is dead —
+        confirmed by consecutive fresh-socket probes, after having seen a
+        predecessor alive at least once — resume the MembershipRegistry
+        here from the last ``mb_sync`` snapshot."""
+        lib = self._lib
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        my_pos = self._groups[0].index(self._sid)
+        preds = self._groups[0][:my_pos]
+        probe_ms = max(min(int(self._hb_timeout_s * 500), 2000), 100)
+        period = max(self._hb_timeout_s / 2.0, 0.1)
+        seen_alive = False
+        dead_rounds = 0
+        while not self._ha_stop.wait(period):
+            if self._registry is not None:
+                return
+            alive = any(
+                lib.mxt_ps_probe(host.encode(), port + s, probe_ms) == 0
+                for s in preds)
+            if alive:
+                seen_alive = True
+                dead_rounds = 0
+                continue
+            if not seen_alive:
+                continue  # launch race: predecessors not up yet
+            dead_rounds += 1
+            if dead_rounds < 2:
+                continue
+            snap = None
+            with self._ha_lock:
+                raw = self._mb_sync
+            if raw:
+                try:
+                    import json
+
+                    snap = json.loads(base64.b64decode(raw).decode())
+                except Exception:  # noqa: BLE001 — a torn snapshot is
+                    # worse than none: resume cold
+                    logging.exception(
+                        "kvstore-server %d: mb_sync snapshot unreadable",
+                        self._sid)
+            telemetry.counter("kv.replication.failovers").inc()
+            telemetry.event("registry_failover", sid=self._sid,
+                            predecessors=preds, with_snapshot=bool(snap))
+            logging.warning(
+                "kvstore-server %d: registry predecessor(s) %s dead — "
+                "resuming the membership registry here (%s snapshot)",
+                self._sid, preds, "with" if snap else "WITHOUT")
+            self._registry = MembershipRegistry(
+                self._num_workers, resume=snap)
+            return
 
     def _note_update_failure(self, key, err):
         """Count a failed server-side update (runs on a conn thread).
@@ -465,9 +1281,15 @@ class KVStoreServer:
 
     def _handle_membership(self, cmd):
         """Dispatch a worker's ``mb_*`` command to the registry (conn
-        handler thread). Only server 0 hosts one; a sibling or non-elastic
-        server ignores the traffic (the worker's bounded fetch times out
-        and it retries against the registry's real address)."""
+        handler thread). Only the registry host serves them; a sibling or
+        non-elastic server ignores the traffic (the worker's bounded fetch
+        times out and it retries against the registry's real address) —
+        except ``mb_sync``, the registry's own state replicated TO the
+        standbys."""
+        if cmd.startswith(b"mb_sync:"):
+            with self._ha_lock:
+                self._mb_sync = cmd[8:].decode()
+            return
         if self._registry is None:
             return
         name, _, arg = cmd.decode().partition(":")
@@ -488,6 +1310,13 @@ class KVStoreServer:
 
             self._registry.set_pos(
                 json.loads(base64.b64decode(arg).decode()))
+        elif name in ("mb_srv_hb", "mb_srv_join"):
+            self._registry.server_heartbeat(int(arg))
+        elif name == "mb_srv_dead":
+            # a worker's dead-socket hint; the registry probe-confirms
+            # before evicting (this blocks the conn thread for at most one
+            # probe deadline — conn handlers are per-request threads)
+            self._registry.server_suspect(int(arg))
         elif name == "mb_get":
             import json
 
@@ -558,12 +1387,14 @@ class KVStoreServer:
     def stats(self):
         """Health counters (also printed by the ``b"stats"`` client command)."""
         with self._stats_lock:  # counters bump on conn threads; snapshot
-            return {            # must pair count with its matching error
+            out = {             # must pair count with its matching error
                 "updates_applied": self._updates_applied,
                 "update_failures": self._update_failures,
                 "last_update_error": self._last_update_error,
                 "has_optimizer": self._updater is not None,
             }
+            out.update(self._ha_stats)
+        return out
 
     def _set_optimizer(self, optimizer):
         from . import fault
@@ -571,6 +1402,22 @@ class KVStoreServer:
         from .ndarray import NDArray
 
         updater = opt.get_updater(optimizer)
+        # server HA: an optimizer (re)install must never silently reset the
+        # per-key slots — reconfigure resends the optimizer after a rescale
+        # (elastic.py), and a restored/promoted server holds slots from its
+        # checkpoint or from primary forwards (_pending_states)
+        prev = self._updater_obj
+        if prev is not None and prev.states:
+            updater.states = prev.states
+            updater.states_synced = dict.fromkeys(updater.states, False)
+        elif self._pending_states:
+            updater.states = {
+                k: opt.Updater._from_np(v)
+                for k, v in self._pending_states.items()}
+            updater.states_synced = dict.fromkeys(updater.states, False)
+            self._pending_states = None
+        self._updater_obj = updater
+        self._optimizer_obj = optimizer
 
         def apply_np(key, grad_np, weight_np):
             fault.hit("server_updater")
@@ -621,6 +1468,13 @@ class KVStoreServer:
         d = threading.Thread(target=drainer,
                              name="mxnet-kv-server-drainer")
         d.start()
+        # stop the HA threads before tearing the transport down (they own
+        # client handles into it); queue sentinels wake the blocking gets
+        self._ha_stop.set()
+        self._repl_q.put(None)
+        self._ckpt_q.put(None)
+        for t in self._ha_threads:
+            t.join(timeout=2)
         if self._registry is not None:
             self._registry.close()
         with self._self_client_lock:

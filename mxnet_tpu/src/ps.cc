@@ -617,14 +617,18 @@ class PSServer {
 
 class PSClient {
  public:
-  PSClient(const char* host, int port) {
+  // attempts × 100ms bounds the connect retry: the default (600 = 60s)
+  // covers the worker-before-server launch race; replication / failover
+  // reconnect paths pass a small budget so a dead peer costs a bounded
+  // wait, not a minute per round.
+  PSClient(const char* host, int port, int attempts = 600) {
     fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(static_cast<uint16_t>(port));
     inet_pton(AF_INET, host, &addr.sin_addr);
     // retry: workers may start before the server (launch.py races too)
-    for (int attempt = 0; attempt < 600; ++attempt) {
+    for (int attempt = 0; attempt < attempts; ++attempt) {
       if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
           0) {
         int one = 1;
@@ -650,6 +654,14 @@ class PSClient {
   }
 
   bool ok() const { return fd_ >= 0; }
+
+  // True once the reader observed a socket failure: every outstanding and
+  // future RPC on this handle fails. The HA tier uses this to decide which
+  // client handles to rebuild after adopting a new key→server map.
+  bool IsDead() {
+    std::unique_lock<std::mutex> lk(pmu_);
+    return dead_;
+  }
 
   // Membership epoch stamped on every subsequent request (elastic mode);
   // adopted by the Python tier after a registry sync.
@@ -887,6 +899,20 @@ void* mxt_ps_client_create(const char* host, int port) {
     return nullptr;
   }
   return c;
+}
+// HA reconnect path: bounded connect budget (attempts × 100ms) so dialing
+// a still-dead server costs a deterministic wait, not the 60s launch-race
+// budget of mxt_ps_client_create.
+void* mxt_ps_client_create2(const char* host, int port, int attempts) {
+  auto* c = new mxt::PSClient(host, port, attempts);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+int mxt_ps_client_is_dead(void* h) {
+  return static_cast<mxt::PSClient*>(h)->IsDead() ? 1 : 0;
 }
 int mxt_ps_client_push(void* h, int key, const float* data,
                        unsigned long long n) {

@@ -677,6 +677,36 @@ METRIC_HELP = {
         "registry-side membership epoch bumps (always-on)",
     "kv.membership.heartbeat_failures":
         "worker heartbeats the registry missed the deadline on (always-on)",
+    "kv.replication.forwards":
+        "primary->backup value/slot forwards issued (always-on)",
+    "kv.replication.acks":
+        "backup-acknowledged replication forwards (always-on)",
+    "kv.replication.errors":
+        "replication forwards that failed or timed out (always-on)",
+    "kv.replication.lag_rounds":
+        "replication rounds the slowest backup trails the primary by "
+        "(always-on)",
+    "kv.replication.failovers":
+        "backup promotions after a server loss — registry-side plus "
+        "standby registry activations (always-on)",
+    "kv.server_ckpt.writes":
+        "server optimizer-slot checkpoints written (always-on)",
+    "kv.server_ckpt.restores":
+        "server optimizer-slot checkpoints restored on recovery "
+        "(always-on)",
+    "kv.server_ckpt.bytes":
+        "cumulative server optimizer-slot checkpoint bytes (always-on)",
+    "kv.server_ckpt.errors":
+        "failed or corrupt server checkpoint writes/restores — a corrupt "
+        "restore cold-starts, never crashes (always-on)",
+    "kv.stats_unreachable":
+        "stats/trace polls skipped or failed per dead server — the poll "
+        "pays one deadline per penalty window, not per poll (always-on)",
+    "kvstore.server_loss_reports":
+        "dead servers this worker reported to the registry (always-on)",
+    "kv.registry.failover_probes":
+        "registry traffic redirected to a standby registry host "
+        "(always-on)",
     "kv.straggler.rank":
         "rank the straggler detector last named (-1 = none) (always-on)",
     "kv.cluster.publish_failures":

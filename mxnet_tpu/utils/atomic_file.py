@@ -36,6 +36,11 @@ import struct
 import zlib
 from contextlib import contextmanager
 
+# fault is imported at module top (not lazily in the writer): the server's
+# checkpoint-writer thread calls atomic_write while the server's main
+# thread sits inside ``import mxnet_tpu`` forever — a package-relative
+# import on that thread would deadlock on the import lock
+from .. import fault
 from ..base import MXNetError
 
 __all__ = ["atomic_write", "ChecksumError", "ChecksummingReader",
@@ -61,16 +66,12 @@ class _ChecksummedWriter:
         self._budget = None
         self._fault_name = fault_name
         if fault_name is not None:
-            from .. import fault
-
             self._budget = fault.crash_after_bytes(fault_name)
 
     def write(self, data):
         if isinstance(data, str):
             data = data.encode("utf-8")
         if self._budget is not None and self.nbytes + len(data) > self._budget:
-            from .. import fault
-
             allowed = self._budget - self.nbytes
             self._f.write(data[:allowed])
             self.nbytes += allowed

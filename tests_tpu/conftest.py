@@ -26,7 +26,8 @@ _HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
                     "test_analysis.py", "test_elastic.py",
                     "test_cluster_obs.py", "test_native_decode.py",
                     "test_compileobs.py", "test_serving.py",
-                    "test_kv_overlap.py", "test_graphpass.py"}
+                    "test_kv_overlap.py", "test_graphpass.py",
+                    "test_server_ha.py"}
 
 
 def pytest_configure(config):
@@ -42,6 +43,9 @@ def pytest_configure(config):
         "markers", "analysis: fwlint / engine-sanitizer tests (host-only)")
     config.addinivalue_line(
         "markers", "elastic: elastic-membership / reshard tests (host-only)")
+    config.addinivalue_line(
+        "markers", "server_ha: parameter-server HA (replication / failover) "
+                   "tests (host-only)")
     config.addinivalue_line(
         "markers", "serving: paged-KV serving-engine tests (host-only)")
     config.addinivalue_line(

@@ -1,0 +1,589 @@
+"""Parameter-server HA suite (docs/distributed.md §server-HA): replicated
+server groups (key routing + sticky primary promotion), the stats wire v2
+(HA counters appended after the pre-HA prefix), durable server-side
+optimizer slots (atomic checkpoint round-trip, CRC-corrupt cold start),
+registry failover off server 0 (snapshot / resume / mb_sync standby
+replication), the worker's dead-server stats penalty window, the
+``kill_server`` fault point, and the full SIGKILL-a-primary →
+promote-backup → relaunch-rejoins-as-backup cycle on the multi-process
+CPU mesh (slow-marked).
+
+Host-side only: runs on a CPU-only machine (tests_tpu/conftest.py exempts
+this file from the hardware gate). `ci/run_tests.sh server_ha` is the CI
+tier.
+"""
+import os
+import pickle
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from mxnet_tpu import telemetry  # noqa: E402
+from mxnet_tpu._native import get_lib  # noqa: E402
+from mxnet_tpu.kvstore_server import (  # noqa: E402
+    _STATS_COUNTER_FIELDS_HA, STATS_VEC_LEN, KVStoreServer,
+    MembershipRegistry, decode_stats_vec, encode_stats_vec,
+    plan_server_groups)
+
+pytestmark = pytest.mark.server_ha
+
+needs_native = pytest.mark.skipif(get_lib() is None,
+                                  reason="native lib unavailable")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# group planning — the HA sharding contract
+# ---------------------------------------------------------------------------
+
+def test_plan_server_groups_replicated():
+    assert plan_server_groups(4, 1) == [[0, 1], [2, 3]]
+    assert plan_server_groups(6, 2) == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_plan_server_groups_default_is_pre_ha_sharding():
+    # replicas=0: one singleton group per server == ikey % num_servers
+    assert plan_server_groups(3, 0) == [[0], [1], [2]]
+    assert plan_server_groups(1, 0) == [[0]]
+
+
+def test_plan_server_groups_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="divisible"):
+        plan_server_groups(4, 2)  # 4 % 3 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        plan_server_groups(1, 1)  # a group needs its backup
+    with pytest.raises(ValueError, match=">= 0"):
+        plan_server_groups(4, -1)
+
+
+# ---------------------------------------------------------------------------
+# stats wire v2 — HA counters appended after the pre-HA prefix
+# ---------------------------------------------------------------------------
+
+def _full_stats():
+    s = {"updates_applied": (1 << 30) + 7, "update_failures": 3,
+         "has_optimizer": True}
+    for i, f in enumerate(_STATS_COUNTER_FIELDS_HA):
+        s[f] = (1 << 26) + i  # past float32's 2^24 integer range
+    return s
+
+
+def test_stats_vec_v2_roundtrip_exact():
+    stats = _full_stats()
+    vec = encode_stats_vec(stats)
+    assert len(vec) == STATS_VEC_LEN
+    assert vec.dtype == np.float32
+    assert decode_stats_vec(vec) == stats
+
+
+def test_stats_vec_decoder_tolerates_pre_ha_vector():
+    # a pre-HA server publishes only the original 5-entry prefix; the v2
+    # decoder must parse it and simply omit the HA counters
+    vec = encode_stats_vec(_full_stats())[:5]
+    out = decode_stats_vec(vec)
+    assert out["updates_applied"] == (1 << 30) + 7
+    assert out["update_failures"] == 3
+    assert out["has_optimizer"] is True
+    for f in _STATS_COUNTER_FIELDS_HA:
+        assert f not in out
+
+
+def test_stats_vec_missing_ha_fields_encode_as_zero():
+    vec = encode_stats_vec({"updates_applied": 1, "update_failures": 0,
+                            "has_optimizer": False})
+    out = decode_stats_vec(vec)
+    assert all(out[f] == 0 for f in _STATS_COUNTER_FIELDS_HA)
+
+
+# ---------------------------------------------------------------------------
+# membership registry — server membership, sticky promotion, failover
+# (in-process: broadcast + probe injected)
+# ---------------------------------------------------------------------------
+
+def _ha_registry(num_workers=1, timeout=60, num_servers=4, replicas=1,
+                 probe=lambda sid: False, resume=None):
+    sent = []
+    reg = MembershipRegistry(num_workers, heartbeat_timeout_s=timeout,
+                             broadcast=sent.append, num_servers=num_servers,
+                             replicas=replicas, probe=probe, resume=resume)
+    return reg, sent
+
+
+def _beat_all(reg, n=4):
+    for sid in range(n):
+        reg.server_heartbeat(sid)
+
+
+def test_registry_promotes_backup_and_bumps_after_smap():
+    telemetry.reset()
+    reg, sent = _ha_registry()
+    try:
+        reg.join(0)
+        _beat_all(reg)
+        assert sent == []  # steady state: no churn
+        reg.server_suspect(2)  # group-1 primary; probe confirms dead
+        t = reg.table()
+        assert t["smap"] == [0, 3]
+        assert t["servers"] == [0, 1, 3]
+        assert t["epoch"] == 1
+        # wire order is the contract: every server routes/replicates on
+        # the new map BEFORE any worker can read the bumped epoch
+        assert len(sent) == 2, sent
+        assert sent[0].startswith("smap:") and sent[1] == "mepoch:1:1", sent
+        import json
+
+        m = json.loads(sent[0][len("smap:"):])
+        assert m == {"smap": [0, 3], "alive": [0, 1, 3]}
+        assert telemetry.counter("kv.replication.failovers").value == 1
+    finally:
+        reg.close()
+
+
+def test_registry_probe_veto_keeps_reported_server():
+    # a worker-side blip must not evict a shard that answers probes
+    reg, sent = _ha_registry(probe=lambda sid: True)
+    try:
+        reg.join(0)
+        _beat_all(reg)
+        reg.server_suspect(2)
+        t = reg.table()
+        assert t["smap"] == [0, 2] and t["epoch"] == 0 and sent == []
+    finally:
+        reg.close()
+
+
+def test_registry_backup_loss_needs_no_promotion():
+    reg, sent = _ha_registry()
+    try:
+        reg.join(0)
+        _beat_all(reg)
+        reg.server_suspect(1)  # group-0 BACKUP: primaries unaffected
+        t = reg.table()
+        assert t["smap"] == [0, 2] and t["epoch"] == 0
+        # surviving servers still learn the alive set (replication targets)
+        assert len(sent) == 1 and sent[0].startswith("smap:"), sent
+    finally:
+        reg.close()
+
+
+def test_registry_rejoin_is_sticky_backup_then_revives_dead_group():
+    reg, sent = _ha_registry()
+    try:
+        reg.join(0)
+        _beat_all(reg)
+        reg.server_suspect(2)  # promote 3
+        assert reg.table()["smap"] == [0, 3]
+        del sent[:]
+        # the relaunched 2 rejoins: it must NOT steal primaryship back
+        # (its slots are stale) and must NOT churn the workers
+        reg.server_heartbeat(2)
+        t = reg.table()
+        assert t["smap"] == [0, 3] and t["epoch"] == 1
+        assert 2 in t["servers"]
+        assert all(m.startswith("smap:") for m in sent), sent
+        # group 1 loses EVERY member: unservable, but no false promotion
+        reg.server_suspect(2)
+        reg.server_suspect(3)
+        t = reg.table()
+        assert t["smap"] == [0, None] and t["epoch"] == 1
+        # the first rejoiner revives the group — that IS a promotion
+        reg.server_heartbeat(3)
+        t = reg.table()
+        assert t["smap"] == [0, 3] and t["epoch"] == 2
+    finally:
+        reg.close()
+
+
+def test_registry_snapshot_resume_roundtrip():
+    reg, _ = _ha_registry()
+    try:
+        reg.join(0, step=17)
+        _beat_all(reg)
+        reg.server_suspect(2)  # epoch 1, smap [0, 3]
+        snap = reg.snapshot()
+    finally:
+        reg.close()
+    # the group-0 standby resumes the registry from the mb_sync snapshot
+    reg2, _ = _ha_registry(resume=snap)
+    try:
+        t = reg2.table()
+        assert t["epoch"] == 1
+        assert t["smap"] == [0, 3]
+        assert t["workers"] == [0] and t["formed"]
+        assert t["steps"] == {0: 17}
+        assert t["servers"] == [0, 1, 3]
+    finally:
+        reg2.close()
+
+
+def test_registry_sync_standbys_replicates_snapshot():
+    import base64
+    import json
+
+    reg, sent = _ha_registry()
+    try:
+        reg.join(0)
+        _beat_all(reg)
+        reg._sync_standbys()
+        msgs = [m for m in sent if m.startswith("mb_sync:")]
+        assert len(msgs) == 1, sent
+        snap = json.loads(base64.b64decode(msgs[0][len("mb_sync:"):]))
+        assert snap["smap"] == [0, 2] and snap["formed"]
+    finally:
+        reg.close()
+    # no standbys configured (group 0 is a singleton): nothing to sync
+    reg, sent = _ha_registry(num_servers=2, replicas=0)
+    try:
+        reg._sync_standbys()
+        assert sent == []
+    finally:
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# durable optimizer slots — checkpoint round-trip + CRC-corrupt cold start
+# (the writer/restore methods run on a detached shim: no transport needed)
+# ---------------------------------------------------------------------------
+
+def _ckpt_shim(path, steps=4, pending=None):
+    srv = object.__new__(KVStoreServer)
+    srv._sid = 0
+    srv._ckpt_path = str(path)
+    srv._ckpt_steps = steps
+    srv._ckpt_count = 0
+    srv._updater_obj = None
+    srv._optimizer_obj = None
+    srv._pending_states = pending
+    srv._ckpt_q = queue.Queue()
+    srv._ha_stop = threading.Event()
+    srv._stats_lock = threading.Lock()
+    srv._ha_stats = dict.fromkeys(_STATS_COUNTER_FIELDS_HA, 0)
+    return srv
+
+
+def test_server_ckpt_roundtrip_warm_start(tmp_path):
+    telemetry.reset()
+    path = tmp_path / "kv_server_0.optstate"
+    states = {3: np.arange(6, dtype=np.float32),
+              7: (np.float64(0.5), np.ones(2, np.float32))}
+    srv = _ckpt_shim(path, steps=4, pending=states)
+    for _ in range(7):  # cadence: exactly one snapshot at tick 4
+        srv._ckpt_tick_main()
+    assert srv._ckpt_q.qsize() == 1
+    srv._ckpt_q.put(None)
+    srv._ckpt_writer_loop()  # drains synchronously: blob then stop
+    assert path.exists()
+    assert srv._ha_stats["ckpt_writes"] == 1
+    assert srv._ha_stats["ckpt_bytes"] > 0
+    assert telemetry.counter("kv.server_ckpt.writes").value == 1
+
+    # a relaunched/promoted slot warm-starts from the durable file
+    srv2 = _ckpt_shim(path)
+    srv2._restore_checkpoint()
+    assert srv2._ha_stats["ckpt_restores"] == 1
+    got = srv2._pending_states
+    assert set(got) == {3, 7}
+    np.testing.assert_array_equal(got[3], states[3])
+    assert got[7][0] == 0.5
+    np.testing.assert_array_equal(got[7][1], states[7][1])
+
+
+def test_server_ckpt_skips_when_no_slots(tmp_path):
+    # a stateless optimizer (plain SGD) has nothing durable to write
+    srv = _ckpt_shim(tmp_path / "x.optstate", steps=2, pending=None)
+    for _ in range(8):
+        srv._ckpt_tick_main()
+    assert srv._ckpt_q.qsize() == 0
+
+
+def test_server_ckpt_disabled_by_default(tmp_path):
+    srv = _ckpt_shim(tmp_path / "x.optstate", steps=0,
+                     pending={0: np.ones(2, np.float32)})
+    for _ in range(64):
+        srv._ckpt_tick_main()
+    assert srv._ckpt_q.qsize() == 0 and srv._ckpt_count == 0
+
+
+def test_server_ckpt_crc_corruption_cold_starts_never_crashes(tmp_path):
+    path = tmp_path / "kv_server_0.optstate"
+    srv = _ckpt_shim(path, pending={0: np.ones(4, np.float32)})
+    srv._ckpt_q.put(pickle.dumps({"optimizer": None,
+                                  "states": srv._pending_states,
+                                  "updates_applied": 4}))
+    srv._ckpt_q.put(None)
+    srv._ckpt_writer_loop()
+    raw = bytearray(path.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF  # flip a payload byte: CRC must catch it
+    path.write_bytes(bytes(raw))
+
+    telemetry.reset()
+    srv2 = _ckpt_shim(path)
+    srv2._restore_checkpoint()  # must NOT raise
+    assert srv2._pending_states is None  # cold start: no torn slots
+    assert srv2._ha_stats["ckpt_restores"] == 0
+    assert telemetry.counter("kv.server_ckpt.errors").value == 1
+    assert telemetry.counter("kv.server_ckpt.restores").value == 0
+
+
+def test_server_ckpt_missing_file_is_silent_cold_start(tmp_path):
+    telemetry.reset()
+    srv = _ckpt_shim(tmp_path / "never_written.optstate")
+    srv._restore_checkpoint()
+    assert srv._pending_states is None
+    assert telemetry.counter("kv.server_ckpt.errors").value == 0
+
+
+# ---------------------------------------------------------------------------
+# worker side — dead-server stats penalty window (deadline-and-skip)
+# ---------------------------------------------------------------------------
+
+def test_stats_unreachable_penalty_window():
+    from mxnet_tpu.kvstore import KVStoreDist
+
+    telemetry.reset()
+    kv = object.__new__(KVStoreDist)
+    kv._stats_skip = {}
+    addr = "127.0.0.1:19091"
+    assert not kv._stats_skipped(addr)  # healthy: no counter bump
+    assert telemetry.counter("kv.stats_unreachable", server=addr).value == 0
+    kv._stats_unreachable(addr, timeout_ms=150)
+    # inside the window: skipped WITHOUT wire traffic, and counted
+    assert kv._stats_skipped(addr)
+    assert telemetry.counter("kv.stats_unreachable", server=addr).value == 2
+    # other servers are unaffected by one dead peer's penalty
+    assert not kv._stats_skipped("127.0.0.1:19092")
+    time.sleep(0.2)  # window expired: the next poll tries the wire again
+    assert not kv._stats_skipped(addr)
+
+
+# ---------------------------------------------------------------------------
+# fault injection — kill_server mirrors kill_worker (spec-driven, targeted)
+# ---------------------------------------------------------------------------
+
+FAULT_SCRIPT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MXNET_FAULT_SPEC"] = "kill_server:server_id=1"
+from mxnet_tpu import fault
+fault.kill_server(0)      # wrong target: must not fire (and not a hit)
+fault.kill_server(3)      # wrong target again
+print("ALIVE"); sys.stdout.flush()
+fault.kill_server(1)      # SIGKILL — nothing after this line runs
+print("SURVIVED")
+"""
+
+
+def test_fault_kill_server_targets_by_server_id():
+    env = dict(os.environ)
+    env.pop("MXNET_FAULT_SPEC", None)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", FAULT_SCRIPT], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == -signal.SIGKILL, \
+        (proc.returncode, proc.stdout, proc.stderr)
+    assert "ALIVE" in proc.stdout, (proc.stdout, proc.stderr)
+    assert "SURVIVED" not in proc.stdout, proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# multi-process cluster harness (launch.py, CPU mesh)
+# ---------------------------------------------------------------------------
+
+def _run_cluster(script, n_workers=1, n_servers=1, env_extra=None,
+                 timeout=180, launch_args=()):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DMLC_ROLE", None)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", str(n_workers), "-s", str(n_servers),
+           "--port", str(_free_port()),
+           *launch_args, sys.executable, "-c", script]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        out, err = proc.communicate()
+        raise AssertionError("cluster hung: %s %s" % (out, err))
+    return proc.returncode, out, err
+
+
+# replicated groups serve the pre-HA API unchanged: group routing, init,
+# aggregation, and the v2 stats poll across every (primary AND backup) slot
+WORKER_GROUPS = r"""
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_sync")
+assert kv._ngroups == 2 and kv._smap == [0, 2], (kv._ngroups, kv._smap)
+for k in range(5):  # keys shard over GROUPS, values land on the primary
+    kv.init(k, mx.nd.ones((3,)) * (k + 1))
+out = mx.nd.zeros((3,))
+for k in range(5):
+    kv.pull(k, out=out)
+    assert np.allclose(out.asnumpy(), k + 1), (k, out.asnumpy())
+# no optimizer installed: the merged gradient replaces the value
+# (update_on_kvstore=False semantics) — same as on a single server
+kv.push(2, mx.nd.ones((3,)) * 5)
+kv.pull(2, out=out)
+assert np.allclose(out.asnumpy(), 5.0), out.asnumpy()
+stats = kv.request_server_stats()
+assert len(stats) == 4, stats
+assert all(s is not None for s in stats.values()), stats
+assert all("repl_forwards" in s for s in stats.values()), stats
+# the committed round was chain-forwarded: the group-0 primary shows a
+# forward AND its backup's ack on the always-on replication counters
+assert sum(s["repl_forwards"] for s in stats.values()) >= 1, stats
+assert sum(s["repl_acks"] for s in stats.values()) >= 1, stats
+assert sum(s["repl_failures"] for s in stats.values()) == 0, stats
+kv.barrier()
+kv._stop_servers()
+print("WORKER_OK")
+"""
+
+
+@needs_native
+def test_replicated_groups_serve_and_report_stats():
+    rc, out, err = _run_cluster(WORKER_GROUPS, n_servers=4,
+                                env_extra={"MXNET_KV_REPLICAS": "1"})
+    assert rc == 0, (rc, out, err)
+    assert "WORKER_OK" in out, (out, err)
+
+
+# ---------------------------------------------------------------------------
+# the whole cycle: SIGKILL a primary mid-training -> registry promotes its
+# backup -> workers drain/adopt/re-seed -> launcher relaunches the slot ->
+# it warm-starts off its checkpoint and rejoins as a backup
+# ---------------------------------------------------------------------------
+
+SERVER_HA_FIT = r"""
+import os
+
+# the kill rule targets server 2's FIRST incarnation only: the relaunched
+# slot starts with DMLC_PS_RECOVERY=1 and must not re-kill itself
+if os.environ.get("DMLC_PS_RECOVERY"):
+    os.environ.pop("MXNET_FAULT_SPEC", None)
+
+import numpy as np
+import mxnet_tpu as mx
+
+seed = 42
+rng = np.random.RandomState(seed)
+X = rng.randn(256, 10).astype(np.float32)
+w_true = rng.randn(10, 1).astype(np.float32)
+y = (X @ w_true > 0).astype(np.float32).reshape(-1)
+
+np.random.seed(seed)
+
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+it = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=False,
+                       num_parts=nw, part_index=rank)
+
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+mod = mx.mod.Module(net, context=mx.cpu())
+
+
+def pace(param):
+    import time
+
+    # keep training alive long enough for the relaunched server slot (a
+    # fresh python import away) to rejoin its group as a backup
+    time.sleep(0.1)
+
+
+NUM_EPOCH = 10
+mod.fit(it, num_epoch=NUM_EPOCH, kvstore=kv, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2.0),
+        eval_metric="acc", force_init=True, batch_end_callback=pace)
+
+arg, _ = mod.get_params()
+sig = float(sum(float(np.abs(v.asnumpy()).sum()) for v in arg.values()))
+os.write(1, ("HA_DONE rank=%d sig=%.6f smap=%s\n"
+             % (rank, sig, ",".join(str(s) for s in kv._smap))).encode())
+kv.barrier()
+if rank == 0:
+    kv._stop_servers()
+print("WORKER_OK", rank)
+"""
+
+
+@needs_native
+@pytest.mark.slow
+def test_server_kill_promote_rejoin_end_to_end(tmp_path):
+    """Acceptance scenario (ISSUE: server HA): fault.py SIGKILLs server 2
+    — the group-1 PRIMARY, not the registry host — mid-training under
+    ``launch.py --elastic`` with MXNET_KV_REPLICAS=1. The registry detects
+    the loss, promotes backup 3 (smap [0,2] -> [0,3]) and bumps the
+    membership epoch; the workers take the same reject→drain→adopt path
+    they take for worker loss — the job finishes with rc 0 and
+    BIT-IDENTICAL final params across workers (BSP held straight through
+    the failover). The launcher relaunches the dead slot with
+    DMLC_PS_RECOVERY=1: it warm-starts its optimizer slots from the
+    durable checkpoint and rejoins its group as a backup."""
+    rc, out, err = _run_cluster(
+        SERVER_HA_FIT, n_workers=2, n_servers=4, timeout=420,
+        env_extra={
+            # server 2 serves ~2 of the 4 MLP keys per round: the 40th
+            # applied update lands it mid-epoch 2-ish, then never again
+            "MXNET_FAULT_SPEC": "kill_server:server_id=2,after=40,times=1",
+            "MXNET_KV_REPLICAS": "1",
+            "MXNET_KV_SERVER_CKPT_STEPS": "8",
+            "MXNET_KV_SERVER_CKPT_DIR": str(tmp_path / "ckpt"),
+            "MXNET_ELASTIC_HEARTBEAT_S": "0.5",
+            "MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S": "2",
+            "MXNET_COMPILE_CACHE_DIR": str(tmp_path / "cc"),
+        },
+        launch_args=("--elastic",))
+    assert rc == 0, (rc, out, err)
+    assert out.count("WORKER_OK") == 2, (out, err)
+    lines = [l for l in out.splitlines() if l.startswith("HA_DONE")]
+    assert len(lines) == 2, (out, err)
+    info = {}
+    for l in lines:
+        kvs = dict(f.split("=", 1) for f in l.split()[1:])
+        info[int(kvs["rank"])] = kvs
+    # both workers finished routing on the POST-failover map
+    assert info[0]["smap"] == info[1]["smap"] == "0,3", info
+    # BSP held through the promotion: identical final params, bit for bit
+    assert info[0]["sig"] == info[1]["sig"], info
+    # every leg of the cycle is visible in the logs:
+    # 1. the backup was promoted and the workers adopted the new map
+    assert "PROMOTED to primary" in err, err
+    assert "adopting server map" in err, err
+    # 2. the launcher supervised the dead server slot back into the job
+    assert "relaunching server 2" in err, err
+    # 3. durable slots: checkpoints were written, and the relaunched slot
+    #    warm-started from one instead of resetting its momentum
+    assert "optimizer-state checkpoint" in err, err
+    assert "restored optimizer state" in err, err
+    # 4. the relaunched slot rejoined as a BACKUP (sticky smap: no churn)
+    assert "rejoined as a backup" in err, err

@@ -26,7 +26,11 @@ DMLC_PS_RECOVERY=1, so it rejoins the running job through the PS membership
 registry instead of re-initializing — up to MXNET_ELASTIC_MAX_RESTARTS
 times per worker slot, with exponential backoff. Survivors keep training
 through the loss (membership epochs + guard rollback); the job exits 0 once
-every worker slot has completed.
+every worker slot has completed. SERVER slots are supervised the same way
+(docs/distributed.md §server-HA): a dead server is relaunched with
+DMLC_PS_RECOVERY=1 so it restores its optimizer-slot checkpoint and rejoins
+as a backup, while the registry promotes a replica to keep the key range
+live in the meantime (MXNET_KV_REPLICAS).
 
 Usage: python tools/launch.py -n 2 -s 1 python train_mnist.py --kv-store dist_sync
 """
@@ -67,25 +71,36 @@ def run_local(args):
             env["MXNET_ELASTIC"] = "1"
             if elastic_cache_dir:
                 env["MXNET_COMPILE_CACHE_DIR"] = elastic_cache_dir
+            # a relaunched server is only useful if it can warm-start its
+            # optimizer slots: default the server checkpoint cadence on
+            # (docs/distributed.md §server-HA; explicit value wins, and an
+            # explicit 0 opts out)
+            if "MXNET_KV_SERVER_CKPT_STEPS" not in os.environ:
+                env["MXNET_KV_SERVER_CKPT_STEPS"] = "32"
         if role == "server":
             env["DMLC_SERVER_ID"] = str(idx)
         else:
             env["DMLC_WORKER_ID"] = str(idx)
-            if recovery:
-                env["DMLC_PS_RECOVERY"] = "1"
-            else:
-                env.pop("DMLC_PS_RECOVERY", None)
+        # DMLC_PS_RECOVERY on a relaunched SERVER restores the slot
+        # checkpoint (kvstore_server._restore_checkpoint); on a worker it
+        # takes the elastic rejoin path instead of re-initializing
+        if recovery:
+            env["DMLC_PS_RECOVERY"] = "1"
+        else:
+            env.pop("DMLC_PS_RECOVERY", None)
         return subprocess.Popen(args.command, env=env)
 
-    servers = [spawn("server", i) for i in range(args.num_servers)]
+    servers = {i: spawn("server", i) for i in range(args.num_servers)}
     workers = {i: spawn("worker", i) for i in range(args.num_workers)}
     done_ok = set()           # worker slots that exited 0
     restarts = {}             # worker slot -> relaunch count
     pending = {}              # worker slot -> monotonic relaunch time
+    srv_restarts = {}         # server slot -> relaunch count
+    srv_pending = {}          # server slot -> monotonic relaunch time
     state = {"sig": 0}
 
     def terminate_all():
-        for p in list(workers.values()) + servers:
+        for p in list(workers.values()) + list(servers.values()):
             if p.poll() is None:
                 p.terminate()
 
@@ -105,6 +120,42 @@ def run_local(args):
             rc_final = 128 + state["sig"]
             break
         now = time.monotonic()
+        # server slots are supervised exactly like worker slots under
+        # --elastic (docs/distributed.md §server-HA): a dead server is
+        # relaunched with backoff and DMLC_PS_RECOVERY=1 so it restores
+        # its optimizer-slot checkpoint and rejoins as a backup — the
+        # registry already promoted a replica meanwhile
+        for i, when in list(srv_pending.items()):
+            if now >= when:
+                del srv_pending[i]
+                print("launch.py: relaunching server %d (restart %d/%d)"
+                      % (i, srv_restarts[i], max_restarts), file=sys.stderr)
+                servers[i] = spawn("server", i, recovery=True)
+        if args.elastic:
+            for i, p in list(servers.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                del servers[i]
+                if code == 0:
+                    continue  # clean stop (rank 0's end-of-job shutdown)
+                if not workers and not pending:
+                    # job finishing: a relaunch would only rejoin a
+                    # cluster that is shutting down
+                    continue
+                if srv_restarts.get(i, 0) >= max_restarts:
+                    print("launch.py: server %d exceeded "
+                          "MXNET_ELASTIC_MAX_RESTARTS=%d — terminating "
+                          "the job" % (i, max_restarts), file=sys.stderr)
+                    rc_final = code
+                    break
+                srv_restarts[i] = srv_restarts.get(i, 0) + 1
+                delay = min(0.5 * (1 << (srv_restarts[i] - 1)), 30.0)
+                print("launch.py: server %d died (code %d); relaunch in "
+                      "%.1fs" % (i, code, delay), file=sys.stderr)
+                srv_pending[i] = now + delay
+            if rc_final is not None:
+                break
         for i, when in list(pending.items()):
             if now >= when:
                 del pending[i]
@@ -156,7 +207,7 @@ def run_local(args):
         terminate_all()
     # workers done: servers were told to stop by worker rank 0; reap — on a
     # failure path they were just SIGTERMed and should go promptly
-    for p in servers:
+    for p in servers.values():
         try:
             p.wait(timeout=30 if rc_final == 0 else 5)
         except subprocess.TimeoutExpired:
@@ -240,9 +291,11 @@ def main():
     ap.add_argument("--sync-dst-dir", default=None,
                     help="ssh launcher: rsync working dir to hosts first")
     ap.add_argument("--elastic", action="store_true",
-                    help="local launcher: supervise workers — relaunch dead "
-                         "ones (MXNET_ELASTIC_MAX_RESTARTS, backoff) into "
-                         "the running job via the PS membership registry")
+                    help="local launcher: supervise workers AND servers — "
+                         "relaunch dead ones (MXNET_ELASTIC_MAX_RESTARTS, "
+                         "backoff; servers restore their optimizer-slot "
+                         "checkpoint) into the running job via the PS "
+                         "membership registry")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
