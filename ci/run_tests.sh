@@ -207,13 +207,18 @@ run_serving() {
   # program the TPU runs), KV block-pool alloc/free/OOM invariants,
   # continuous-batching FCFS fairness + recompute preemption, the
   # graph-level cache-overflow contract on both decode paths, and the
-  # compile-flat-after-warmup gate. The slow case (>=32 concurrent
-  # variable-length HTTP streams through tools/serve.py, outputs
-  # bit-identical to sequential decoding) runs only when this stage is
+  # compile-flat-after-warmup gate — plus the observability plane
+  # (tests_tpu/test_serving_obs.py): phase-clock attribution closure,
+  # two-engine stats isolation, SLO burn edge, and the serve.py HTTP
+  # schemas. The slow cases (>=32 concurrent variable-length HTTP
+  # streams through tools/serve.py, outputs bit-identical to sequential
+  # decoding; the waterfall-attribution e2e) run only when this stage is
   # invoked directly, like `elastic`.
-  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_serving.py -q -m "not slow"
+  JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_serving.py \
+    tests_tpu/test_serving_obs.py -q -m "not slow"
   if [ "${1:-}" = "with_slow" ]; then
-    JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_serving.py -q -m slow
+    JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_serving.py \
+      tests_tpu/test_serving_obs.py -q -m slow
   fi
 }
 

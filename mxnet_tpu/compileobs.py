@@ -392,6 +392,19 @@ class ObservedJit:
     def program(self):
         return self._rec().name
 
+    def compile_totals(self):
+        """This wrapper's program-record compile tallies
+        ``(compile_count, compile_seconds)`` — a cheap two-field read
+        under the record lock. The serving engine samples it around each
+        bucket dispatch to attribute compile-stall wall to the requests
+        blocked behind a cold bucket (serving/obs.py). The record is
+        shared per PROGRAM name, so concurrent compiles of sibling
+        buckets land in the same tallies — callers diffing around a
+        dispatch own the only driver thread in every shipped engine."""
+        rec = self._rec()
+        with rec.lock:
+            return rec.compile_count, rec.compile_seconds
+
     @property
     def __wrapped__(self):
         return self._jitted

@@ -21,14 +21,20 @@ Layers:
 * :mod:`.engine`    — :class:`ServingEngine`: the Python API
   (``submit``/``step``/``generate``) with per-request TTFT / latency /
   tokens-per-sec flowing through the telemetry registry.
+* :mod:`.obs`       — the per-request observability plane: lifecycle
+  event stream keyed by ``request_id``, phase attribution (queue_wait /
+  prefill / decode / replay / compile_stall summing to end-to-end),
+  SLO accounting (``MXNET_SERVING_SLO_*``), the step occupancy timeline.
 
 Front ends: ``tools/serve.py`` (HTTP/JSON standing server with live stat
-columns) and ``tools/bench_serving.py`` (offline BENCH headline). See
-docs/serving.md.
+columns), ``tools/bench_serving.py`` (offline BENCH headline), and
+``tools/serving_report.py`` (per-request waterfalls + occupancy timeline
+from telemetry JSONL). See docs/serving.md.
 """
 from .engine import ServingConfig, ServingEngine
 from .kv_cache import KVBlockPool, KVCacheOOM
+from .obs import PHASES, RequestTrace, ServingObs
 from .scheduler import Request, Scheduler
 
 __all__ = ["ServingConfig", "ServingEngine", "KVBlockPool", "KVCacheOOM",
-           "Request", "Scheduler"]
+           "Request", "Scheduler", "ServingObs", "RequestTrace", "PHASES"]

@@ -33,6 +33,7 @@ from .. import compile_cache, compileobs, telemetry
 from ..base import env_int
 from . import model as _model
 from .kv_cache import KVBlockPool
+from .obs import ServingObs
 from .scheduler import DECODING, FAILED, FINISHED, Request, Scheduler
 
 _SITE = "serving/engine.py"
@@ -135,6 +136,11 @@ class ServingEngine:
         self._token_window = []   # one timestamp per token, for tokens/sec
         self._t_started = time.time()
         self._tokens_total = 0
+        # per-engine identity: labels this engine's histograms/counters in
+        # the process-global registry (stats() reads ONLY its own label)
+        # and salts the graph keys below
+        self.engine_id = next(_engine_ids)
+        self.obs = ServingObs(self.engine_id)
 
         # donation frees the pool's previous pages the moment the step
         # consumes them — without it every step would briefly double the
@@ -149,7 +155,7 @@ class ServingEngine:
         # under a shared graph key that warmup would diff against the
         # first engine's signatures and misreport as compile.recompile
         # (cause=placement; cause=dtype when only kv_dtype differs)
-        gkey = ("serving", next(_engine_ids)) + cfg.key() + (
+        gkey = ("serving", self.engine_id) + cfg.key() + (
             cfg.block_size, cfg.num_blocks, str(cfg.kv_dtype))
 
         # fresh function objects per bucket (factories, not one shared
@@ -214,11 +220,14 @@ class ServingEngine:
                                              ctx, kp, vp)
 
     # ------------------------------------------------------------------ API
-    def submit(self, prompt, max_new_tokens, eos_id=None):
+    def submit(self, prompt, max_new_tokens, eos_id=None, request_id=None):
         """Enqueue a request; returns the :class:`Request` (its
         ``done_event`` is set when it finishes — block on it from serving
-        threads, or drive :meth:`step` yourself)."""
-        req = Request(prompt, max_new_tokens, eos_id=eos_id)
+        threads, or drive :meth:`step` yourself). ``request_id`` is the
+        wire identity threaded through every lifecycle event and trace
+        lane (auto-assigned from the rid when omitted)."""
+        req = Request(prompt, max_new_tokens, eos_id=eos_id,
+                      request_id=request_id)
         total = len(req.prompt) + req.max_new_tokens
         if total > self.config.max_len:
             raise ValueError(
@@ -236,6 +245,7 @@ class ServingEngine:
             # behind a dead driver with a done_event nobody will ever set
             if self._aborted is not None:
                 raise RuntimeError(self._aborted)
+            self.obs.request_submitted(req)
             self.scheduler.add(req)
             self._work.notify_all()
         return req
@@ -257,17 +267,25 @@ class ServingEngine:
         try:
             with self._lock, telemetry.span("serving.step"):
                 plan = self.scheduler.schedule()
+                for req in plan.preempted:
+                    self.obs.request_preempted(req)
+                for req in plan.prefills:
+                    self.obs.request_admitted(req)
                 failed = self._drain_failed()
                 if plan.empty():
                     return failed
                 for req in plan.prefills:
                     self._run_prefill(req)
+                n_preempted = len(plan.preempted)
                 if plan.prefills:
                     # a prompt that exactly filled its blocks writes its
                     # first decode token at a fresh block boundary — back
                     # that slot with a real block NOW or the write lands in
                     # trash and the position's K/V is silently lost
-                    self.scheduler.ensure_decode_headroom()
+                    late = self.scheduler.ensure_decode_headroom()
+                    for req in late:
+                        self.obs.request_preempted(req)
+                    n_preempted += len(late)
                     failed += self._drain_failed()
                 decodes = self.scheduler.decodable()
                 if decodes:
@@ -279,6 +297,14 @@ class ServingEngine:
                     self._retire(req)
                 self._steps += 1
                 self._refresh_throughput()
+                self.obs.step_timeline(
+                    step=self._steps, occupancy=len(decodes),
+                    admitted=len(plan.prefills), preempted=n_preempted,
+                    finished=len(finished) + len(failed),
+                    queue=len(self.scheduler.waiting),
+                    running=len(self.scheduler.running),
+                    kv_used=self.pool.used(), kv_free=self.pool.available(),
+                    kv_frag_slots=self.scheduler.frag_slots())
                 return finished + failed
         except Exception as exc:
             self.abort(exc)
@@ -325,6 +351,7 @@ class ServingEngine:
                 req.error = msg
                 req.finish_t = time.time()
                 telemetry.counter("serving.requests_failed").inc()
+                self.obs.request_finished(req, failed=True)
                 if req.done_event is not None:
                     req.done_event.set()
             self._finished.extend(reqs)
@@ -389,6 +416,8 @@ class ServingEngine:
         from :meth:`step`. ``_fail`` already stamped ``finish_t``, bumped
         ``serving.requests_failed`` and woke the ``done_event``."""
         failed = self.scheduler.pop_failed()
+        for req in failed:
+            self.obs.request_finished(req, failed=True)
         self._finished.extend(failed)
         self._n_failed += len(failed)
         return failed
@@ -411,6 +440,11 @@ class ServingEngine:
         toks = np.zeros((1, S), np.int32)
         toks[0, :L] = replay
         table = self._table_row(req, S // cfg.block_size)
+        # compile-tally delta around the dispatch: a bump means THIS call
+        # sat behind a cold prefill bucket — that wall is the request's
+        # compile_stall, not honest prefill time
+        jit = self._prefill_jits[S]
+        c0, s0 = jit.compile_totals()
         t0 = time.time()
         tok, _logits, kp, vp = self._prefill_fn(
             self.params, toks, np.int32(L), table,
@@ -418,16 +452,20 @@ class ServingEngine:
         self.pool.k_pages, self.pool.v_pages = kp, vp
         # the per-step token egress: serving's output IS this transfer
         tok = int(np.asarray(tok)[0])  # fwlint: disable=device-escape — token egress to the client is the product, one scalar per prefill
-        telemetry.histogram("serving.prefill_seconds").observe(
-            time.time() - t0)
+        wall = time.time() - t0
+        c1, s1 = jit.compile_totals()
+        stall = min(s1 - s0, wall) if c1 > c0 else 0.0
+        telemetry.histogram("serving.prefill_seconds").observe(wall)
         telemetry.counter("serving.prefill_tokens").inc(L)
+        was_replay = req.pending_token is not None
         req.context_len = L
         req.state = DECODING
-        if req.pending_token is None:
+        if not was_replay:
             # fresh prompt: the prefill's greedy token is the first output
             self._note_token(req, tok)
         # else: preemption replay — the pending token was already produced
         # (greedy replay recomputes the same cache; tok == pending_token)
+        self.obs.prefill_done(req, stall, was_replay)
 
     def _run_decode(self, reqs):
         cfg = self.config
@@ -441,12 +479,21 @@ class ServingEngine:
             poss[i] = req.context_len
             tables[i] = self._table_row(req, self._nb_max)
             ctx[i] = req.context_len + 1
+        # compile-tally delta: a cold decode batch bucket stalls EVERY
+        # stream in the batch for the compile wall (serving/obs.py)
+        jit = self._decode_jits[B]
+        c0, s0 = jit.compile_totals()
+        t0 = time.time()
         nxt, _logits, kp, vp = self._decode_fn(
             self.params, toks, poss, tables, ctx,
             self.pool.k_pages, self.pool.v_pages)
         self.pool.k_pages, self.pool.v_pages = kp, vp
         # the fused step's single device->host sync: the next-token vector
         nxt = np.asarray(nxt)  # fwlint: disable=device-escape — token egress to clients is the product, B int32s per step
+        wall = time.time() - t0
+        c1, s1 = jit.compile_totals()
+        if c1 > c0:
+            self.obs.decode_stall(reqs, min(s1 - s0, wall))
         telemetry.histogram("serving.decode_batch").observe(len(reqs))
         for i, req in enumerate(reqs):
             req.context_len += 1
@@ -470,9 +517,13 @@ class ServingEngine:
 
     def _retire(self, req):
         req.finish_t = time.time()
+        # unlabeled aggregate kept alongside the engine-labeled observe in
+        # obs.request_finished: process-wide dashboards and pre-existing
+        # tests read the bare name
         telemetry.histogram("serving.request_latency_seconds").observe(
             req.finish_t - req.arrival_t)
         telemetry.counter("serving.requests_completed").inc()
+        self.obs.request_finished(req)
         self._n_completed += 1
         self._finished.append(req)
         if req.done_event is not None:
@@ -490,24 +541,27 @@ class ServingEngine:
     def stats(self):
         """One dashboard snapshot (serve.py columns, /stats endpoint).
 
-        Counts (completed/failed/preemptions) are THIS engine's; the
-        latency/TTFT percentiles read the process-global registry
-        histograms, which merge traffic across engines when several share
-        a process (one engine per process in every shipped front end)."""
+        Everything here is THIS engine's: counts are per-engine tallies
+        and the latency/TTFT percentiles read the ``engine=<id>``-labeled
+        registry histograms, so two engines sharing a process never mix
+        numbers (the bare-name histograms still aggregate process-wide
+        for dashboards)."""
         with self._lock:
             self._refresh_throughput()   # a stale window must read as 0
-            lat = telemetry.histogram("serving.request_latency_seconds")
-            ttft = telemetry.histogram("serving.ttft_seconds")
+            eid = str(self.engine_id)
+            lat = telemetry.histogram("serving.request_latency_seconds",
+                                      engine=eid)
+            ttft = telemetry.histogram("serving.ttft_seconds", engine=eid)
             prog = {p["program"]: p for p in compileobs.program_table()
                     if p["program"].startswith("serving.")}
             return {
+                "engine": self.engine_id,
                 "steps": self._steps,
                 "waiting": len(self.scheduler.waiting),
                 "active": len(self.scheduler.running),
                 "kv_blocks_total": self.pool.num_usable,
                 "kv_blocks_used": self.pool.used(),
-                "kv_blocks_frag_slots": int(telemetry.gauge(
-                    "serving.kv_blocks_frag_slots").value),
+                "kv_blocks_frag_slots": self.scheduler.frag_slots(),
                 "kv_pool_bytes": self.pool.nbytes(),
                 "tokens_total": self._tokens_total,
                 "tokens_per_sec":
@@ -519,6 +573,8 @@ class ServingEngine:
                 "preemptions": self.scheduler.preempt_count,
                 "completed": self._n_completed,
                 "failed": self._n_failed,
+                "slo": self.obs.slo_snapshot(),
+                "phases": self.obs.phase_snapshot(),
                 "compiles": {n: {"count": p["compile_count"],
                                  "seconds": round(p["compile_seconds"], 3),
                                  "runs": p["run_count"]}

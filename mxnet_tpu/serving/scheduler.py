@@ -45,13 +45,20 @@ _rid_counter = itertools.count()
 class Request:
     """One generation request and its serving-side state."""
 
-    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "state",
-                 "blocks", "context_len", "generated", "pending_token",
-                 "arrival_t", "admitted_t", "first_token_t", "finish_t",
-                 "preemptions", "error", "done_event")
+    __slots__ = ("rid", "request_id", "prompt", "max_new_tokens", "eos_id",
+                 "state", "blocks", "context_len", "generated",
+                 "pending_token", "arrival_t", "admitted_t", "first_token_t",
+                 "preempted_t", "finish_t", "preemptions", "error",
+                 "done_event", "trace")
 
-    def __init__(self, prompt, max_new_tokens, eos_id=None, rid=None):
+    def __init__(self, prompt, max_new_tokens, eos_id=None, rid=None,
+                 request_id=None):
         self.rid = rid if rid is not None else next(_rid_counter)
+        # wire identity: caller-supplied (X-Request-Id header) or derived
+        # from the process-local rid — threads through every lifecycle
+        # event, the /stats surface, and the per-request trace lanes
+        self.request_id = (str(request_id) if request_id is not None
+                           else "r%d" % self.rid)
         self.prompt = [int(t) for t in prompt]
         if not self.prompt:
             raise ValueError("empty prompt (the decoder needs a seed token)")
@@ -67,10 +74,12 @@ class Request:
         self.arrival_t = time.time()
         self.admitted_t = None
         self.first_token_t = None
+        self.preempted_t = None   # last preemption (obs replay clock)
         self.finish_t = None
         self.preemptions = 0
         self.error = None
         self.done_event = None    # engine attaches for blocking consumers
+        self.trace = None         # obs.RequestTrace (engine submits only)
 
     # tokens that must be in the KV cache for the next decode step
     def replay_tokens(self):
@@ -206,6 +215,7 @@ class Scheduler:
         req.context_len = 0
         req.state = WAITING
         req.preemptions += 1
+        req.preempted_t = time.time()
         self.preempt_count += 1
         telemetry.counter("serving.preemptions").inc()
         self.waiting.appendleft(req)
@@ -281,10 +291,15 @@ class Scheduler:
             req.blocks = []
         self._refresh_gauges()
 
+    def frag_slots(self):
+        """Internal fragmentation: allocated-but-unused tail-block slots.
+        Per-scheduler (the gauge below is process-global; engine stats()
+        and the step timeline read this directly)."""
+        return sum(len(r.blocks) * self.pool.block_size - r.context_len
+                   for r in self.running)
+
     def _refresh_gauges(self):
         telemetry.gauge("serving.queue_depth").set(len(self.waiting))
         telemetry.gauge("serving.active_requests").set(len(self.running))
-        # internal fragmentation: allocated-but-unused tail-block slots
-        frag = sum(len(r.blocks) * self.pool.block_size - r.context_len
-                   for r in self.running)
-        telemetry.gauge("serving.kv_blocks_frag_slots").set(frag)
+        telemetry.gauge("serving.kv_blocks_frag_slots").set(
+            self.frag_slots())

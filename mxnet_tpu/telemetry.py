@@ -748,10 +748,28 @@ METRIC_HELP = {
     "serving.prefill_tokens": "prompt+replay tokens prefilled",
     "serving.decode_batch": "live streams per fused decode step",
     "serving.generated_tokens": "tokens generated across all streams",
-    "serving.ttft_seconds": "request time-to-first-token",
-    "serving.request_latency_seconds": "request end-to-end latency",
+    "serving.ttft_seconds": "request time-to-first-token "
+        "(bare = process-wide; engine label = per-engine)",
+    "serving.request_latency_seconds": "request end-to-end latency "
+        "(bare = process-wide; engine label = per-engine)",
     "serving.tokens_per_sec":
         "generated tokens/sec over a sliding 10s window",
+    "serving.phase_seconds":
+        "per-request wall by phase{engine,phase}: queue_wait / prefill / "
+        "decode / replay / compile_stall sum to end-to-end "
+        "(serving/obs.py)",
+    "serving.tpot_seconds":
+        "per-request time-per-output-token{engine} (decode-phase "
+        "requests, >= 2 tokens)",
+    "serving.slo_good":
+        "requests meeting the SLO target{engine,phase}: phase=ttft vs "
+        "MXNET_SERVING_SLO_TTFT_MS, phase=tpot vs "
+        "MXNET_SERVING_SLO_TPOT_MS (always-on)",
+    "serving.slo_total":
+        "requests judged against the SLO target{engine,phase} (always-on)",
+    "serving.goodput":
+        "fraction of the last 32 finished requests meeting every "
+        "applicable SLO target{engine}",
 }
 
 

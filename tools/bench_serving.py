@@ -9,6 +9,12 @@ variable-length prompts, and emits ONE JSON record (BENCH idiom):
   (headline; read back from the ``serving.tokens_per_sec``-adjacent
   counters so the registry and the record can never disagree)
 * request latency p50/p99 and TTFT p50/p99 (telemetry histograms)
+* ``phases`` — per-phase p50/p99/total from the engine's phase
+  attribution (queue_wait / prefill / decode / replay / compile_stall;
+  serving/obs.py) with the preemption replay-overhead total — the
+  before/after artifact for scheduler work
+* ``slo`` — SLO attainment block (``MXNET_SERVING_SLO_TTFT_MS`` /
+  ``MXNET_SERVING_SLO_TPOT_MS`` targets, good/total per phase, goodput)
 * ``max_concurrent_streams`` — how many average-length streams the KV
   block pool can hold at the configured HBM budget (pool bytes), plus the
   measured peak in-flight count
@@ -102,8 +108,10 @@ def main(argv=None):
     wall = time.time() - t0
 
     gen_tokens = sum(len(r.generated) for r in reqs)
-    lat = telemetry.histogram("serving.request_latency_seconds")
-    ttft = telemetry.histogram("serving.ttft_seconds")
+    eid = str(engine.engine_id)
+    lat = telemetry.histogram("serving.request_latency_seconds", engine=eid)
+    ttft = telemetry.histogram("serving.ttft_seconds", engine=eid)
+    phases = engine.obs.phase_snapshot()
     pool = engine.pool
     avg_stream_tokens = (sum(len(p) for p in prompts) / len(prompts)
                          + args.max_new)
@@ -119,7 +127,13 @@ def main(argv=None):
         "latency_p99_s": round(lat.percentile(99), 4),
         "ttft_p50_s": round(ttft.percentile(50), 4),
         "ttft_p99_s": round(ttft.percentile(99), 4),
-        "preemptions": telemetry.counter("serving.preemptions").value,
+        "preemptions": engine.scheduler.preempt_count,
+        # per-request phase attribution: where the latency above actually
+        # went (the five phases sum to each request's end-to-end wall)
+        "phases": phases,
+        "replay_overhead_total_s": phases["replay"]["total_s"],
+        "compile_stall_total_s": phases["compile_stall"]["total_s"],
+        "slo": engine.obs.slo_snapshot(),
         "kv_pool_bytes": pool.nbytes(),
         "kv_blocks": pool.num_usable,
         "block_size": pool.block_size,
@@ -137,8 +151,24 @@ def main(argv=None):
         # cold or loaded from the persistent cache
         "compile_cache": compile_cache.stats(),
     }
+    _phase_table(reqs, file=sys.stderr)
     print(json.dumps(rec))
     return rec
+
+
+def _phase_table(reqs, file):
+    """Per-request phase breakdown (stderr; stdout stays BENCH JSON)."""
+    from mxnet_tpu.serving.obs import PHASES
+
+    cols = "  ".join("%8s" % p[:8] for p in PHASES)
+    print("request          %s  %8s  pre  tok" % (cols, "e2e"), file=file)
+    for r in sorted(reqs, key=lambda r: r.rid):
+        ph = r.trace.phases if r.trace is not None else {}
+        cells = "  ".join("%8.3f" % ph.get(p, 0.0) for p in PHASES)
+        e2e = (r.finish_t - r.arrival_t) if r.finish_t else float("nan")
+        print("%-16s %s  %8.3f  %3d  %3d"
+              % (r.request_id, cells, e2e, r.preemptions, len(r.generated)),
+              file=file)
 
 
 if __name__ == "__main__":

@@ -22,9 +22,16 @@ Usage::
     python tools/mxtop.py --host 127.0.0.1 --port 9091 -n 4
     python tools/mxtop.py --once        # single frame, no screen control
     python tools/mxtop.py --trace      # also dump per-server rank traces
+    python tools/mxtop.py --serving http://127.0.0.1:8090   # serving panel
 
 Defaults come from the launcher's DMLC_* env when present, so running it
 on a cluster host needs no flags.
+
+``--serving URL`` switches to the serving panel: polls a
+``tools/serve.py`` instance's ``/stats`` endpoint and renders occupancy,
+KV-pool pressure, latency/TTFT percentiles, the per-phase attribution
+(queue_wait/prefill/decode/replay/compile_stall) and SLO attainment —
+docs/serving.md §observability.
 """
 from __future__ import annotations
 
@@ -221,6 +228,64 @@ def render(snaps, membership=None, straggler_factor=2.0, now=None):
     return "\n".join(lines)
 
 
+def render_serving(stats, now=None):
+    """One serving-panel frame from a serve.py ``/stats`` snapshot
+    (pure: unit-testable)."""
+    def ms(v):
+        return "--" if v is None else "%.0f" % (float(v) * 1000.0)
+
+    lines = []
+    slo = stats.get("slo") or {}
+    goodput = slo.get("goodput")
+    lines.append(
+        "mxtop serving  engine=%s  steps=%d  completed=%d  failed=%d  "
+        "preemptions=%d"
+        % (stats.get("engine", "?"), stats.get("steps", 0),
+           stats.get("completed", 0), stats.get("failed", 0),
+           stats.get("preemptions", 0)))
+    lines.append(
+        "  act %3d wait %3d | kv %4d/%-4d frag %5d | %7.1f tok/s | "
+        "ttft %s/%s ms | lat %s/%s ms"
+        % (stats.get("active", 0), stats.get("waiting", 0),
+           stats.get("kv_blocks_used", 0), stats.get("kv_blocks_total", 0),
+           int(stats.get("kv_blocks_frag_slots", 0)),
+           float(stats.get("tokens_per_sec", 0.0)),
+           ms(stats.get("ttft_p50_s")), ms(stats.get("ttft_p99_s")),
+           ms(stats.get("latency_p50_s")), ms(stats.get("latency_p99_s"))))
+    att = slo.get("attainment") or {}
+
+    def pct(v):
+        return "--" if v is None else "%.0f%%" % (float(v) * 100.0)
+
+    lines.append(
+        "  slo: ttft<=%sms %s | tpot<=%sms %s | goodput %s%s"
+        % (slo.get("ttft_target_ms", "?"), pct(att.get("ttft")),
+           slo.get("tpot_target_ms", "?"), pct(att.get("tpot")),
+           pct(goodput), "  BURNING" if slo.get("burning") else ""))
+    phases = stats.get("phases") or {}
+    if phases:
+        lines.append("  %-14s %10s %10s %10s"
+                     % ("phase", "p50_ms", "p99_ms", "total_s"))
+        for ph in ("queue_wait", "prefill", "decode", "replay",
+                   "compile_stall"):
+            row = phases.get(ph) or {}
+            lines.append("  %-14s %10s %10s %10.3f"
+                         % (ph, ms(row.get("p50_s")), ms(row.get("p99_s")),
+                            float(row.get("total_s", 0.0))))
+    return "\n".join(lines)
+
+
+def _fetch_stats(url, timeout_s=2.0):
+    """GET ``<url>/stats`` from a serve.py instance, or None."""
+    from urllib.request import urlopen
+
+    try:
+        with urlopen(url.rstrip("/") + "/stats", timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+    except (OSError, ValueError):
+        return None
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description="live cluster dashboard over "
                                              "the PS telemetry plane")
@@ -239,7 +304,22 @@ def main(argv=None):
                     help="also print per-server per-rank RPC attribution")
     ap.add_argument("--factor", type=float, default=2.0,
                     help="straggler threshold vs cluster-median self time")
+    ap.add_argument("--serving", default=None, metavar="URL",
+                    help="render the serving panel from a serve.py "
+                         "instance's /stats instead of the PS plane "
+                         "(e.g. http://127.0.0.1:8090)")
     args = ap.parse_args(argv)
+    if args.serving:
+        while True:
+            stats = _fetch_stats(args.serving)
+            frame = (render_serving(stats) if stats
+                     else "mxtop serving: no /stats from %s" % args.serving)
+            if args.once:
+                print(frame)
+                return 0 if stats else 1
+            sys.stdout.write("\x1b[H\x1b[2J" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
     obs = Observer(args.host, args.port, args.num_servers)
     while True:
         snaps = {r: obs.snapshot(r) for r in range(args.num_workers)}

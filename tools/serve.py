@@ -12,10 +12,18 @@ continuous batching means N in-flight requests share every decode step.
 
 Endpoints:
   POST /generate  {"tokens": [int...], "max_new_tokens": N,
-                   "eos_id": optional int}
-                  -> {"tokens": [int...], "ttft_s": float,
-                      "latency_s": float, "preemptions": int}
-  GET  /stats     engine snapshot (queue/blocks/latency/compiles) as JSON
+                   "eos_id": optional int, "request_id": optional str}
+                  -> {"tokens": [int...], "request_id": str,
+                      "ttft_s": float, "latency_s": float,
+                      "preemptions": int}
+                  The request identity (X-Request-Id header or body
+                  "request_id"; auto-assigned otherwise) threads through
+                  every serving.request lifecycle event — a slow reply
+                  decomposes by cause in tools/serving_report.py. The
+                  reply echoes it in both the X-Request-Id header and
+                  the body.
+  GET  /stats     engine snapshot (queue/blocks/latency/phases/SLO/
+                  compiles) as JSON
   GET  /metrics   Prometheus text exposition of the telemetry registry
   GET  /healthz   {"ok": true}
 
@@ -64,15 +72,19 @@ def _columns(stats):
     def ms(v):
         return "--" if v is None else "%.0f" % (v * 1000.0)
 
+    slo = stats.get("slo") or {}
+    goodput = slo.get("goodput")
     return ("reqs %3d | act %3d wait %3d | kv %4d/%-4d frag %5d | "
-            "%6.1f tok/s | ttft %s/%s ms | lat %s/%s ms | steps %d"
+            "%6.1f tok/s | ttft %s/%s ms | lat %s/%s ms | slo %s | steps %d"
             % (stats["active"] + stats["waiting"], stats["active"],
                stats["waiting"], stats["kv_blocks_used"],
                stats["kv_blocks_total"],
                int(stats.get("kv_blocks_frag_slots", 0)),
                stats["tokens_per_sec"], ms(stats["ttft_p50_s"]),
                ms(stats["ttft_p99_s"]), ms(stats["latency_p50_s"]),
-               ms(stats["latency_p99_s"]), stats["steps"]))
+               ms(stats["latency_p99_s"]),
+               "--" if goodput is None else "%.0f%%" % (goodput * 100.0),
+               stats["steps"]))
 
 
 def make_server(engine, host, port, driver=None):
@@ -86,12 +98,15 @@ def make_server(engine, host, port, driver=None):
         def log_message(self, fmt, *a):  # quiet: telemetry is the log
             pass
 
-        def _reply(self, code, body, ctype="application/json"):
+        def _reply(self, code, body, ctype="application/json",
+                   request_id=None):
             data = body if isinstance(body, bytes) else \
                 json.dumps(body).encode()
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            if request_id is not None:
+                self.send_header("X-Request-Id", request_id)
             self.end_headers()
             self.wfile.write(data)
 
@@ -119,7 +134,12 @@ def make_server(engine, host, port, driver=None):
                 tokens = body["tokens"]
                 max_new = int(body["max_new_tokens"])
                 eos_id = body.get("eos_id")
-                req = engine.submit(tokens, max_new, eos_id=eos_id)
+                # wire identity: header wins over body; engine assigns
+                # one when the caller sent neither
+                request_id = (self.headers.get("X-Request-Id")
+                              or body.get("request_id"))
+                req = engine.submit(tokens, max_new, eos_id=eos_id,
+                                    request_id=request_id)
             except (KeyError, TypeError, ValueError) as e:
                 self._reply(400, {"error": str(e)})
                 return
@@ -129,14 +149,17 @@ def make_server(engine, host, port, driver=None):
             req.done_event.wait()
             if req.error is not None:
                 self._reply(503, {"error": req.error,
-                                  "preemptions": req.preemptions})
+                                  "preemptions": req.preemptions,
+                                  "request_id": req.request_id},
+                            request_id=req.request_id)
                 return
             self._reply(200, {
                 "tokens": list(req.generated),
+                "request_id": req.request_id,
                 "ttft_s": round(req.first_token_t - req.arrival_t, 6),
                 "latency_s": round(req.finish_t - req.arrival_t, 6),
                 "preemptions": req.preemptions,
-            })
+            }, request_id=req.request_id)
 
     return ThreadingHTTPServer((host, port), Handler)
 

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Render per-request waterfalls + the step occupancy timeline from
+serving telemetry JSONL (docs/serving.md §observability).
+
+The serving engine (``mxnet_tpu/serving/obs.py``) emits one
+``serving.request`` event per lifecycle transition and one
+``serving.step_timeline`` event per non-empty step into
+``MXNET_TELEMETRY_FILE``. This tool turns that stream into the answer to
+"why was request X slow":
+
+* a **per-request waterfall** — one row per request with its phase
+  breakdown (queue_wait / prefill / decode / replay / compile_stall, which
+  sum to the end-to-end latency), preemption count, SLO verdicts, and a
+  proportional phase bar;
+* the **occupancy timeline** — per step: batch occupancy, admitted /
+  preempted / finished counts, queue depth, KV-pool used/frag;
+* **totals** — SLO attainment, total replay overhead (what preemptions
+  cost), total compile stall (what cold buckets cost).
+
+Usage::
+
+    MXNET_TELEMETRY_FILE=/tmp/serving.jsonl python tools/serve.py ... &
+    python tools/serving_report.py /tmp/serving.jsonl
+    python tools/serving_report.py --json /tmp/serving.jsonl   # machine use
+
+``--json`` prints one JSON object ({"requests", "steps", "slo"}) for
+scripting; the e2e test asserts attribution closure through it. The
+chrome-trace view of the same stream is
+``tools/trace_merge.py --serving-lanes``.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from trace_merge import request_segments  # noqa: E402  (shared walker)
+
+PHASES = ("queue_wait", "prefill", "decode", "replay", "compile_stall")
+_BAR_CHARS = {"queue_wait": "q", "prefill": "P", "decode": "D",
+              "replay": "R", "compile_stall": "C"}
+
+
+def load_events(path):
+    """Parse a telemetry JSONL file into (request_events, step_events)."""
+    requests, steps = [], []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue   # torn tail of a killed server: keep the rest
+            if rec.get("type") != "event":
+                continue
+            name = rec.get("event")
+            if name == "serving.request" and "request_id" in rec:
+                requests.append(rec)
+            elif name == "serving.step_timeline":
+                steps.append(rec)
+    return requests, steps
+
+
+def summarize_requests(events):
+    """One summary dict per request (submission order): identity, phase
+    breakdown from the terminal event (exact — the engine's clock), the
+    segment walk (for bars/lanes), SLO verdicts, preemptions."""
+    by_req = {}
+    for rec in events:
+        key = (str(rec.get("engine", "")), str(rec["request_id"]))
+        by_req.setdefault(key, []).append(rec)
+    out = []
+    for key in sorted(by_req, key=lambda k: float(by_req[k][0]["ts"])):
+        engine, request_id = key
+        evs = sorted(by_req[key], key=lambda r: float(r["ts"]))
+        terminal = next((r for r in evs
+                         if r.get("state") in ("finished", "failed")), None)
+        phases = dict.fromkeys(PHASES, 0.0)
+        if terminal is not None and "phases" in terminal:
+            phases.update(terminal["phases"])
+        out.append({
+            "request_id": request_id,
+            "engine": engine,
+            "state": terminal["state"] if terminal else "in-flight",
+            "submitted_ts": float(evs[0]["ts"]),
+            "e2e_s": terminal.get("e2e_s") if terminal else None,
+            "phases": phases,
+            "phase_sum_s": round(sum(phases.values()), 6),
+            "tokens": terminal.get("tokens") if terminal else None,
+            "preemptions": max([r.get("preemptions", 0) for r in evs]
+                               or [0]),
+            "slo_ttft_ok": terminal.get("slo_ttft_ok") if terminal else None,
+            "slo_tpot_ok": terminal.get("slo_tpot_ok") if terminal else None,
+            "segments": request_segments(evs),
+        })
+    return out
+
+
+def _bar(summary, width=32):
+    """Proportional phase bar over the request's end-to-end span. Stalls
+    are debited from their enclosing phase in the attribution, so the bar
+    draws the SEGMENT timeline (what the request was doing when) and
+    flags stall time in the breakdown columns instead."""
+    segs = [(p, s, e) for p, s, e in summary["segments"] if e is not None]
+    if not segs:
+        return "-" * width
+    t0 = segs[0][1]
+    t1 = max(e for _p, _s, e in segs)
+    span = max(t1 - t0, 1e-9)
+    bar = []
+    for i in range(width):
+        t = t0 + (i + 0.5) / width * span
+        ch = "."
+        for phase, s, e in segs:
+            if s <= t < e:
+                ch = _BAR_CHARS.get(phase, "?")
+                break
+        bar.append(ch)
+    return "".join(bar)
+
+
+def _slo_cell(summary):
+    verdicts = [summary["slo_ttft_ok"], summary["slo_tpot_ok"]]
+    if all(v is None for v in verdicts):
+        return "--"
+    return "ok" if all(v in (True, None) for v in verdicts) else "MISS"
+
+
+def render(requests, steps, bar_width=32, file=sys.stdout):
+    """The human report: waterfall table, totals, occupancy timeline."""
+    w = file.write
+    w("serving_report: %d requests, %d timeline steps\n\n"
+      % (len(requests), len(steps)))
+    if requests:
+        w("per-request waterfall (seconds; q=queue P=prefill D=decode "
+          "R=replay; stall debited from its phase):\n")
+        w("%-16s %9s %9s %9s %9s %9s %9s %4s %5s %4s  %s\n"
+          % ("request", "e2e", "queue", "prefill", "decode", "replay",
+             "stall", "pre", "slo", "tok", "timeline"))
+        for s in requests:
+            ph = s["phases"]
+            w("%-16s %9s %9.3f %9.3f %9.3f %9.3f %9.3f %4d %5s %4s  %s\n"
+              % (s["request_id"],
+                 ("%9.3f" % s["e2e_s"]) if s["e2e_s"] is not None else "--",
+                 ph["queue_wait"], ph["prefill"], ph["decode"], ph["replay"],
+                 ph["compile_stall"], s["preemptions"], _slo_cell(s),
+                 s["tokens"] if s["tokens"] is not None else "--",
+                 _bar(s, bar_width)))
+        done = [s for s in requests if s["state"] == "finished"]
+        judged = [s for s in done if s["slo_ttft_ok"] is not None]
+        good = sum(1 for s in judged
+                   if s["slo_ttft_ok"] and s["slo_tpot_ok"] in (True, None))
+        w("\ntotals: %d finished, %d failed/in-flight | replay overhead "
+          "%.3fs | compile stall %.3fs | preemptions %d"
+          % (len(done), len(requests) - len(done),
+             sum(s["phases"]["replay"] for s in requests),
+             sum(s["phases"]["compile_stall"] for s in requests),
+             sum(s["preemptions"] for s in requests)))
+        if judged:
+            w(" | SLO %d/%d (%.0f%%)"
+              % (good, len(judged), 100.0 * good / len(judged)))
+        w("\n")
+    if steps:
+        w("\noccupancy timeline (per engine step):\n")
+        w("%6s %4s %4s %4s %4s %6s %8s %6s\n"
+          % ("step", "occ", "adm", "pre", "fin", "queue", "kv_used",
+             "frag"))
+        for rec in sorted(steps, key=lambda r: (str(r.get("engine", "")),
+                                                r.get("step", 0))):
+            w("%6s %4d %4d %4d %4d %6d %8d %6d\n"
+              % (rec.get("step", "?"), rec.get("occupancy", 0),
+                 rec.get("admitted", 0), rec.get("preempted", 0),
+                 rec.get("finished", 0), rec.get("queue", 0),
+                 rec.get("kv_used", 0), rec.get("kv_frag_slots", 0)))
+
+
+def report(path):
+    """Machine form: {"requests": [...], "steps": [...], "slo": {...}}."""
+    events, steps = load_events(path)
+    requests = summarize_requests(events)
+    judged = [s for s in requests if s["slo_ttft_ok"] is not None]
+    good = sum(1 for s in judged
+               if s["slo_ttft_ok"] and s["slo_tpot_ok"] in (True, None))
+    return {
+        "requests": requests,
+        "steps": steps,
+        "slo": {"judged": len(judged), "good": good,
+                "attainment": (good / len(judged)) if judged else None},
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-request serving waterfalls + occupancy timeline "
+                    "from telemetry JSONL")
+    ap.add_argument("input", help="telemetry JSONL file "
+                                  "(MXNET_TELEMETRY_FILE sink)")
+    ap.add_argument("--json", action="store_true",
+                    help="print one machine-readable JSON object instead "
+                         "of the tables")
+    ap.add_argument("--bar-width", type=int, default=32,
+                    help="timeline bar width in characters")
+    args = ap.parse_args(argv)
+    if args.json:
+        rep = report(args.input)
+        for s in rep["requests"]:
+            s.pop("segments", None)   # ts tuples: noise for machine use
+        print(json.dumps(rep))
+        return 0
+    events, steps = load_events(args.input)
+    render(summarize_requests(events), steps, bar_width=args.bar_width)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

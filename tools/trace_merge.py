@@ -30,6 +30,13 @@ Usage::
     python tools/trace_merge.py -o merged.json worker0.jsonl worker1.jsonl \
         profile.1234.json profile.1240.json
     python tools/trace_merge.py -o merged.json /path/to/rundir
+    python tools/trace_merge.py -o merged.json --serving-lanes --validate \
+        serving_telemetry.jsonl   # one lane per request (docs/serving.md)
+
+``--serving-lanes`` renders ``serving.request`` lifecycle events (from
+``mxnet_tpu/serving/obs.py``) as one lane per request — queue_wait /
+prefill / decode / replay phase spans with preemption instants — plus a
+per-engine occupancy counter lane from ``serving.step_timeline``.
 
 ``validate_trace`` doubles as the repo's trace-event schema checker
 (required ph/ts/pid/tid fields, per-tid start-time monotonicity, proper
@@ -61,6 +68,9 @@ ANNOTATION_EVENTS = (
     # (Chrome-trace files additionally carry the per-process "compile" lane
     # spans the profiler records — those merge as ordinary events.)
     "compile", "compile.recompile", "oom",
+    # serving SLO attainment crossing below the burn threshold
+    # (mxnet_tpu/serving/obs.py)
+    "serving.slo_burn",
 )
 # annotation events whose `rank` field names the SUBJECT worker's lane
 RANKED_ANNOTATIONS = ("worker_lost", "worker_joined", "worker_rejoined")
@@ -115,13 +125,16 @@ def _load_trace(path, obj):
                 float(ev["ts"]) + float(ev.get("dur", 0))) / 1e6
     return {"path": path, "kind": "trace", "rank": rank,
             "events": [e for e in events if e.get("ph") != "M"],
-            "sync": sync, "annotations": []}
+            "sync": sync, "annotations": [], "serving": [],
+            "serving_steps": []}
 
 
 def _load_jsonl(path, f):
     rank = None
     sync = {}
     annotations = []
+    serving = []
+    serving_steps = []
     for line in f:
         line = line.strip()
         if not line:
@@ -142,10 +155,15 @@ def _load_jsonl(path, f):
             sync[_barrier_key(rec)] = float(ts)
         elif name == "bsp_sync" and "step_id" in rec:
             sync[("bsp_sync", int(rec["step_id"]))] = float(ts)
+        if name == "serving.request" and "request_id" in rec:
+            serving.append(rec)
+        elif name == "serving.step_timeline":
+            serving_steps.append(rec)
         if name in ANNOTATION_EVENTS:
             annotations.append(rec)
     return {"path": path, "kind": "jsonl", "rank": rank, "events": [],
-            "sync": sync, "annotations": annotations}
+            "sync": sync, "annotations": annotations, "serving": serving,
+            "serving_steps": serving_steps}
 
 
 # ---------------------------------------------------------------------------
@@ -195,18 +213,134 @@ def estimate_offsets(inputs):
 # ---------------------------------------------------------------------------
 
 _CLUSTER_PID = 1 << 20  # lane for rank-less annotation sources
+_SERVING_PID_BASE = 1 << 21  # per-request serving lanes start here
 
 
-def merge(inputs, offsets=None):
+def request_segments(events):
+    """Phase segments for ONE request from its ``serving.request``
+    lifecycle events: ``[(phase, start_s, end_s)]``, contiguous and
+    non-overlapping. ``end_s`` is None for a phase still open at the end
+    of the stream (request in flight when the sink closed). The walker
+    mirrors serving/obs.py's clock: readmission after a preemption stays
+    on the replay clock until the replay prefill lands (``replayed``)."""
+    segs = []
+    cur = None   # (phase, start_s)
+    for rec in sorted(events, key=lambda r: float(r["ts"])):
+        state = rec.get("state")
+        ts = float(rec["ts"])
+        if state == "submitted":
+            cur = ("queue_wait", ts)
+            continue
+        if state == "readmitted":
+            continue   # replay continues through the re-prefill
+        if cur is not None and state in ("admitted", "decoding", "replayed",
+                                         "preempted", "finished", "failed"):
+            segs.append((cur[0], cur[1], ts))
+        if state == "admitted":
+            cur = ("prefill", ts)
+        elif state in ("decoding", "replayed"):
+            cur = ("decode", ts)
+        elif state == "preempted":
+            cur = ("replay", ts)
+        elif state in ("finished", "failed"):
+            cur = None
+    if cur is not None:
+        segs.append((cur[0], cur[1], None))
+    return segs
+
+
+def _serving_lane_events(inp, off_us, pid_alloc):
+    """One chrome-trace lane per request (phase spans + preemption
+    instants) plus one counter lane per engine (occupancy / queue /
+    KV-pool time series) from an input's serving telemetry events."""
+    out = []
+    meta = []
+    by_req = {}
+    for rec in inp["serving"]:
+        key = (str(rec.get("engine", "")), str(rec["request_id"]))
+        by_req.setdefault(key, []).append(rec)
+    # lane order = first-submission order, so the trace reads top-down in
+    # arrival order
+    for key in sorted(by_req, key=lambda k: float(by_req[k][0]["ts"])):
+        engine, request_id = key
+        pid = pid_alloc(("request",) + key)
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": "req %s" % request_id}})
+        events = by_req[key]
+        end_default = max(float(r["ts"]) for r in events)
+        terminal = next((r for r in events
+                         if r.get("state") in ("finished", "failed")), None)
+        spans = [{
+            "name": phase, "cat": "serving", "ph": "X",
+            "ts": start * 1e6 + off_us,
+            "dur": ((end if end is not None else end_default) - start) * 1e6,
+            "pid": pid, "tid": 0,
+            "args": {"request_id": request_id, "engine": engine},
+        } for phase, start, end in request_segments(events)]
+        if spans and terminal is not None and "phases" in terminal:
+            # the terminal record's exact attribution rides on the lane's
+            # closing span args (hover in Perfetto for the breakdown)
+            spans[-1]["args"]["phases"] = terminal["phases"]
+        out.extend(spans)
+        for rec in events:
+            if rec.get("state") == "preempted":
+                out.append({
+                    "name": "preempted", "cat": "serving", "ph": "i",
+                    "s": "t", "ts": float(rec["ts"]) * 1e6 + off_us,
+                    "pid": pid, "tid": 0,
+                    "args": {"request_id": request_id,
+                             "preemptions": rec.get("preemptions")},
+                })
+    by_engine = {}
+    for rec in inp["serving_steps"]:
+        by_engine.setdefault(str(rec.get("engine", "")), []).append(rec)
+    for engine in sorted(by_engine):
+        pid = pid_alloc(("engine", engine))
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0,
+                     "args": {"name": "serving engine %s" % engine}})
+        for rec in sorted(by_engine[engine], key=lambda r: float(r["ts"])):
+            out.append({
+                "name": "serving.occupancy", "cat": "serving", "ph": "C",
+                "ts": float(rec["ts"]) * 1e6 + off_us, "pid": pid, "tid": 0,
+                "args": {"occupancy": rec.get("occupancy", 0),
+                         "queue": rec.get("queue", 0),
+                         "kv_used": rec.get("kv_used", 0),
+                         "kv_frag_slots": rec.get("kv_frag_slots", 0)},
+            })
+    return meta, out
+
+
+def merge(inputs, offsets=None, serving_lanes=False):
     """One chrome trace from N per-worker inputs: pid = rank (one lane per
     rank; multiple files of one rank — e.g. a killed incarnation's jsonl
     plus its replacement's — share the lane on distinct tids), spans
-    shifted by each file's clock offset, annotations as instant events."""
+    shifted by each file's clock offset, annotations as instant events.
+
+    ``serving_lanes=True`` additionally renders the serving telemetry a
+    file carries as one lane per request (phase spans: queue_wait /
+    prefill / decode / replay, preemption instants) plus a per-engine
+    occupancy counter lane — the chrome-trace view of
+    ``tools/serving_report.py``."""
     offsets = offsets if offsets is not None else estimate_offsets(inputs)
     merged = []
     lanes = set()
+    serving_meta = []
+    _serving_pids = {}
+
+    def _pid_alloc(key):
+        # one lane per (request|engine) identity, shared across input
+        # files that carry events for the same request
+        if key not in _serving_pids:
+            _serving_pids[key] = _SERVING_PID_BASE + len(_serving_pids)
+        return _serving_pids[key]
+
     for idx, inp in enumerate(inputs):
         off_us = offsets[inp["path"]]["offset_s"] * 1e6
+        if serving_lanes and (inp.get("serving") or inp.get("serving_steps")):
+            s_meta, s_events = _serving_lane_events(inp, off_us, _pid_alloc)
+            serving_meta.extend(s_meta)
+            merged.extend(s_events)
         rank = inp["rank"]
         pid = rank if rank is not None else _CLUSTER_PID
         lanes.add(pid)
@@ -246,6 +380,7 @@ def merge(inputs, offsets=None):
             "args": {"name": ("cluster" if pid == _CLUSTER_PID
                               else "rank %d" % pid)},
         })
+    meta.extend(serving_meta)
     merged.sort(key=lambda e: (e.get("pid", 0), e.get("tid", 0),
                                e.get("ts", 0)))
     return {
@@ -325,10 +460,24 @@ def validate_trace(trace, _eps_us=0.5):
 
 
 def lane_pids(trace):
-    """The worker-lane pids of a merged trace (annotation lane excluded)."""
+    """The worker-lane pids of a merged trace (annotation + serving lanes
+    excluded)."""
     return sorted({ev["pid"] for ev in trace.get("traceEvents", [])
                    if isinstance(ev.get("pid"), int)
-                   and ev["pid"] != _CLUSTER_PID})
+                   and ev["pid"] < _CLUSTER_PID})
+
+
+def serving_request_lanes(trace):
+    """The per-request serving lanes of a merged trace:
+    ``{pid: request_label}`` for every ``req <request_id>`` lane (the
+    per-engine occupancy counter lanes are excluded)."""
+    return {ev["pid"]: ev["args"]["name"]
+            for ev in trace.get("traceEvents", [])
+            if ev.get("ph") == "M" and ev.get("name") == "process_name"
+            and isinstance(ev.get("pid"), int)
+            and ev["pid"] >= _SERVING_PID_BASE
+            and str((ev.get("args") or {}).get("name", "")
+                    ).startswith("req ")}
 
 
 # ---------------------------------------------------------------------------
@@ -357,6 +506,10 @@ def main(argv=None):
     ap.add_argument("-o", "--out", default="merged_trace.json")
     ap.add_argument("--validate", action="store_true",
                     help="schema-check the merged trace and fail on problems")
+    ap.add_argument("--serving-lanes", action="store_true",
+                    help="render serving telemetry as one lane per request "
+                         "(lifecycle phase spans + preemption instants) "
+                         "plus a per-engine occupancy counter lane")
     args = ap.parse_args(argv)
     inputs = []
     for path in _expand_paths(args.inputs):
@@ -369,7 +522,7 @@ def main(argv=None):
         print("trace_merge: no readable inputs", file=sys.stderr)
         return 2
     offsets = estimate_offsets(inputs)
-    trace = merge(inputs, offsets)
+    trace = merge(inputs, offsets, serving_lanes=args.serving_lanes)
     with open(args.out, "w") as f:
         json.dump(trace, f)
     for inp in inputs:
@@ -381,8 +534,11 @@ def main(argv=None):
                  ("%.6fs" % o["residual_s"]) if o["residual_s"] is not None
                  else "n/a",
                  o["sync_points"]))
-    print("trace_merge: %d lanes -> %s"
-          % (len(lane_pids(trace)), args.out))
+    suffix = ""
+    if args.serving_lanes:
+        suffix = " (+%d request lanes)" % len(serving_request_lanes(trace))
+    print("trace_merge: %d lanes%s -> %s"
+          % (len(lane_pids(trace)), suffix, args.out))
     if args.validate:
         problems = validate_trace(trace)
         if problems:
