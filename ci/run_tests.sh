@@ -210,15 +210,22 @@ run_serving() {
   # compile-flat-after-warmup gate — plus the observability plane
   # (tests_tpu/test_serving_obs.py): phase-clock attribution closure,
   # two-engine stats isolation, SLO burn edge, and the serve.py HTTP
-  # schemas. The slow cases (>=32 concurrent variable-length HTTP
+  # schemas — plus the prefix-sharing KV reuse plane
+  # (tests_tpu/test_serving_prefix.py): refcount/COW invariants,
+  # eviction-gain victim picking, sharing bit-identity — and the
+  # speculative-decoding plane (tests_tpu/test_serving_spec.py):
+  # multi-query verify numerics and the greedy-acceptance bit-identity
+  # contract. The slow cases (>=32 concurrent variable-length HTTP
   # streams through tools/serve.py, outputs bit-identical to sequential
-  # decoding; the waterfall-attribution e2e) run only when this stage is
-  # invoked directly, like `elastic`.
+  # decoding, with and without spec+sharing; the waterfall-attribution
+  # e2e) run only when this stage is invoked directly, like `elastic`.
   JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_serving.py \
-    tests_tpu/test_serving_obs.py -q -m "not slow"
+    tests_tpu/test_serving_obs.py tests_tpu/test_serving_prefix.py \
+    tests_tpu/test_serving_spec.py -q -m "not slow"
   if [ "${1:-}" = "with_slow" ]; then
     JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_serving.py \
-      tests_tpu/test_serving_obs.py -q -m slow
+      tests_tpu/test_serving_obs.py tests_tpu/test_serving_prefix.py \
+      tests_tpu/test_serving_spec.py -q -m slow
   fi
 }
 

@@ -12,6 +12,18 @@ attention math lowers to ring attention over an sp axis (parallel/ring.py).
 from .. import symbol as sym
 from ..initializer import Normal, One, Zero
 
+#: Tiny zoo shapes for speculative-decoding DRAFT models
+#: (``MXNET_SERVING_DRAFT``, docs/serving.md §speculative-decoding). The
+#: draft proposes greedy tokens the serving target then verifies in one
+#: multi-query paged-attention pass; these presets trade acceptance rate
+#: for draft cost. vocab_size/max_len always follow the target config
+#: (``serving.model.draft_config``) — the draft must propose from the
+#: same vocabulary at the same absolute positions.
+SERVING_DRAFT_PRESETS = {
+    "tiny": dict(num_layers=1, model_dim=64, num_heads=2, ffn_dim=128),
+    "small": dict(num_layers=2, model_dim=128, num_heads=2, ffn_dim=256),
+}
+
 
 def _layer_norm(x, model_dim, name):
     # composed from reference-era primitives (no LayerNorm op in v0.10)

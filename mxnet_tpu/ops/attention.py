@@ -36,7 +36,8 @@ from jax import lax
 from .registry import Param, fp32_precision, register
 
 __all__ = ["flash_attention", "attention_reference", "paged_attention",
-           "paged_attention_reference"]
+           "paged_attention_reference", "paged_attention_multi",
+           "paged_attention_multi_reference"]
 
 _NEG_INF = -1e30
 
@@ -791,6 +792,159 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
         )
     return paged_attention_reference(q, k_pages, v_pages, block_tables,
                                      context_lens, sm_scale=sm_scale)
+
+
+# --------------------------------------------- paged multi-query (verify)
+def paged_attention_multi_reference(q, k_pages, v_pages, block_tables,
+                                    context_lens, sm_scale=None):
+    """Pure-XLA multi-query paged attention — q-length > 1 per sequence
+    with PER-LANE context lengths. The speculative-decoding verify pass
+    and the CPU/CI lowering of the Pallas kernel below.
+
+    Each sequence carries T query lanes (this step's speculative window);
+    lane t's K/V has already been scattered into the pool at its position,
+    so causality within the window reduces to per-lane masking: lane t may
+    only read pool positions < context_lens[b, t].
+
+    q:            (B, T, H, D)     — T query tokens per stream
+    k_pages:      (N, bs, H, D)    — the shared K pool
+    v_pages:      (N, bs, H, D)    — the shared V pool
+    block_tables: (B, nb) int32    — ONE table per sequence (lanes share it)
+    context_lens: (B, T) int32     — valid pool positions PER LANE
+                                     (monotone over t for a causal window)
+
+    Returns (B, T, H, D) in q.dtype. T == 1 with context_lens (B, 1)
+    reproduces :func:`paged_attention_reference` exactly. A lane with
+    context_len == 0 returns all zeros, like the single-query oracle.
+    """
+    sm_scale = _scale(sm_scale, q.shape[-1])
+    b, tq, h, d = q.shape
+    bs = k_pages.shape[1]
+    nb = block_tables.shape[1]
+    t = nb * bs
+    k = jnp.take(k_pages, block_tables, axis=0)  # (B, nb, bs, H, D)
+    v = jnp.take(v_pages, block_tables, axis=0)
+    k = k.reshape(b, t, h, d).astype(jnp.float32)
+    v = v.reshape(b, t, h, d).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k,
+                   precision=lax.Precision.HIGHEST) * sm_scale
+    valid = jnp.arange(t)[None, None, :] < context_lens[:, :, None]  # (B,T,K)
+    s = jnp.where(valid[:, None, :, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # all-masked lanes (context_len == 0) softmax to uniform and would
+    # average gathered garbage — pin them to zero like the 1-query oracle
+    p = jnp.where((context_lens > 0)[:, None, :, None], p, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                     precision=lax.Precision.HIGHEST)
+    return out.astype(q.dtype)
+
+
+def _paged_pallas_multi(q, k_pages, v_pages, block_tables, context_lens,
+                        sm_scale, interpret=False):
+    """Pallas TPU multi-query ragged-paged-attention kernel.
+
+    The decode kernel generalized to T query lanes per sequence: the same
+    (B, nb) grid and scalar-prefetch-steered K/V DMA, but the online-
+    softmax state (m, l, acc) carries a T axis and masking is per lane
+    (``context_lens`` is (B, T)). One extra row of VMEM scratch per lane —
+    still O(T·H·D), independent of pool size and sequence length. Blocks
+    wholly past the LONGEST lane's context skip compute (``pl.when``);
+    shorter lanes mask the tail of shared blocks with -1e30 like the
+    single-query kernel masks ragged block tails.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, h, d = q.shape
+    bs = k_pages.shape[1]
+    nb = block_tables.shape[1]
+
+    def kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+               m_ref, l_ref, acc_ref):
+        i = pl.program_id(0)  # sequence
+        j = pl.program_id(1)  # block-table slot (innermost)
+
+        @pl.when(j == 0)
+        def _init():
+            m_ref[:] = jnp.full((tq, h), _NEG_INF, jnp.float32)
+            l_ref[:] = jnp.zeros((tq, h), jnp.float32)
+            acc_ref[:] = jnp.zeros((tq, h, d), jnp.float32)
+
+        ctx = cl_ref[i]                       # (T,) per-lane context
+        ctx_max = jnp.max(ctx)
+
+        @pl.when(j * bs < ctx_max)  # ragged early-out past every lane
+        def _step():
+            qv = q_ref[0].astype(jnp.float32)   # (T, H, D)
+            kv = k_ref[0].astype(jnp.float32)   # (bs, H, D)
+            vv = v_ref[0].astype(jnp.float32)
+            s = jnp.einsum("thd,shd->tsh", qv, kv) * sm_scale  # (T, bs, H)
+            pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (tq, bs, h), 1)
+            s = jnp.where(pos < ctx[:, None, None], s, _NEG_INF)
+            m = m_ref[:]                                       # (T, H)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None, :])
+            scale = jnp.exp(m - m_new)
+            m_ref[:] = m_new
+            l_ref[:] = l_ref[:] * scale + jnp.sum(p, axis=1)
+            acc_ref[:] = (acc_ref[:] * scale[:, :, None]
+                          + jnp.einsum("tsh,shd->thd", p, vv))
+
+        @pl.when(j == nb - 1)
+        def _finish():
+            l = jnp.maximum(l_ref[:], 1e-30)
+            out = acc_ref[:] / l[:, :, None]
+            # a lane that never saw a valid position accumulated
+            # exp(-1e30 - -1e30) = 1 weights over garbage — pin it to the
+            # oracle's empty-lane zero
+            out = jnp.where((ctx > 0)[:, None, None], out, 0.0)
+            o_ref[0] = out.astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((1, tq, h, d), lambda i, j, bt, cl: (i, 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d), lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d), lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tq, h, d),
+                               lambda i, j, bt, cl: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tq, h), jnp.float32),
+            pltpu.VMEM((tq, h), jnp.float32),
+            pltpu.VMEM((tq, h, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, tq, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_attention_multi(q, k_pages, v_pages, block_tables, context_lens,
+                          sm_scale=None):
+    """Multi-query paged attention over a shared KV block pool: q is
+    (B, T, H, D), context_lens (B, T) per lane — the speculative-decoding
+    verify pass scores all T = k+1 window positions in this ONE dispatch.
+
+    Platform selected at LOWERING time like :func:`paged_attention`: the
+    Pallas kernel on TPU, the pure-XLA gather reference everywhere else.
+    Serving-only (no vjp).
+    """
+    sm_scale = _scale(sm_scale, q.shape[-1])
+    if _paged_shapes_ok(q, k_pages) and _tpu_in_process():
+        return lax.platform_dependent(
+            q, k_pages, v_pages, block_tables, context_lens,
+            tpu=functools.partial(_paged_pallas_multi, sm_scale=sm_scale),
+            default=functools.partial(paged_attention_multi_reference,
+                                      sm_scale=sm_scale),
+        )
+    return paged_attention_multi_reference(q, k_pages, v_pages, block_tables,
+                                           context_lens, sm_scale=sm_scale)
 
 
 @register(

@@ -30,7 +30,7 @@ from collections import deque
 import numpy as np
 
 from .. import compile_cache, compileobs, telemetry
-from ..base import env_int
+from ..base import env_bool, env_int, env_str
 from . import model as _model
 from .kv_cache import KVBlockPool
 from .obs import ServingObs
@@ -46,12 +46,14 @@ class ServingConfig(_model.ModelConfig):
     ``MXNET_SERVING_*`` environment (docs/env_var.md)."""
 
     __slots__ = ("block_size", "num_blocks", "max_batch",
-                 "prefills_per_step", "kv_dtype")
+                 "prefills_per_step", "kv_dtype", "prefix_cache",
+                 "spec_k", "draft")
 
     def __init__(self, vocab_size=32000, num_layers=4, model_dim=256,
                  num_heads=4, ffn_dim=1024, max_len=128,
                  block_size=None, num_blocks=None, max_batch=None,
-                 prefills_per_step=None, kv_dtype=np.float32):
+                 prefills_per_step=None, kv_dtype=np.float32,
+                 prefix_cache=None, spec_k=None, draft=None):
         super().__init__(vocab_size, num_layers, model_dim, num_heads,
                          ffn_dim, max_len)
         self.block_size = int(block_size if block_size is not None
@@ -64,6 +66,24 @@ class ServingConfig(_model.ModelConfig):
             prefills_per_step if prefills_per_step is not None
             else env_int("MXNET_SERVING_PREFILLS_PER_STEP", 4))
         self.kv_dtype = np.dtype(kv_dtype)
+        # prefix sharing (docs/serving.md §prefix-sharing): content-hash
+        # full prefill blocks so same-prefix admissions map cached blocks
+        # (refcounted, copy-on-write) instead of re-caching them
+        self.prefix_cache = bool(
+            prefix_cache if prefix_cache is not None
+            else env_bool("MXNET_SERVING_PREFIX_CACHE", True))
+        # speculative decoding (docs/serving.md §speculative-decoding):
+        # spec_k > 0 turns it on — a draft LM proposes spec_k tokens per
+        # step, the target scores all spec_k+1 window positions in one
+        # multi-query verify pass, greedy acceptance keeps the emitted
+        # stream bit-identical to target-only decoding
+        self.spec_k = int(spec_k if spec_k is not None
+                          else env_int("MXNET_SERVING_SPEC_K", 0))
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0 (0 disables speculative "
+                             "decoding)")
+        self.draft = str(draft if draft is not None
+                         else env_str("MXNET_SERVING_DRAFT", "self"))
         if self.max_len % self.block_size:
             raise ValueError(
                 "max_len (%d) must be a multiple of block_size (%d): "
@@ -112,9 +132,16 @@ class ServingEngine:
         self.pool = KVBlockPool(cfg.num_layers, cfg.num_blocks,
                                 cfg.block_size, cfg.num_heads,
                                 cfg.model_dim // cfg.num_heads,
-                                dtype=cfg.kv_dtype, device=device)
+                                dtype=cfg.kv_dtype, device=device,
+                                prefix_cache=cfg.prefix_cache)
+        # speculative decoding writes spec_k+1 window slots per step, so
+        # headroom lookahead covers the whole draft+verify window
+        self._spec = cfg.spec_k > 0
+        self.spec_k = cfg.spec_k
         self.scheduler = Scheduler(self.pool, max_batch=cfg.max_batch,
-                                   prefills_per_step=cfg.prefills_per_step)
+                                   prefills_per_step=cfg.prefills_per_step,
+                                   lookahead=cfg.spec_k + 1,
+                                   max_positions=cfg.max_len)
         self._nb_max = cfg.max_len // cfg.block_size
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
@@ -219,6 +246,106 @@ class ServingEngine:
             self._decode_jits[toks.shape[0]](params, toks, poss, tables,
                                              ctx, kp, vp)
 
+        # ---- speculative decoding: draft model + verify pass ----------
+        # two more compileobs program families riding the same nonce-free
+        # persistent-cache pattern: `serving.draft` (the proposal model's
+        # prefill + one-token decode over its own pages) and
+        # `serving.verify` (the target scoring all spec_k+1 window
+        # positions in ONE multi-query paged-attention pass). Fixed k per
+        # engine: the verify window is a static shape, so compile counts
+        # stay flat after bucket warmup — no per-k recompiles.
+        self._draft_params = None
+        self._draft_kp = self._draft_vp = None
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_draft_s = 0.0
+        self._spec_verify_s = 0.0
+        if self._spec:
+            dcfg = _model.draft_config(cfg, cfg.draft)
+            self.draft_config = dcfg
+            if dcfg.key() == cfg.key():
+                # self-drafting: the draft IS the target (shared device
+                # params) — proposals match the verify pass and
+                # acceptance sits near 1.0 (the test harness's mode)
+                self._draft_params = self.params
+            else:
+                self._draft_params = _model.as_device_params(
+                    _model.random_params(dcfg, seed=seed), dcfg,
+                    device=device)
+            import jax.numpy as jnp
+
+            dshape = (dcfg.num_layers, cfg.num_blocks, cfg.block_size,
+                      dcfg.num_heads, dcfg.model_dim // dcfg.num_heads)
+            dk = jnp.zeros(dshape, cfg.kv_dtype)
+            dv = jnp.zeros(dshape, cfg.kv_dtype)
+            if device is not None:
+                dk = jax.device_put(dk, device)
+                dv = jax.device_put(dv, device)
+            self._draft_kp, self._draft_vp = dk, dv
+
+            def _mk_draft_prefill():
+                def _dprefill(params, tokens, length, block_table,
+                              k_pages, v_pages):
+                    return _model.prefill(params, tokens, length,
+                                          block_table, k_pages, v_pages,
+                                          dcfg)
+                return _dprefill
+
+            def _mk_draft_decode():
+                def _ddecode(params, tokens, positions, block_tables,
+                             context_lens, k_pages, v_pages):
+                    return _model.decode(params, tokens, positions,
+                                         block_tables, context_lens,
+                                         k_pages, v_pages, dcfg)
+                return _ddecode
+
+            def _mk_verify():
+                def _verify(params, tokens, positions, block_tables,
+                            context_lens, k_pages, v_pages):
+                    return _model.extend(  # fwlint: disable=trace-impure — module-level verify-step function, not a container mutation
+                        params, tokens, positions, block_tables,
+                        context_lens, k_pages, v_pages, cfg)
+                return _verify
+
+            dkey_base = dcfg.key() + (cfg.block_size, cfg.num_blocks,
+                                      str(cfg.kv_dtype))
+            self._draft_prefill_jits = {
+                S: compileobs.jit(_mk_draft_prefill(), "serving.draft",
+                                  site=_SITE,
+                                  graph_key=gkey + ("draft.prefill", S),
+                                  aot=True,
+                                  cache_key=("serving.draft.prefill",)
+                                  + dkey_base + (S,), **donate)
+                for S in cfg.prefill_buckets()}
+            self._draft_decode_jits = {
+                B: compileobs.jit(_mk_draft_decode(), "serving.draft",
+                                  site=_SITE,
+                                  graph_key=gkey + ("draft.decode", B),
+                                  aot=True,
+                                  cache_key=("serving.draft.decode",)
+                                  + dkey_base + (B,), **decode_donate)
+                for B in cfg.decode_buckets()}
+            self._verify_jits = {
+                B: compileobs.jit(_mk_verify(), "serving.verify",
+                                  site=_SITE,
+                                  graph_key=gkey + ("verify", B, cfg.spec_k),
+                                  aot=True,
+                                  cache_key=("serving.verify",) + ckey_base
+                                  + (B, cfg.spec_k), **decode_donate)
+                for B in cfg.decode_buckets()}
+            self._draft_prefill_fn = \
+                lambda params, toks, L, table, kp, vp: \
+                self._draft_prefill_jits[toks.shape[1]](
+                    params, toks, L, table, kp, vp)
+            self._draft_decode_fn = \
+                lambda params, toks, poss, tables, ctx, kp, vp: \
+                self._draft_decode_jits[toks.shape[0]](
+                    params, toks, poss, tables, ctx, kp, vp)
+            self._verify_fn = \
+                lambda params, toks, poss, tables, ctx, kp, vp: \
+                self._verify_jits[toks.shape[0]](
+                    params, toks, poss, tables, ctx, kp, vp)
+
     # ------------------------------------------------------------------ API
     def submit(self, prompt, max_new_tokens, eos_id=None, request_id=None):
         """Enqueue a request; returns the :class:`Request` (its
@@ -289,7 +416,16 @@ class ServingEngine:
                     failed += self._drain_failed()
                 decodes = self.scheduler.decodable()
                 if decodes:
-                    self._run_decode(decodes)
+                    # copy-on-write safety net: a write slot backed by a
+                    # SHARED block gets a private bit-exact copy first
+                    # (structurally unreachable — prefix matches cover
+                    # only full replay blocks, writes land past them —
+                    # but the pool invariant must hold unconditionally)
+                    self._cow_guard(decodes)
+                    if self._spec:
+                        self._run_spec_decode(decodes)
+                    else:
+                        self._run_decode(decodes)
                 finished = [r for r in list(self.scheduler.running)
                             if r.finished()]
                 for req in finished:
@@ -380,6 +516,33 @@ class ServingEngine:
                     self.params, toks, poss, tables, ctx,
                     self.pool.k_pages, self.pool.v_pages)
                 self.pool.k_pages, self.pool.v_pages = kp, vp
+            if self._spec:
+                # spec adds three program families — warm them too or the
+                # first spec step pays draft + verify compile wall at once
+                for S in cfg.prefill_buckets():
+                    toks = np.zeros((1, S), np.int32)
+                    table = np.zeros(S // cfg.block_size, np.int32)
+                    _t, _l, dkp, dvp = self._draft_prefill_fn(
+                        self._draft_params, toks, np.int32(1), table,
+                        self._draft_kp, self._draft_vp)
+                    self._draft_kp, self._draft_vp = dkp, dvp
+                T = self.spec_k + 1
+                for B in cfg.decode_buckets():
+                    toks = np.zeros(B, np.int32)
+                    poss = np.zeros(B, np.int32)
+                    tables = np.zeros((B, self._nb_max), np.int32)
+                    ctx = np.ones(B, np.int32)
+                    _t, _l, dkp, dvp = self._draft_decode_fn(
+                        self._draft_params, toks, poss, tables, ctx,
+                        self._draft_kp, self._draft_vp)
+                    self._draft_kp, self._draft_vp = dkp, dvp
+                    toks2 = np.zeros((B, T), np.int32)
+                    poss2 = np.zeros((B, T), np.int32)
+                    ctx2 = np.ones((B, T), np.int32)
+                    _t, _l, kp, vp = self._verify_fn(
+                        self.params, toks2, poss2, tables, ctx2,
+                        self.pool.k_pages, self.pool.v_pages)
+                    self.pool.k_pages, self.pool.v_pages = kp, vp
 
     def generate(self, prompts, max_new_tokens, eos_id=None):
         """Convenience batch API: submit every prompt, drive steps until
@@ -440,23 +603,47 @@ class ServingEngine:
         toks = np.zeros((1, S), np.int32)
         toks[0, :L] = replay
         table = self._table_row(req, S // cfg.block_size)
+        # prefix sharing: blocks mapped from the index already hold this
+        # prefix's K/V — route their WRITE entries to the trash block so
+        # the scatter cannot touch a shared block (copy-on-write contract;
+        # the logits are untouched, the table only steers the scatter)
+        write_table = table
+        if req.shared_blocks:
+            write_table = table.copy()
+            write_table[:min(req.shared_blocks, len(write_table))] = 0
         # compile-tally delta around the dispatch: a bump means THIS call
         # sat behind a cold prefill bucket — that wall is the request's
         # compile_stall, not honest prefill time
         jit = self._prefill_jits[S]
         c0, s0 = jit.compile_totals()
+        s0 += self._draft_prefill_jits[S].compile_totals()[1] \
+            if self._spec else 0.0
         t0 = time.time()
         tok, _logits, kp, vp = self._prefill_fn(
-            self.params, toks, np.int32(L), table,
+            self.params, toks, np.int32(L), write_table,
             self.pool.k_pages, self.pool.v_pages)
         self.pool.k_pages, self.pool.v_pages = kp, vp
+        if self._spec:
+            # the draft caches the same replay through the same write
+            # table into its OWN pages (its K/V never mixes with the
+            # target's); shared blocks were draft-cached by the prefix's
+            # original prefill, same as the target pages
+            _dt, _dl, dkp, dvp = self._draft_prefill_fn(
+                self._draft_params, toks, np.int32(L), write_table,
+                self._draft_kp, self._draft_vp)
+            self._draft_kp, self._draft_vp = dkp, dvp
         # the per-step token egress: serving's output IS this transfer
         tok = int(np.asarray(tok)[0])  # fwlint: disable=device-escape — token egress to the client is the product, one scalar per prefill
         wall = time.time() - t0
         c1, s1 = jit.compile_totals()
-        stall = min(s1 - s0, wall) if c1 > c0 else 0.0
+        s1 += self._draft_prefill_jits[S].compile_totals()[1] \
+            if self._spec else 0.0
+        stall = min(s1 - s0, wall) if c1 > c0 or s1 > s0 else 0.0
         telemetry.histogram("serving.prefill_seconds").observe(wall)
         telemetry.counter("serving.prefill_tokens").inc(L)
+        # register this prefix's full blocks for later admissions (first
+        # writer wins; the blocks it itself mapped shared are already in)
+        self.pool.prefix_insert(replay, req.blocks)
         was_replay = req.pending_token is not None
         req.context_len = L
         req.state = DECODING
@@ -498,6 +685,136 @@ class ServingEngine:
         for i, req in enumerate(reqs):
             req.context_len += 1
             self._note_token(req, int(nxt[i]))
+
+    def _cow_guard(self, reqs):
+        """Give every write slot this step will touch a PRIVATE block.
+
+        Structurally unreachable with the current admission flow — a
+        prefix match covers only FULL blocks of the replay (n <= L//bs)
+        and every decode/spec write lands at a position >= L, i.e. in a
+        later, privately-allocated block — but the pool's copy-on-write
+        contract must hold unconditionally (a future scheduler change
+        must fail a unit test, not corrupt a neighbour's cache)."""
+        bs = self.config.block_size
+        k = self.spec_k if self._spec else 0
+        for req in reqs:
+            first = req.context_len // bs
+            last = min(req.context_len + k, self.config.max_len - 1) // bs
+            for idx in range(first, min(last, len(req.blocks) - 1) + 1):
+                b = req.blocks[idx]
+                if self.pool.refcount(b) > 1:
+                    nb = self.pool.cow(b)
+                    if nb != b:
+                        if self._draft_kp is not None:
+                            # draft pages share the block table, so the
+                            # draft copy rides the same COW decision
+                            self._draft_kp = self._draft_kp.at[:, nb].set(
+                                self._draft_kp[:, b])
+                            self._draft_vp = self._draft_vp.at[:, nb].set(
+                                self._draft_vp[:, b])
+                        req.blocks[idx] = nb
+
+    def _run_spec_decode(self, reqs):
+        """Speculative decode: the draft proposes ``spec_k`` greedy
+        tokens (one-token steps over its OWN pages, same block tables),
+        then the target scores all ``spec_k+1`` window positions in ONE
+        multi-query paged-attention pass and greedy acceptance emits the
+        TARGET's tokens — the output stream is bit-identical to
+        target-only decoding no matter what the draft proposed.
+
+        The draft runs k+1 inner steps: steps 0..k-1 yield proposals,
+        the last is cache-fill only — with all k proposals accepted the
+        next step starts at position ctx+k+1, and the draft's attention
+        there needs its K/V at ctx+k (which no proposal step wrote)."""
+        cfg = self.config
+        k = self.spec_k
+        B = _bucket_for(len(reqs), cfg.decode_buckets())
+        n = len(reqs)
+        nb = self._nb_max
+        base_ctx = [r.context_len for r in reqs]
+        tables = np.zeros((B, nb), np.int32)
+        for i, req in enumerate(reqs):
+            tables[i] = self._table_row(req, nb)
+        proposals = [[] for _ in range(n)]
+        cur = np.zeros(B, np.int32)
+        for i, req in enumerate(reqs):
+            cur[i] = req.pending_token
+        djit = self._draft_decode_jits[B]
+        c0, s0 = djit.compile_totals()
+        t0 = time.time()
+        for j in range(k + 1):
+            toks = cur.copy()
+            poss = np.zeros(B, np.int32)
+            ctx = np.ones(B, np.int32)
+            for i in range(n):
+                poss[i] = base_ctx[i] + j
+                ctx[i] = base_ctx[i] + j + 1
+            dnxt, _dl, dkp, dvp = self._draft_decode_fn(
+                self._draft_params, toks, poss, tables, ctx,
+                self._draft_kp, self._draft_vp)
+            self._draft_kp, self._draft_vp = dkp, dvp
+            if j < k:
+                # the proposal steers the NEXT inner step's input token —
+                # an unavoidable per-draft-step sync, B int32s
+                dnxt = np.asarray(dnxt)  # fwlint: disable=device-escape — draft proposals feed the next inner draft step, B int32s per step
+                for i in range(n):
+                    proposals[i].append(int(dnxt[i]))
+                    cur[i] = dnxt[i]
+        draft_wall = time.time() - t0
+        c1, s1 = djit.compile_totals()
+        draft_stall = min(s1 - s0, draft_wall) if c1 > c0 else 0.0
+        # verify: the target scores position ctx+j for j in 0..k in one
+        # extend() pass — lane j consumes [pending, d_1..d_k][j] and its
+        # greedy argmax is the token the stream emits if lane j is reached
+        T = k + 1
+        toks2 = np.zeros((B, T), np.int32)
+        poss2 = np.zeros((B, T), np.int32)
+        ctx2 = np.ones((B, T), np.int32)
+        for i, req in enumerate(reqs):
+            toks2[i, 0] = req.pending_token
+            for j in range(k):
+                toks2[i, j + 1] = proposals[i][j]
+            for j in range(T):
+                poss2[i, j] = base_ctx[i] + j
+                ctx2[i, j] = base_ctx[i] + j + 1
+        vjit = self._verify_jits[B]
+        c0, s0 = vjit.compile_totals()
+        t0 = time.time()
+        nxt2, _logits, kp, vp = self._verify_fn(
+            self.params, toks2, poss2, tables, ctx2,
+            self.pool.k_pages, self.pool.v_pages)
+        self.pool.k_pages, self.pool.v_pages = kp, vp
+        nxt2 = np.asarray(nxt2)  # fwlint: disable=device-escape — token egress to clients is the product, B×(k+1) int32s per step
+        verify_wall = time.time() - t0
+        c1, s1 = vjit.compile_totals()
+        verify_stall = min(s1 - s0, verify_wall) if c1 > c0 else 0.0
+        if draft_stall or verify_stall:
+            self.obs.decode_stall(reqs, draft_stall + verify_stall)
+        # greedy acceptance — emit the TARGET's token at every reached
+        # lane. Lane j+1 is reached only if the draft's proposal d_{j+1}
+        # MATCHED the target's lane-j output (the window's K/V past a
+        # mismatch encodes the draft's wrong token, so stop there; the
+        # stale writes are overwritten by the next step's lane 0).
+        proposed = accepted = 0
+        for i, req in enumerate(reqs):
+            proposed += k
+            for j in range(T):
+                tok = int(nxt2[i, j])
+                if tok < 0:
+                    break   # overflow-poisoned lane (past max_len)
+                req.context_len += 1
+                self._note_token(req, tok)
+                if req.state != DECODING or j >= k \
+                        or proposals[i][j] != tok:
+                    break
+                accepted += 1
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        self._spec_draft_s += draft_wall
+        self._spec_verify_s += verify_wall
+        telemetry.histogram("serving.decode_batch").observe(len(reqs))
+        self.obs.spec_step(reqs, draft_wall - draft_stall,
+                           verify_wall - verify_stall, proposed, accepted)
 
     def _note_token(self, req, tok):
         now = time.time()
@@ -573,6 +890,19 @@ class ServingEngine:
                 "preemptions": self.scheduler.preempt_count,
                 "completed": self._n_completed,
                 "failed": self._n_failed,
+                "prefix": self.pool.prefix_stats(),
+                "spec": {
+                    "enabled": self._spec,
+                    "k": self.spec_k,
+                    "draft": self.config.draft if self._spec else None,
+                    "proposed_tokens": self._spec_proposed,
+                    "accepted_tokens": self._spec_accepted,
+                    "acceptance_rate":
+                        (self._spec_accepted / self._spec_proposed)
+                        if self._spec_proposed else 0.0,
+                    "draft_seconds": round(self._spec_draft_s, 6),
+                    "verify_seconds": round(self._spec_verify_s, 6),
+                },
                 "slo": self.obs.slo_snapshot(),
                 "phases": self.obs.phase_snapshot(),
                 "compiles": {n: {"count": p["compile_count"],

@@ -26,7 +26,8 @@ as ``_contrib_CachedMultiHeadAttention``.
 """
 import numpy as np
 
-from ..ops.attention import flash_attention, paged_attention
+from ..ops.attention import (flash_attention, paged_attention,
+                             paged_attention_multi)
 from ..ops.registry import fp32_precision
 
 #: parameter init scale matching models/transformer_lm.py's Normal(0.02)
@@ -149,6 +150,30 @@ def as_device_params(arg_params, cfg, dtype=None, device=None):
 # ---------------------------------------------------------------------------
 # functional blocks (numerics mirror models/transformer_lm.py op for op)
 # ---------------------------------------------------------------------------
+
+
+def draft_config(cfg, spec):
+    """Resolve a draft-model selection (``MXNET_SERVING_DRAFT``) against a
+    target config. ``"self"`` is the self-drafting harness — the draft IS
+    the target shape (the engine then shares the target's weights, so
+    greedy proposals match the verify pass and acceptance sits near 1.0);
+    any other name must be a ``models/transformer_lm.py``
+    ``SERVING_DRAFT_PRESETS`` entry (a tiny zoo shape). vocab_size and
+    max_len always follow the target: the draft proposes tokens from the
+    same vocabulary at the same absolute positions."""
+    from ..models.transformer_lm import SERVING_DRAFT_PRESETS
+
+    if spec == "self":
+        return ModelConfig(cfg.vocab_size, cfg.num_layers, cfg.model_dim,
+                           cfg.num_heads, cfg.ffn_dim, cfg.max_len)
+    if spec not in SERVING_DRAFT_PRESETS:
+        raise ValueError(
+            "unknown draft model %r: expected 'self' or one of %s "
+            "(models/transformer_lm.py SERVING_DRAFT_PRESETS)"
+            % (spec, sorted(SERVING_DRAFT_PRESETS)))
+    p = SERVING_DRAFT_PRESETS[spec]
+    return ModelConfig(cfg.vocab_size, p["num_layers"], p["model_dim"],
+                       p["num_heads"], p["ffn_dim"], cfg.max_len)
 
 
 def _layer_norm(x, gamma, beta):
@@ -300,5 +325,85 @@ def decode(params, tokens, positions, block_tables, context_lens,
     # overflow contract: poison the overflowed lanes, loudly
     next_tokens = jnp.where(in_range, next_tokens, -1)
     logits = jnp.where(in_range[:, None], logits,
+                       jnp.asarray(np.nan, logits.dtype))
+    return next_tokens, logits, k_pages, v_pages
+
+
+def extend(params, tokens, positions, block_tables, context_lens,
+           k_pages, v_pages, cfg):
+    """The speculative-decoding VERIFY step: :func:`decode` generalized to
+    T tokens per stream, scored in ONE multi-query paged-attention pass.
+
+    tokens:       (B, T) int32 — lane 0 is the stream's pending token,
+                  lanes 1..T-1 the draft's proposals
+    positions:    (B, T) int32 — each lane's write slot (consecutive:
+                  context_len + lane for live rows)
+    block_tables: (B, max_len // block_size) int32 — ONE table per stream
+                  (the window's lanes share the stream's blocks)
+    context_lens: (B, T) int32 — valid tokens PER LANE after this step's
+                  writes (positions + 1 for live lanes) — per-lane
+                  masking is what makes the window causal
+    k/v_pages:    pool pages (donated)
+
+    Returns ``(next_tokens (B, T), logits (B, T, V), k_pages, v_pages)``:
+    lane t's output is the target model's greedy next token given the
+    stream's context plus window lanes 0..t — exactly what :func:`decode`
+    would have produced had the window been fed one token at a time, so
+    greedy acceptance of matching draft proposals emits a token stream
+    bit-identical to target-only decoding. Out-of-range lanes
+    (position >= max_len) honor the overflow contract per lane: write
+    routed to the trash block, token -1, logits NaN.
+    """
+    import jax.numpy as jnp
+
+    B, T = tokens.shape
+    m, hh = cfg.model_dim, cfg.num_heads
+    hd = m // hh
+    bs = k_pages.shape[2]
+    prec = fp32_precision(k_pages.dtype)
+
+    in_range = positions < cfg.max_len                          # (B, T)
+    safe_pos = jnp.minimum(positions, cfg.max_len - 1)
+    page_ids = jnp.take_along_axis(block_tables, safe_pos // bs, axis=1)
+    page_ids = jnp.where(in_range, page_ids, 0)  # overflow -> trash block
+    slots = jnp.where(in_range, safe_pos % bs, 0)
+
+    pos_tab = params["pos_embed_weight"].reshape(cfg.max_len, m)
+    x = (jnp.take(params["embed_weight"], tokens, axis=0)
+         + jnp.take(pos_tab, safe_pos, axis=0))                 # (B, T, M)
+
+    for i in range(cfg.num_layers):
+        p = "layer%d" % i
+        h = _layer_norm(x, params[p + "_ln1_gamma"], params[p + "_ln1_beta"])
+        qkv = jnp.einsum("btm,nm->btn", h, params[p + "_attn_in_weight"],
+                         precision=prec)
+        q, k_new, v_new = jnp.split(qkv, 3, axis=-1)            # (B, T, M)
+        q = q.reshape(B, T, hh, hd)
+        k_new = k_new.reshape(B, T, hh, hd)
+        v_new = v_new.reshape(B, T, hh, hd)
+        # window lanes write their K/V first (distinct slots per lane;
+        # overflow lanes pile into trash), then every lane reads back
+        # under its OWN context length — lane t cannot see lanes > t
+        k_pages = k_pages.at[i, page_ids, slots].set(
+            k_new.astype(k_pages.dtype))
+        v_pages = v_pages.at[i, page_ids, slots].set(
+            v_new.astype(v_pages.dtype))
+        attn = paged_attention_multi(q, k_pages[i], v_pages[i],
+                                     block_tables, context_lens)
+        attn = attn.reshape(B, T, m)
+        attn = jnp.einsum("btm,nm->btn", attn,
+                          params[p + "_attn_out_weight"], precision=prec)
+        x = x + attn
+        h = _layer_norm(x, params[p + "_ln2_gamma"], params[p + "_ln2_beta"])
+        x = x + _ffn(h.reshape(B * T, m), params, p, prec).reshape(B, T, m)
+
+    x = _layer_norm(x, params["final_ln_gamma"], params["final_ln_beta"])
+    logits = (jnp.dot(x.reshape(B * T, m), params["lm_head_weight"].T,
+                      precision=prec)
+              + params["lm_head_bias"]).reshape(B, T, -1)       # (B, T, V)
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # overflow contract: poison the overflowed lanes, loudly
+    next_tokens = jnp.where(in_range, next_tokens, -1)
+    logits = jnp.where(in_range[:, :, None], logits,
                        jnp.asarray(np.nan, logits.dtype))
     return next_tokens, logits, k_pages, v_pages

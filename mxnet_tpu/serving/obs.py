@@ -89,7 +89,7 @@ class RequestTrace:
     lock, in timestamp order.
     """
 
-    __slots__ = ("phases", "cur", "t0", "stall_debit", "closed")
+    __slots__ = ("phases", "cur", "t0", "stall_debit", "closed", "sub")
 
     def __init__(self, t0):
         self.phases = dict.fromkeys(PHASES, 0.0)
@@ -97,6 +97,10 @@ class RequestTrace:
         self.t0 = float(t0)
         self.stall_debit = 0.0
         self.closed = False
+        # SUB-attribution inside the decode phase (speculative decoding's
+        # draft/verify split) — informational breakdown, NOT a phase:
+        # the five phases alone still sum exactly to end-to-end wall
+        self.sub = {"spec_draft": 0.0, "spec_verify": 0.0}
 
     def _settle(self, now):
         # stall_debit <= elapsed by construction (each stall is clipped to
@@ -213,6 +217,22 @@ class ServingObs:
             if req.trace is not None:
                 req.trace.add_stall(stall_s)
 
+    def spec_step(self, reqs, draft_s, verify_s, proposed, accepted):
+        """One speculative decode step landed: histogram the draft/verify
+        walls (stall already subtracted by the caller), count the
+        proposal/acceptance tokens, and sub-attribute each stream's share
+        of the step inside its decode phase (``trace.sub`` — the
+        waterfall's draft/verify split; never double-counted against the
+        phase sum, which only partitions over :data:`PHASES`)."""
+        telemetry.histogram("serving.spec_draft_seconds").observe(draft_s)
+        telemetry.histogram("serving.spec_verify_seconds").observe(verify_s)
+        telemetry.counter("serving.spec_proposed_tokens").inc(proposed)
+        telemetry.counter("serving.spec_accepted_tokens").inc(accepted)
+        for req in reqs:
+            if req.trace is not None:
+                req.trace.sub["spec_draft"] += draft_s
+                req.trace.sub["spec_verify"] += verify_s
+
     def request_preempted(self, req):
         """Blocks evicted, tokens-so-far requeued: everything until the
         replay prefill lands is overhead the preemption caused."""
@@ -249,6 +269,11 @@ class ServingObs:
                       state=state, e2e_s=round(e2e, 6), phases=phases,
                       tokens=len(req.generated),
                       preemptions=req.preemptions, **slo)
+        if tr.sub["spec_draft"] or tr.sub["spec_verify"]:
+            # decode-phase sub-split for serving_report.py's waterfall;
+            # NOT part of the phase-sum contract
+            fields["spec_draft_s"] = round(tr.sub["spec_draft"], 6)
+            fields["spec_verify_s"] = round(tr.sub["spec_verify"], 6)
         if failed:
             fields["error"] = req.error
         telemetry.event("serving.request", **fields)

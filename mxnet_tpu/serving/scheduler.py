@@ -46,10 +46,10 @@ class Request:
     """One generation request and its serving-side state."""
 
     __slots__ = ("rid", "request_id", "prompt", "max_new_tokens", "eos_id",
-                 "state", "blocks", "context_len", "generated",
-                 "pending_token", "arrival_t", "admitted_t", "first_token_t",
-                 "preempted_t", "finish_t", "preemptions", "error",
-                 "done_event", "trace")
+                 "state", "blocks", "shared_blocks", "context_len",
+                 "generated", "pending_token", "arrival_t", "admitted_t",
+                 "first_token_t", "preempted_t", "finish_t", "preemptions",
+                 "error", "done_event", "trace")
 
     def __init__(self, prompt, max_new_tokens, eos_id=None, rid=None,
                  request_id=None):
@@ -68,6 +68,10 @@ class Request:
         self.eos_id = None if eos_id is None else int(eos_id)
         self.state = WAITING
         self.blocks = []          # pool block ids, position order
+        self.shared_blocks = 0    # leading blocks mapped from the prefix
+                                  # index (refcounted, copy-on-write; the
+                                  # prefill write table routes them to
+                                  # trash — their K/V is already cached)
         self.context_len = 0      # tokens currently cached in the pool
         self.generated = []       # tokens produced so far (output stream)
         self.pending_token = None  # last generated token, not yet cached
@@ -121,10 +125,19 @@ class StepPlan:
 class Scheduler:
     """FCFS continuous-batching scheduler over one :class:`KVBlockPool`."""
 
-    def __init__(self, pool, max_batch=32, prefills_per_step=4):
+    def __init__(self, pool, max_batch=32, prefills_per_step=4,
+                 lookahead=1, max_positions=None):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.prefills_per_step = int(prefills_per_step)
+        # write slots a decoding stream consumes per engine step: 1 for
+        # plain decode, spec_k + 1 for speculative decoding (the draft +
+        # verify window writes positions context_len .. context_len+k)
+        self.lookahead = int(lookahead)
+        # position cap (cfg.max_len): write slots at/past it route to the
+        # trash block in-graph, so headroom past it is never allocated
+        self.max_positions = (None if max_positions is None
+                              else int(max_positions))
         self.waiting = deque()
         self.running = []          # admission order (oldest first)
         self.failed = []           # _fail victims awaiting engine drain
@@ -174,7 +187,12 @@ class Scheduler:
             # state check also skips members the loop snapshot still holds
             if req.state != DECODING or req.pending_token is None:
                 continue
-            need_idx = req.context_len // self.pool.block_size
+            last_pos = req.context_len + self.lookahead - 1
+            if self.max_positions is not None:
+                # slots at/past the cap route to trash in-graph; backing
+                # them with real blocks would waste pool for nothing
+                last_pos = min(last_pos, self.max_positions - 1)
+            need_idx = last_pos // self.pool.block_size
             while need_idx >= len(req.blocks):
                 try:
                     req.blocks.extend(self.pool.alloc(1))
@@ -182,7 +200,7 @@ class Scheduler:
                     # evict the YOUNGEST decoding stream — possibly req
                     # itself (a younger request never steals blocks from
                     # an older one: FCFS both ways)
-                    victim = self._pick_victim()
+                    victim = self._pick_victim(ensuring=req)
                     if victim is None or (victim is req
                                           and len(self.running) == 1):
                         # alone and still dry: the pool cannot hold this
@@ -198,20 +216,36 @@ class Scheduler:
                         break
         return preempted
 
-    def _pick_victim(self):
+    def _pick_victim(self, ensuring=None):
+        """Youngest decoding stream whose eviction actually reclaims
+        blocks. With refcounted prefix sharing the real reclaim gain is
+        the count of blocks whose refcount would drop to ZERO — a stream
+        holding only shared prefix blocks frees nothing, and preempting
+        it would burn a replay for zero reclaimed headroom.
+
+        Scanning stops at the stream being ensured: FCFS both ways means
+        a younger request never steals blocks from an older one, so when
+        every candidate at or after ``ensuring`` frees nothing the answer
+        is None (the ensured stream fails, it does not reach upstream)."""
         for req in reversed(self.running):   # youngest admission first
-            if req.state == DECODING:
+            if (req.state == DECODING
+                    and self.pool.reclaimable(req.blocks) > 0):
                 return req
+            if req is ensuring:
+                break
         return None
 
     def _preempt(self, req):
         """Recompute-style preemption: free the blocks, requeue at the
         HEAD of the waiting queue with tokens-so-far as the new replay
-        prompt (greedy decode makes the replay deterministic)."""
+        prompt (greedy decode makes the replay deterministic). Freeing
+        decrements refcounts: shared prefix blocks survive for their
+        other holders, only sole-owner blocks return to the pool."""
         self.running.remove(req)
         if req.blocks:
             self.pool.free(req.blocks)
             req.blocks = []
+        req.shared_blocks = 0
         req.context_len = 0
         req.state = WAITING
         req.preemptions += 1
@@ -226,6 +260,7 @@ class Scheduler:
         if req.blocks:
             self.pool.free(req.blocks)
             req.blocks = []
+        req.shared_blocks = 0
         req.state = FAILED
         req.error = msg
         req.finish_t = time.time()
@@ -262,10 +297,21 @@ class Scheduler:
                                 "holds %d usable"
                            % (need, self.pool.num_usable))
                 continue
-            if need > self.pool.available():
+            # prefix sharing: map the longest indexed block-aligned prefix
+            # into the table (refcounted), allocate only the tail. The
+            # match can never cover the first write slot — it spans full
+            # blocks of the replay only, so decode writes always land in
+            # this request's private tail blocks (COW stays a safety net,
+            # not a hot path).
+            shared = self.pool.prefix_match(replay)
+            fresh = need - len(shared)
+            if fresh > self.pool.available():
+                if shared:   # drop our references; other holders keep them
+                    self.pool.free(shared)
                 break
             self.waiting.popleft()
-            req.blocks = self.pool.alloc(need)
+            req.blocks = shared + self.pool.alloc(fresh)
+            req.shared_blocks = len(shared)
             req.state = PREFILL
             req.admitted_t = time.time()
             self.running.append(req)
@@ -289,6 +335,7 @@ class Scheduler:
         if req.blocks:
             self.pool.free(req.blocks)
             req.blocks = []
+        req.shared_blocks = 0
         self._refresh_gauges()
 
     def frag_slots(self):

@@ -770,6 +770,33 @@ METRIC_HELP = {
     "serving.goodput":
         "fraction of the last 32 finished requests meeting every "
         "applicable SLO target{engine}",
+    "serving.prefix_lookups":
+        "admissions probed against the prefix index "
+        "(MXNET_SERVING_PREFIX_CACHE)",
+    "serving.prefix_hits": "admissions that mapped >= 1 cached prefix block",
+    "serving.prefix_hit_blocks":
+        "KV blocks mapped from the prefix index instead of re-prefilled "
+        "(cumulative)",
+    "serving.prefix_shared_blocks":
+        "allocated KV blocks currently shared by >= 2 streams",
+    "serving.prefix_kv_bytes_saved":
+        "KV bytes deduplicated right now: sum over shared blocks of "
+        "(refcount-1) x block bytes",
+    "serving.prefix_cow_copies":
+        "copy-on-write block copies (a write slot backed by a shared "
+        "block got a private copy)",
+    "serving.spec_proposed_tokens":
+        "draft tokens proposed (spec_k per stream per speculative step, "
+        "MXNET_SERVING_SPEC_K)",
+    "serving.spec_accepted_tokens":
+        "draft proposals the target's verify pass accepted (emitted "
+        "tokens stay bit-identical to target-only decoding)",
+    "serving.spec_draft_seconds":
+        "draft-model wall per speculative decode step (stall-free; the "
+        "decode phase's draft sub-share)",
+    "serving.spec_verify_seconds":
+        "target multi-query verify wall per speculative decode step "
+        "(stall-free)",
 }
 
 

@@ -26,7 +26,8 @@ _HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
                     "test_analysis.py", "test_elastic.py",
                     "test_cluster_obs.py", "test_native_decode.py",
                     "test_compileobs.py", "test_serving.py",
-                    "test_serving_obs.py",
+                    "test_serving_obs.py", "test_serving_prefix.py",
+                    "test_serving_spec.py",
                     "test_kv_overlap.py", "test_graphpass.py",
                     "test_server_ha.py"}
 

@@ -17,7 +17,12 @@ variable-length prompts, and emits ONE JSON record (BENCH idiom):
   ``MXNET_SERVING_SLO_TPOT_MS`` targets, good/total per phase, goodput)
 * ``max_concurrent_streams`` — how many average-length streams the KV
   block pool can hold at the configured HBM budget (pool bytes), plus the
-  measured peak in-flight count
+  measured peak in-flight count; with ``--prefix-len``/``--share-groups``
+  (shared-prefix workload) each group's full prefix blocks are counted
+  ONCE — the prefix-sharing capacity headline
+* ``prefix_hit_blocks`` / ``kv_bytes_saved`` — prefill work and KV bytes
+  the prefix index deduplicated; ``spec_acceptance_rate`` and the
+  draft/verify wall split when ``--spec-k`` > 0
 * the compileobs summary: bucket-warmup compiles vs steady-state runs —
   a recompile sneaking into the timed window is visible in the record
 
@@ -55,6 +60,21 @@ def main(argv=None):
                     help="tokens generated per request")
     ap.add_argument("--prompt-min", type=int, default=1)
     ap.add_argument("--prompt-max", type=int, default=24)
+    ap.add_argument("--prefix-len", type=int, default=0,
+                    help="shared-prefix workload: each share group's "
+                         "prompts start with the same PREFIX_LEN tokens "
+                         "(block-aligned prefixes dedupe in the prefix "
+                         "index when MXNET_SERVING_PREFIX_CACHE is on)")
+    ap.add_argument("--share-groups", type=int, default=1,
+                    help="distinct shared prefixes across the workload "
+                         "(requests round-robin over the groups)")
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="speculative decoding: draft proposes K tokens "
+                         "per step (0 = off; MXNET_SERVING_SPEC_K)")
+    ap.add_argument("--draft", default=None,
+                    help="draft model: 'self' or a "
+                         "transformer_lm.SERVING_DRAFT_PRESETS name "
+                         "(MXNET_SERVING_DRAFT)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cache-dir", default=None,
                     help="persistent compile-cache directory (same as "
@@ -74,7 +94,8 @@ def main(argv=None):
         model_dim=args.model_dim, num_heads=args.num_heads,
         ffn_dim=args.ffn_dim, max_len=args.max_len,
         block_size=args.block_size, num_blocks=args.num_blocks,
-        max_batch=args.max_batch, kv_dtype=np.dtype(args.kv_dtype))
+        max_batch=args.max_batch, kv_dtype=np.dtype(args.kv_dtype),
+        spec_k=args.spec_k, draft=args.draft)
     engine = ServingEngine(cfg, seed=args.seed)
 
     rng = np.random.RandomState(args.seed)
@@ -87,10 +108,25 @@ def main(argv=None):
             "(--max-len %d bounds prompt+generation), below --prompt-min %d"
             % (args.max_new, max(cfg.max_len - args.max_new, 0),
                cfg.max_len, args.prompt_min))
-    prompts = [[int(t) for t in rng.randint(0, cfg.vocab_size,
-                                            rng.randint(args.prompt_min,
-                                                        pmax + 1))]
-               for _ in range(args.requests)]
+    if args.prefix_len < 0 or args.prefix_len + args.prompt_max \
+            > cfg.max_len - args.max_new:
+        ap.error("--prefix-len %d + --prompt-max %d + --max-new %d exceeds "
+                 "--max-len %d" % (args.prefix_len, args.prompt_max,
+                                   args.max_new, cfg.max_len))
+    if args.share_groups < 1:
+        ap.error("--share-groups must be >= 1")
+    # shared-prefix workload: request i carries group (i mod G)'s common
+    # prefix followed by a private variable-length tail — with the prefix
+    # cache on, every group's full prefix blocks are cached once and
+    # mapped by the other members
+    shared = [[int(t) for t in rng.randint(0, cfg.vocab_size,
+                                           args.prefix_len)]
+              for _ in range(args.share_groups)]
+    prompts = [shared[i % args.share_groups]
+               + [int(t) for t in rng.randint(0, cfg.vocab_size,
+                                              rng.randint(args.prompt_min,
+                                                          pmax + 1))]
+               for i in range(args.requests)]
 
     # warmup: compile EVERY shape bucket outside the timed window, without
     # submitting requests — the latency/TTFT histograms the record reads
@@ -115,6 +151,18 @@ def main(argv=None):
     pool = engine.pool
     avg_stream_tokens = (sum(len(p) for p in prompts) / len(prompts)
                          + args.max_new)
+    # capacity at this HBM budget: blocks bound the streams the pool can
+    # hold at once. With prefix sharing, each share group pays its full
+    # prefix blocks ONCE — every member stream holds only its private
+    # tail (plus the group's shared blocks, refcounted not duplicated)
+    stream_blocks = pool.blocks_for(int(np.ceil(avg_stream_tokens)))
+    shared_blocks_per_group = (args.prefix_len // pool.block_size
+                               if cfg.prefix_cache else 0)
+    private_blocks = max(stream_blocks - shared_blocks_per_group, 1)
+    group_cost = args.share_groups * shared_blocks_per_group
+    max_streams = int(max(pool.num_usable - group_cost, 0) // private_blocks)
+    prefix = pool.prefix_stats()
+    spec = engine.stats()["spec"]
     rec = {
         "metric": "serving_decode_tokens_per_sec",
         "value": round(gen_tokens / wall, 2),
@@ -137,14 +185,22 @@ def main(argv=None):
         "kv_pool_bytes": pool.nbytes(),
         "kv_blocks": pool.num_usable,
         "block_size": pool.block_size,
-        # capacity at this HBM budget: blocks bound the streams the pool
-        # can hold at once (avg prompt + full generation per stream;
-        # blocks_for truncates fractional tokens, so ceil first or the
-        # headline overstates capacity past every block boundary)
-        "max_concurrent_streams":
-            int(pool.num_usable
-                // pool.blocks_for(int(np.ceil(avg_stream_tokens)))),
+        "max_concurrent_streams": max_streams,
         "peak_inflight": peak_inflight,
+        # prefix-sharing gains (tentpole artifact: hit blocks are prefill
+        # work + KV bytes NOT spent; kv_bytes_saved is the live dedup)
+        "prefix_hit_blocks": prefix["hit_blocks"],
+        # cumulative: every hit block is one block of KV the pool never
+        # had to duplicate (the gauge flavour in prefix[] is the LIVE
+        # dedup, zero once the workload drains)
+        "kv_bytes_saved": prefix["hit_blocks"] * pool.block_nbytes(),
+        "prefix": prefix,
+        # speculative decoding: acceptance rate + the decode phase's
+        # draft/verify wall split
+        "spec_acceptance_rate": round(spec["acceptance_rate"], 4),
+        "spec_draft_s": spec["draft_seconds"],
+        "spec_verify_s": spec["verify_seconds"],
+        "spec": spec,
         "compile": compileobs.summary(include_recompiles=False),
         # the serving cold-start story per run: warmup wall-clock is up
         # top (warmup_s); this block says whether the buckets compiled

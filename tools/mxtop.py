@@ -228,6 +228,15 @@ def render(snaps, membership=None, straggler_factor=2.0, now=None):
     return "\n".join(lines)
 
 
+def _fmt_bytes(n):
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024.0 or unit == "GiB":
+            return "%.0f%s" % (n, unit) if unit == "B" \
+                else "%.1f%s" % (n, unit)
+        n /= 1024.0
+
+
 def render_serving(stats, now=None):
     """One serving-panel frame from a serve.py ``/stats`` snapshot
     (pure: unit-testable)."""
@@ -262,6 +271,29 @@ def render_serving(stats, now=None):
         % (slo.get("ttft_target_ms", "?"), pct(att.get("ttft")),
            slo.get("tpot_target_ms", "?"), pct(att.get("tpot")),
            pct(goodput), "  BURNING" if slo.get("burning") else ""))
+    prefix = stats.get("prefix") or {}
+    spec = stats.get("spec") or {}
+    if prefix.get("enabled") or spec.get("enabled"):
+        bits = []
+        if prefix.get("enabled"):
+            bits.append(
+                "prefix: hit %s (%d/%d lkups, %d blk) shared %d blk "
+                "saved %s"
+                % (pct(prefix.get("hit_rate", 0.0)
+                       if prefix.get("lookups") else None),
+                   prefix.get("hits", 0), prefix.get("lookups", 0),
+                   prefix.get("hit_blocks", 0),
+                   prefix.get("shared_blocks", 0),
+                   _fmt_bytes(prefix.get("kv_bytes_saved", 0))))
+        if spec.get("enabled"):
+            bits.append(
+                "spec k=%s/%s: accept %s (%d/%d)"
+                % (spec.get("k", "?"), spec.get("draft", "?"),
+                   pct(spec.get("acceptance_rate", 0.0)
+                       if spec.get("proposed_tokens") else None),
+                   spec.get("accepted_tokens", 0),
+                   spec.get("proposed_tokens", 0)))
+        lines.append("  " + " | ".join(bits))
     phases = stats.get("phases") or {}
     if phases:
         lines.append("  %-14s %10s %10s %10s"

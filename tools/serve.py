@@ -74,8 +74,15 @@ def _columns(stats):
 
     slo = stats.get("slo") or {}
     goodput = slo.get("goodput")
+    extra = ""
+    prefix = stats.get("prefix") or {}
+    if prefix.get("enabled") and prefix.get("lookups"):
+        extra += " | pfx %.0f%%" % (100.0 * prefix.get("hit_rate", 0.0))
+    spec = stats.get("spec") or {}
+    if spec.get("enabled"):
+        extra += " | acc %.0f%%" % (100.0 * spec.get("acceptance_rate", 0.0))
     return ("reqs %3d | act %3d wait %3d | kv %4d/%-4d frag %5d | "
-            "%6.1f tok/s | ttft %s/%s ms | lat %s/%s ms | slo %s | steps %d"
+            "%6.1f tok/s | ttft %s/%s ms | lat %s/%s ms | slo %s%s | steps %d"
             % (stats["active"] + stats["waiting"], stats["active"],
                stats["waiting"], stats["kv_blocks_used"],
                stats["kv_blocks_total"],
@@ -84,7 +91,7 @@ def _columns(stats):
                ms(stats["ttft_p99_s"]), ms(stats["latency_p50_s"]),
                ms(stats["latency_p99_s"]),
                "--" if goodput is None else "%.0f%%" % (goodput * 100.0),
-               stats["steps"]))
+               extra, stats["steps"]))
 
 
 def make_server(engine, host, port, driver=None):

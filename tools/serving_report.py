@@ -90,6 +90,13 @@ def summarize_requests(events):
             "phases": phases,
             "phase_sum_s": round(sum(phases.values()), 6),
             "tokens": terminal.get("tokens") if terminal else None,
+            # speculative decoding's draft/verify split INSIDE the decode
+            # phase (sub-attribution — not part of the phase sum, which
+            # stays an exact partition over PHASES)
+            "spec_draft_s": terminal.get("spec_draft_s", 0.0)
+            if terminal else 0.0,
+            "spec_verify_s": terminal.get("spec_verify_s", 0.0)
+            if terminal else 0.0,
             "preemptions": max([r.get("preemptions", 0) for r in evs]
                                or [0]),
             "slo_ttft_ok": terminal.get("slo_ttft_ok") if terminal else None,
@@ -149,6 +156,19 @@ def render(requests, steps, bar_width=32, file=sys.stdout):
                  ph["compile_stall"], s["preemptions"], _slo_cell(s),
                  s["tokens"] if s["tokens"] is not None else "--",
                  _bar(s, bar_width)))
+        spec = [s for s in requests
+                if s["spec_draft_s"] or s["spec_verify_s"]]
+        if spec:
+            w("\nspeculative decode split (inside the decode column; "
+              "other = decode - draft - verify):\n")
+            w("%-16s %9s %9s %9s %9s\n"
+              % ("request", "decode", "draft", "verify", "other"))
+            for s in spec:
+                dec = s["phases"]["decode"]
+                w("%-16s %9.3f %9.3f %9.3f %9.3f\n"
+                  % (s["request_id"], dec, s["spec_draft_s"],
+                     s["spec_verify_s"],
+                     dec - s["spec_draft_s"] - s["spec_verify_s"]))
         done = [s for s in requests if s["state"] == "finished"]
         judged = [s for s in done if s["slo_ttft_ok"] is not None]
         good = sum(1 for s in judged
