@@ -215,17 +215,27 @@ run_serving() {
   # eviction-gain victim picking, sharing bit-identity — and the
   # speculative-decoding plane (tests_tpu/test_serving_spec.py):
   # multi-query verify numerics and the greedy-acceptance bit-identity
-  # contract. The slow cases (>=32 concurrent variable-length HTTP
-  # streams through tools/serve.py, outputs bit-identical to sequential
-  # decoding, with and without spec+sharing; the waterfall-attribution
-  # e2e) run only when this stage is invoked directly, like `elastic`.
+  # contract — and the resilience plane
+  # (tests_tpu/test_serving_resilience.py): deadlines/cancellation
+  # freeing KV blocks (pool invariant), overload shed + Retry-After,
+  # supervised warm restart bit-identical to a fault-free oracle,
+  # permanent-failure classification, drain semantics, and the serving
+  # fault points (dispatch_error/kv_oom/slow_step). The slow cases
+  # (>=32 concurrent variable-length HTTP streams through
+  # tools/serve.py, outputs bit-identical to sequential decoding, with
+  # and without spec+sharing; the waterfall-attribution e2e; the chaos
+  # e2e — injected dispatch fault under concurrent HTTP load → warm
+  # supervised restart + SIGTERM drain exit 0) run only when this
+  # stage is invoked directly, like `elastic`.
   JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_serving.py \
     tests_tpu/test_serving_obs.py tests_tpu/test_serving_prefix.py \
-    tests_tpu/test_serving_spec.py -q -m "not slow"
+    tests_tpu/test_serving_spec.py tests_tpu/test_serving_resilience.py \
+    -q -m "not slow"
   if [ "${1:-}" = "with_slow" ]; then
     JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_serving.py \
       tests_tpu/test_serving_obs.py tests_tpu/test_serving_prefix.py \
-      tests_tpu/test_serving_spec.py -q -m slow
+      tests_tpu/test_serving_spec.py \
+      tests_tpu/test_serving_resilience.py -q -m slow
   fi
 }
 

@@ -40,6 +40,17 @@ recovery path is testable in a single process, byte-for-byte reproducibly:
   promote→reconfigure path (docs/distributed.md §server-HA). The optional
   ``server_id=N`` arg targets one server of a launched cluster; combine
   with ``after=K`` to die after K applied updates (mid-epoch).
+* ``dispatch_error`` — the serving engine's prefill/decode dispatch seam
+  (serving/engine.py): ``raise=1`` escapes the step, aborting the engine —
+  the supervisor-restart trigger for the serving chaos e2e
+  (docs/fault_tolerance.md §serving).
+* ``kv_oom`` — the KV block allocator (serving/kv_cache.py): a firing rule
+  synthesizes a classified ``KVCacheOOM`` (bumping the alloc-failure
+  counters) without actually draining the pool, exercising preemption and
+  admission-failure paths at any pool size.
+* ``slow_step`` — the serving engine step's entry (``delay_ms=N`` stalls
+  the whole step): trips request deadlines and SLO burn without faking
+  clocks.
 
 Faults are described by a spec string, either in ``MXNET_FAULT_SPEC`` (so a
 whole process tree — e.g. launched PS servers — inherits them) or pushed
@@ -73,8 +84,29 @@ from contextlib import contextmanager
 from . import telemetry
 from .base import MXNetError, env_str as _env_str
 
-__all__ = ["InjectedFault", "InjectedCrash", "hit", "inject", "reset",
-           "crash_after_bytes", "kill_worker", "kill_server"]
+__all__ = ["InjectedFault", "InjectedCrash", "POINTS", "hit", "inject",
+           "reset", "crash_after_bytes", "kill_worker", "kill_server"]
+
+#: Every registered injection point (the module docstring is the prose
+#: catalog; tests pin this list so a new seam cannot ship undocumented).
+#: A spec naming a point outside this list arms a rule nothing consults.
+POINTS = (
+    "checkpoint_write",
+    "checkpoint_between_files",
+    "kv_push",
+    "kv_pull",
+    "server_updater",
+    "nan",
+    "stall",
+    "bad_record",
+    "oom",
+    "kill_worker",
+    "kill_server",
+    # serving resilience seams (docs/fault_tolerance.md §serving)
+    "dispatch_error",
+    "kv_oom",
+    "slow_step",
+)
 
 
 class InjectedFault(MXNetError):

@@ -25,6 +25,12 @@ Layers:
   event stream keyed by ``request_id``, phase attribution (queue_wait /
   prefill / decode / replay / compile_stall summing to end-to-end),
   SLO accounting (``MXNET_SERVING_SLO_*``), the step occupancy timeline.
+* :mod:`.resilience` — failure-as-routine: classified load shedding
+  (:class:`ServingOverloadError` + Retry-After hints), per-request
+  deadlines/cancellation (TIMED_OUT/CANCELLED terminal states swept
+  every step), and :class:`EngineSupervisor` — abort → salvage →
+  backoff → rebuild warm from the compile cache → replay survivors
+  bit-identically. docs/serving.md §resilience.
 
 Front ends: ``tools/serve.py`` (HTTP/JSON standing server with live stat
 columns), ``tools/bench_serving.py`` (offline BENCH headline), and
@@ -34,7 +40,11 @@ from telemetry JSONL). See docs/serving.md.
 from .engine import ServingConfig, ServingEngine
 from .kv_cache import KVBlockPool, KVCacheOOM
 from .obs import PHASES, RequestTrace, ServingObs
-from .scheduler import Request, Scheduler
+from .resilience import EngineSupervisor, ServingOverloadError, retry_after_s
+from .scheduler import (CANCELLED, FAILED, FINISHED, TIMED_OUT, Request,
+                        Scheduler)
 
 __all__ = ["ServingConfig", "ServingEngine", "KVBlockPool", "KVCacheOOM",
-           "Request", "Scheduler", "ServingObs", "RequestTrace", "PHASES"]
+           "Request", "Scheduler", "ServingObs", "RequestTrace", "PHASES",
+           "EngineSupervisor", "ServingOverloadError", "retry_after_s",
+           "FINISHED", "FAILED", "TIMED_OUT", "CANCELLED"]

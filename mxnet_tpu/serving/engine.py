@@ -29,12 +29,14 @@ from collections import deque
 
 import numpy as np
 
-from .. import compile_cache, compileobs, telemetry
+from .. import compile_cache, compileobs, fault, telemetry
 from ..base import env_bool, env_int, env_str
 from . import model as _model
 from .kv_cache import KVBlockPool
 from .obs import ServingObs
-from .scheduler import DECODING, FAILED, FINISHED, Request, Scheduler
+from .resilience import ServingOverloadError, retry_after_s
+from .scheduler import (CANCELLED, DECODING, FAILED, FINISHED, TIMED_OUT,
+                        WAITING, Request, Scheduler)
 
 _SITE = "serving/engine.py"
 
@@ -47,13 +49,14 @@ class ServingConfig(_model.ModelConfig):
 
     __slots__ = ("block_size", "num_blocks", "max_batch",
                  "prefills_per_step", "kv_dtype", "prefix_cache",
-                 "spec_k", "draft")
+                 "spec_k", "draft", "max_queue", "default_timeout_ms")
 
     def __init__(self, vocab_size=32000, num_layers=4, model_dim=256,
                  num_heads=4, ffn_dim=1024, max_len=128,
                  block_size=None, num_blocks=None, max_batch=None,
                  prefills_per_step=None, kv_dtype=np.float32,
-                 prefix_cache=None, spec_k=None, draft=None):
+                 prefix_cache=None, spec_k=None, draft=None,
+                 max_queue=None, default_timeout_ms=None):
         super().__init__(vocab_size, num_layers, model_dim, num_heads,
                          ffn_dim, max_len)
         self.block_size = int(block_size if block_size is not None
@@ -84,6 +87,20 @@ class ServingConfig(_model.ModelConfig):
                              "decoding)")
         self.draft = str(draft if draft is not None
                          else env_str("MXNET_SERVING_DRAFT", "self"))
+        # resilience knobs (docs/serving.md §resilience): a bounded
+        # admission queue sheds load at submit instead of letting the
+        # WAITING deque grow without limit, and a default deadline bounds
+        # how long any request may live without the client asking
+        self.max_queue = int(max_queue if max_queue is not None
+                             else env_int("MXNET_SERVING_MAX_QUEUE", 0))
+        if self.max_queue < 0:
+            raise ValueError("max_queue must be >= 0 (0 = unbounded)")
+        self.default_timeout_ms = int(
+            default_timeout_ms if default_timeout_ms is not None
+            else env_int("MXNET_SERVING_DEFAULT_TIMEOUT_MS", 0))
+        if self.default_timeout_ms < 0:
+            raise ValueError("default_timeout_ms must be >= 0 (0 = no "
+                             "default deadline)")
         if self.max_len % self.block_size:
             raise ValueError(
                 "max_len (%d) must be a multiple of block_size (%d): "
@@ -154,12 +171,23 @@ class ServingEngine:
         # entries, and those waiters were already woken via done_event.
         self._finished = deque(maxlen=max(256, 8 * cfg.max_batch))
         self._aborted = None
+        self._draining = False
+        # supervisor contract (resilience.EngineSupervisor): when set,
+        # abort() parks still-salvageable inflight requests in _salvaged
+        # (blocks dropped, tokens-so-far kept as a replay prompt) instead
+        # of failing them, so a fresh engine can resubmit() them and —
+        # greedy decode — finish them bit-identical to an unfaulted run
+        self.salvage_on_abort = False
+        self._salvaged = []
         self._steps = 0
         # per-engine tallies: the registry counters with the same names
         # are process-global and would attribute a previous engine's
         # traffic to this one in stats()
         self._n_completed = 0
         self._n_failed = 0
+        self._n_timed_out = 0
+        self._n_cancelled = 0
+        self._n_shed = 0
         self._token_window = []   # one timestamp per token, for tokens/sec
         self._t_started = time.time()
         self._tokens_total = 0
@@ -347,14 +375,23 @@ class ServingEngine:
                     params, toks, poss, tables, ctx, kp, vp)
 
     # ------------------------------------------------------------------ API
-    def submit(self, prompt, max_new_tokens, eos_id=None, request_id=None):
+    def submit(self, prompt, max_new_tokens, eos_id=None, request_id=None,
+               timeout_s=None):
         """Enqueue a request; returns the :class:`Request` (its
         ``done_event`` is set when it finishes — block on it from serving
         threads, or drive :meth:`step` yourself). ``request_id`` is the
         wire identity threaded through every lifecycle event and trace
-        lane (auto-assigned from the rid when omitted)."""
+        lane (auto-assigned from the rid when omitted). ``timeout_s``
+        sets the request's deadline (default from
+        ``MXNET_SERVING_DEFAULT_TIMEOUT_MS``; None/0 = none): once it
+        expires the request is swept to TIMED_OUT and its KV blocks
+        return to the pool. Raises :class:`ServingOverloadError` (with a
+        ``retry_after_s`` hint) when the engine is draining or the
+        admission queue is at ``cfg.max_queue`` — shed, not enqueued."""
+        if timeout_s is None and self.config.default_timeout_ms > 0:
+            timeout_s = self.config.default_timeout_ms / 1000.0
         req = Request(prompt, max_new_tokens, eos_id=eos_id,
-                      request_id=request_id)
+                      request_id=request_id, timeout_s=timeout_s)
         total = len(req.prompt) + req.max_new_tokens
         if total > self.config.max_len:
             raise ValueError(
@@ -372,10 +409,74 @@ class ServingEngine:
             # behind a dead driver with a done_event nobody will ever set
             if self._aborted is not None:
                 raise RuntimeError(self._aborted)
+            if self._draining:
+                telemetry.counter("serving.shed").inc()
+                self._n_shed += 1
+                raise ServingOverloadError(
+                    "engine is draining (admission closed)",
+                    reason="draining",
+                    retry_after_s=retry_after_s(self))
+            if (self.config.max_queue
+                    and len(self.scheduler.waiting) >= self.config.max_queue):
+                telemetry.counter("serving.shed").inc()
+                self._n_shed += 1
+                raise ServingOverloadError(
+                    "admission queue full (%d waiting >= max_queue %d)"
+                    % (len(self.scheduler.waiting), self.config.max_queue),
+                    reason="queue_full",
+                    retry_after_s=retry_after_s(self))
             self.obs.request_submitted(req)
             self.scheduler.add(req)
             self._work.notify_all()
         return req
+
+    def cancel(self, req):
+        """Mark ``req`` for cancellation (safe from any thread — serve.py
+        calls it when the client connection drops). The next step's sweep
+        moves it to CANCELLED and frees its KV blocks; a WAITING request
+        is dropped without ever being admitted. No-op once terminal."""
+        with self._work:
+            if not req.finished():
+                req.cancelled = True
+                self._work.notify_all()
+
+    def cancel_all(self):
+        """Cancel every non-terminal request (the drain deadline passed:
+        stragglers are cut loose rather than holding the process open).
+        Returns the number marked."""
+        with self._work:
+            n = 0
+            for req in (list(self.scheduler.running)
+                        + list(self.scheduler.waiting)):
+                if not req.finished():
+                    req.cancelled = True
+                    n += 1
+            if n:
+                self._work.notify_all()
+            return n
+
+    def start_drain(self):
+        """Close admission: new submits are shed with
+        ``reason="draining"`` while inflight work keeps stepping to
+        completion. ``has_work()`` going False signals the drain is done
+        (serve.py's drain sequence; idempotent)."""
+        with self._work:
+            if not self._draining:
+                self._draining = True
+                telemetry.counter("serving.drains").inc()
+                telemetry.event("serving.drain", engine=self.engine_id,
+                                waiting=len(self.scheduler.waiting),
+                                active=len(self.scheduler.running))
+                self._work.notify_all()
+
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def aborted(self):
+        """The abort cause message, or None while the engine is live."""
+        return self._aborted
 
     def has_work(self):
         with self._lock:
@@ -393,6 +494,15 @@ class ServingEngine:
         submits refuse."""
         try:
             with self._lock, telemetry.span("serving.step"):
+                # chaos: injected per-step latency (trips deadlines/SLOs
+                # without faking clocks) — docs/fault_tolerance.md
+                # fwlint: disable=lock-order — the injected delay models a slow device dispatch, which blocks under the step lock by design
+                fault.hit("slow_step")
+                # deadline/cancellation sweep BEFORE scheduling: expired
+                # or abandoned requests release their KV blocks this step
+                # instead of decoding on for a consumer that is gone, and
+                # _drain_failed below routes them out the public channels
+                self.scheduler.sweep()
                 plan = self.scheduler.schedule()
                 for req in plan.preempted:
                     self.obs.request_preempted(req)
@@ -402,6 +512,7 @@ class ServingEngine:
                 if plan.empty():
                     return failed
                 for req in plan.prefills:
+                    # fwlint: disable=lock-order — fault.hit("dispatch_error") in the callee can inject a delay; real dispatch blocks under the step lock identically
                     self._run_prefill(req)
                 n_preempted = len(plan.preempted)
                 if plan.prefills:
@@ -423,8 +534,10 @@ class ServingEngine:
                     # but the pool invariant must hold unconditionally)
                     self._cow_guard(decodes)
                     if self._spec:
+                        # fwlint: disable=lock-order — injected dispatch fault may stall; matches real device-dispatch blocking under the step lock
                         self._run_spec_decode(decodes)
                     else:
+                        # fwlint: disable=lock-order — injected dispatch fault may stall; matches real device-dispatch blocking under the step lock
                         self._run_decode(decodes)
                 finished = [r for r in list(self.scheduler.running)
                             if r.finished()]
@@ -473,7 +586,15 @@ class ServingEngine:
         """Fail every queued and running request (the driver died mid-
         step, or the caller is shutting down hard). After an abort the
         engine refuses new submits — the pool pages may have been donated
-        into the failed dispatch and cannot be trusted."""
+        into the failed dispatch and cannot be trusted.
+
+        Under a supervisor (``salvage_on_abort`` set), non-terminal
+        requests are PARKED instead of failed: blocks dropped (the pool
+        dies with the engine), tokens-so-far kept, done_event left unset
+        — :meth:`pop_salvaged` hands them to the supervisor, which
+        :meth:`resubmit`-s them into a fresh engine where the replay
+        prefill (recompute-preemption style) rebuilds their cache and
+        greedy decode finishes them bit-identical to an unfaulted run."""
         msg = "serving engine aborted: %r" % (exc,)
         with self._lock:
             self._aborted = msg
@@ -481,6 +602,26 @@ class ServingEngine:
             reqs = list(self.scheduler.running) + list(self.scheduler.waiting)
             self.scheduler.running.clear()
             self.scheduler.waiting.clear()
+            if self.salvage_on_abort:
+                now = time.time()
+                for req in reqs:
+                    if req.finished():
+                        continue
+                    was_running = req.state != WAITING
+                    req.blocks = []   # pool accounting is moot post-abort
+                    req.shared_blocks = 0
+                    req.context_len = 0
+                    req.state = WAITING
+                    if was_running:
+                        # the restart wall is replay overhead, same clock
+                        # as recompute preemption — the 5-phase sum still
+                        # partitions the request's end-to-end wall
+                        req.preemptions += 1
+                        req.preempted_t = now
+                        telemetry.counter("serving.preemptions").inc()
+                        self.obs.request_preempted(req)
+                    self._salvaged.append(req)
+                return
             for req in reqs:
                 req.blocks = []   # pool accounting is moot post-abort
                 req.state = FAILED
@@ -492,6 +633,31 @@ class ServingEngine:
                     req.done_event.set()
             self._finished.extend(reqs)
             self._n_failed += len(reqs)
+
+    def pop_salvaged(self):
+        """Drain the requests :meth:`abort` parked for the supervisor
+        (empty unless ``salvage_on_abort`` was set before the abort)."""
+        with self._lock:
+            out, self._salvaged = self._salvaged, []
+            return out
+
+    def resubmit(self, req):
+        """Re-admit a request salvaged from a dead engine: it keeps its
+        identity, done_event, trace clock, and generated-so-far tokens —
+        ``replay_tokens()`` re-prefills prompt + emitted tokens exactly
+        like a recompute preemption, so greedy decode continues the
+        stream bit-identically. The supervisor calls this on the FRESH
+        engine for every survivor, in original submit order."""
+        with self._work:
+            if self._aborted is not None:
+                raise RuntimeError(self._aborted)
+            telemetry.event("serving.request", request_id=req.request_id,
+                            engine=self.engine_id, state="resubmitted",
+                            generated=len(req.generated),
+                            preemptions=req.preemptions)
+            self.scheduler.add(req)
+            self._work.notify_all()
+        return req
 
     def warmup(self):
         """Compile every prefill length bucket and decode batch bucket in
@@ -544,20 +710,32 @@ class ServingEngine:
                         self.pool.k_pages, self.pool.v_pages)
                     self.pool.k_pages, self.pool.v_pages = kp, vp
 
-    def generate(self, prompts, max_new_tokens, eos_id=None):
+    def generate(self, prompts, max_new_tokens, eos_id=None, timeout_s=None):
         """Convenience batch API: submit every prompt, drive steps until
         all finish, return each request's generated tokens (in input
-        order). Raises if any request failed."""
+        order). Raises if any request failed.
+
+        ``timeout_s`` bounds each request (threaded to :meth:`submit`):
+        the per-step sweep moves expired requests to TIMED_OUT, so the
+        drive loop terminates instead of decoding past a blown deadline.
+        An abort — this loop's own step raising, or another thread
+        killing the engine — surfaces as a RuntimeError carrying the
+        classified cause rather than a silent spin."""
         if isinstance(max_new_tokens, int):
             max_new_tokens = [max_new_tokens] * len(prompts)
-        reqs = [self.submit(p, n, eos_id=eos_id)
+        reqs = [self.submit(p, n, eos_id=eos_id, timeout_s=timeout_s)
                 for p, n in zip(prompts, max_new_tokens)]
         while any(not r.finished() for r in reqs):
+            # an external abort() cleared the scheduler queues but this
+            # loop's snapshot still holds the requests — re-stepping a
+            # dead engine forever would spin without ever finishing them
+            if self._aborted is not None:
+                raise RuntimeError(self._aborted)
             self.step()
-        failed = [r for r in reqs if r.state == FAILED]
-        if failed:
+        bad = [r for r in reqs if r.state != FINISHED]
+        if bad:
             raise RuntimeError("requests failed: %s"
-                               % [(r.rid, r.error) for r in failed])
+                               % [(r.rid, r.state, r.error) for r in bad])
         return [list(r.generated) for r in reqs]
 
     def pop_finished(self):
@@ -574,15 +752,22 @@ class ServingEngine:
             return out
 
     def _drain_failed(self):
-        """Scheduler-failed requests surface through the same channels as
-        successes: appended to the ``pop_finished()`` queue and returned
-        from :meth:`step`. ``_fail`` already stamped ``finish_t``, bumped
-        ``serving.requests_failed`` and woke the ``done_event``."""
+        """Requests the scheduler terminated — FAILED, and since the
+        resilience layer also TIMED_OUT/CANCELLED — surface through the
+        same channels as successes: appended to the ``pop_finished()``
+        queue and returned from :meth:`step`. ``_terminate`` already
+        stamped ``finish_t``, bumped the per-state counter and woke the
+        ``done_event``; obs reads the terminal state off the request."""
         failed = self.scheduler.pop_failed()
         for req in failed:
-            self.obs.request_finished(req, failed=True)
+            self.obs.request_finished(req)
+            if req.state == TIMED_OUT:
+                self._n_timed_out += 1
+            elif req.state == CANCELLED:
+                self._n_cancelled += 1
+            else:
+                self._n_failed += 1
         self._finished.extend(failed)
-        self._n_failed += len(failed)
         return failed
 
     # ------------------------------------------------------------ internals
@@ -618,6 +803,9 @@ class ServingEngine:
         c0, s0 = jit.compile_totals()
         s0 += self._draft_prefill_jits[S].compile_totals()[1] \
             if self._spec else 0.0
+        # chaos: injected dispatch failure — escapes step(), which aborts
+        # the engine (the supervisor's restart trigger in the chaos e2e)
+        fault.hit("dispatch_error")
         t0 = time.time()
         tok, _logits, kp, vp = self._prefill_fn(
             self.params, toks, np.int32(L), write_table,
@@ -670,6 +858,7 @@ class ServingEngine:
         # stream in the batch for the compile wall (serving/obs.py)
         jit = self._decode_jits[B]
         c0, s0 = jit.compile_totals()
+        fault.hit("dispatch_error")
         t0 = time.time()
         nxt, _logits, kp, vp = self._decode_fn(
             self.params, toks, poss, tables, ctx,
@@ -741,6 +930,7 @@ class ServingEngine:
             cur[i] = req.pending_token
         djit = self._draft_decode_jits[B]
         c0, s0 = djit.compile_totals()
+        fault.hit("dispatch_error")
         t0 = time.time()
         for j in range(k + 1):
             toks = cur.copy()
@@ -890,6 +1080,15 @@ class ServingEngine:
                 "preemptions": self.scheduler.preempt_count,
                 "completed": self._n_completed,
                 "failed": self._n_failed,
+                "resilience": {
+                    "draining": self._draining,
+                    "aborted": self._aborted,
+                    "max_queue": self.config.max_queue,
+                    "default_timeout_ms": self.config.default_timeout_ms,
+                    "shed": self._n_shed,
+                    "timed_out": self._n_timed_out,
+                    "cancelled": self._n_cancelled,
+                },
                 "prefix": self.pool.prefix_stats(),
                 "spec": {
                     "enabled": self._spec,

@@ -39,7 +39,7 @@ import threading
 
 import numpy as np
 
-from .. import telemetry
+from .. import fault, telemetry
 from ..base import MXNetError
 
 
@@ -136,6 +136,15 @@ class KVBlockPool:
         raises :class:`KVCacheOOM` (allocating nothing) when fewer than
         ``n`` are free."""
         n = int(n)
+        # chaos: forced allocator exhaustion, checked OUTSIDE the pool
+        # lock (the injection must not perturb lock ordering) — exercises
+        # every KVCacheOOM consumer: preemption, admission failure, the
+        # classified alloc-failure counters (docs/fault_tolerance.md)
+        if fault.hit("kv_oom") is not None:
+            telemetry.counter("serving.kv_blocks_alloc_failures").inc()
+            raise KVCacheOOM(
+                "KV block pool exhausted (fault-injected kv_oom): want %d "
+                "blocks" % n)
         with self._lock:
             if n > len(self._free):
                 telemetry.counter("serving.kv_blocks_alloc_failures").inc()

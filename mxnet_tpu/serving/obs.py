@@ -247,7 +247,13 @@ class ServingObs:
     def request_finished(self, req, failed=False):
         """Terminal: close the trace, observe the labeled latency/phase
         histograms, judge the SLOs (always-on counters), refresh goodput
-        and the burn state, emit the terminal event with the breakdown."""
+        and the burn state, emit the terminal event with the breakdown.
+
+        The terminal state comes from ``req.state`` (finished / failed /
+        timed_out / cancelled); the legacy ``failed`` flag forces the
+        failed lane for callers predating the resilience states. Only
+        FINISHED requests are judged against the SLOs — a shed, expired,
+        or cancelled request is not a latency sample."""
         tr = req.trace
         if tr is None or tr.closed:
             return
@@ -258,9 +264,10 @@ class ServingObs:
         for ph in PHASES:
             telemetry.histogram("serving.phase_seconds", engine=self.engine_id,
                                 phase=ph).observe(tr.phases[ph])
-        state = "failed" if failed else "finished"
+        state = "failed" if failed else req.state
+        ok = state == "finished"
         slo = {}
-        if not failed:
+        if ok:
             telemetry.histogram(
                 "serving.request_latency_seconds",
                 engine=self.engine_id).observe(e2e)
@@ -274,7 +281,7 @@ class ServingObs:
             # NOT part of the phase-sum contract
             fields["spec_draft_s"] = round(tr.sub["spec_draft"], 6)
             fields["spec_verify_s"] = round(tr.sub["spec_verify"], 6)
-        if failed:
+        if not ok:
             fields["error"] = req.error
         telemetry.event("serving.request", **fields)
 

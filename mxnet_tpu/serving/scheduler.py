@@ -7,7 +7,17 @@ State machine (one :class:`Request` each)::
        ^                                                 |
        |<------------- preempt (blocks exhausted) -------|
                                                          v
-                                      FINISHED (len/eos) or FAILED
+                FINISHED (len/eos) / FAILED / TIMED_OUT / CANCELLED
+
+Terminal states:
+
+* **FINISHED** — length cap or EOS; the only state SLO accounting judges.
+* **FAILED** — engine/scheduler error (pool too small, dispatch abort).
+* **TIMED_OUT** — the request's deadline (``timeout_s``) expired; swept
+  at admission and per step so its blocks return to the pool promptly.
+* **CANCELLED** — the consumer walked away (serve.py detects the dropped
+  connection; direct drivers call ``engine.cancel``); blocks freed on
+  the next sweep rather than decoding to ``max_new_tokens`` for nobody.
 
 Each engine step the scheduler produces one :class:`StepPlan`:
 
@@ -38,6 +48,17 @@ PREFILL = "prefill"
 DECODING = "decoding"
 FINISHED = "finished"
 FAILED = "failed"
+TIMED_OUT = "timed_out"
+CANCELLED = "cancelled"
+
+# every state a finished() request can be in; _terminate() routes each to
+# its own counter so shed/expiry accounting never inflates requests_failed
+TERMINAL_STATES = (FINISHED, FAILED, TIMED_OUT, CANCELLED)
+_TERMINAL_COUNTERS = {
+    FAILED: "serving.requests_failed",
+    TIMED_OUT: "serving.timeouts",
+    CANCELLED: "serving.cancelled",
+}
 
 _rid_counter = itertools.count()
 
@@ -49,10 +70,10 @@ class Request:
                  "state", "blocks", "shared_blocks", "context_len",
                  "generated", "pending_token", "arrival_t", "admitted_t",
                  "first_token_t", "preempted_t", "finish_t", "preemptions",
-                 "error", "done_event", "trace")
+                 "error", "done_event", "trace", "deadline_t", "cancelled")
 
     def __init__(self, prompt, max_new_tokens, eos_id=None, rid=None,
-                 request_id=None):
+                 request_id=None, timeout_s=None):
         self.rid = rid if rid is not None else next(_rid_counter)
         # wire identity: caller-supplied (X-Request-Id header) or derived
         # from the process-local rid — threads through every lifecycle
@@ -84,6 +105,19 @@ class Request:
         self.error = None
         self.done_event = None    # engine attaches for blocking consumers
         self.trace = None         # obs.RequestTrace (engine submits only)
+        if timeout_s is not None:
+            timeout_s = float(timeout_s)
+            if timeout_s <= 0:
+                raise ValueError("timeout_s must be > 0")
+            self.deadline_t = self.arrival_t + timeout_s
+        else:
+            self.deadline_t = None
+        self.cancelled = False    # consumer walked away; swept next step
+
+    def expired(self, now=None):
+        if self.deadline_t is None:
+            return False
+        return (now if now is not None else time.time()) >= self.deadline_t
 
     # tokens that must be in the KV cache for the next decode step
     def replay_tokens(self):
@@ -98,7 +132,7 @@ class Request:
         return len(self.generated)
 
     def finished(self):
-        return self.state in (FINISHED, FAILED)
+        return self.state in TERMINAL_STATES
 
     def __repr__(self):
         return ("Request(rid=%s, state=%s, prompt=%d, generated=%d, ctx=%d, "
@@ -255,19 +289,56 @@ class Scheduler:
         self.waiting.appendleft(req)
 
     def _fail(self, req, msg):
+        self._terminate(req, FAILED, msg)
+
+    def _terminate(self, req, state, msg):
+        """Move ``req`` to a non-FINISHED terminal state: free its blocks
+        promptly (refcount-decrement — shared prefix blocks survive for
+        their other holders), route it into the ``failed`` drain channel
+        so the engine's public completion paths surface it, and wake any
+        blocked consumer. One exit door for FAILED/TIMED_OUT/CANCELLED —
+        each bumps its own counter."""
         if req in self.running:   # admission-time failures never joined
             self.running.remove(req)
         if req.blocks:
             self.pool.free(req.blocks)
             req.blocks = []
         req.shared_blocks = 0
-        req.state = FAILED
+        req.state = state
         req.error = msg
         req.finish_t = time.time()
-        telemetry.counter("serving.requests_failed").inc()
+        telemetry.counter(_TERMINAL_COUNTERS[state]).inc()
         self.failed.append(req)
         if req.done_event is not None:
             req.done_event.set()
+
+    def sweep(self, now=None):
+        """Terminate expired / cancelled requests wherever they sit —
+        WAITING (queue positions open up) or PREFILL/DECODING (their KV
+        blocks return to the pool at once instead of decoding to
+        ``max_new_tokens`` for a consumer that is gone). Called by the
+        engine at the top of every step and safe to call directly.
+        Returns the requests it terminated."""
+        now = time.time() if now is None else now
+        swept = []
+        for req in list(self.running) + list(self.waiting):
+            if req.finished():
+                continue
+            if req.cancelled:
+                state, msg = CANCELLED, "cancelled by consumer"
+            elif req.expired(now):
+                state, msg = TIMED_OUT, (
+                    "deadline expired after %.3fs (timeout_s=%.3f)"
+                    % (now - req.arrival_t, req.deadline_t - req.arrival_t))
+            else:
+                continue
+            if req in self.waiting:
+                self.waiting.remove(req)
+            self._terminate(req, state, msg)
+            swept.append(req)
+        if swept:
+            self._refresh_gauges()
+        return swept
 
     def _admit(self, preempted=()):
         """FCFS head-first admission into PREFILL, bounded by the batch
@@ -310,7 +381,19 @@ class Scheduler:
                     self.pool.free(shared)
                 break
             self.waiting.popleft()
-            req.blocks = shared + self.pool.alloc(fresh)
+            try:
+                fresh_blocks = self.pool.alloc(fresh)
+            except KVCacheOOM as e:
+                # refused despite the available() check above (a
+                # fault-injected kv_oom, or a racing allocator): no
+                # dispatch happened and the pool is intact, so this is
+                # the request's failure, not the engine's — fail it
+                # through the classified exit door and keep admitting
+                if shared:   # drop our references; other holders keep them
+                    self.pool.free(shared)
+                self._fail(req, "admission refused: %s" % e)
+                continue
+            req.blocks = shared + fresh_blocks
             req.shared_blocks = len(shared)
             req.state = PREFILL
             req.admitted_t = time.time()

@@ -797,6 +797,24 @@ METRIC_HELP = {
     "serving.spec_verify_seconds":
         "target multi-query verify wall per speculative decode step "
         "(stall-free)",
+    "serving.shed":
+        "submits rejected by load shedding (queue at MXNET_SERVING_MAX_"
+        "QUEUE, engine draining, or supervisor mid-restart) — the 503 + "
+        "Retry-After path (always-on)",
+    "serving.timeouts":
+        "requests swept to TIMED_OUT at their deadline (timeout_s / "
+        "MXNET_SERVING_DEFAULT_TIMEOUT_MS); KV blocks freed at the sweep "
+        "(always-on)",
+    "serving.cancelled":
+        "requests swept to CANCELLED after the consumer walked away "
+        "(dropped connection / engine.cancel) (always-on)",
+    "serving.restarts":
+        "supervised engine restarts: abort -> salvage -> backoff -> "
+        "rebuild warm -> replay survivors (resilience.EngineSupervisor) "
+        "(always-on)",
+    "serving.drains":
+        "graceful drains begun (SIGTERM / POST /drain / start_drain): "
+        "admission closed, inflight work finishing (always-on)",
 }
 
 

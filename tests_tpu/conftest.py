@@ -27,7 +27,7 @@ _HOST_ONLY_FILES = {"test_fault_tolerance.py", "test_telemetry.py",
                     "test_cluster_obs.py", "test_native_decode.py",
                     "test_compileobs.py", "test_serving.py",
                     "test_serving_obs.py", "test_serving_prefix.py",
-                    "test_serving_spec.py",
+                    "test_serving_spec.py", "test_serving_resilience.py",
                     "test_kv_overlap.py", "test_graphpass.py",
                     "test_server_ha.py"}
 

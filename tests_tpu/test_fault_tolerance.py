@@ -164,6 +164,38 @@ def test_crash_after_bytes_respects_after_and_times(tmp_path):
     assert mx.nd.load(str(tmp_path / "c.params"))
 
 
+def test_points_registry_covers_serving_faults():
+    """fault.POINTS is the documented registry: every injection point the
+    docs name parses in a spec, including the serving trio."""
+    for p in ("dispatch_error", "kv_oom", "slow_step"):
+        assert p in fault.POINTS, p
+    # the whole registry round-trips through the spec grammar
+    with fault.inject(";".join("%s:after=1000000" % p
+                               for p in fault.POINTS)):
+        for p in fault.POINTS:
+            assert fault.hit(p) is None     # armed but budgeted off
+
+
+def test_serving_point_specs_fire():
+    """The serving points honor the shared grammar: raise=1 raises,
+    delay_ms sleeps, a bare rule returns its (empty) args dict."""
+    import time as _time
+
+    with fault.inject("dispatch_error:raise=1,times=1"):
+        with pytest.raises(fault.InjectedFault):
+            fault.hit("dispatch_error")
+        assert fault.hit("dispatch_error") is None      # times=1 spent
+    with fault.inject("slow_step:delay_ms=30,times=1"):
+        t0 = _time.time()
+        assert fault.hit("slow_step") is not None
+        assert _time.time() - t0 >= 0.03
+    with fault.inject("kv_oom:"):
+        # bare rule: fires with EMPTY args — consumers must test
+        # `is not None`, not truthiness (the kv_cache.alloc contract)
+        args = fault.hit("kv_oom")
+        assert args == {} and args is not None
+
+
 def test_fault_spec_from_env(monkeypatch):
     monkeypatch.setenv("MXNET_FAULT_SPEC", "envpoint:raise=1,times=1")
     fault.reset()

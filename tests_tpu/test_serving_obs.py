@@ -123,6 +123,7 @@ class _FakeReq:
         self.arrival_t = arrival_t
         self.prompt = [1, 2, 3]
         self.max_new_tokens = 4
+        self.state = "finished"     # terminal classification (resilience)
         self.admitted_t = None
         self.preempted_t = None
         self.first_token_t = None
@@ -390,7 +391,8 @@ def test_http_surface_schemas_and_request_id_roundtrip(telem):
 
     try:
         code, _h, body = get("/healthz")
-        assert code == 200 and json.loads(body) == {"ok": True}
+        assert code == 200 and json.loads(body) == {"ok": True,
+                                                   "state": "serving"}
 
         # header-supplied identity round-trips through header AND body
         code, hdrs, rep = post({"tokens": [1, 2, 3], "max_new_tokens": 4},

@@ -201,6 +201,10 @@ def main(argv=None):
         "spec_draft_s": spec["draft_seconds"],
         "spec_verify_s": spec["verify_seconds"],
         "spec": spec,
+        # resilience tallies (docs/serving.md §resilience): all zero on a
+        # clean offline run — a nonzero shed/timed_out/cancelled here
+        # means the workload outran the engine (or a fault spec was live)
+        "resilience": engine.stats()["resilience"],
         "compile": compileobs.summary(include_recompiles=False),
         # the serving cold-start story per run: warmup wall-clock is up
         # top (warmup_s); this block says whether the buckets compiled

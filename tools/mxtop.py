@@ -294,6 +294,25 @@ def render_serving(stats, now=None):
                    spec.get("accepted_tokens", 0),
                    spec.get("proposed_tokens", 0)))
         lines.append("  " + " | ".join(bits))
+    res = stats.get("resilience") or {}
+    sup = stats.get("supervisor") or {}
+    if res or sup:
+        bits = ["shed %d to %d cx %d"
+                % (res.get("shed", 0), res.get("timed_out", 0),
+                   res.get("cancelled", 0))]
+        if sup:
+            bits.append("restarts %d/%s%s"
+                        % (sup.get("restarts", 0),
+                           sup.get("max_restarts", "?"),
+                           " RESTARTING" if sup.get("restarting") else ""))
+        state = None
+        if sup.get("failed") or res.get("aborted"):
+            state = "FAILED"
+        elif res.get("draining") or sup.get("draining"):
+            state = "DRAINING"
+        if state:
+            bits.append(state)
+        lines.append("  " + " | ".join(bits))
     phases = stats.get("phases") or {}
     if phases:
         lines.append("  %-14s %10s %10s %10s"

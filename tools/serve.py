@@ -12,7 +12,8 @@ continuous batching means N in-flight requests share every decode step.
 
 Endpoints:
   POST /generate  {"tokens": [int...], "max_new_tokens": N,
-                   "eos_id": optional int, "request_id": optional str}
+                   "eos_id": optional int, "request_id": optional str,
+                   "timeout_s": optional float}
                   -> {"tokens": [int...], "request_id": str,
                       "ttft_s": float, "latency_s": float,
                       "preemptions": int}
@@ -21,11 +22,21 @@ Endpoints:
                   every serving.request lifecycle event — a slow reply
                   decomposes by cause in tools/serving_report.py. The
                   reply echoes it in both the X-Request-Id header and
-                  the body.
+                  the body. Failure statuses are classified
+                  (docs/serving.md §resilience): 503 + Retry-After when
+                  the engine shed the request (queue full / draining /
+                  restarting), 504 when its deadline expired, 500 when
+                  the engine aborted under it.
+  POST /drain     begin graceful drain: admission closes (new work shed
+                  with 503), inflight requests finish up to
+                  --drain-timeout, then the process exits 0. SIGTERM
+                  triggers the same sequence.
   GET  /stats     engine snapshot (queue/blocks/latency/phases/SLO/
-                  compiles) as JSON
+                  resilience/supervisor/compiles) as JSON
   GET  /metrics   Prometheus text exposition of the telemetry registry
-  GET  /healthz   {"ok": true}
+  GET  /healthz   {"ok": true, "state": "serving"}; 503 with state
+                  "draining" (load balancers: stop routing here) or
+                  "dead" (engine driver gone)
 
 Weights come from --checkpoint PREFIX --epoch N (a trained Transformer-LM
 checkpoint; shapes must match the --num-layers/--model-dim/... flags) or,
@@ -58,7 +69,9 @@ def build_engine(args):
         ffn_dim=args.ffn_dim, max_len=args.max_len,
         block_size=args.block_size, num_blocks=args.num_blocks,
         max_batch=args.max_batch,
-        kv_dtype=np.dtype(args.kv_dtype))
+        kv_dtype=np.dtype(args.kv_dtype),
+        max_queue=getattr(args, "max_queue", None),
+        default_timeout_ms=getattr(args, "default_timeout_ms", None))
     arg_params = None
     if args.checkpoint:
         from mxnet_tpu import model as mxmodel
@@ -66,6 +79,24 @@ def build_engine(args):
         _sym, arg_params, _aux = mxmodel.load_checkpoint(args.checkpoint,
                                                          args.epoch)
     return ServingEngine(cfg, arg_params=arg_params, seed=args.seed)
+
+
+def build_supervisor(args):
+    """Supervised engine (docs/serving.md §resilience): the factory
+    rebuilds pool + engine after an abort, re-running warmup when asked —
+    with a persistent compile cache (--cache-dir) the replacement loads
+    every bucket's serialized executable instead of compiling, so the
+    restart is warm."""
+    from mxnet_tpu.serving import EngineSupervisor
+
+    def factory():
+        eng = build_engine(args)
+        if getattr(args, "warmup", False):
+            eng.warmup()
+        return eng
+
+    return EngineSupervisor(factory,
+                            max_restarts=getattr(args, "max_restarts", None))
 
 
 def _columns(stats):
@@ -81,6 +112,16 @@ def _columns(stats):
     spec = stats.get("spec") or {}
     if spec.get("enabled"):
         extra += " | acc %.0f%%" % (100.0 * spec.get("acceptance_rate", 0.0))
+    res = stats.get("resilience") or {}
+    if res.get("shed") or res.get("timed_out") or res.get("cancelled"):
+        extra += " | shed %d to %d cx %d" % (res.get("shed", 0),
+                                             res.get("timed_out", 0),
+                                             res.get("cancelled", 0))
+    sup = stats.get("supervisor") or {}
+    if sup.get("restarts"):
+        extra += " | rst %d" % sup["restarts"]
+    if res.get("draining"):
+        extra += " | DRAINING"
     return ("reqs %3d | act %3d wait %3d | kv %4d/%-4d frag %5d | "
             "%6.1f tok/s | ttft %s/%s ms | lat %s/%s ms | slo %s%s | steps %d"
             % (stats["active"] + stats["waiting"], stats["active"],
@@ -94,10 +135,18 @@ def _columns(stats):
                extra, stats["steps"]))
 
 
-def make_server(engine, host, port, driver=None):
+def make_server(engine, host, port, driver=None, drain_cb=None):
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from mxnet_tpu import telemetry
+    from mxnet_tpu.base import env_float
+    from mxnet_tpu.serving import (CANCELLED, FINISHED, TIMED_OUT,
+                                   ServingOverloadError)
+
+    # bound on a handler thread's done_event wait when the request has no
+    # deadline of its own: a wedged or aborted engine must not hang every
+    # open client connection forever
+    handler_timeout_s = env_float("MXNET_SERVING_HANDLER_TIMEOUT_S", 300.0)
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -106,7 +155,7 @@ def make_server(engine, host, port, driver=None):
             pass
 
         def _reply(self, code, body, ctype="application/json",
-                   request_id=None):
+                   request_id=None, retry_after_s=None):
             data = body if isinstance(body, bytes) else \
                 json.dumps(body).encode()
             self.send_response(code)
@@ -114,15 +163,41 @@ def make_server(engine, host, port, driver=None):
             self.send_header("Content-Length", str(len(data)))
             if request_id is not None:
                 self.send_header("X-Request-Id", request_id)
+            if retry_after_s is not None:
+                # RFC 9110 delta-seconds (integer, >= 1): the client's
+                # backoff hint from the engine's occupancy/goodput gauges
+                self.send_header("Retry-After",
+                                 str(max(1, int(round(retry_after_s)))))
             self.end_headers()
             self.wfile.write(data)
 
+        def _client_gone(self):
+            """True when the client hung up: on a request-response
+            connection with the request body fully read, a readable
+            socket means EOF (or pipelined garbage we won't answer)."""
+            import select
+            import socket
+
+            try:
+                r, _w, _x = select.select([self.connection], [], [], 0)
+                if not r:
+                    return False
+                return self.connection.recv(1, socket.MSG_PEEK) == b""
+            except (OSError, ValueError):
+                return True
+
         def do_GET(self):
             if self.path == "/healthz":
-                # a dead engine driver means every /generate would hang on
-                # its done_event — report it, don't claim healthy
-                ok = driver is None or driver.is_alive()
-                self._reply(200 if ok else 503, {"ok": ok})
+                # a dead engine driver means every /generate would hang
+                # on its done_event — report it, don't claim healthy; a
+                # draining server still answers inflight work but load
+                # balancers must stop routing new requests here
+                if driver is not None and not driver.is_alive():
+                    self._reply(503, {"ok": False, "state": "dead"})
+                elif getattr(engine, "draining", False):
+                    self._reply(503, {"ok": False, "state": "draining"})
+                else:
+                    self._reply(200, {"ok": True, "state": "serving"})
             elif self.path == "/stats":
                 self._reply(200, engine.stats())
             elif self.path == "/metrics":
@@ -132,6 +207,15 @@ def make_server(engine, host, port, driver=None):
                 self._reply(404, {"error": "unknown path %s" % self.path})
 
         def do_POST(self):
+            if self.path == "/drain":
+                if drain_cb is None:
+                    self._reply(501, {"error": "drain not wired (library "
+                                               "embedding without a "
+                                               "drain_cb)"})
+                    return
+                self._reply(202, {"draining": True})
+                drain_cb()
+                return
             if self.path != "/generate":
                 self._reply(404, {"error": "unknown path %s" % self.path})
                 return
@@ -141,38 +225,91 @@ def make_server(engine, host, port, driver=None):
                 tokens = body["tokens"]
                 max_new = int(body["max_new_tokens"])
                 eos_id = body.get("eos_id")
+                timeout_s = body.get("timeout_s")
                 # wire identity: header wins over body; engine assigns
                 # one when the caller sent neither
                 request_id = (self.headers.get("X-Request-Id")
                               or body.get("request_id"))
                 req = engine.submit(tokens, max_new, eos_id=eos_id,
-                                    request_id=request_id)
+                                    request_id=request_id,
+                                    timeout_s=timeout_s)
+            except ServingOverloadError as e:
+                # shed, not enqueued: tell the client when to come back
+                self._reply(503, {"error": str(e), "reason": e.reason,
+                                  "retry_after_s": e.retry_after_s},
+                            retry_after_s=e.retry_after_s)
+                return
             except (KeyError, TypeError, ValueError) as e:
                 self._reply(400, {"error": str(e)})
                 return
-            except RuntimeError as e:   # engine aborted: driver died
-                self._reply(503, {"error": str(e)})
+            except RuntimeError as e:   # engine aborted permanently
+                self._reply(500, {"error": str(e)})
                 return
-            req.done_event.wait()
-            if req.error is not None:
-                self._reply(503, {"error": req.error,
+            # bounded wait (never hang a client thread forever behind a
+            # wedged or aborted engine): the request's own deadline plus
+            # sweep slack when it has one, the handler bound otherwise —
+            # and watch the connection so an abandoned stream is
+            # cancelled instead of decoding to max_new_tokens for nobody
+            if req.deadline_t is not None:
+                bound = req.deadline_t + 5.0
+            else:
+                bound = time.time() + handler_timeout_s
+            gone = False
+            while not req.done_event.wait(0.1):
+                if time.time() >= bound:
+                    engine.cancel(req)
+                    self._reply(504, {
+                        "error": "request did not finish within the "
+                                 "handler bound (engine wedged?)",
+                        "state": req.state,
+                        "request_id": req.request_id},
+                        request_id=req.request_id)
+                    return
+                if self._client_gone():
+                    gone = True
+                    engine.cancel(req)
+                    # no reply possible; wait briefly for the sweep to
+                    # free the KV blocks, then release the handler thread
+                    req.done_event.wait(5.0)
+                    return
+            if req.state == FINISHED:
+                self._reply(200, {
+                    "tokens": list(req.generated),
+                    "request_id": req.request_id,
+                    "ttft_s": round(req.first_token_t - req.arrival_t, 6),
+                    "latency_s": round(req.finish_t - req.arrival_t, 6),
+                    "preemptions": req.preemptions,
+                }, request_id=req.request_id)
+            elif req.state == TIMED_OUT:
+                self._reply(504, {"error": req.error, "state": req.state,
+                                  "tokens_done": len(req.generated),
+                                  "request_id": req.request_id},
+                            request_id=req.request_id)
+            elif req.state == CANCELLED:
+                if not gone:   # cancelled server-side (drain straggler)
+                    self._reply(503, {"error": req.error,
+                                      "state": req.state,
+                                      "request_id": req.request_id},
+                                request_id=req.request_id)
+            else:   # FAILED: the engine aborted under this request
+                self._reply(500, {"error": req.error, "state": req.state,
                                   "preemptions": req.preemptions,
                                   "request_id": req.request_id},
                             request_id=req.request_id)
-                return
-            self._reply(200, {
-                "tokens": list(req.generated),
-                "request_id": req.request_id,
-                "ttft_s": round(req.first_token_t - req.arrival_t, 6),
-                "latency_s": round(req.finish_t - req.arrival_t, 6),
-                "preemptions": req.preemptions,
-            }, request_id=req.request_id)
 
-    return ThreadingHTTPServer((host, port), Handler)
+    class Server(ThreadingHTTPServer):
+        # a client burst SYNs far more connections at once than
+        # socketserver's default backlog of 5: overflowed handshakes get
+        # reset by the kernel and the client sees ECONNRESET before the
+        # request ever reaches admission control — shedding is the
+        # engine's job (503 + Retry-After), not the listen queue's
+        request_queue_size = 128
+
+    return Server((host, port), Handler)
 
 
 def main(argv=None):
-    from mxnet_tpu.base import env_int
+    from mxnet_tpu.base import env_float, env_int
 
     ap = argparse.ArgumentParser(
         description="paged-KV continuous-batching LLM server")
@@ -205,18 +342,33 @@ def main(argv=None):
                          "MXNET_COMPILE_CACHE_DIR)")
     ap.add_argument("--top", action="store_true",
                     help="render live stat columns to stderr")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="admission-queue bound: submits past it are shed "
+                         "with 503 + Retry-After (0 = unbounded; default "
+                         "MXNET_SERVING_MAX_QUEUE)")
+    ap.add_argument("--default-timeout-ms", type=int, default=None,
+                    help="deadline for requests whose body sends no "
+                         "timeout_s (0 = none; default "
+                         "MXNET_SERVING_DEFAULT_TIMEOUT_MS)")
+    ap.add_argument("--max-restarts", type=int, default=None,
+                    help="supervisor restart budget before the engine is "
+                         "failed permanently (default "
+                         "MXNET_SERVING_MAX_RESTARTS)")
+    ap.add_argument("--drain-timeout", type=float,
+                    default=env_float("MXNET_SERVING_DRAIN_S", 30.0),
+                    help="seconds SIGTERM//drain waits for inflight work "
+                         "before cancelling stragglers and exiting")
     args = ap.parse_args(argv)
 
     if args.cache_dir:
         from mxnet_tpu import compile_cache
 
         compile_cache.enable(args.cache_dir)
-    engine = build_engine(args)
+    t0 = time.time()
+    sup = build_supervisor(args)   # factory warms up when --warmup is set
     if args.warmup:
         from mxnet_tpu import compile_cache
 
-        t0 = time.time()
-        engine.warmup()   # every prefill/decode shape bucket, one dispatch each
         cstats = compile_cache.stats()
         print("warmup: %.1fs (compile cache: %s)"
               % (time.time() - t0,
@@ -224,20 +376,57 @@ def main(argv=None):
                  if cstats["enabled"] else "off"), file=sys.stderr)
 
     stop = threading.Event()
-    driver = threading.Thread(target=engine.run_loop, args=(stop,),
+    driver = threading.Thread(target=sup.run_loop, args=(stop,),
                               name="serving-engine-driver", daemon=True)
     driver.start()
     if args.top:
         def top():
             while not stop.wait(1.0):
-                print(_columns(engine.stats()), file=sys.stderr)
+                print(_columns(sup.stats()), file=sys.stderr)
         threading.Thread(target=top, name="serving-top",
                          daemon=True).start()
 
-    httpd = make_server(engine, args.host, args.port, driver=driver)
+    httpd = None
+    drained = threading.Event()
+
+    def drain():
+        """Graceful drain (docs/serving.md §resilience runbook): close
+        admission, flip /healthz to draining, finish inflight work up to
+        the drain deadline, cancel stragglers, stop, exit 0."""
+        if drained.is_set():
+            return
+        drained.set()
+        sup.start_drain()
+        print("draining: admission closed, waiting up to %.0fs for "
+              "inflight work" % args.drain_timeout, file=sys.stderr)
+        deadline = time.time() + args.drain_timeout
+        while time.time() < deadline and sup.has_work():
+            time.sleep(0.1)
+        n = sup.cancel_all()
+        if n:
+            print("drain deadline: cancelled %d straggler(s)" % n,
+                  file=sys.stderr)
+            t_end = time.time() + 5.0
+            while time.time() < t_end and sup.has_work():
+                time.sleep(0.05)
+        stop.set()
+        if httpd is not None:
+            httpd.shutdown()
+
+    def drain_async():
+        threading.Thread(target=drain, name="serving-drain",
+                         daemon=True).start()
+
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda _sig, _frm: drain_async())
+
+    httpd = make_server(sup, args.host, args.port, driver=driver,
+                        drain_cb=drain_async)
+    eng = sup.engine
     print("serving on http://%s:%d (pool: %d blocks x %d slots)"
-          % (args.host, args.port, engine.pool.num_usable,
-             engine.pool.block_size), flush=True)
+          % (args.host, args.port, eng.pool.num_usable,
+             eng.pool.block_size), flush=True)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
@@ -245,6 +434,8 @@ def main(argv=None):
     finally:
         stop.set()
         httpd.server_close()
+    if drained.is_set():
+        print("drained: exiting 0", file=sys.stderr)
 
 
 if __name__ == "__main__":
