@@ -345,9 +345,40 @@ run_lint() {
   python tools/fwlint.py --baseline ci/fwlint_baseline.json \
     --json-out /tmp/fwlint_report.json
   # the analysis suite: checker positives/negatives, dataflow propagation,
-  # suppression + ratchet semantics, engine dependency-sanitizer modes
+  # suppression + ratchet semantics, engine dependency-sanitizer modes,
+  # concurrency rules + lock-order witness modes
   JAX_PLATFORMS=cpu python -m pytest tests_tpu/test_analysis.py \
     -q -m "not slow"
+  run_witness_smoke
+}
+
+run_witness_smoke() {
+  # runtime lock-order witness smoke (docs/static_analysis.md
+  # §concurrency): warn mode over one nested pair — the proxy wraps,
+  # the edge records, and the always-on lock.* counters move. Stdlib +
+  # telemetry only; no jax import on this path.
+  JAX_PLATFORMS=cpu python - <<'PYEOF'
+import threading
+from mxnet_tpu.analysis import witness
+from mxnet_tpu import telemetry
+
+witness.configure("warn")
+a = witness.declare("ci.smoke.A", threading.Lock())
+b = witness.declare("ci.smoke.B", threading.Lock())
+with a:
+    with b:
+        pass
+assert ("ci.smoke.A", "ci.smoke.B") in witness.observed_edges()
+assert telemetry.histogram(witness.HELD_HISTOGRAM, lock="ci.smoke.A").count == 1
+with b:
+    with a:  # inversion: counted in warn mode, never raises
+        pass
+assert telemetry.counter(witness.COUNTER_ORDER).value == 1
+witness.configure(None)
+raw = threading.Lock()
+assert witness.declare("ci.smoke.off", raw) is raw
+print("witness smoke ok")
+PYEOF
 }
 
 run_deep() {

@@ -14,7 +14,10 @@ Two halves (docs/static_analysis.md):
 * ``sanitizer`` — a runtime checker for the engine's dependency contracts
   (``MXNET_ENGINE_SANITIZER=warn|strict``): pushed functions are wrapped and
   their actual NDArray reads/writes compared against the declared
-  ``const_vars``/``mutable_vars``.
+  ``const_vars``/``mutable_vars``. ``witness`` is its locking sibling
+  (``MXNET_LOCK_WITNESS=warn|strict``): declared locks record observed
+  acquisition order, hold time and contention, cross-checked against the
+  static lock graph.
 
 This package deliberately imports only the standard library at import time
 (no jax, no numpy): ``tools/fwlint.py`` loads it standalone so linting a
@@ -24,16 +27,17 @@ its framework dependencies lazily, at enable time.
 from .fwlint import Finding, RULES, lint_paths, lint_source, run_lint
 
 __all__ = ["Finding", "RULES", "lint_paths", "lint_source", "run_lint",
-           "sanitizer"]
+           "sanitizer", "witness"]
 
 
 def __getattr__(name):
-    # lazy: the sanitizer submodule is runtime wiring (engine/ndarray); the
-    # lint half must stay importable standalone (see module docstring)
-    if name == "sanitizer":
+    # lazy: the sanitizer/witness submodules are runtime wiring
+    # (engine/ndarray/telemetry); the lint half must stay importable
+    # standalone (see module docstring)
+    if name in ("sanitizer", "witness"):
         import importlib
 
         # NOT `from . import sanitizer`: the fromlist machinery consults
         # this very __getattr__ while the submodule is mid-import → recursion
-        return importlib.import_module(__name__ + ".sanitizer")
+        return importlib.import_module(__name__ + "." + name)
     raise AttributeError(name)

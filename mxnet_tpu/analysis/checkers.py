@@ -940,13 +940,29 @@ def check_recompile_hazard(ctx):
 
 
 # ---------------------------------------------------------------------------
-# lock-order (repo scope)
+# lock-order / concurrency (repo scope)
 # ---------------------------------------------------------------------------
+
+def _lock_graph_for(ctxs):
+    """One LockGraph per lint run: lock-order and the concurrency pass
+    consume the same build (cached on the first context — contexts are
+    reconstructed per run, so the cache can never go stale)."""
+    if not ctxs:
+        return _build_lock_graph(ctxs)
+    anchor = ctxs[0]
+    key = tuple(id(c) for c in ctxs)
+    cached = getattr(anchor, "_lockgraph_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    graph = _build_lock_graph(ctxs)
+    anchor._lockgraph_cache = (key, graph)
+    return graph
+
 
 @_checker("lock-order")
 def check_lock_order(ctxs):
     ctxs = list(ctxs)
-    graph = _build_lock_graph(ctxs)
+    graph = _lock_graph_for(ctxs)
     out = []
     for cycle in graph.cycles():
         edges = graph.cycle_edges(cycle)
@@ -1013,9 +1029,24 @@ def check_mutable_default(ctx):
     return out
 
 
+# ---------------------------------------------------------------------------
+# concurrency (repo scope): thread roots, shared state, guards — see
+# concurrency.py for the model and docs/static_analysis.md §concurrency
+# ---------------------------------------------------------------------------
+
+@_checker("unguarded-shared-write", "check-then-act", "unbalanced-acquire",
+          "guard-mismatch")
+def check_concurrency(ctxs):
+    # attr-form import — same standalone-CLI constraint as the driver
+    from .concurrency import run as _run
+
+    ctxs = list(ctxs)
+    return _run(ctxs, graph=_lock_graph_for(ctxs))
+
+
 CHECKERS = (check_env_raw_read, check_excepts, check_thread_hygiene,
             check_lock_discipline, check_device_escape,
             check_recompile_hazard, check_untracked_jit,
             check_mutable_default)
 
-REPO_CHECKERS = (check_trace_impure, check_lock_order)
+REPO_CHECKERS = (check_trace_impure, check_lock_order, check_concurrency)

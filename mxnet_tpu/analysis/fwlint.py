@@ -255,7 +255,11 @@ def _file_findings(fctx, select):
     for chk in _checker_registry():
         if select is not None and not (set(chk.rules) & set(select)):
             continue
-        findings.extend(chk(fctx))
+        # per-finding, not just per-checker: a multi-rule checker (the
+        # concurrency pass carries four rules) must not leak findings for
+        # rules outside the selection
+        findings.extend(f for f in chk(fctx)
+                        if select is None or f.rule in select)
     return findings
 
 
@@ -264,7 +268,8 @@ def _repo_findings(fctxs, select):
     for chk in _repo_checker_registry():
         if select is not None and not (set(chk.rules) & set(select)):
             continue
-        findings.extend(chk(fctxs))
+        findings.extend(f for f in chk(fctxs)
+                        if select is None or f.rule in select)
     return findings
 
 

@@ -6,11 +6,24 @@ a module-level name or a ``self.<attr>`` — and adds an edge ``A -> B``
 whenever B is acquired while A is held:
 
 * lexically, via nested ``with`` statements;
+* sequentially, via manual ``lock.acquire()`` / ``lock.release()`` pairs
+  (the acquire extends the held set for the rest of the enclosing block,
+  including a ``try``'s body when the release sits in its ``finally``)
+  and via ``stack.enter_context(lock)`` (ExitStack indirection — held for
+  the rest of the block, released by the stack's own exit);
 * transitively, via calls made under a lock: ``self.method()`` resolves
   within the class, ``alias.fn()`` through the file's imports,
   ``self.obj.method()`` through constructor-assignment types
   (``self.obj = SomeClass(...)``), and each resolved callee contributes
   its own (transitive) acquisitions via a repo-wide fixpoint.
+
+The walk also records every ``self.<attr>`` / module-global access it
+passes — ``(owner id, read|write, held locks, line, in-test)`` per
+function into ``accesses`` — which is the raw material concurrency.py's
+shared-state race inference consumes (one tree walk feeds both analyses),
+plus ``unbalanced``: manual acquires whose release never appears in the
+same function (``release_sites`` lets the consumer recognize the
+cross-function handoff idiom before flagging).
 
 Lock identity is **per declaration site** (``module.Class.attr``), not per
 instance: two instances of one class share a node. That over-approximates
@@ -47,8 +60,30 @@ __all__ = ["LockGraph", "build"]
 _LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore",
                "BoundedSemaphore")
 _QUEUE_CTORS = ("Queue", "LifoQueue", "PriorityQueue", "SimpleQueue")
+# method calls that mutate their receiver in place — a call through a
+# shared attribute is a WRITE to that attribute's object
+_MUTATORS = ("append", "extend", "add", "update", "pop", "popleft",
+             "setdefault", "insert", "remove", "discard", "clear",
+             "appendleft", "popitem")
+# self-attr types that are thread-safe primitives (or the thread handle
+# itself): accesses through them are not shared-state races
+_SAFE_ATTR_TYPES = ("__queue__", "__thread__", "__event__")
 _RPC_ATTRS = ("pull", "push", "barrier", "request_server_stats")
 _RPC_RECV_HINTS = ("kv", "client", "store")
+# bare receiver names that are (near-certainly) stdlib/third-party
+# modules, not repo instances — their attribute traffic is never a
+# duck-typed repo call
+_STDLIB_RECV = frozenset((
+    "os", "sys", "time", "json", "re", "math", "struct", "socket",
+    "threading", "queue", "logging", "ast", "io", "np", "numpy", "jax",
+    "jnp", "random", "collections", "itertools", "functools",
+    "subprocess", "shutil", "tempfile", "urllib", "http", "argparse",
+    "contextlib", "signal", "atexit", "traceback", "pickle", "hashlib",
+    "base64", "zlib", "gzip", "csv", "heapq", "bisect", "string",
+    "textwrap", "types", "typing", "enum", "abc", "copy", "weakref",
+    "warnings", "inspect", "platform", "stat", "glob", "fnmatch",
+    "errno", "select", "ssl", "uuid", "datetime", "statistics", "array",
+    "ctypes", "mmap", "unittest", "pytest"))
 
 
 def _modname(path):
@@ -82,11 +117,33 @@ class _FileInfo:
         self.attr_types = {}     # (class, attr) -> bare class name
         self.imports = {}        # alias -> repo path
         self.defs = {}           # qualname -> FunctionDef
-        self.class_names = {n.name for n in ctx.tree.body
-                            if isinstance(n, ast.ClassDef)}
+        self.class_names = set()  # every ClassDef, nested included (the
+        # serve-tier handler classes live INSIDE factory functions)
+        self.method_index = {}   # (class, method) -> def qualname
+        self.properties = set()  # def qualnames decorated @property
+        self.module_names = set()  # module-level assigned (data) names
         for node in ctx.nodes:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.defs[ctx.qualnames[node]] = node
+            if isinstance(node, ast.ClassDef):
+                self.class_names.add(node.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = ctx.qualnames[node]
+                self.defs[qn] = node
+                comps = qn.split(".")
+                for c in reversed(comps[:-1]):
+                    if c in self.class_names:  # innermost enclosing class
+                        self.method_index.setdefault((c, comps[-1]), qn)
+                        break
+                for dec in node.decorator_list:
+                    if _dotted(dec) in ("property", "cached_property",
+                                        "functools.cached_property"):
+                        self.properties.add(qn)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)) \
+                    and ctx.qualnames.get(node) == "<module>":
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.module_names.add(t.id)
         self._scan_imports(known_paths)
         self._scan_assigns(known_classes)
 
@@ -111,8 +168,9 @@ class _FileInfo:
                                                                 t.id)
                 elif isinstance(t, ast.Attribute) \
                         and _dotted(t.value) == "self":
-                    cls = qn.split(".")[0]
-                    if cls not in self.class_names:
+                    cls = next((c for c in reversed(qn.split("."))
+                                if c in self.class_names), None)
+                    if cls is None:
                         continue
                     if ctor:
                         kind, wrapped = ctor
@@ -157,11 +215,23 @@ class LockGraph:
             for node in c.nodes:
                 if isinstance(node, ast.ClassDef):
                     known_classes.add(node.name)
+        self.known_classes = known_classes
         self.infos = {c.path: _FileInfo(c, known_paths, known_classes)
                       for c in ctxs}
         self.edges = {}
         self.acquire_fns = {}
         self.blocking = []
+        self.accesses = {}  # fnkey -> [(owner, kind, line, held, in_test)]
+        self.unbalanced = []  # (lock id, path, line, fnkey): acquire w/o
+        # release in the same function
+        self.release_sites = {}  # lock id -> set of fnkeys releasing it
+        # duck-typed residue the type pass could not resolve: method calls
+        # and attribute loads on receivers with unknown type.  concurrency
+        # turns the DISTINCTIVE names (<= 2 repo candidates) into reach
+        # edges so a supervisor driving a factory-built engine still
+        # connects to it.
+        self.unresolved_calls = {}  # fnkey -> {(method name, held tuple)}
+        self.unresolved_attrs = {}  # fnkey -> {(attr name, held tuple)}
         self._direct = {}   # fnkey -> set(lock ids)
         self._calls = {}    # fnkey -> [(held tuple, callee key, site)]
         self._fn_blocking = {}  # fnkey -> [(kind, path, line)] own calls
@@ -171,15 +241,31 @@ class LockGraph:
                 self._walk_fn(ctx, info, fnode, (ctx.path, qn))
         self._apply_transitive()
 
+    def edge_set(self):
+        """The static acquisition-order edges as a plain set of
+        ``(src, dst)`` lock-id pairs — the witness's comparison baseline."""
+        return set(self.edges)
+
     # ------------------------------------------------------------- walking
     def _walk_fn(self, ctx, info, fnode, key):
-        cls = None
-        head = key[1].split(".")[0]
-        if head in info.class_names and "." in key[1]:
-            cls = head
+        comps = key[1].split(".")
+        cls = next((c for c in reversed(comps[:-1])
+                    if c in info.class_names), None)
         aliases = {}
         direct = self._direct.setdefault(key, set())
         calls = self._calls.setdefault(key, [])
+        accesses = self.accesses.setdefault(key, [])
+        # module-global accesses resolve AFTER the walk: any local binding
+        # of the name (Python scoping, not flow order) shadows the global
+        # unless a `global` declaration reclaims it
+        pending_globals = []  # (name, kind, line, held, in_test)
+        fn_bound = set()
+        fn_globals = set()
+        args = fnode.args
+        for a in (list(getattr(args, "posonlyargs", ())) + list(args.args)
+                  + list(args.kwonlyargs)):
+            fn_bound.add(a.arg)
+        man_acquires = []  # [lock id, line, released?] manual .acquire()s
 
         def resolve_lock(expr):
             if isinstance(expr, ast.Name):
@@ -214,8 +300,8 @@ class LockGraph:
             if isinstance(f, ast.Attribute):
                 base = f.value
                 if _dotted(base) == "self" and cls:
-                    qn = cls + "." + f.attr
-                    if qn in info.defs:
+                    qn = info.method_index.get((cls, f.attr))
+                    if qn:
                         return (ctx.path, qn)
                     return None
                 if isinstance(base, ast.Name) and base.id in info.imports:
@@ -284,7 +370,48 @@ class LockGraph:
                 self._fn_blocking.setdefault(key, []).append(
                     (kind, ctx.path, call.lineno, wlid))
 
-        def scan_calls(expr, held):
+        def resolve_owner(expr):
+            """Shared-state owner id for an access expression, or None.
+            ``self.<attr>`` (within a known class, not a lock, not a
+            thread-safe primitive, not a method) -> ``module.Class.attr``;
+            a module-level data name -> ``module.name`` (scoping resolved
+            after the walk via ``pending_globals``)."""
+            if isinstance(expr, ast.Attribute) \
+                    and _dotted(expr.value) == "self" and cls:
+                if (cls, expr.attr) in info.class_locks:
+                    return None
+                if info.attr_types.get((cls, expr.attr)) \
+                        in _SAFE_ATTR_TYPES:
+                    return None
+                if (cls, expr.attr) in info.method_index:
+                    return None  # method/property reference, not data
+                return "%s.%s.%s" % (info.mod, cls, expr.attr)
+            return None
+
+        def record_name(node, kind, held, in_test):
+            nm = node.id
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                fn_bound.add(nm)
+            if nm in info.module_names and nm not in info.module_locks:
+                pending_globals.append((nm, kind, node.lineno,
+                                        tuple(held), in_test))
+
+        def duck_recv(base):
+            """True when ``base`` is a receiver whose type the pass cannot
+            name — the residue worth matching by method name later."""
+            if isinstance(base, ast.Name):
+                return (base.id not in info.imports
+                        and base.id not in info.module_locks
+                        and base.id not in aliases
+                        and base.id not in _STDLIB_RECV
+                        and base.id not in ("self", "cls"))
+            if isinstance(base, ast.Attribute) \
+                    and _dotted(base.value) == "self" and cls:
+                return ((cls, base.attr) not in info.class_locks
+                        and self._typeof(info, cls, base) is None)
+            return False
+
+        def scan_calls(expr, held, in_test=False):
             for node in ast.walk(expr):
                 if isinstance(node, ast.Call):
                     callee = resolve_call(node)
@@ -292,12 +419,102 @@ class LockGraph:
                             ctx.line_text(node.lineno))
                     if callee:
                         calls.append((tuple(held), callee, site))
+                    elif isinstance(node.func, ast.Attribute) \
+                            and not node.func.attr.startswith("__") \
+                            and duck_recv(node.func.value):
+                        self.unresolved_calls.setdefault(key, set()).add(
+                            (node.func.attr, tuple(held)))
                     check_blocking(node, held)
+                    f = node.func
+                    if isinstance(f, ast.Attribute):
+                        if f.attr in _MUTATORS:
+                            owner = resolve_owner(f.value)
+                            if owner:
+                                accesses.append((owner, "write",
+                                                 node.lineno, tuple(held),
+                                                 in_test))
+                            elif isinstance(f.value, ast.Name):
+                                record_name(f.value, "write", held,
+                                            in_test)
+                        elif f.attr in ("acquire", "release"):
+                            lid = resolve_lock(f.value)
+                            if lid and f.attr == "release":
+                                self.release_sites.setdefault(
+                                    lid, set()).add(key)
+                                for rec in reversed(man_acquires):
+                                    if rec[0] == lid and not rec[2]:
+                                        rec[2] = True
+                                        break
+                elif isinstance(node, ast.Attribute):
+                    owner = resolve_owner(node)
+                    if owner:
+                        kind = "write" if isinstance(
+                            node.ctx, (ast.Store, ast.Del)) else "read"
+                        accesses.append((owner, kind, node.lineno,
+                                         tuple(held), in_test))
+                    elif isinstance(node.ctx, ast.Load) \
+                            and not node.attr.startswith("__") \
+                            and isinstance(node.value, ast.Name) \
+                            and duck_recv(node.value):
+                        # may be a PROPERTY of a repo class (the
+                        # handler's `engine.draining` read) — matched
+                        # against @property defs by the consumer
+                        self.unresolved_attrs.setdefault(
+                            key, set()).add((node.attr, tuple(held)))
+                elif isinstance(node, ast.Name):
+                    kind = "write" if isinstance(
+                        node.ctx, (ast.Store, ast.Del)) else "read"
+                    record_name(node, kind, held, in_test)
+                elif isinstance(node, ast.Subscript) \
+                        and isinstance(node.ctx, (ast.Store, ast.Del)):
+                    owner = resolve_owner(node.value)
+                    if owner:
+                        accesses.append((owner, "write", node.lineno,
+                                         tuple(held), in_test))
+                    elif isinstance(node.value, ast.Name):
+                        record_name(node.value, "write", held, in_test)
+
+        def acquire_here(lid, stmt, held):
+            site = (ctx.path, stmt.lineno, ctx.line_text(stmt.lineno))
+            direct.add(lid)
+            self.acquire_fns.setdefault(lid, set()).add(key)
+            for h in held:
+                self._edge(h, lid, site)
+
+        def manual_lock_call(stmt):
+            """(lock id, 'acquire'|'release'|'enter_context') when the
+            statement is a bare manual lock operation; None otherwise."""
+            if not (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Attribute)):
+                return None
+            call, f = stmt.value, stmt.value.func
+            if f.attr in ("acquire", "release"):
+                lid = resolve_lock(f.value)
+                return (lid, f.attr) if lid else None
+            if f.attr == "enter_context" and call.args:
+                lid = resolve_lock(call.args[0])
+                return (lid, "enter_context") if lid else None
+            return None
+
+        def block_walk(stmts, held):
+            """Walk a statement sequence with RUNNING held state: a manual
+            acquire/enter_context extends it for the remaining siblings
+            (and their nested blocks), a release retires it."""
+            cur = list(held)
+            for s in stmts:
+                cur = stmt_walk(s, cur)
+            return cur
 
         def stmt_walk(stmt, held):
+            """Walk one statement under ``held``; returns the held list the
+            FOLLOWING sibling statements run under."""
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
-                return  # separate function keys
+                return held  # separate function keys
+            if isinstance(stmt, ast.Global):
+                fn_globals.update(stmt.names)
+                return held
             if isinstance(stmt, (ast.With, ast.AsyncWith)):
                 got = []
                 site = (ctx.path, stmt.lineno, ctx.line_text(stmt.lineno))
@@ -313,9 +530,10 @@ class LockGraph:
                         got.append(lid)
                     else:
                         scan_calls(item.context_expr, held)
-                for s in stmt.body:
-                    stmt_walk(s, held + got)
-                return
+                    if isinstance(item.optional_vars, ast.Name):
+                        fn_bound.add(item.optional_vars.id)
+                block_walk(stmt.body, held + got)
+                return held
             if isinstance(stmt, ast.Assign):
                 lid = resolve_lock(stmt.value) if isinstance(
                     stmt.value, (ast.Name, ast.Attribute)) else None
@@ -323,24 +541,58 @@ class LockGraph:
                     for t in stmt.targets:
                         if isinstance(t, ast.Name):
                             aliases[t.id] = lid
-            # scan this statement's own expressions (not nested stmts)
+            op = manual_lock_call(stmt)
+            if op is not None:
+                lid, what = op
+                scan_calls(stmt.value, held)
+                if what == "acquire":
+                    acquire_here(lid, stmt, held)
+                    man_acquires.append([lid, stmt.lineno, False])
+                    return held + [lid] if lid not in held else held
+                if what == "enter_context":
+                    # ExitStack owns the release — balanced by construction
+                    acquire_here(lid, stmt, held)
+                    return held + [lid] if lid not in held else held
+                # release: scan_calls already retired the man_acquires rec
+                out = list(held)
+                if lid in out:
+                    out.remove(lid)
+                return out
+            # scan this statement's own expressions (not nested stmts);
+            # an If/While TEST is marked so check-then-act can find reads
+            # whose decision a racing write invalidates
+            test = stmt.test if isinstance(stmt,
+                                           (ast.If, ast.While)) else None
             for child in ast.iter_child_nodes(stmt):
                 if isinstance(child, ast.expr):
-                    scan_calls(child, held)
+                    scan_calls(child, held, in_test=child is test)
                 elif isinstance(child, ast.withitem):
                     pass
-            for field in ("body", "orelse", "finalbody"):
+            if isinstance(stmt, ast.Try):
+                # sequential semantics for the acquire/try/finally idiom:
+                # the body's running held state flows into orelse/finally,
+                # and a finally release retires it for later siblings
+                cur = block_walk(stmt.body, held)
+                for h in stmt.handlers:
+                    block_walk(h.body, held)
+                cur = block_walk(stmt.orelse, cur)
+                return block_walk(stmt.finalbody, cur)
+            for field in ("body", "orelse"):
                 sub = getattr(stmt, field, None)
                 if isinstance(sub, list):
-                    for s in sub:
-                        if isinstance(s, ast.stmt):
-                            stmt_walk(s, held)
-            for h in getattr(stmt, "handlers", ()):
-                for s in h.body:
-                    stmt_walk(s, held)
+                    block_walk([s for s in sub
+                                if isinstance(s, ast.stmt)], held)
+            return held
 
-        for stmt in fnode.body:
-            stmt_walk(stmt, [])
+        block_walk(fnode.body, [])
+        for lid, line, released in man_acquires:
+            if not released:
+                self.unbalanced.append((lid, ctx.path, line, key))
+        for nm, kind, line, held, in_test in pending_globals:
+            if nm in fn_bound and nm not in fn_globals:
+                continue  # a local binding shadows the module global
+            accesses.append(("%s.%s" % (info.mod, nm), kind, line, held,
+                             in_test))
 
     def _typeof(self, info, cls, expr):
         if isinstance(expr, ast.Attribute) \
@@ -357,9 +609,9 @@ class LockGraph:
 
     def _class_method(self, owner, attr):
         for path, info in self.infos.items():
-            if owner in info.class_names and (owner + "." + attr) \
-                    in info.defs:
-                return (path, owner + "." + attr)
+            qn = info.method_index.get((owner, attr))
+            if qn:
+                return (path, qn)
         return None
 
     def _edge(self, src, dst, site):

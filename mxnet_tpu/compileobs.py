@@ -64,6 +64,8 @@ __all__ = [
 _log = logging.getLogger(__name__)
 
 _lock = threading.RLock()
+# race-ok: the one unlocked read is _rec()'s identity probe — a stale
+# record is detected and re-resolved under _lock on the next line
 _programs = {}  # program name -> _ProgramRecord
 _recompiles = []  # chronological recompile attributions (bounded)
 _MAX_RECOMPILE_LOG = 256
@@ -385,6 +387,8 @@ class ObservedJit:
         rec = self._record
         if _programs.get(rec.name) is not rec:
             rec = _record(rec.name, rec.site, rec.digest)
+            # race-ok: reference rebind to an equivalent record; racing
+            # threads re-register the same (name, site, digest) idempotently
             self._record = rec
         return rec
 

@@ -21,8 +21,8 @@ __all__ = [
     "mark_variables", "backward", "compute_gradient", "grad_and_loss", "grad",
 ]
 
-_RECORDING = [False]
-_TAPE = []  # list of (op_name, attrs, [input NDArray ids], [output NDArrays])
+_RECORDING = [False]  # thread-confined: the imperative tape records on the user's training thread only (reference semantics: autograd state is per-thread)
+_TAPE = []  # thread-confined: see _RECORDING — (op_name, attrs, [input NDArray ids], [output NDArrays])
 _MARKED = {}  # id(NDArray) -> (NDArray, grad NDArray, grad_req)
 
 

@@ -89,6 +89,8 @@ class ElasticSession:
         # effective (num_workers, rank) under the current membership —
         # epoch-scoped: reconfigure()/join() move it, the data partition
         # follows it
+        # race-ok: atomic tuple rebind on the restart path; stats readers
+        # tolerate sampling the previous membership for one tick
         self.effective = (kv.num_workers, kv.rank)
         self._stop = threading.Event()
         self._hb_thread = None

@@ -302,6 +302,9 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
+# race-ok: producer and consumer alternate strict turns through the
+# data_taken/data_ready Event handshake — each slot is owned by exactly one
+# side at any moment, and Event.set/wait give the happens-before edge
 class PrefetchingIter(DataIter):
     """Threaded prefetcher over one or more iters (reference: io.py:319; the
     C++ analog is dmlc::ThreadedIter in iter_prefetcher.h)."""

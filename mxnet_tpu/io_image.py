@@ -77,6 +77,10 @@ def _mean_std(mean_r, mean_g, mean_b, std_r, std_g, std_b):
     return mean, std
 
 
+# race-ok: the reader -> decode-worker -> batcher pipeline hands records
+# through bounded Queues (their internal locks give the happens-before
+# edge); each stage touches disjoint fields between handoffs, and reset()
+# only runs after every stage thread joined
 class ImageRecordIter(DataIter):
     _label_pad = 0.0
 

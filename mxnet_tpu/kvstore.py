@@ -161,7 +161,7 @@ class _StepSyncMeter:
     def __init__(self):
         self._lock = threading.Lock()
         self.busy_seconds = 0.0  # guarded-by: _lock
-        self.wait_seconds = 0.0
+        self.wait_seconds = 0.0  # guarded-by: _lock
 
     def add_busy(self, seconds):
         with self._lock:
@@ -184,7 +184,10 @@ class _StepSyncMeter:
         try:
             return fn()
         finally:
-            self.wait_seconds += time.perf_counter() - t0
+            # += is a read-modify-write: unlocked it can lose a concurrent
+            # add_busy-thread's increment against overlap_seconds readers
+            with self._lock:
+                self.wait_seconds += time.perf_counter() - t0
 
     def overlap_seconds(self):
         with self._lock:

@@ -43,6 +43,8 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange", "conc
 
 # ring of recently produced arrays so waitall() can block on outstanding work
 # (reference: Engine::WaitForAll, include/mxnet/engine.h:176)
+# race-ok: deque.append is atomic (GIL); racing appends only perturb the
+# cosmetic eviction order of a best-effort ring
 _RECENT = collections.deque(maxlen=4096)
 
 # every live NDArray, weakly held — the allocation registry behind
@@ -65,6 +67,8 @@ def live_arrays():
     return [a for a in arrs if a._base is None]
 
 
+# race-ok: idempotent memo — two threads tracing the same op key race to
+# insert identical values; the loser's work is wasted, never wrong
 _JIT_CACHE = {}
 
 
@@ -183,6 +187,9 @@ def imperative_invoke(op_name, ndargs, attrs, out=None):
     return results
 
 
+# thread-confined: an NDArray is owned by one thread at a time; the cross-
+# thread handoffs in this repo (device feed queue, serving batcher) publish
+# the finished array through a synchronized queue, never mutate it after
 class NDArray:
     """An n-dimensional array on a device context."""
 

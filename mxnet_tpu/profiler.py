@@ -20,19 +20,25 @@ import time
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "emit_span", "is_running"]
 
+# module-level so lock analysis (and the runtime witness) can name it;
+# a dict slot is invisible to both
+_lock = threading.Lock()
+
+# race-ok: mutation happens under _lock; the hot-path reads ("running",
+# "mode") are single-slot bool/str samples — a stale sample drops or keeps
+# one span, and emit_span re-checks under the lock before appending
 _state = {
     "mode": "symbolic",
     "filename": "profile.json",
     "running": False,
     "events": [],
     "jax_trace_dir": None,
-    "lock": threading.Lock(),
 }
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
     """(reference: profiler.py profiler_set_config; modes 'symbolic'|'all')"""
-    with _state["lock"]:
+    with _lock:
         _state["mode"] = mode
         _state["filename"] = filename
 
@@ -40,13 +46,13 @@ def profiler_set_config(mode="symbolic", filename="profile.json"):
 def profiler_set_state(state="stop"):
     """'run' | 'stop' (reference: profiler.py profiler_set_state).
 
-    State transitions and the event-buffer swap run under ``_state["lock"]``:
+    State transitions and the event-buffer swap run under ``_lock``:
     a span completing on a worker thread while another thread restarts the
     profiler must land in exactly one of the old/new buffers, never corrupt
     the list mid-swap (the jax trace start/stop rides along under the same
     lock — it is rare and must not interleave with a concurrent toggle).
     """
-    with _state["lock"]:
+    with _lock:
         if state == "run" and not _state["running"]:
             _state["running"] = True
             _state["events"] = []
@@ -74,7 +80,7 @@ def is_running():
     return _state["running"]
 
 
-_reserved = None
+_reserved = None  # race-ok: idempotent lazy cache of a constant — racing initializers store the same int
 
 
 def _reserved_tid():
@@ -117,7 +123,7 @@ def emit_span(name, category, wall_t0, dur_s, args=None, tid=None):
     }
     if args:
         ev["args"] = dict(args)
-    with _state["lock"]:
+    with _lock:
         if not _state["running"]:
             return
         _state["events"].append(ev)
@@ -177,7 +183,7 @@ def dump_profile():
     process also emits a ``process_name`` metadata row naming its rank, so
     ``tools/trace_merge.py`` can assign the file to a lane without
     guessing from pids."""
-    with _state["lock"]:
+    with _lock:
         events = sorted(_state["events"],
                         key=lambda e: (e.get("tid", 0), e.get("ts", 0)))
         filename = _state["filename"]

@@ -31,6 +31,9 @@ def _decode_lrec(lrec):
     return (lrec >> 29) & 7, lrec & ((1 << 29) - 1)
 
 
+# thread-confined: a record file object belongs to a single thread —
+# concurrent use of one reader is unsupported (reference semantics), and
+# io_image opens a private reader per pipeline stage
 class MXRecordIO:
     """Sequential .rec reader/writer (reference: recordio.py:19).
 
@@ -185,6 +188,7 @@ class MXRecordIO:
                 return b"".join(parts)
 
 
+# thread-confined: same single-owner contract as MXRecordIO
 class MXIndexedRecordIO(MXRecordIO):
     """Random-access .rec via .idx file (reference: recordio.py:153)."""
 

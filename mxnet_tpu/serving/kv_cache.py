@@ -40,6 +40,7 @@ import threading
 import numpy as np
 
 from .. import fault, telemetry
+from ..analysis import witness
 from ..base import MXNetError
 
 
@@ -82,6 +83,8 @@ class KVBlockPool:
         self.k_pages = k
         self.v_pages = v
         self._lock = threading.Lock()
+        self._lock = witness.declare(
+            "mxnet_tpu.serving.kv_cache.KVBlockPool._lock", self._lock)
         # LIFO free list, block 0 excluded (trash)
         self._free = list(range(self.num_blocks - 1, 0, -1))
         # block id -> refcount, allocated blocks only (never block 0)
@@ -98,7 +101,11 @@ class KVBlockPool:
         self.prefix_hit_blocks = 0
         self.cow_copies = 0
         telemetry.gauge("serving.kv_blocks_total").set(self.num_usable)
-        self._refresh_gauges_locked()
+        # the pool may be constructed on a supervisor thread while handler
+        # threads already poll the gauges of a predecessor — honor the
+        # _locked suffix even on the init path
+        with self._lock:
+            self._refresh_gauges_locked()
 
     # ---- capacity -------------------------------------------------------
     @property

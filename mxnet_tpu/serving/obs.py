@@ -79,6 +79,9 @@ BURN_THRESHOLD = 0.9
 _BURN_MIN_SAMPLES = 8
 
 
+# thread-confined: a trace is mutated only by the thread stepping its
+# request (driver thread under the engine lock); handler threads read it
+# only after finish() publishes the request under that same lock
 class RequestTrace:
     """One request's phase clock: exactly one open phase at any moment.
 

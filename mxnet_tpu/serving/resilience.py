@@ -42,6 +42,7 @@ import threading
 import time
 
 from .. import telemetry
+from ..analysis import witness
 from ..base import MXNetError, env_float, env_int
 from .scheduler import FAILED
 
@@ -122,6 +123,8 @@ class EngineSupervisor:
             else env_float("MXNET_SERVING_RESTART_BACKOFF_MAX_MS", 5000.0)
             / 1000.0)
         self._lock = threading.Lock()
+        self._lock = witness.declare(
+            "mxnet_tpu.serving.resilience.EngineSupervisor._lock", self._lock)
         self._restarts = 0
         self._restarting = False
         self._failed_msg = None     # permanent: restart budget exhausted

@@ -249,10 +249,10 @@ _lock = threading.RLock()
 _metrics = {}  # rendered key -> instrument
 _name_types = {}  # bare name -> instrument class (Prometheus: one type/name)
 _events = deque(maxlen=1024)
-_enabled = False
+_enabled = False  # race-ok: config-time bool rebind; a reader that samples the old value emits (or skips) one event, never corrupts state
 _flusher = None  # guarded-by: _lock — (thread, stop_event, path, interval)
 _file_lock = threading.Lock()  # serializes sink appends (flusher vs events)
-_rank = None  # this process's worker rank (distributed runs); None = unset
+_rank = None  # race-ok: set once at launch/kvstore init (int-or-None rebind); this process's worker rank, None = unset
 _collectors = []  # guarded-by: _lock — read-time refresh hooks (compileobs memory gauges)
 
 
@@ -815,6 +815,17 @@ METRIC_HELP = {
     "serving.drains":
         "graceful drains begun (SIGTERM / POST /drain / start_drain): "
         "admission closed, inflight work finishing (always-on)",
+    "lock.held_seconds":
+        "hold time per witness-declared lock (MXNET_LOCK_WITNESS; "
+        "always-on while the witness is enabled)",
+    "lock.contention":
+        "witnessed acquisitions that found the lock already taken "
+        "(always-on while the witness is enabled)",
+    "lock.order_violations":
+        "classified lock-order violations the runtime witness observed: "
+        "order inversions + edges absent from the static lock graph "
+        "(always-on while the witness is enabled; strict mode also "
+        "raises)",
 }
 
 

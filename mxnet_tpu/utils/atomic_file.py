@@ -56,6 +56,8 @@ class ChecksumError(MXNetError):
     """Payload bytes do not match the file's CRC32 footer."""
 
 
+# thread-confined: wraps one open temp file for the duration of a single
+# atomic_write, owned end-to-end by the writing thread
 class _ChecksummedWriter:
     """File-like wrapper: running CRC32 + optional injected byte budget."""
 
@@ -204,6 +206,8 @@ def read_verified(path):
         return verify_and_strip(f.read())
 
 
+# thread-confined: wraps one stream for one parser; the stream itself is
+# never shared across threads (each pipeline stage opens its own)
 class PushbackReader:
     """The one seek shape self-delimiting parsers use to peek — a backward
     relative seek within the most recent read — emulated with a pushback

@@ -12,6 +12,7 @@ this file from the hardware gate). `ci/run_tests.sh lint` is the CI tier.
 import os
 import sys
 import textwrap
+import threading
 
 import pytest
 
@@ -1349,7 +1350,9 @@ def test_repo_is_clean_under_committed_baseline():
 
 
 @pytest.mark.parametrize("rule", ["device-escape", "trace-impure",
-                                  "recompile-hazard", "lock-order"])
+                                  "recompile-hazard", "lock-order",
+                                  "unguarded-shared-write", "check-then-act",
+                                  "unbalanced-acquire", "guard-mismatch"])
 def test_new_rules_repo_clean_or_baselined(rule, _repo_lint):
     """Per-rule acceptance: each new rule family runs repo-wide and every
     finding it raises is frozen in the committed baseline (the ratchet
@@ -1595,3 +1598,672 @@ def test_sanitizer_env_configuration(monkeypatch):
         assert sanitizer.mode() is None  # garbage degrades to off, no crash
     finally:
         sanitizer.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# concurrency analyzer: thread roots, shared state, guards
+# ---------------------------------------------------------------------------
+
+def test_shared_write_two_roots_positive():
+    """A field written from a spawned thread AND from main, with no lock
+    anywhere: the canonical race the annotation-driven rules cannot see."""
+    src = """
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self.count = 0
+
+        def _worker(self):
+            self.count = self.count + 1
+
+        def start(self):
+            threading.Thread(target=self._worker, name="stats-worker").start()
+
+        def reset(self):
+            self.count = 0
+    """
+    found = lint(src, select=["unguarded-shared-write"])
+    assert len(found) == 1
+    f = found[0]
+    assert f.line == 9  # the first unguarded write anchors the finding
+    assert "thread(stats-worker)" in f.message and "main" in f.message
+    assert "no lock held at any access" in f.message
+    # the chain names BOTH racing roots and every bad write site
+    assert any("thread(stats-worker)" in s for s in f.chain)
+    assert any("root main" in s for s in f.chain)
+    assert any("Stats.reset" in s for s in f.chain)
+
+
+def test_publish_once_is_clean():
+    """Writes confined to __init__ are publication, not a race — reads
+    from any number of roots stay silent."""
+    src = """
+    import threading
+
+    class Cfg:
+        def __init__(self):
+            self.limit = 8
+
+        def _worker(self):
+            return self.limit
+
+        def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def read(self):
+            return self.limit
+    """
+    assert lint(src, select=["unguarded-shared-write"]) == []
+
+
+def test_dominant_lock_outlier():
+    """Three of four accesses hold the lock: it is the inferred guard, and
+    the one bypassing write is the finding (message proposes guarded-by)."""
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.val = 0
+
+        def _worker(self):
+            with self._lock:
+                self.val = self.val + 1
+
+        def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def read(self):
+            with self._lock:
+                return self.val
+
+        def smash(self):
+            self.val = 0
+    """
+    found = lint(src, select=["unguarded-shared-write"])
+    assert len(found) == 1
+    f = found[0]
+    assert f.context == "Box.smash"  # the outlier, not the guarded sites
+    assert "guarded by mxnet_tpu.fake.Box._lock at 3 of 4 accesses" \
+        in f.message
+    assert "# guarded-by: _lock" in f.message
+    assert any("guarded access under" in s for s in f.chain)
+
+
+def test_fully_guarded_single_access_is_clean():
+    """Regression: ONE live access, lock held — the dominant-lock vote
+    used to null the lock below two holders and then flag the guarded
+    write itself. Every-access-holds-the-lock must stay silent."""
+    src = """
+    import threading
+
+    class Sched:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.preempts = 0
+
+        def _bump(self):
+            with self._lock:
+                self.preempts = 1
+
+        def _loop(self):
+            self._bump()
+
+        def start(self):
+            threading.Thread(target=self._loop).start()
+
+        def drive(self):
+            self._bump()
+    """
+    assert lint(src, select=["unguarded-shared-write"]) == []
+
+
+def test_check_then_act_positive():
+    src = """
+    import threading
+
+    class Gate:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.open = False
+
+        def _worker(self):
+            with self._lock:
+                self.open = True
+
+        def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def maybe_close(self):
+            if self.open:
+                with self._lock:
+                    self.open = False
+    """
+    found = lint(src, select=["check-then-act"])
+    assert len(found) == 1
+    f = found[0]
+    assert f.line == 17  # anchored at the unlocked read in the test
+    assert "check-then-act on shared state mxnet_tpu.fake.Gate.open" \
+        in f.message
+    assert "the write at line 19" in f.message
+
+
+def test_check_then_act_negative_lock_spans_test_and_set():
+    src = """
+    import threading
+
+    class Gate:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.open = False
+
+        def _worker(self):
+            with self._lock:
+                self.open = True
+
+        def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def maybe_close(self):
+            with self._lock:
+                if self.open:
+                    self.open = False
+    """
+    assert lint(src, select=["check-then-act"]) == []
+
+
+def test_alias_resolved_guard_is_clean():
+    """`lk = self._lock; with lk:` is the same guard — the alias resolves
+    through lockgraph's local-binding pass, so no outlier is reported."""
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.val = 0
+
+        def _worker(self):
+            lk = self._lock
+            with lk:
+                self.val = 1
+
+        def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def read(self):
+            with self._lock:
+                return self.val
+    """
+    assert lint(src, select=["unguarded-shared-write"]) == []
+
+
+def test_handler_thread_root_and_per_connection_exemption():
+    """A request-handler class is a thread root (one connection = one
+    handler thread): a module global it writes races main, but its own
+    self-state is per-connection and exempt wholesale."""
+    src = """
+    from http.server import BaseHTTPRequestHandler
+
+    hits = 0
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            global hits
+            hits = hits + 1
+            self.cache = 1
+
+    def report():
+        return hits
+    """
+    found = lint(src, select=["unguarded-shared-write"])
+    assert len(found) == 1
+    f = found[0]
+    assert "mxnet_tpu.fake.hits" in f.message
+    assert "http-handler(Handler)" in f.message
+    assert "Handler.cache" not in "".join(x.message for x in found)
+
+
+def test_race_ok_annotation_needs_a_reason():
+    base = """
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self.count = 0{ann}
+
+        def _worker(self):
+            self.count = self.count + 1
+
+        def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def reset(self):
+            self.count = 0
+    """
+    with_reason = base.format(
+        ann="  # race-ok: a monotonically wrong debug tally")
+    assert lint(with_reason, select=["unguarded-shared-write"]) == []
+    bare = base.format(ann="  # race-ok:")
+    assert len(lint(bare, select=["unguarded-shared-write"])) == 1
+    # class-level form: the whole class's attrs are exempt
+    confined = base.format(ann="").replace(
+        "class Stats:",
+        "# thread-confined: built fresh inside every test\n    class Stats:")
+    assert lint(confined, select=["unguarded-shared-write"]) == []
+
+
+def test_unbalanced_acquire_positive_and_handoff_negative():
+    src = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self):
+            self._lock.acquire()
+            return 1
+    """
+    found = lint(src, select=["unbalanced-acquire"])
+    assert len(found) == 1
+    assert "_lock.acquire() with no release() in A.bad" in found[0].message
+    # balanced try/finally and the __enter__/__exit__-style cross-function
+    # handoff are both fine
+    src_ok = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def hold(self):
+            self._lock.acquire()
+
+        def drop(self):
+            self._lock.release()
+
+        def balanced(self):
+            self._lock.acquire()
+            try:
+                return 1
+            finally:
+                self._lock.release()
+    """
+    assert lint(src_ok, select=["unbalanced-acquire"]) == []
+
+
+def test_guard_mismatch_positive_and_negative():
+    src = """
+    import threading
+
+    class B:
+        def __init__(self):
+            self.lk_a = threading.Lock()
+            self.lk_b = threading.Lock()
+            self.val = 0  # guarded-by: lk_a
+
+        def _worker(self):
+            with self.lk_b:
+                self.val = self.val + 1
+
+        def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def read(self):
+            with self.lk_b:
+                return self.val
+    """
+    found = lint(src, select=["guard-mismatch"])
+    assert len(found) == 1
+    f = found[0]
+    assert f.line == 8  # the lying annotation, not the accesses
+    assert "annotated `# guarded-by: lk_a`" in f.message
+    assert "actually hold mxnet_tpu.fake.B.lk_b" in f.message
+    fixed = src.replace("guarded-by: lk_a", "guarded-by: lk_b")
+    assert lint(fixed, select=["guard-mismatch"]) == []
+
+
+def test_select_is_per_rule_for_multi_rule_checkers():
+    """The concurrency checker carries four rules: selecting one must not
+    leak findings for the others (the shape that seeds both a race and a
+    check-then-act fires exactly the selected family)."""
+    src = """
+    import threading
+
+    class Gate:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.open = False
+
+        def _worker(self):
+            with self._lock:
+                self.open = True
+
+        def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def maybe_close(self):
+            if self.open:
+                with self._lock:
+                    self.open = False
+    """
+    assert rules_of(lint(src, select=["check-then-act"])) \
+        == ["check-then-act"]
+    assert rules_of(lint(src, select=["unguarded-shared-write"])) \
+        == ["unguarded-shared-write"]
+
+
+def test_concurrency_debt_is_bounded_and_cannot_regrow():
+    """The round-20 triage burned the concurrency debt down to the eight
+    KVStoreDist client-side entries; three of the four rule families are
+    at zero. The ratchet (plus this cap) keeps it shrink-only."""
+    import json as _json
+
+    doc = _json.load(open(os.path.join(ROOT, "ci",
+                                       "fwlint_baseline.json")))
+    rules = [rec["rule"] for rec in doc["findings"].values()]
+    assert rules.count("unguarded-shared-write") <= 8
+    for r in ("check-then-act", "unbalanced-acquire", "guard-mismatch"):
+        assert rules.count(r) == 0, "new %s debt froze into the baseline" % r
+    assert all(rec["path"] == "mxnet_tpu/kvstore.py"
+               for rec in doc["findings"].values()
+               if rec["rule"] == "unguarded-shared-write")
+
+
+def test_cli_dump_thread_roots(capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fwlint_cli5", os.path.join(ROOT, "tools", "fwlint.py"))
+    cli_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli_mod)
+    assert cli_mod.main(["--dump-thread-roots", "--root", ROOT]) == 0
+    out = capsys.readouterr().out
+    # real discovery, not a vacuous table: the profiler's atexit hook, a
+    # named repo thread, and the implicit main root all appear
+    assert "atexit(_dump_at_exit)" in out
+    assert "thread(mxnet-kv-membership-monitor)" in out
+    assert "main  (spawned at <main>:0" in out
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def witness_mode():
+    from mxnet_tpu.analysis import witness
+
+    witness.reset_observations()
+    yield witness
+    witness.configure(None)
+    witness.seed_static(None)
+    witness.reset_observations()
+
+
+def test_witness_off_is_pristine(witness_mode):
+    w = witness_mode
+    w.configure(None)
+    lk = threading.Lock()
+    # acceptance: zero instrumentation when off — declare() hands back the
+    # very same stdlib object, not a proxy
+    assert w.declare("mxnet_tpu.fake.Off._lock", lk) is lk
+
+
+def test_witness_env_configuration(monkeypatch, witness_mode):
+    w = witness_mode
+    monkeypatch.setenv("MXNET_LOCK_WITNESS", "strict")
+    w._mode = w._UNSET  # force a re-read of the env
+    assert w.mode() == "strict" and w.active()
+    monkeypatch.setenv("MXNET_LOCK_WITNESS", "bogus")
+    w._mode = w._UNSET
+    assert w.mode() is None  # garbage degrades to off, no crash
+
+
+def test_witness_warn_counters(witness_mode):
+    w = witness_mode
+    w.configure("warn")
+    a = w.declare("mxnet_tpu.fake.WA", threading.Lock())
+    b = w.declare("mxnet_tpu.fake.WB", threading.Lock())
+    order_before = telemetry.counter(w.COUNTER_ORDER).value
+    held_before = telemetry.histogram(w.HELD_HISTOGRAM,
+                                      lock="mxnet_tpu.fake.WA").count
+    with a:
+        with b:
+            pass
+    assert ("mxnet_tpu.fake.WA", "mxnet_tpu.fake.WB") in w.observed_edges()
+    assert telemetry.histogram(w.HELD_HISTOGRAM,
+                               lock="mxnet_tpu.fake.WA").count \
+        == held_before + 1
+    # the reverse nesting is an order inversion: counted, logged, NO raise
+    with b:
+        with a:
+            pass
+    assert telemetry.counter(w.COUNTER_ORDER).value == order_before + 1
+    # contention: a failed first probe is counted even when non-blocking
+    c = w.declare("mxnet_tpu.fake.WC", threading.Lock())
+    cont_before = telemetry.counter(w.CONTENTION_COUNTER,
+                                    lock="mxnet_tpu.fake.WC").value
+    assert c.acquire() is True
+    assert c.acquire(blocking=False) is False
+    assert telemetry.counter(w.CONTENTION_COUNTER,
+                             lock="mxnet_tpu.fake.WC").value \
+        == cont_before + 1
+    c.release()
+
+
+def test_witness_strict_raises_and_releases(witness_mode):
+    w = witness_mode
+    w.configure("strict")
+    a = w.declare("mxnet_tpu.fake.SA", threading.Lock())
+    b = w.declare("mxnet_tpu.fake.SB", threading.Lock())
+    with a:
+        with b:
+            pass
+    with pytest.raises(w.LockWitnessError) as ei:
+        with b:
+            with a:
+                pass
+    assert ei.value.kind == "order_inversion"
+    assert isinstance(ei.value, MXNetError)
+    # the failed acquisition holds nothing: the inner lock was handed back
+    # when the violation raised out of acquire()
+    assert a.acquire(blocking=False) is True
+    a.release()
+    assert b.acquire(blocking=False) is True
+    b.release()
+
+
+def test_witness_strict_unknown_edge(witness_mode):
+    w = witness_mode
+    w.configure("strict")
+    a = w.declare("mxnet_tpu.fake.UA", threading.Lock())
+    b = w.declare("mxnet_tpu.fake.UB", threading.Lock())
+    c = w.declare("mxnet_tpu.fake.UC", threading.Lock())
+    w.seed_static({("mxnet_tpu.fake.UA", "mxnet_tpu.fake.UB")})
+    with a:      # the statically known edge passes silently
+        with b:
+            pass
+    with pytest.raises(w.LockWitnessError) as ei:
+        with a:  # A->C is an edge the static graph does not contain
+            with c:
+                pass
+    assert ei.value.kind == "unknown_edge"
+
+
+def test_witness_static_dynamic_agreement_three_locks(witness_mode):
+    """Acceptance harness: seed the witness from lockgraph's OWN edge set
+    for a 3-lock hierarchy, replay the same nesting at runtime in strict
+    mode — zero violations; an off-graph nesting raises."""
+    from mxnet_tpu.analysis import lockgraph
+
+    w = witness_mode
+    src = textwrap.dedent("""
+    import threading
+
+    class Eng:
+        def __init__(self):
+            self.la = threading.Lock()
+            self.lb = threading.Lock()
+            self.lc = threading.Lock()
+
+        def step(self):
+            with self.la:
+                with self.lb:
+                    with self.lc:
+                        pass
+    """)
+    graph = lockgraph.build([fwlint.FileContext("mxnet_tpu/fake3.py", src)])
+    edges = set(graph.edges)
+    ids = {s for e in edges for s in e}
+    assert edges == {("mxnet_tpu.fake3.Eng.la", "mxnet_tpu.fake3.Eng.lb"),
+                     ("mxnet_tpu.fake3.Eng.la", "mxnet_tpu.fake3.Eng.lc"),
+                     ("mxnet_tpu.fake3.Eng.lb", "mxnet_tpu.fake3.Eng.lc")}
+    w.configure("strict")
+    w.seed_static(edges)
+    la, lb, lc = (w.declare(i, threading.Lock()) for i in sorted(ids))
+    before = telemetry.counter(w.COUNTER_ORDER).value
+    with la:
+        with lb:
+            with lc:
+                pass
+    with la:
+        with lc:  # skipping the middle lock is still a static edge
+            pass
+    assert telemetry.counter(w.COUNTER_ORDER).value == before
+    assert w.observed_edges() == edges
+    ld = w.declare("mxnet_tpu.fake3.Eng.ld", threading.Lock())
+    with pytest.raises(w.LockWitnessError) as ei:
+        with la:
+            with ld:
+                pass
+    assert ei.value.kind == "unknown_edge"
+    assert telemetry.counter(w.COUNTER_ORDER).value == before + 1
+
+
+def test_witness_condition_integration(witness_mode):
+    """Condition(witnessed_lock) must work end-to-end: wait() releases the
+    proxy for the notifier thread and the hold-time histogram observes
+    each distinct hold."""
+    w = witness_mode
+    w.configure("warn")
+    lk = w.declare("mxnet_tpu.fake.CV._lock", threading.RLock())
+    cv = threading.Condition(lk)
+    hits = []
+
+    def poke():
+        with cv:
+            hits.append(1)
+            cv.notify_all()
+
+    t = threading.Thread(target=poke, name="witness-poke", daemon=True)
+    held_before = telemetry.histogram(w.HELD_HISTOGRAM,
+                                      lock="mxnet_tpu.fake.CV._lock").count
+    with cv:
+        t.start()
+        cv.wait(timeout=5.0)
+    t.join(timeout=5.0)
+    assert hits == [1]
+    # at least: the waiter's pre-wait hold and the notifier's hold
+    assert telemetry.histogram(w.HELD_HISTOGRAM,
+                               lock="mxnet_tpu.fake.CV._lock").count \
+        >= held_before + 2
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the races the analyzer found in this repo
+# ---------------------------------------------------------------------------
+
+class _ProbeLock:
+    """Counts acquisitions; delegates the actual exclusion to an RLock."""
+
+    def __init__(self):
+        self.acquires = 0
+        self._lk = threading.RLock()
+
+    def acquire(self, blocking=True, timeout=-1):
+        self.acquires += 1
+        return self._lk.acquire(blocking, timeout)
+
+    def release(self):
+        self._lk.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def test_engine_abort_flags_read_under_lock():
+    """serving.engine races fixed this round: handler threads poll
+    `draining`/`aborted` against the driver's locked writes — the
+    properties must take the engine lock."""
+    from mxnet_tpu.serving import engine as serving_engine
+
+    eng = object.__new__(serving_engine.ServingEngine)
+    probe = _ProbeLock()
+    eng._lock = probe
+    eng._draining = True
+    eng._aborted = "boom"
+    assert eng.draining is True
+    assert eng.aborted == "boom"
+    assert probe.acquires == 2
+
+
+def test_step_sync_meter_wait_accumulates_under_lock():
+    """kvstore._StepSyncMeter race fixed this round: `wait_seconds +=` is
+    a read-modify-write racing engine-thread add_busy() calls — it must
+    hold the meter lock like every other accumulation."""
+    from mxnet_tpu import kvstore as kv_mod
+
+    m = kv_mod._StepSyncMeter()
+    probe = _ProbeLock()
+    m._lock = probe
+    m.wait(lambda: None)
+    assert probe.acquires == 1 and m.wait_seconds > 0.0
+    m.add_busy(0.25)
+    assert probe.acquires == 2
+    assert 0.0 < m.overlap_seconds() <= 0.25
+    assert probe.acquires == 3
+
+
+def test_membership_resume_from_seeds_under_lock():
+    """kvstore_server race fixed this round: registry failover re-runs
+    _resume_from on a live object whose monitor thread is scanning the
+    same maps — the whole seed must happen under the registry lock."""
+    from mxnet_tpu import kvstore_server as kvs
+
+    reg = object.__new__(kvs.MembershipRegistry)
+    probe = _ProbeLock()
+    reg._lock = probe
+    reg._resume_from({"epoch": 3, "formed": True, "done": False,
+                      "pos": None, "steps": {"0": 7},
+                      "workers": {"0": 0.1}, "servers": {"1": 0.2},
+                      "smap": [1, None], "srv_monitoring": True})
+    assert probe.acquires == 1
+    assert reg._epoch == 3 and reg._formed is True
+    assert reg._smap == [1, None] and 1 in reg._srv_alive
+
+
+def test_kv_pool_init_refreshes_gauges_under_lock():
+    """serving.kv_cache race fixed this round: the pool may be built on a
+    supervisor thread while handler threads poll a predecessor's gauges —
+    the init-path gauge refresh honors the _locked suffix."""
+    from mxnet_tpu.serving import kv_cache as kvc
+
+    calls = []
+
+    class Probe(kvc.KVBlockPool):
+        def _refresh_gauges_locked(self):
+            calls.append(self._lock.locked())
+            return super()._refresh_gauges_locked()
+
+    Probe(num_layers=1, num_blocks=2, block_size=2, num_heads=1,
+          head_dim=2)
+    assert calls and calls[0] is True

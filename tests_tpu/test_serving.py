@@ -799,3 +799,51 @@ def test_e2e_32_concurrent_http_streams_match_sequential(tmp_path):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_lock_witness_strict_clean_on_serving_engine():
+    """Acceptance: MXNET_LOCK_WITNESS=strict over a live concurrent
+    serving workload — handler-thread submits racing the driver loop —
+    raises nothing and adds zero lock.order_violations: the runtime
+    nesting of the engine/pool/supervisor locks agrees with the static
+    lock graph."""
+    from mxnet_tpu.analysis import witness
+
+    witness.reset_observations()
+    before = telemetry.counter(witness.COUNTER_ORDER).value
+    witness.configure("strict")  # BEFORE construction: locks wrap in init
+    try:
+        eng = ServingEngine(_config(), seed=SEED)
+        stop = threading.Event()
+        errs = []
+
+        def drive():
+            try:
+                eng.run_loop(stop, idle_wait_s=0.005)
+            except Exception as exc:   # noqa: BLE001 — assert below
+                errs.append(exc)
+
+        t = threading.Thread(target=drive, name="witness-driver",
+                             daemon=True)
+        t.start()
+        reqs = [eng.submit([1 + i, 2, 3], 3) for i in range(4)]
+        for r in reqs:
+            assert r.done_event.wait(timeout=60), "request stalled"
+        stop.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert errs == [], "witness violation in the serving engine: %r" \
+            % errs
+        assert all(r.state == "finished" for r in reqs)
+        # the witness actually watched: the engine lock was exercised
+        assert any("ServingEngine._lock" in name
+                   for edge in witness.observed_edges() for name in edge) \
+            or telemetry.histogram(
+                witness.HELD_HISTOGRAM,
+                lock="mxnet_tpu.serving.engine.ServingEngine._lock").count \
+            > 0
+        assert telemetry.counter(witness.COUNTER_ORDER).value == before
+    finally:
+        witness.configure(None)
+        witness.seed_static(None)
+        witness.reset_observations()

@@ -466,6 +466,10 @@ def _retry_harness():
     kv._server_addrs = [("127.0.0.1", 12345)]
     kv._num_servers = 1
     kv._clients = [object()]
+    # group routing (server HA): one group, itself primary — the identity
+    # map _sid_for degenerates to with no replicas
+    kv._smap = [0]
+    kv._ngroups = 1
     return kv
 
 

@@ -67,6 +67,9 @@ def main(argv=None):
     ap.add_argument("--dump-lock-graph", action="store_true",
                     help="print the whole-repo lock-acquisition graph as "
                          "DOT (cycle nodes/edges in red) and exit")
+    ap.add_argument("--dump-thread-roots", action="store_true",
+                    help="print the inferred thread roots and the function "
+                         "set reachable from each, then exit")
     ap.add_argument("--explain", default=None, metavar="FINGERPRINT",
                     help="print the dataflow chain behind one finding "
                          "(fingerprint prefix accepted; lints the default "
@@ -97,6 +100,20 @@ def main(argv=None):
             print("// %d cycle(s): %s" % (len(cycles), cycles),
                   file=sys.stderr)
             return 1
+        return 0
+
+    if args.dump_thread_roots:
+        import importlib
+
+        concurrency = importlib.import_module(
+            "mxnet_tpu.analysis.concurrency")
+        paths = args.paths or list(DEFAULT_PATHS)
+        try:
+            ctxs, _errs = analysis.fwlint.load_contexts(paths, args.root)
+        except FileNotFoundError as err:
+            print(err, file=sys.stderr)
+            return 2
+        print(concurrency.build_model(ctxs).dump_roots())
         return 0
 
     select = ([r.strip() for r in args.select.split(",") if r.strip()]
